@@ -1,0 +1,185 @@
+"""Tests for TaskInfo/JobInfo/NodeInfo accounting, mirroring the reference's
+job_info_test.go / node_info_test.go."""
+
+import pytest
+
+from volcano_tpu.models import (JobInfo, NodeInfo, TaskInfo, TaskStatus,
+                                objects)
+from volcano_tpu.models.objects import (Container, Node, NodeStatus, ObjectMeta,
+                                        Pod, PodGroup, PodGroupSpec, PodSpec,
+                                        PodStatus)
+from volcano_tpu.models.resource import Resource, ZERO
+
+
+def build_pod(ns, name, nodename, phase, req, groupname="", priority=None, uid=None):
+    """Analogue of util.BuildPod (reference: pkg/scheduler/util/test_utils.go:38)."""
+    ann = {objects.GROUP_NAME_ANNOTATION: groupname} if groupname else {}
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, uid=uid or f"{ns}-{name}",
+                            annotations=ann),
+        spec=PodSpec(containers=[Container(requests=req)], node_name=nodename,
+                     priority=priority),
+        status=PodStatus(phase=phase),
+    )
+
+
+def build_node(name, alloc, labels=None):
+    return Node(metadata=ObjectMeta(name=name, labels=labels or {}),
+                status=NodeStatus(allocatable=alloc, capacity=alloc))
+
+
+CPU1_MEM1 = {"cpu": "1", "memory": "1Gi"}
+CPU2_MEM2 = {"cpu": "2", "memory": "2Gi"}
+CPU8_MEM8 = {"cpu": "8", "memory": "8Gi"}
+
+
+class TestTaskInfo:
+    def test_status_mapping(self):
+        assert TaskInfo(build_pod("ns", "p", "", "Pending", CPU1_MEM1)).status == TaskStatus.Pending
+        assert TaskInfo(build_pod("ns", "p", "n1", "Pending", CPU1_MEM1)).status == TaskStatus.Bound
+        assert TaskInfo(build_pod("ns", "p", "n1", "Running", CPU1_MEM1)).status == TaskStatus.Running
+        assert TaskInfo(build_pod("ns", "p", "n1", "Succeeded", CPU1_MEM1)).status == TaskStatus.Succeeded
+        assert TaskInfo(build_pod("ns", "p", "n1", "Failed", CPU1_MEM1)).status == TaskStatus.Failed
+        releasing = build_pod("ns", "p", "n1", "Running", CPU1_MEM1)
+        releasing.metadata.deletion_timestamp = 1.0
+        assert TaskInfo(releasing).status == TaskStatus.Releasing
+
+    def test_job_link(self):
+        t = TaskInfo(build_pod("ns", "p", "", "Pending", CPU1_MEM1, groupname="pg1"))
+        assert t.job == "ns/pg1"
+        t2 = TaskInfo(build_pod("ns", "p2", "", "Pending", CPU1_MEM1))
+        assert t2.job == ""
+
+    def test_best_effort(self):
+        assert TaskInfo(build_pod("ns", "p", "", "Pending", {})).best_effort
+        assert not TaskInfo(build_pod("ns", "p", "", "Pending", CPU1_MEM1)).best_effort
+
+
+class TestJobInfo:
+    def test_add_delete_accounting(self):
+        """Mirrors job_info_test.go TestAddTaskInfo/TestDeleteTaskInfo."""
+        t1 = TaskInfo(build_pod("ns", "p1", "n1", "Running", CPU1_MEM1, "pg"))
+        t2 = TaskInfo(build_pod("ns", "p2", "", "Pending", CPU2_MEM2, "pg"))
+        job = JobInfo("ns/pg", t1, t2)
+        assert len(job.tasks) == 2
+        assert job.allocated.equal(Resource.from_resource_list(CPU1_MEM1), ZERO)
+        expected_total = Resource.from_resource_list(CPU1_MEM1).add(
+            Resource.from_resource_list(CPU2_MEM2))
+        assert job.total_request.equal(expected_total, ZERO)
+
+        job.delete_task_info(t1)
+        assert len(job.tasks) == 1
+        assert job.allocated.is_empty()
+
+    def test_update_task_status_reindexes(self):
+        t = TaskInfo(build_pod("ns", "p1", "", "Pending", CPU1_MEM1, "pg"))
+        job = JobInfo("ns/pg", t)
+        job.update_task_status(t, TaskStatus.Allocated)
+        assert TaskStatus.Pending not in job.task_status_index
+        assert t.uid in job.task_status_index[TaskStatus.Allocated]
+        assert job.allocated.equal(Resource.from_resource_list(CPU1_MEM1), ZERO)
+
+    def test_ready_accounting(self):
+        pg = PodGroup(metadata=ObjectMeta(name="pg", namespace="ns"),
+                      spec=PodGroupSpec(min_member=2))
+        tasks = [TaskInfo(build_pod("ns", f"p{i}", "", "Pending", CPU1_MEM1, "pg"))
+                 for i in range(3)]
+        job = JobInfo("ns/pg", *tasks)
+        job.set_pod_group(pg)
+        assert not job.ready()
+        job.update_task_status(tasks[0], TaskStatus.Allocated)
+        assert job.ready_task_num() == 1
+        job.update_task_status(tasks[1], TaskStatus.Pipelined)
+        assert job.waiting_task_num() == 1
+        assert not job.ready()
+        job.update_task_status(tasks[1], TaskStatus.Bound)
+        assert job.ready()
+
+    def test_best_effort_counts_ready(self):
+        pg = PodGroup(metadata=ObjectMeta(name="pg", namespace="ns"),
+                      spec=PodGroupSpec(min_member=1))
+        t = TaskInfo(build_pod("ns", "p", "", "Pending", {}, "pg"))
+        job = JobInfo("ns/pg", t)
+        job.set_pod_group(pg)
+        assert job.ready()
+
+    def test_task_min_available(self):
+        pg = PodGroup(metadata=ObjectMeta(name="pg", namespace="ns"),
+                      spec=PodGroupSpec(min_member=2,
+                                        min_task_member={"master": 1, "worker": 1}))
+        master = build_pod("ns", "m", "", "Pending", CPU1_MEM1, "pg")
+        master.metadata.annotations[objects.TASK_SPEC_KEY] = "master"
+        worker = build_pod("ns", "w", "", "Pending", CPU1_MEM1, "pg")
+        worker.metadata.annotations[objects.TASK_SPEC_KEY] = "worker"
+        job = JobInfo("ns/pg", TaskInfo(master), TaskInfo(worker))
+        job.set_pod_group(pg)
+        assert job.check_task_min_available()
+        job.delete_task_info(job.tasks["ns-w"])
+        assert not job.check_task_min_available()
+
+
+class TestNodeInfo:
+    def test_add_remove_task(self):
+        """Mirrors node_info_test.go TestNodeInfo_AddPod/RemovePod."""
+        ni = NodeInfo(build_node("n1", CPU8_MEM8))
+        alloc = Resource.from_resource_list(CPU8_MEM8)
+        assert ni.idle.equal(alloc, ZERO)
+
+        t1 = TaskInfo(build_pod("ns", "p1", "n1", "Running", CPU1_MEM1))
+        ni.add_task(t1)
+        assert ni.used.equal(Resource.from_resource_list(CPU1_MEM1), ZERO)
+        assert ni.idle.equal(alloc - Resource.from_resource_list(CPU1_MEM1), ZERO)
+
+        ni.remove_task(t1)
+        assert ni.idle.equal(alloc, ZERO)
+        assert ni.used.is_empty()
+
+    def test_pipelined_accounting(self):
+        ni = NodeInfo(build_node("n1", CPU8_MEM8))
+        t = TaskInfo(build_pod("ns", "p1", "", "Pending", CPU2_MEM2))
+        t.status = TaskStatus.Pipelined
+        ni.add_task(t)
+        assert ni.idle.equal(Resource.from_resource_list(CPU8_MEM8), ZERO)
+        assert ni.pipelined.equal(Resource.from_resource_list(CPU2_MEM2), ZERO)
+        fi = ni.future_idle()
+        assert fi.equal(Resource.from_resource_list(CPU8_MEM8)
+                        - Resource.from_resource_list(CPU2_MEM2), ZERO)
+
+    def test_releasing_accounting(self):
+        ni = NodeInfo(build_node("n1", CPU8_MEM8))
+        pod = build_pod("ns", "p1", "n1", "Running", CPU2_MEM2)
+        pod.metadata.deletion_timestamp = 1.0
+        t = TaskInfo(pod)
+        assert t.status == TaskStatus.Releasing
+        ni.add_task(t)
+        assert ni.releasing.equal(Resource.from_resource_list(CPU2_MEM2), ZERO)
+        # future idle gets releasing back
+        assert ni.future_idle().equal(Resource.from_resource_list(CPU8_MEM8), ZERO)
+
+    def test_add_task_insufficient_raises(self):
+        ni = NodeInfo(build_node("n1", CPU1_MEM1))
+        t = TaskInfo(build_pod("ns", "p1", "n1", "Running", CPU2_MEM2))
+        with pytest.raises(RuntimeError):
+            ni.add_task(t)
+        assert ni.used.is_empty()
+
+    def test_duplicate_add_raises(self):
+        ni = NodeInfo(build_node("n1", CPU8_MEM8))
+        t = TaskInfo(build_pod("ns", "p1", "n1", "Running", CPU1_MEM1))
+        ni.add_task(t)
+        with pytest.raises(RuntimeError):
+            ni.add_task(t.clone())
+
+    def test_unschedulable_state(self):
+        node = build_node("n1", CPU8_MEM8)
+        node.spec.unschedulable = True
+        assert not NodeInfo(node).ready()
+
+    def test_clone(self):
+        ni = NodeInfo(build_node("n1", CPU8_MEM8))
+        ni.add_task(TaskInfo(build_pod("ns", "p1", "n1", "Running", CPU1_MEM1)))
+        c = ni.clone()
+        assert c.idle.equal(ni.idle, ZERO)
+        assert len(c.tasks) == 1
+        c.remove_task(list(c.tasks.values())[0])
+        assert len(ni.tasks) == 1  # original untouched

@@ -1,0 +1,31 @@
+"""volcano-tpu: a TPU-native batch scheduling framework.
+
+A ground-up rebuild of the capabilities of Volcano (the CNCF Kubernetes batch
+system: gang scheduling, fair-share queues with DRF/proportion, preemption and
+reclaim, bin-packing and topology-aware placement, job lifecycle control,
+admission, CLI) designed TPU-first: every scheduling cycle snapshots cluster
+state into dense structure-of-arrays and evaluates predicates, scoring,
+fair-share water-filling and victim selection for all task x node pairs at
+once as jitted JAX/XLA kernels.
+
+Layout:
+  models/      -- the data model (Resource vectors, Task/Job/Node/Queue infos,
+                  CRD-equivalent objects) and the dense snapshot encoding
+  ops/         -- the TPU kernels (fit, score, allocate scan, fair share,
+                  victim selection, topology)
+  framework/   -- Session / Statement / plugin & action registries / conf
+  actions/     -- enqueue, allocate, preempt, reclaim, backfill, elect, reserve
+  plugins/     -- gang, drf, proportion, predicates, nodeorder, binpack,
+                  priority, conformance, overcommit, sla, tdm, task-topology,
+                  numaaware, reservation
+  cache/       -- informer-fed cluster cache, event handlers, binder/evictor
+  apiserver/   -- in-process object store + watch bus (the standalone
+                  replacement for the Kubernetes API server)
+  controllers/ -- job / queue / podgroup / garbage-collector controllers
+  webhooks/    -- admission validate/mutate
+  cli/         -- vcctl and single-verb tools
+  parallel/    -- device mesh + node-axis sharded solver (shard_map)
+  utils/       -- filewatcher, priority queue, test fakes
+"""
+
+__version__ = "0.1.0"

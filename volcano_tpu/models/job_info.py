@@ -1,0 +1,348 @@
+"""TaskInfo / JobInfo: scheduler-facing wrappers over Pod and PodGroup.
+
+Behavioral contract mirrors the reference (pkg/scheduler/api/job_info.go):
+status taxonomy (job_info.go / types.go:26-74), readiness accounting
+(ReadyTaskNum:509, WaitingTaskNum:531, ValidTaskNum:572,
+CheckTaskMinAvailable:543, Ready:587), and annotation extraction
+(preemptable:304, revocable zone:332, sla waiting time:286, budget:354).
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from . import objects
+from .objects import Pod, PodGroup, PodGroupCondition
+from .resource import Resource
+from .unschedule_info import FitErrors
+
+
+class TaskStatus(enum.IntFlag):
+    """Task status bits (reference: pkg/scheduler/api/types.go:26-74)."""
+    Pending = 1 << 0
+    Allocated = 1 << 1
+    Pipelined = 1 << 2
+    Binding = 1 << 3
+    Bound = 1 << 4
+    Running = 1 << 5
+    Releasing = 1 << 6
+    Succeeded = 1 << 7
+    Failed = 1 << 8
+    Unknown = 1 << 9
+
+
+def allocated_status(status: TaskStatus) -> bool:
+    """Statuses that occupy node resources from the scheduler's viewpoint
+    (reference: pkg/scheduler/api/job_info.go AllocatedStatus)."""
+    return status in (TaskStatus.Bound, TaskStatus.Binding,
+                      TaskStatus.Running, TaskStatus.Allocated)
+
+
+def is_terminated(status: TaskStatus) -> bool:
+    return status in (TaskStatus.Succeeded, TaskStatus.Failed)
+
+
+def get_task_status(pod: Pod) -> TaskStatus:
+    """Pod phase -> TaskStatus (reference: pkg/scheduler/api/pod_info.go)."""
+    phase = pod.status.phase
+    if phase == "Running":
+        if pod.metadata.deletion_timestamp is not None:
+            return TaskStatus.Releasing
+        return TaskStatus.Running
+    if phase == "Pending":
+        if pod.metadata.deletion_timestamp is not None:
+            return TaskStatus.Releasing
+        if pod.spec.node_name:
+            return TaskStatus.Bound
+        return TaskStatus.Pending
+    if phase == "Succeeded":
+        return TaskStatus.Succeeded
+    if phase == "Failed":
+        return TaskStatus.Failed
+    return TaskStatus.Unknown
+
+
+def get_job_id(pod: Pod) -> str:
+    """PodGroup link via annotation (reference: job_info.go:99-106)."""
+    gn = pod.metadata.annotations.get(objects.GROUP_NAME_ANNOTATION, "")
+    if gn:
+        return f"{pod.metadata.namespace}/{gn}"
+    return ""
+
+
+def get_task_id(pod: Pod) -> str:
+    return pod.metadata.annotations.get(objects.TASK_SPEC_KEY, "")
+
+
+class TaskInfo:
+    """Scheduler view of one Pod (reference: job_info.go:70-147)."""
+
+    __slots__ = ("uid", "job", "name", "namespace", "resreq", "init_resreq",
+                 "node_name", "status", "priority", "volume_ready",
+                 "preemptable", "revocable_zone", "topology_policy", "pod",
+                 "best_effort", "last_transaction")
+
+    def __init__(self, pod: Pod):
+        req = pod.resource_request()
+        self.uid: str = pod.metadata.uid or pod.metadata.key()
+        self.job: str = get_job_id(pod)
+        self.name: str = pod.metadata.name
+        self.namespace: str = pod.metadata.namespace
+        self.init_resreq: Resource = req
+        self.resreq: Resource = req.clone()
+        self.node_name: str = pod.spec.node_name
+        self.status: TaskStatus = get_task_status(pod)
+        self.priority: int = pod.spec.priority if pod.spec.priority is not None else 1
+        self.volume_ready: bool = False
+        pa = pod.metadata.annotations.get(objects.PREEMPTABLE_KEY)
+        self.preemptable: bool = str(pa).lower() == "true" if pa is not None else False
+        self.revocable_zone: str = pod.metadata.annotations.get(objects.REVOCABLE_ZONE_KEY, "")
+        self.topology_policy: str = pod.metadata.annotations.get(objects.NUMA_TOPOLOGY_POLICY_KEY, "")
+        self.pod: Pod = pod
+        self.best_effort: bool = self.init_resreq.is_empty()
+        self.last_transaction = None
+
+    @property
+    def task_id(self) -> str:
+        return get_task_id(self.pod)
+
+    def clone(self) -> "TaskInfo":
+        c = TaskInfo.__new__(TaskInfo)
+        for s in TaskInfo.__slots__:
+            setattr(c, s, getattr(self, s))
+        c.resreq = self.resreq.clone()
+        c.init_resreq = self.init_resreq.clone()
+        return c
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def __repr__(self):
+        return (f"Task ({self.uid}:{self.namespace}/{self.name}): "
+                f"job {self.job}, status {self.status.name}, pri {self.priority}")
+
+
+class DisruptionBudget:
+    """Job disruption budget (reference: job_info.go:38-58)."""
+
+    def __init__(self, min_available: str = "", max_unavailable: str = ""):
+        self.min_available = min_available
+        self.max_unavailable = max_unavailable
+
+    def clone(self) -> "DisruptionBudget":
+        return DisruptionBudget(self.min_available, self.max_unavailable)
+
+
+class JobInfo:
+    """Scheduler view of one PodGroup and its tasks
+    (reference: job_info.go:187-591)."""
+
+    def __init__(self, uid: str, *tasks: TaskInfo):
+        self.uid: str = uid
+        self.name: str = ""
+        self.namespace: str = ""
+        self.queue: str = objects.DEFAULT_QUEUE
+        self.priority: int = 0
+        self.min_available: int = 0
+        self.waiting_time: Optional[float] = None   # sla-waiting-time seconds
+        self.job_fit_errors: str = ""
+        self.nodes_fit_errors: Dict[str, FitErrors] = {}
+        self.tasks: Dict[str, TaskInfo] = {}
+        self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = defaultdict(dict)
+        self.allocated: Resource = Resource()
+        self.total_request: Resource = Resource()
+        self.creation_timestamp: float = 0.0
+        self.pod_group: Optional[PodGroup] = None
+        self.scheduling_start_time: float = 0.0
+        self.preemptable: bool = False
+        self.revocable_zone: str = ""
+        self.budget: DisruptionBudget = DisruptionBudget()
+        self.task_min_available: Dict[str, int] = {}
+        self.task_min_available_total: int = 0
+        for t in tasks:
+            self.add_task_info(t)
+
+    # -- podgroup ingestion ------------------------------------------------
+
+    def set_pod_group(self, pg: PodGroup) -> None:
+        self.name = pg.metadata.name
+        self.namespace = pg.metadata.namespace
+        self.min_available = pg.spec.min_member
+        self.queue = pg.spec.queue
+        self.creation_timestamp = pg.metadata.creation_timestamp
+        self.waiting_time = self._extract_waiting_time(pg)
+        self.preemptable = self._extract_preemptable(pg)
+        self.revocable_zone = self._extract_revocable_zone(pg)
+        self.budget = self._extract_budget(pg)
+        self.task_min_available = dict(pg.spec.min_task_member)
+        self.task_min_available_total = sum(self.task_min_available.values())
+        self.pod_group = pg
+
+    def unset_pod_group(self) -> None:
+        self.pod_group = None
+
+    @staticmethod
+    def _extract_waiting_time(pg: PodGroup) -> Optional[float]:
+        """Invalid annotations are treated as unset, never fatal
+        (reference: job_info.go:286-300 logs and returns nil)."""
+        v = pg.metadata.annotations.get(objects.SLA_WAITING_TIME_KEY)
+        if v is None:
+            return None
+        w = parse_duration(v)
+        if w is None or w <= 0:
+            return None
+        return w
+
+    @staticmethod
+    def _extract_preemptable(pg: PodGroup) -> bool:
+        """Annotations beat labels (reference: job_info.go:304-330)."""
+        for src in (pg.metadata.annotations, pg.metadata.labels):
+            if objects.PREEMPTABLE_KEY in src:
+                return str(src[objects.PREEMPTABLE_KEY]).lower() == "true"
+        return False
+
+    @staticmethod
+    def _extract_revocable_zone(pg: PodGroup) -> str:
+        v = pg.metadata.annotations.get(objects.REVOCABLE_ZONE_KEY)
+        if v is not None:
+            return v if v == "*" else ""
+        if pg.metadata.annotations.get(objects.PREEMPTABLE_KEY, "").lower() == "true":
+            return "*"
+        return ""
+
+    @staticmethod
+    def _extract_budget(pg: PodGroup) -> DisruptionBudget:
+        a = pg.metadata.annotations
+        if objects.JDB_MIN_AVAILABLE_KEY in a:
+            return DisruptionBudget(min_available=a[objects.JDB_MIN_AVAILABLE_KEY])
+        if objects.JDB_MAX_UNAVAILABLE_KEY in a:
+            return DisruptionBudget(max_unavailable=a[objects.JDB_MAX_UNAVAILABLE_KEY])
+        return DisruptionBudget()
+
+    def get_min_resources(self) -> Resource:
+        if self.pod_group is None or self.pod_group.spec.min_resources is None:
+            return Resource()
+        return Resource.from_resource_list(self.pod_group.spec.min_resources)
+
+    # -- task management ---------------------------------------------------
+
+    def add_task_info(self, ti: TaskInfo) -> None:
+        self.tasks[ti.uid] = ti
+        self.task_status_index[ti.status][ti.uid] = ti
+        if allocated_status(ti.status):
+            self.allocated.add(ti.resreq)
+        self.total_request.add(ti.resreq)
+
+    def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
+        self.delete_task_info(task)
+        task.status = status
+        self.add_task_info(task)
+
+    def delete_task_info(self, ti: TaskInfo) -> None:
+        task = self.tasks.get(ti.uid)
+        if task is None:
+            raise KeyError(f"failed to find task <{ti.namespace}/{ti.name}> "
+                           f"in job <{self.namespace}/{self.name}>")
+        if allocated_status(task.status):
+            self.allocated.sub(task.resreq)
+        self.total_request.sub(task.resreq)
+        del self.tasks[task.uid]
+        idx = self.task_status_index[task.status]
+        idx.pop(task.uid, None)
+        if not idx:
+            del self.task_status_index[task.status]
+
+    def clone(self) -> "JobInfo":
+        info = JobInfo(self.uid)
+        info.name = self.name
+        info.namespace = self.namespace
+        info.queue = self.queue
+        info.priority = self.priority
+        info.min_available = self.min_available
+        info.waiting_time = self.waiting_time
+        info.nodes_fit_errors = {}
+        info.pod_group = self.pod_group
+        info.creation_timestamp = self.creation_timestamp
+        info.preemptable = self.preemptable
+        info.revocable_zone = self.revocable_zone
+        info.budget = self.budget.clone()
+        info.task_min_available = dict(self.task_min_available)
+        info.task_min_available_total = self.task_min_available_total
+        for task in self.tasks.values():
+            info.add_task_info(task.clone())
+        return info
+
+    # -- readiness accounting ---------------------------------------------
+
+    def ready_task_num(self) -> int:
+        """Allocated-ish + Succeeded + best-effort Pending
+        (reference: job_info.go:509-527)."""
+        occupied = 0
+        for status, tasks in self.task_status_index.items():
+            if allocated_status(status) or status == TaskStatus.Succeeded:
+                occupied += len(tasks)
+            elif status == TaskStatus.Pending:
+                occupied += sum(1 for t in tasks.values() if t.init_resreq.is_empty())
+        return occupied
+
+    def waiting_task_num(self) -> int:
+        return len(self.task_status_index.get(TaskStatus.Pipelined, {}))
+
+    def valid_task_num(self) -> int:
+        occupied = 0
+        for status, tasks in self.task_status_index.items():
+            if (allocated_status(status) or status == TaskStatus.Succeeded
+                    or status == TaskStatus.Pipelined or status == TaskStatus.Pending):
+                occupied += len(tasks)
+        return occupied
+
+    def check_task_min_available(self) -> bool:
+        """Per-task-type minAvailable check (reference: job_info.go:543-569)."""
+        if self.min_available < self.task_min_available_total:
+            return True
+        actual: Dict[str, int] = defaultdict(int)
+        for status, tasks in self.task_status_index.items():
+            if (allocated_status(status) or status == TaskStatus.Succeeded
+                    or status == TaskStatus.Pipelined or status == TaskStatus.Pending):
+                for t in tasks.values():
+                    actual[t.task_id] += 1
+        return all(actual.get(name, 0) >= need
+                   for name, need in self.task_min_available.items())
+
+    def ready(self) -> bool:
+        return self.ready_task_num() >= self.min_available
+
+    def is_pending(self) -> bool:
+        return (self.pod_group is None
+                or self.pod_group.status.phase == objects.PodGroupPhase.PENDING)
+
+    def fit_error(self) -> str:
+        """Histogram of pending/fit reasons (reference: job_info.go:487-505)."""
+        reasons: Dict[str, int] = defaultdict(int)
+        for status, tasks in self.task_status_index.items():
+            reasons[status.name] += len(tasks)
+        sorted_reasons = sorted(reasons.items(), key=lambda kv: kv[0])
+        msg = ", ".join(f"{n} {r}" for r, n in sorted_reasons)
+        return f"pod group is not ready, {self.min_available} minAvailable, {msg}"
+
+    def __repr__(self):
+        return (f"Job ({self.uid}): namespace {self.namespace} ({self.name}), "
+                f"minAvailable {self.min_available}")
+
+
+def parse_duration(v: str) -> Optional[float]:
+    """Go-style duration string to seconds ("1h30m", "300s", "1.5h")."""
+    import re
+    if v is None:
+        return None
+    v = str(v).strip()
+    m = re.findall(r"([0-9]*\.?[0-9]+)(ms|us|ns|h|m|s)", v)
+    if not m:
+        try:
+            return float(v)
+        except ValueError:
+            return None
+    mult = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 1e-3, "us": 1e-6, "ns": 1e-9}
+    return sum(float(num) * mult[unit] for num, unit in m)

@@ -1,0 +1,267 @@
+"""NodeInfo: per-node resource state machine.
+
+Behavioral contract mirrors the reference (pkg/scheduler/api/node_info.go):
+Idle/Used/Releasing/Pipelined accounting by task status (AddTask:341,
+RemoveTask:388), FutureIdle = Idle + Releasing - Pipelined (:71-73),
+oversubscription ingestion (:187-226), ready/phase state (:227-263), and
+GPU-share device accounting (:264-289, 463-509 + device_info.go).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from . import objects
+from .objects import Node
+from .job_info import TaskInfo, TaskStatus
+from .resource import EPS, GPU_MEMORY_RESOURCE, GPU_NUMBER_RESOURCE, Resource, ZERO
+
+
+class GPUDevice:
+    """One shareable GPU card (reference: pkg/scheduler/api/device_info.go:24-72)."""
+
+    def __init__(self, gpu_id: int, memory: float):
+        self.id = gpu_id
+        self.memory = memory
+        self.pod_map: Dict[str, float] = {}  # pod uid -> gpu memory used
+
+    def get_pods_used_gpu_memory(self) -> float:
+        return sum(self.pod_map.values())
+
+
+def get_gpu_memory_of_pod(pod) -> float:
+    """Requested volcano.sh/gpu-memory across containers (device_info.go)."""
+    mem = 0.0
+    for c in pod.spec.containers:
+        req = Resource.from_resource_list(c.requests)
+        mem += req.get(GPU_MEMORY_RESOURCE) / 1000.0  # stored in milli-units
+    return mem
+
+
+class NodeState:
+    def __init__(self, phase: str = "Ready", reason: str = ""):
+        self.phase = phase
+        self.reason = reason
+
+
+class NodeInfo:
+    """Aggregated per-node scheduling state."""
+
+    def __init__(self, node: Optional[Node] = None):
+        self.name: str = ""
+        self.node: Optional[Node] = node
+        self.state = NodeState()
+        self.releasing = Resource()
+        self.pipelined = Resource()
+        self.idle = Resource()
+        self.used = Resource()
+        self.allocatable = Resource()
+        self.capability = Resource()
+        self.tasks: Dict[str, TaskInfo] = {}
+        self.numa_info = None            # NumatopoInfo, set by cache
+        self.numa_scheduler_info = None
+        self.revocable_zone: str = ""
+        self.others: Dict[str, object] = {}
+        self.gpu_devices: Dict[int, GPUDevice] = {}
+        self.oversubscription_node: bool = False
+        self.offline_job_evicting: bool = False
+        self.oversubscription_resource = Resource()
+
+        self._set_oversubscription(node)
+        if node is not None:
+            self.name = node.metadata.name
+            alloc = Resource.from_resource_list(node.status.allocatable)
+            self.idle = alloc.clone().add(self.oversubscription_resource)
+            self.allocatable = alloc.clone().add(self.oversubscription_resource)
+            self.capability = Resource.from_resource_list(node.status.capacity) \
+                .add(self.oversubscription_resource)
+        self._set_gpu_info(node)
+        self._set_node_state(node)
+        self._set_revocable_zone(node)
+
+    # -- node-level state --------------------------------------------------
+
+    def _set_oversubscription(self, node: Optional[Node]) -> None:
+        """Oversubscription annotations (node_info.go:187-226)."""
+        if node is None:
+            return
+        a = node.metadata.annotations
+        self.oversubscription_node = a.get(objects.OVERSUBSCRIPTION_NODE_KEY, "").lower() == "true"
+        self.offline_job_evicting = a.get(objects.OFFLINE_JOB_EVICTING_KEY, "").lower() == "true"
+        res = a.get(objects.OVERSUBSCRIPTION_RESOURCE_KEY, "")
+        if self.oversubscription_node and res:
+            # "cpu:1000,memory:10Gi" style annotation
+            rl = {}
+            for part in res.split(","):
+                if ":" in part:
+                    k, v = part.split(":", 1)
+                    rl[k.strip()] = v.strip()
+            self.oversubscription_resource = Resource.from_resource_list(rl)
+
+    def _set_node_state(self, node: Optional[Node]) -> None:
+        """Ready iff node exists, schedulable and Ready (node_info.go:227-263)."""
+        if node is None:
+            self.state = NodeState("NotReady", "UnknownNode")
+            return
+        if node.spec.unschedulable:
+            self.state = NodeState("NotReady", "Unschedulable")
+            return
+        if not node.status.ready:
+            self.state = NodeState("NotReady", "NotReady")
+            return
+        self.state = NodeState("Ready")
+
+    def _set_revocable_zone(self, node: Optional[Node]) -> None:
+        if node is None:
+            return
+        self.revocable_zone = node.metadata.labels.get(objects.REVOCABLE_ZONE_LABEL, "")
+
+    def _set_gpu_info(self, node: Optional[Node]) -> None:
+        """Populate shareable GPU devices from capacity (node_info.go:264-289)."""
+        if node is None:
+            return
+        cap = Resource.from_resource_list(node.status.capacity)
+        mem_total = cap.get(GPU_MEMORY_RESOURCE) / 1000.0
+        num = int(cap.get(GPU_NUMBER_RESOURCE) / 1000.0)
+        if num > 0 and mem_total > 0:
+            per_card = mem_total / num
+            for i in range(num):
+                self.gpu_devices[i] = GPUDevice(i, per_card)
+
+    def ready(self) -> bool:
+        return self.state.phase == "Ready"
+
+    def future_idle(self) -> Resource:
+        """Idle + Releasing - Pipelined (node_info.go:71-73)."""
+        return self.idle.clone().add(self.releasing).sub(self.pipelined)
+
+    # -- task accounting ---------------------------------------------------
+
+    def _allocate_idle(self, ti: TaskInfo) -> None:
+        if not ti.resreq.less_equal(self.idle, ZERO):
+            raise RuntimeError("selected node NotReady")
+        self.idle.sub(ti.resreq)
+
+    def add_task(self, task: TaskInfo) -> None:
+        """Add a task; accounting depends on its status (node_info.go:341-384).
+        On error, both task and node are left unchanged."""
+        if task.node_name and self.name and task.node_name != self.name:
+            raise RuntimeError(
+                f"task <{task.namespace}/{task.name}> already on different "
+                f"node <{task.node_name}>")
+        key = task.key()
+        if key in self.tasks:
+            raise RuntimeError(
+                f"task <{task.namespace}/{task.name}> already on node <{self.name}>")
+        ti = task.clone()
+        if self.node is not None:
+            if ti.status == TaskStatus.Releasing:
+                self._allocate_idle(ti)
+                self.releasing.add(ti.resreq)
+                self.used.add(ti.resreq)
+                self.add_gpu_resource(ti.pod)
+            elif ti.status == TaskStatus.Pipelined:
+                self.pipelined.add(ti.resreq)
+            else:
+                self._allocate_idle(ti)
+                self.used.add(ti.resreq)
+                self.add_gpu_resource(ti.pod)
+        task.node_name = self.name
+        ti.node_name = self.name
+        self.tasks[key] = ti
+
+    def remove_task(self, ti: TaskInfo) -> None:
+        """Remove a task, reversing its accounting (node_info.go:388-420)."""
+        key = ti.key()
+        task = self.tasks.get(key)
+        if task is None:
+            return
+        if self.node is not None:
+            if task.status == TaskStatus.Releasing:
+                self.releasing.sub(task.resreq)
+                self.idle.add(task.resreq)
+                self.used.sub(task.resreq)
+                self.sub_gpu_resource(ti.pod)
+            elif task.status == TaskStatus.Pipelined:
+                self.pipelined.sub(task.resreq)
+            else:
+                self.idle.add(task.resreq)
+                self.used.sub(task.resreq)
+                self.sub_gpu_resource(ti.pod)
+        ti.node_name = ""
+        del self.tasks[key]
+
+    def update_task(self, ti: TaskInfo) -> None:
+        self.remove_task(ti)
+        self.add_task(ti)
+
+    def set_node(self, node: Node) -> None:
+        """Re-ingest node object, rebasing Idle on allocatable minus current
+        usage (node_info.go:291-327)."""
+        self.name = node.metadata.name
+        self.node = node
+        self._set_oversubscription(node)
+        self._set_node_state(node)
+        self._set_revocable_zone(node)
+        self._set_gpu_info(node)
+        if not self.ready():
+            return
+        alloc = Resource.from_resource_list(node.status.allocatable) \
+            .add(self.oversubscription_resource)
+        self.allocatable = alloc.clone()
+        self.capability = Resource.from_resource_list(node.status.capacity) \
+            .add(self.oversubscription_resource)
+        self.idle = alloc.clone()
+        self.used = Resource()
+        self.releasing = Resource()
+        self.pipelined = Resource()
+        tasks = list(self.tasks.values())
+        self.tasks = {}
+        for t in tasks:
+            t2 = t.clone()
+            t2.node_name = ""
+            self.add_task(t2)
+
+    def clone(self) -> "NodeInfo":
+        c = NodeInfo(self.node)
+        c.numa_info = self.numa_info
+        c.numa_scheduler_info = (self.numa_scheduler_info.clone()
+                                 if self.numa_scheduler_info is not None else None)
+        c.others = dict(self.others)
+        for t in self.tasks.values():
+            t2 = t.clone()
+            t2.node_name = ""  # re-add to the clone
+            c.add_task(t2)
+        return c
+
+    def pods(self):
+        return [t.pod for t in self.tasks.values()]
+
+    # -- GPU share accounting (device_info.go) -----------------------------
+
+    def get_devices_idle_gpu_memory(self) -> Dict[int, float]:
+        return {i: d.memory - d.get_pods_used_gpu_memory()
+                for i, d in self.gpu_devices.items()}
+
+    def add_gpu_resource(self, pod) -> None:
+        mem = get_gpu_memory_of_pod(pod)
+        if mem <= EPS:
+            return
+        gpu_id = pod.metadata.annotations.get("volcano.sh/gpu-index")
+        if gpu_id is None:
+            return
+        dev = self.gpu_devices.get(int(gpu_id))
+        if dev is not None:
+            dev.pod_map[pod.metadata.uid] = mem
+
+    def sub_gpu_resource(self, pod) -> None:
+        gpu_id = pod.metadata.annotations.get("volcano.sh/gpu-index")
+        if gpu_id is None:
+            return
+        dev = self.gpu_devices.get(int(gpu_id))
+        if dev is not None:
+            dev.pod_map.pop(pod.metadata.uid, None)
+
+    def __repr__(self):
+        return (f"Node ({self.name}): idle <{self.idle}>, used <{self.used}>, "
+                f"releasing <{self.releasing}>, state <{self.state.phase}>")

@@ -1,0 +1,521 @@
+"""Standalone API objects: the framework's equivalents of the reference's CRD
+groups and the slice of core/v1 it consumes.
+
+The reference defines four CRD groups over the Kubernetes API server
+(reference: vendor/volcano.sh/apis/pkg/apis/{batch,scheduling,bus,nodeinfo}).
+This framework is standalone, so the same object shapes live here as plain
+dataclasses and are stored/watched via :mod:`volcano_tpu.apiserver`.
+
+Object groups:
+  * core: ObjectMeta, Pod, Node, PriorityClass (the slice of core/v1 used)
+  * scheduling: PodGroup, Queue            (scheduling/v1beta1)
+  * batch: Job (+TaskSpec/LifecyclePolicy) (batch/v1alpha1)
+  * bus: Command, actions & events         (bus/v1alpha1)
+  * nodeinfo: Numatopology                 (nodeinfo/v1alpha1)
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .resource import Resource
+
+# ---------------------------------------------------------------------------
+# Annotation / label keys (reference: scheduling/v1beta1 & batch/v1alpha1 consts)
+# ---------------------------------------------------------------------------
+
+GROUP_NAME_ANNOTATION = "scheduling.k8s.io/group-name"       # pod -> PodGroup link
+TASK_SPEC_KEY = "volcano.sh/task-spec"                       # pod -> task name in Job
+JOB_NAME_KEY = "volcano.sh/job-name"
+JOB_VERSION_KEY = "volcano.sh/job-version"
+QUEUE_NAME_KEY = "volcano.sh/queue-name"
+PREEMPTABLE_KEY = "volcano.sh/preemptable"
+REVOCABLE_ZONE_KEY = "volcano.sh/revocable-zone"
+JDB_MIN_AVAILABLE_KEY = "volcano.sh/jdb-min-available"
+JDB_MAX_UNAVAILABLE_KEY = "volcano.sh/jdb-max-unavailable"
+SLA_WAITING_TIME_KEY = "sla-waiting-time"
+TOPOLOGY_AFFINITY_KEY = "volcano.sh/task-topology-affinity"
+TOPOLOGY_ANTI_AFFINITY_KEY = "volcano.sh/task-topology-anti-affinity"
+TOPOLOGY_TASK_ORDER_KEY = "volcano.sh/task-topology-task-order"
+NUMA_TOPOLOGY_POLICY_KEY = "volcano.sh/numa-topology-policy"
+QUEUE_HIERARCHY_ANNOTATION = "volcano.sh/hierarchy"
+QUEUE_HIERARCHY_WEIGHT_ANNOTATION = "volcano.sh/hierarchy-weights"
+OVERSUBSCRIPTION_NODE_KEY = "volcano.sh/oversubscription"
+OVERSUBSCRIPTION_RESOURCE_KEY = "volcano.sh/oversubscription-resource"
+OFFLINE_JOB_EVICTING_KEY = "volcano.sh/offline-job-evicting"
+REVOCABLE_ZONE_LABEL = "volcano.sh/revocable-zone"
+
+DEFAULT_SCHEDULER_NAME = "volcano"
+DEFAULT_QUEUE = "default"
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid(prefix: str = "obj") -> str:
+    return f"{prefix}-{next(_uid_counter):08d}"
+
+
+# ---------------------------------------------------------------------------
+# core/v1 slice
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = 0.0
+    resource_version: int = 0
+    deletion_timestamp: Optional[float] = None
+    owner: Optional[str] = None  # "kind/namespace/name" of the controller owner
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"      # Equal | Exists
+    value: str = ""
+    effect: str = ""             # "" matches all effects
+    toleration_seconds: Optional[int] = None
+
+    def tolerates(self, taint: "Taint") -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.operator == "Exists":
+            return self.key == "" or self.key == taint.key
+        return self.key == taint.key and self.value == taint.value
+
+
+@dataclass
+class Taint:
+    key: str = ""
+    value: str = ""
+    effect: str = "NoSchedule"   # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclass
+class NodeSelectorRequirement:
+    key: str = ""
+    operator: str = "In"         # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: List[str] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        has = self.key in labels
+        val = labels.get(self.key)
+        if self.operator == "In":
+            return has and val in self.values
+        if self.operator == "NotIn":
+            # k8s label-selector semantics: absent keys satisfy NotIn
+            return (not has) or val not in self.values
+        if self.operator == "Exists":
+            return has
+        if self.operator == "DoesNotExist":
+            return not has
+        if self.operator == "Gt":
+            try:
+                return has and float(val) > float(self.values[0])
+            except (ValueError, IndexError):
+                return False
+        if self.operator == "Lt":
+            try:
+                return has and float(val) < float(self.values[0])
+            except (ValueError, IndexError):
+                return False
+        return False
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: List[NodeSelectorRequirement] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        return all(e.matches(labels) for e in self.match_expressions)
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int = 1
+    preference: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+
+@dataclass
+class NodeAffinity:
+    required: List[NodeSelectorTerm] = field(default_factory=list)      # OR of terms
+    preferred: List[PreferredSchedulingTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodAffinityTerm:
+    label_selector: List[NodeSelectorRequirement] = field(default_factory=list)
+    topology_key: str = "kubernetes.io/hostname"
+    namespaces: List[str] = field(default_factory=list)
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int = 1
+    term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+
+@dataclass
+class PodAffinity:
+    required: List[PodAffinityTerm] = field(default_factory=list)
+    preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAffinity] = None
+
+
+@dataclass
+class Container:
+    name: str = "main"
+    image: str = ""
+    requests: Dict[str, Any] = field(default_factory=dict)   # resource list
+    limits: Dict[str, Any] = field(default_factory=dict)
+    ports: List[int] = field(default_factory=list)
+    command: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    volume_mounts: List[Dict[str, str]] = field(default_factory=list)
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    node_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: List[Toleration] = field(default_factory=list)
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    priority: Optional[int] = None
+    priority_class_name: str = ""
+    restart_policy: str = "OnFailure"
+    host_ports: List[int] = field(default_factory=list)
+    volumes: List[Dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"   # Pending | Running | Succeeded | Failed | Unknown
+    reason: str = ""
+    message: str = ""
+    host_ip: str = ""
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    def resource_request(self) -> Resource:
+        """Aggregate container requests; init containers contribute their max
+        per dimension (k8s pod resource semantics used by NewTaskInfo,
+        reference: pkg/scheduler/api/pod_info.go GetPodResourceRequest)."""
+        total = Resource()
+        for c in self.spec.containers:
+            total.add(Resource.from_resource_list(c.requests))
+        for c in self.spec.init_containers:
+            total.set_max_resource(Resource.from_resource_list(c.requests))
+        return total
+
+
+@dataclass
+class NodeStatus:
+    allocatable: Dict[str, Any] = field(default_factory=dict)
+    capacity: Dict[str, Any] = field(default_factory=dict)
+    ready: bool = True
+
+
+@dataclass
+class NodeSpec:
+    taints: List[Taint] = field(default_factory=list)
+    unschedulable: bool = False
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+
+@dataclass
+class PriorityClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    preemption_policy: str = "PreemptLowerPriority"
+
+
+@dataclass
+class ResourceQuota:
+    """Consumed only for namespace weight (reference: namespace_info.go)."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    hard: Dict[str, Any] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# scheduling group: PodGroup & Queue
+# ---------------------------------------------------------------------------
+
+class PodGroupPhase:
+    PENDING = "Pending"
+    RUNNING = "Running"
+    UNKNOWN = "Unknown"
+    INQUEUE = "Inqueue"
+    COMPLETED = "Completed"
+
+
+class PodGroupConditionType:
+    UNSCHEDULABLE = "Unschedulable"
+    SCHEDULED = "Scheduled"
+
+
+NOT_ENOUGH_RESOURCES_REASON = "NotEnoughResources"
+NOT_ENOUGH_PODS_REASON = "NotEnoughTasks"
+POD_GROUP_READY = "tasks in gang are ready to be scheduled"
+POD_GROUP_NOT_READY = "pod group is not ready"
+
+
+@dataclass
+class PodGroupCondition:
+    type: str = ""
+    status: str = "True"
+    transition_id: str = ""
+    last_transition_time: float = 0.0
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class PodGroupSpec:
+    min_member: int = 0
+    min_task_member: Dict[str, int] = field(default_factory=dict)
+    queue: str = DEFAULT_QUEUE
+    priority_class_name: str = ""
+    min_resources: Optional[Dict[str, Any]] = None
+
+
+@dataclass
+class PodGroupStatus:
+    phase: str = PodGroupPhase.PENDING
+    conditions: List[PodGroupCondition] = field(default_factory=list)
+    running: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class PodGroup:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodGroupSpec = field(default_factory=PodGroupSpec)
+    status: PodGroupStatus = field(default_factory=PodGroupStatus)
+
+
+class QueueState:
+    OPEN = "Open"
+    CLOSED = "Closed"
+    CLOSING = "Closing"
+    UNKNOWN = "Unknown"
+
+
+@dataclass
+class QueueSpec:
+    weight: int = 1
+    capability: Optional[Dict[str, Any]] = None
+    reclaimable: bool = True
+    guarantee: Optional[Dict[str, Any]] = None
+    extend_clusters: List[Dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class QueueStatus:
+    state: str = QueueState.OPEN
+    unknown: int = 0
+    pending: int = 0
+    running: int = 0
+    inqueue: int = 0
+
+
+@dataclass
+class Queue:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: QueueSpec = field(default_factory=QueueSpec)
+    status: QueueStatus = field(default_factory=QueueStatus)
+
+
+# ---------------------------------------------------------------------------
+# batch group: Job
+# ---------------------------------------------------------------------------
+
+class JobPhase:
+    PENDING = "Pending"
+    ABORTING = "Aborting"
+    ABORTED = "Aborted"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    COMPLETING = "Completing"
+    COMPLETED = "Completed"
+    TERMINATING = "Terminating"
+    TERMINATED = "Terminated"
+    FAILED = "Failed"
+
+
+class JobEvent:
+    """Lifecycle events (reference: vendor/.../bus/v1alpha1/events.go)."""
+    ANY = "*"
+    POD_FAILED = "PodFailed"
+    POD_EVICTED = "PodEvicted"
+    UNSCHEDULABLE = "Unschedulable"
+    POD_PENDING = "PodPending"
+    TASK_COMPLETED = "TaskCompleted"
+    OUT_OF_SYNC = "OutOfSync"
+    COMMAND_ISSUED = "CommandIssued"
+    JOB_UPDATED = "JobUpdated"
+
+
+class JobAction:
+    """Lifecycle actions (reference: vendor/.../bus/v1alpha1/actions.go:20-50)."""
+    ABORT_JOB = "AbortJob"
+    RESTART_JOB = "RestartJob"
+    RESTART_TASK = "RestartTask"
+    TERMINATE_JOB = "TerminateJob"
+    COMPLETE_JOB = "CompleteJob"
+    RESUME_JOB = "ResumeJob"
+    SYNC_JOB = "SyncJob"
+    ENQUEUE_JOB = "EnqueueJob"
+    SYNC_QUEUE = "SyncQueue"
+    OPEN_QUEUE = "OpenQueue"
+    CLOSE_QUEUE = "CloseQueue"
+
+
+@dataclass
+class LifecyclePolicy:
+    event: str = ""
+    events: List[str] = field(default_factory=list)
+    action: str = ""
+    exit_code: Optional[int] = None
+    timeout_seconds: Optional[float] = None
+
+    def matches(self, event: str, exit_code: Optional[int] = None) -> bool:
+        if self.exit_code is not None:
+            return exit_code is not None and exit_code == self.exit_code
+        evs = set(self.events)
+        if self.event:
+            evs.add(self.event)
+        return event in evs or JobEvent.ANY in evs
+
+
+@dataclass
+class PodTemplate:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+
+@dataclass
+class TaskSpec:
+    name: str = ""
+    replicas: int = 1
+    min_available: Optional[int] = None
+    template: PodTemplate = field(default_factory=PodTemplate)
+    policies: List[LifecyclePolicy] = field(default_factory=list)
+    topology_policy: str = ""   # NUMA: none|best-effort|restricted|single-numa-node
+
+
+@dataclass
+class JobSpec:
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    min_available: int = 0
+    volumes: List[Dict[str, Any]] = field(default_factory=list)
+    tasks: List[TaskSpec] = field(default_factory=list)
+    policies: List[LifecyclePolicy] = field(default_factory=list)
+    plugins: Dict[str, List[str]] = field(default_factory=dict)  # svc/ssh/env
+    queue: str = DEFAULT_QUEUE
+    max_retry: int = 3
+    ttl_seconds_after_finished: Optional[int] = None
+    priority_class_name: str = ""
+    min_success: Optional[int] = None
+
+
+@dataclass
+class JobState:
+    phase: str = JobPhase.PENDING
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class JobStatus:
+    state: JobState = field(default_factory=JobState)
+    pending: int = 0
+    running: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    terminating: int = 0
+    unknown: int = 0
+    version: int = 0
+    retry_count: int = 0
+    min_available: int = 0
+    task_status_count: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    controlled_resources: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Job:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+
+# ---------------------------------------------------------------------------
+# bus group: Command
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Command:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    action: str = ""
+    target_kind: str = "Job"
+    target_name: str = ""
+    reason: str = ""
+    message: str = ""
+
+
+# ---------------------------------------------------------------------------
+# nodeinfo group: Numatopology
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CpuInfo:
+    numa_id: int = 0
+    socket_id: int = 0
+    core_id: int = 0
+
+
+@dataclass
+class NumaResInfo:
+    """Per-resource allocatable set/amount on a node (numatopo_types.go)."""
+    allocatable: List[int] = field(default_factory=list)   # e.g. cpu ids
+    capacity: int = 0
+
+
+@dataclass
+class Numatopology:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    policies: Dict[str, str] = field(default_factory=dict)  # TopologyManagerPolicy etc.
+    numa_res: Dict[str, NumaResInfo] = field(default_factory=dict)
+    cpu_detail: Dict[int, CpuInfo] = field(default_factory=dict)
+    res_reserved: Dict[str, Any] = field(default_factory=dict)
