@@ -1,0 +1,193 @@
+"""Cache + store tests mirroring the reference's cache_test.go /
+event_handlers_test.go: watch ingestion, snapshot filtering, bind/evict."""
+
+import pytest
+
+from volcano_tpu.apiserver import AdmissionError, AdmissionHook, ObjectStore
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.models import TaskStatus
+from volcano_tpu.models.objects import PodGroupPhase, PriorityClass, ObjectMeta
+from volcano_tpu.models.resource import Resource, ZERO
+from volcano_tpu.utils.test_utils import (FakeBinder, FakeEvictor, build_node,
+                                          build_pod, build_pod_group,
+                                          build_queue, build_resource_list)
+
+
+@pytest.fixture
+def store():
+    return ObjectStore()
+
+
+@pytest.fixture
+def cache(store):
+    c = SchedulerCache(store)
+    c.run()
+    return c
+
+
+RL1 = build_resource_list("1", "1Gi")
+RL8 = build_resource_list("8", "8Gi")
+
+
+class TestStore:
+    def test_crud_and_watch(self, store):
+        seen = []
+        store.watch("queues", on_add=lambda q: seen.append(("add", q.metadata.name)),
+                    on_delete=lambda q: seen.append(("del", q.metadata.name)))
+        store.create("queues", build_queue("q1"))
+        store.delete("queues", "q1")
+        assert seen == [("add", "q1"), ("del", "q1")]
+
+    def test_watch_replays_existing(self, store):
+        store.create("queues", build_queue("q1"))
+        seen = []
+        store.watch("queues", on_add=lambda q: seen.append(q.metadata.name))
+        assert seen == ["q1"]
+
+    def test_admission_validate_rejects(self, store):
+        def deny(op, new, old):
+            raise AdmissionError("nope")
+        store.register_admission(AdmissionHook("queues", validate=deny))
+        with pytest.raises(AdmissionError):
+            store.create("queues", build_queue("q1"))
+        assert store.get("queues", "q1") is None
+
+    def test_admission_mutate(self, store):
+        def default_weight(op, new, old):
+            if new.spec.weight <= 0:
+                new.spec.weight = 5
+        store.register_admission(AdmissionHook("queues", mutate=default_weight))
+        q = build_queue("q1", weight=0)
+        store.create("queues", q)
+        assert store.get("queues", "q1").spec.weight == 5
+
+    def test_uid_and_rv_assigned(self, store):
+        q = store.create("queues", build_queue("q1"))
+        assert q.metadata.uid and q.metadata.resource_version > 0
+
+
+class TestCacheIngestion:
+    def test_pod_node_podgroup_queue(self, store, cache):
+        store.create("nodes", build_node("n1", RL8))
+        store.create("queues", build_queue("default"))
+        store.create("podgroups", build_pod_group("pg1", "ns1", "default", 2))
+        store.create("pods", build_pod("ns1", "p1", "", "Pending", RL1, "pg1"))
+        store.create("pods", build_pod("ns1", "p2", "n1", "Running", RL1, "pg1"))
+
+        assert "n1" in cache.nodes
+        job = cache.jobs["ns1/pg1"]
+        assert len(job.tasks) == 2
+        assert job.min_available == 2
+        used = cache.nodes["n1"].used
+        assert used.equal(Resource.from_resource_list(RL1), ZERO)
+
+    def test_pod_for_other_scheduler_ignored(self, store, cache):
+        p = build_pod("ns1", "px", "", "Pending", RL1, "pg1")
+        p.spec.scheduler_name = "default-scheduler"
+        store.create("pods", p)
+        assert "ns1/pg1" not in cache.jobs
+
+    def test_delete_pod_removes_accounting(self, store, cache):
+        store.create("nodes", build_node("n1", RL8))
+        store.create("pods", build_pod("ns1", "p1", "n1", "Running", RL1, "pg1"))
+        assert cache.jobs["ns1/pg1"].tasks
+        store.delete("pods", "p1", "ns1")
+        assert cache.nodes["n1"].used.is_empty()
+        assert "ns1/pg1" not in cache.jobs  # shell job cleaned up
+
+    def test_node_update_keeps_tasks(self, store, cache):
+        store.create("nodes", build_node("n1", RL8))
+        store.create("pods", build_pod("ns1", "p1", "n1", "Running", RL1, "pg1"))
+        n = store.get("nodes", "n1")
+        n.status.allocatable = build_resource_list("16", "16Gi")
+        store.update("nodes", n)
+        ni = cache.nodes["n1"]
+        assert len(ni.tasks) == 1
+        assert ni.idle.milli_cpu == 16000 - 1000
+
+    def test_priority_class_default(self, store, cache):
+        store.create("priorityclasses",
+                     PriorityClass(metadata=ObjectMeta(name="low"), value=10,
+                                   global_default=True))
+        assert cache.default_priority == 10
+
+
+class TestSnapshot:
+    def test_filters(self, store, cache):
+        store.create("queues", build_queue("default"))
+        store.create("nodes", build_node("n1", RL8))
+        bad = build_node("n2", RL8)
+        bad.spec.unschedulable = True
+        store.create("nodes", bad)
+        store.create("podgroups", build_pod_group("pg1", "ns1", "default", 1))
+        store.create("podgroups", build_pod_group("pg2", "ns1", "missing-q", 1))
+        store.create("pods", build_pod("ns1", "orphan", "", "Pending", RL1))
+
+        snap = cache.snapshot()
+        assert set(snap.nodes) == {"n1"}          # NotReady filtered
+        assert set(snap.jobs) == {"ns1/pg1"}      # missing queue + no-pg filtered
+        assert set(snap.queues) == {"default"}
+
+    def test_snapshot_is_deep_copy(self, store, cache):
+        store.create("queues", build_queue("default"))
+        store.create("nodes", build_node("n1", RL8))
+        snap = cache.snapshot()
+        snap.nodes["n1"].idle.milli_cpu = 0
+        assert cache.nodes["n1"].idle.milli_cpu == 8000
+
+    def test_priority_resolution(self, store, cache):
+        store.create("queues", build_queue("default"))
+        store.create("priorityclasses",
+                     PriorityClass(metadata=ObjectMeta(name="high"), value=1000))
+        store.create("podgroups",
+                     build_pod_group("pg1", "ns1", "default", 1,
+                                     priority_class="high"))
+        snap = cache.snapshot()
+        assert snap.jobs["ns1/pg1"].priority == 1000
+
+
+class TestBindEvict:
+    def _setup(self, store, cache):
+        store.create("queues", build_queue("default"))
+        store.create("nodes", build_node("n1", RL8))
+        store.create("podgroups", build_pod_group("pg1", "ns1", "default", 1))
+        store.create("pods", build_pod("ns1", "p1", "", "Pending", RL1, "pg1"))
+        return cache.jobs["ns1/pg1"]
+
+    def test_bind_updates_cache_and_store(self, store, cache):
+        job = self._setup(store, cache)
+        task = next(iter(job.tasks.values()))
+        cache.bind(task, "n1")
+        # store pod got node_name; watch re-ingested it as Bound
+        assert store.get("pods", "p1", "ns1").spec.node_name == "n1"
+        task2 = next(iter(cache.jobs["ns1/pg1"].tasks.values()))
+        assert task2.status == TaskStatus.Bound
+        assert cache.nodes["n1"].used.equal(Resource.from_resource_list(RL1), ZERO)
+
+    def test_bind_missing_node_raises(self, store, cache):
+        job = self._setup(store, cache)
+        task = next(iter(job.tasks.values()))
+        with pytest.raises(KeyError):
+            cache.bind(task, "nope")
+        assert task.status == TaskStatus.Pending
+
+    def test_evict_deletes_pod(self, store, cache):
+        job = self._setup(store, cache)
+        task = next(iter(job.tasks.values()))
+        cache.bind(task, "n1")
+        task2 = next(iter(cache.jobs["ns1/pg1"].tasks.values()))
+        cache.evict(task2, "preempted")
+        assert store.get("pods", "p1", "ns1") is None
+        assert cache.nodes["n1"].used.is_empty()
+
+    def test_fake_binder(self, store):
+        cache = SchedulerCache(store, binder=FakeBinder(store),
+                               evictor=FakeEvictor(store))
+        cache.run()
+        store.create("queues", build_queue("default"))
+        store.create("nodes", build_node("n1", RL8))
+        store.create("podgroups", build_pod_group("pg1", "ns1", "default", 1))
+        store.create("pods", build_pod("ns1", "p1", "", "Pending", RL1, "pg1"))
+        task = next(iter(cache.jobs["ns1/pg1"].tasks.values()))
+        cache.bind(task, "n1")
+        assert cache.binder.binds == {"ns1/p1": "n1"}

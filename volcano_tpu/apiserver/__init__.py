@@ -1,0 +1,1 @@
+from .store import AdmissionError, AdmissionHook, ObjectStore  # noqa: F401

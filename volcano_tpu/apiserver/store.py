@@ -1,0 +1,202 @@
+"""In-process object store + watch bus.
+
+The reference's distributed backbone is the Kubernetes API server: informer
+watch streams in, binding/eviction/status writes out (SURVEY.md section 5.8).
+In this standalone framework the same role is played by this store: typed
+object collections with resource versions, admission hook chains (the webhook
+manager registers here), and synchronous watch fan-out to informers (cache,
+controllers).
+
+Kinds and scoping mirror the reference's CRD groups plus the consumed core
+slice; namespaced kinds key by "namespace/name", cluster-scoped by "name".
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional
+
+from ..models import objects as obj
+from ..utils.clock import GLOBAL_CLOCK, Clock
+
+NAMESPACED = {"pods", "podgroups", "jobs", "commands", "resourcequotas", "services", "configmaps", "secrets"}
+CLUSTER_SCOPED = {"nodes", "queues", "priorityclasses", "numatopologies"}
+KINDS = NAMESPACED | CLUSTER_SCOPED
+
+
+class AdmissionError(Exception):
+    """Raised when a validating admission hook rejects an operation."""
+
+
+class AdmissionHook:
+    """One admission service (reference: pkg/webhooks/router/interface.go:38-48).
+
+    ``mutate``/``validate`` receive (operation, new_obj, old_obj) where
+    operation is "CREATE"|"UPDATE"|"DELETE"; mutate edits new_obj in place,
+    validate raises AdmissionError to reject.
+    """
+
+    def __init__(self, kind: str, path: str = "",
+                 mutate: Optional[Callable] = None,
+                 validate: Optional[Callable] = None,
+                 operations: tuple = ("CREATE",)):
+        self.kind = kind
+        self.path = path
+        self.mutate = mutate
+        self.validate = validate
+        self.operations = operations
+
+
+class Watch:
+    def __init__(self, kind: str, on_add=None, on_update=None, on_delete=None,
+                 filter_fn: Optional[Callable] = None):
+        self.kind = kind
+        self.on_add = on_add
+        self.on_update = on_update
+        self.on_delete = on_delete
+        self.filter_fn = filter_fn
+
+    def _passes(self, o) -> bool:
+        return self.filter_fn is None or self.filter_fn(o)
+
+
+class ObjectStore:
+    """Thread-safe typed object store with admission + watch."""
+
+    def __init__(self, clock: Clock = GLOBAL_CLOCK):
+        self._objects: Dict[str, Dict[str, object]] = {k: {} for k in KINDS}
+        self._watches: Dict[str, List[Watch]] = defaultdict(list)
+        self._hooks: List[AdmissionHook] = []
+        self._rv = 0
+        self._lock = threading.RLock()
+        self.clock = clock
+        self.events: List[tuple] = []   # (kind, type, reason, message) event records
+
+    # -- keys --------------------------------------------------------------
+
+    @staticmethod
+    def key_of(kind: str, o) -> str:
+        meta = o.metadata
+        return meta.name if kind in CLUSTER_SCOPED else f"{meta.namespace}/{meta.name}"
+
+    # -- admission ---------------------------------------------------------
+
+    def register_admission(self, hook: AdmissionHook) -> None:
+        self._hooks.append(hook)
+
+    def _admit(self, kind: str, operation: str, new_obj, old_obj=None) -> None:
+        for h in self._hooks:
+            if h.kind != kind or operation not in h.operations:
+                continue
+            if h.mutate is not None:
+                h.mutate(operation, new_obj, old_obj)
+        for h in self._hooks:
+            if h.kind != kind or operation not in h.operations:
+                continue
+            if h.validate is not None:
+                h.validate(operation, new_obj, old_obj)  # raises AdmissionError
+
+    # -- CRUD --------------------------------------------------------------
+
+    def create(self, kind: str, o, skip_admission: bool = False):
+        with self._lock:
+            if not skip_admission:
+                self._admit(kind, "CREATE", o)
+            key = self.key_of(kind, o)
+            if key in self._objects[kind]:
+                raise KeyError(f"{kind} {key!r} already exists")
+            if not o.metadata.uid:
+                o.metadata.uid = obj.new_uid(kind[:-1] if kind.endswith("s") else kind)
+            if not o.metadata.creation_timestamp:
+                o.metadata.creation_timestamp = self.clock.now()
+            self._rv += 1
+            o.metadata.resource_version = self._rv
+            self._objects[kind][key] = o
+            watches = list(self._watches[kind])
+        for w in watches:
+            if w.on_add and w._passes(o):
+                w.on_add(o)
+        return o
+
+    def update(self, kind: str, o, skip_admission: bool = False):
+        with self._lock:
+            key = self.key_of(kind, o)
+            old = self._objects[kind].get(key)
+            if old is None:
+                raise KeyError(f"{kind} {key!r} not found")
+            if not skip_admission:
+                self._admit(kind, "UPDATE", o, old)
+            self._rv += 1
+            o.metadata.resource_version = self._rv
+            self._objects[kind][key] = o
+            watches = list(self._watches[kind])
+        for w in watches:
+            old_p, new_p = w._passes(old), w._passes(o)
+            if old_p and new_p and w.on_update:
+                w.on_update(old, o)
+            elif not old_p and new_p and w.on_add:
+                w.on_add(o)
+            elif old_p and not new_p and w.on_delete:
+                w.on_delete(old)
+        return o
+
+    def delete(self, kind: str, name: str, namespace: str = "default",
+               skip_admission: bool = False) -> None:
+        key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
+        with self._lock:
+            old = self._objects[kind].get(key)
+            if old is None:
+                raise KeyError(f"{kind} {key!r} not found")
+            if not skip_admission:
+                self._admit(kind, "DELETE", None, old)
+            del self._objects[kind][key]
+            watches = list(self._watches[kind])
+        for w in watches:
+            if w.on_delete and w._passes(old):
+                w.on_delete(old)
+
+    def get(self, kind: str, name: str, namespace: str = "default"):
+        key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
+        with self._lock:
+            return self._objects[kind].get(key)
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> list:
+        with self._lock:
+            items = list(self._objects[kind].values())
+        if namespace is not None and kind in NAMESPACED:
+            items = [o for o in items if o.metadata.namespace == namespace]
+        return items
+
+    # -- watch -------------------------------------------------------------
+
+    def watch(self, kind: str, on_add=None, on_update=None, on_delete=None,
+              filter_fn=None, sync: bool = True) -> Watch:
+        """Subscribe to events for a kind; with sync=True, existing objects
+        are replayed through on_add first (informer list+watch semantics)."""
+        w = Watch(kind, on_add, on_update, on_delete, filter_fn)
+        with self._lock:
+            self._watches[kind].append(w)
+            existing = list(self._objects[kind].values()) if sync else []
+        for o in existing:
+            if w.on_add and w._passes(o):
+                w.on_add(o)
+        return w
+
+    def unwatch(self, w: Watch) -> None:
+        with self._lock:
+            if w in self._watches[w.kind]:
+                self._watches[w.kind].remove(w)
+
+    # -- events (Recorder equivalent) --------------------------------------
+
+    def record_event(self, kind: str, o, event_type: str, reason: str, message: str) -> None:
+        self.events.append((kind, self.key_of(kind, o) if o is not None else "",
+                            event_type, reason, message))
+
+    # -- helpers for deep-copied reads ------------------------------------
+
+    def get_copy(self, kind: str, name: str, namespace: str = "default"):
+        o = self.get(kind, name, namespace)
+        return copy.deepcopy(o) if o is not None else None

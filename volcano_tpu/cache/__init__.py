@@ -1,0 +1,3 @@
+from .cache import SchedulerCache  # noqa: F401
+from .interface import (Binder, Evictor, NullVolumeBinder, StatusUpdater,  # noqa: F401
+                        StoreBinder, StoreEvictor, StoreStatusUpdater)

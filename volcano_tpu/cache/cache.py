@@ -1,0 +1,288 @@
+"""SchedulerCache: watch-fed mutable cluster state with per-cycle Snapshot.
+
+Mirrors pkg/scheduler/cache/cache.go: watch ingestion for pods/nodes/
+podgroups/queues/priorityclasses/quotas/numatopologies (:84-96, Run:487),
+deep-copy Snapshot per cycle (:793-882), Bind/Evict executors with resync
+on failure (:552-660, processResyncTask:772), PodGroup status writeback
+(UpdateJobStatus), and job status event recording.
+
+Differences by design: executors run inline against the in-process store
+(no goroutines needed -- the store write is cheap and the watch fan-out is
+synchronous), which removes the async bind/evict race window while keeping
+the resync path for executor failures.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..apiserver.store import ObjectStore
+from ..models import objects as obj
+from ..models.cluster_info import ClusterInfo
+from ..models.job_info import JobInfo, TaskInfo, TaskStatus
+from ..models.node_info import NodeInfo
+from ..models.objects import (DEFAULT_QUEUE, DEFAULT_SCHEDULER_NAME, PodGroup,
+                              PodGroupCondition, PodGroupPhase)
+from ..models.queue_info import NamespaceCollection, QueueInfo
+from .event_handlers import EventHandlersMixin
+from .interface import (NullVolumeBinder, StoreBinder, StoreEvictor,
+                        StoreStatusUpdater)
+
+
+class SchedulerCache(EventHandlersMixin):
+    """The scheduler's view of the cluster, fed by store watches."""
+
+    def __init__(self, store: ObjectStore,
+                 scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+                 default_queue: str = DEFAULT_QUEUE,
+                 binder=None, evictor=None, status_updater=None,
+                 volume_binder=None):
+        self.store = store
+        self.scheduler_name = scheduler_name
+        self.default_queue = default_queue
+
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.priority_classes: Dict[str, obj.PriorityClass] = {}
+        self.default_priority: int = 0
+        self.default_priority_class: Optional[obj.PriorityClass] = None
+        self.namespace_collection: Dict[str, NamespaceCollection] = {}
+        self.numatopologies: Dict[str, object] = {}
+        self.node_list: List[str] = []
+
+        self.binder = binder if binder is not None else StoreBinder(store)
+        self.evictor = evictor if evictor is not None else StoreEvictor(store)
+        self.status_updater = (status_updater if status_updater is not None
+                               else StoreStatusUpdater(store))
+        self.volume_binder = volume_binder if volume_binder is not None else NullVolumeBinder()
+
+        self.mutex = threading.RLock()
+        self.err_tasks: deque = deque()      # resync queue (cache.go:116)
+        self._watches: list = []
+        self._running = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _responsible_for(self, pod: obj.Pod) -> bool:
+        """Only pods targeted at this scheduler (cache.go responsibleForPod)."""
+        return pod.spec.scheduler_name == self.scheduler_name
+
+    def run(self) -> None:
+        """Subscribe all watches, replaying existing objects (informer
+        list+watch; cache.go:487-507)."""
+        if self._running:
+            return
+        self._running = True
+        s = self.store
+
+        def locked(fn):
+            def wrapper(*args):
+                with self.mutex:
+                    try:
+                        fn(*args)
+                    except KeyError:
+                        pass  # e.g. pod bound to a node we haven't seen yet
+            return wrapper
+
+        w = []
+        w.append(s.watch("pods", locked(self.add_pod), locked(self.update_pod),
+                         locked(self.delete_pod), filter_fn=self._responsible_for))
+        w.append(s.watch("nodes", locked(self.add_node), locked(self.update_node),
+                         locked(self.delete_node)))
+        w.append(s.watch("podgroups", locked(self.add_pod_group),
+                         locked(self.update_pod_group), locked(self.delete_pod_group)))
+        w.append(s.watch("queues", locked(self.add_queue), locked(self.update_queue),
+                         locked(self.delete_queue)))
+        w.append(s.watch("priorityclasses", locked(self.add_priority_class),
+                         locked(self.update_priority_class),
+                         locked(self.delete_priority_class)))
+        w.append(s.watch("resourcequotas", locked(self.add_resource_quota),
+                         locked(self.update_resource_quota),
+                         locked(self.delete_resource_quota)))
+        w.append(s.watch("numatopologies", locked(self.add_numa_info),
+                         locked(self.update_numa_info), locked(self.delete_numa_info)))
+        self._watches = w
+
+    def stop(self) -> None:
+        for w in self._watches:
+            self.store.unwatch(w)
+        self._watches = []
+        self._running = False
+
+    def wait_for_cache_sync(self) -> bool:
+        return self._running  # synchronous watches: always synced once run
+
+    def client(self) -> ObjectStore:
+        """The plugins'/actions' handle to the API (Cache.Client analogue)."""
+        return self.store
+
+    # -- snapshot ----------------------------------------------------------
+
+    def snapshot(self) -> ClusterInfo:
+        """Deep copy of the whole state (cache.go:793-882): only Ready nodes;
+        only jobs with a PodGroup and an existing queue; job priority resolved
+        from PriorityClass here."""
+        with self.mutex:
+            snap = ClusterInfo()
+            snap.node_list = list(self.node_list)
+            for node in self.nodes.values():
+                node.refresh_numa_scheduler_info()
+            for node in self.nodes.values():
+                if not node.ready():
+                    continue
+                cloned = node.clone()
+                snap.nodes[node.name] = cloned
+                if node.revocable_zone:
+                    snap.revocable_nodes[node.name] = cloned
+            for q in self.queues.values():
+                snap.queues[q.uid] = q.clone()
+            for name, coll in self.namespace_collection.items():
+                info = coll.snapshot()
+                snap.namespaces[info.name] = info
+            for job in self.jobs.values():
+                if job.pod_group is None:
+                    continue
+                if job.queue not in snap.queues:
+                    continue
+                job.priority = self.default_priority
+                pri_name = job.pod_group.spec.priority_class_name
+                pc = self.priority_classes.get(pri_name)
+                if pc is not None:
+                    job.priority = pc.value
+                snap.jobs[job.uid] = job.clone()
+            return snap
+
+    # -- find helpers ------------------------------------------------------
+
+    def _find_job_and_task(self, task_info: TaskInfo):
+        job = self.jobs.get(task_info.job)
+        if job is None:
+            raise KeyError(f"failed to find job <{task_info.job}>")
+        task = job.tasks.get(task_info.uid)
+        if task is None:
+            raise KeyError(f"failed to find task <{task_info.uid}>")
+        return job, task
+
+    # -- executors ---------------------------------------------------------
+
+    def bind(self, task_info: TaskInfo, hostname: str) -> None:
+        """Mark Binding in cache, add to node, then execute the store bind
+        (cache.go:605-655). Executor failure enqueues a resync."""
+        with self.mutex:
+            job, task = self._find_job_and_task(task_info)
+            node = self.nodes.get(hostname)
+            if node is None:
+                raise KeyError(f"failed to bind Task {task.uid} to host "
+                               f"{hostname}, host does not exist")
+            original = task.status
+            job.update_task_status(task, TaskStatus.Binding)
+            try:
+                node.add_task(task)
+            except RuntimeError:
+                job.update_task_status(task, original)
+                raise
+            pod = task.pod
+        try:
+            self.binder.bind(pod, hostname)
+            self.store.record_event(
+                "pods", pod, "Normal", "Scheduled",
+                f"Successfully assigned {task.namespace}/{task.name} to {hostname}")
+        except Exception:
+            self.resync_task(task)
+
+    def evict(self, task_info: TaskInfo, reason: str) -> None:
+        """Mark Releasing, update node accounting, then delete the pod
+        (cache.go:552-601)."""
+        with self.mutex:
+            job, task = self._find_job_and_task(task_info)
+            node = self.nodes.get(task.node_name)
+            if node is None:
+                raise KeyError(f"failed to evict Task {task.uid} on host "
+                               f"{task.node_name}, host does not exist")
+            original = task.status
+            job.update_task_status(task, TaskStatus.Releasing)
+            try:
+                node.update_task(task)
+            except RuntimeError:
+                job.update_task_status(task, original)
+                raise
+            pod = task.pod
+        try:
+            self.evictor.evict(pod, reason)
+        except Exception:
+            self.resync_task(task)
+        if job.pod_group is not None:
+            self.store.record_event("podgroups", job.pod_group, "Normal",
+                                    "Evict", reason)
+
+    # -- resync (cache.go:768-791) ----------------------------------------
+
+    def resync_task(self, task: TaskInfo) -> None:
+        self.err_tasks.append(task)
+
+    def process_resync_tasks(self) -> None:
+        """Refetch each errored pod from the store and reconcile the cache."""
+        n = len(self.err_tasks)
+        for _ in range(n):
+            task = self.err_tasks.popleft()
+            self.sync_task(task)
+
+    def sync_task(self, old_task: TaskInfo) -> None:
+        pod = self.store.get("pods", old_task.name, old_task.namespace)
+        with self.mutex:
+            if pod is None:
+                self._delete_task(old_task)
+                return
+            new_task = TaskInfo(pod)
+            # update = delete old view, add fresh view
+            self._delete_task(old_task)
+            try:
+                self._add_task(new_task)
+            except KeyError:
+                self.err_tasks.append(new_task)
+
+    # -- status writeback --------------------------------------------------
+
+    def update_job_status(self, job: JobInfo, update_pg: bool = True) -> JobInfo:
+        """Record user-facing events and push PodGroup status
+        (cache.go:700-739 + job_updater)."""
+        self.record_job_status_event(job)
+        if update_pg and job.pod_group is not None:
+            pg = self.status_updater.update_pod_group(job.pod_group)
+            if pg is not None:
+                job.pod_group = pg
+        return job
+
+    def record_job_status_event(self, job: JobInfo) -> None:
+        """Pending-not-ready jobs get FailedScheduling events on their
+        unscheduled tasks (cache.go:659-698)."""
+        if job.pod_group is None:
+            return
+        phase = job.pod_group.status.phase
+        if phase in (PodGroupPhase.PENDING, PodGroupPhase.INQUEUE) and not job.ready():
+            msg = job.fit_error()
+            for status, tasks in job.task_status_index.items():
+                if status != TaskStatus.Pending:
+                    continue
+                for task in tasks.values():
+                    fit_errors = job.nodes_fit_errors.get(task.uid)
+                    reason = fit_errors.error() if fit_errors is not None else msg
+                    self.store.record_event("pods", task.pod, "Warning",
+                                            "FailedScheduling", reason)
+                    self.status_updater.update_pod_condition(
+                        task.pod, "Unschedulable", reason)
+
+    def update_scheduler_numa_info(self, node_res_sets: Dict[str, Dict[str, set]]) -> None:
+        """Write allocated NUMA sets back (numaaware plugin session close)."""
+        with self.mutex:
+            for node_name, res_sets in node_res_sets.items():
+                node = self.nodes.get(node_name)
+                if node is not None and node.numa_scheduler_info is not None:
+                    node.numa_scheduler_info.allocate(res_sets)
+
+    def __repr__(self):
+        return (f"SchedulerCache(jobs={len(self.jobs)}, nodes={len(self.nodes)}, "
+                f"queues={len(self.queues)})")

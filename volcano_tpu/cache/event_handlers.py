@@ -1,0 +1,198 @@
+"""Cache event handlers: watch events -> JobInfo/NodeInfo mutation.
+
+Mirrors pkg/scheduler/cache/event_handlers.go: pod->task conversion and
+job/node accounting (:47-260), node ingestion (:302-418), PodGroup/Queue
+ingestion (:420-560), PriorityClass/ResourceQuota/Numatopology handlers.
+All methods assume the cache lock is held by the caller (the watch fan-out
+is synchronous).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..models import objects as obj
+from ..models.job_info import (JobInfo, TaskInfo, get_job_id, is_terminated)
+from ..models.node_info import NodeInfo
+from ..models.queue_info import NamespaceCollection, QueueInfo
+
+
+class EventHandlersMixin:
+    """Mixed into SchedulerCache; operates on self.jobs/self.nodes/..."""
+
+    # -- pods -------------------------------------------------------------
+
+    def _get_or_create_job(self, ti: TaskInfo) -> Optional[JobInfo]:
+        """Tasks without a PodGroup link are not schedulable by us
+        (event_handlers.go:47-58)."""
+        if not ti.job:
+            return None
+        if ti.job not in self.jobs:
+            self.jobs[ti.job] = JobInfo(ti.job)
+        return self.jobs[ti.job]
+
+    def _add_task(self, ti: TaskInfo) -> None:
+        if ti.node_name:
+            if ti.node_name not in self.nodes:
+                # pods bound to unknown nodes create a placeholder so their
+                # resources are accounted once the node arrives
+                raise KeyError(f"node <{ti.node_name}> does not exist")
+            if not is_terminated(ti.status):
+                self.nodes[ti.node_name].add_task(ti)
+        job = self._get_or_create_job(ti)
+        if job is not None:
+            job.add_task_info(ti)
+
+    def add_pod(self, pod: obj.Pod) -> None:
+        self._add_task(TaskInfo(pod))
+
+    def _cached_task_view(self, ti: TaskInfo) -> TaskInfo:
+        """Prefer the cache's task (it knows Binding/Allocated state and the
+        node it sits on) over the event's view — the event's pod may predate
+        an in-flight bind (event_handlers.go:163-176 deletePod)."""
+        job = self.jobs.get(ti.job)
+        if job is not None:
+            cached = job.tasks.get(ti.uid)
+            if cached is not None:
+                return cached
+        return ti
+
+    def _delete_task(self, ti: TaskInfo) -> None:
+        ti = self._cached_task_view(ti)
+        job = self.jobs.get(ti.job) if ti.job else None
+        if job is not None:
+            try:
+                job.delete_task_info(ti)
+            except KeyError:
+                pass
+        if ti.node_name and ti.node_name in self.nodes:
+            self.nodes[ti.node_name].remove_task(ti)
+
+    def update_pod(self, old: obj.Pod, new: obj.Pod) -> None:
+        self._delete_task(TaskInfo(old))
+        self.add_pod(new)
+
+    def delete_pod(self, pod: obj.Pod) -> None:
+        self._delete_task(TaskInfo(pod))
+        # drop empty shell jobs with no podgroup (processCleanupJob analogue)
+        jid = get_job_id(pod)
+        job = self.jobs.get(jid)
+        if job is not None and not job.tasks and job.pod_group is None:
+            del self.jobs[jid]
+
+    # -- nodes ------------------------------------------------------------
+
+    def add_node(self, node: obj.Node) -> None:
+        name = node.metadata.name
+        if name in self.nodes:
+            self.nodes[name].set_node(node)
+        else:
+            self.nodes[name] = NodeInfo(node)
+            nt = self.numatopologies.get(name)
+            if nt is not None:
+                self.nodes[name].numa_info = nt
+        if name not in self.node_list:
+            self.node_list.append(name)
+
+    def update_node(self, old: obj.Node, new: obj.Node) -> None:
+        if new.metadata.name in self.nodes:
+            self.nodes[new.metadata.name].set_node(new)
+        else:
+            self.add_node(new)
+
+    def delete_node(self, node: obj.Node) -> None:
+        self.nodes.pop(node.metadata.name, None)
+        if node.metadata.name in self.node_list:
+            self.node_list.remove(node.metadata.name)
+
+    # -- podgroups --------------------------------------------------------
+
+    def add_pod_group(self, pg: obj.PodGroup) -> None:
+        key = pg.metadata.key()
+        if key not in self.jobs:
+            self.jobs[key] = JobInfo(key)
+        self.jobs[key].set_pod_group(pg)
+
+    def update_pod_group(self, old: obj.PodGroup, new: obj.PodGroup) -> None:
+        self.add_pod_group(new)
+
+    def delete_pod_group(self, pg: obj.PodGroup) -> None:
+        key = pg.metadata.key()
+        job = self.jobs.get(key)
+        if job is None:
+            return
+        job.unset_pod_group()
+        if not job.tasks:
+            del self.jobs[key]
+
+    # -- queues -----------------------------------------------------------
+
+    def add_queue(self, queue: obj.Queue) -> None:
+        self.queues[queue.metadata.name] = QueueInfo(queue)
+
+    def update_queue(self, old: obj.Queue, new: obj.Queue) -> None:
+        self.add_queue(new)
+
+    def delete_queue(self, queue: obj.Queue) -> None:
+        self.queues.pop(queue.metadata.name, None)
+
+    # -- priority classes -------------------------------------------------
+
+    def add_priority_class(self, pc: obj.PriorityClass) -> None:
+        if pc.global_default:
+            self.default_priority_class = pc
+            self.default_priority = pc.value
+        self.priority_classes[pc.metadata.name] = pc
+
+    def update_priority_class(self, old: obj.PriorityClass, new: obj.PriorityClass) -> None:
+        self.delete_priority_class(old)
+        self.add_priority_class(new)
+
+    def delete_priority_class(self, pc: obj.PriorityClass) -> None:
+        if pc.global_default:
+            self.default_priority_class = None
+            self.default_priority = 0
+        self.priority_classes.pop(pc.metadata.name, None)
+
+    # -- resource quotas (namespace weights) ------------------------------
+
+    def add_resource_quota(self, quota: obj.ResourceQuota) -> None:
+        ns = quota.metadata.namespace
+        if ns not in self.namespace_collection:
+            self.namespace_collection[ns] = NamespaceCollection(ns)
+        self.namespace_collection[ns].update(quota)
+
+    def update_resource_quota(self, old, new) -> None:
+        self.add_resource_quota(new)
+
+    def delete_resource_quota(self, quota: obj.ResourceQuota) -> None:
+        coll = self.namespace_collection.get(quota.metadata.namespace)
+        if coll is not None:
+            coll.delete(quota)
+
+    # -- numatopology -----------------------------------------------------
+
+    def add_numa_info(self, nt: obj.Numatopology) -> None:
+        from ..models.numa_info import NumatopoInfo
+        info = NumatopoInfo.from_crd(nt)
+        old = self.numatopologies.get(nt.metadata.name)
+        self.numatopologies[nt.metadata.name] = info
+        node = self.nodes.get(nt.metadata.name)
+        if node is not None:
+            node.numa_info = info
+            # widen vs narrow decides how the scheduler-side view is merged
+            # at snapshot time (reference: event_handlers.go:818-841 Compare)
+            shrank = old is not None and any(
+                len(info.numa_res_map[res].allocatable) < len(ri.allocatable)
+                for res, ri in old.numa_res_map.items()
+                if res in info.numa_res_map)
+            node.numa_chg_flag = "less" if shrank else "more"
+
+    def update_numa_info(self, old: obj.Numatopology, new: obj.Numatopology) -> None:
+        self.add_numa_info(new)
+
+    def delete_numa_info(self, nt: obj.Numatopology) -> None:
+        self.numatopologies.pop(nt.metadata.name, None)
+        node = self.nodes.get(nt.metadata.name)
+        if node is not None:
+            node.numa_info = None

@@ -1,0 +1,91 @@
+"""Cache-facing executor interfaces (reference: pkg/scheduler/cache/
+interface.go:29-100): Binder, Evictor, StatusUpdater, VolumeBinder, plus the
+store-backed default implementations."""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol
+
+from ..models.objects import Pod, PodGroup
+
+
+class Binder(Protocol):
+    def bind(self, pod: Pod, hostname: str) -> None: ...
+
+
+class Evictor(Protocol):
+    def evict(self, pod: Pod, reason: str) -> None: ...
+
+
+class StatusUpdater(Protocol):
+    def update_pod_condition(self, pod: Pod, reason: str, message: str) -> None: ...
+    def update_pod_group(self, pg: PodGroup) -> PodGroup: ...
+
+
+class VolumeBinder(Protocol):
+    def get_pod_volumes(self, task, node): ...
+    def allocate_volumes(self, task, hostname, pod_volumes) -> None: ...
+    def bind_volumes(self, task, pod_volumes) -> None: ...
+
+
+class StoreBinder:
+    """Default binder: writes pod.spec.node_name through the object store
+    (the standalone equivalent of POST .../binding, cache.go:214-230)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def bind(self, pod: Pod, hostname: str) -> None:
+        live = self.store.get("pods", pod.metadata.name, pod.metadata.namespace)
+        if live is None:
+            raise KeyError(f"pod {pod.metadata.key()} not found")
+        live.spec.node_name = hostname
+        self.store.update("pods", live, skip_admission=True)
+
+
+class StoreEvictor:
+    """Default evictor: deletes the pod through the store (cache.go:232-255)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def evict(self, pod: Pod, reason: str) -> None:
+        self.store.record_event("pods", pod, "Normal", "Evict", reason)
+        self.store.delete("pods", pod.metadata.name, pod.metadata.namespace,
+                          skip_admission=True)
+
+
+class StoreStatusUpdater:
+    """Default status updater: pushes PodGroup status (cache.go:257-290)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def update_pod_condition(self, pod: Pod, reason: str, message: str) -> None:
+        live = self.store.get("pods", pod.metadata.name, pod.metadata.namespace)
+        if live is not None:
+            live.status.reason = reason
+            live.status.message = message
+            self.store.update("pods", live, skip_admission=True)
+
+    def update_pod_group(self, pg: PodGroup) -> Optional[PodGroup]:
+        live = self.store.get("podgroups", pg.metadata.name, pg.metadata.namespace)
+        if live is None:
+            return None
+        live.status = pg.status
+        live.spec = pg.spec
+        return self.store.update("podgroups", live, skip_admission=True)
+
+
+class NullVolumeBinder:
+    """Volume scheduling is not modeled; all pods' volumes are always ready
+    (the reference's FakeVolumeBinder, util/test_utils.go:160-177)."""
+
+    def get_pod_volumes(self, task, node):
+        return None
+
+    def allocate_volumes(self, task, hostname, pod_volumes) -> None:
+        return None
+
+    def bind_volumes(self, task, pod_volumes) -> None:
+        return None

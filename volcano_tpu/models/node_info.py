@@ -60,6 +60,7 @@ class NodeInfo:
         self.tasks: Dict[str, TaskInfo] = {}
         self.numa_info = None            # NumatopoInfo, set by cache
         self.numa_scheduler_info = None
+        self.numa_chg_flag: str = ""     # ""|"more"|"less" (NumaChgFlag)
         self.revocable_zone: str = ""
         self.others: Dict[str, object] = {}
         self.gpu_devices: Dict[int, GPUDevice] = {}
@@ -130,6 +131,24 @@ class NodeInfo:
 
     def ready(self) -> bool:
         return self.state.phase == "Ready"
+
+    def refresh_numa_scheduler_info(self) -> None:
+        """Sync scheduler-side NUMA view from the CRD-fed one, only widening
+        (or narrowing when the kubelet shrank allocatable)
+        (node_info.go:120-143 RefreshNumaSchedulerInfoByCrd)."""
+        if self.numa_info is None:
+            self.numa_scheduler_info = None
+            return
+        if self.numa_scheduler_info is None or self.numa_chg_flag == "more":
+            self.numa_scheduler_info = self.numa_info.clone()
+        elif self.numa_chg_flag == "less":
+            tmp = self.numa_info.clone()
+            for res, resinfo in tmp.numa_res_map.items():
+                cur = self.numa_scheduler_info.numa_res_map.get(res)
+                if cur is not None and len(cur.allocatable) >= len(resinfo.allocatable):
+                    cur.allocatable = set(resinfo.allocatable)
+                    cur.capacity = resinfo.capacity
+        self.numa_chg_flag = ""
 
     def future_idle(self) -> Resource:
         """Idle + Releasing - Pipelined (node_info.go:71-73)."""

@@ -1,0 +1,1 @@
+from .clock import Clock, FakeClock, GLOBAL_CLOCK  # noqa: F401
