@@ -1,0 +1,54 @@
+"""Action/plugin test harness: fake-binder + real cache + real session
+(the reference's key test pattern, allocate_test.go:211-276)."""
+
+from volcano_tpu.apiserver import ObjectStore
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.framework import (close_session, open_session,
+                                   parse_scheduler_conf)
+from volcano_tpu.utils.test_utils import (FakeBinder, FakeEvictor,
+                                          FakeStatusUpdater)
+
+
+class Harness:
+    def __init__(self, conf_text: str):
+        self.store = ObjectStore()
+        self.binder = FakeBinder(self.store)
+        self.evictor = FakeEvictor(self.store)
+        self.cache = SchedulerCache(self.store, binder=self.binder,
+                                    evictor=self.evictor,
+                                    status_updater=FakeStatusUpdater())
+        self.cache.run()
+        self.conf = parse_scheduler_conf(conf_text)
+        self.ssn = None
+
+    def add(self, kind, *objs):
+        for o in objs:
+            self.store.create(kind, o)
+        return self
+
+    def open_session(self):
+        self.ssn = open_session(self.cache, self.conf.tiers,
+                                self.conf.configurations)
+        return self.ssn
+
+    def run_actions(self, *names):
+        from volcano_tpu.framework import get_action
+        if self.ssn is None:
+            self.open_session()
+        for name in names:
+            get_action(name).execute(self.ssn)
+        return self
+
+    def close_session(self):
+        if self.ssn is not None:
+            close_session(self.ssn)
+            self.ssn = None
+        return self
+
+    @property
+    def binds(self):
+        return self.binder.binds
+
+    @property
+    def evicts(self):
+        return self.evictor.evicts

@@ -1,0 +1,209 @@
+"""allocate action tests (mirroring pkg/scheduler/actions/allocate/
+allocate_test.go): gang commit/rollback, binpack vs spread, predicates,
+pipeline on releasing resources."""
+
+import pytest
+
+from tests.harness import Harness
+from volcano_tpu.models import TaskStatus, objects
+from volcano_tpu.models.objects import PodGroupPhase, Taint
+from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                          build_pod_group, build_queue,
+                                          build_resource_list)
+
+CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: nodeorder
+"""
+
+CONF_BINPACK = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: binpack
+"""
+
+RL1 = build_resource_list("1", "1Gi")
+RL2 = build_resource_list("2", "2Gi")
+RL4 = build_resource_list("4", "4Gi")
+RL8 = build_resource_list("8", "8Gi")
+
+
+def inqueue_pg(name, ns, queue, minm, **kw):
+    return build_pod_group(name, ns, queue, minm, phase=PodGroupPhase.INQUEUE, **kw)
+
+
+class TestAllocate:
+    def test_single_gang_allocates(self):
+        """Config-1 shape: one PodGroup, gang minAvailable=3."""
+        h = Harness(CONF)
+        h.add("queues", build_queue("default"))
+        h.add("nodes", build_node("n1", RL8), build_node("n2", RL8))
+        h.add("podgroups", inqueue_pg("pg1", "ns1", "default", 3))
+        for i in range(3):
+            h.add("pods", build_pod("ns1", f"p{i}", "", "Pending", RL2, "pg1"))
+        h.run_actions("allocate").close_session()
+        assert len(h.binds) == 3
+        assert set(h.binds) == {f"ns1/p{i}" for i in range(3)}
+
+    def test_gang_rollback_when_insufficient(self):
+        """A gang that cannot fully fit gets nothing (statement discard)."""
+        h = Harness(CONF)
+        h.add("queues", build_queue("default"))
+        h.add("nodes", build_node("n1", RL4))
+        h.add("podgroups", inqueue_pg("pg1", "ns1", "default", 3))
+        for i in range(3):
+            h.add("pods", build_pod("ns1", f"p{i}", "", "Pending", RL2, "pg1"))
+        h.run_actions("allocate").close_session()
+        assert h.binds == {}
+        # gang wrote the Unschedulable condition on close
+        pg = h.store.get("podgroups", "pg1", "ns1")
+        assert any(c.type == "Unschedulable" for c in pg.status.conditions)
+
+    def test_rollback_frees_resources_for_next_job(self):
+        """After a gang rollback, a later job must see the freed nodes."""
+        h = Harness(CONF)
+        h.add("queues", build_queue("default"))
+        h.add("nodes", build_node("n1", RL4))
+        big = inqueue_pg("big", "ns1", "default", 3)
+        big.metadata.creation_timestamp = 1.0
+        small = inqueue_pg("small", "ns1", "default", 2)
+        small.metadata.creation_timestamp = 2.0
+        h.add("podgroups", big, small)
+        for i in range(3):
+            h.add("pods", build_pod("ns1", f"big-{i}", "", "Pending", RL2, "big"))
+        for i in range(2):
+            h.add("pods", build_pod("ns1", f"small-{i}", "", "Pending", RL2, "small"))
+        h.run_actions("allocate").close_session()
+        assert set(h.binds) == {"ns1/small-0", "ns1/small-1"}
+
+    def test_pending_phase_podgroup_skipped(self):
+        """Jobs not yet enqueued are not allocated (allocate.go:61-63)."""
+        h = Harness(CONF)
+        h.add("queues", build_queue("default"))
+        h.add("nodes", build_node("n1", RL8))
+        h.add("podgroups", build_pod_group("pg1", "ns1", "default", 1,
+                                           phase=PodGroupPhase.PENDING))
+        h.add("pods", build_pod("ns1", "p0", "", "Pending", RL2, "pg1"))
+        h.run_actions("allocate").close_session()
+        assert h.binds == {}
+
+    def test_priority_order(self):
+        """Higher-priority job wins scarce resources."""
+        h = Harness(CONF)
+        h.add("queues", build_queue("default"))
+        h.add("nodes", build_node("n1", RL2))
+        h.add("priorityclasses",
+              objects.PriorityClass(metadata=objects.ObjectMeta(name="high"),
+                                    value=100))
+        lo = inqueue_pg("lo", "ns1", "default", 1)
+        lo.metadata.creation_timestamp = 1.0
+        hi = inqueue_pg("hi", "ns1", "default", 1, priority_class="high")
+        hi.metadata.creation_timestamp = 2.0
+        h.add("podgroups", lo, hi)
+        h.add("pods", build_pod("ns1", "lo-0", "", "Pending", RL2, "lo"))
+        h.add("pods", build_pod("ns1", "hi-0", "", "Pending", RL2, "hi"))
+        h.run_actions("allocate").close_session()
+        assert set(h.binds) == {"ns1/hi-0"}
+
+    def test_node_selector_predicate(self):
+        h = Harness(CONF)
+        h.add("queues", build_queue("default"))
+        h.add("nodes", build_node("n1", RL8),
+              build_node("n2", RL8, labels={"zone": "a"}))
+        h.add("podgroups", inqueue_pg("pg1", "ns1", "default", 1))
+        h.add("pods", build_pod("ns1", "p0", "", "Pending", RL2, "pg1",
+                                selector={"zone": "a"}))
+        h.run_actions("allocate").close_session()
+        assert h.binds == {"ns1/p0": "n2"}
+
+    def test_taint_predicate(self):
+        h = Harness(CONF)
+        h.add("queues", build_queue("default"))
+        tainted = build_node("n1", RL8)
+        tainted.spec.taints.append(Taint(key="dedicated", value="x",
+                                         effect="NoSchedule"))
+        h.add("nodes", tainted, build_node("n2", RL8))
+        h.add("podgroups", inqueue_pg("pg1", "ns1", "default", 1))
+        h.add("pods", build_pod("ns1", "p0", "", "Pending", RL2, "pg1"))
+        h.run_actions("allocate").close_session()
+        assert h.binds == {"ns1/p0": "n2"}
+
+    def test_binpack_packs_one_node(self):
+        """With binpack scoring, tasks stack onto the same node."""
+        h = Harness(CONF_BINPACK)
+        h.add("queues", build_queue("default"))
+        h.add("nodes", build_node("n1", RL8), build_node("n2", RL8))
+        h.add("podgroups", inqueue_pg("pg1", "ns1", "default", 2))
+        h.add("pods", build_pod("ns1", "p0", "", "Pending", RL2, "pg1"))
+        h.add("pods", build_pod("ns1", "p1", "", "Pending", RL2, "pg1"))
+        h.run_actions("allocate").close_session()
+        assert len(h.binds) == 2
+        assert len(set(h.binds.values())) == 1  # same node
+
+    def test_spread_with_leastrequested(self):
+        """Default nodeorder (leastrequested) spreads across nodes."""
+        h = Harness(CONF)
+        h.add("queues", build_queue("default"))
+        h.add("nodes", build_node("n1", RL8), build_node("n2", RL8))
+        h.add("podgroups", inqueue_pg("pg1", "ns1", "default", 2))
+        h.add("pods", build_pod("ns1", "p0", "", "Pending", RL2, "pg1"))
+        h.add("pods", build_pod("ns1", "p1", "", "Pending", RL2, "pg1"))
+        h.run_actions("allocate").close_session()
+        assert len(h.binds) == 2
+        assert len(set(h.binds.values())) == 2  # different nodes
+
+    def test_pipeline_on_releasing(self):
+        """A task that fits only future idle gets Pipelined, not bound."""
+        h = Harness(CONF)
+        h.add("queues", build_queue("default"))
+        h.add("nodes", build_node("n1", RL2))
+        # running pod being deleted -> releasing resources
+        dying = build_pod("ns1", "dying", "n1", "Running", RL2, "old")
+        dying.metadata.deletion_timestamp = 1.0
+        h.add("pods", dying)
+        h.add("podgroups", inqueue_pg("pg1", "ns1", "default", 1))
+        h.add("pods", build_pod("ns1", "p0", "", "Pending", RL2, "pg1"))
+        h.run_actions("allocate")
+        job = h.ssn.jobs["ns1/pg1"]
+        assert job.waiting_task_num() == 1  # pipelined in session
+        h.close_session()
+        assert h.binds == {}  # nothing actually bound
+
+    def test_best_effort_skipped(self):
+        h = Harness(CONF)
+        h.add("queues", build_queue("default"))
+        h.add("nodes", build_node("n1", RL8))
+        h.add("podgroups", inqueue_pg("pg1", "ns1", "default", 0))
+        h.add("pods", build_pod("ns1", "p0", "", "Pending", {}, "pg1"))
+        h.run_actions("allocate").close_session()
+        assert h.binds == {}
+
+    def test_surplus_tasks_beyond_min(self):
+        """minAvailable=1 but 3 tasks pending: all get placed (phase B)."""
+        h = Harness(CONF)
+        h.add("queues", build_queue("default"))
+        h.add("nodes", build_node("n1", RL8))
+        h.add("podgroups", inqueue_pg("pg1", "ns1", "default", 1))
+        for i in range(3):
+            h.add("pods", build_pod("ns1", f"p{i}", "", "Pending", RL2, "pg1"))
+        h.run_actions("allocate").close_session()
+        assert len(h.binds) == 3
+
+    def test_missing_queue_skips_job(self):
+        h = Harness(CONF)
+        h.add("nodes", build_node("n1", RL8))
+        h.add("podgroups", inqueue_pg("pg1", "ns1", "ghost-queue", 1))
+        h.add("pods", build_pod("ns1", "p0", "", "Pending", RL2, "pg1"))
+        h.run_actions("allocate").close_session()
+        assert h.binds == {}

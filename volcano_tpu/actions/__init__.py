@@ -1,0 +1,4 @@
+"""Builtin actions (reference: pkg/scheduler/actions/factory.go:30-38).
+Importing this package registers them."""
+
+from . import allocate  # noqa: F401

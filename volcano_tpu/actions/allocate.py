@@ -1,0 +1,206 @@
+"""allocate: the hot-path action.
+
+Mirrors pkg/scheduler/actions/allocate/allocate.go with the per-task loop
+replaced by the batched TPU solver:
+
+1. Collect allocatable jobs (PodGroup not Pending-phase, JobValid, queue
+   exists, queue not Overused) -- allocate.go:60-103.
+2. Order host-side: namespaces by NamespaceOrderFn, queues by QueueOrderFn,
+   jobs by JobOrderFn, each job's pending non-best-effort tasks by
+   TaskOrderFn -- allocate.go:54-96,183-196.
+3. Place in two solver phases, preserving the reference's breadth-first
+   behavior (a ready job re-queues its extra tasks, allocate.go:258-262):
+   phase A places each job's tasks up to its remaining minAvailable with
+   gang commit/rollback in-kernel; phase B places the committed/kept jobs'
+   surplus tasks with no gang constraint.
+4. Apply to the session through a Statement per job: JobReady -> Commit
+   (binds), JobPipelined -> keep, else Discard -- allocate.go:264-270.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..framework.plugin import Action
+from ..framework.registry import register_action
+from ..framework.statement import Statement
+from ..metrics import metrics as m
+from ..models.job_info import JobInfo, TaskInfo, TaskStatus
+from ..models.objects import PodGroupPhase
+
+
+class AllocateAction(Action):
+    def name(self) -> str:
+        return "allocate"
+
+    def execute(self, ssn) -> None:
+        # latency is observed by the scheduler loop's action_timer
+        self._execute(ssn)
+
+    # -- ordering ----------------------------------------------------------
+
+    def _ordered_jobs(self, ssn) -> List[JobInfo]:
+        """(namespace, queue, job) nested ordering, flattened."""
+        jobs_by_ns_queue: Dict[str, Dict[str, List[JobInfo]]] = {}
+        for job in ssn.jobs.values():
+            if job.pod_group.status.phase == PodGroupPhase.PENDING:
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.passed:
+                continue
+            if job.queue not in ssn.queues:
+                continue
+            jobs_by_ns_queue.setdefault(job.namespace, {}) \
+                .setdefault(job.queue, []).append(job)
+
+        import functools
+        ns_sorted = sorted(
+            jobs_by_ns_queue,
+            key=functools.cmp_to_key(
+                lambda a, b: -1 if ssn.namespace_order_fn(a, b) else 1))
+
+        ordered: List[JobInfo] = []
+        for ns in ns_sorted:
+            queues = [ssn.queues[q] for q in jobs_by_ns_queue[ns]
+                      if not ssn.overused(ssn.queues[q])]
+            queues.sort(key=functools.cmp_to_key(
+                lambda a, b: -1 if ssn.queue_order_fn(a, b) else 1))
+            for q in queues:
+                jobs = jobs_by_ns_queue[ns][q.name]
+                jobs.sort(key=functools.cmp_to_key(
+                    lambda a, b: -1 if ssn.job_order_fn(a, b) else 1))
+                ordered.extend(jobs)
+        return ordered
+
+    def _pending_tasks(self, ssn, job: JobInfo) -> List[TaskInfo]:
+        """Pending, non-best-effort, task-order sorted (allocate.go:183-196)."""
+        import functools
+        tasks = [t for t in job.task_status_index.get(TaskStatus.Pending, {}).values()
+                 if not t.resreq.is_empty()]
+        tasks.sort(key=functools.cmp_to_key(
+            lambda a, b: -1 if ssn.task_order_fn(a, b) else 1))
+        return tasks
+
+    # -- main --------------------------------------------------------------
+
+    def _execute(self, ssn) -> None:
+        ordered_jobs = self._ordered_jobs(ssn)
+        if not ordered_jobs:
+            return
+
+        pending: Dict[str, List[TaskInfo]] = {}
+        phase_a = []
+        for job in ordered_jobs:
+            tasks = self._pending_tasks(ssn, job)
+            if not tasks:
+                continue
+            pending[job.uid] = tasks
+            need = max(0, job.min_available - job.ready_task_num())
+            phase_a.append((job, tasks[:need] if need else []))
+
+        if not phase_a:
+            return
+
+        result_a = ssn.solver.place([(j, t) for j, t in phase_a],
+                                    allow_pipeline=True)
+
+        # phase B: surplus tasks of jobs that survived phase A
+        phase_b = []
+        for job, tasks_a in phase_a:
+            if not (result_a.committed[job.uid] or result_a.kept[job.uid]):
+                continue
+            surplus = pending[job.uid][len(tasks_a):]
+            if surplus:
+                shadow = _ZeroMinJob(job)
+                phase_b.append((job, shadow, surplus))
+
+        placements = {job.uid: list(result_a.placements[job.uid])
+                      for job, _ in phase_a}
+        if phase_b:
+            # phase A's claims must be visible to phase B's solver run;
+            # stage them in session state first, then place surplus
+            staged = self._stage(ssn, phase_a, result_a, placements)
+            result_b = ssn.solver.place(
+                [(shadow, ts) for _, shadow, ts in phase_b],
+                allow_pipeline=True)
+            for job, shadow, _ in phase_b:
+                placements[job.uid].extend(result_b.placements[shadow.uid])
+            self._apply_extra(ssn, staged, result_b, phase_b)
+            self._finalize(ssn, phase_a, result_a, staged)
+        else:
+            staged = self._stage(ssn, phase_a, result_a, placements)
+            self._finalize(ssn, phase_a, result_a, staged)
+
+    # -- session application ----------------------------------------------
+
+    def _stage(self, ssn, phase_a, result_a, placements) -> Dict[str, Statement]:
+        """Stage phase-A placements into session state via per-job statements."""
+        staged: Dict[str, Statement] = {}
+        for job, _ in phase_a:
+            if not (result_a.committed[job.uid] or result_a.kept[job.uid]):
+                continue
+            stmt = Statement(ssn)
+            ok = True
+            for p in result_a.placements[job.uid]:
+                try:
+                    if p.pipelined:
+                        stmt.pipeline(p.task, p.node_name)
+                    else:
+                        stmt.allocate(p.task, ssn.nodes[p.node_name])
+                except (KeyError, RuntimeError, AssertionError):
+                    ok = False
+                    break
+            if not ok:
+                stmt.discard()
+                continue
+            staged[job.uid] = stmt
+        return staged
+
+    def _apply_extra(self, ssn, staged, result_b, phase_b) -> None:
+        """Stage surplus placements onto the same statements."""
+        for job, shadow, _ in phase_b:
+            stmt = staged.get(job.uid)
+            if stmt is None:
+                continue
+            for p in result_b.placements.get(shadow.uid, []):
+                try:
+                    if p.pipelined:
+                        stmt.pipeline(p.task, p.node_name)
+                    else:
+                        stmt.allocate(p.task, ssn.nodes[p.node_name])
+                except (KeyError, RuntimeError, AssertionError):
+                    break
+
+    def _finalize(self, ssn, phase_a, result_a, staged) -> None:
+        """JobReady -> Commit; JobPipelined -> keep; else Discard."""
+        for job, _ in phase_a:
+            stmt = staged.get(job.uid)
+            if stmt is None:
+                continue
+            if ssn.job_ready(job):
+                stmt.commit()
+                m.register_schedule_attempt("scheduled")
+            elif ssn.job_pipelined(job):
+                pass  # keep claims in session state
+            else:
+                stmt.discard()
+                m.register_schedule_attempt("unschedulable")
+
+
+class _ZeroMinJob:
+    """A shadow of a job with min_available 0, for gang-free surplus
+    placement (the reference achieves this by re-queuing ready jobs)."""
+
+    def __init__(self, job: JobInfo):
+        self._job = job
+        self.uid = job.uid
+        self.min_available = 0
+
+    def ready_task_num(self) -> int:
+        return 0
+
+    def __getattr__(self, item):
+        return getattr(self._job, item)
+
+
+register_action(AllocateAction())

@@ -1,0 +1,15 @@
+"""Scheduling framework: Session/Statement, registries, conf, TPU solver."""
+
+from .arguments import Arguments  # noqa: F401
+from .conf import (PluginOption, SchedulerConfiguration, Tier,  # noqa: F401
+                   default_scheduler_conf, parse_scheduler_conf)
+from .framework import (close_session, job_status, open_session,  # noqa: F401
+                        update_pod_group_condition)
+from .plugin import Plugin  # noqa: F401
+from .registry import (get_action, get_plugin_builder,  # noqa: F401
+                       load_custom_plugins, register_action,
+                       register_plugin_builder)
+from .session import (ABSTAIN, PERMIT, REJECT, Event, EventHandler,  # noqa: F401
+                      Session, ValidateResult)
+from .solver import BatchSolver, Placement, PlacementResult  # noqa: F401
+from .statement import Statement  # noqa: F401

@@ -1,0 +1,105 @@
+"""Scheduler configuration schema (reference: pkg/scheduler/conf/
+scheduler_conf.go:20-103 + plugins/defaults.go + pkg/scheduler/util.go:31-84).
+
+YAML shape:
+
+    actions: "enqueue, allocate, backfill"
+    tiers:
+    - plugins:
+      - name: priority
+      - name: gang
+    - plugins:
+      - name: drf
+        enableJobOrder: false
+        arguments:
+          drf.enableHierarchy: true
+
+Every per-extension-point enable flag defaults to true (defaults.go), so a
+bare plugin name enables everything the plugin registers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import yaml
+
+from .arguments import Arguments
+
+DEFAULT_SCHEDULER_CONF = """\
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+# the ~18 per-extension-point enables (conf/scheduler_conf.go:44-94)
+ENABLE_FLAGS = (
+    "enabledJobOrder", "enabledNamespaceOrder", "enabledHierarchy",
+    "enabledJobReady", "enabledJobPipelined", "enabledTaskOrder",
+    "enabledPreemptable", "enabledReclaimable", "enabledQueueOrder",
+    "enabledPredicate", "enabledBestNode", "enabledNodeOrder",
+    "enabledTargetJob", "enabledReservedNodes", "enabledJobEnqueued",
+    "enabledVictim", "enabledJobStarving", "enabledOverused",
+)
+
+
+@dataclass
+class PluginOption:
+    name: str
+    enabled: Dict[str, bool] = field(default_factory=dict)
+    arguments: Arguments = field(default_factory=Arguments)
+
+    def is_enabled(self, flag: str) -> bool:
+        """Unset flags default to enabled (plugins/defaults.go)."""
+        return self.enabled.get(flag, True)
+
+
+@dataclass
+class Tier:
+    plugins: List[PluginOption] = field(default_factory=list)
+
+
+@dataclass
+class SchedulerConfiguration:
+    actions: List[str] = field(default_factory=list)
+    tiers: List[Tier] = field(default_factory=list)
+    configurations: Dict[str, Arguments] = field(default_factory=dict)
+
+
+def parse_scheduler_conf(text: str) -> SchedulerConfiguration:
+    """Parse + validate; raises ValueError on unknown actions
+    (util.go:57-84 unmarshalSchedulerConf + validation in scheduler.go)."""
+    raw = yaml.safe_load(text) or {}
+    conf = SchedulerConfiguration()
+    actions = raw.get("actions", "")
+    conf.actions = [a.strip() for a in actions.split(",") if a.strip()]
+    for tier_raw in raw.get("tiers", []) or []:
+        tier = Tier()
+        for p in tier_raw.get("plugins", []) or []:
+            opt = PluginOption(name=p["name"])
+            for key, value in p.items():
+                if key in ("name", "arguments"):
+                    continue
+                # accept both enabledX and enableX spellings
+                canon = key if key.startswith("enabled") else \
+                    "enabled" + key[len("enable"):] if key.startswith("enable") else key
+                if canon in ENABLE_FLAGS:
+                    opt.enabled[canon] = bool(value)
+            opt.arguments = Arguments(p.get("arguments") or {})
+            tier.plugins.append(opt)
+        conf.tiers.append(tier)
+    for c in raw.get("configurations", []) or []:
+        conf.configurations[c.get("name", "")] = Arguments(c.get("arguments") or {})
+    return conf
+
+
+def default_scheduler_conf() -> SchedulerConfiguration:
+    return parse_scheduler_conf(DEFAULT_SCHEDULER_CONF)

@@ -1,0 +1,64 @@
+"""Plugin and action registries (reference: pkg/scheduler/framework/
+plugins.go:37-119 + actions/factory.go).
+
+Out-of-tree plugins load through Python entry points in the
+``volcano_tpu.plugins`` group -- the TPU-native analogue of the reference's
+dynamic ``.so`` loading via plugin.Open/Lookup("New")
+(plugins.go:62-101 LoadCustomPlugins).
+"""
+
+from __future__ import annotations
+
+import importlib.metadata
+from typing import Callable, Dict, Optional
+
+PluginBuilder = Callable  # (Arguments) -> Plugin
+
+_plugin_builders: Dict[str, PluginBuilder] = {}
+_actions: Dict[str, object] = {}
+
+
+def register_plugin_builder(name: str, builder: PluginBuilder) -> None:
+    _plugin_builders[name] = builder
+
+
+def get_plugin_builder(name: str) -> Optional[PluginBuilder]:
+    _ensure_builtins()
+    if name not in _plugin_builders:
+        load_custom_plugins()
+    return _plugin_builders.get(name)
+
+
+def register_action(action) -> None:
+    _actions[action.name()] = action
+
+
+def get_action(name: str) -> Optional[object]:
+    _ensure_builtins()
+    return _actions.get(name)
+
+
+def load_custom_plugins(group: str = "volcano_tpu.plugins") -> None:
+    """Discover out-of-tree plugin builders via entry points."""
+    try:
+        eps = importlib.metadata.entry_points(group=group)
+    except Exception:
+        return
+    for ep in eps:
+        if ep.name not in _plugin_builders:
+            try:
+                _plugin_builders[ep.name] = ep.load()
+            except Exception:
+                continue
+
+
+_builtins_loaded = False
+
+
+def _ensure_builtins() -> None:
+    global _builtins_loaded
+    if _builtins_loaded:
+        return
+    _builtins_loaded = True
+    from .. import actions as _actions_pkg   # noqa: F401 (registers via import)
+    from .. import plugins as _plugins_pkg   # noqa: F401

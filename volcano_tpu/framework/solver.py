@@ -1,0 +1,220 @@
+"""BatchSolver: the session's TPU placement context.
+
+This is the TPU-native replacement for the reference's per-task scheduling
+helpers (pkg/scheduler/util/scheduler_helper.go: PredicateNodes,
+PrioritizeNodes, SelectBestNode): instead of 16-way goroutine fan-out per
+task, the whole ordered task batch is placed by one jitted gang-allocate
+scan over dense snapshot arrays (models/arrays.py, ops/allocate.py).
+
+Builtin plugins contribute during OnSessionOpen:
+  * score weights (binpack / nodeorder terms) -> ``set_weight``
+  * extra feasibility masks [G, N]            -> ``add_mask_fn``
+  * static score terms [G, N]                 -> ``add_static_score_fn``
+
+Plugins that only register host-side predicate fns (out-of-tree ones) are
+honored through a per-group fallback sweep, trading speed for generality.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..models.arrays import (NodeArrays, PredicateFeatures, ResourceIndex,
+                             TaskBatch)
+from ..models.job_info import JobInfo, TaskInfo
+from ..models.unschedule_info import FitError, FitErrors
+from ..ops.allocate import gang_allocate
+from ..ops.fit import group_fit_mask, selector_mask, static_predicate_mask, taint_mask
+from ..ops.score import ScoreWeights
+
+
+@dataclass
+class Placement:
+    task: TaskInfo
+    node_name: str
+    pipelined: bool
+
+
+@dataclass
+class PlacementResult:
+    batch: TaskBatch
+    committed: Dict[str, bool]                  # job uid -> JobReady (bind)
+    kept: Dict[str, bool]                       # job uid -> JobPipelined (keep)
+    placements: Dict[str, List[Placement]]      # job uid -> placements
+    unplaced: Dict[str, List[TaskInfo]]         # job uid -> tasks left pending
+
+
+class BatchSolver:
+    def __init__(self, ssn):
+        self.ssn = ssn
+        self.rindex = ResourceIndex.from_cluster(ssn.nodes, ssn.jobs)
+        self._weights: Dict[str, float] = {"binpack": 0.0, "least": 0.0,
+                                           "most": 0.0, "balanced": 0.0}
+        self._binpack_res: Optional[np.ndarray] = None
+        self.mask_fns: List[Callable] = []
+        self.static_score_fns: List[Callable] = []
+        self.vectorized_plugins: set = set()
+        self.enable_default_predicates = False
+
+    # -- plugin contribution API ------------------------------------------
+
+    def set_weight(self, term: str, value: float) -> None:
+        self._weights[term] = float(value)
+
+    def add_weight(self, term: str, value: float) -> None:
+        self._weights[term] = self._weights.get(term, 0.0) + float(value)
+
+    def set_binpack_resources(self, weights_by_name: Dict[str, float]) -> None:
+        w = np.zeros(self.rindex.r, np.float32)
+        for name, weight in weights_by_name.items():
+            i = self.rindex.index.get(name)
+            if i is not None:
+                w[i] = weight
+        self._binpack_res = w
+
+    def add_mask_fn(self, fn: Callable) -> None:
+        """fn(batch, node_arrays, features) -> [G, N] bool"""
+        self.mask_fns.append(fn)
+
+    def add_static_score_fn(self, fn: Callable) -> None:
+        """fn(batch, node_arrays, features) -> [G, N] float"""
+        self.static_score_fns.append(fn)
+
+    def mark_vectorized(self, plugin_name: str) -> None:
+        self.vectorized_plugins.add(plugin_name)
+
+    def score_weights(self) -> ScoreWeights:
+        br = self._binpack_res if self._binpack_res is not None \
+            else np.ones(self.rindex.r, np.float32)
+        return ScoreWeights(jnp.asarray(br),
+                            jnp.float32(self._weights.get("binpack", 0.0)),
+                            jnp.float32(self._weights.get("least", 0.0)),
+                            jnp.float32(self._weights.get("most", 0.0)),
+                            jnp.float32(self._weights.get("balanced", 0.0)))
+
+    # -- placement ---------------------------------------------------------
+
+    def _host_predicate_mask(self, batch: TaskBatch, narr: NodeArrays) -> Optional[np.ndarray]:
+        """Fallback for plugins that registered only host predicate fns."""
+        extra = {name: fn for name, fn in self.ssn.predicate_fns.items()
+                 if name not in self.vectorized_plugins}
+        if not extra:
+            return None
+        mask = np.ones((batch.g_pad, narr.n_pad), bool)
+        for g, members in enumerate(batch.group_members):
+            rep = batch.tasks[members[0]]
+            for name, node in self.ssn.nodes.items():
+                i = narr.name_to_idx.get(name)
+                if i is None:
+                    continue
+                for fn in extra.values():
+                    try:
+                        fn(rep, node)
+                    except Exception:
+                        mask[g, i] = False
+                        break
+        return mask
+
+    def place(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]],
+              allow_pipeline: bool = True) -> PlacementResult:
+        """Run the gang-allocate kernel for the ordered job/task batch against
+        the session's *current* node state."""
+        ssn = self.ssn
+        narr = NodeArrays.build(ssn.nodes, [n.name for n in ssn.node_list],
+                                self.rindex)
+        batch = TaskBatch.build(ordered_jobs, self.rindex)
+        feats = PredicateFeatures.build(ssn.nodes, narr, batch)
+
+        eps = jnp.asarray(self.rindex.eps)
+        fit_cap = group_fit_mask(jnp.asarray(batch.group_req),
+                                 jnp.asarray(narr.capability), eps)
+        if self.enable_default_predicates:
+            sel_ok = selector_mask(jnp.asarray(feats.node_pairs),
+                                   jnp.asarray(feats.group_requires),
+                                   jnp.asarray(feats.group_require_counts))
+            taint_ok = taint_mask(jnp.asarray(feats.node_taints),
+                                  jnp.asarray(feats.group_tolerates))
+            affinity_ok = jnp.asarray(feats.group_affinity_ok)
+        else:
+            shape = (batch.g_pad, narr.n_pad)
+            sel_ok = jnp.ones(shape, bool)
+            taint_ok = jnp.ones(shape, bool)
+            affinity_ok = jnp.ones(shape, bool)
+
+        gmask = static_predicate_mask(jnp.asarray(narr.valid), fit_cap,
+                                      sel_ok, taint_ok, affinity_ok)
+        for fn in self.mask_fns:
+            gmask = gmask & jnp.asarray(fn(batch, narr, feats))
+        host_mask = self._host_predicate_mask(batch, narr)
+        if host_mask is not None:
+            gmask = gmask & jnp.asarray(host_mask)
+
+        static_score = jnp.zeros((batch.g_pad, narr.n_pad), jnp.float32)
+        for fn in self.static_score_fns:
+            static_score = static_score + jnp.asarray(fn(batch, narr, feats))
+
+        assign, pipelined, ready, kept, _ = gang_allocate(
+            jnp.asarray(batch.task_group), jnp.asarray(batch.task_job),
+            jnp.asarray(batch.task_valid), jnp.asarray(batch.group_req),
+            gmask, static_score,
+            jnp.asarray(batch.job_min_available),
+            jnp.asarray(batch.job_ready_base),
+            jnp.asarray(narr.idle), jnp.asarray(narr.future_idle),
+            jnp.asarray(narr.allocatable), jnp.asarray(narr.n_tasks),
+            jnp.asarray(narr.max_tasks), eps, self.score_weights(),
+            allow_pipeline=allow_pipeline)
+
+        assign = np.asarray(assign)
+        pipelined_np = np.asarray(pipelined)
+        ready_np = np.asarray(ready)
+        kept_np = np.asarray(kept)
+        gmask_np = np.asarray(gmask)
+
+        result = PlacementResult(batch=batch, committed={}, kept={},
+                                 placements={}, unplaced={})
+        for j, (job, jtasks) in enumerate(ordered_jobs):
+            if not jtasks:
+                # job contributed no tasks to the scan: readiness is decided
+                # by its pre-existing occupancy alone
+                ok = job.ready_task_num() >= job.min_available
+                was_kept = ok
+            else:
+                ok = bool(ready_np[j])
+                was_kept = bool(kept_np[j])
+            result.committed[job.uid] = ok
+            result.kept[job.uid] = was_kept
+            placements, unplaced = [], []
+            for t_idx in range(batch.job_task_start[j], batch.job_task_end[j]):
+                task = batch.tasks[t_idx]
+                node_i = int(assign[t_idx])
+                if (ok or was_kept) and node_i >= 0:
+                    placements.append(Placement(task, narr.names[node_i],
+                                                bool(pipelined_np[t_idx])))
+                else:
+                    unplaced.append(task)
+                    self._record_fit_errors(job, task, batch, narr, gmask_np,
+                                            t_idx)
+            result.placements[job.uid] = placements
+            result.unplaced[job.uid] = unplaced
+        return result
+
+    def _record_fit_errors(self, job: JobInfo, task: TaskInfo,
+                           batch: TaskBatch, narr: NodeArrays,
+                           gmask: np.ndarray, t_idx: int) -> None:
+        """Summarize why a task found no node (FitErrors analogue)."""
+        g = batch.task_group[t_idx]
+        fe = FitErrors()
+        n_real = len(narr.names)
+        blocked = int(n_real - gmask[g, :n_real].sum())
+        if blocked:
+            fe.set_error(f"{blocked}/{n_real} nodes are unavailable for task "
+                         f"{task.namespace}/{task.name}: predicates failed "
+                         f"or insufficient resources")
+        else:
+            fe.set_error("gang rollback or all feasible nodes already full")
+        job.nodes_fit_errors[task.uid] = fe

@@ -1,0 +1,140 @@
+"""Statement: the transactional operation log enabling gang all-or-nothing
+(reference: pkg/scheduler/framework/statement.go).
+
+Evict/Pipeline/Allocate are staged against session state only; Commit
+replays them against the cache (real binds/evictions), Discard rolls them
+back in reverse order (statement.go:350-393).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..models.job_info import TaskInfo, TaskStatus
+
+
+class _Operation:
+    def __init__(self, name: str, task: TaskInfo, reason: str = ""):
+        self.name = name
+        self.task = task
+        self.reason = reason
+
+
+class Statement:
+    def __init__(self, ssn):
+        self.ssn = ssn
+        self.operations: List[_Operation] = []
+
+    # -- evict (statement.go:61-134) --------------------------------------
+
+    def evict(self, reclaimee: TaskInfo, reason: str) -> None:
+        """Stage an eviction: session state flips to Releasing now; the pod
+        delete happens at Commit."""
+        job = self.ssn.jobs.get(reclaimee.job)
+        if job is None:
+            raise KeyError(f"failed to find job {reclaimee.job}")
+        node = self.ssn.nodes.get(reclaimee.node_name)
+        if node is None:
+            raise KeyError(f"failed to find node {reclaimee.node_name}")
+        job.update_task_status(reclaimee, TaskStatus.Releasing)
+        node.update_task(reclaimee)
+        self.ssn._fire_deallocate(reclaimee)
+        self.operations.append(_Operation("evict", reclaimee, reason))
+
+    def _unevict(self, reclaimee: TaskInfo) -> None:
+        job = self.ssn.jobs.get(reclaimee.job)
+        node = self.ssn.nodes.get(reclaimee.node_name)
+        if job is not None:
+            job.update_task_status(reclaimee, TaskStatus.Running)
+        if node is not None:
+            node.update_task(reclaimee)
+        self.ssn._fire_allocate(reclaimee)
+
+    # -- pipeline (statement.go:136-230) ----------------------------------
+
+    def pipeline(self, task: TaskInfo, hostname: str) -> None:
+        job = self.ssn.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job}")
+        node = self.ssn.nodes.get(hostname)
+        if node is None:
+            raise KeyError(f"failed to find node {hostname}")
+        job.update_task_status(task, TaskStatus.Pipelined)
+        task.node_name = hostname
+        node.add_task(task)
+        self.ssn._fire_allocate(task)
+        self.operations.append(_Operation("pipeline", task))
+
+    def _unpipeline(self, task: TaskInfo) -> None:
+        job = self.ssn.jobs.get(task.job)
+        node = self.ssn.nodes.get(task.node_name)
+        if node is not None:
+            node.remove_task(task)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.Pending)
+        task.node_name = ""
+        self.ssn._fire_deallocate(task)
+
+    # -- allocate (statement.go:232-348) ----------------------------------
+
+    def allocate(self, task: TaskInfo, node_info) -> None:
+        hostname = node_info.name if hasattr(node_info, "name") else str(node_info)
+        if self.ssn.cache is not None:
+            pod_volumes = self.ssn.cache.volume_binder.get_pod_volumes(
+                task, getattr(self.ssn.nodes.get(hostname), "node", None))
+            self.ssn.cache.volume_binder.allocate_volumes(task, hostname, pod_volumes)
+            task.pod_volumes = pod_volumes
+        job = self.ssn.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job}")
+        node = self.ssn.nodes.get(hostname)
+        if node is None:
+            raise KeyError(f"failed to find node {hostname}")
+        task.pod.spec.node_name = hostname
+        job.update_task_status(task, TaskStatus.Allocated)
+        task.node_name = hostname
+        node.add_task(task)
+        self.ssn._fire_allocate(task)
+        self.operations.append(_Operation("allocate", task))
+
+    def _unallocate(self, task: TaskInfo) -> None:
+        job = self.ssn.jobs.get(task.job)
+        node = self.ssn.nodes.get(task.node_name)
+        if node is not None:
+            node.remove_task(task)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.Pending)
+        task.node_name = ""
+        task.pod.spec.node_name = ""
+        self.ssn._fire_deallocate(task)
+
+    # -- commit / discard (statement.go:350-393) ---------------------------
+
+    def discard(self) -> None:
+        """Roll back all staged operations in reverse order."""
+        for op in reversed(self.operations):
+            if op.name == "evict":
+                self._unevict(op.task)
+            elif op.name == "pipeline":
+                self._unpipeline(op.task)
+            elif op.name == "allocate":
+                self._unallocate(op.task)
+        self.operations = []
+
+    def commit(self) -> None:
+        """Replay staged operations against the cache."""
+        ops, self.operations = self.operations, []
+        for op in ops:
+            if op.name == "evict":
+                if self.ssn.cache is not None:
+                    try:
+                        self.ssn.cache.evict(op.task, op.reason)
+                    except KeyError:
+                        pass
+            elif op.name == "pipeline":
+                pass  # session-state only until resources actually release
+            elif op.name == "allocate":
+                try:
+                    self.ssn.dispatch(op.task, op.task.pod_volumes)
+                except KeyError:
+                    pass

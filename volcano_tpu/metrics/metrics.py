@@ -1,0 +1,154 @@
+"""Prometheus-style metrics (reference: pkg/scheduler/metrics/*.go).
+
+The metric names mirror the reference's (namespace ``volcano``) so dashboards
+translate directly. Without a hard prometheus_client dependency, metrics are
+kept in-process (counters/gauges/histogram summaries) and can be scraped via
+``render_prometheus()`` which emits the text exposition format.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, List, Tuple
+
+_lock = threading.Lock()
+
+
+class _Hist:
+    __slots__ = ("count", "total", "buckets")
+    BOUNDS = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10)
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.buckets = [0] * (len(self.BOUNDS) + 1)
+
+    def observe(self, v: float):
+        self.count += 1
+        self.total += v
+        for i, b in enumerate(self.BOUNDS):
+            if v <= b:
+                self.buckets[i] += 1
+                return
+        self.buckets[-1] += 1
+
+
+_histograms: Dict[Tuple[str, Tuple], _Hist] = defaultdict(_Hist)
+_gauges: Dict[Tuple[str, Tuple], float] = {}
+_counters: Dict[Tuple[str, Tuple], float] = defaultdict(float)
+
+NS = "volcano"
+
+E2E_SCHEDULING_LATENCY = f"{NS}_e2e_scheduling_latency_milliseconds"
+E2E_JOB_SCHEDULING_LATENCY = f"{NS}_e2e_job_scheduling_latency_milliseconds"
+PLUGIN_LATENCY = f"{NS}_plugin_scheduling_latency_microseconds"
+ACTION_LATENCY = f"{NS}_action_scheduling_latency_microseconds"
+TASK_LATENCY = f"{NS}_task_scheduling_latency_milliseconds"
+SCHEDULE_ATTEMPTS = f"{NS}_schedule_attempts_total"
+PREEMPTION_VICTIMS = f"{NS}_pod_preemption_victims"
+PREEMPTION_ATTEMPTS = f"{NS}_total_preemption_attempts"
+UNSCHEDULE_TASK_COUNT = f"{NS}_unschedule_task_count"
+UNSCHEDULE_JOB_COUNT = f"{NS}_unschedule_job_count"
+QUEUE_ALLOCATED = f"{NS}_queue_allocated_milli_cpu"
+QUEUE_DESERVED = f"{NS}_queue_deserved_milli_cpu"
+QUEUE_SHARE = f"{NS}_queue_share"
+QUEUE_WEIGHT = f"{NS}_queue_weight"
+NAMESPACE_SHARE = f"{NS}_namespace_share"
+NAMESPACE_WEIGHT = f"{NS}_namespace_weight"
+SOLVER_KERNEL_LATENCY = f"{NS}_tpu_solver_kernel_latency_milliseconds"
+
+
+def observe(name: str, value: float, **labels):
+    with _lock:
+        _histograms[(name, tuple(sorted(labels.items())))].observe(value)
+
+
+def set_gauge(name: str, value: float, **labels):
+    with _lock:
+        _gauges[(name, tuple(sorted(labels.items())))] = value
+
+
+def inc(name: str, value: float = 1.0, **labels):
+    with _lock:
+        _counters[(name, tuple(sorted(labels.items())))] += value
+
+
+@contextmanager
+def plugin_timer(plugin: str, phase: str):
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        observe(PLUGIN_LATENCY, (time.perf_counter() - start) * 1e6,
+                plugin=plugin, OnSession=phase)
+
+
+@contextmanager
+def action_timer(action: str):
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        observe(ACTION_LATENCY, (time.perf_counter() - start) * 1e6,
+                action=action)
+
+
+def update_e2e_duration(seconds: float):
+    observe(E2E_SCHEDULING_LATENCY, seconds * 1000.0)
+
+
+def update_unschedulable_task_count(job: str, count: int):
+    set_gauge(UNSCHEDULE_TASK_COUNT, count, job=job)
+
+
+def register_schedule_attempt(result: str):
+    inc(SCHEDULE_ATTEMPTS, result=result)
+
+
+def update_preemption_victims(count: int):
+    set_gauge(PREEMPTION_VICTIMS, count)
+
+
+def register_preemption_attempt():
+    inc(PREEMPTION_ATTEMPTS)
+
+
+def reset():
+    with _lock:
+        _histograms.clear()
+        _gauges.clear()
+        _counters.clear()
+
+
+def snapshot() -> dict:
+    """Structured dump for tests and the /metrics endpoint."""
+    with _lock:
+        return {
+            "histograms": {k: (h.count, h.total) for k, h in _histograms.items()},
+            "gauges": dict(_gauges),
+            "counters": dict(_counters),
+        }
+
+
+def render_prometheus() -> str:
+    """Text exposition format."""
+    lines: List[str] = []
+
+    def fmt_labels(labels: Tuple) -> str:
+        if not labels:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        return "{" + inner + "}"
+
+    with _lock:
+        for (name, labels), h in _histograms.items():
+            lines.append(f"{name}_count{fmt_labels(labels)} {h.count}")
+            lines.append(f"{name}_sum{fmt_labels(labels)} {h.total}")
+        for (name, labels), v in _gauges.items():
+            lines.append(f"{name}{fmt_labels(labels)} {v}")
+        for (name, labels), v in _counters.items():
+            lines.append(f"{name}{fmt_labels(labels)} {v}")
+    return "\n".join(lines) + "\n"

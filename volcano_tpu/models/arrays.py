@@ -1,0 +1,377 @@
+"""Dense structure-of-arrays snapshot encoding for the TPU solver.
+
+The reference evaluates predicates/scores task-by-task with goroutine fan-out
+(pkg/scheduler/util/scheduler_helper.go:71-192). Here the per-cycle state is
+encoded once into padded, statically-shaped arrays and every task x node
+decision is computed by jitted kernels (volcano_tpu.ops).
+
+Key encodings:
+
+* **Resource index**: the cycle's resource dimensions [cpu, memory, *scalars]
+  with per-dimension scale (memory is encoded in MiB to keep float32 exact)
+  and the reference's 0.1 epsilon scaled alongside.
+* **Task groups**: tasks sharing (job, task-spec, resreq, scheduling
+  constraints) collapse into one group; predicates and static scores are
+  evaluated per group x node, tasks index into their group. A 50k-task gang
+  job costs as much mask memory as one task.
+* **Feature matrices**: node labels/taints referenced by any group become
+  integer-coded boolean matrices so selector/affinity/toleration matching is
+  a matmul (MXU) instead of string comparisons.
+* **Padding/bucketing**: node/task/group counts are padded to buckets so XLA
+  recompiles only when a bucket boundary is crossed, with validity masks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .job_info import JobInfo, TaskInfo
+from .node_info import NodeInfo
+from .resource import CPU, EPS, MEMORY, Resource
+
+MIB = float(2**20)
+
+# scales: millicores stay, bytes -> MiB, scalar milli-units stay
+def _scale_for(name: str) -> float:
+    return 1.0 / MIB if name == MEMORY else 1.0
+
+
+def bucket(n: int, size: int) -> int:
+    """Round up to a bucket boundary (>= 1 bucket) for stable jit shapes."""
+    return max(size, ((n + size - 1) // size) * size)
+
+
+class ResourceIndex:
+    """The cycle's resource-dimension registry."""
+
+    def __init__(self, names: Sequence[str]):
+        ordered = [CPU, MEMORY] + sorted(n for n in names if n not in (CPU, MEMORY))
+        self.names: Tuple[str, ...] = tuple(ordered)
+        self.index: Dict[str, int] = {n: i for i, n in enumerate(self.names)}
+        self.scales = np.array([_scale_for(n) for n in self.names], np.float32)
+        self.eps = (EPS * self.scales).astype(np.float32)
+
+    @property
+    def r(self) -> int:
+        return len(self.names)
+
+    @classmethod
+    def from_cluster(cls, nodes: Dict[str, NodeInfo],
+                     jobs: Dict[str, JobInfo]) -> "ResourceIndex":
+        names = set()
+        for n in nodes.values():
+            names.update(n.allocatable.scalars.keys())
+        for j in jobs.values():
+            names.update(j.total_request.scalars.keys())
+        return cls(names)
+
+    def vec(self, r: Resource) -> np.ndarray:
+        v = np.zeros(self.r, np.float32)
+        v[0] = r.milli_cpu
+        v[1] = r.memory
+        for name, quant in r.scalars.items():
+            i = self.index.get(name)
+            if i is not None:
+                v[i] = quant
+        return v * self.scales
+
+    def vec_capability(self, r: Resource) -> np.ndarray:
+        """Capability-style vector: dimensions the resource does not mention
+        are unbounded (the Infinity dimension default)."""
+        v = np.full(self.r, np.inf, np.float32)
+        if r.milli_cpu > 0:
+            v[0] = r.milli_cpu * self.scales[0]
+        if r.memory > 0:
+            v[1] = r.memory * self.scales[1]
+        for name, quant in r.scalars.items():
+            i = self.index.get(name)
+            if i is not None:
+                v[i] = quant * self.scales[i]
+        return v
+
+
+NODE_BUCKET = 256
+TASK_BUCKET = 256
+GROUP_BUCKET = 16
+
+
+@dataclass
+class NodeArrays:
+    """Per-node resource state, padded to N_pad (valid mask marks real rows)."""
+
+    rindex: ResourceIndex
+    names: List[str]                 # real node names, index-aligned
+    name_to_idx: Dict[str, int]
+    n_pad: int
+    valid: np.ndarray                # [N] bool
+    idle: np.ndarray                 # [N, R] f32
+    used: np.ndarray
+    releasing: np.ndarray
+    pipelined: np.ndarray
+    allocatable: np.ndarray
+    capability: np.ndarray
+    max_tasks: np.ndarray            # [N] i32 (pods capacity; 0 => unlimited)
+    n_tasks: np.ndarray              # [N] i32 current task count
+    revocable: np.ndarray            # [N] bool
+    oversubscription: np.ndarray     # [N] bool
+
+    @classmethod
+    def build(cls, nodes: Dict[str, NodeInfo], node_order: Sequence[str],
+              rindex: Optional[ResourceIndex] = None,
+              node_bucket: int = NODE_BUCKET) -> "NodeArrays":
+        names = [n for n in node_order if n in nodes]
+        if rindex is None:
+            rindex = ResourceIndex.from_cluster(nodes, {})
+        n_pad = bucket(len(names), node_bucket)
+        r = rindex.r
+        z = lambda: np.zeros((n_pad, r), np.float32)
+        arr = cls(rindex=rindex, names=names,
+                  name_to_idx={n: i for i, n in enumerate(names)},
+                  n_pad=n_pad, valid=np.zeros(n_pad, bool),
+                  idle=z(), used=z(), releasing=z(), pipelined=z(),
+                  allocatable=z(), capability=z(),
+                  max_tasks=np.zeros(n_pad, np.int32),
+                  n_tasks=np.zeros(n_pad, np.int32),
+                  revocable=np.zeros(n_pad, bool),
+                  oversubscription=np.zeros(n_pad, bool))
+        for i, name in enumerate(names):
+            ni = nodes[name]
+            arr.valid[i] = True
+            arr.idle[i] = rindex.vec(ni.idle)
+            arr.used[i] = rindex.vec(ni.used)
+            arr.releasing[i] = rindex.vec(ni.releasing)
+            arr.pipelined[i] = rindex.vec(ni.pipelined)
+            arr.allocatable[i] = rindex.vec(ni.allocatable)
+            arr.capability[i] = rindex.vec(ni.capability)
+            arr.max_tasks[i] = ni.allocatable.max_task_num
+            arr.n_tasks[i] = len(ni.tasks)
+            arr.revocable[i] = bool(ni.revocable_zone)
+            arr.oversubscription[i] = ni.oversubscription_node
+        return arr
+
+    @property
+    def future_idle(self) -> np.ndarray:
+        return self.idle + self.releasing - self.pipelined
+
+
+def _constraint_key(t: TaskInfo) -> tuple:
+    """Scheduling-constraint fingerprint for grouping: tasks with identical
+    constraints share predicate masks."""
+    spec = t.pod.spec
+    sel = tuple(sorted(spec.node_selector.items()))
+    tol = tuple(sorted((x.key, x.operator, x.value, x.effect)
+                       for x in spec.tolerations))
+    aff = repr(spec.affinity) if spec.affinity is not None else ""
+    return (sel, tol, aff)
+
+
+def _req_key(t: TaskInfo) -> tuple:
+    r = t.resreq
+    return (r.milli_cpu, r.memory, tuple(sorted(r.scalars.items())))
+
+
+@dataclass
+class TaskBatch:
+    """An ordered batch of pending tasks to place, with group compression.
+
+    ``order`` preserves the session's job/task ordering: the allocate scan
+    walks tasks in this order, so gang jobs occupy contiguous spans.
+    """
+
+    rindex: ResourceIndex
+    tasks: List[TaskInfo]            # real tasks, scan order
+    t_pad: int
+    g_pad: int
+    j_pad: int
+    task_valid: np.ndarray           # [T] bool
+    task_group: np.ndarray           # [T] i32
+    task_job: np.ndarray             # [T] i32
+    group_req: np.ndarray            # [G, R] f32
+    group_members: List[List[int]]   # group -> task indices
+    group_task_count: np.ndarray     # [G] i32 (1 task slot each on a node)
+    job_uids: List[str]
+    job_min_available: np.ndarray    # [J] i32 (padding rows incl. sentinel: 0)
+    job_ready_base: np.ndarray       # [J] i32 already-occupied task count
+    job_task_start: np.ndarray       # [J] i32 span starts in scan order
+    job_task_end: np.ndarray         # [J] i32
+    group_keys: List[tuple] = field(default_factory=list)
+
+    @classmethod
+    def build(cls, ordered_jobs: Sequence[Tuple[JobInfo, Sequence[TaskInfo]]],
+              rindex: ResourceIndex,
+              task_bucket: int = TASK_BUCKET,
+              group_bucket: int = GROUP_BUCKET) -> "TaskBatch":
+        tasks: List[TaskInfo] = []
+        task_group: List[int] = []
+        task_job: List[int] = []
+        group_ids: Dict[tuple, int] = {}
+        group_reqs: List[np.ndarray] = []
+        group_members: List[List[int]] = []
+        group_keys: List[tuple] = []
+        job_uids: List[str] = []
+        job_min: List[int] = []
+        job_base: List[int] = []
+        job_start: List[int] = []
+        job_end: List[int] = []
+
+        for j_idx, (job, jtasks) in enumerate(ordered_jobs):
+            job_uids.append(job.uid)
+            job_min.append(job.min_available)
+            job_base.append(job.ready_task_num())
+            job_start.append(len(tasks))
+            for t in jtasks:
+                key = (j_idx, t.task_id, _req_key(t), _constraint_key(t))
+                g = group_ids.get(key)
+                if g is None:
+                    g = len(group_reqs)
+                    group_ids[key] = g
+                    group_reqs.append(rindex.vec(t.resreq))
+                    group_members.append([])
+                    group_keys.append(key)
+                group_members[g].append(len(tasks))
+                task_group.append(g)
+                task_job.append(j_idx)
+                tasks.append(t)
+            job_end.append(len(tasks))
+
+        t_pad = bucket(len(tasks), task_bucket)
+        g_pad = bucket(max(1, len(group_reqs)), group_bucket)
+        # one spare sentinel job absorbs padding tasks: its min_available of 0
+        # commits trivially so it can never roll back a real job's placements
+        sentinel = len(job_uids)
+        j_pad = bucket(len(job_uids) + 1, group_bucket)
+        r = rindex.r
+
+        def pad1(a, n, dtype, fill=0):
+            out = np.full(n, fill, dtype)
+            if len(a):
+                out[:len(a)] = a
+            return out
+
+        greq = np.zeros((g_pad, r), np.float32)
+        if group_reqs:
+            greq[:len(group_reqs)] = np.stack(group_reqs)
+
+        return cls(
+            rindex=rindex, tasks=tasks, t_pad=t_pad, g_pad=g_pad, j_pad=j_pad,
+            task_valid=pad1(np.ones(len(tasks), bool), t_pad, bool),
+            task_group=pad1(task_group, t_pad, np.int32),
+            task_job=pad1(task_job, t_pad, np.int32, fill=sentinel),
+            group_req=greq,
+            group_members=group_members,
+            group_task_count=pad1([len(m) for m in group_members], g_pad, np.int32),
+            job_uids=job_uids,
+            job_min_available=pad1(job_min, j_pad, np.int32),
+            job_ready_base=pad1(job_base, j_pad, np.int32),
+            job_task_start=pad1(job_start, j_pad, np.int32),
+            job_task_end=pad1(job_end, j_pad, np.int32),
+            group_keys=group_keys,
+        )
+
+    @property
+    def n_tasks(self) -> int:
+        return len(self.tasks)
+
+    @property
+    def n_groups(self) -> int:
+        return len(self.group_members)
+
+
+# ---------------------------------------------------------------------------
+# Feature matrices: label/taint/affinity matching as integer matmuls
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PredicateFeatures:
+    """Boolean feature matrices for the predicate kernels.
+
+    * ``node_pairs`` [N, F]: node has label pair f (pair = referenced
+      (key,value) from any group's selector / required node affinity)
+    * ``group_requires`` [G, F]: group's conjunctive required pairs
+    * ``group_require_counts`` [G]: number of required pairs per group
+    * ``node_taints`` [N, K]: node carries (NoSchedule|NoExecute) taint k
+    * ``group_tolerates`` [G, K]: group tolerates taint k
+    * ``group_affinity_ok`` [G, N]: OR-of-terms node affinity evaluated for
+      expression forms beyond In-pairs (Exists/Gt/Lt/NotIn), host-encoded
+    """
+
+    node_pairs: np.ndarray
+    group_requires: np.ndarray
+    group_require_counts: np.ndarray
+    node_taints: np.ndarray
+    group_tolerates: np.ndarray
+    group_affinity_ok: np.ndarray
+
+    @classmethod
+    def build(cls, nodes: Dict[str, NodeInfo], node_arrays: NodeArrays,
+              batch: TaskBatch) -> "PredicateFeatures":
+        n_pad = node_arrays.n_pad
+        g_pad = batch.g_pad
+
+        # collect referenced selector pairs
+        pair_ids: Dict[Tuple[str, str], int] = {}
+        group_pairs: List[List[int]] = [[] for _ in range(g_pad)]
+        for g, members in enumerate(batch.group_members):
+            t = batch.tasks[members[0]]
+            for k, v in sorted(t.pod.spec.node_selector.items()):
+                pid = pair_ids.setdefault((k, v), len(pair_ids))
+                group_pairs[g].append(pid)
+
+        f_pad = bucket(max(1, len(pair_ids)), 8)
+        node_pairs = np.zeros((n_pad, f_pad), np.float32)
+        for name, i in node_arrays.name_to_idx.items():
+            labels = nodes[name].node.metadata.labels if nodes[name].node else {}
+            for (k, v), pid in pair_ids.items():
+                if labels.get(k) == v:
+                    node_pairs[i, pid] = 1.0
+
+        group_requires = np.zeros((g_pad, f_pad), np.float32)
+        for g, pids in enumerate(group_pairs):
+            for pid in pids:
+                group_requires[g, pid] = 1.0
+        group_require_counts = group_requires.sum(axis=1).astype(np.float32)
+
+        # taints (NoSchedule/NoExecute block scheduling)
+        taint_ids: Dict[tuple, int] = {}
+        node_taint_list: List[List[int]] = [[] for _ in range(n_pad)]
+        for name, i in node_arrays.name_to_idx.items():
+            node = nodes[name].node
+            for taint in (node.spec.taints if node else []):
+                if taint.effect in ("NoSchedule", "NoExecute"):
+                    tid = taint_ids.setdefault(
+                        (taint.key, taint.value, taint.effect), len(taint_ids))
+                    node_taint_list[i].append(tid)
+        k_pad = bucket(max(1, len(taint_ids)), 8)
+        node_taints = np.zeros((n_pad, k_pad), np.float32)
+        for i, tids in enumerate(node_taint_list):
+            for tid in tids:
+                node_taints[i, tid] = 1.0
+        group_tolerates = np.zeros((g_pad, k_pad), np.float32)
+        from .objects import Taint
+        for g, members in enumerate(batch.group_members):
+            t = batch.tasks[members[0]]
+            for (key, value, effect), tid in taint_ids.items():
+                taint = Taint(key=key, value=value, effect=effect)
+                if any(tol.tolerates(taint) for tol in t.pod.spec.tolerations):
+                    group_tolerates[g, tid] = 1.0
+
+        # full node-affinity evaluation (any expression form), host-encoded
+        # per group x node; groups without affinity default to all-ok
+        group_affinity_ok = np.ones((g_pad, n_pad), bool)
+        for g, members in enumerate(batch.group_members):
+            t = batch.tasks[members[0]]
+            aff = t.pod.spec.affinity
+            if aff is None or aff.node_affinity is None or not aff.node_affinity.required:
+                continue
+            terms = aff.node_affinity.required
+            for name, i in node_arrays.name_to_idx.items():
+                labels = nodes[name].node.metadata.labels if nodes[name].node else {}
+                group_affinity_ok[g, i] = any(term.matches(labels) for term in terms)
+
+        return cls(node_pairs=node_pairs, group_requires=group_requires,
+                   group_require_counts=group_require_counts,
+                   node_taints=node_taints, group_tolerates=group_tolerates,
+                   group_affinity_ok=group_affinity_ok)

@@ -82,7 +82,7 @@ class TaskInfo:
     __slots__ = ("uid", "job", "name", "namespace", "resreq", "init_resreq",
                  "node_name", "status", "priority", "volume_ready",
                  "preemptable", "revocable_zone", "topology_policy", "pod",
-                 "best_effort", "last_transaction")
+                 "best_effort", "last_transaction", "pod_volumes")
 
     def __init__(self, pod: Pod):
         req = pod.resource_request()
@@ -103,6 +103,7 @@ class TaskInfo:
         self.pod: Pod = pod
         self.best_effort: bool = self.init_resreq.is_empty()
         self.last_transaction = None
+        self.pod_volumes = None
 
     @property
     def task_id(self) -> str:

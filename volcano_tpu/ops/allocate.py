@@ -1,0 +1,162 @@
+"""The gang-allocate kernel: one compiled scan places an entire ordered task
+batch with per-job all-or-nothing semantics.
+
+TPU-native replacement for the allocate action's hot loop
+(pkg/scheduler/actions/allocate/allocate.go:201-270): per task -- predicates,
+scoring, best-node selection, allocate-or-pipeline -- and per job -- gang
+commit/rollback via the Statement (framework/statement.go:350-393). The
+sequential task-by-task semantics (each placement changes Idle for the next
+task) are preserved exactly by a lax.scan whose carry is the node state; the
+gang Statement becomes a checkpoint of that carry taken at each job boundary
+and restored when a job misses its minAvailable.
+
+Outputs are per-task node assignments plus per-job committed flags; a task's
+assignment is real only if its job committed (Statement.Commit) -- otherwise
+it was rolled back in-carry (Statement.Discard) and later jobs observed the
+reverted node state, exactly like the reference's in-session semantics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .score import ScoreWeights, node_score
+
+NEG = jnp.float32(-1e30)
+
+
+class AllocState(NamedTuple):
+    idle: jax.Array          # [N, R]
+    future: jax.Array        # [N, R] = idle + releasing - pipelined
+    n_tasks: jax.Array       # [N] i32
+    ckpt_idle: jax.Array
+    ckpt_future: jax.Array
+    ckpt_ntasks: jax.Array
+    cur_job: jax.Array       # i32
+    placed: jax.Array        # i32 tasks placed for cur_job so far (any kind)
+    placed_alloc: jax.Array  # i32 of those, placed on real idle
+    ready: jax.Array         # [J] bool JobReady   -> commit (bind)
+    kept: jax.Array          # [J] bool JobPipelined -> keep session claims
+
+
+@partial(jax.jit, static_argnames=("allow_pipeline",))
+def gang_allocate(task_group: jax.Array,      # [T] i32
+                  task_job: jax.Array,        # [T] i32 (padding -> sentinel job)
+                  task_valid: jax.Array,      # [T] bool
+                  group_req: jax.Array,       # [G, R] f32
+                  group_mask: jax.Array,      # [G, N] bool static predicates
+                  group_static_score: jax.Array,  # [G, N] f32
+                  job_min_available: jax.Array,   # [J] i32
+                  job_ready_base: jax.Array,      # [J] i32 already-occupied count
+                  node_idle: jax.Array,       # [N, R] f32
+                  node_future: jax.Array,     # [N, R] f32
+                  node_alloc: jax.Array,      # [N, R] f32
+                  node_ntasks: jax.Array,     # [N] i32
+                  node_max_tasks: jax.Array,  # [N] i32 (0 = uncapped)
+                  eps: jax.Array,             # [R] f32
+                  weights: ScoreWeights,
+                  allow_pipeline: bool = True):
+    """Returns (assign [T] i32 node-or--1, pipelined [T] bool,
+    ready [J] bool, kept [J] bool, final AllocState).
+
+    * ``ready[j]``: JobReady -- enough tasks on real idle resources; the
+      caller commits (binds) these placements.
+    * ``kept[j]``: JobPipelined -- ready only counting pipelined claims;
+      session state keeps the claims but nothing binds
+      (allocate.go:264-270, gang.go:141-152).
+    * neither: all of the job's placements were rolled back in-carry and
+      later jobs saw the restored node state (Statement.Discard).
+
+    The caller guarantees tasks are ordered so each job's tasks are
+    contiguous and padding tasks point at a sentinel job whose
+    min_available is 0.
+    """
+    T = task_group.shape[0]
+
+    J = job_min_available.shape[0]
+    init = AllocState(
+        idle=node_idle, future=node_future, n_tasks=node_ntasks,
+        ckpt_idle=node_idle, ckpt_future=node_future, ckpt_ntasks=node_ntasks,
+        cur_job=task_job[0], placed=jnp.int32(0), placed_alloc=jnp.int32(0),
+        ready=jnp.zeros(J, bool), kept=jnp.zeros(J, bool),
+    )
+
+    def finalize_job(state: AllocState, job: jax.Array):
+        """Gang check for `job`: JobReady commits; JobPipelined keeps; else
+        restore the checkpoint (Statement.Discard)."""
+        base = job_ready_base[job]
+        minavail = job_min_available[job]
+        is_ready = base + state.placed_alloc >= minavail
+        is_kept = base + state.placed >= minavail
+        keep = is_ready | is_kept
+        idle = jnp.where(keep, state.idle, state.ckpt_idle)
+        future = jnp.where(keep, state.future, state.ckpt_future)
+        n_tasks = jnp.where(keep, state.n_tasks, state.ckpt_ntasks)
+        ready = state.ready.at[job].set(is_ready)
+        kept = state.kept.at[job].set(is_kept)
+        return state._replace(idle=idle, future=future, n_tasks=n_tasks,
+                              ready=ready, kept=kept)
+
+    def step(state: AllocState, t):
+        g = task_group[t]
+        j = task_job[t]
+        valid = task_valid[t]
+
+        boundary = j != state.cur_job
+        finalized = finalize_job(state, state.cur_job)
+        state = jax.tree.map(
+            lambda a, b: jnp.where(boundary, a, b), finalized, state)
+        # new checkpoint at the boundary (post-rollback state)
+        state = state._replace(
+            ckpt_idle=jnp.where(boundary, state.idle, state.ckpt_idle),
+            ckpt_future=jnp.where(boundary, state.future, state.ckpt_future),
+            ckpt_ntasks=jnp.where(boundary, state.n_tasks, state.ckpt_ntasks),
+            placed=jnp.where(boundary, 0, state.placed),
+            placed_alloc=jnp.where(boundary, 0, state.placed_alloc),
+            cur_job=j,
+        )
+
+        req = group_req[g]                       # [R]
+        static_ok = group_mask[g]                # [N]
+        pods_ok = (node_max_tasks == 0) | (state.n_tasks < node_max_tasks)
+        base_ok = static_ok & pods_ok & valid
+
+        fits_idle = jnp.all(req[None, :] <= state.idle + eps[None, :], axis=-1) & base_ok
+        fits_future = jnp.all(req[None, :] <= state.future + eps[None, :], axis=-1) & base_ok
+
+        score = node_score(req, state.idle, node_alloc, weights,
+                           group_static_score[g])
+
+        any_idle = jnp.any(fits_idle)
+        if allow_pipeline:
+            cand = jnp.where(any_idle, fits_idle, fits_future)
+        else:
+            cand = fits_idle
+        sel = jnp.argmax(jnp.where(cand, score, NEG))
+        placed_ok = jnp.any(cand)
+        pipelined = placed_ok & ~any_idle if allow_pipeline else jnp.bool_(False)
+
+        dreq = jnp.where(placed_ok, req, 0.0)
+        take_idle = placed_ok & ~pipelined
+        idle = state.idle.at[sel].add(jnp.where(take_idle, -req, 0.0))
+        future = state.future.at[sel].add(-dreq)
+        n_tasks = state.n_tasks.at[sel].add(jnp.where(placed_ok, 1, 0))
+
+        state = state._replace(
+            idle=idle, future=future, n_tasks=n_tasks,
+            placed=state.placed + placed_ok.astype(jnp.int32),
+            placed_alloc=state.placed_alloc + take_idle.astype(jnp.int32))
+        return state, (jnp.where(placed_ok, sel.astype(jnp.int32), -1), pipelined)
+
+    state, (assign, pipelined) = jax.lax.scan(step, init, jnp.arange(T))
+    state = finalize_job(state, state.cur_job)
+
+    # a task's placement survives only if its job was kept or committed
+    ok = (state.ready[task_job] | state.kept[task_job]) & task_valid
+    assign = jnp.where(ok, assign, -1)
+    pipelined = pipelined & ok
+    return assign, pipelined, state.ready, state.kept, state

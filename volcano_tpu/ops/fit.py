@@ -1,0 +1,66 @@
+"""Predicate kernels: every group x node feasibility decision in one shot.
+
+TPU-native replacement for the reference's goroutine-parallel predicate loop
+(pkg/scheduler/util/scheduler_helper.go:71-127 PredicateNodes + the
+predicates plugin's per-node filters, pkg/scheduler/plugins/predicates/
+predicates.go:247-361). String matching was encoded into feature matrices at
+snapshot time (models/arrays.py PredicateFeatures); here it is pure matmul
+and broadcast compares, so the full task x node matrix is evaluated
+exhaustively -- no node sampling (scheduler_helper.go:49-68) needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def resource_le(req: jax.Array, avail: jax.Array, eps: jax.Array) -> jax.Array:
+    """req <= avail within per-dimension epsilon, all dims.
+    req [..., R], avail [..., R] -> [...] bool.
+    Mirrors Resource.LessEqual with the Zero dimension default
+    (resource_info.go:310-341): padded dims are 0 <= avail."""
+    return jnp.all(req <= avail + eps, axis=-1)
+
+
+def group_fit_mask(group_req: jax.Array, node_avail: jax.Array,
+                   eps: jax.Array) -> jax.Array:
+    """[G,R] x [N,R] -> [G,N] resource-fit mask."""
+    return jnp.all(group_req[:, None, :] <= node_avail[None, :, :] + eps[None, None, :],
+                   axis=-1)
+
+
+def selector_mask(node_pairs: jax.Array, group_requires: jax.Array,
+                  group_require_counts: jax.Array) -> jax.Array:
+    """Conjunctive label-pair matching as a matmul (MXU path).
+    node_pairs [N,F], group_requires [G,F] -> [G,N] bool: node satisfies all
+    of the group's required pairs."""
+    got = group_requires @ node_pairs.T           # [G, N] matched-pair counts
+    return got >= group_require_counts[:, None] - 0.5
+
+
+def taint_mask(node_taints: jax.Array, group_tolerates: jax.Array) -> jax.Array:
+    """[N,K] x [G,K] -> [G,N] bool: no untolerated NoSchedule/NoExecute taint.
+    (TaintToleration filter, predicates.go:316-329)."""
+    violations = (1.0 - group_tolerates) @ node_taints.T   # [G, N]
+    return violations < 0.5
+
+
+def pod_count_mask(n_tasks: jax.Array, max_tasks: jax.Array) -> jax.Array:
+    """[N] -> [N] bool: node pod-count cap (predicates.go:273-279);
+    max_tasks == 0 means uncapped."""
+    return (max_tasks == 0) | (n_tasks < max_tasks)
+
+
+def static_predicate_mask(node_valid: jax.Array,
+                          fit_cap: jax.Array,
+                          sel_ok: jax.Array,
+                          taints_ok: jax.Array,
+                          affinity_ok: jax.Array) -> jax.Array:
+    """AND-compose the cycle-static predicate masks into [G,N].
+
+    fit_cap: capability prefit [G,N] (req <= node capability — tasks that can
+    never fit a node are excluded up front, like the allocate action's
+    resource prefit allocate.go:111-118).
+    """
+    return (node_valid[None, :] & fit_cap & sel_ok & taints_ok & affinity_ok)

@@ -1,0 +1,134 @@
+"""nodeorder plugin (reference: pkg/scheduler/plugins/nodeorder/
+nodeorder.go).
+
+Weighted sum of the standard k8s scorers: LeastRequested, MostRequested,
+BalancedResourceAllocation, NodeAffinity (preferred terms), TaintToleration
+(PreferNoSchedule) -- weights from arguments (nodeorder.go:39-135):
+
+    leastrequested.weight    (default 1)
+    mostrequested.weight     (default 0)
+    balancedresource.weight  (default 1)
+    nodeaffinity.weight      (default 1)
+    tainttoleration.weight   (default 1)
+    podaffinity.weight       (default 1; batch scorer, see interpod module)
+
+TPU-first: least/most/balanced run inside the allocate scan (dynamic state);
+nodeaffinity-preferred and PreferNoSchedule taints are cycle-static, so they
+are encoded per group x node once and added as a static score term.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.plugin import Plugin
+from ..framework.registry import register_plugin_builder
+
+NAME = "nodeorder"
+
+
+def _preferred_affinity_score(task, labels) -> float:
+    aff = task.pod.spec.affinity
+    if aff is None or aff.node_affinity is None:
+        return 0.0
+    total = 0.0
+    max_total = 0.0
+    for pref in aff.node_affinity.preferred:
+        max_total += pref.weight
+        if pref.preference.matches(labels):
+            total += pref.weight
+    if max_total <= 0:
+        return 0.0
+    return total / max_total * 100.0
+
+
+def _prefer_no_schedule_score(task, node) -> float:
+    """Fewer untolerated PreferNoSchedule taints -> higher score."""
+    if node.node is None:
+        return 100.0
+    intolerable = 0
+    total = 0
+    for taint in node.node.spec.taints:
+        if taint.effect != "PreferNoSchedule":
+            continue
+        total += 1
+        if not any(tol.tolerates(taint) for tol in task.pod.spec.tolerations):
+            intolerable += 1
+    if total == 0:
+        return 100.0
+    return (1.0 - intolerable / total) * 100.0
+
+
+class NodeOrderPlugin(Plugin):
+    def __init__(self, arguments=None):
+        args = arguments or {}
+        get = args.get_int if hasattr(args, "get_int") else \
+            (lambda k, d: int(args.get(k, d)))
+        self.least_w = get("leastrequested.weight", 1)
+        self.most_w = get("mostrequested.weight", 0)
+        self.balanced_w = get("balancedresource.weight", 1)
+        self.node_affinity_w = get("nodeaffinity.weight", 1)
+        self.taint_w = get("tainttoleration.weight", 1)
+
+    def name(self) -> str:
+        return NAME
+
+    def on_session_open(self, ssn) -> None:
+        if ssn.solver is not None:
+            ssn.solver.add_weight("least", float(self.least_w))
+            ssn.solver.add_weight("most", float(self.most_w))
+            ssn.solver.add_weight("balanced", float(self.balanced_w))
+            ssn.solver.mark_vectorized(NAME)
+            if self.node_affinity_w or self.taint_w:
+                ssn.solver.add_static_score_fn(self._static_score(ssn))
+
+        def node_order_fn(task, node) -> float:
+            """Host-side mirror for single-pair paths."""
+            score = 0.0
+            alloc = node.allocatable
+            used = node.used
+            if alloc.milli_cpu > 0 and alloc.memory > 0:
+                cpu_frac = min(1.0, (used.milli_cpu + task.resreq.milli_cpu) / alloc.milli_cpu)
+                mem_frac = min(1.0, (used.memory + task.resreq.memory) / alloc.memory)
+                score += self.least_w * (((1 - cpu_frac) + (1 - mem_frac)) / 2) * 100
+                score += self.most_w * ((cpu_frac + mem_frac) / 2) * 100
+                score += self.balanced_w * (100 - abs(cpu_frac - mem_frac) * 100)
+            labels = node.node.metadata.labels if node.node is not None else {}
+            score += self.node_affinity_w * _preferred_affinity_score(task, labels)
+            score += self.taint_w * _prefer_no_schedule_score(task, node)
+            return score
+
+        ssn.add_node_order_fn(NAME, node_order_fn)
+
+    def _static_score(self, ssn):
+        def fn(batch, narr, feats):
+            score = np.zeros((batch.g_pad, narr.n_pad), np.float32)
+            # PreferNoSchedule taints are rare: sweep only nodes that carry
+            # one (taint-free nodes score a constant, which can't change the
+            # per-task argmax and is omitted)
+            taint_nodes = [
+                (name, i) for name, i in narr.name_to_idx.items()
+                if ssn.nodes[name].node is not None
+                and any(t.effect == "PreferNoSchedule"
+                        for t in ssn.nodes[name].node.spec.taints)]
+            for g, members in enumerate(batch.group_members):
+                rep = batch.tasks[members[0]]
+                has_pref = (rep.pod.spec.affinity is not None
+                            and rep.pod.spec.affinity.node_affinity is not None
+                            and rep.pod.spec.affinity.node_affinity.preferred)
+                if has_pref and self.node_affinity_w:
+                    for name, i in narr.name_to_idx.items():
+                        labels = ssn.nodes[name].node.metadata.labels \
+                            if ssn.nodes[name].node else {}
+                        score[g, i] += self.node_affinity_w * \
+                            _preferred_affinity_score(rep, labels)
+                if self.taint_w:
+                    for name, i in taint_nodes:
+                        # relative to the taint-free constant of 100
+                        score[g, i] += self.taint_w * (
+                            _prefer_no_schedule_score(rep, ssn.nodes[name]) - 100.0)
+            return score
+        return fn
+
+
+register_plugin_builder(NAME, NodeOrderPlugin)

@@ -1,0 +1,144 @@
+"""predicates plugin (reference: pkg/scheduler/plugins/predicates/
+predicates.go).
+
+Wraps the standard node filters: NodeUnschedulable (handled by the cache --
+NotReady nodes never reach the snapshot), node selector / required node
+affinity, taints/tolerations, pod-count cap, host ports, and GPU-share fit.
+
+TPU-first: for the batch solver these predicates are *vectorized* -- the
+plugin flips on the solver's feature-matrix kernels (selector/taint/affinity
+matmuls built at snapshot time, models/arrays.py PredicateFeatures) and adds
+mask fns for ports and GPU sharing. The same checks are also registered as a
+host-side PredicateFn for actions that probe single task x node pairs
+(preempt/reclaim/backfill), keeping both paths semantically identical.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.plugin import Plugin
+from ..framework.registry import register_plugin_builder
+from ..models.node_info import get_gpu_memory_of_pod
+from ..models.resource import GPU_MEMORY_RESOURCE, ZERO
+from ..models.unschedule_info import (FitError, NODE_AFFINITY_FAILED,
+                                      NODE_POD_NUMBER_EXCEEDED,
+                                      NODE_PORT_FAILED, NODE_SELECTOR_FAILED,
+                                      TAINT_FAILED)
+
+NAME = "predicates"
+
+
+class FitException(Exception):
+    def __init__(self, fit_error: FitError):
+        super().__init__(fit_error.error())
+        self.fit_error = fit_error
+
+
+def _node_selector_ok(task, node) -> bool:
+    labels = node.node.metadata.labels if node.node is not None else {}
+    for k, v in task.pod.spec.node_selector.items():
+        if labels.get(k) != v:
+            return False
+    return True
+
+
+def _node_affinity_ok(task, node) -> bool:
+    aff = task.pod.spec.affinity
+    if aff is None or aff.node_affinity is None or not aff.node_affinity.required:
+        return True
+    labels = node.node.metadata.labels if node.node is not None else {}
+    return any(term.matches(labels) for term in aff.node_affinity.required)
+
+
+def _taints_ok(task, node) -> bool:
+    if node.node is None:
+        return True
+    for taint in node.node.spec.taints:
+        if taint.effect not in ("NoSchedule", "NoExecute"):
+            continue
+        if not any(tol.tolerates(taint) for tol in task.pod.spec.tolerations):
+            return False
+    return True
+
+
+def _ports_ok(task, node) -> bool:
+    want = set(task.pod.spec.host_ports)
+    if not want:
+        return True
+    used = set()
+    for t in node.tasks.values():
+        used.update(t.pod.spec.host_ports)
+    return not (want & used)
+
+
+def _gpu_share_ok(task, node) -> bool:
+    """GPU-share fit: some card must have enough free gpu-memory
+    (predicates.go:343-352 + gpu.go checkNodeGPUSharingPredicate)."""
+    mem = task.resreq.get(GPU_MEMORY_RESOURCE) / 1000.0
+    if mem <= 0:
+        return True
+    idle = node.get_devices_idle_gpu_memory()
+    return any(free >= mem for free in idle.values())
+
+
+class PredicatesPlugin(Plugin):
+    def __init__(self, arguments=None):
+        self.arguments = arguments or {}
+
+    def name(self) -> str:
+        return NAME
+
+    def on_session_open(self, ssn) -> None:
+        # vectorized path: selector/taints/affinity matrices + extra masks
+        if ssn.solver is not None:
+            ssn.solver.enable_default_predicates = True
+            ssn.solver.mark_vectorized(NAME)
+            ssn.solver.add_mask_fn(self._ports_and_gpu_mask(ssn))
+
+        def predicate_fn(task, node):
+            """Host path for single-pair probes."""
+            cap = node.allocatable.max_task_num
+            if cap and len(node.tasks) >= cap:
+                raise FitException(FitError(task=task, node=node,
+                                            reasons=[NODE_POD_NUMBER_EXCEEDED]))
+            if not _node_selector_ok(task, node):
+                raise FitException(FitError(task=task, node=node,
+                                            reasons=[NODE_SELECTOR_FAILED]))
+            if not _node_affinity_ok(task, node):
+                raise FitException(FitError(task=task, node=node,
+                                            reasons=[NODE_AFFINITY_FAILED]))
+            if not _taints_ok(task, node):
+                raise FitException(FitError(task=task, node=node,
+                                            reasons=[TAINT_FAILED]))
+            if not _ports_ok(task, node):
+                raise FitException(FitError(task=task, node=node,
+                                            reasons=[NODE_PORT_FAILED]))
+            if not _gpu_share_ok(task, node):
+                raise FitException(FitError(
+                    task=task, node=node,
+                    reasons=["node(s) didn't have enough free gpu memory"]))
+
+        ssn.add_predicate_fn(NAME, predicate_fn)
+
+    def _ports_and_gpu_mask(self, ssn):
+        def mask_fn(batch, narr, feats):
+            mask = np.ones((batch.g_pad, narr.n_pad), bool)
+            # only sweep groups that actually use host ports or shared GPUs
+            for g, members in enumerate(batch.group_members):
+                rep = batch.tasks[members[0]]
+                uses_ports = bool(rep.pod.spec.host_ports)
+                uses_gpu = rep.resreq.get(GPU_MEMORY_RESOURCE) > 0
+                if not (uses_ports or uses_gpu):
+                    continue
+                for name, i in narr.name_to_idx.items():
+                    node = ssn.nodes[name]
+                    if uses_ports and not _ports_ok(rep, node):
+                        mask[g, i] = False
+                    elif uses_gpu and not _gpu_share_ok(rep, node):
+                        mask[g, i] = False
+            return mask
+        return mask_fn
+
+
+register_plugin_builder(NAME, PredicatesPlugin)

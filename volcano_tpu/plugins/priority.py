@@ -1,0 +1,52 @@
+"""priority plugin (reference: pkg/scheduler/plugins/priority/priority.go).
+
+TaskOrder/JobOrder by priority; Preemptable admits only strictly
+lower-priority victims.
+"""
+
+from __future__ import annotations
+
+from ..framework.plugin import Plugin
+from ..framework.registry import register_plugin_builder
+from ..framework.session import PERMIT
+
+NAME = "priority"
+
+
+class PriorityPlugin(Plugin):
+    def __init__(self, arguments=None):
+        self.arguments = arguments or {}
+
+    def name(self) -> str:
+        return NAME
+
+    def on_session_open(self, ssn) -> None:
+        def task_order_fn(l, r):
+            if l.priority == r.priority:
+                return 0
+            return -1 if l.priority > r.priority else 1
+
+        ssn.add_task_order_fn(NAME, task_order_fn)
+
+        def job_order_fn(l, r):
+            if l.priority == r.priority:
+                return 0
+            return -1 if l.priority > r.priority else 1
+
+        ssn.add_job_order_fn(NAME, job_order_fn)
+
+        def preemptable_fn(preemptor, preemptees):
+            """Only strictly lower priority tasks are victims
+            (priority.go:79-108)."""
+            preemptor_job = ssn.jobs.get(preemptor.job)
+            if preemptor_job is None:
+                return [], PERMIT
+            victims = [t for t in preemptees
+                       if ssn.jobs.get(t.job) is not None
+                       and ssn.jobs[t.job].priority < preemptor_job.priority]
+            return victims, PERMIT
+
+        ssn.add_preemptable_fn(NAME, preemptable_fn)
+
+
+register_plugin_builder(NAME, PriorityPlugin)

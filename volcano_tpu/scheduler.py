@@ -1,0 +1,106 @@
+"""Scheduler: the periodic cycle driver (reference: pkg/scheduler/
+scheduler.go): load conf (hot-reloadable), every period open a session, run
+the configured actions in order, close the session.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .apiserver.store import ObjectStore
+from .cache import SchedulerCache
+from .framework import (close_session, default_scheduler_conf, get_action,
+                        open_session, parse_scheduler_conf)
+from .metrics import metrics as m
+from .models.objects import DEFAULT_SCHEDULER_NAME
+from .utils.filewatcher import FileWatcher
+
+
+class Scheduler:
+    def __init__(self, store: ObjectStore,
+                 scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+                 scheduler_conf: Optional[str] = None,
+                 scheduler_conf_path: Optional[str] = None,
+                 schedule_period: float = 1.0,
+                 cache: Optional[SchedulerCache] = None):
+        self.store = store
+        self.cache = cache if cache is not None else SchedulerCache(
+            store, scheduler_name)
+        self.schedule_period = schedule_period
+        self._conf_path = scheduler_conf_path
+        self._mutex = threading.Lock()
+        self._stop = threading.Event()
+        self._watcher: Optional[FileWatcher] = None
+        if scheduler_conf is not None:
+            self.conf = parse_scheduler_conf(scheduler_conf)
+        elif scheduler_conf_path is not None:
+            with open(scheduler_conf_path) as f:
+                self.conf = parse_scheduler_conf(f.read())
+        else:
+            self.conf = default_scheduler_conf()
+
+    # -- conf hot reload (scheduler.go:60-68,122-170) ----------------------
+
+    def load_scheduler_conf(self) -> None:
+        """Re-read the conf file; keep the previous conf on parse errors
+        (validation-or-keep-previous, scheduler.go:122-135)."""
+        if self._conf_path is None:
+            return
+        try:
+            with open(self._conf_path) as f:
+                new_conf = parse_scheduler_conf(f.read())
+            for name in new_conf.actions:
+                if get_action(name) is None:
+                    raise ValueError(f"unknown action {name!r}")
+            with self._mutex:
+                self.conf = new_conf
+        except Exception:
+            pass  # keep previous conf
+
+    def watch_conf(self) -> None:
+        if self._conf_path is None:
+            return
+        self._watcher = FileWatcher(self._conf_path,
+                                    on_change=lambda: self.load_scheduler_conf())
+        self._watcher.start()
+
+    # -- cycle -------------------------------------------------------------
+
+    def run_once(self) -> None:
+        """One scheduling cycle (scheduler.go:90-110)."""
+        start = time.perf_counter()
+        with self._mutex:
+            conf = self.conf
+        ssn = open_session(self.cache, conf.tiers, conf.configurations)
+        try:
+            for name in conf.actions:
+                action = get_action(name)
+                if action is None:
+                    continue
+                with m.action_timer(name):
+                    action.execute(ssn)
+        finally:
+            close_session(ssn)
+        m.update_e2e_duration(time.perf_counter() - start)
+
+    def run(self) -> None:
+        """Start cache ingestion + periodic cycles until stop()."""
+        self.cache.run()
+        self.watch_conf()
+        while not self._stop.is_set():
+            cycle_start = time.monotonic()
+            self.run_once()
+            elapsed = time.monotonic() - cycle_start
+            self._stop.wait(max(0.0, self.schedule_period - elapsed))
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self.run, daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watcher is not None:
+            self._watcher.stop()

@@ -1,0 +1,44 @@
+"""Polling file watcher (reference: pkg/filewatcher, an fsnotify wrapper).
+Used for scheduler conf hot-reload; a 1s mtime poll avoids any non-baked
+dependency while keeping the same observable behavior."""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Optional
+
+
+class FileWatcher:
+    def __init__(self, path: str, on_change: Callable[[], None],
+                 interval: float = 1.0):
+        self.path = path
+        self.on_change = on_change
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_mtime = self._mtime()
+
+    def _mtime(self) -> float:
+        try:
+            return os.stat(self.path).st_mtime
+        except OSError:
+            return 0.0
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            mtime = self._mtime()
+            if mtime != self._last_mtime:
+                self._last_mtime = mtime
+                try:
+                    self.on_change()
+                except Exception:
+                    pass
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
