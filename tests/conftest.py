@@ -4,8 +4,14 @@ sharding paths are exercised without TPU hardware."""
 import os
 
 # Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The axon sitecustomize pins jax_platforms to the TPU tunnel at interpreter
+# start; the env var alone doesn't win, so override the config directly.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -114,26 +114,19 @@ class AllocateAction(Action):
                 shadow = _ZeroMinJob(job)
                 phase_b.append((job, shadow, surplus))
 
-        placements = {job.uid: list(result_a.placements[job.uid])
-                      for job, _ in phase_a}
+        # phase A's claims must be visible to phase B's solver run;
+        # stage them in session state first, then place surplus
+        staged = self._stage(ssn, phase_a, result_a)
         if phase_b:
-            # phase A's claims must be visible to phase B's solver run;
-            # stage them in session state first, then place surplus
-            staged = self._stage(ssn, phase_a, result_a, placements)
             result_b = ssn.solver.place(
                 [(shadow, ts) for _, shadow, ts in phase_b],
                 allow_pipeline=True)
-            for job, shadow, _ in phase_b:
-                placements[job.uid].extend(result_b.placements[shadow.uid])
             self._apply_extra(ssn, staged, result_b, phase_b)
-            self._finalize(ssn, phase_a, result_a, staged)
-        else:
-            staged = self._stage(ssn, phase_a, result_a, placements)
-            self._finalize(ssn, phase_a, result_a, staged)
+        self._finalize(ssn, phase_a, result_a, staged)
 
     # -- session application ----------------------------------------------
 
-    def _stage(self, ssn, phase_a, result_a, placements) -> Dict[str, Statement]:
+    def _stage(self, ssn, phase_a, result_a) -> Dict[str, Statement]:
         """Stage phase-A placements into session state via per-job statements."""
         staged: Dict[str, Statement] = {}
         for job, _ in phase_a:

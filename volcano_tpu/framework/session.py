@@ -144,6 +144,17 @@ class Session:
     # tiered dispatch
     # ------------------------------------------------------------------
 
+    def plugin_enabled(self, plugin_name: str, flag: str) -> bool:
+        """Whether the conf enables ``flag`` for ``plugin_name`` (unset flags
+        default to enabled). Consulted by plugins before feeding the solver so
+        the vectorized path honors per-extension-point enables exactly like
+        tiered dispatch does for host fns."""
+        for tier in self.tiers:
+            for opt in tier.plugins:
+                if opt.name == plugin_name:
+                    return opt.is_enabled(flag)
+        return True
+
     def _enabled_fns(self, map_name: str):
         """Yield (tier_index, plugin_option, fn) honoring enable flags."""
         fns = getattr(self, map_name)

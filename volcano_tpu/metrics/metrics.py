@@ -19,7 +19,10 @@ _lock = threading.Lock()
 
 class _Hist:
     __slots__ = ("count", "total", "buckets")
-    BOUNDS = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10)
+    # log-spaced to cover metrics recorded in seconds, milliseconds and
+    # microseconds alike (the reference's units vary per metric)
+    BOUNDS = (0.0001, 0.001, 0.01, 0.1, 1.0, 10.0, 100.0,
+              1e3, 1e4, 1e5, 1e6, 1e7)
 
     def __init__(self):
         self.count = 0

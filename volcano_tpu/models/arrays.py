@@ -78,20 +78,6 @@ class ResourceIndex:
                 v[i] = quant
         return v * self.scales
 
-    def vec_capability(self, r: Resource) -> np.ndarray:
-        """Capability-style vector: dimensions the resource does not mention
-        are unbounded (the Infinity dimension default)."""
-        v = np.full(self.r, np.inf, np.float32)
-        if r.milli_cpu > 0:
-            v[0] = r.milli_cpu * self.scales[0]
-        if r.memory > 0:
-            v[1] = r.memory * self.scales[1]
-        for name, quant in r.scalars.items():
-            i = self.index.get(name)
-            if i is not None:
-                v[i] = quant * self.scales[i]
-        return v
-
 
 NODE_BUCKET = 256
 TASK_BUCKET = 256
@@ -191,7 +177,6 @@ class TaskBatch:
     task_job: np.ndarray             # [T] i32
     group_req: np.ndarray            # [G, R] f32
     group_members: List[List[int]]   # group -> task indices
-    group_task_count: np.ndarray     # [G] i32 (1 task slot each on a node)
     job_uids: List[str]
     job_min_available: np.ndarray    # [J] i32 (padding rows incl. sentinel: 0)
     job_ready_base: np.ndarray       # [J] i32 already-occupied task count
@@ -262,7 +247,6 @@ class TaskBatch:
             task_job=pad1(task_job, t_pad, np.int32, fill=sentinel),
             group_req=greq,
             group_members=group_members,
-            group_task_count=pad1([len(m) for m in group_members], g_pad, np.int32),
             job_uids=job_uids,
             job_min_available=pad1(job_min, j_pad, np.int32),
             job_ready_base=pad1(job_base, j_pad, np.int32),

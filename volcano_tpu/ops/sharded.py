@@ -1,0 +1,208 @@
+"""Node-axis-sharded gang-allocate: the multi-chip scheduling step.
+
+The reference scales its per-task node sweep with a 16-goroutine fan-out and
+adaptive node *sampling* (pkg/scheduler/util/scheduler_helper.go:49-68,121).
+The TPU-native scale-out instead shards the node axis across the device mesh
+(ICI) and evaluates every node exhaustively: each chip owns N/D nodes' state,
+the scan carry stays resident per-chip, and the only cross-chip traffic per
+scan step is an all-gather of one (score, index) candidate pair per chip plus
+a psum'd bit — a few dozen bytes over ICI, with the node-dimension compute
+(fit compares + scoring) fully parallel.
+
+This is the project's "sequence parallelism": the long axis (nodes, 10k+) is
+blockwise-decomposed across chips exactly like ring attention decomposes
+sequence — SURVEY.md §5.7.
+
+Semantics match ops/allocate.gang_allocate bit-for-bit (ties broken by the
+lowest global node index, which is also what argmax-over-concatenated-shards
+yields).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .score import ScoreWeights, node_score
+
+NEG = jnp.float32(-1e30)
+
+
+class ShardState(NamedTuple):
+    idle: jax.Array          # [Nl, R] local shard
+    future: jax.Array        # [Nl, R]
+    n_tasks: jax.Array       # [Nl]
+    ckpt_idle: jax.Array
+    ckpt_future: jax.Array
+    ckpt_ntasks: jax.Array
+    cur_job: jax.Array       # i32 (replicated value, identical on all chips)
+    placed: jax.Array        # i32 replicated
+    placed_alloc: jax.Array  # i32 replicated
+    ready: jax.Array         # [J] bool replicated
+    kept: jax.Array          # [J] bool replicated
+
+
+def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
+                  group_static_score, job_min_available, job_ready_base,
+                  node_idle, node_future, node_alloc, node_ntasks,
+                  node_max_tasks, eps, weights, allow_pipeline: bool,
+                  axis: str):
+    """Runs inside shard_map: node-axis arrays are the local shard."""
+    T = task_group.shape[0]
+    J = job_min_available.shape[0]
+    Nl = node_idle.shape[0]
+    shard = jax.lax.axis_index(axis)
+    offset = shard * Nl
+
+    init = ShardState(
+        idle=node_idle, future=node_future, n_tasks=node_ntasks,
+        ckpt_idle=node_idle, ckpt_future=node_future, ckpt_ntasks=node_ntasks,
+        cur_job=task_job[0], placed=jnp.int32(0), placed_alloc=jnp.int32(0),
+        ready=jnp.zeros(J, bool), kept=jnp.zeros(J, bool))
+
+    def finalize_job(state: ShardState, job):
+        # counters are replicated: every chip takes the same branch, so the
+        # gang commit/rollback (Statement semantics) needs no communication
+        base = job_ready_base[job]
+        minavail = job_min_available[job]
+        is_ready = base + state.placed_alloc >= minavail
+        is_kept = base + state.placed >= minavail
+        keep = is_ready | is_kept
+        return state._replace(
+            idle=jnp.where(keep, state.idle, state.ckpt_idle),
+            future=jnp.where(keep, state.future, state.ckpt_future),
+            n_tasks=jnp.where(keep, state.n_tasks, state.ckpt_ntasks),
+            ready=state.ready.at[job].set(is_ready),
+            kept=state.kept.at[job].set(is_kept))
+
+    def step(state: ShardState, t):
+        g = task_group[t]
+        j = task_job[t]
+        valid = task_valid[t]
+
+        boundary = j != state.cur_job
+        finalized = finalize_job(state, state.cur_job)
+        state = jax.tree.map(
+            lambda a, b: jnp.where(boundary, a, b), finalized, state)
+        state = state._replace(
+            ckpt_idle=jnp.where(boundary, state.idle, state.ckpt_idle),
+            ckpt_future=jnp.where(boundary, state.future, state.ckpt_future),
+            ckpt_ntasks=jnp.where(boundary, state.n_tasks, state.ckpt_ntasks),
+            placed=jnp.where(boundary, 0, state.placed),
+            placed_alloc=jnp.where(boundary, 0, state.placed_alloc),
+            cur_job=j)
+
+        req = group_req[g]
+        static_ok = group_mask[g]                      # [Nl]
+        pods_ok = (node_max_tasks == 0) | (state.n_tasks < node_max_tasks)
+        base_ok = static_ok & pods_ok & valid
+
+        fits_idle = jnp.all(req[None, :] <= state.idle + eps[None, :], axis=-1) & base_ok
+        fits_future = jnp.all(req[None, :] <= state.future + eps[None, :], axis=-1) & base_ok
+
+        score = node_score(req, state.idle, node_alloc, weights,
+                           group_static_score[g])
+
+        # -- cross-chip: does ANY chip have an idle fit? (1 int over ICI)
+        any_idle = jax.lax.psum(jnp.any(fits_idle).astype(jnp.int32), axis) > 0
+        if allow_pipeline:
+            cand = jnp.where(any_idle, fits_idle, fits_future)
+        else:
+            cand = fits_idle
+
+        masked = jnp.where(cand, score, NEG)
+        local_best = jnp.argmax(masked)
+        local_score = masked[local_best]
+        local_gidx = offset + local_best.astype(jnp.int32)
+
+        # -- cross-chip: all-gather one (score, index) pair per chip
+        scores = jax.lax.all_gather(local_score, axis)      # [D]
+        gidxs = jax.lax.all_gather(local_gidx, axis)        # [D]
+        best_score = jnp.max(scores)
+        winner = scores >= best_score
+        sel_g = jnp.min(jnp.where(winner, gidxs, jnp.int32(2**30)))
+        placed_ok = best_score > NEG * 0.5
+        pipelined = placed_ok & ~any_idle if allow_pipeline else jnp.bool_(False)
+
+        # owner-shard applies the placement to its local state
+        is_owner = (sel_g >= offset) & (sel_g < offset + Nl)
+        sel_l = jnp.clip(sel_g - offset, 0, Nl - 1)
+        take_idle = placed_ok & ~pipelined
+        d_idle = jnp.where(is_owner & take_idle, -req, 0.0)
+        d_future = jnp.where(is_owner & placed_ok, -req, 0.0)
+        idle = state.idle.at[sel_l].add(d_idle)
+        future = state.future.at[sel_l].add(d_future)
+        n_tasks = state.n_tasks.at[sel_l].add(
+            jnp.where(is_owner & placed_ok, 1, 0))
+
+        state = state._replace(
+            idle=idle, future=future, n_tasks=n_tasks,
+            placed=state.placed + placed_ok.astype(jnp.int32),
+            placed_alloc=state.placed_alloc + take_idle.astype(jnp.int32))
+        return state, (jnp.where(placed_ok, sel_g, -1), pipelined)
+
+    state, (assign, pipelined) = jax.lax.scan(step, init, jnp.arange(T))
+    state = finalize_job(state, state.cur_job)
+
+    ok = (state.ready[task_job] | state.kept[task_job]) & task_valid
+    assign = jnp.where(ok, assign, -1)
+    pipelined = pipelined & ok
+    return assign, pipelined, state.ready, state.kept, state.idle
+
+
+def make_sharded_gang_allocate(mesh: Mesh, axis: str = "nodes",
+                               allow_pipeline: bool = True):
+    """Build the jitted node-sharded gang-allocate for a device mesh.
+
+    Node-axis inputs ([N,...] and [G,N]) must be padded so N divides the mesh
+    size. Returns fn(task_group, task_job, task_valid, group_req, group_mask,
+    group_static_score, job_min_available, job_ready_base, node_idle,
+    node_future, node_alloc, node_ntasks, node_max_tasks, eps, weights)
+    -> (assign [T] global node index, pipelined [T], ready [J], kept [J],
+        final node idle [N,R]).
+    """
+    n = P(axis)               # [N] vectors
+    nr = P(axis, None)        # [N, R]
+    gn = P(None, axis)        # [G, N]
+    rep = P()
+    in_specs = (rep, rep, rep, rep, gn, gn, rep, rep,
+                nr, nr, nr, n, n, rep,
+                ScoreWeights(rep, rep, rep, rep, rep))
+    out_specs = (rep, rep, rep, rep, nr)
+    body = partial(_sharded_body, allow_pipeline=allow_pipeline, axis=axis)
+    try:
+        sm = shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
+    except TypeError:  # pre-0.9 jax
+        sm = shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=False)
+    return jax.jit(sm)
+
+
+def shard_synth(mesh: Mesh, sa, axis: str = "nodes"):
+    """Device-put a SynthArrays set with node-axis sharding over ``mesh``."""
+    n = NamedSharding(mesh, P(axis))
+    nr = NamedSharding(mesh, P(axis, None))
+    gn = NamedSharding(mesh, P(None, axis))
+    rep = NamedSharding(mesh, P())
+    put = jax.device_put
+    return dict(
+        task_group=put(sa.task_group, rep), task_job=put(sa.task_job, rep),
+        task_valid=put(sa.task_valid, rep), group_req=put(sa.group_req, rep),
+        group_mask=put(sa.group_mask, gn),
+        group_static_score=put(sa.group_static_score, gn),
+        job_min_available=put(sa.job_min_available, rep),
+        job_ready_base=put(sa.job_ready_base, rep),
+        node_idle=put(sa.node_idle, nr), node_future=put(sa.node_future, nr),
+        node_alloc=put(sa.node_alloc, nr),
+        node_ntasks=put(sa.node_ntasks, n),
+        node_max_tasks=put(sa.node_max_tasks, n),
+        eps=put(sa.eps, rep))

@@ -44,7 +44,7 @@ class BinpackPlugin(Plugin):
         return NAME
 
     def on_session_open(self, ssn) -> None:
-        if ssn.solver is not None:
+        if ssn.solver is not None and ssn.plugin_enabled(NAME, "enabledNodeOrder"):
             ssn.solver.add_weight("binpack", float(self.weight))
             ssn.solver.set_binpack_resources(
                 {k: float(v) for k, v in self.res_weights.items()})

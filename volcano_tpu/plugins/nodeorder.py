@@ -74,7 +74,7 @@ class NodeOrderPlugin(Plugin):
         return NAME
 
     def on_session_open(self, ssn) -> None:
-        if ssn.solver is not None:
+        if ssn.solver is not None and ssn.plugin_enabled(NAME, "enabledNodeOrder"):
             ssn.solver.add_weight("least", float(self.least_w))
             ssn.solver.add_weight("most", float(self.most_w))
             ssn.solver.add_weight("balanced", float(self.balanced_w))

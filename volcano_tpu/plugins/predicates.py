@@ -91,7 +91,7 @@ class PredicatesPlugin(Plugin):
 
     def on_session_open(self, ssn) -> None:
         # vectorized path: selector/taints/affinity matrices + extra masks
-        if ssn.solver is not None:
+        if ssn.solver is not None and ssn.plugin_enabled(NAME, "enabledPredicate"):
             ssn.solver.enable_default_predicates = True
             ssn.solver.mark_vectorized(NAME)
             ssn.solver.add_mask_fn(self._ports_and_gpu_mask(ssn))

@@ -1,0 +1,150 @@
+"""Synthetic cluster generators for benchmarks and scale tests.
+
+Two levels, mirroring the reference's two test tiers (SURVEY.md §4):
+
+* ``synth_arrays``: dense post-snapshot solver inputs (the analogue of a
+  populated ``TaskBatch``/``NodeArrays`` pair) for kernel-level benches —
+  what the scheduler sees after the cache snapshot has been encoded.
+* ``populate_store``: object-level cluster (Nodes/Pods/PodGroups/Queues in
+  an ObjectStore) for end-to-end action benches and e2e tests, the analogue
+  of the reference e2e harness's kind-cluster fixtures.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.arrays import bucket
+
+
+@dataclass
+class SynthArrays:
+    """Dense solver inputs for a T-task x N-node synthetic cluster."""
+    task_group: np.ndarray      # [T] i32
+    task_job: np.ndarray        # [T] i32
+    task_valid: np.ndarray      # [T] bool
+    group_req: np.ndarray       # [G, R] f32
+    group_mask: np.ndarray      # [G, N] bool
+    group_static_score: np.ndarray  # [G, N] f32
+    job_min_available: np.ndarray   # [J] i32
+    job_ready_base: np.ndarray      # [J] i32
+    node_idle: np.ndarray       # [N, R] f32
+    node_future: np.ndarray     # [N, R] f32
+    node_alloc: np.ndarray      # [N, R] f32
+    node_ntasks: np.ndarray     # [N] i32
+    node_max_tasks: np.ndarray  # [N] i32
+    eps: np.ndarray             # [R] f32
+
+    @property
+    def shapes(self) -> str:
+        return (f"T={self.task_group.shape[0]} N={self.node_idle.shape[0]} "
+                f"G={self.group_req.shape[0]} J={self.job_min_available.shape[0]} "
+                f"R={self.node_idle.shape[1]}")
+
+
+def synth_arrays(n_tasks: int, n_nodes: int, *, gang_size: int = 8,
+                 n_racks: int = 32, r: int = 4, seed: int = 0,
+                 utilization: float = 0.3, node_pad_to: Optional[int] = None,
+                 rack_affinity: bool = True) -> SynthArrays:
+    """A gang-heavy pending backlog over a partially utilized cluster.
+
+    Nodes: 64-core/256GiB-shaped with uniform random pre-existing usage around
+    ``utilization``; resource dims are [cpu(milli), memory(MiB), pods-slack,
+    accelerator]. Tasks: gangs of ``gang_size`` with per-gang resource shapes;
+    each gang is one group (homogeneous replicas). Rack-affinity static score
+    prefers a random rack per gang (config-5's topology-aware nodeorder).
+    """
+    rng = np.random.default_rng(seed)
+    n_jobs = max(1, n_tasks // gang_size)
+    n_tasks = n_jobs * gang_size
+    n_groups = n_jobs
+
+    t_pad = bucket(n_tasks, 256)
+    g_pad = bucket(n_groups, 16)
+    j_pad = bucket(n_jobs + 1, 16)          # + sentinel for padding tasks
+    n_pad = node_pad_to if node_pad_to else bucket(n_nodes, 256)
+
+    # nodes
+    cap = np.zeros((n_pad, r), np.float32)
+    cap[:n_nodes, 0] = 64_000.0                           # 64 cores (milli)
+    cap[:n_nodes, 1] = 256 * 1024.0                       # 256 GiB in MiB
+    cap[:n_nodes, 2] = 110.0                              # pods dimension
+    cap[:n_nodes, 3] = 8.0                                # accelerators
+    used_frac = rng.uniform(0.0, 2 * utilization, (n_pad, 1)).astype(np.float32)
+    used = (cap * used_frac).astype(np.float32)
+    idle = cap - used
+    node_ntasks = np.zeros(n_pad, np.int32)
+    node_ntasks[:n_nodes] = (used_frac[:n_nodes, 0] * 30).astype(np.int32)
+    node_max_tasks = np.zeros(n_pad, np.int32)            # uncapped
+
+    # gangs
+    group_req = np.zeros((g_pad, r), np.float32)
+    group_req[:n_groups, 0] = rng.choice([1000, 2000, 4000, 8000], n_groups)
+    group_req[:n_groups, 1] = rng.choice([2048, 4096, 8192, 16384], n_groups)
+    group_req[:n_groups, 2] = 1.0
+    group_req[:n_groups, 3] = rng.choice([0, 0, 0, 1], n_groups)
+
+    task_group = np.zeros(t_pad, np.int32)
+    task_job = np.full(t_pad, n_jobs, np.int32)           # sentinel fill
+    task_valid = np.zeros(t_pad, bool)
+    ids = np.arange(n_tasks)
+    task_group[:n_tasks] = ids // gang_size
+    task_job[:n_tasks] = ids // gang_size
+    task_valid[:n_tasks] = True
+
+    job_min_available = np.zeros(j_pad, np.int32)
+    job_min_available[:n_jobs] = gang_size
+    job_ready_base = np.zeros(j_pad, np.int32)
+
+    # static predicates: valid nodes only; static score: rack affinity
+    group_mask = np.zeros((g_pad, n_pad), bool)
+    group_mask[:, :n_nodes] = True
+    group_static_score = np.zeros((g_pad, n_pad), np.float32)
+    if rack_affinity and n_racks > 0:
+        node_rack = rng.integers(0, n_racks, n_nodes)
+        gang_rack = rng.integers(0, n_racks, n_groups)
+        group_static_score[:n_groups, :n_nodes] = (
+            (gang_rack[:, None] == node_rack[None, :]) * 50.0)
+
+    eps = np.array([100.0, 0.1, 0.1, 0.1], np.float32)[:r]
+
+    return SynthArrays(
+        task_group=task_group, task_job=task_job, task_valid=task_valid,
+        group_req=group_req, group_mask=group_mask,
+        group_static_score=group_static_score,
+        job_min_available=job_min_available, job_ready_base=job_ready_base,
+        node_idle=idle, node_future=idle.copy(), node_alloc=cap,
+        node_ntasks=node_ntasks, node_max_tasks=node_max_tasks, eps=eps)
+
+
+def populate_store(store, *, n_nodes: int, n_jobs: int, gang_size: int,
+                   queues: Optional[List[Tuple[str, int]]] = None,
+                   cpu_req: str = "2", mem_req: str = "4Gi",
+                   node_cpu: str = "64", node_mem: str = "256Gi",
+                   seed: int = 0, namespace: str = "default",
+                   phase: str = "Inqueue") -> Dict[str, int]:
+    """Object-level synthetic cluster in an ObjectStore (e2e bench path)."""
+    from .test_utils import (build_node, build_pod, build_pod_group,
+                             build_queue)
+    rng = np.random.default_rng(seed)
+    queues = queues or [("default", 1)]
+    for qname, weight in queues:
+        if store.get("queues", qname) is None:
+            store.create("queues", build_queue(qname, weight=weight))
+    for i in range(n_nodes):
+        store.create("nodes", build_node(
+            f"node-{i}", {"cpu": node_cpu, "memory": node_mem, "pods": "110"},
+            labels={"rack": f"rack-{i % 32}"}))
+    for j in range(n_jobs):
+        qname = queues[j % len(queues)][0]
+        pg = build_pod_group(f"pg-{j}", namespace, qname, gang_size,
+                             phase=phase)
+        store.create("podgroups", pg)
+        for t in range(gang_size):
+            store.create("pods", build_pod(
+                namespace, f"job{j}-task{t}", "", "Pending",
+                {"cpu": cpu_req, "memory": mem_req}, groupname=f"pg-{j}"))
+    return {"nodes": n_nodes, "jobs": n_jobs, "tasks": n_jobs * gang_size}
