@@ -33,13 +33,7 @@ def main() -> None:
     sa = synth_arrays(N_TASKS, N_NODES, gang_size=8, seed=42,
                       utilization=0.3)
     weights = ScoreWeights.make(sa.group_req.shape[1], binpack=1.0)
-    args = (jnp.asarray(sa.task_group), jnp.asarray(sa.task_job),
-            jnp.asarray(sa.task_valid), jnp.asarray(sa.group_req),
-            jnp.asarray(sa.group_mask), jnp.asarray(sa.group_static_score),
-            jnp.asarray(sa.job_min_available), jnp.asarray(sa.job_ready_base),
-            jnp.asarray(sa.node_idle), jnp.asarray(sa.node_future),
-            jnp.asarray(sa.node_alloc), jnp.asarray(sa.node_ntasks),
-            jnp.asarray(sa.node_max_tasks), jnp.asarray(sa.eps), weights)
+    args = [jnp.asarray(a) for a in sa.args] + [weights]
 
     # warm-up (compile)
     out = gang_allocate(*args)

@@ -1,0 +1,210 @@
+"""Fair-share layer: proportion water-fill kernel vs a NumPy oracle of the
+reference loop (proportion.go:129-194), dominant-share conventions, and
+action-level DRF/proportion behavior through a real session."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tests.harness import Harness
+from volcano_tpu.ops.fairshare import dominant_share, proportion_waterfill
+from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                          build_pod_group, build_queue)
+
+
+# ---------------------------------------------------------------------------
+# kernel vs oracle
+# ---------------------------------------------------------------------------
+
+def waterfill_oracle(weight, capability, request, total):
+    """Direct transcription of the reference pass semantics."""
+    q, r = request.shape
+    deserved = np.zeros((q, r), np.float64)
+    met = np.zeros(q, bool)
+    remaining = total.astype(np.float64).copy()
+    has_cap = np.isfinite(capability).any(axis=1)
+    prev = None
+    while True:
+        tw = weight[~met].sum()
+        if tw == 0 or (remaining <= 0).all() or (
+                prev is not None and np.array_equal(prev, remaining)):
+            break
+        prev = remaining.copy()
+        old = deserved.copy()
+        for i in range(q):
+            if met[i]:
+                continue
+            grown = deserved[i] + remaining * (weight[i] / tw)
+            if has_cap[i] and not (grown <= capability[i]).all():
+                deserved[i] = np.minimum(np.minimum(grown, capability[i]),
+                                         request[i])
+                met[i] = True
+            elif (request[i] <= grown).all():
+                deserved[i] = np.minimum(grown, request[i])
+                met[i] = True
+            else:
+                deserved[i] = np.minimum(grown, request[i])
+        remaining = remaining - (deserved - old).sum(axis=0)
+    return deserved, met
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_waterfill_matches_oracle(seed):
+    rng = np.random.default_rng(seed)
+    q, r = 5, 3
+    weight = rng.integers(1, 8, q).astype(np.float32)
+    request = (rng.uniform(0, 100, (q, r))).astype(np.float32)
+    capability = np.full((q, r), np.inf, np.float32)
+    # half the queues get finite capabilities
+    for i in range(0, q, 2):
+        capability[i] = rng.uniform(20, 120, r)
+    total = np.array([200.0, 150.0, 80.0], np.float32)
+
+    got, got_met = proportion_waterfill(jnp.asarray(weight),
+                                        jnp.asarray(capability),
+                                        jnp.asarray(request),
+                                        jnp.asarray(total))
+    want, _ = waterfill_oracle(weight, capability, request, total)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4, atol=1e-2)
+
+
+def test_waterfill_weighted_split():
+    """Two insatiable queues split the cluster by weight."""
+    weight = jnp.asarray(np.array([3.0, 1.0], np.float32))
+    capability = jnp.asarray(np.full((2, 2), np.inf, np.float32))
+    request = jnp.asarray(np.full((2, 2), 1e6, np.float32))
+    total = jnp.asarray(np.array([100.0, 40.0], np.float32))
+    deserved, met = proportion_waterfill(weight, capability, request, total)
+    np.testing.assert_allclose(np.asarray(deserved[0]), [75.0, 30.0], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(deserved[1]), [25.0, 10.0], rtol=1e-5)
+
+
+def test_waterfill_capability_clamp_redistributes():
+    """A capability-clamped queue's leftover flows to the other queue."""
+    weight = jnp.asarray(np.array([1.0, 1.0], np.float32))
+    capability = np.full((2, 1), np.inf, np.float32)
+    capability[0, 0] = 10.0
+    request = jnp.asarray(np.full((2, 1), 1e6, np.float32))
+    total = jnp.asarray(np.array([100.0], np.float32))
+    deserved, _ = proportion_waterfill(weight, jnp.asarray(capability),
+                                       request, total)
+    np.testing.assert_allclose(np.asarray(deserved[:, 0]), [10.0, 90.0],
+                               rtol=1e-5)
+
+
+def test_dominant_share_conventions():
+    total = jnp.asarray(np.array([10.0, 0.0], np.float32))
+    alloc = jnp.asarray(np.array([[5.0, 0.0],    # 0/0 on dim 1 -> dim0 wins
+                                  [0.0, 3.0],    # 3/0 -> 1.0
+                                  [0.0, 0.0]], np.float32))
+    share, dom = dominant_share(alloc, total)
+    np.testing.assert_allclose(np.asarray(share), [0.5, 1.0, 0.0])
+    assert int(dom[0]) == 0 and int(dom[1]) == 1
+
+
+# ---------------------------------------------------------------------------
+# action-level: proportion gates an overused queue; drf orders jobs
+# ---------------------------------------------------------------------------
+
+CONF = """\
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def test_proportion_overused_queue_blocked():
+    """Queue q1 already holds more than its deserved share; its pending job
+    must not allocate while q2's does."""
+    h = Harness(CONF)
+    h.add("queues", build_queue("q1", weight=1), build_queue("q2", weight=1))
+    h.add("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+    # q1 is running 6 cpu worth on n1 (75% > its 50% deserved)
+    h.add("podgroups",
+          build_pod_group("pg-run", "default", "q1", 1, phase="Running"),
+          build_pod_group("pg1", "default", "q1", 1, phase="Inqueue"),
+          build_pod_group("pg2", "default", "q2", 1, phase="Inqueue"))
+    h.add("pods",
+          build_pod("default", "r1", "n1", "Running",
+                    {"cpu": "6", "memory": "2Gi"}, groupname="pg-run"),
+          build_pod("default", "p1", "", "Pending",
+                    {"cpu": "1", "memory": "1Gi"}, groupname="pg1"),
+          build_pod("default", "p2", "", "Pending",
+                    {"cpu": "2", "memory": "1Gi"}, groupname="pg2"),
+          build_pod("default", "p3", "", "Pending",
+                    {"cpu": "2", "memory": "1Gi"}, groupname="pg2"))
+    # water-fill: q2's 4-cpu demand caps q1's deserved at 4 cpu < 6 allocated
+    h.run_actions("allocate").close_session()
+    assert "default/p2" in h.binds
+    assert "default/p1" not in h.binds
+
+
+def test_drf_job_order_low_share_first():
+    """With one schedulable slot, the job whose queue... job share is lower
+    (no current allocation) should win over the job already holding
+    resources."""
+    h = Harness(CONF)
+    h.add("queues", build_queue("default", weight=1))
+    h.add("nodes", build_node("n1", {"cpu": "9", "memory": "16Gi"}))
+    # jobA runs 6cpu already and wants one more; jobB has nothing pending yet
+    h.add("podgroups",
+          build_pod_group("pgA", "default", "default", 1, phase="Running"),
+          build_pod_group("pgB", "default", "default", 1, phase="Inqueue"))
+    h.add("pods",
+          build_pod("default", "a-run", "n1", "Running",
+                    {"cpu": "6", "memory": "2Gi"}, groupname="pgA"),
+          build_pod("default", "a-pend", "", "Pending",
+                    {"cpu": "3", "memory": "1Gi"}, groupname="pgA"),
+          build_pod("default", "b-pend", "", "Pending",
+                    {"cpu": "3", "memory": "1Gi"}, groupname="pgB"))
+    ssn = h.open_session()
+    jobs = {j.name: j for j in ssn.jobs.values()}
+    # DRF: pgB (share 0) orders before pgA (share 6/9)
+    assert ssn.job_order_fn(jobs["pgB"], jobs["pgA"])
+    assert not ssn.job_order_fn(jobs["pgA"], jobs["pgB"])
+    h.run_actions("allocate").close_session()
+    assert "default/b-pend" in h.binds
+
+
+def test_hdrf_queue_compare():
+    """Hierarchical DRF: the queue under the lighter-loaded subtree wins."""
+    conf = """\
+actions: "allocate"
+tiers:
+- plugins:
+  - name: drf
+    enabledHierarchy: true
+  - name: predicates
+  - name: nodeorder
+"""
+    h = Harness(conf)
+    root_ann = "volcano.sh/hierarchy"
+    w_ann = "volcano.sh/hierarchy-weights"
+    q1 = build_queue("q1", weight=1)
+    q1.metadata.annotations[root_ann] = "root/sci"
+    q1.metadata.annotations[w_ann] = "1/2"
+    q2 = build_queue("q2", weight=1)
+    q2.metadata.annotations[root_ann] = "root/eng"
+    q2.metadata.annotations[w_ann] = "1/2"
+    h.add("queues", q1, q2)
+    h.add("nodes", build_node("n1", {"cpu": "10", "memory": "16Gi"}))
+    h.add("podgroups",
+          build_pod_group("sci-run", "default", "q1", 1, phase="Running"),
+          build_pod_group("eng-pend", "default", "q2", 1, phase="Inqueue"))
+    h.add("pods",
+          build_pod("default", "s1", "n1", "Running",
+                    {"cpu": "6", "memory": "2Gi"}, groupname="sci-run"),
+          build_pod("default", "e1", "", "Pending",
+                    {"cpu": "2", "memory": "1Gi"}, groupname="eng-pend"))
+    ssn = h.open_session()
+    qi1, qi2 = ssn.queues["q1"], ssn.queues["q2"]
+    # eng subtree has no allocation -> q2 orders first
+    assert ssn.queue_order_fn(qi2, qi1)
+    h.close_session()

@@ -14,14 +14,7 @@ from volcano_tpu.utils.synth import synth_arrays
 
 
 def _single(sa, weights):
-    return gang_allocate(
-        jnp.asarray(sa.task_group), jnp.asarray(sa.task_job),
-        jnp.asarray(sa.task_valid), jnp.asarray(sa.group_req),
-        jnp.asarray(sa.group_mask), jnp.asarray(sa.group_static_score),
-        jnp.asarray(sa.job_min_available), jnp.asarray(sa.job_ready_base),
-        jnp.asarray(sa.node_idle), jnp.asarray(sa.node_future),
-        jnp.asarray(sa.node_alloc), jnp.asarray(sa.node_ntasks),
-        jnp.asarray(sa.node_max_tasks), jnp.asarray(sa.eps), weights)
+    return gang_allocate(*[jnp.asarray(a) for a in sa.args], weights)
 
 
 @pytest.mark.parametrize("n_dev", [2, 8])
@@ -32,19 +25,14 @@ def test_sharded_matches_single_device(n_dev):
     mesh = Mesh(np.array(devices), ("nodes",))
 
     sa = synth_arrays(96, 8 * n_dev, gang_size=4, node_pad_to=8 * n_dev,
-                      seed=3, utilization=0.4)
+                      seed=3, utilization=0.4, n_queues=3)
     weights = ScoreWeights.make(sa.group_req.shape[1], binpack=1.0)
 
     a_s, p_s, r_s, k_s, _ = _single(sa, weights)
 
     fn = make_sharded_gang_allocate(mesh)
     args = shard_synth(mesh, sa)
-    a_m, p_m, r_m, k_m, idle_m = fn(
-        args["task_group"], args["task_job"], args["task_valid"],
-        args["group_req"], args["group_mask"], args["group_static_score"],
-        args["job_min_available"], args["job_ready_base"], args["node_idle"],
-        args["node_future"], args["node_alloc"], args["node_ntasks"],
-        args["node_max_tasks"], args["eps"], weights)
+    a_m, p_m, r_m, k_m, idle_m = fn(*args, weights)
 
     np.testing.assert_array_equal(np.asarray(a_s), np.asarray(a_m))
     np.testing.assert_array_equal(np.asarray(p_s), np.asarray(p_m))

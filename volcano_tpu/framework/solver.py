@@ -58,6 +58,7 @@ class BatchSolver:
         self._binpack_res: Optional[np.ndarray] = None
         self.mask_fns: List[Callable] = []
         self.static_score_fns: List[Callable] = []
+        self.queue_budget_fns: List[Callable] = []
         self.vectorized_plugins: set = set()
         self.enable_default_predicates = False
 
@@ -84,6 +85,14 @@ class BatchSolver:
     def add_static_score_fn(self, fn: Callable) -> None:
         """fn(batch, node_arrays, features) -> [G, N] float"""
         self.static_score_fns.append(fn)
+
+    def add_queue_budget_fn(self, fn: Callable) -> None:
+        """fn(queue_name, rindex) -> None | (allocated [R], deserved [R]).
+
+        Feeds the kernel's live fair-share gate: a job is only selected while
+        its queue's in-scan allocation stays within deserved (the proportion
+        plugin's Overused semantics, at job granularity)."""
+        self.queue_budget_fns.append(fn)
 
     def mark_vectorized(self, plugin_name: str) -> None:
         self.vectorized_plugins.add(plugin_name)
@@ -158,12 +167,30 @@ class BatchSolver:
         for fn in self.static_score_fns:
             static_score = static_score + jnp.asarray(fn(batch, narr, feats))
 
+        # queue fair-share budgets (live Overused gate inside the scan)
+        q_deserved = np.full((batch.q_pad, self.rindex.r), np.inf, np.float32)
+        q_alloc0 = np.zeros((batch.q_pad, self.rindex.r), np.float32)
+        for qi, qname in enumerate(batch.queue_names):
+            for fn in self.queue_budget_fns:
+                budget = fn(qname, self.rindex)
+                if budget is not None:
+                    allocated, deserved = budget
+                    q_alloc0[qi] = allocated
+                    q_deserved[qi] = deserved
+                    break
+
         assign, pipelined, ready, kept, _ = gang_allocate(
             jnp.asarray(batch.task_group), jnp.asarray(batch.task_job),
             jnp.asarray(batch.task_valid), jnp.asarray(batch.group_req),
             gmask, static_score,
             jnp.asarray(batch.job_min_available),
             jnp.asarray(batch.job_ready_base),
+            jnp.asarray(batch.job_task_start),
+            jnp.asarray(batch.job_n_tasks),
+            jnp.asarray(batch.job_queue),
+            jnp.asarray(batch.queue_job_start),
+            jnp.asarray(batch.queue_njobs),
+            jnp.asarray(q_deserved), jnp.asarray(q_alloc0),
             jnp.asarray(narr.idle), jnp.asarray(narr.future_idle),
             jnp.asarray(narr.allocatable), jnp.asarray(narr.n_tasks),
             jnp.asarray(narr.max_tasks), eps, self.score_weights(),
@@ -175,10 +202,12 @@ class BatchSolver:
         kept_np = np.asarray(kept)
         gmask_np = np.asarray(gmask)
 
+        uid_to_j = {uid: j for j, uid in enumerate(batch.job_uids)}
         result = PlacementResult(batch=batch, committed={}, kept={},
                                  placements={}, unplaced={})
-        for j, (job, jtasks) in enumerate(ordered_jobs):
-            if not jtasks:
+        for job, jtasks in ordered_jobs:
+            j = uid_to_j.get(job.uid, -1)
+            if not jtasks or j < 0:
                 # job contributed no tasks to the scan: readiness is decided
                 # by its pre-existing occupancy alone
                 ok = job.ready_task_num() >= job.min_available

@@ -111,6 +111,50 @@ def register_schedule_attempt(result: str):
     inc(SCHEDULE_ATTEMPTS, result=result)
 
 
+def update_queue_allocated(queue: str, milli_cpu: float, memory: float):
+    set_gauge(QUEUE_ALLOCATED, milli_cpu, queue_name=queue)
+    set_gauge(f"{NS}_queue_allocated_memory_bytes", memory, queue_name=queue)
+
+
+def update_queue_request(queue: str, milli_cpu: float, memory: float):
+    set_gauge(f"{NS}_queue_request_milli_cpu", milli_cpu, queue_name=queue)
+    set_gauge(f"{NS}_queue_request_memory_bytes", memory, queue_name=queue)
+
+
+def update_queue_deserved(queue: str, milli_cpu: float, memory: float):
+    set_gauge(QUEUE_DESERVED, milli_cpu, queue_name=queue)
+    set_gauge(f"{NS}_queue_deserved_memory_bytes", memory, queue_name=queue)
+
+
+def update_queue_share(queue: str, share: float):
+    set_gauge(QUEUE_SHARE, share, queue_name=queue)
+
+
+def update_queue_weight(queue: str, weight: int):
+    set_gauge(QUEUE_WEIGHT, weight, queue_name=queue)
+
+
+def update_queue_overused(queue: str, overused: bool):
+    set_gauge(f"{NS}_queue_overused", 1.0 if overused else 0.0,
+              queue_name=queue)
+
+
+def update_namespace_share(namespace: str, share: float):
+    set_gauge(NAMESPACE_SHARE, share, namespace=namespace)
+
+
+def update_namespace_weight(namespace: str, weight: int):
+    set_gauge(NAMESPACE_WEIGHT, weight, namespace=namespace)
+
+
+def update_namespace_weighted_share(namespace: str, share: float):
+    set_gauge(f"{NS}_namespace_weighted_share", share, namespace=namespace)
+
+
+def update_job_share(namespace: str, job: str, share: float):
+    set_gauge(f"{NS}_job_share", share, job_ns=namespace, job_id=job)
+
+
 def update_preemption_victims(count: int):
     set_gauge(PREEMPTION_VICTIMS, count)
 

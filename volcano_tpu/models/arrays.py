@@ -78,6 +78,20 @@ class ResourceIndex:
                 v[i] = quant
         return v * self.scales
 
+    def vec_capability(self, r: Resource) -> np.ndarray:
+        """Capability-style vector: dimensions the resource does not mention
+        are unbounded (the Infinity dimension default, resource_info.go:43)."""
+        v = np.full(self.r, np.inf, np.float32)
+        if r.milli_cpu > 0:
+            v[0] = r.milli_cpu * self.scales[0]
+        if r.memory > 0:
+            v[1] = r.memory * self.scales[1]
+        for name, quant in r.scalars.items():
+            i = self.index.get(name)
+            if i is not None:
+                v[i] = quant * self.scales[i]
+        return v
+
 
 NODE_BUCKET = 256
 TASK_BUCKET = 256
@@ -163,8 +177,10 @@ def _req_key(t: TaskInfo) -> tuple:
 class TaskBatch:
     """An ordered batch of pending tasks to place, with group compression.
 
-    ``order`` preserves the session's job/task ordering: the allocate scan
-    walks tasks in this order, so gang jobs occupy contiguous spans.
+    Jobs are regrouped so that each queue's jobs form one contiguous span
+    (first-appearance queue order = the session's queue ordering, used for
+    tie-breaking); the kernel then *dynamically* interleaves jobs across
+    queues by live share, so the encode order only decides ties.
     """
 
     rindex: ResourceIndex
@@ -172,6 +188,7 @@ class TaskBatch:
     t_pad: int
     g_pad: int
     j_pad: int
+    q_pad: int
     task_valid: np.ndarray           # [T] bool
     task_group: np.ndarray           # [T] i32
     task_job: np.ndarray             # [T] i32
@@ -182,13 +199,36 @@ class TaskBatch:
     job_ready_base: np.ndarray       # [J] i32 already-occupied task count
     job_task_start: np.ndarray       # [J] i32 span starts in scan order
     job_task_end: np.ndarray         # [J] i32
+    job_queue: np.ndarray            # [J] i32 queue index (padding: 0)
+    queue_names: List[str]           # first-appearance queue order
+    queue_job_start: np.ndarray      # [Q] i32 jobs grouped by queue
+    queue_njobs: np.ndarray          # [Q] i32
     group_keys: List[tuple] = field(default_factory=list)
+
+    @property
+    def job_n_tasks(self) -> np.ndarray:
+        return self.job_task_end - self.job_task_start
 
     @classmethod
     def build(cls, ordered_jobs: Sequence[Tuple[JobInfo, Sequence[TaskInfo]]],
               rindex: ResourceIndex,
               task_bucket: int = TASK_BUCKET,
               group_bucket: int = GROUP_BUCKET) -> "TaskBatch":
+        # regroup jobs by queue, stable: queue order = first appearance;
+        # zero-task jobs are excluded (each job consumes scan steps equal to
+        # its task count, so empty jobs would starve the T-step budget — the
+        # caller resolves their readiness from existing occupancy instead)
+        queue_names: List[str] = []
+        by_queue: Dict[str, list] = {}
+        for job, jtasks in ordered_jobs:
+            if not jtasks:
+                continue
+            qname = getattr(job, "queue", "") or ""
+            if qname not in by_queue:
+                by_queue[qname] = []
+                queue_names.append(qname)
+            by_queue[qname].append((job, jtasks))
+
         tasks: List[TaskInfo] = []
         task_group: List[int] = []
         task_job: List[int] = []
@@ -201,33 +241,42 @@ class TaskBatch:
         job_base: List[int] = []
         job_start: List[int] = []
         job_end: List[int] = []
+        job_queue: List[int] = []
+        queue_job_start: List[int] = []
+        queue_njobs: List[int] = []
 
-        for j_idx, (job, jtasks) in enumerate(ordered_jobs):
-            job_uids.append(job.uid)
-            job_min.append(job.min_available)
-            job_base.append(job.ready_task_num())
-            job_start.append(len(tasks))
-            for t in jtasks:
-                key = (j_idx, t.task_id, _req_key(t), _constraint_key(t))
-                g = group_ids.get(key)
-                if g is None:
-                    g = len(group_reqs)
-                    group_ids[key] = g
-                    group_reqs.append(rindex.vec(t.resreq))
-                    group_members.append([])
-                    group_keys.append(key)
-                group_members[g].append(len(tasks))
-                task_group.append(g)
-                task_job.append(j_idx)
-                tasks.append(t)
-            job_end.append(len(tasks))
+        for q_idx, qname in enumerate(queue_names):
+            queue_job_start.append(len(job_uids))
+            queue_njobs.append(len(by_queue[qname]))
+            for job, jtasks in by_queue[qname]:
+                j_idx = len(job_uids)
+                job_uids.append(job.uid)
+                job_min.append(job.min_available)
+                job_base.append(job.ready_task_num())
+                job_start.append(len(tasks))
+                job_queue.append(q_idx)
+                for t in jtasks:
+                    key = (j_idx, t.task_id, _req_key(t), _constraint_key(t))
+                    g = group_ids.get(key)
+                    if g is None:
+                        g = len(group_reqs)
+                        group_ids[key] = g
+                        group_reqs.append(rindex.vec(t.resreq))
+                        group_members.append([])
+                        group_keys.append(key)
+                    group_members[g].append(len(tasks))
+                    task_group.append(g)
+                    task_job.append(j_idx)
+                    tasks.append(t)
+                job_end.append(len(tasks))
 
         t_pad = bucket(len(tasks), task_bucket)
         g_pad = bucket(max(1, len(group_reqs)), group_bucket)
-        # one spare sentinel job absorbs padding tasks: its min_available of 0
-        # commits trivially so it can never roll back a real job's placements
+        # one spare sentinel job absorbs padding tasks: it is never selected
+        # (it belongs to no queue span) and its ready/kept stay False
         sentinel = len(job_uids)
         j_pad = bucket(len(job_uids) + 1, group_bucket)
+        q_pad = bucket(max(1, len(queue_names)), 8)
         r = rindex.r
 
         def pad1(a, n, dtype, fill=0):
@@ -242,6 +291,7 @@ class TaskBatch:
 
         return cls(
             rindex=rindex, tasks=tasks, t_pad=t_pad, g_pad=g_pad, j_pad=j_pad,
+            q_pad=q_pad,
             task_valid=pad1(np.ones(len(tasks), bool), t_pad, bool),
             task_group=pad1(task_group, t_pad, np.int32),
             task_job=pad1(task_job, t_pad, np.int32, fill=sentinel),
@@ -252,6 +302,10 @@ class TaskBatch:
             job_ready_base=pad1(job_base, j_pad, np.int32),
             job_task_start=pad1(job_start, j_pad, np.int32),
             job_task_end=pad1(job_end, j_pad, np.int32),
+            job_queue=pad1(job_queue, j_pad, np.int32),
+            queue_names=queue_names,
+            queue_job_start=pad1(queue_job_start, q_pad, np.int32),
+            queue_njobs=pad1(queue_njobs, q_pad, np.int32),
             group_keys=group_keys,
         )
 

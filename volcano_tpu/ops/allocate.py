@@ -1,19 +1,36 @@
-"""The gang-allocate kernel: one compiled scan places an entire ordered task
-batch with per-job all-or-nothing semantics.
+"""The gang-allocate kernel: one compiled scan runs the entire allocate loop
+— dynamic queue selection, fair-share budget gating, task placement, and
+per-job gang commit/rollback.
 
 TPU-native replacement for the allocate action's hot loop
-(pkg/scheduler/actions/allocate/allocate.go:201-270): per task -- predicates,
-scoring, best-node selection, allocate-or-pipeline -- and per job -- gang
-commit/rollback via the Statement (framework/statement.go:350-393). The
-sequential task-by-task semantics (each placement changes Idle for the next
-task) are preserved exactly by a lax.scan whose carry is the node state; the
-gang Statement becomes a checkpoint of that carry taken at each job boundary
-and restored when a job misses its minAvailable.
+(pkg/scheduler/actions/allocate/allocate.go:123-270): the reference picks,
+for every job, the currently least-loaded non-overused queue
+(QueueOrderFn/Overused re-evaluated after each job because plugin event
+handlers update shares live), pops that queue's next job, then places its
+tasks one by one — predicates, scoring, best-node argmax — and finally
+commits or discards the whole gang via the Statement
+(framework/statement.go:350-393).
 
-Outputs are per-task node assignments plus per-job committed flags; a task's
-assignment is real only if its job committed (Statement.Commit) -- otherwise
-it was rolled back in-carry (Statement.Discard) and later jobs observed the
-reverted node state, exactly like the reference's in-session semantics.
+All of that happens inside one ``lax.scan``:
+
+* the carry holds the node state (idle/future/task counts), the per-queue
+  allocation matrix, per-queue job cursors and the current job's progress;
+* each step places one task of the current job (argmax over all nodes of the
+  masked score, exactly the sequential semantics — every placement changes
+  ``idle`` for the next);
+* when the current job's span ends, the gang check either keeps the
+  placements or restores the checkpoint (Statement.Commit/Discard), charges
+  the queue's allocation, and the next (queue, job) pair is selected by
+  live dominant share over the queue budgets — the in-kernel equivalent of
+  the reference's re-sorted queue priority queue;
+* queues whose allocation exceeds their deserved budget (the proportion
+  plugin's Overused gate) stop being selected, at job granularity, exactly
+  like allocate.go:141-146.
+
+Known divergence from the reference: namespaces are not round-robined as a
+separate outer priority queue (allocate.go:123-139); queue selection is
+global with ties broken by encode order. Namespace-fair ordering only
+changes outcomes when multiple namespaces share a queue under contention.
 """
 
 from __future__ import annotations
@@ -27,31 +44,62 @@ import jax.numpy as jnp
 from .score import ScoreWeights, node_score
 
 NEG = jnp.float32(-1e30)
+BIG = jnp.float32(1e30)
 
 
 class AllocState(NamedTuple):
     idle: jax.Array          # [N, R]
     future: jax.Array        # [N, R] = idle + releasing - pipelined
     n_tasks: jax.Array       # [N] i32
-    ckpt_idle: jax.Array
+    ckpt_idle: jax.Array     # checkpoint for gang rollback
     ckpt_future: jax.Array
     ckpt_ntasks: jax.Array
-    cur_job: jax.Array       # i32
-    placed: jax.Array        # i32 tasks placed for cur_job so far (any kind)
-    placed_alloc: jax.Array  # i32 of those, placed on real idle
+    q_alloc: jax.Array       # [Q, R] live queue allocations
+    q_cursor: jax.Array      # [Q] i32 next-job offset per queue
+    cur_q: jax.Array         # i32 selected queue (-1 when done)
+    cur_job: jax.Array       # i32 selected job (-1 when done)
+    t_off: jax.Array         # i32 offset inside the current job's span
+    placed: jax.Array        # i32 tasks placed for cur_job (any kind)
+    placed_alloc: jax.Array  # i32 of those, on real idle
+    placed_res: jax.Array    # [R] resources placed for cur_job
     ready: jax.Array         # [J] bool JobReady   -> commit (bind)
     kept: jax.Array          # [J] bool JobPipelined -> keep session claims
 
 
+def queue_share(q_alloc: jax.Array, q_deserved: jax.Array) -> jax.Array:
+    """Dominant share per queue: max_r alloc/deserved with 0/0=0, x/0=1;
+    unbudgeted (+inf deserved) dims contribute 0 (proportion.go:196-209)."""
+    frac = jnp.where(
+        jnp.isinf(q_deserved), 0.0,
+        jnp.where(q_deserved == 0.0,
+                  jnp.where(q_alloc == 0.0, 0.0, 1.0),
+                  q_alloc / jnp.where(q_deserved == 0.0, 1.0, q_deserved)))
+    return jnp.max(frac, axis=-1)
+
+
+def queue_overused(q_alloc: jax.Array, q_deserved: jax.Array,
+                   eps: jax.Array) -> jax.Array:
+    """allocated > deserved in any dimension (proportion.go:238-250)."""
+    le = (q_alloc <= q_deserved + eps[None, :]) | jnp.isinf(q_deserved)
+    return ~jnp.all(le, axis=-1)
+
+
 @partial(jax.jit, static_argnames=("allow_pipeline",))
 def gang_allocate(task_group: jax.Array,      # [T] i32
-                  task_job: jax.Array,        # [T] i32 (padding -> sentinel job)
+                  task_job: jax.Array,        # [T] i32 (padding -> sentinel)
                   task_valid: jax.Array,      # [T] bool
                   group_req: jax.Array,       # [G, R] f32
                   group_mask: jax.Array,      # [G, N] bool static predicates
                   group_static_score: jax.Array,  # [G, N] f32
                   job_min_available: jax.Array,   # [J] i32
-                  job_ready_base: jax.Array,      # [J] i32 already-occupied count
+                  job_ready_base: jax.Array,      # [J] i32 occupied count
+                  job_task_start: jax.Array,      # [J] i32 span start
+                  job_n_tasks: jax.Array,         # [J] i32 span length
+                  job_queue: jax.Array,           # [J] i32
+                  queue_job_start: jax.Array,     # [Q] i32 jobs grouped/queue
+                  queue_njobs: jax.Array,         # [Q] i32
+                  queue_deserved: jax.Array,      # [Q, R] f32 (+inf ungated)
+                  queue_alloc0: jax.Array,        # [Q, R] f32
                   node_idle: jax.Array,       # [N, R] f32
                   node_future: jax.Array,     # [N, R] f32
                   node_alloc: jax.Array,      # [N, R] f32
@@ -60,73 +108,51 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
                   eps: jax.Array,             # [R] f32
                   weights: ScoreWeights,
                   allow_pipeline: bool = True):
-    """Returns (assign [T] i32 node-or--1, pipelined [T] bool,
-    ready [J] bool, kept [J] bool, final AllocState).
-
-    * ``ready[j]``: JobReady -- enough tasks on real idle resources; the
-      caller commits (binds) these placements.
-    * ``kept[j]``: JobPipelined -- ready only counting pipelined claims;
-      session state keeps the claims but nothing binds
-      (allocate.go:264-270, gang.go:141-152).
-    * neither: all of the job's placements were rolled back in-carry and
-      later jobs saw the restored node state (Statement.Discard).
-
-    The caller guarantees tasks are ordered so each job's tasks are
-    contiguous and padding tasks point at a sentinel job whose
-    min_available is 0.
-    """
+    """Returns (assign [T] node-or--1, pipelined [T] bool, ready [J] bool,
+    kept [J] bool, final AllocState)."""
     T = task_group.shape[0]
-
     J = job_min_available.shape[0]
+
+    def select(q_alloc, q_cursor):
+        """Next (queue, job): min live share among queues with jobs left and
+        budget headroom; ties by encode order."""
+        share = queue_share(q_alloc, queue_deserved)
+        eligible = (q_cursor < queue_njobs) & \
+            ~queue_overused(q_alloc, queue_deserved, eps)
+        q = jnp.argmin(jnp.where(eligible, share, BIG)).astype(jnp.int32)
+        ok = eligible[q]
+        job = queue_job_start[q] + q_cursor[q]
+        return jnp.where(ok, q, -1), jnp.where(ok, job, -1)
+
+    q0, j0 = select(queue_alloc0, jnp.zeros_like(queue_njobs))
     init = AllocState(
         idle=node_idle, future=node_future, n_tasks=node_ntasks,
         ckpt_idle=node_idle, ckpt_future=node_future, ckpt_ntasks=node_ntasks,
-        cur_job=task_job[0], placed=jnp.int32(0), placed_alloc=jnp.int32(0),
-        ready=jnp.zeros(J, bool), kept=jnp.zeros(J, bool),
-    )
+        q_alloc=queue_alloc0, q_cursor=jnp.zeros_like(queue_njobs),
+        cur_q=q0, cur_job=j0, t_off=jnp.int32(0),
+        placed=jnp.int32(0), placed_alloc=jnp.int32(0),
+        placed_res=jnp.zeros_like(eps),
+        ready=jnp.zeros(J, bool), kept=jnp.zeros(J, bool))
 
-    def finalize_job(state: AllocState, job: jax.Array):
-        """Gang check for `job`: JobReady commits; JobPipelined keeps; else
-        restore the checkpoint (Statement.Discard)."""
-        base = job_ready_base[job]
-        minavail = job_min_available[job]
-        is_ready = base + state.placed_alloc >= minavail
-        is_kept = base + state.placed >= minavail
-        keep = is_ready | is_kept
-        idle = jnp.where(keep, state.idle, state.ckpt_idle)
-        future = jnp.where(keep, state.future, state.ckpt_future)
-        n_tasks = jnp.where(keep, state.n_tasks, state.ckpt_ntasks)
-        ready = state.ready.at[job].set(is_ready)
-        kept = state.kept.at[job].set(is_kept)
-        return state._replace(idle=idle, future=future, n_tasks=n_tasks,
-                              ready=ready, kept=kept)
-
-    def step(state: AllocState, t):
-        g = task_group[t]
-        j = task_job[t]
-        valid = task_valid[t]
-
-        boundary = j != state.cur_job
-        finalized = finalize_job(state, state.cur_job)
-        state = jax.tree.map(
-            lambda a, b: jnp.where(boundary, a, b), finalized, state)
-        # new checkpoint at the boundary (post-rollback state)
-        state = state._replace(
-            ckpt_idle=jnp.where(boundary, state.idle, state.ckpt_idle),
-            ckpt_future=jnp.where(boundary, state.future, state.ckpt_future),
-            ckpt_ntasks=jnp.where(boundary, state.n_tasks, state.ckpt_ntasks),
-            placed=jnp.where(boundary, 0, state.placed),
-            placed_alloc=jnp.where(boundary, 0, state.placed_alloc),
-            cur_job=j,
-        )
+    def step(state: AllocState, _):
+        active = state.cur_job >= 0
+        job = jnp.maximum(state.cur_job, 0)
+        t_idx = jnp.clip(job_task_start[job] + state.t_off, 0, T - 1)
+        g = task_group[t_idx]
+        # guard zero-task jobs (they still consume a step, so callers must
+        # exclude them from the encoding to preserve the T-step budget)
+        valid = task_valid[t_idx] & active & \
+            (state.t_off < job_n_tasks[job])
 
         req = group_req[g]                       # [R]
         static_ok = group_mask[g]                # [N]
         pods_ok = (node_max_tasks == 0) | (state.n_tasks < node_max_tasks)
         base_ok = static_ok & pods_ok & valid
 
-        fits_idle = jnp.all(req[None, :] <= state.idle + eps[None, :], axis=-1) & base_ok
-        fits_future = jnp.all(req[None, :] <= state.future + eps[None, :], axis=-1) & base_ok
+        fits_idle = jnp.all(req[None, :] <= state.idle + eps[None, :],
+                            axis=-1) & base_ok
+        fits_future = jnp.all(req[None, :] <= state.future + eps[None, :],
+                              axis=-1) & base_ok
 
         score = node_score(req, state.idle, node_alloc, weights,
                            group_static_score[g])
@@ -138,24 +164,67 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
             cand = fits_idle
         sel = jnp.argmax(jnp.where(cand, score, NEG))
         placed_ok = jnp.any(cand)
-        pipelined = placed_ok & ~any_idle if allow_pipeline else jnp.bool_(False)
+        pipelined = placed_ok & ~any_idle if allow_pipeline \
+            else jnp.bool_(False)
 
-        dreq = jnp.where(placed_ok, req, 0.0)
         take_idle = placed_ok & ~pipelined
         idle = state.idle.at[sel].add(jnp.where(take_idle, -req, 0.0))
-        future = state.future.at[sel].add(-dreq)
+        future = state.future.at[sel].add(jnp.where(placed_ok, -req, 0.0))
         n_tasks = state.n_tasks.at[sel].add(jnp.where(placed_ok, 1, 0))
 
         state = state._replace(
             idle=idle, future=future, n_tasks=n_tasks,
+            t_off=state.t_off + jnp.where(active, 1, 0),
             placed=state.placed + placed_ok.astype(jnp.int32),
-            placed_alloc=state.placed_alloc + take_idle.astype(jnp.int32))
-        return state, (jnp.where(placed_ok, sel.astype(jnp.int32), -1), pipelined)
+            placed_alloc=state.placed_alloc + take_idle.astype(jnp.int32),
+            placed_res=state.placed_res + jnp.where(placed_ok, req, 0.0))
 
-    state, (assign, pipelined) = jax.lax.scan(step, init, jnp.arange(T))
-    state = finalize_job(state, state.cur_job)
+        # ---- job boundary: gang commit/rollback + queue charge + select
+        complete = active & (state.t_off >= job_n_tasks[job])
+        base = job_ready_base[job]
+        minavail = job_min_available[job]
+        is_ready = complete & (base + state.placed_alloc >= minavail)
+        is_kept = complete & (base + state.placed >= minavail)
+        keep = is_ready | is_kept
+        roll = complete & ~keep
 
-    # a task's placement survives only if its job was kept or committed
+        idle = jnp.where(roll, state.ckpt_idle, state.idle)
+        future = jnp.where(roll, state.ckpt_future, state.future)
+        n_tasks = jnp.where(roll, state.ckpt_ntasks, state.n_tasks)
+        q = jnp.maximum(state.cur_q, 0)
+        q_alloc = state.q_alloc.at[q].add(
+            jnp.where(keep, state.placed_res, 0.0))
+        q_cursor = state.q_cursor.at[q].add(jnp.where(complete, 1, 0))
+        ready = state.ready.at[job].set(is_ready | state.ready[job])
+        kept = state.kept.at[job].set(is_kept | state.kept[job])
+
+        nq, nj = select(q_alloc, q_cursor)
+        cur_q = jnp.where(complete, nq, state.cur_q)
+        cur_job = jnp.where(complete, nj, state.cur_job)
+
+        state = state._replace(
+            idle=idle, future=future, n_tasks=n_tasks,
+            ckpt_idle=jnp.where(complete, idle, state.ckpt_idle),
+            ckpt_future=jnp.where(complete, future, state.ckpt_future),
+            ckpt_ntasks=jnp.where(complete, n_tasks, state.ckpt_ntasks),
+            q_alloc=q_alloc, q_cursor=q_cursor,
+            cur_q=cur_q, cur_job=cur_job,
+            t_off=jnp.where(complete, 0, state.t_off),
+            placed=jnp.where(complete, 0, state.placed),
+            placed_alloc=jnp.where(complete, 0, state.placed_alloc),
+            placed_res=jnp.where(complete, 0.0, state.placed_res),
+            ready=ready, kept=kept)
+        emit_t = jnp.where(valid, t_idx, T)
+        emit_sel = jnp.where(placed_ok, sel.astype(jnp.int32), -1)
+        return state, (emit_t, emit_sel, pipelined)
+
+    state, (emit_t, emit_sel, emit_pipe) = jax.lax.scan(
+        step, init, None, length=T)
+
+    # scatter per-step placements back to task order (slot T absorbs no-ops)
+    assign = jnp.full(T + 1, -1, jnp.int32).at[emit_t].set(emit_sel)[:T]
+    pipelined = jnp.zeros(T + 1, bool).at[emit_t].set(emit_pipe)[:T]
+
     ok = (state.ready[task_job] | state.kept[task_job]) & task_valid
     assign = jnp.where(ok, assign, -1)
     pipelined = pipelined & ok

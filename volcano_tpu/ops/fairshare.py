@@ -1,0 +1,98 @@
+"""Fair-share kernels: proportion water-fill and dominant-resource shares.
+
+TPU-native replacements for the iterative fair-share math in
+pkg/scheduler/plugins/proportion/proportion.go:129-194 (weighted water-fill
+of per-queue ``deserved``) and pkg/scheduler/plugins/drf/drf.go:621-660
+(dominant-resource share). Both evaluate every queue/job at once over dense
+[Q,R]/[J,R] arrays; the water-fill's data-dependent fixed point runs under
+``lax.while_loop`` so the whole convergence loop is one compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+INF = jnp.float32(jnp.inf)
+
+
+class _WFState(NamedTuple):
+    deserved: jax.Array      # [Q, R]
+    met: jax.Array           # [Q] bool
+    remaining: jax.Array     # [R]
+    prev_remaining: jax.Array
+    first: jax.Array         # bool: no prev_remaining to compare yet
+
+
+@jax.jit
+def proportion_waterfill(weight: jax.Array,       # [Q] f32
+                         capability: jax.Array,   # [Q, R] f32, +inf = unset
+                         request: jax.Array,      # [Q, R] f32
+                         total: jax.Array,        # [R] f32
+                         ) -> Tuple[jax.Array, jax.Array]:
+    """Iterative weighted water-fill of per-queue deserved resources.
+
+    Mirrors proportion.go:129-194 pass-for-pass: each pass hands every
+    unmet queue ``remaining * w/total_w``; a queue whose deserved crosses its
+    capability is clamped to min(capability, request) and marked met; one
+    whose request is satisfied is clamped to its request and marked met;
+    otherwise deserved is dimension-clamped to the request. The pass's net
+    deserved growth is returned to ``remaining``; iteration ends when
+    remaining is empty, unchanged, or no unmet queue is left.
+
+    Returns (deserved [Q,R], met [Q]).
+    """
+    Q, R = request.shape
+    has_cap = jnp.any(jnp.isfinite(capability), axis=-1)       # [Q]
+
+    def cond(s: _WFState):
+        total_w = jnp.sum(jnp.where(s.met, 0.0, weight))
+        unchanged = jnp.all(s.remaining == s.prev_remaining) & ~s.first
+        empty = jnp.all(s.remaining <= 0.0)
+        return (total_w > 0) & ~empty & ~unchanged
+
+    def body(s: _WFState):
+        total_w = jnp.sum(jnp.where(s.met, 0.0, weight))
+        frac = jnp.where(s.met, 0.0, weight) / jnp.maximum(total_w, 1e-9)
+        grown = s.deserved + s.remaining[None, :] * frac[:, None]  # [Q, R]
+
+        over_cap = has_cap & ~jnp.all(grown <= capability, axis=-1)
+        req_met = jnp.all(request <= grown, axis=-1)
+
+        cap_clamped = jnp.minimum(jnp.minimum(grown, capability), request)
+        req_clamped = jnp.minimum(grown, request)
+
+        new_deserved = jnp.where(
+            over_cap[:, None], cap_clamped,
+            jnp.where(req_met[:, None], req_clamped,
+                      jnp.minimum(grown, request)))
+        new_deserved = jnp.where(s.met[:, None], s.deserved, new_deserved)
+        new_met = s.met | over_cap | req_met
+
+        delta = new_deserved - s.deserved                   # per-queue growth
+        remaining = s.remaining - jnp.sum(delta, axis=0)
+        return _WFState(new_deserved, new_met, remaining, s.remaining,
+                        jnp.bool_(False))
+
+    init = _WFState(jnp.zeros((Q, R), jnp.float32), jnp.zeros(Q, bool),
+                    total, total, jnp.bool_(True))
+    out = jax.lax.while_loop(cond, body, init)
+    return out.deserved, out.met
+
+
+@jax.jit
+def dominant_share(allocated: jax.Array,   # [..., R] f32
+                   total: jax.Array,       # [R] f32
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """share = max_r allocated_r/total_r with the reference's Share()
+    convention (0/0 = 0, x/0 = 1) — drf.go:621-646, helpers.go:47-60.
+
+    Returns (share [...], dominant dim index [...] i32).
+    """
+    zero_total = total == 0.0
+    frac = jnp.where(zero_total[..., :],
+                     jnp.where(allocated == 0.0, 0.0, 1.0),
+                     allocated / jnp.where(zero_total, 1.0, total))
+    return jnp.max(frac, axis=-1), jnp.argmax(frac, axis=-1).astype(jnp.int32)

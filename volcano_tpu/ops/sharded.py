@@ -13,9 +13,12 @@ This is the project's "sequence parallelism": the long axis (nodes, 10k+) is
 blockwise-decomposed across chips exactly like ring attention decomposes
 sequence — SURVEY.md §5.7.
 
-Semantics match ops/allocate.gang_allocate bit-for-bit (ties broken by the
-lowest global node index, which is also what argmax-over-concatenated-shards
-yields).
+Queue/job bookkeeping (dynamic queue selection by live share, fair-share
+budget gating, gang commit/rollback — see ops/allocate.py) is replicated:
+every chip runs the identical small-state math, so job selection needs no
+communication. Semantics match ops/allocate.gang_allocate bit-for-bit
+(ties broken by the lowest global node index, which is also what
+argmax-over-concatenated-shards yields).
 """
 
 from __future__ import annotations
@@ -25,15 +28,18 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+
 try:
     from jax import shard_map
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .allocate import queue_overused, queue_share
 from .score import ScoreWeights, node_score
 
 NEG = jnp.float32(-1e30)
+BIG = jnp.float32(1e30)
 
 
 class ShardState(NamedTuple):
@@ -43,15 +49,22 @@ class ShardState(NamedTuple):
     ckpt_idle: jax.Array
     ckpt_future: jax.Array
     ckpt_ntasks: jax.Array
-    cur_job: jax.Array       # i32 (replicated value, identical on all chips)
-    placed: jax.Array        # i32 replicated
-    placed_alloc: jax.Array  # i32 replicated
+    q_alloc: jax.Array       # [Q, R] replicated
+    q_cursor: jax.Array      # [Q] replicated
+    cur_q: jax.Array         # i32 replicated
+    cur_job: jax.Array       # i32 replicated
+    t_off: jax.Array
+    placed: jax.Array
+    placed_alloc: jax.Array
+    placed_res: jax.Array    # [R]
     ready: jax.Array         # [J] bool replicated
     kept: jax.Array          # [J] bool replicated
 
 
 def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
                   group_static_score, job_min_available, job_ready_base,
+                  job_task_start, job_n_tasks, job_queue, queue_job_start,
+                  queue_njobs, queue_deserved, queue_alloc0,
                   node_idle, node_future, node_alloc, node_ntasks,
                   node_max_tasks, eps, weights, allow_pipeline: bool,
                   axis: str):
@@ -62,51 +75,43 @@ def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
     shard = jax.lax.axis_index(axis)
     offset = shard * Nl
 
+    def select(q_alloc, q_cursor):
+        share = queue_share(q_alloc, queue_deserved)
+        eligible = (q_cursor < queue_njobs) & \
+            ~queue_overused(q_alloc, queue_deserved, eps)
+        q = jnp.argmin(jnp.where(eligible, share, BIG)).astype(jnp.int32)
+        ok = eligible[q]
+        job = queue_job_start[q] + q_cursor[q]
+        return jnp.where(ok, q, -1), jnp.where(ok, job, -1)
+
+    q0, j0 = select(queue_alloc0, jnp.zeros_like(queue_njobs))
     init = ShardState(
         idle=node_idle, future=node_future, n_tasks=node_ntasks,
         ckpt_idle=node_idle, ckpt_future=node_future, ckpt_ntasks=node_ntasks,
-        cur_job=task_job[0], placed=jnp.int32(0), placed_alloc=jnp.int32(0),
+        q_alloc=queue_alloc0, q_cursor=jnp.zeros_like(queue_njobs),
+        cur_q=q0, cur_job=j0, t_off=jnp.int32(0),
+        placed=jnp.int32(0), placed_alloc=jnp.int32(0),
+        placed_res=jnp.zeros_like(eps),
         ready=jnp.zeros(J, bool), kept=jnp.zeros(J, bool))
 
-    def finalize_job(state: ShardState, job):
-        # counters are replicated: every chip takes the same branch, so the
-        # gang commit/rollback (Statement semantics) needs no communication
-        base = job_ready_base[job]
-        minavail = job_min_available[job]
-        is_ready = base + state.placed_alloc >= minavail
-        is_kept = base + state.placed >= minavail
-        keep = is_ready | is_kept
-        return state._replace(
-            idle=jnp.where(keep, state.idle, state.ckpt_idle),
-            future=jnp.where(keep, state.future, state.ckpt_future),
-            n_tasks=jnp.where(keep, state.n_tasks, state.ckpt_ntasks),
-            ready=state.ready.at[job].set(is_ready),
-            kept=state.kept.at[job].set(is_kept))
-
-    def step(state: ShardState, t):
-        g = task_group[t]
-        j = task_job[t]
-        valid = task_valid[t]
-
-        boundary = j != state.cur_job
-        finalized = finalize_job(state, state.cur_job)
-        state = jax.tree.map(
-            lambda a, b: jnp.where(boundary, a, b), finalized, state)
-        state = state._replace(
-            ckpt_idle=jnp.where(boundary, state.idle, state.ckpt_idle),
-            ckpt_future=jnp.where(boundary, state.future, state.ckpt_future),
-            ckpt_ntasks=jnp.where(boundary, state.n_tasks, state.ckpt_ntasks),
-            placed=jnp.where(boundary, 0, state.placed),
-            placed_alloc=jnp.where(boundary, 0, state.placed_alloc),
-            cur_job=j)
+    def step(state: ShardState, _):
+        active = state.cur_job >= 0
+        job = jnp.maximum(state.cur_job, 0)
+        t_idx = jnp.clip(job_task_start[job] + state.t_off, 0, T - 1)
+        g = task_group[t_idx]
+        # guard zero-task jobs (see ops/allocate.py)
+        valid = task_valid[t_idx] & active & \
+            (state.t_off < job_n_tasks[job])
 
         req = group_req[g]
         static_ok = group_mask[g]                      # [Nl]
         pods_ok = (node_max_tasks == 0) | (state.n_tasks < node_max_tasks)
         base_ok = static_ok & pods_ok & valid
 
-        fits_idle = jnp.all(req[None, :] <= state.idle + eps[None, :], axis=-1) & base_ok
-        fits_future = jnp.all(req[None, :] <= state.future + eps[None, :], axis=-1) & base_ok
+        fits_idle = jnp.all(req[None, :] <= state.idle + eps[None, :],
+                            axis=-1) & base_ok
+        fits_future = jnp.all(req[None, :] <= state.future + eps[None, :],
+                              axis=-1) & base_ok
 
         score = node_score(req, state.idle, node_alloc, weights,
                            group_static_score[g])
@@ -130,28 +135,71 @@ def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
         winner = scores >= best_score
         sel_g = jnp.min(jnp.where(winner, gidxs, jnp.int32(2**30)))
         placed_ok = best_score > NEG * 0.5
-        pipelined = placed_ok & ~any_idle if allow_pipeline else jnp.bool_(False)
+        pipelined = placed_ok & ~any_idle if allow_pipeline \
+            else jnp.bool_(False)
 
         # owner-shard applies the placement to its local state
         is_owner = (sel_g >= offset) & (sel_g < offset + Nl)
         sel_l = jnp.clip(sel_g - offset, 0, Nl - 1)
         take_idle = placed_ok & ~pipelined
-        d_idle = jnp.where(is_owner & take_idle, -req, 0.0)
-        d_future = jnp.where(is_owner & placed_ok, -req, 0.0)
-        idle = state.idle.at[sel_l].add(d_idle)
-        future = state.future.at[sel_l].add(d_future)
+        idle = state.idle.at[sel_l].add(
+            jnp.where(is_owner & take_idle, -req, 0.0))
+        future = state.future.at[sel_l].add(
+            jnp.where(is_owner & placed_ok, -req, 0.0))
         n_tasks = state.n_tasks.at[sel_l].add(
             jnp.where(is_owner & placed_ok, 1, 0))
 
         state = state._replace(
             idle=idle, future=future, n_tasks=n_tasks,
+            t_off=state.t_off + jnp.where(active, 1, 0),
             placed=state.placed + placed_ok.astype(jnp.int32),
-            placed_alloc=state.placed_alloc + take_idle.astype(jnp.int32))
-        return state, (jnp.where(placed_ok, sel_g, -1), pipelined)
+            placed_alloc=state.placed_alloc + take_idle.astype(jnp.int32),
+            placed_res=state.placed_res + jnp.where(placed_ok, req, 0.0))
 
-    state, (assign, pipelined) = jax.lax.scan(step, init, jnp.arange(T))
-    state = finalize_job(state, state.cur_job)
+        # ---- job boundary (replicated math, no communication)
+        complete = active & (state.t_off >= job_n_tasks[job])
+        base = job_ready_base[job]
+        minavail = job_min_available[job]
+        is_ready = complete & (base + state.placed_alloc >= minavail)
+        is_kept = complete & (base + state.placed >= minavail)
+        keep = is_ready | is_kept
+        roll = complete & ~keep
 
+        idle = jnp.where(roll, state.ckpt_idle, state.idle)
+        future = jnp.where(roll, state.ckpt_future, state.future)
+        n_tasks = jnp.where(roll, state.ckpt_ntasks, state.n_tasks)
+        q = jnp.maximum(state.cur_q, 0)
+        q_alloc = state.q_alloc.at[q].add(
+            jnp.where(keep, state.placed_res, 0.0))
+        q_cursor = state.q_cursor.at[q].add(jnp.where(complete, 1, 0))
+        ready = state.ready.at[job].set(is_ready | state.ready[job])
+        kept = state.kept.at[job].set(is_kept | state.kept[job])
+
+        nq, nj = select(q_alloc, q_cursor)
+        cur_q = jnp.where(complete, nq, state.cur_q)
+        cur_job = jnp.where(complete, nj, state.cur_job)
+
+        state = state._replace(
+            idle=idle, future=future, n_tasks=n_tasks,
+            ckpt_idle=jnp.where(complete, idle, state.ckpt_idle),
+            ckpt_future=jnp.where(complete, future, state.ckpt_future),
+            ckpt_ntasks=jnp.where(complete, n_tasks, state.ckpt_ntasks),
+            q_alloc=q_alloc, q_cursor=q_cursor,
+            cur_q=cur_q, cur_job=cur_job,
+            t_off=jnp.where(complete, 0, state.t_off),
+            placed=jnp.where(complete, 0, state.placed),
+            placed_alloc=jnp.where(complete, 0, state.placed_alloc),
+            placed_res=jnp.where(complete, 0.0, state.placed_res),
+            ready=ready, kept=kept)
+        emit_t = jnp.where(valid, t_idx, T)
+        emit_sel = jnp.where(placed_ok, sel_g, -1)
+        return state, (emit_t, emit_sel, pipelined)
+
+    state, (emit_t, emit_sel, emit_pipe) = jax.lax.scan(
+        step, init, None, length=T)
+
+    assign = jnp.full(T + 1, -1, jnp.int32).at[emit_t].set(emit_sel)[:T]
+    pipelined = jnp.zeros(T + 1, bool).at[emit_t].set(emit_pipe)[:T]
     ok = (state.ready[task_job] | state.kept[task_job]) & task_valid
     assign = jnp.where(ok, assign, -1)
     pipelined = pipelined & ok
@@ -163,17 +211,16 @@ def make_sharded_gang_allocate(mesh: Mesh, axis: str = "nodes",
     """Build the jitted node-sharded gang-allocate for a device mesh.
 
     Node-axis inputs ([N,...] and [G,N]) must be padded so N divides the mesh
-    size. Returns fn(task_group, task_job, task_valid, group_req, group_mask,
-    group_static_score, job_min_available, job_ready_base, node_idle,
-    node_future, node_alloc, node_ntasks, node_max_tasks, eps, weights)
-    -> (assign [T] global node index, pipelined [T], ready [J], kept [J],
-        final node idle [N,R]).
+    size. Same argument order as ops.allocate.gang_allocate (minus the
+    weights keyword); returns (assign [T] global node index, pipelined [T],
+    ready [J], kept [J], final node idle [N,R]).
     """
     n = P(axis)               # [N] vectors
     nr = P(axis, None)        # [N, R]
     gn = P(None, axis)        # [G, N]
     rep = P()
-    in_specs = (rep, rep, rep, rep, gn, gn, rep, rep,
+    in_specs = (rep, rep, rep, rep, gn, gn, rep, rep, rep, rep, rep,
+                rep, rep, rep, rep,
                 nr, nr, nr, n, n, rep,
                 ScoreWeights(rep, rep, rep, rep, rep))
     out_specs = (rep, rep, rep, rep, nr)
@@ -188,21 +235,23 @@ def make_sharded_gang_allocate(mesh: Mesh, axis: str = "nodes",
 
 
 def shard_synth(mesh: Mesh, sa, axis: str = "nodes"):
-    """Device-put a SynthArrays set with node-axis sharding over ``mesh``."""
+    """Device-put a SynthArrays set with node-axis sharding over ``mesh``.
+    Returns the argument list for make_sharded_gang_allocate's fn, minus
+    weights."""
     n = NamedSharding(mesh, P(axis))
     nr = NamedSharding(mesh, P(axis, None))
     gn = NamedSharding(mesh, P(None, axis))
     rep = NamedSharding(mesh, P())
     put = jax.device_put
-    return dict(
-        task_group=put(sa.task_group, rep), task_job=put(sa.task_job, rep),
-        task_valid=put(sa.task_valid, rep), group_req=put(sa.group_req, rep),
-        group_mask=put(sa.group_mask, gn),
-        group_static_score=put(sa.group_static_score, gn),
-        job_min_available=put(sa.job_min_available, rep),
-        job_ready_base=put(sa.job_ready_base, rep),
-        node_idle=put(sa.node_idle, nr), node_future=put(sa.node_future, nr),
-        node_alloc=put(sa.node_alloc, nr),
-        node_ntasks=put(sa.node_ntasks, n),
-        node_max_tasks=put(sa.node_max_tasks, n),
-        eps=put(sa.eps, rep))
+    return [
+        put(sa.task_group, rep), put(sa.task_job, rep),
+        put(sa.task_valid, rep), put(sa.group_req, rep),
+        put(sa.group_mask, gn), put(sa.group_static_score, gn),
+        put(sa.job_min_available, rep), put(sa.job_ready_base, rep),
+        put(sa.job_task_start, rep), put(sa.job_n_tasks, rep),
+        put(sa.job_queue, rep), put(sa.queue_job_start, rep),
+        put(sa.queue_njobs, rep), put(sa.queue_deserved, rep),
+        put(sa.queue_alloc0, rep),
+        put(sa.node_idle, nr), put(sa.node_future, nr),
+        put(sa.node_alloc, nr), put(sa.node_ntasks, n),
+        put(sa.node_max_tasks, n), put(sa.eps, rep)]

@@ -2,7 +2,9 @@
 37-56). Importing this package registers all builders."""
 
 from . import binpack  # noqa: F401
+from . import drf  # noqa: F401
 from . import gang  # noqa: F401
+from . import proportion  # noqa: F401
 from . import nodeorder  # noqa: F401
 from . import predicates  # noqa: F401
 from . import priority  # noqa: F401

@@ -31,12 +31,32 @@ class SynthArrays:
     group_static_score: np.ndarray  # [G, N] f32
     job_min_available: np.ndarray   # [J] i32
     job_ready_base: np.ndarray      # [J] i32
+    job_task_start: np.ndarray      # [J] i32
+    job_n_tasks: np.ndarray         # [J] i32
+    job_queue: np.ndarray           # [J] i32
+    queue_job_start: np.ndarray     # [Q] i32
+    queue_njobs: np.ndarray         # [Q] i32
+    queue_deserved: np.ndarray      # [Q, R] f32
+    queue_alloc0: np.ndarray        # [Q, R] f32
     node_idle: np.ndarray       # [N, R] f32
     node_future: np.ndarray     # [N, R] f32
     node_alloc: np.ndarray      # [N, R] f32
     node_ntasks: np.ndarray     # [N] i32
     node_max_tasks: np.ndarray  # [N] i32
     eps: np.ndarray             # [R] f32
+
+    @property
+    def args(self) -> list:
+        """Positional argument list for ops.allocate.gang_allocate (weights
+        excluded)."""
+        return [self.task_group, self.task_job, self.task_valid,
+                self.group_req, self.group_mask, self.group_static_score,
+                self.job_min_available, self.job_ready_base,
+                self.job_task_start, self.job_n_tasks, self.job_queue,
+                self.queue_job_start, self.queue_njobs, self.queue_deserved,
+                self.queue_alloc0, self.node_idle, self.node_future,
+                self.node_alloc, self.node_ntasks, self.node_max_tasks,
+                self.eps]
 
     @property
     def shapes(self) -> str:
@@ -48,7 +68,7 @@ class SynthArrays:
 def synth_arrays(n_tasks: int, n_nodes: int, *, gang_size: int = 8,
                  n_racks: int = 32, r: int = 4, seed: int = 0,
                  utilization: float = 0.3, node_pad_to: Optional[int] = None,
-                 rack_affinity: bool = True) -> SynthArrays:
+                 rack_affinity: bool = True, n_queues: int = 1) -> SynthArrays:
     """A gang-heavy pending backlog over a partially utilized cluster.
 
     Nodes: 64-core/256GiB-shaped with uniform random pre-existing usage around
@@ -98,6 +118,34 @@ def synth_arrays(n_tasks: int, n_nodes: int, *, gang_size: int = 8,
     job_min_available = np.zeros(j_pad, np.int32)
     job_min_available[:n_jobs] = gang_size
     job_ready_base = np.zeros(j_pad, np.int32)
+    job_task_start = np.zeros(j_pad, np.int32)
+    job_task_start[:n_jobs] = np.arange(n_jobs) * gang_size
+    job_n_tasks = np.zeros(j_pad, np.int32)
+    job_n_tasks[:n_jobs] = gang_size
+
+    # queues: jobs striped round-robin then grouped contiguously per queue
+    q_pad = bucket(n_queues, 8)
+    job_queue = np.zeros(j_pad, np.int32)
+    job_queue[:n_jobs] = np.arange(n_jobs) % n_queues
+    order = np.argsort(job_queue[:n_jobs], kind="stable")
+    # regroup job spans so each queue's jobs are contiguous
+    if n_queues > 1:
+        # rebuild task arrays in regrouped job order
+        new_task_order = np.concatenate(
+            [np.arange(j * gang_size, (j + 1) * gang_size) for j in order])
+        task_group[:n_tasks] = task_group[:n_tasks][new_task_order]
+        remap = np.empty(n_jobs, np.int64)
+        remap[order] = np.arange(n_jobs)
+        task_job[:n_tasks] = remap[task_job[:n_tasks][new_task_order]]
+        job_queue[:n_jobs] = job_queue[:n_jobs][order]
+    queue_job_start = np.zeros(q_pad, np.int32)
+    queue_njobs = np.zeros(q_pad, np.int32)
+    for q in range(n_queues):
+        members = np.nonzero(job_queue[:n_jobs] == q)[0]
+        queue_job_start[q] = members[0] if len(members) else 0
+        queue_njobs[q] = len(members)
+    queue_deserved = np.full((q_pad, r), np.inf, np.float32)
+    queue_alloc0 = np.zeros((q_pad, r), np.float32)
 
     # static predicates: valid nodes only; static score: rack affinity
     group_mask = np.zeros((g_pad, n_pad), bool)
@@ -116,6 +164,10 @@ def synth_arrays(n_tasks: int, n_nodes: int, *, gang_size: int = 8,
         group_req=group_req, group_mask=group_mask,
         group_static_score=group_static_score,
         job_min_available=job_min_available, job_ready_base=job_ready_base,
+        job_task_start=job_task_start, job_n_tasks=job_n_tasks,
+        job_queue=job_queue, queue_job_start=queue_job_start,
+        queue_njobs=queue_njobs, queue_deserved=queue_deserved,
+        queue_alloc0=queue_alloc0,
         node_idle=idle, node_future=idle.copy(), node_alloc=cap,
         node_ntasks=node_ntasks, node_max_tasks=node_max_tasks, eps=eps)
 
