@@ -1,0 +1,138 @@
+"""preempt action tests (mirroring pkg/scheduler/actions/preempt/
+preempt_test.go): no preemption with idle headroom, no preemption when the
+preemptor job can't pipeline, single- and multi-victim preemption driven by
+priority classes."""
+
+from tests.harness import Harness
+from volcano_tpu.models import objects
+from volcano_tpu.models.objects import ObjectMeta, PodGroupPhase, PriorityClass
+from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                          build_pod_group, build_queue,
+                                          build_resource_list)
+
+CONF = """
+actions: "preempt"
+tiers:
+- plugins:
+  - name: conformance
+  - name: gang
+"""
+
+RL1 = build_resource_list("1", "1Gi")
+RL2 = build_resource_list("2", "2Gi")
+
+
+def pg(name, ns, queue, minm, **kw):
+    return build_pod_group(name, ns, queue, minm,
+                           phase=PodGroupPhase.INQUEUE, **kw)
+
+
+def classes():
+    return (PriorityClass(metadata=ObjectMeta(name="low-priority"), value=100),
+            PriorityClass(metadata=ObjectMeta(name="high-priority"),
+                          value=1000))
+
+
+def test_no_preempt_with_idle_headroom():
+    h = Harness(CONF)
+    h.add("queues", build_queue("q1"))
+    h.add("podgroups", pg("pg1", "c1", "q1", 3))
+    h.add("nodes", build_node("n1", build_resource_list("10", "10Gi")))
+    h.add("pods",
+          build_pod("c1", "preemptee1", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptee2", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptor1", "", "Pending", RL1, "pg1"))
+    h.run_actions("preempt").close_session()
+    assert len(h.evicts) == 0
+
+
+def test_no_preempt_when_only_pipelined():
+    # both jobs have minMember satisfied by running pods; nothing starves
+    h = Harness(CONF)
+    h.add("queues", build_queue("q1"))
+    h.add("podgroups", pg("pg1", "c1", "q1", 1), pg("pg2", "c1", "q1", 1))
+    h.add("nodes", build_node("n1", build_resource_list("3", "3Gi")))
+    h.add("pods",
+          build_pod("c1", "preemptee1", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptee2", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptee3", "n1", "Running", RL1, "pg2"),
+          build_pod("c1", "preemptor2", "", "Pending", RL1, "pg2"))
+    h.run_actions("preempt").close_session()
+    assert len(h.evicts) == 0
+
+
+def test_preempt_one_task_of_lower_priority_job():
+    h = Harness(CONF)
+    h.add("priorityclasses", *classes())
+    h.add("queues", build_queue("q1"))
+    h.add("podgroups",
+          pg("pg1", "c1", "q1", 1, priority_class="low-priority"),
+          pg("pg2", "c1", "q1", 1, priority_class="high-priority"))
+    h.add("nodes", build_node("n1", build_resource_list("2", "2Gi")))
+    h.add("pods",
+          build_pod("c1", "preemptee1", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptee2", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptor1", "", "Pending", RL1, "pg2"),
+          build_pod("c1", "preemptor2", "", "Pending", RL1, "pg2"))
+    h.run_actions("preempt").close_session()
+    assert len(h.evicts) == 1
+
+
+def test_preempt_enough_tasks_for_large_preemptor():
+    h = Harness(CONF)
+    h.add("priorityclasses", *classes())
+    h.add("queues", build_queue("q1"))
+    h.add("podgroups",
+          pg("pg1", "c1", "q1", 1, priority_class="low-priority"),
+          pg("pg2", "c1", "q1", 1, priority_class="high-priority"))
+    h.add("nodes", build_node("n1", build_resource_list("3", "3Gi")))
+    h.add("pods",
+          build_pod("c1", "preemptee1", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptee2", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptee3", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptor1", "", "Pending", RL2, "pg2"))
+    h.run_actions("preempt").close_session()
+    assert len(h.evicts) == 2
+
+
+def test_preemptor_pipelined_onto_victim_node():
+    """After eviction the preemptor is Pipelined in session state onto the
+    victims' node (stmt.Pipeline, preempt.go:257-262); the bind happens in a
+    later cycle once resources release."""
+    h = Harness(CONF)
+    h.add("priorityclasses", *classes())
+    h.add("queues", build_queue("q1"))
+    h.add("podgroups",
+          pg("pg1", "c1", "q1", 1, priority_class="low-priority"),
+          pg("pg2", "c1", "q1", 1, priority_class="high-priority"))
+    h.add("nodes", build_node("n1", build_resource_list("2", "2Gi")))
+    h.add("pods",
+          build_pod("c1", "preemptee1", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptee2", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptor1", "", "Pending", RL1, "pg2"))
+    ssn = h.open_session()
+    h.run_actions("preempt")
+    job2 = next(j for j in ssn.jobs.values() if "pg2" in j.uid or j.name == "pg2")
+    from volcano_tpu.models.job_info import TaskStatus
+    pipelined = job2.task_status_index.get(TaskStatus.Pipelined, {})
+    assert len(pipelined) == 1
+    assert next(iter(pipelined.values())).node_name == "n1"
+    h.close_session()
+    assert len(h.evicts) == 1
+
+
+def test_conformance_shields_critical_pods():
+    """kube-system pods are excluded from victim sets by the conformance
+    plugin (conformance.go:60-85)."""
+    h = Harness(CONF)
+    h.add("priorityclasses", *classes())
+    h.add("queues", build_queue("q1"))
+    h.add("podgroups",
+          pg("pg1", "kube-system", "q1", 1, priority_class="low-priority"),
+          pg("pg2", "c1", "q1", 1, priority_class="high-priority"))
+    h.add("nodes", build_node("n1", build_resource_list("1", "1Gi")))
+    h.add("pods",
+          build_pod("kube-system", "critical1", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptor1", "", "Pending", RL1, "pg2"))
+    h.run_actions("preempt").close_session()
+    assert len(h.evicts) == 0

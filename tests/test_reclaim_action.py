@@ -1,0 +1,80 @@
+"""reclaim action tests (mirroring pkg/scheduler/actions/reclaim/
+reclaim_test.go): a task of an underserved queue reclaims Running tasks
+from an overused queue; non-reclaimable queues are shielded."""
+
+from tests.harness import Harness
+from volcano_tpu.models.job_info import TaskStatus
+from volcano_tpu.models.objects import PodGroupPhase
+from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                          build_pod_group, build_queue,
+                                          build_resource_list)
+
+CONF = """
+actions: "reclaim"
+tiers:
+- plugins:
+  - name: conformance
+  - name: gang
+  - name: proportion
+"""
+
+RL1 = build_resource_list("1", "1Gi")
+
+
+def pg(name, ns, queue, minm, **kw):
+    return build_pod_group(name, ns, queue, minm,
+                           phase=PodGroupPhase.INQUEUE, **kw)
+
+
+def test_reclaim_from_overused_queue():
+    """q2's pending task reclaims one of q1's three running tasks: the node
+    is full, both queues weigh 1, so q1 (3/4 of the cluster) is above its
+    half deserved and q2 below (reclaim_test.go:40-116)."""
+    h = Harness(CONF)
+    h.add("queues", build_queue("q1", weight=1), build_queue("q2", weight=1))
+    h.add("podgroups", pg("pg1", "c1", "q1", 1), pg("pg2", "c1", "q2", 1))
+    h.add("nodes", build_node("n1", build_resource_list("3", "3Gi")))
+    h.add("pods",
+          build_pod("c1", "preemptee1", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptee2", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptee3", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptor1", "", "Pending", RL1, "pg2"))
+    ssn = h.open_session()
+    h.run_actions("reclaim")
+    # reclaimer is pipelined onto the node in session state
+    job2 = next(j for j in ssn.jobs.values() if j.name == "pg2")
+    pipelined = job2.task_status_index.get(TaskStatus.Pipelined, {})
+    assert len(pipelined) == 1
+    h.close_session()
+    assert len(h.evicts) == 1
+
+
+def test_no_reclaim_from_unreclaimable_queue():
+    h = Harness(CONF)
+    h.add("queues",
+          build_queue("q1", weight=1, reclaimable=False),
+          build_queue("q2", weight=1))
+    h.add("podgroups", pg("pg1", "c1", "q1", 1), pg("pg2", "c1", "q2", 1))
+    h.add("nodes", build_node("n1", build_resource_list("3", "3Gi")))
+    h.add("pods",
+          build_pod("c1", "preemptee1", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptee2", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptee3", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptor1", "", "Pending", RL1, "pg2"))
+    h.run_actions("reclaim").close_session()
+    assert len(h.evicts) == 0
+
+
+def test_no_reclaim_within_own_queue():
+    """Same-queue tasks are never reclaim victims (reclaim.go:131-141)."""
+    h = Harness(CONF)
+    h.add("queues", build_queue("q1", weight=1))
+    h.add("podgroups", pg("pg1", "c1", "q1", 1), pg("pg2", "c1", "q1", 1))
+    h.add("nodes", build_node("n1", build_resource_list("3", "3Gi")))
+    h.add("pods",
+          build_pod("c1", "preemptee1", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptee2", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptee3", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "preemptor1", "", "Pending", RL1, "pg2"))
+    h.run_actions("reclaim").close_session()
+    assert len(h.evicts) == 0

@@ -2,3 +2,5 @@
 Importing this package registers them."""
 
 from . import allocate  # noqa: F401
+from . import preempt  # noqa: F401
+from . import reclaim  # noqa: F401

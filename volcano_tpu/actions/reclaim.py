@@ -1,0 +1,152 @@
+"""reclaim: cross-queue reclamation for underserved queues.
+
+Mirrors pkg/scheduler/actions/reclaim/reclaim.go: queues popped by
+QueueOrder (skipping Overused ones), their jobs by JobOrder, one pending
+task per turn; candidate victims are Running tasks of *other* queues whose
+queue allows reclamation (reclaim.go:124-141), filtered by the Reclaimable
+plugin intersection. Unlike preempt, evictions are immediate session evicts
+(not statement-staged) and the stop condition is the summed victim
+resources alone covering the request (reclaim.go:149-181); the node choice
+and victim prefix come from the reclaim_prefix kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.plugin import Action
+from ..framework.registry import register_action
+from ..models.job_info import JobInfo, TaskInfo, TaskStatus
+from ..models.objects import PodGroupPhase
+from ..ops.preempt import reclaim_prefix
+
+
+class ReclaimAction(Action):
+    def name(self) -> str:
+        return "reclaim"
+
+    def execute(self, ssn) -> None:
+        queue_list = []
+        queue_seen = set()
+        preemptors_map: Dict[str, List[JobInfo]] = {}
+        preemptor_tasks: Dict[str, List[TaskInfo]] = {}
+
+        task_key = functools.cmp_to_key(
+            lambda a, b: -1 if ssn.task_order_fn(a, b) else 1)
+        job_key = functools.cmp_to_key(
+            lambda a, b: -1 if ssn.job_order_fn(a, b) else 1)
+        queue_key = functools.cmp_to_key(
+            lambda a, b: -1 if ssn.queue_order_fn(a, b) else 1)
+
+        for job in ssn.jobs.values():
+            if job.pod_group.status.phase == PodGroupPhase.PENDING:
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.passed:
+                continue
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                continue
+            if queue.uid not in queue_seen:
+                queue_seen.add(queue.uid)
+                queue_list.append(queue)
+            pending = list(job.task_status_index.get(
+                TaskStatus.Pending, {}).values())
+            if pending:
+                preemptors_map.setdefault(job.queue, []).append(job)
+                pending.sort(key=task_key)
+                preemptor_tasks[job.uid] = pending
+
+        # queue priority loop (reclaim.go:84-188): pop best queue each turn,
+        # re-pushing it after a task was attempted
+        while queue_list:
+            queue_list.sort(key=queue_key)
+            queue = queue_list.pop(0)
+            if ssn.overused(queue):
+                continue
+            jobs = preemptors_map.get(queue.name)
+            if not jobs:
+                continue
+            jobs.sort(key=job_key)
+            job = jobs.pop(0)
+            tasks = preemptor_tasks.get(job.uid)
+            if not tasks:
+                continue
+            task = tasks.pop(0)
+
+            assigned = self._reclaim(ssn, job, task)
+            if assigned:
+                jobs.append(job)
+            queue_list.append(queue)
+
+    # ------------------------------------------------------------------
+
+    def _reclaim(self, ssn, job: JobInfo, task: TaskInfo) -> bool:
+        """Place one reclaimer by evicting cross-queue victims
+        (reclaim.go:114-182)."""
+        narr, mask, _score = ssn.solver.task_feasibility(job, task)
+        rindex = ssn.solver.rindex
+
+        victims_by_node: List[List[TaskInfo]] = [[] for _ in narr.names]
+        vmax = 1
+        for i, name in enumerate(narr.names):
+            node = ssn.nodes.get(name)
+            if node is None or not mask[i]:
+                continue
+            reclaimees = []
+            for t in node.tasks.values():
+                if t.status != TaskStatus.Running:
+                    continue
+                victim_job = ssn.jobs.get(t.job)
+                if victim_job is None or victim_job.queue == job.queue:
+                    continue
+                victim_queue = ssn.queues.get(victim_job.queue)
+                if victim_queue is None or not victim_queue.reclaimable():
+                    continue
+                reclaimees.append(t.clone())  # reclaim.go:138-140
+            if not reclaimees:
+                continue
+            victims = ssn.reclaimable(task, reclaimees)
+            victims_by_node[i] = victims
+            vmax = max(vmax, len(victims))
+
+        n_pad = narr.idle.shape[0]
+        victim_res = np.zeros((n_pad, vmax, rindex.r), np.float32)
+        victim_valid = np.zeros((n_pad, vmax), bool)
+        for i, victims in enumerate(victims_by_node):
+            for v, t in enumerate(victims):
+                victim_res[i, v] = rindex.vec(t.resreq)
+                victim_valid[i, v] = True
+
+        req = rindex.vec(task.init_resreq)
+        feasible, n_evict, covered = reclaim_prefix(
+            jnp.asarray(req), jnp.asarray(mask),
+            jnp.asarray(narr.future_idle), jnp.asarray(victim_res),
+            jnp.asarray(victim_valid), jnp.asarray(rindex.eps))
+        feasible = np.asarray(feasible)
+        n_evict = np.asarray(n_evict)
+        covered = np.asarray(covered)
+
+        # first feasible node in order; evictions are immediate and stick
+        # even when coverage fails (ssn.Evict, reclaim.go:156-166)
+        for i in np.flatnonzero(feasible):
+            for victim in victims_by_node[i][:int(n_evict[i])]:
+                try:
+                    ssn.evict(victim, "reclaim")
+                except KeyError:
+                    continue
+            if covered[i]:
+                try:
+                    ssn.pipeline(task, narr.names[i])
+                except KeyError:
+                    return False
+                return True
+        return False
+
+
+register_action(ReclaimAction())

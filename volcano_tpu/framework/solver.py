@@ -129,10 +129,10 @@ class BatchSolver:
                         break
         return mask
 
-    def place(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]],
-              allow_pipeline: bool = True) -> PlacementResult:
-        """Run the gang-allocate kernel for the ordered job/task batch against
-        the session's *current* node state."""
+    def _build_context(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]]):
+        """Snapshot the session's current node state and compute the static
+        predicate mask + static score for the batch: (narr, batch, gmask,
+        static_score)."""
         ssn = self.ssn
         narr = NodeArrays.build(ssn.nodes, [n.name for n in ssn.node_list],
                                 self.rindex)
@@ -166,6 +166,32 @@ class BatchSolver:
         static_score = jnp.zeros((batch.g_pad, narr.n_pad), jnp.float32)
         for fn in self.static_score_fns:
             static_score = static_score + jnp.asarray(fn(batch, narr, feats))
+        return narr, batch, gmask, static_score
+
+    def task_feasibility(self, job: JobInfo, task: TaskInfo):
+        """Predicate mask + score over all nodes for a single task against
+        the session's current node state (the PredicateNodes +
+        PrioritizeNodes pair used by preempt/reclaim, preempt.go:202-206).
+
+        Returns (narr, mask [N_pad] np.bool, score [N_pad] np.ndarray).
+        """
+        from ..ops.score import node_score
+        narr, batch, gmask, static_score = self._build_context([(job, [task])])
+        g = int(batch.task_group[0])
+        req = jnp.asarray(batch.group_req[g])
+        score = node_score(req, jnp.asarray(narr.idle),
+                           jnp.asarray(narr.allocatable),
+                           self.score_weights(), static_score[g])
+        pods_ok = (narr.max_tasks == 0) | (narr.n_tasks < narr.max_tasks)
+        mask = np.asarray(gmask[g]) & pods_ok
+        return narr, mask, np.asarray(score)
+
+    def place(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]],
+              allow_pipeline: bool = True) -> PlacementResult:
+        """Run the gang-allocate kernel for the ordered job/task batch against
+        the session's *current* node state."""
+        narr, batch, gmask, static_score = self._build_context(ordered_jobs)
+        eps = jnp.asarray(self.rindex.eps)
 
         # queue fair-share budgets (live Overused gate inside the scan)
         q_deserved = np.full((batch.q_pad, self.rindex.r), np.inf, np.float32)

@@ -2,6 +2,7 @@
 37-56). Importing this package registers all builders."""
 
 from . import binpack  # noqa: F401
+from . import conformance  # noqa: F401
 from . import drf  # noqa: F401
 from . import gang  # noqa: F401
 from . import proportion  # noqa: F401
