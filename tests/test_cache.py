@@ -191,3 +191,28 @@ class TestBindEvict:
         task = next(iter(cache.jobs["ns1/pg1"].tasks.values()))
         cache.bind(task, "n1")
         assert cache.binder.binds == {"ns1/p1": "n1"}
+
+
+def test_run_after_objects_created_replays_in_dependency_order():
+    """Objects created before cache.run() must be fully ingested: the pods
+    watch registers after nodes/podgroups/queues so replayed running pods
+    find their node (informer list+watch semantics)."""
+    from volcano_tpu.utils.test_utils import (FakeBinder, FakeEvictor,
+                                              FakeStatusUpdater, build_node,
+                                              build_pod, build_pod_group,
+                                              build_queue,
+                                              build_resource_list)
+    store = ObjectStore()
+    store.create("queues", build_queue("q1"))
+    store.create("nodes", build_node("n1", build_resource_list("4", "4Gi")))
+    store.create("podgroups", build_pod_group("pg1", "c1", "q1", 1,
+                                              phase="Inqueue"))
+    store.create("pods", build_pod("c1", "p1", "n1", "Running",
+                                   build_resource_list("1", "1Gi"), "pg1"))
+    cache = SchedulerCache(store, binder=FakeBinder(store),
+                           evictor=FakeEvictor(store),
+                           status_updater=FakeStatusUpdater())
+    cache.run()
+    snap = cache.snapshot()
+    assert len(snap.nodes["n1"].tasks) == 1
+    assert snap.nodes["n1"].idle.get("cpu") == 3000.0

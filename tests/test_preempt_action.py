@@ -4,7 +4,6 @@ preemptor job can't pipeline, single- and multi-victim preemption driven by
 priority classes."""
 
 from tests.harness import Harness
-from volcano_tpu.models import objects
 from volcano_tpu.models.objects import ObjectMeta, PodGroupPhase, PriorityClass
 from volcano_tpu.utils.test_utils import (build_node, build_pod,
                                           build_pod_group, build_queue,

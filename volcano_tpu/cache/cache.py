@@ -87,15 +87,17 @@ class SchedulerCache(EventHandlersMixin):
                         pass  # e.g. pod bound to a node we haven't seen yet
             return wrapper
 
+        # nodes/podgroups/queues before pods: replayed pods reference them
+        # (a pod bound to an unknown node would be silently dropped)
         w = []
-        w.append(s.watch("pods", locked(self.add_pod), locked(self.update_pod),
-                         locked(self.delete_pod), filter_fn=self._responsible_for))
         w.append(s.watch("nodes", locked(self.add_node), locked(self.update_node),
                          locked(self.delete_node)))
         w.append(s.watch("podgroups", locked(self.add_pod_group),
                          locked(self.update_pod_group), locked(self.delete_pod_group)))
         w.append(s.watch("queues", locked(self.add_queue), locked(self.update_queue),
                          locked(self.delete_queue)))
+        w.append(s.watch("pods", locked(self.add_pod), locked(self.update_pod),
+                         locked(self.delete_pod), filter_fn=self._responsible_for))
         w.append(s.watch("priorityclasses", locked(self.add_priority_class),
                          locked(self.update_priority_class),
                          locked(self.delete_priority_class)))

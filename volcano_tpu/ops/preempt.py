@@ -16,8 +16,6 @@ victim set plus the base availability covers the request.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 
@@ -95,11 +93,3 @@ def reclaim_prefix(req: jax.Array,          # [R]
                        axis=-1)
     feasible = node_ok & (n_valid > 0) & validate
     return feasible, jnp.where(feasible, n_evict, 0), any_k & feasible
-
-
-@jax.jit
-def pick_first_node(feasible: jax.Array):
-    """Lowest-index feasible node or -1 (reclaim's deterministic stand-in
-    for the reference's unordered map iteration, reclaim.go:115)."""
-    best = jnp.argmax(feasible).astype(jnp.int32)
-    return jnp.where(jnp.any(feasible), best, -1)
