@@ -1,0 +1,129 @@
+"""enqueue + backfill action tests, with the overcommit and sla
+JobEnqueueable voters (mirroring pkg/scheduler/actions/enqueue +
+plugins/overcommit + plugins/sla behaviors)."""
+
+import time
+
+from tests.harness import Harness
+from volcano_tpu.models.job_info import TaskStatus
+from volcano_tpu.models.objects import PodGroupPhase
+from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                          build_pod_group, build_queue,
+                                          build_resource_list)
+
+CONF = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: overcommit
+  - name: predicates
+  - name: nodeorder
+"""
+
+RL1 = build_resource_list("1", "1Gi")
+
+
+def test_enqueue_admits_within_overcommit_headroom():
+    """A Pending podgroup whose MinResources fit idle x factor advances to
+    Inqueue and schedules the same cycle."""
+    h = Harness(CONF)
+    h.add("queues", build_queue("q1"))
+    pg = build_pod_group("pg1", "c1", "q1", 1, phase=PodGroupPhase.PENDING)
+    pg.spec.min_resources = {"cpu": "1", "memory": "1Gi"}
+    h.add("podgroups", pg)
+    h.add("nodes", build_node("n1", build_resource_list("4", "4Gi")))
+    h.add("pods", build_pod("c1", "p1", "", "Pending", RL1, "pg1"))
+    ssn = h.open_session()
+    h.run_actions("enqueue")
+    job = next(iter(ssn.jobs.values()))
+    assert job.pod_group.status.phase == PodGroupPhase.INQUEUE
+    h.run_actions("allocate").close_session()
+    assert len(h.binds) == 1
+
+
+def test_enqueue_rejects_beyond_overcommit_headroom():
+    """MinResources exceeding total x 1.2 keeps the podgroup Pending
+    (overcommit.go:99-117)."""
+    h = Harness(CONF)
+    h.add("queues", build_queue("q1"))
+    pg = build_pod_group("pg1", "c1", "q1", 1, phase=PodGroupPhase.PENDING)
+    pg.spec.min_resources = {"cpu": "40", "memory": "1Gi"}
+    h.add("podgroups", pg)
+    h.add("nodes", build_node("n1", build_resource_list("4", "4Gi")))
+    h.add("pods", build_pod("c1", "p1", "", "Pending",
+                            build_resource_list("40", "1Gi"), "pg1"))
+    ssn = h.open_session()
+    h.run_actions("enqueue")
+    job = next(iter(ssn.jobs.values()))
+    assert job.pod_group.status.phase == PodGroupPhase.PENDING
+    h.close_session()
+    assert len(h.binds) == 0
+
+
+def test_enqueue_without_min_resources_always_admits():
+    h = Harness(CONF)
+    h.add("queues", build_queue("q1"))
+    h.add("podgroups",
+          build_pod_group("pg1", "c1", "q1", 1, phase=PodGroupPhase.PENDING))
+    h.add("nodes", build_node("n1", build_resource_list("4", "4Gi")))
+    h.add("pods", build_pod("c1", "p1", "", "Pending", RL1, "pg1"))
+    ssn = h.open_session()
+    h.run_actions("enqueue")
+    job = next(iter(ssn.jobs.values()))
+    assert job.pod_group.status.phase == PodGroupPhase.INQUEUE
+    h.close_session()
+
+
+def test_sla_force_permits_starved_job():
+    """A job past its sla-waiting-time is enqueued even when overcommit
+    rejects it (sla permit in an earlier tier wins)."""
+    conf = """
+actions: "enqueue"
+tiers:
+- plugins:
+  - name: sla
+    arguments:
+      sla-waiting-time: 1ms
+- plugins:
+  - name: overcommit
+"""
+    h = Harness(conf)
+    h.add("queues", build_queue("q1"))
+    pg = build_pod_group("pg1", "c1", "q1", 1, phase=PodGroupPhase.PENDING)
+    pg.spec.min_resources = {"cpu": "40", "memory": "1Gi"}  # over headroom
+    h.add("podgroups", pg)
+    h.add("nodes", build_node("n1", build_resource_list("4", "4Gi")))
+    h.add("pods", build_pod("c1", "p1", "", "Pending",
+                            build_resource_list("40", "1Gi"), "pg1"))
+    time.sleep(0.01)  # age past the 1ms SLA
+    ssn = h.open_session()
+    h.run_actions("enqueue")
+    job = next(iter(ssn.jobs.values()))
+    assert job.pod_group.status.phase == PodGroupPhase.INQUEUE
+    h.close_session()
+
+
+def test_backfill_places_best_effort_tasks():
+    """Zero-request tasks land on a predicate-passing node even with zero
+    idle resources (backfill.go:40-90)."""
+    conf = """
+actions: "backfill"
+tiers:
+- plugins:
+  - name: gang
+  - name: predicates
+"""
+    h = Harness(conf)
+    h.add("queues", build_queue("q1"))
+    h.add("podgroups",
+          build_pod_group("pg1", "c1", "q1", 1, phase=PodGroupPhase.INQUEUE),
+          build_pod_group("pg2", "c1", "q1", 1, phase=PodGroupPhase.INQUEUE))
+    h.add("nodes", build_node("n1", build_resource_list("1", "1Gi")))
+    h.add("pods",
+          build_pod("c1", "full", "n1", "Running", RL1, "pg1"),
+          build_pod("c1", "be1", "", "Pending", {}, "pg2"))
+    h.run_actions("backfill").close_session()
+    assert h.binds == {"c1/be1": "n1"}

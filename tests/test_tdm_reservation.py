@@ -1,0 +1,176 @@
+"""tdm plugin + elect/reserve/reservation tests (mirroring pkg/scheduler/
+plugins/tdm/tdm_test.go behaviors and the reservation flow)."""
+
+import time
+
+from tests.harness import Harness
+from volcano_tpu.models.job_info import TaskStatus
+from volcano_tpu.models.objects import (PREEMPTABLE_KEY, PodGroupPhase,
+                                        REVOCABLE_ZONE_KEY,
+                                        REVOCABLE_ZONE_LABEL)
+from volcano_tpu.utils.reservation import RESERVATION
+from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                          build_pod_group, build_queue,
+                                          build_resource_list)
+
+RL1 = build_resource_list("1", "1Gi")
+
+
+def _zone_window(active: bool) -> str:
+    lt = time.localtime()
+    now_min = lt.tm_hour * 60 + lt.tm_min
+    if active:
+        start, end = max(0, now_min - 60), min(23 * 60 + 59, now_min + 60)
+    else:
+        start, end = (now_min + 120) % (24 * 60), (now_min + 180) % (24 * 60)
+        if start >= end:
+            start, end = 1, 2  # degenerate inactive window
+    return f"{start // 60:02d}:{start % 60:02d}-{end // 60:02d}:{end % 60:02d}"
+
+
+def tdm_conf(active: bool) -> str:
+    return f"""
+actions: "allocate, preempt"
+tiers:
+- plugins:
+  - name: gang
+  - name: tdm
+    arguments:
+      tdm.revocable-zone.rz1: {_zone_window(active)}
+      tdm.evict.period: 1ms
+- plugins:
+  - name: predicates
+  - name: nodeorder
+"""
+
+
+def revocable_node(name):
+    return build_node(name, build_resource_list("4", "4Gi"),
+                      labels={REVOCABLE_ZONE_LABEL: "rz1"})
+
+
+def revocable_pod(ns, name, nodename, phase, pg):
+    p = build_pod(ns, name, nodename, phase, RL1, pg, preemptable=True)
+    p.metadata.annotations[REVOCABLE_ZONE_KEY] = "rz1"
+    return p
+
+
+def test_tdm_blocks_plain_tasks_from_revocable_nodes():
+    """Inside the window, a task without a revocable-zone annotation cannot
+    land on a revocable node (tdm.go:146-167)."""
+    h = Harness(tdm_conf(active=True))
+    h.add("queues", build_queue("q1"))
+    h.add("podgroups",
+          build_pod_group("pg1", "c1", "q1", 1, phase=PodGroupPhase.INQUEUE))
+    h.add("nodes", revocable_node("n1"))
+    h.add("pods", build_pod("c1", "plain", "", "Pending", RL1, "pg1"))
+    h.run_actions("allocate").close_session()
+    assert len(h.binds) == 0
+
+
+def test_tdm_admits_revocable_tasks_inside_window():
+    h = Harness(tdm_conf(active=True))
+    h.add("queues", build_queue("q1"))
+    h.add("podgroups",
+          build_pod_group("pg1", "c1", "q1", 1, phase=PodGroupPhase.INQUEUE))
+    h.add("nodes", revocable_node("n1"))
+    h.add("pods", revocable_pod("c1", "rev1", "", "Pending", "pg1"))
+    h.run_actions("allocate").close_session()
+    assert h.binds == {"c1/rev1": "n1"}
+
+
+def test_tdm_drains_revocable_nodes_outside_window():
+    """Outside the window, VictimTasks (run by preempt) evicts preemptable
+    pods from the zone's nodes, budget-capped per job per cycle
+    (tdm.go:232-260,305-334)."""
+    import volcano_tpu.plugins.tdm as tdm_mod
+    tdm_mod._last_evict_at = 0.0
+    h = Harness(tdm_conf(active=False))
+    h.add("queues", build_queue("q1"))
+    h.add("podgroups",
+          build_pod_group("pg1", "c1", "q1", 1, phase=PodGroupPhase.INQUEUE))
+    h.add("nodes", revocable_node("n1"))
+    h.add("pods",
+          revocable_pod("c1", "rev1", "n1", "Running", "pg1"),
+          revocable_pod("c1", "rev2", "n1", "Running", "pg1"))
+    h.run_actions("preempt").close_session()
+    # default disruption budget evicts 1 pod per job per cycle
+    assert len(h.evicts) == 1
+
+
+def test_elect_reserve_lock_and_release():
+    """elect picks the pending job; reserve locks the max-idle node; once
+    the target schedules, the reservation resets (elect.go + reserve.go +
+    reservation.go)."""
+    conf = """
+actions: "elect, allocate, reserve"
+tiers:
+- plugins:
+  - name: gang
+  - name: reservation
+- plugins:
+  - name: predicates
+  - name: nodeorder
+"""
+    RESERVATION.reset()
+    h = Harness(conf)
+    h.add("queues", build_queue("q1"))
+    h.add("podgroups",
+          build_pod_group("pg1", "c1", "q1", 1, phase=PodGroupPhase.PENDING))
+    h.add("nodes", build_node("n1", build_resource_list("4", "4Gi")),
+          build_node("n2", build_resource_list("2", "2Gi")))
+    h.add("pods", build_pod("c1", "p1", "", "Pending", RL1, "pg1"))
+    h.run_actions("elect", "allocate", "reserve").close_session()
+    # pending-phase job cannot allocate; it became the target and locked
+    # the biggest node
+    assert RESERVATION.target_job is not None
+    assert "n1" in RESERVATION.locked_nodes
+
+    # next cycle: podgroup now inqueue -> allocate binds it (target job is
+    # exempt from the lock) and reserve releases the reservation
+    job_uid = RESERVATION.target_job.uid
+    pg_obj = h.store.get("podgroups", "pg1", "c1")
+    pg_obj.status.phase = PodGroupPhase.INQUEUE
+    h.store.update("podgroups", pg_obj)
+    h.open_session()
+    h.run_actions("elect", "allocate", "reserve").close_session()
+    assert len(h.binds) == 1
+    assert RESERVATION.target_job is None
+    assert not RESERVATION.locked_nodes
+
+
+def test_allocate_exempts_target_job_from_locked_nodes():
+    """The reservation target may use its locked nodes; other jobs see them
+    masked out of the placement kernel."""
+    conf = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+  - name: reservation
+- plugins:
+  - name: predicates
+  - name: nodeorder
+"""
+    RESERVATION.reset()
+    h = Harness(conf)
+    h.add("queues", build_queue("q1"))
+    h.add("podgroups",
+          build_pod_group("pgT", "c1", "q1", 1, phase=PodGroupPhase.INQUEUE),
+          build_pod_group("pgO", "c1", "q1", 1, phase=PodGroupPhase.INQUEUE))
+    h.add("nodes", build_node("n1", build_resource_list("4", "4Gi")),
+          build_node("n2", build_resource_list("1", "1Gi")))
+    h.add("pods",
+          build_pod("c1", "tgt", "", "Pending",
+                    build_resource_list("3", "3Gi"), "pgT"),
+          build_pod("c1", "other", "", "Pending", RL1, "pgO"))
+    ssn = h.open_session()
+    RESERVATION.target_job = next(j for j in ssn.jobs.values()
+                                  if j.name == "pgT")
+    RESERVATION.locked_nodes["n1"] = None
+    try:
+        h.run_actions("allocate").close_session()
+        assert h.binds.get("c1/tgt") == "n1"
+        assert h.binds.get("c1/other") == "n2"
+    finally:
+        RESERVATION.reset()

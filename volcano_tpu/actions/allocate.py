@@ -84,6 +84,35 @@ class AllocateAction(Action):
     # -- main --------------------------------------------------------------
 
     def _execute(self, ssn) -> None:
+        # reservation-locked nodes are masked for every job except the
+        # reservation target they are held for (allocate.go:98-107; the
+        # exemption realizes the reservation design's intent)
+        from ..utils.reservation import RESERVATION
+        if RESERVATION.target_job is not None and RESERVATION.locked_nodes:
+            import numpy as np
+            locked = set(RESERVATION.locked_nodes)
+            target_uid = RESERVATION.target_job.uid
+
+            def locked_mask(batch, narr, feats):
+                node_open = np.array([name not in locked
+                                      for name in narr.names] +
+                                     [True] * (narr.idle.shape[0]
+                                               - len(narr.names)))
+                mask = np.ones((batch.g_pad, narr.idle.shape[0]), bool)
+                for g, members in enumerate(batch.group_members):
+                    if batch.tasks[members[0]].job != target_uid:
+                        mask[g] &= node_open
+                return mask
+
+            ssn.solver.add_mask_fn(locked_mask)
+            try:
+                self._execute_inner(ssn)
+            finally:
+                ssn.solver.mask_fns.remove(locked_mask)
+        else:
+            self._execute_inner(ssn)
+
+    def _execute_inner(self, ssn) -> None:
         ordered_jobs = self._ordered_jobs(ssn)
         if not ordered_jobs:
             return

@@ -1,0 +1,54 @@
+"""backfill: place zero-request (BestEffort) tasks.
+
+Mirrors pkg/scheduler/actions/backfill/backfill.go:40-90: every Pending
+task with an empty InitResreq is bound to the first node passing
+predicates; resource fit is irrelevant by construction. Feasibility over
+all nodes comes from one solver mask evaluation per task.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.plugin import Action
+from ..framework.registry import register_action
+from ..models.job_info import TaskStatus
+from ..models.objects import PodGroupPhase
+from ..models.unschedule_info import FitErrors
+
+
+class BackfillAction(Action):
+    def name(self) -> str:
+        return "backfill"
+
+    def execute(self, ssn) -> None:
+        for job in list(ssn.jobs.values()):
+            if job.pod_group.status.phase == PodGroupPhase.PENDING:
+                continue
+            vr = ssn.job_valid(job)
+            if vr is not None and not vr.passed:
+                continue
+            for task in list(job.task_status_index.get(
+                    TaskStatus.Pending, {}).values()):
+                if not task.init_resreq.is_empty():
+                    continue
+                narr, mask, _score = ssn.solver.task_feasibility(job, task)
+                allocated = False
+                for i in np.flatnonzero(mask[:len(narr.names)]):
+                    node = ssn.nodes.get(narr.names[int(i)])
+                    if node is None:
+                        continue
+                    try:
+                        ssn.allocate(task, node)
+                    except (KeyError, RuntimeError):
+                        continue
+                    allocated = True
+                    break
+                if not allocated:
+                    fe = FitErrors()
+                    fe.set_error("no node passed predicates for "
+                                 "best-effort task")
+                    job.nodes_fit_errors[task.uid] = fe
+
+
+register_action(BackfillAction())

@@ -195,7 +195,7 @@ class PreemptAction(Action):
         stmt = Statement(ssn)
         for victim in victims:
             try:
-                stmt.evict(victim, "evict")
+                stmt.evict(victim.clone(), "evict")  # preempt.go:277
             except KeyError:
                 continue
         stmt.commit()

@@ -156,7 +156,11 @@ class JobInfo:
         self.total_request: Resource = Resource()
         self.creation_timestamp: float = 0.0
         self.pod_group: Optional[PodGroup] = None
-        self.scheduling_start_time: float = 0.0
+        # stamped when the cache first sees the job, so the reservation
+        # election's "longest waiting" survives per-cycle snapshot clones
+        # (clone() copies it; the reference's ScheduleStartTimestamp analogue)
+        import time as _t
+        self.scheduling_start_time: float = _t.time()
         self.preemptable: bool = False
         self.revocable_zone: str = ""
         self.budget: DisruptionBudget = DisruptionBudget()
@@ -266,6 +270,7 @@ class JobInfo:
         info.nodes_fit_errors = {}
         info.pod_group = self.pod_group
         info.creation_timestamp = self.creation_timestamp
+        info.scheduling_start_time = self.scheduling_start_time
         info.preemptable = self.preemptable
         info.revocable_zone = self.revocable_zone
         info.budget = self.budget.clone()

@@ -7,5 +7,9 @@ from . import drf  # noqa: F401
 from . import gang  # noqa: F401
 from . import proportion  # noqa: F401
 from . import nodeorder  # noqa: F401
+from . import overcommit  # noqa: F401
+from . import sla  # noqa: F401
+from . import tdm  # noqa: F401
 from . import predicates  # noqa: F401
 from . import priority  # noqa: F401
+from . import reservation  # noqa: F401
