@@ -1,0 +1,110 @@
+"""task-topology plugin tests (mirroring pkg/scheduler/plugins/
+task-topology/topology_test.go behaviors): affinity packs task types onto
+one node, anti-affinity spreads them, task order drives bucket priority."""
+
+from tests.harness import Harness
+from volcano_tpu.models.objects import PodGroupPhase
+from volcano_tpu.plugins.task_topology import (AFFINITY_ANNOTATION,
+                                               ANTI_AFFINITY_ANNOTATION,
+                                               JobManager,
+                                               parse_affinity_annotation)
+from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                          build_pod_group, build_queue,
+                                          build_resource_list)
+
+CONF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+  - name: task-topology
+    arguments:
+      task-topology.weight: 10
+- plugins:
+  - name: predicates
+  - name: nodeorder
+"""
+
+RL1 = build_resource_list("1", "1Gi")
+
+
+def topo_pg(name, ns, queue, minm, annotations):
+    pg = build_pod_group(name, ns, queue, minm, phase=PodGroupPhase.INQUEUE)
+    pg.metadata.annotations.update(annotations)
+    return pg
+
+
+def test_parse_affinity_annotation():
+    valid = {"ps", "worker", "chief"}
+    assert parse_affinity_annotation("ps,worker;chief", valid) == \
+        [["ps", "worker"], ["chief"]]
+    assert parse_affinity_annotation("ps,unknown", valid) is None
+    assert parse_affinity_annotation("ps,ps", valid) is None
+    assert parse_affinity_annotation(None, valid) is None
+
+
+def test_affinity_packs_task_types_together():
+    """ps/worker affinity: all four pods share one bucket and land on the
+    same node despite spread-friendly alternatives."""
+    h = Harness(CONF)
+    h.add("queues", build_queue("q1"))
+    h.add("podgroups", topo_pg("pg1", "c1", "q1", 4,
+                               {AFFINITY_ANNOTATION: "ps,worker"}))
+    h.add("nodes", build_node("n1", build_resource_list("8", "8Gi")),
+          build_node("n2", build_resource_list("8", "8Gi")))
+    h.add("pods",
+          build_pod("c1", "ps-0", "", "Pending", RL1, "pg1", task_name="ps"),
+          build_pod("c1", "ps-1", "", "Pending", RL1, "pg1", task_name="ps"),
+          build_pod("c1", "worker-0", "", "Pending", RL1, "pg1",
+                    task_name="worker"),
+          build_pod("c1", "worker-1", "", "Pending", RL1, "pg1",
+                    task_name="worker"))
+    h.run_actions("allocate").close_session()
+    assert len(h.binds) == 4
+    assert len(set(h.binds.values())) == 1, \
+        f"affinity should pack all pods on one node: {h.binds}"
+
+
+def test_anti_affinity_spreads_task_type():
+    """self anti-affinity on ps: the two ps pods must not share a node."""
+    h = Harness(CONF)
+    h.add("queues", build_queue("q1"))
+    h.add("podgroups", topo_pg("pg1", "c1", "q1", 2,
+                               {ANTI_AFFINITY_ANNOTATION: "ps"}))
+    h.add("nodes", build_node("n1", build_resource_list("8", "8Gi")),
+          build_node("n2", build_resource_list("8", "8Gi")))
+    h.add("pods",
+          build_pod("c1", "ps-0", "", "Pending", RL1, "pg1", task_name="ps"),
+          build_pod("c1", "ps-1", "", "Pending", RL1, "pg1", task_name="ps"))
+    h.run_actions("allocate").close_session()
+    assert len(h.binds) == 2
+    assert len(set(h.binds.values())) == 2, \
+        f"anti-affinity should spread ps pods: {h.binds}"
+
+
+def test_bucket_construction():
+    """Affinity groups merge into one bucket; anti-affinity splits."""
+    class T:
+        def __init__(self, uid, name, task_name):
+            self.uid = uid
+            self.name = name
+            self.node_name = ""
+            self.resreq = __import__(
+                "volcano_tpu.models.resource", fromlist=["Resource"]
+            ).Resource(1000, 1 << 30)
+            from volcano_tpu.models.objects import (ObjectMeta, Pod, PodSpec,
+                                                    TASK_SPEC_KEY)
+            self.pod = Pod(metadata=ObjectMeta(
+                name=name, annotations={TASK_SPEC_KEY: task_name}))
+
+    jm = JobManager("job1")
+    jm.apply_task_topology([["ps", "worker"]], [["ps"]], None)
+    tasks = {t.uid: t for t in (T("u1", "ps-0", "ps"), T("u2", "ps-1", "ps"),
+                                T("u3", "w-0", "worker"))}
+    jm.construct_buckets(tasks)
+    # self anti-affinity on ps forces ps-0 / ps-1 into different buckets;
+    # worker joins one of them via inter-affinity
+    assert len(jm.buckets) == 2
+    b0 = {jm.pod_in_bucket["u1"], jm.pod_in_bucket["u2"]}
+    assert len(b0) == 2
+    assert jm.pod_in_bucket["u3"] in b0

@@ -59,6 +59,7 @@ class BatchSolver:
         self.mask_fns: List[Callable] = []
         self.static_score_fns: List[Callable] = []
         self.queue_budget_fns: List[Callable] = []
+        self.bucket_fn: Optional[Callable] = None
         self.vectorized_plugins: set = set()
         self.enable_default_predicates = False
 
@@ -93,6 +94,14 @@ class BatchSolver:
         its queue's in-scan allocation stays within deserved (the proportion
         plugin's Overused semantics, at job granularity)."""
         self.queue_budget_fns.append(fn)
+
+    def set_bucket_fn(self, fn: Callable) -> None:
+        """fn(task) -> None | (bucket_key, per_mate_bonus). Tasks sharing a
+        bucket_key attract each other inside the allocate scan: every
+        same-bucket placement on a node adds per_mate_bonus to that node's
+        score for subsequent bucket mates (the task-topology plugin's
+        packing term)."""
+        self.bucket_fn = fn
 
     def mark_vectorized(self, plugin_name: str) -> None:
         self.vectorized_plugins.add(plugin_name)
@@ -205,10 +214,26 @@ class BatchSolver:
                     q_deserved[qi] = deserved
                     break
 
+        # task-topology buckets: same-bucket tasks attract within the scan
+        task_bucket = np.full(batch.task_group.shape[0], -1, np.int32)
+        pack_bonus = np.zeros(batch.g_pad, np.float32)
+        if self.bucket_fn is not None:
+            keys: Dict = {}
+            for t_idx in range(len(batch.tasks)):
+                if not batch.task_valid[t_idx]:
+                    continue
+                res = self.bucket_fn(batch.tasks[t_idx])
+                if res is None:
+                    continue
+                key, bonus = res
+                task_bucket[t_idx] = keys.setdefault(key, len(keys))
+                pack_bonus[batch.task_group[t_idx]] = bonus
+
         assign, pipelined, ready, kept, _ = gang_allocate(
             jnp.asarray(batch.task_group), jnp.asarray(batch.task_job),
             jnp.asarray(batch.task_valid), jnp.asarray(batch.group_req),
             gmask, static_score,
+            jnp.asarray(task_bucket), jnp.asarray(pack_bonus),
             jnp.asarray(batch.job_min_available),
             jnp.asarray(batch.job_ready_base),
             jnp.asarray(batch.job_task_start),

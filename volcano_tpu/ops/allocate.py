@@ -54,6 +54,8 @@ class AllocState(NamedTuple):
     ckpt_idle: jax.Array     # checkpoint for gang rollback
     ckpt_future: jax.Array
     ckpt_ntasks: jax.Array
+    cur_bucket: jax.Array    # i32 task-topology bucket of the running chain
+    pack_nodes: jax.Array    # [N] f32 current-bucket placements per node
     q_alloc: jax.Array       # [Q, R] live queue allocations
     q_cursor: jax.Array      # [Q] i32 next-job offset per queue
     cur_q: jax.Array         # i32 selected queue (-1 when done)
@@ -91,6 +93,8 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
                   group_req: jax.Array,       # [G, R] f32
                   group_mask: jax.Array,      # [G, N] bool static predicates
                   group_static_score: jax.Array,  # [G, N] f32
+                  task_bucket: jax.Array,     # [T] i32 topology bucket (-1 none)
+                  group_pack_bonus: jax.Array,  # [G] f32 per-mate pack score
                   job_min_available: jax.Array,   # [J] i32
                   job_ready_base: jax.Array,      # [J] i32 occupied count
                   job_task_start: jax.Array,      # [J] i32 span start
@@ -128,6 +132,8 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
     init = AllocState(
         idle=node_idle, future=node_future, n_tasks=node_ntasks,
         ckpt_idle=node_idle, ckpt_future=node_future, ckpt_ntasks=node_ntasks,
+        cur_bucket=jnp.int32(-1),
+        pack_nodes=jnp.zeros(node_ntasks.shape[0], jnp.float32),
         q_alloc=queue_alloc0, q_cursor=jnp.zeros_like(queue_njobs),
         cur_q=q0, cur_job=j0, t_off=jnp.int32(0),
         placed=jnp.int32(0), placed_alloc=jnp.int32(0),
@@ -154,8 +160,14 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
         fits_future = jnp.all(req[None, :] <= state.future + eps[None, :],
                               axis=-1) & base_ok
 
+        # task-topology packing: same-bucket placements earlier in the scan
+        # attract this task to their nodes (the in-kernel form of the
+        # reference's per-task bucket.node rescoring, topology.go:152-153)
+        b = task_bucket[t_idx]
+        same_bucket = (b >= 0) & (b == state.cur_bucket)
+        pack = jnp.where(same_bucket, state.pack_nodes, 0.0)
         score = node_score(req, state.idle, node_alloc, weights,
-                           group_static_score[g])
+                           group_static_score[g] + pack * group_pack_bonus[g])
 
         any_idle = jnp.any(fits_idle)
         if allow_pipeline:
@@ -174,6 +186,9 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
 
         state = state._replace(
             idle=idle, future=future, n_tasks=n_tasks,
+            cur_bucket=jnp.where(valid, b, state.cur_bucket),
+            pack_nodes=pack.at[sel].add(
+                jnp.where(placed_ok & valid, 1.0, 0.0)),
             t_off=state.t_off + jnp.where(active, 1, 0),
             placed=state.placed + placed_ok.astype(jnp.int32),
             placed_alloc=state.placed_alloc + take_idle.astype(jnp.int32),

@@ -49,6 +49,8 @@ class ShardState(NamedTuple):
     ckpt_idle: jax.Array
     ckpt_future: jax.Array
     ckpt_ntasks: jax.Array
+    cur_bucket: jax.Array    # i32 replicated
+    pack_nodes: jax.Array    # [Nl] f32 local current-bucket placements
     q_alloc: jax.Array       # [Q, R] replicated
     q_cursor: jax.Array      # [Q] replicated
     cur_q: jax.Array         # i32 replicated
@@ -62,7 +64,8 @@ class ShardState(NamedTuple):
 
 
 def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
-                  group_static_score, job_min_available, job_ready_base,
+                  group_static_score, task_bucket, group_pack_bonus,
+                  job_min_available, job_ready_base,
                   job_task_start, job_n_tasks, job_queue, queue_job_start,
                   queue_njobs, queue_deserved, queue_alloc0,
                   node_idle, node_future, node_alloc, node_ntasks,
@@ -88,6 +91,8 @@ def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
     init = ShardState(
         idle=node_idle, future=node_future, n_tasks=node_ntasks,
         ckpt_idle=node_idle, ckpt_future=node_future, ckpt_ntasks=node_ntasks,
+        cur_bucket=jnp.int32(-1),
+        pack_nodes=jnp.zeros(Nl, jnp.float32),
         q_alloc=queue_alloc0, q_cursor=jnp.zeros_like(queue_njobs),
         cur_q=q0, cur_job=j0, t_off=jnp.int32(0),
         placed=jnp.int32(0), placed_alloc=jnp.int32(0),
@@ -113,8 +118,12 @@ def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
         fits_future = jnp.all(req[None, :] <= state.future + eps[None, :],
                               axis=-1) & base_ok
 
+        # task-topology packing on the local shard (see ops/allocate.py)
+        b = task_bucket[t_idx]
+        same_bucket = (b >= 0) & (b == state.cur_bucket)
+        pack = jnp.where(same_bucket, state.pack_nodes, 0.0)
         score = node_score(req, state.idle, node_alloc, weights,
-                           group_static_score[g])
+                           group_static_score[g] + pack * group_pack_bonus[g])
 
         # -- cross-chip: does ANY chip have an idle fit? (1 int over ICI)
         any_idle = jax.lax.psum(jnp.any(fits_idle).astype(jnp.int32), axis) > 0
@@ -151,6 +160,9 @@ def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
 
         state = state._replace(
             idle=idle, future=future, n_tasks=n_tasks,
+            cur_bucket=jnp.where(valid, b, state.cur_bucket),
+            pack_nodes=pack.at[sel_l].add(
+                jnp.where(is_owner & placed_ok & valid, 1.0, 0.0)),
             t_off=state.t_off + jnp.where(active, 1, 0),
             placed=state.placed + placed_ok.astype(jnp.int32),
             placed_alloc=state.placed_alloc + take_idle.astype(jnp.int32),
@@ -219,7 +231,8 @@ def make_sharded_gang_allocate(mesh: Mesh, axis: str = "nodes",
     nr = P(axis, None)        # [N, R]
     gn = P(None, axis)        # [G, N]
     rep = P()
-    in_specs = (rep, rep, rep, rep, gn, gn, rep, rep, rep, rep, rep,
+    in_specs = (rep, rep, rep, rep, gn, gn, rep, rep,
+                rep, rep, rep, rep, rep,
                 rep, rep, rep, rep,
                 nr, nr, nr, n, n, rep,
                 ScoreWeights(rep, rep, rep, rep, rep))
@@ -247,6 +260,7 @@ def shard_synth(mesh: Mesh, sa, axis: str = "nodes"):
         put(sa.task_group, rep), put(sa.task_job, rep),
         put(sa.task_valid, rep), put(sa.group_req, rep),
         put(sa.group_mask, gn), put(sa.group_static_score, gn),
+        put(sa.task_bucket, rep), put(sa.group_pack_bonus, rep),
         put(sa.job_min_available, rep), put(sa.job_ready_base, rep),
         put(sa.job_task_start, rep), put(sa.job_n_tasks, rep),
         put(sa.job_queue, rep), put(sa.queue_job_start, rep),

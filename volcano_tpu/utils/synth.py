@@ -29,6 +29,8 @@ class SynthArrays:
     group_req: np.ndarray       # [G, R] f32
     group_mask: np.ndarray      # [G, N] bool
     group_static_score: np.ndarray  # [G, N] f32
+    task_bucket: np.ndarray     # [T] i32 (-1 = out of bucket)
+    group_pack_bonus: np.ndarray  # [G] f32
     job_min_available: np.ndarray   # [J] i32
     job_ready_base: np.ndarray      # [J] i32
     job_task_start: np.ndarray      # [J] i32
@@ -51,6 +53,7 @@ class SynthArrays:
         excluded)."""
         return [self.task_group, self.task_job, self.task_valid,
                 self.group_req, self.group_mask, self.group_static_score,
+                self.task_bucket, self.group_pack_bonus,
                 self.job_min_available, self.job_ready_base,
                 self.job_task_start, self.job_n_tasks, self.job_queue,
                 self.queue_job_start, self.queue_njobs, self.queue_deserved,
@@ -163,6 +166,8 @@ def synth_arrays(n_tasks: int, n_nodes: int, *, gang_size: int = 8,
         task_group=task_group, task_job=task_job, task_valid=task_valid,
         group_req=group_req, group_mask=group_mask,
         group_static_score=group_static_score,
+        task_bucket=np.full(t_pad, -1, np.int32),
+        group_pack_bonus=np.zeros(g_pad, np.float32),
         job_min_available=job_min_available, job_ready_base=job_ready_base,
         job_task_start=job_task_start, job_n_tasks=job_n_tasks,
         job_queue=job_queue, queue_job_start=queue_job_start,
