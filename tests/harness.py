@@ -5,8 +5,7 @@ from volcano_tpu.apiserver import ObjectStore
 from volcano_tpu.cache import SchedulerCache
 from volcano_tpu.framework import (close_session, open_session,
                                    parse_scheduler_conf)
-from volcano_tpu.utils.test_utils import (FakeBinder, FakeEvictor,
-                                          FakeStatusUpdater)
+from volcano_tpu.utils.test_utils import FakeBinder, FakeEvictor
 
 
 class Harness:
@@ -15,8 +14,7 @@ class Harness:
         self.binder = FakeBinder(self.store)
         self.evictor = FakeEvictor(self.store)
         self.cache = SchedulerCache(self.store, binder=self.binder,
-                                    evictor=self.evictor,
-                                    status_updater=FakeStatusUpdater())
+                                    evictor=self.evictor)
         self.cache.run()
         self.conf = parse_scheduler_conf(conf_text)
         self.ssn = None

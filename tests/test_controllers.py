@@ -1,0 +1,356 @@
+"""Controller subsystem tests (reference test model:
+pkg/controllers/job/job_controller_actions_test.go et al. — fake-backed
+clients; here the in-process store plays that role).
+"""
+
+import pytest
+
+from volcano_tpu.apiserver import ObjectStore
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.controllers import (ControllerManager, GarbageCollector,
+                                     JobController, PodGroupController,
+                                     QueueController, make_pod_name)
+from volcano_tpu.framework import (close_session, get_action, open_session,
+                                   parse_scheduler_conf)
+from volcano_tpu.models import objects as obj
+from volcano_tpu.models.objects import (Command, Container, Job, JobAction,
+                                        JobPhase, JobSpec, LifecyclePolicy,
+                                        ObjectMeta, PodGroupPhase, PodSpec,
+                                        PodTemplate, Queue, QueueState,
+                                        TaskSpec)
+from volcano_tpu.utils.clock import FakeClock
+from volcano_tpu.utils.kubelet import SimulatedKubelet
+from volcano_tpu.utils.test_utils import build_node, build_queue
+
+CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def make_job(name="job1", replicas=2, min_available=2, plugins=None,
+             policies=None, tasks=None, queue="default", **spec_kw):
+    if tasks is None:
+        tasks = [TaskSpec(
+            name="task", replicas=replicas,
+            template=PodTemplate(spec=PodSpec(
+                containers=[Container(requests={"cpu": "1", "memory": "1Gi"})])))]
+    return Job(
+        metadata=ObjectMeta(name=name),
+        spec=JobSpec(min_available=min_available, tasks=tasks,
+                     plugins=plugins or {}, policies=policies or [],
+                     queue=queue, **spec_kw))
+
+
+class Cluster:
+    """Full control plane: store + controllers + scheduler session runner
+    + simulated kubelet."""
+
+    def __init__(self, controllers=None, clock=None):
+        self.clock = clock or FakeClock(start=100.0)
+        self.store = ObjectStore(clock=self.clock)
+        self.store.create("queues", build_queue("default", weight=1))
+        self.manager = ControllerManager(self.store, controllers)
+        self.kubelet = SimulatedKubelet(self.store)
+        self.cache = SchedulerCache(self.store)  # real status writeback
+        self.cache.run()
+        self.conf = parse_scheduler_conf(CONF)
+
+    def schedule_once(self):
+        ssn = open_session(self.cache, self.conf.tiers, self.conf.configurations)
+        try:
+            for name in self.conf.actions:
+                get_action(name).execute(ssn)
+        finally:
+            close_session(ssn)
+
+    def converge(self, cycles=5):
+        for _ in range(cycles):
+            self.manager.sync()
+            self.schedule_once()
+            self.kubelet.tick()
+        self.manager.sync()
+
+
+def job_phase(cluster, name="job1"):
+    return cluster.store.get("jobs", name).status.state.phase
+
+
+class TestJobController:
+    def test_job_creates_podgroup_and_waits_for_gang(self):
+        cl = Cluster()
+        cl.store.create("jobs", make_job())
+        cl.manager.sync()
+        pg = cl.store.get("podgroups", "job1")
+        assert pg is not None
+        assert pg.spec.min_member == 2
+        assert pg.spec.min_task_member == {"task": 2}
+        assert pg.spec.min_resources["cpu"] == "2000m"
+        # PodGroup still Pending: no pods yet (gang gate, actions.go:269-281)
+        assert cl.store.list("pods") == []
+        assert job_phase(cl) == JobPhase.PENDING
+
+    def test_pods_created_after_podgroup_leaves_pending(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        cl.store.create("jobs", make_job())
+        cl.converge(cycles=2)
+        pods = cl.store.list("pods")
+        assert len(pods) == 2
+        names = {p.metadata.name for p in pods}
+        assert names == {make_pod_name("job1", "task", 0),
+                         make_pod_name("job1", "task", 1)}
+        # pods carry the volcano annotations
+        p = pods[0]
+        assert p.metadata.annotations[obj.GROUP_NAME_ANNOTATION] == "job1"
+        assert p.metadata.annotations[obj.JOB_NAME_KEY] == "job1"
+        assert p.metadata.annotations[obj.JOB_VERSION_KEY] == "0"
+
+    def test_job_runs_and_completes(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        job = make_job()
+        for t in job.spec.tasks:
+            t.template.metadata.annotations["volcano.sh/sim-duration"] = "5"
+        cl.store.create("jobs", job)
+        cl.converge(cycles=3)
+        assert job_phase(cl) == JobPhase.RUNNING
+        status = cl.store.get("jobs", "job1").status
+        assert status.running == 2
+        cl.clock.advance(10)
+        cl.converge(cycles=3)
+        assert job_phase(cl) == JobPhase.COMPLETED
+
+    def test_min_success_completes_early(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        cl.store.create("jobs", make_job(replicas=3, min_available=3, min_success=1))
+        cl.converge(cycles=3)
+        assert job_phase(cl) == JobPhase.RUNNING
+        cl.kubelet.complete("default", make_pod_name("job1", "task", 0))
+        cl.manager.sync()
+        assert job_phase(cl) == JobPhase.COMPLETED
+
+    def test_pod_failure_policy_restarts_job(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        cl.store.create("jobs", make_job(
+            policies=[LifecyclePolicy(event="PodFailed",
+                                      action=JobAction.RESTART_JOB)]))
+        cl.converge(cycles=3)
+        assert job_phase(cl) == JobPhase.RUNNING
+        cl.kubelet.complete("default", make_pod_name("job1", "task", 0),
+                            exit_code=1)
+        cl.manager.sync()
+        job = cl.store.get("jobs", "job1")
+        assert job.status.retry_count == 1
+        # restarting drains pods then goes back through Pending to Running
+        cl.converge(cycles=4)
+        assert job_phase(cl) == JobPhase.RUNNING
+
+    def test_abort_and_resume_via_command(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        cl.store.create("jobs", make_job())
+        cl.converge(cycles=3)
+        assert job_phase(cl) == JobPhase.RUNNING
+        cl.store.create("commands", Command(
+            metadata=ObjectMeta(name="cmd1"), action=JobAction.ABORT_JOB,
+            target_kind="Job", target_name="job1"))
+        cl.manager.sync()
+        assert job_phase(cl) == JobPhase.ABORTED
+        assert cl.store.get("commands", "cmd1") is None  # consumed exactly once
+        assert cl.store.list("pods") == []
+        cl.store.create("commands", Command(
+            metadata=ObjectMeta(name="cmd2"), action=JobAction.RESUME_JOB,
+            target_kind="Job", target_name="job1"))
+        cl.converge(cycles=4)
+        assert job_phase(cl) == JobPhase.RUNNING
+
+    def test_max_retry_exhaustion_fails_job(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        cl.store.create("jobs", make_job(
+            max_retry=2,
+            policies=[LifecyclePolicy(event="PodFailed",
+                                      action=JobAction.RESTART_JOB)]))
+        for _ in range(4):
+            cl.converge(cycles=4)
+            if job_phase(cl) == JobPhase.FAILED:
+                break
+            pods = [p for p in cl.store.list("pods")
+                    if p.status.phase == "Running"]
+            if not pods:
+                break
+            cl.kubelet.complete("default", pods[0].metadata.name, exit_code=137)
+            cl.manager.sync()
+        assert job_phase(cl) == JobPhase.FAILED
+
+    def test_task_level_policy_overrides_job_level(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        tasks = [TaskSpec(
+            name="task", replicas=2,
+            policies=[LifecyclePolicy(event="PodFailed",
+                                      action=JobAction.ABORT_JOB)],
+            template=PodTemplate(spec=PodSpec(
+                containers=[Container(requests={"cpu": "1", "memory": "1Gi"})])))]
+        cl.store.create("jobs", make_job(
+            tasks=tasks,
+            policies=[LifecyclePolicy(event="PodFailed",
+                                      action=JobAction.RESTART_JOB)]))
+        cl.converge(cycles=3)
+        cl.kubelet.complete("default", make_pod_name("job1", "task", 0),
+                            exit_code=1)
+        cl.manager.sync()
+        assert job_phase(cl) in (JobPhase.ABORTING, JobPhase.ABORTED)
+
+    def test_job_delete_cascades(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        cl.store.create("jobs", make_job(plugins={"svc": [], "ssh": [], "env": []}))
+        cl.converge(cycles=3)
+        assert len(cl.store.list("pods")) == 2
+        assert cl.store.get("services", "job1") is not None
+        assert cl.store.get("secrets", "job1-ssh") is not None
+        cl.store.delete("jobs", "job1")
+        cl.manager.sync()
+        assert cl.store.list("pods") == []
+        assert cl.store.get("podgroups", "job1") is None
+        assert cl.store.get("services", "job1") is None
+        assert cl.store.get("secrets", "job1-ssh") is None
+
+
+class TestJobPlugins:
+    def _initiated_cluster(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        cl.store.create("jobs", make_job(
+            replicas=2, plugins={"svc": [], "ssh": [], "env": []}))
+        cl.converge(cycles=3)
+        return cl
+
+    def test_svc_creates_service_configmap_networkpolicy(self):
+        cl = self._initiated_cluster()
+        svc = cl.store.get("services", "job1")
+        assert svc is not None and svc.cluster_ip == "None"
+        cm = cl.store.get("configmaps", "job1-svc")
+        assert cm.data["task.host"] == "job1-task-0.job1\njob1-task-1.job1"
+        assert cm.data["VC_TASK_NUM"] == "2"
+        assert cl.store.get("networkpolicies", "job1-network-policy") is not None
+
+    def test_ssh_secret_with_keypair(self):
+        cl = self._initiated_cluster()
+        secret = cl.store.get("secrets", "job1-ssh")
+        assert b"PRIVATE KEY" in secret.data["id_rsa"]
+        assert secret.data["id_rsa.pub"].startswith(b"ssh-rsa")
+        assert secret.data["authorized_keys"] == secret.data["id_rsa.pub"]
+        assert b"StrictHostKeyChecking no" in secret.data["config"]
+
+    def test_env_and_svc_pod_mutations(self):
+        cl = self._initiated_cluster()
+        pod = cl.store.get("pods", make_pod_name("job1", "task", 1))
+        c = pod.spec.containers[0]
+        assert c.env["VC_TASK_INDEX"] == "1"
+        assert c.env["VK_TASK_INDEX"] == "1"
+        assert c.env["VC_TASK_HOSTS"] == "job1-task-0.job1,job1-task-1.job1"
+        mounts = {m["name"] for m in c.volume_mounts}
+        assert "job1-svc" in mounts and "job1-ssh" in mounts
+
+
+class TestQueueController:
+    def test_status_rollup(self):
+        cl = Cluster()
+        cl.store.create("queues", build_queue("q1"))
+        cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        cl.store.create("jobs", make_job(name="jq", queue="q1"))
+        cl.converge(cycles=3)
+        q = cl.store.get("queues", "q1")
+        assert q.status.state == QueueState.OPEN
+        assert q.status.running == 1
+
+    def test_close_and_open_via_command(self):
+        cl = Cluster()
+        cl.store.create("queues", build_queue("q2"))
+        cl.manager.sync()
+        cl.store.create("commands", Command(
+            metadata=ObjectMeta(name="close-q2"), action=JobAction.CLOSE_QUEUE,
+            target_kind="Queue", target_name="q2"))
+        cl.manager.sync()
+        assert cl.store.get("queues", "q2").status.state == QueueState.CLOSED
+        cl.store.create("commands", Command(
+            metadata=ObjectMeta(name="open-q2"), action=JobAction.OPEN_QUEUE,
+            target_kind="Queue", target_name="q2"))
+        cl.manager.sync()
+        assert cl.store.get("queues", "q2").status.state == QueueState.OPEN
+
+    def test_close_with_podgroups_is_closing(self):
+        cl = Cluster()
+        cl.store.create("queues", build_queue("q3"))
+        cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        cl.store.create("jobs", make_job(name="jq3", queue="q3"))
+        cl.converge(cycles=2)
+        cl.store.create("commands", Command(
+            metadata=ObjectMeta(name="close-q3"), action=JobAction.CLOSE_QUEUE,
+            target_kind="Queue", target_name="q3"))
+        cl.manager.sync()
+        assert cl.store.get("queues", "q3").status.state == QueueState.CLOSING
+
+
+class TestPodGroupController:
+    def test_bare_pod_gets_podgroup(self):
+        cl = Cluster()
+        from volcano_tpu.models.objects import Pod, PodStatus
+        pod = Pod(metadata=ObjectMeta(name="bare", uid="bare-uid"),
+                  spec=PodSpec(containers=[Container(requests={"cpu": "1"})]),
+                  status=PodStatus())
+        cl.store.create("pods", pod)
+        cl.manager.sync()
+        live = cl.store.get("pods", "bare")
+        pg_name = live.metadata.annotations[obj.GROUP_NAME_ANNOTATION]
+        pg = cl.store.get("podgroups", pg_name)
+        assert pg is not None and pg.spec.min_member == 1
+
+    def test_volcano_job_pods_not_duplicated(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        cl.store.create("jobs", make_job())
+        cl.converge(cycles=2)
+        # only the job's own podgroup exists
+        assert [pg.metadata.name for pg in cl.store.list("podgroups")] == ["job1"]
+
+
+class TestGarbageCollector:
+    def test_ttl_deletes_finished_job(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        job = make_job(ttl_seconds_after_finished=30, min_success=1)
+        cl.store.create("jobs", job)
+        cl.converge(cycles=3)
+        cl.kubelet.complete("default", make_pod_name("job1", "task", 0))
+        cl.manager.sync()
+        assert job_phase(cl) == JobPhase.COMPLETED
+        cl.clock.advance(10)
+        cl.manager.sync()
+        assert cl.store.get("jobs", "job1") is not None   # TTL not yet elapsed
+        cl.clock.advance(31)
+        cl.manager.sync()
+        assert cl.store.get("jobs", "job1") is None
+
+    def test_no_ttl_keeps_job(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        cl.store.create("jobs", make_job(min_success=1))
+        cl.converge(cycles=3)
+        cl.kubelet.complete("default", make_pod_name("job1", "task", 0))
+        cl.manager.sync()
+        cl.clock.advance(10_000)
+        cl.manager.sync()
+        assert cl.store.get("jobs", "job1") is not None

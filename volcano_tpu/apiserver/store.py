@@ -21,13 +21,19 @@ from typing import Callable, Dict, List, Optional
 from ..models import objects as obj
 from ..utils.clock import GLOBAL_CLOCK, Clock
 
-NAMESPACED = {"pods", "podgroups", "jobs", "commands", "resourcequotas", "services", "configmaps", "secrets"}
+NAMESPACED = {"pods", "podgroups", "jobs", "commands", "resourcequotas", "services",
+              "configmaps", "secrets", "networkpolicies", "persistentvolumeclaims"}
 CLUSTER_SCOPED = {"nodes", "queues", "priorityclasses", "numatopologies"}
 KINDS = NAMESPACED | CLUSTER_SCOPED
 
 
 class AdmissionError(Exception):
     """Raised when a validating admission hook rejects an operation."""
+
+
+class ConflictError(Exception):
+    """Raised on update when the caller's copy is stale (optimistic
+    concurrency, the apiserver 409). Re-get and retry."""
 
 
 class AdmissionHook:
@@ -120,12 +126,22 @@ class ObjectStore:
                 w.on_add(o)
         return o
 
+    # API-server semantics: reads hand out copies so callers can never mutate
+    # stored state in place — a get+mutate+update round trip must present the
+    # true old/new pair to watchers (the aliasing alternative silently breaks
+    # phase-transition detection in controllers).
+
     def update(self, kind: str, o, skip_admission: bool = False):
         with self._lock:
             key = self.key_of(kind, o)
             old = self._objects[kind].get(key)
             if old is None:
                 raise KeyError(f"{kind} {key!r} not found")
+            if o.metadata.resource_version and \
+                    o.metadata.resource_version != old.metadata.resource_version:
+                raise ConflictError(
+                    f"{kind} {key!r}: stale resource_version "
+                    f"{o.metadata.resource_version} != {old.metadata.resource_version}")
             if not skip_admission:
                 self._admit(kind, "UPDATE", o, old)
             self._rv += 1
@@ -160,14 +176,15 @@ class ObjectStore:
     def get(self, kind: str, name: str, namespace: str = "default"):
         key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
         with self._lock:
-            return self._objects[kind].get(key)
+            o = self._objects[kind].get(key)
+        return copy.deepcopy(o) if o is not None else None
 
     def list(self, kind: str, namespace: Optional[str] = None) -> list:
         with self._lock:
             items = list(self._objects[kind].values())
         if namespace is not None and kind in NAMESPACED:
             items = [o for o in items if o.metadata.namespace == namespace]
-        return items
+        return [copy.deepcopy(o) for o in items]
 
     # -- watch -------------------------------------------------------------
 
@@ -195,8 +212,6 @@ class ObjectStore:
         self.events.append((kind, self.key_of(kind, o) if o is not None else "",
                             event_type, reason, message))
 
-    # -- helpers for deep-copied reads ------------------------------------
-
-    def get_copy(self, kind: str, name: str, namespace: str = "default"):
-        o = self.get(kind, name, namespace)
-        return copy.deepcopy(o) if o is not None else None
+    # get already returns a deep copy; kept for callers written against the
+    # earlier live-reference API
+    get_copy = get

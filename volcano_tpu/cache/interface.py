@@ -72,8 +72,9 @@ class StoreStatusUpdater:
         live = self.store.get("podgroups", pg.metadata.name, pg.metadata.namespace)
         if live is None:
             return None
+        # status subresource only: the session's pg.spec is a snapshot copy
+        # and writing it back would clobber concurrent controller spec updates
         live.status = pg.status
-        live.spec = pg.spec
         return self.store.update("podgroups", live, skip_admission=True)
 
 

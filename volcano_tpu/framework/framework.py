@@ -46,8 +46,14 @@ def open_session(cache, tiers, configurations=None) -> Session:
             with m.plugin_timer(plugin.name(), "OnSessionOpen"):
                 plugin.on_session_open(ssn)
 
-    # drop invalid gangs (JobValid), writing the Unschedulable condition
+    # drop invalid gangs (JobValid), writing the Unschedulable condition.
+    # Pending PodGroups are exempt: their pods don't exist yet (the job
+    # controller gates pod creation on the enqueue action moving the group
+    # to Inqueue), so gang's valid-task-count check cannot apply to them.
     for job in list(ssn.jobs.values()):
+        if job.pod_group is not None and \
+                job.pod_group.status.phase == PodGroupPhase.PENDING:
+            continue
         vr = ssn.job_valid(job)
         if vr is not None and not vr.passed:
             update_pod_group_condition(ssn, job, PodGroupCondition(

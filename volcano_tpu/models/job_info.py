@@ -9,6 +9,7 @@ CheckTaskMinAvailable:543, Ready:587), and annotation extraction
 
 from __future__ import annotations
 
+import copy
 import enum
 from collections import defaultdict
 from typing import Dict, List, Optional
@@ -268,7 +269,11 @@ class JobInfo:
         info.min_available = self.min_available
         info.waiting_time = self.waiting_time
         info.nodes_fit_errors = {}
-        info.pod_group = self.pod_group
+        # deep-copy the PodGroup: the snapshot must be mutable (enqueue flips
+        # phase, gang writes conditions) without writing through to the cache's
+        # live object — writeback goes through the status updater instead
+        # (reference: cache.go:793 Snapshot deep copy)
+        info.pod_group = copy.deepcopy(self.pod_group) if self.pod_group else None
         info.creation_timestamp = self.creation_timestamp
         info.scheduling_start_time = self.scheduling_start_time
         info.preemptable = self.preemptable

@@ -211,6 +211,7 @@ class PodStatus:
     reason: str = ""
     message: str = ""
     host_ip: str = ""
+    exit_code: Optional[int] = None  # terminated main-container exit code
 
 
 @dataclass
@@ -451,7 +452,9 @@ class JobSpec:
 
 @dataclass
 class JobState:
-    phase: str = JobPhase.PENDING
+    # empty until the job controller's initiateJob stamps Pending
+    # (reference: job_controller_actions.go initJobStatus)
+    phase: str = ""
     reason: str = ""
     message: str = ""
     last_transition_time: float = 0.0
@@ -478,6 +481,46 @@ class Job:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: JobSpec = field(default_factory=JobSpec)
     status: JobStatus = field(default_factory=JobStatus)
+
+
+# ---------------------------------------------------------------------------
+# core/v1 controlled resources (created by job controller plugins / volumes)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Service:
+    """Headless service equivalent (created by the svc job plugin,
+    reference: pkg/controllers/job/plugins/svc/svc.go:219-264)."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Dict[str, str] = field(default_factory=dict)
+    cluster_ip: str = "None"
+    ports: List[int] = field(default_factory=list)
+
+
+@dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Secret:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, bytes] = field(default_factory=dict)
+
+
+@dataclass
+class NetworkPolicy:
+    """Intra-job network isolation (svc plugin, svc.go:266-313)."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    pod_selector: Dict[str, str] = field(default_factory=dict)
+    ingress_from_selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: Dict[str, Any] = field(default_factory=dict)
 
 
 # ---------------------------------------------------------------------------

@@ -86,6 +86,20 @@ class Resource:
         c.scalars = dict(self.scalars)
         return c
 
+    def to_resource_list(self) -> Dict[str, object]:
+        """Inverse of from_resource_list (cpu/scalars as "<milli>m" strings,
+        memory as bytes). Used when writing PodGroup.spec.min_resources."""
+        rl: Dict[str, object] = {}
+        if self.milli_cpu:
+            rl[CPU] = f"{self.milli_cpu:g}m"
+        if self.memory:
+            rl[MEMORY] = self.memory
+        if self.max_task_num:
+            rl[PODS] = self.max_task_num
+        for name, value in self.scalars.items():
+            rl[name] = f"{value:g}m"
+        return rl
+
     # -- access ------------------------------------------------------------
 
     def get(self, name: str) -> float:
