@@ -21,6 +21,7 @@ from volcano_tpu.models.objects import (Command, Container, Job, JobAction,
 from volcano_tpu.utils.clock import FakeClock
 from volcano_tpu.utils.kubelet import SimulatedKubelet
 from volcano_tpu.utils.test_utils import build_node, build_queue
+from volcano_tpu.webhooks import WebhookManager
 
 CONF = """
 actions: "enqueue, allocate, backfill"
@@ -56,6 +57,7 @@ class Cluster:
     def __init__(self, controllers=None, clock=None):
         self.clock = clock or FakeClock(start=100.0)
         self.store = ObjectStore(clock=self.clock)
+        WebhookManager(self.store)   # full admission chain enabled
         self.store.create("queues", build_queue("default", weight=1))
         self.manager = ControllerManager(self.store, controllers)
         self.kubelet = SimulatedKubelet(self.store)

@@ -1,0 +1,260 @@
+"""Admission webhook tests (reference: pkg/webhooks/admission/jobs/validate/
+admit_job_test.go et al.)."""
+
+import pytest
+
+from volcano_tpu.apiserver import ObjectStore
+from volcano_tpu.models.objects import (Container, Job, JobAction, JobSpec,
+                                        LifecyclePolicy, ObjectMeta, Pod,
+                                        PodGroup, PodGroupSpec, PodSpec,
+                                        PodTemplate, Queue, QueueSpec,
+                                        QueueState, TaskSpec, Toleration)
+from volcano_tpu.utils.test_utils import build_queue
+from volcano_tpu.webhooks import (AdmissionDenied, ResGroupConfig,
+                                  WebhookManager, set_resource_groups)
+
+
+def make_store(enabled=None):
+    store = ObjectStore()
+    WebhookManager(store, enabled_admission=enabled)
+    store.create("queues", build_queue("default"), skip_admission=True)
+    return store
+
+
+def simple_job(name="j1", **kw):
+    spec = dict(
+        min_available=1,
+        tasks=[TaskSpec(name="task", replicas=1, template=PodTemplate(
+            spec=PodSpec(containers=[Container(requests={"cpu": "1"})])))])
+    spec.update(kw)
+    return Job(metadata=ObjectMeta(name=name), spec=JobSpec(**spec))
+
+
+class TestJobMutate:
+    def test_defaults_applied(self):
+        store = make_store()
+        job = Job(metadata=ObjectMeta(name="j1"), spec=JobSpec(
+            tasks=[TaskSpec(name="", replicas=2, template=PodTemplate(
+                spec=PodSpec(containers=[Container(requests={"cpu": "1"})])))]))
+        store.create("jobs", job)
+        live = store.get("jobs", "j1")
+        assert live.spec.queue == "default"
+        assert live.spec.scheduler_name == "volcano"
+        assert live.spec.max_retry == 3
+        assert live.spec.min_available == 2       # sum of task replicas
+        assert live.spec.tasks[0].name == "default0"
+
+
+class TestJobValidate:
+    def test_negative_min_available(self):
+        store = make_store()
+        with pytest.raises(AdmissionDenied, match="minAvailable"):
+            store.create("jobs", simple_job(min_available=-1))
+
+    def test_no_tasks(self):
+        store = make_store()
+        with pytest.raises(AdmissionDenied, match="No task specified"):
+            store.create("jobs", Job(metadata=ObjectMeta(name="j1"),
+                                     spec=JobSpec(min_available=1)))
+
+    def test_duplicate_task_names(self):
+        store = make_store()
+        job = simple_job()
+        job.spec.tasks = job.spec.tasks * 2
+        with pytest.raises(AdmissionDenied, match="duplicated task name"):
+            store.create("jobs", job)
+
+    def test_min_available_exceeds_replicas(self):
+        store = make_store()
+        with pytest.raises(AdmissionDenied, match="not be greater than total"):
+            store.create("jobs", simple_job(min_available=5))
+
+    def test_bad_task_name(self):
+        store = make_store()
+        job = simple_job()
+        job.spec.tasks[0].name = "Bad_Name"
+        with pytest.raises(AdmissionDenied, match="DNS-1123"):
+            store.create("jobs", job)
+
+    def test_invalid_policy_event(self):
+        store = make_store()
+        job = simple_job(policies=[LifecyclePolicy(
+            event="OutOfSync", action=JobAction.RESTART_JOB)])
+        with pytest.raises(AdmissionDenied, match="invalid policy event"):
+            store.create("jobs", job)
+
+    def test_policy_event_and_exit_code_conflict(self):
+        store = make_store()
+        job = simple_job(policies=[LifecyclePolicy(
+            event="PodFailed", action=JobAction.RESTART_JOB, exit_code=1)])
+        with pytest.raises(AdmissionDenied, match="simultaneously"):
+            store.create("jobs", job)
+
+    def test_unknown_plugin(self):
+        store = make_store()
+        job = simple_job(plugins={"nope": []})
+        with pytest.raises(AdmissionDenied, match="unable to find job plugin"):
+            store.create("jobs", job)
+
+    def test_missing_queue(self):
+        store = make_store()
+        job = simple_job(queue="ghost")
+        with pytest.raises(AdmissionDenied, match="unable to find job queue"):
+            store.create("jobs", job)
+
+    def test_closed_queue(self):
+        store = make_store()
+        q = build_queue("closed-q")
+        q.status.state = QueueState.CLOSED
+        store.create("queues", q, skip_admission=True)
+        with pytest.raises(AdmissionDenied, match="state `Open`"):
+            store.create("jobs", simple_job(queue="closed-q"))
+
+    def test_update_immutability(self):
+        store = make_store()
+        store.create("jobs", simple_job())
+        live = store.get("jobs", "j1")
+        live.spec.queue = "other"
+        with pytest.raises(AdmissionDenied, match="may not change fields"):
+            store.update("jobs", live)
+
+    def test_update_replicas_allowed(self):
+        store = make_store()
+        store.create("jobs", simple_job())
+        live = store.get("jobs", "j1")
+        live.spec.tasks[0].replicas = 4
+        store.update("jobs", live)   # no raise
+        assert store.get("jobs", "j1").spec.tasks[0].replicas == 4
+
+    def test_update_may_not_add_tasks(self):
+        store = make_store()
+        store.create("jobs", simple_job())
+        live = store.get("jobs", "j1")
+        live.spec.tasks.append(TaskSpec(name="extra", replicas=1,
+                                        template=live.spec.tasks[0].template))
+        with pytest.raises(AdmissionDenied, match="add or remove tasks"):
+            store.update("jobs", live)
+
+
+class TestQueueAdmission:
+    def test_weight_default_and_positive(self):
+        store = make_store()
+        store.create("queues", Queue(metadata=ObjectMeta(name="q0"),
+                                     spec=QueueSpec(weight=0)))
+        assert store.get("queues", "q0").spec.weight == 1
+        with pytest.raises(AdmissionDenied, match="positive integer"):
+            store.create("queues", Queue(metadata=ObjectMeta(name="qneg"),
+                                         spec=QueueSpec(weight=-2)))
+
+    def test_hierarchy_root_prefix_added(self):
+        store = make_store()
+        q = Queue(metadata=ObjectMeta(name="qh", annotations={
+            "volcano.sh/hierarchy": "sci/dev",
+            "volcano.sh/hierarchy-weights": "2/3"}))
+        store.create("queues", q)
+        live = store.get("queues", "qh")
+        assert live.metadata.annotations["volcano.sh/hierarchy"] == "root/sci/dev"
+        assert live.metadata.annotations["volcano.sh/hierarchy-weights"] == "1/2/3"
+
+    def test_hierarchy_length_mismatch(self):
+        store = make_store()
+        q = Queue(metadata=ObjectMeta(name="qbad", annotations={
+            "volcano.sh/hierarchy": "root/a/b",
+            "volcano.sh/hierarchy-weights": "1/2"}))
+        with pytest.raises(AdmissionDenied, match="same length"):
+            store.create("queues", q)
+
+    def test_hierarchy_subpath_conflict(self):
+        store = make_store()
+        store.create("queues", Queue(metadata=ObjectMeta(name="qa", annotations={
+            "volcano.sh/hierarchy": "root/sci/dev",
+            "volcano.sh/hierarchy-weights": "1/2/3"})))
+        with pytest.raises(AdmissionDenied, match="sub path"):
+            store.create("queues", Queue(metadata=ObjectMeta(name="qb", annotations={
+                "volcano.sh/hierarchy": "root/sci",
+                "volcano.sh/hierarchy-weights": "1/2"})))
+
+    def test_default_queue_undeletable(self):
+        store = make_store()
+        with pytest.raises(AdmissionDenied, match="can not be deleted"):
+            store.delete("queues", "default")
+
+    def test_open_queue_undeletable(self):
+        store = make_store()
+        store.create("queues", build_queue("q1"))
+        with pytest.raises(AdmissionDenied, match="state `Closed`"):
+            store.delete("queues", "q1")
+        q = store.get("queues", "q1")
+        q.status.state = QueueState.CLOSED
+        store.update("queues", q, skip_admission=True)
+        store.delete("queues", "q1")   # now allowed
+        assert store.get("queues", "q1") is None
+
+
+class TestPodAdmission:
+    def test_vc_pod_blocked_while_podgroup_pending(self):
+        store = make_store()
+        pg = PodGroup(metadata=ObjectMeta(name="pg1"),
+                      spec=PodGroupSpec(min_member=1))
+        store.create("podgroups", pg, skip_admission=True)
+        pod = Pod(metadata=ObjectMeta(
+            name="p1", annotations={"scheduling.k8s.io/group-name": "pg1"}),
+            spec=PodSpec(containers=[Container()]))
+        with pytest.raises(AdmissionDenied, match="phase is Pending"):
+            store.create("pods", pod)
+
+    def test_pod_allowed_when_podgroup_inqueue(self):
+        store = make_store()
+        pg = PodGroup(metadata=ObjectMeta(name="pg2"),
+                      spec=PodGroupSpec(min_member=1))
+        pg.status.phase = "Inqueue"
+        store.create("podgroups", pg, skip_admission=True)
+        pod = Pod(metadata=ObjectMeta(
+            name="p2", annotations={"scheduling.k8s.io/group-name": "pg2"}),
+            spec=PodSpec(containers=[Container()]))
+        store.create("pods", pod)   # no raise
+
+    def test_bad_jdb_annotation(self):
+        store = make_store()
+        pod = Pod(metadata=ObjectMeta(
+            name="p3", annotations={"volcano.sh/jdb-min-available": "150%"}),
+            spec=PodSpec(containers=[Container()]))
+        with pytest.raises(AdmissionDenied, match="percentage"):
+            store.create("pods", pod)
+
+    def test_resource_group_mutation(self):
+        store = make_store()
+        set_resource_groups([ResGroupConfig(
+            resource_group="mgmt", object_key={"namespace": ["mgmt"]},
+            labels={"pool": "mgmt"},
+            tolerations=[Toleration(key="dedicated", value="mgmt")],
+            scheduler_name="default-scheduler")])
+        try:
+            pod = Pod(metadata=ObjectMeta(name="p4", namespace="mgmt"),
+                      spec=PodSpec(containers=[Container()]))
+            store.create("pods", pod)
+            live = store.get("pods", "p4", "mgmt")
+            assert live.spec.node_selector == {"pool": "mgmt"}
+            assert live.spec.tolerations[0].key == "dedicated"
+            assert live.spec.scheduler_name == "default-scheduler"
+        finally:
+            set_resource_groups([])
+
+
+class TestPodGroupAdmission:
+    def test_default_queue(self):
+        store = make_store()
+        pg = PodGroup(metadata=ObjectMeta(name="pgq"),
+                      spec=PodGroupSpec(min_member=1, queue=""))
+        store.create("podgroups", pg)
+        assert store.get("podgroups", "pgq").spec.queue == "default"
+
+
+class TestEnabledAdmission:
+    def test_disabled_service_not_enforced(self):
+        store = ObjectStore()
+        WebhookManager(store, enabled_admission="/jobs/mutate")
+        # validate disabled: a job with no tasks is accepted
+        store.create("jobs", Job(metadata=ObjectMeta(name="jx"),
+                                 spec=JobSpec(min_available=1)))
+        assert store.get("jobs", "jx") is not None

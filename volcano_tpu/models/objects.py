@@ -382,6 +382,8 @@ class JobEvent:
     UNSCHEDULABLE = "Unschedulable"
     POD_PENDING = "PodPending"
     TASK_COMPLETED = "TaskCompleted"
+    TASK_FAILED = "TaskFailed"
+    JOB_UNKNOWN = "JobUnknown"
     OUT_OF_SYNC = "OutOfSync"
     COMMAND_ISSUED = "CommandIssued"
     JOB_UPDATED = "JobUpdated"

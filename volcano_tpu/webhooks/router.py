@@ -1,0 +1,85 @@
+"""Admission router: service registry + manager
+(reference: pkg/webhooks/router/{interface,admission,server}.go and
+cmd/webhook-manager/app/server.go).
+
+An ``AdmissionService`` declares a path, the kind/operations it covers, and
+mutate/validate callables. The ``WebhookManager`` (the vc-webhook-manager
+process equivalent) registers every enabled service as an admission hook on
+the in-process store — the store's admission chain plays the role of the
+apiserver calling out to the webhook's TLS endpoint.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..apiserver.store import AdmissionError, AdmissionHook, ObjectStore
+
+
+class AdmissionDenied(AdmissionError):
+    """A validating webhook rejected the object."""
+
+
+@dataclass
+class AdmissionService:
+    """interface.go:38-48"""
+    path: str
+    kind: str
+    operations: Sequence[str] = ("CREATE",)
+    # mutate(store, operation, new_obj, old_obj) edits new_obj in place
+    mutate: Optional[Callable] = None
+    # validate(store, operation, new_obj, old_obj) raises AdmissionDenied
+    validate: Optional[Callable] = None
+
+
+_services: Dict[str, AdmissionService] = {}
+
+
+def register_admission(service: AdmissionService) -> None:
+    """router.RegisterAdmission equivalent (each webhook file's init())."""
+    _services[service.path] = service
+
+
+def get_service(path: str) -> Optional[AdmissionService]:
+    return _services.get(path)
+
+
+def all_services() -> List[AdmissionService]:
+    return list(_services.values())
+
+
+class WebhookManager:
+    """Registers enabled admission services with the store
+    (cmd/webhook-manager/app/server.go:64-87 registers webhook
+    configurations with the apiserver)."""
+
+    def __init__(self, store: ObjectStore,
+                 enabled_admission: Optional[str] = None):
+        """enabled_admission: comma-separated service paths
+        (the --enabled-admission flag); None enables all."""
+        self.store = store
+        if enabled_admission is None:
+            enabled = None
+        else:
+            enabled = {p.strip() for p in enabled_admission.split(",") if p.strip()}
+        self.services: List[AdmissionService] = [
+            s for s in all_services()
+            if enabled is None or s.path in enabled]
+        self._hooks: List[AdmissionHook] = []
+        for svc in self.services:
+            hook = AdmissionHook(
+                kind=svc.kind, path=svc.path,
+                mutate=self._bind(svc.mutate), validate=self._bind(svc.validate),
+                operations=tuple(svc.operations))
+            self._hooks.append(hook)
+            store.register_admission(hook)
+
+    def _bind(self, fn):
+        if fn is None:
+            return None
+        store = self.store
+
+        def bound(operation, new_obj, old_obj):
+            return fn(store, operation, new_obj, old_obj)
+        return bound
