@@ -1,0 +1,208 @@
+"""CLI + HTTP apiserver tests (reference: test/e2e/vcctl suite +
+pkg/cli tests)."""
+
+import pytest
+
+from volcano_tpu.apiserver import ObjectStore
+from volcano_tpu.apiserver.codec import decode_object, encode_object
+from volcano_tpu.apiserver.http import StoreClient, StoreHTTPServer
+from volcano_tpu.cli import vcctl
+from volcano_tpu.cli.singles import run_single
+from volcano_tpu.models.objects import (Job, JobPhase, ObjectMeta, Pod,
+                                        PodSpec, Secret, Toleration)
+from volcano_tpu.utils.test_utils import build_node, build_queue
+from volcano_tpu.webhooks import WebhookManager
+
+
+@pytest.fixture
+def store():
+    s = ObjectStore()
+    WebhookManager(s)
+    s.create("queues", build_queue("default"), skip_admission=True)
+    return s
+
+
+def run(store, *argv):
+    """Run vcctl against an in-process store, capturing output."""
+    import contextlib
+    import io
+    out, err = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+        code = vcctl.main(list(argv), client=store)
+    return code, out.getvalue().strip(), err.getvalue().strip()
+
+
+class TestCodec:
+    def test_round_trip_job(self):
+        from volcano_tpu.cli.job import run_job
+        store = ObjectStore()
+        store.create("queues", build_queue("default"))
+        run_job(store, "j1", replicas=3, min_available=2)
+        job = store.get("jobs", "j1")
+        data = encode_object("jobs", job)
+        import json
+        back = decode_object("jobs", json.loads(json.dumps(data)))
+        assert back.spec.tasks[0].replicas == 3
+        assert back.spec.min_available == 2
+        assert back.metadata.name == "j1"
+
+    def test_bytes_round_trip(self):
+        secret = Secret(metadata=ObjectMeta(name="s1"),
+                        data={"key": b"\x00\x01binary"})
+        back = decode_object("secrets", encode_object("secrets", secret))
+        assert back.data["key"] == b"\x00\x01binary"
+
+    def test_nested_toleration(self):
+        pod = Pod(metadata=ObjectMeta(name="p"),
+                  spec=PodSpec(tolerations=[Toleration(key="k", value="v")]))
+        back = decode_object("pods", encode_object("pods", pod))
+        assert back.spec.tolerations[0].key == "k"
+        assert isinstance(back.spec.tolerations[0], Toleration)
+
+
+class TestVcctlJob:
+    def test_run_and_list(self, store):
+        code, out, _ = run(store, "job", "run", "-N", "train", "-r", "3",
+                           "-m", "3")
+        assert code == 0 and "run job train successfully" in out
+        job = store.get("jobs", "train")
+        assert job.spec.min_available == 3
+        assert job.spec.tasks[0].replicas == 3
+
+        code, out, _ = run(store, "job", "list")
+        assert code == 0
+        assert out.split("\n")[1].startswith("train")
+
+    def test_run_requires_name(self, store):
+        code, _, err = run(store, "job", "run")
+        assert code == 1 and "name cannot be left blank" in err
+
+    def test_view(self, store):
+        run(store, "job", "run", "-N", "train", "-r", "2", "-m", "2")
+        code, out, _ = run(store, "job", "view", "-N", "train")
+        assert code == 0
+        assert "Name:       train" in out
+        assert "replicas=2" in out
+
+    def test_suspend_resume_create_commands(self, store):
+        run(store, "job", "run", "-N", "train")
+        code, out, _ = run(store, "job", "suspend", "-N", "train")
+        assert code == 0
+        cmds = store.list("commands")
+        assert len(cmds) == 1 and cmds[0].action == "AbortJob"
+        assert cmds[0].target_name == "train"
+        code, out, _ = run(store, "job", "resume", "-N", "train")
+        assert code == 0
+        assert any(c.action == "ResumeJob" for c in store.list("commands"))
+
+    def test_delete(self, store):
+        run(store, "job", "run", "-N", "train")
+        code, out, _ = run(store, "job", "delete", "-N", "train")
+        assert code == 0
+        assert store.get("jobs", "train") is None
+
+    def test_rejected_by_admission(self, store):
+        code, _, err = run(store, "job", "run", "-N", "train",
+                           "-q", "missing-queue")
+        assert code == 1 and "unable to find job queue" in err
+
+
+class TestVcctlQueue:
+    def test_create_list_get(self, store):
+        code, out, _ = run(store, "queue", "create", "-n", "q1", "-w", "4")
+        assert code == 0
+        code, out, _ = run(store, "queue", "list")
+        assert "q1" in out and "default" in out
+        code, out, _ = run(store, "queue", "get", "-n", "q1")
+        assert "q1" in out and "4" in out
+
+    def test_operate_update_weight(self, store):
+        run(store, "queue", "create", "-n", "q1", "-w", "1")
+        code, out, _ = run(store, "queue", "operate", "-n", "q1",
+                           "-a", "update", "-w", "7")
+        assert code == 0
+        assert store.get("queues", "q1").spec.weight == 7
+
+    def test_operate_close_creates_command(self, store):
+        run(store, "queue", "create", "-n", "q1")
+        code, _, _ = run(store, "queue", "operate", "-n", "q1", "-a", "close")
+        assert code == 0
+        cmds = store.list("commands")
+        assert cmds[0].action == "CloseQueue" and cmds[0].target_kind == "Queue"
+
+    def test_operate_invalid_action(self, store):
+        run(store, "queue", "create", "-n", "q1")
+        code, _, err = run(store, "queue", "operate", "-n", "q1", "-a", "bogus")
+        assert code == 1 and "invalid queue action" in err
+
+    def test_delete_open_queue_rejected(self, store):
+        run(store, "queue", "create", "-n", "q1")
+        code, _, err = run(store, "queue", "delete", "-n", "q1")
+        assert code == 1 and "Closed" in err
+
+
+class TestSingleVerbTools:
+    def test_vsub_vjobs_vcancel(self, store):
+        import contextlib
+        import io
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            assert run_single("vsub", ["-N", "j1"], client=store) == 0
+            assert run_single("vjobs", [], client=store) == 0
+            assert run_single("vsuspend", ["-N", "j1"], client=store) == 0
+            assert run_single("vcancel", ["-N", "j1"], client=store) == 0
+        text = out.getvalue()
+        assert "run job j1 successfully" in text
+        assert store.get("jobs", "j1") is None
+
+
+class TestHTTPServer:
+    def test_crud_over_http(self, store):
+        server = StoreHTTPServer(store, port=0)
+        server.start()
+        try:
+            client = StoreClient(f"http://127.0.0.1:{server.port}")
+            # create via HTTP goes through admission
+            from volcano_tpu.cli.job import run_job
+            assert "successfully" in run_job(client, "remote-job", replicas=2,
+                                             min_available=2)
+            job = client.get("jobs", "remote-job")
+            assert job is not None and job.spec.tasks[0].replicas == 2
+            # list
+            names = [j.metadata.name for j in client.list("jobs")]
+            assert "remote-job" in names
+            # update via HTTP (allowed field)
+            job.spec.tasks[0].replicas = 5
+            client.update("jobs", job)
+            assert store.get("jobs", "remote-job").spec.tasks[0].replicas == 5
+            # admission rejection surfaces as error
+            from volcano_tpu.apiserver.http import ApiError
+            job2 = client.get("jobs", "remote-job")
+            job2.spec.queue = "other"
+            with pytest.raises(ApiError) as exc:
+                client.update("jobs", job2)
+            assert exc.value.code == 422
+            # delete
+            client.delete("jobs", "remote-job")
+            assert client.get("jobs", "remote-job") is None
+            # cluster-scoped kind
+            client.create("nodes", build_node("n1", {"cpu": "4", "memory": "8Gi"}))
+            assert client.get("nodes", "n1") is not None
+        finally:
+            server.stop()
+
+    def test_vcctl_against_http(self, store):
+        server = StoreHTTPServer(store, port=0)
+        server.start()
+        try:
+            import contextlib
+            import io
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                code = vcctl.main(["--server",
+                                   f"http://127.0.0.1:{server.port}",
+                                   "job", "run", "-N", "httpjob"])
+            assert code == 0
+            assert store.get("jobs", "httpjob") is not None
+        finally:
+            server.stop()

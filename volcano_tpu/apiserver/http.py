@@ -1,0 +1,212 @@
+"""HTTP front-end for the object store + client.
+
+The reference's CLI and controllers speak REST to the Kubernetes API server;
+this module gives the standalone framework the same seam: a threaded HTTP
+server over an :class:`ObjectStore` and a client exposing the store's CRUD
+interface over the wire. Watches stay in-process (scheduler/controllers run
+in the serving process; SURVEY.md section 5.8).
+
+Routes (namespaced kinds):
+    GET    /apis/{kind}?namespace=ns      list
+    GET    /apis/{kind}/{ns}/{name}       get
+    POST   /apis/{kind}                   create
+    PUT    /apis/{kind}/{ns}/{name}       update
+    DELETE /apis/{kind}/{ns}/{name}       delete
+Cluster-scoped kinds use /apis/{kind}/{name}.
+Admission rejections -> 422, conflicts -> 409, missing -> 404.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .codec import decode_object, encode_object
+from .store import (CLUSTER_SCOPED, KINDS, AdmissionError, ConflictError,
+                    ObjectStore)
+
+
+class StoreHTTPServer:
+    def __init__(self, store: ObjectStore, host: str = "127.0.0.1",
+                 port: int = 8181):
+        self.store = store
+        handler = self._make_handler()
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    def _make_handler(self):
+        store = self.store
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _send(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _parse(self):
+                parsed = urllib.parse.urlparse(self.path)
+                parts = [p for p in parsed.path.split("/") if p]
+                query = urllib.parse.parse_qs(parsed.query)
+                if len(parts) < 2 or parts[0] != "apis" or parts[1] not in KINDS:
+                    return None
+                kind = parts[1]
+                rest = parts[2:]
+                if kind in CLUSTER_SCOPED:
+                    name = rest[0] if rest else None
+                    ns = "default"
+                else:
+                    ns = rest[0] if len(rest) >= 2 else \
+                        (query.get("namespace", ["default"])[0])
+                    name = rest[1] if len(rest) >= 2 else None
+                return kind, ns, name, query
+
+            def _body(self):
+                length = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(length)) if length else None
+
+            def do_GET(self):
+                route = self._parse()
+                if route is None:
+                    return self._send(404, {"error": "not found"})
+                kind, ns, name, query = route
+                if name is None:
+                    namespace = query.get("namespace", [None])[0]
+                    items = store.list(kind, namespace)
+                    return self._send(200, {"items": [
+                        encode_object(kind, o) for o in items]})
+                o = store.get(kind, name, ns)
+                if o is None:
+                    return self._send(404, {"error": f"{kind} {name} not found"})
+                return self._send(200, encode_object(kind, o))
+
+            def do_POST(self):
+                route = self._parse()
+                if route is None:
+                    return self._send(404, {"error": "not found"})
+                kind, _ns, _name, _q = route
+                try:
+                    o = decode_object(kind, self._body())
+                    created = store.create(kind, o)
+                    return self._send(201, encode_object(kind, created))
+                except AdmissionError as e:
+                    return self._send(422, {"error": str(e)})
+                except KeyError as e:
+                    return self._send(409, {"error": str(e)})
+
+            def do_PUT(self):
+                route = self._parse()
+                if route is None:
+                    return self._send(404, {"error": "not found"})
+                kind, _ns, _name, _q = route
+                try:
+                    o = decode_object(kind, self._body())
+                    updated = store.update(kind, o)
+                    return self._send(200, encode_object(kind, updated))
+                except ConflictError as e:
+                    return self._send(409, {"error": str(e)})
+                except AdmissionError as e:
+                    return self._send(422, {"error": str(e)})
+                except KeyError as e:
+                    return self._send(404, {"error": str(e)})
+
+            def do_DELETE(self):
+                route = self._parse()
+                if route is None or route[2] is None:
+                    return self._send(404, {"error": "not found"})
+                kind, ns, name, _q = route
+                try:
+                    store.delete(kind, name, ns)
+                    return self._send(200, {"status": "deleted"})
+                except AdmissionError as e:
+                    return self._send(422, {"error": str(e)})
+                except KeyError as e:
+                    return self._send(404, {"error": str(e)})
+
+        return Handler
+
+    def start(self) -> threading.Thread:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._thread
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class StoreClient:
+    """Remote client mirroring the ObjectStore CRUD surface."""
+
+    def __init__(self, base_url: str):
+        self.base_url = base_url.rstrip("/")
+
+    def _request(self, method: str, path: str, payload=None):
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            f"{self.base_url}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                message = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                message = str(e)
+            raise ApiError(e.code, message) from None
+
+    def _path(self, kind: str, name: Optional[str] = None,
+              namespace: str = "default") -> str:
+        if name is None:
+            return f"/apis/{kind}"
+        if kind in CLUSTER_SCOPED:
+            return f"/apis/{kind}/{name}"
+        return f"/apis/{kind}/{namespace}/{name}"
+
+    def get(self, kind: str, name: str, namespace: str = "default"):
+        try:
+            data = self._request("GET", self._path(kind, name, namespace))
+        except ApiError as e:
+            if e.code == 404:
+                return None
+            raise
+        return decode_object(kind, data)
+
+    def list(self, kind: str, namespace: Optional[str] = None) -> list:
+        path = self._path(kind)
+        if namespace is not None:
+            path += f"?namespace={urllib.parse.quote(namespace)}"
+        data = self._request("GET", path)
+        return [decode_object(kind, item) for item in data["items"]]
+
+    def create(self, kind: str, o):
+        data = self._request("POST", self._path(kind), encode_object(kind, o))
+        return decode_object(kind, data)
+
+    def update(self, kind: str, o):
+        data = self._request(
+            "PUT", self._path(kind, o.metadata.name, o.metadata.namespace),
+            encode_object(kind, o))
+        return decode_object(kind, data)
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        self._request("DELETE", self._path(kind, name, namespace))

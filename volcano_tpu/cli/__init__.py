@@ -1,0 +1,5 @@
+"""CLI (reference: pkg/cli + cmd/cli): vcctl plus the single-verb tools."""
+
+from .vcctl import build_parser, dispatch, main
+
+__all__ = ["build_parser", "dispatch", "main"]

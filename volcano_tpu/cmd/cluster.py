@@ -1,0 +1,102 @@
+"""Single-process control plane runner.
+
+Runs the whole framework in one process: object store + admission webhooks +
+controllers + scheduler + HTTP API endpoint (+ optional simulated kubelets),
+the standalone equivalent of deploying the reference's three binaries and
+CRDs onto a cluster (installer/volcano-development.yaml).
+
+    python -m volcano_tpu.cmd.cluster --port 8181 --nodes 4 \
+        --node-resources cpu=16,memory=32Gi
+
+Then drive it with vcctl:
+
+    python -m volcano_tpu.cli.vcctl job run -N demo -r 4 -m 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from ..apiserver.http import StoreHTTPServer
+from ..apiserver.store import ObjectStore
+from ..cli.util import parse_resource_list
+from ..controllers import ControllerManager
+from ..models.objects import Queue, ObjectMeta, QueueSpec
+from ..scheduler import Scheduler
+from ..utils.kubelet import SimulatedKubelet
+from ..utils.test_utils import build_node
+from ..webhooks import WebhookManager
+
+
+def build_cluster(port: int = 8181, nodes: int = 0,
+                  node_resources: str = "cpu=8,memory=16Gi",
+                  scheduler_conf: str = None, schedule_period: float = 1.0,
+                  simulate_kubelet: bool = True):
+    store = ObjectStore()
+    WebhookManager(store)
+    store.create("queues", Queue(metadata=ObjectMeta(name="default"),
+                                 spec=QueueSpec(weight=1)),
+                 skip_admission=True)
+    for i in range(nodes):
+        store.create("nodes", build_node(
+            f"node-{i}", parse_resource_list(node_resources)))
+    manager = ControllerManager(store)
+    kubelet = SimulatedKubelet(store) if simulate_kubelet else None
+    scheduler = Scheduler(store, scheduler_conf_path=scheduler_conf,
+                          schedule_period=schedule_period)
+    server = StoreHTTPServer(store, port=port)
+    return store, manager, kubelet, scheduler, server
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="vc-cluster")
+    parser.add_argument("--port", type=int, default=8181)
+    parser.add_argument("--nodes", type=int, default=0,
+                        help="number of simulated nodes to create")
+    parser.add_argument("--node-resources", default="cpu=8,memory=16Gi")
+    parser.add_argument("--scheduler-conf", default=None,
+                        help="scheduler conf YAML path (hot-reloaded)")
+    parser.add_argument("--schedule-period", type=float, default=1.0)
+    parser.add_argument("--no-kubelet", action="store_true",
+                        help="do not simulate pod execution")
+    args = parser.parse_args(argv)
+
+    store, manager, kubelet, scheduler, server = build_cluster(
+        port=args.port, nodes=args.nodes, node_resources=args.node_resources,
+        scheduler_conf=args.scheduler_conf,
+        schedule_period=args.schedule_period,
+        simulate_kubelet=not args.no_kubelet)
+
+    stop = threading.Event()
+
+    def tick_kubelet():
+        while not stop.is_set():
+            kubelet.tick()
+            stop.wait(0.2)
+
+    manager.start()
+    scheduler.start()
+    server.start()
+    if kubelet is not None:
+        threading.Thread(target=tick_kubelet, daemon=True).start()
+    print(f"volcano-tpu control plane listening on :{server.port} "
+          f"({args.nodes} nodes)")
+
+    def shutdown(*_):
+        stop.set()
+        scheduler.stop()
+        manager.stop()
+        server.stop()
+        sys.exit(0)
+
+    signal.signal(signal.SIGINT, shutdown)
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.pause()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
