@@ -9,6 +9,7 @@ from . import proportion  # noqa: F401
 from . import nodeorder  # noqa: F401
 from . import overcommit  # noqa: F401
 from . import sla  # noqa: F401
+from . import numaaware  # noqa: F401
 from . import task_topology  # noqa: F401
 from . import tdm  # noqa: F401
 from . import predicates  # noqa: F401
