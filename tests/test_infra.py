@@ -1,0 +1,124 @@
+"""Infrastructure tests: leader election, dynamic plugin loading, metrics
+exposition, version (reference: leader election in cmd/*/app/server.go,
+LoadCustomPlugins in framework/plugins.go:62-101, metrics endpoint)."""
+
+import textwrap
+import urllib.request
+
+from volcano_tpu.apiserver import ObjectStore
+from volcano_tpu.framework.registry import (get_plugin_builder,
+                                            load_plugins_dir)
+from volcano_tpu.metrics import metrics as m
+from volcano_tpu.metrics.server import MetricsServer
+from volcano_tpu.utils.clock import FakeClock
+from volcano_tpu.utils.leaderelection import LeaderElector
+from volcano_tpu.version import version_string
+
+
+class TestLeaderElection:
+    def _elector(self, store, ident, events):
+        return LeaderElector(
+            store, ident, lease_name="vc-test", lease_duration=15.0,
+            on_started_leading=lambda: events.append(f"{ident}:start"),
+            on_stopped_leading=lambda: events.append(f"{ident}:stop"),
+            on_new_leader=lambda who: events.append(f"{ident}:sees:{who}"))
+
+    def test_first_candidate_wins(self):
+        clock = FakeClock(0.0)
+        store = ObjectStore(clock=clock)
+        events = []
+        a = self._elector(store, "a", events)
+        b = self._elector(store, "b", events)
+        assert a.step() is True
+        assert b.step() is False
+        assert "a:start" in events and "b:sees:a" in events
+
+    def test_lease_renewal_keeps_leadership(self):
+        clock = FakeClock(0.0)
+        store = ObjectStore(clock=clock)
+        events = []
+        a = self._elector(store, "a", events)
+        b = self._elector(store, "b", events)
+        a.step()
+        for _ in range(5):
+            clock.advance(10)     # under the 15s lease each time
+            assert a.step() is True
+            assert b.step() is False
+
+    def test_takeover_after_lease_expiry(self):
+        clock = FakeClock(0.0)
+        store = ObjectStore(clock=clock)
+        events = []
+        a = self._elector(store, "a", events)
+        b = self._elector(store, "b", events)
+        a.step()
+        clock.advance(20)         # leader a went silent past the lease
+        assert b.step() is True
+        assert "b:start" in events
+        # a comes back, observes it lost
+        assert a.step() is False
+        assert "a:stop" in events
+
+    def test_release_hands_over_immediately(self):
+        clock = FakeClock(0.0)
+        store = ObjectStore(clock=clock)
+        events = []
+        a = self._elector(store, "a", events)
+        b = self._elector(store, "b", events)
+        a.step()
+        a.release()
+        clock.advance(1)          # well inside the lease window
+        assert b.step() is True
+
+
+class TestDynamicPluginLoading:
+    def test_load_plugins_dir(self, tmp_path):
+        (tmp_path / "myplugin.py").write_text(textwrap.dedent("""
+            from volcano_tpu.framework.plugin import Plugin
+
+            class MyPlugin(Plugin):
+                def __init__(self, arguments=None):
+                    self.arguments = arguments
+                def name(self):
+                    return "my-plugin"
+                def on_session_open(self, ssn):
+                    pass
+
+            def Name():
+                return "my-plugin"
+
+            def New(arguments):
+                return MyPlugin(arguments)
+        """))
+        (tmp_path / "_ignored.py").write_text("raise RuntimeError('no')")
+        (tmp_path / "broken.py").write_text("this is ( not python")
+        loaded = load_plugins_dir(str(tmp_path))
+        assert loaded == ["my-plugin"]
+        builder = get_plugin_builder("my-plugin")
+        assert builder is not None
+        assert builder({}).name() == "my-plugin"
+
+    def test_missing_dir_is_noop(self):
+        assert load_plugins_dir("/nonexistent/path") == []
+
+
+class TestMetricsServer:
+    def test_prometheus_exposition(self):
+        m.reset()
+        m.update_e2e_duration(0.5)
+        m.update_queue_share("default", 0.25)
+        server = MetricsServer(port=0)
+        server.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=5).read().decode()
+            assert "volcano_e2e_scheduling_latency_milliseconds" in body
+            assert "volcano_queue_share" in body
+        finally:
+            server.stop()
+
+
+class TestVersion:
+    def test_version_string(self):
+        s = version_string()
+        assert "volcano-tpu version" in s and "Python version" in s

@@ -24,6 +24,7 @@ from ..apiserver.http import StoreHTTPServer
 from ..apiserver.store import ObjectStore
 from ..cli.util import parse_resource_list
 from ..controllers import ControllerManager
+from ..framework.registry import load_plugins_dir
 from ..models.objects import Queue, ObjectMeta, QueueSpec
 from ..scheduler import Scheduler
 from ..utils.kubelet import SimulatedKubelet
@@ -34,15 +35,18 @@ from ..webhooks import WebhookManager
 def build_cluster(port: int = 8181, nodes: int = 0,
                   node_resources: str = "cpu=8,memory=16Gi",
                   scheduler_conf: str = None, schedule_period: float = 1.0,
-                  simulate_kubelet: bool = True):
+                  simulate_kubelet: bool = True,
+                  enabled_admission: str = None, plugins_dir: str = None):
     store = ObjectStore()
-    WebhookManager(store)
+    WebhookManager(store, enabled_admission=enabled_admission)
     store.create("queues", Queue(metadata=ObjectMeta(name="default"),
                                  spec=QueueSpec(weight=1)),
                  skip_admission=True)
     for i in range(nodes):
         store.create("nodes", build_node(
             f"node-{i}", parse_resource_list(node_resources)))
+    if plugins_dir:
+        load_plugins_dir(plugins_dir)
     manager = ControllerManager(store)
     kubelet = SimulatedKubelet(store) if simulate_kubelet else None
     scheduler = Scheduler(store, scheduler_conf_path=scheduler_conf,
@@ -62,13 +66,32 @@ def main(argv=None) -> int:
     parser.add_argument("--schedule-period", type=float, default=1.0)
     parser.add_argument("--no-kubelet", action="store_true",
                         help="do not simulate pod execution")
+    parser.add_argument("--enabled-admission", default=None,
+                        help="comma-separated admission paths to enable")
+    parser.add_argument("--plugins-dir", default=None,
+                        help="directory of custom scheduler plugin .py files")
+    parser.add_argument("--listen-address", default=None,
+                        help="host:port for the Prometheus /metrics endpoint")
+    parser.add_argument("--version", action="store_true")
     args = parser.parse_args(argv)
+    if args.version:
+        from ..version import print_version_and_exit
+        print_version_and_exit()
 
     store, manager, kubelet, scheduler, server = build_cluster(
         port=args.port, nodes=args.nodes, node_resources=args.node_resources,
         scheduler_conf=args.scheduler_conf,
         schedule_period=args.schedule_period,
-        simulate_kubelet=not args.no_kubelet)
+        simulate_kubelet=not args.no_kubelet,
+        enabled_admission=args.enabled_admission,
+        plugins_dir=args.plugins_dir)
+
+    metrics_server = None
+    if args.listen_address:
+        from ..metrics.server import MetricsServer
+        host, _, port_s = args.listen_address.rpartition(":")
+        metrics_server = MetricsServer(host or "127.0.0.1", int(port_s))
+        metrics_server.start()
 
     stop = threading.Event()
 
@@ -90,6 +113,8 @@ def main(argv=None) -> int:
         scheduler.stop()
         manager.stop()
         server.stop()
+        if metrics_server is not None:
+            metrics_server.stop()
         sys.exit(0)
 
     signal.signal(signal.SIGINT, shutdown)

@@ -1,0 +1,74 @@
+"""vc-scheduler binary equivalent (reference: cmd/scheduler/app/server.go).
+
+Runs the scheduler component alone against an embedded store with leader
+election and a Prometheus endpoint. For a full control plane in one
+process use cmd.cluster; this entry point exists for component-parity and
+HA topologies where several scheduler candidates share one store.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+
+from ..apiserver.store import ObjectStore
+from ..scheduler import Scheduler
+from ..utils.leaderelection import LeaderElector
+
+
+def add_flags(parser: argparse.ArgumentParser) -> None:
+    """cmd/scheduler/app/options/options.go:81-108"""
+    parser.add_argument("--scheduler-name", default="volcano")
+    parser.add_argument("--scheduler-conf", default=None)
+    parser.add_argument("--schedule-period", type=float, default=1.0)
+    parser.add_argument("--default-queue", default="default")
+    parser.add_argument("--leader-elect", action="store_true")
+    parser.add_argument("--lock-object-namespace", default="volcano-system")
+    parser.add_argument("--listen-address", default=":8080")
+    parser.add_argument("--plugins-dir", default=None)
+    parser.add_argument("--percentage-nodes-to-find", type=int, default=0,
+                        help="accepted for flag parity; the TPU solver "
+                             "evaluates all nodes exhaustively")
+    parser.add_argument("--version", action="store_true")
+
+
+def run_scheduler(store: ObjectStore, args) -> Scheduler:
+    if args.plugins_dir:
+        from ..framework.registry import load_plugins_dir
+        load_plugins_dir(args.plugins_dir)
+    scheduler = Scheduler(store, scheduler_name=args.scheduler_name,
+                          scheduler_conf_path=args.scheduler_conf,
+                          schedule_period=args.schedule_period)
+    if args.leader_elect:
+        identity = f"{os.uname().nodename}-{os.getpid()}"
+        elector = LeaderElector(
+            store, identity, lease_name="vc-scheduler",
+            on_started_leading=scheduler.start,
+            on_stopped_leading=scheduler.stop)
+        elector.start()
+    else:
+        scheduler.start()
+    return scheduler
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="vc-scheduler")
+    add_flags(parser)
+    args = parser.parse_args(argv)
+    if args.version:
+        from ..version import print_version_and_exit
+        print_version_and_exit()
+    store = ObjectStore()
+    run_scheduler(store, args)
+    from ..metrics.server import MetricsServer
+    host, _, port_s = args.listen_address.rpartition(":")
+    MetricsServer(host or "127.0.0.1", int(port_s)).start()
+    print("vc-scheduler running (embedded store)")
+    threading.Event().wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

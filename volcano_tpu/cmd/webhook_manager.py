@@ -1,0 +1,42 @@
+"""vc-webhook-manager binary equivalent
+(reference: cmd/webhook-manager/app/server.go): registers the enabled
+admission services on a store and exposes it over HTTP.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+
+from ..apiserver.http import StoreHTTPServer
+from ..apiserver.store import ObjectStore
+from ..webhooks import WebhookManager
+
+
+def add_flags(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--enabled-admission", default=None,
+                        help="comma-separated admission service paths")
+    parser.add_argument("--port", type=int, default=8443)
+    parser.add_argument("--version", action="store_true")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="vc-webhook-manager")
+    add_flags(parser)
+    args = parser.parse_args(argv)
+    if args.version:
+        from ..version import print_version_and_exit
+        print_version_and_exit()
+    store = ObjectStore()
+    manager = WebhookManager(store, enabled_admission=args.enabled_admission)
+    server = StoreHTTPServer(store, port=args.port)
+    server.start()
+    print(f"vc-webhook-manager serving {len(manager.services)} admission "
+          f"services on :{server.port}")
+    threading.Event().wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,38 @@ def get_action(name: str) -> Optional[object]:
     return _actions.get(name)
 
 
+def load_plugins_dir(plugins_dir: str) -> list:
+    """Load every *.py file in ``plugins_dir`` as a plugin module exposing
+    ``New(arguments) -> Plugin`` (and optionally ``Name() -> str``) — the
+    --plugins-dir flag equivalent of the reference's plugin.Open +
+    Lookup("New") over .so files (framework/plugins.go:62-101).
+
+    Returns the list of plugin names registered."""
+    import importlib.util
+    import os
+    loaded = []
+    if not plugins_dir or not os.path.isdir(plugins_dir):
+        return loaded
+    for fname in sorted(os.listdir(plugins_dir)):
+        if not fname.endswith(".py") or fname.startswith("_"):
+            continue
+        path = os.path.join(plugins_dir, fname)
+        mod_name = f"volcano_tpu_custom_{fname[:-3]}"
+        try:
+            spec = importlib.util.spec_from_file_location(mod_name, path)
+            module = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(module)
+            new = getattr(module, "New", None)
+            if new is None:
+                continue
+            name = module.Name() if hasattr(module, "Name") else fname[:-3]
+            register_plugin_builder(name, new)
+            loaded.append(name)
+        except Exception:
+            continue
+    return loaded
+
+
 def load_custom_plugins(group: str = "volcano_tpu.plugins") -> None:
     """Discover out-of-tree plugin builders via entry points."""
     try:

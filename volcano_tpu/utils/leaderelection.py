@@ -1,0 +1,129 @@
+"""Leader election over the object store (reference: the scheduler and
+controller-manager's resource-lock leader election,
+cmd/scheduler/app/server.go:45-96 leaderelection.RunOrDie).
+
+Multiple candidate processes/threads race on a lease held in a ConfigMap
+(the reference's configmap resource lock); the holder renews before
+``lease_duration`` expires, standbys take over when it lapses. Callbacks
+mirror client-go: on_started_leading / on_stopped_leading / on_new_leader.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..apiserver.store import ConflictError
+from ..models.objects import ConfigMap, ObjectMeta
+
+LOCK_NAMESPACE = "volcano-system"
+
+HOLDER_KEY = "holderIdentity"
+RENEW_KEY = "renewTime"
+
+
+class LeaderElector:
+    def __init__(self, store, identity: str,
+                 lease_name: str = "vc-scheduler",
+                 lease_duration: float = 15.0,
+                 retry_period: float = 5.0,
+                 on_started_leading: Optional[Callable] = None,
+                 on_stopped_leading: Optional[Callable] = None,
+                 on_new_leader: Optional[Callable[[str], None]] = None):
+        self.store = store
+        self.identity = identity
+        self.lease_name = lease_name
+        self.lease_duration = lease_duration
+        self.retry_period = retry_period
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.on_new_leader = on_new_leader
+        self.is_leader = False
+        self._observed_leader = ""
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lock handling -----------------------------------------------------
+
+    def _try_acquire_or_renew(self) -> bool:
+        now = self.store.clock.now()
+        lease = self.store.get("configmaps", self.lease_name, LOCK_NAMESPACE)
+        if lease is None:
+            try:
+                self.store.create("configmaps", ConfigMap(
+                    metadata=ObjectMeta(name=self.lease_name,
+                                        namespace=LOCK_NAMESPACE),
+                    data={HOLDER_KEY: self.identity, RENEW_KEY: str(now)}),
+                    skip_admission=True)
+                return True
+            except KeyError:
+                return False
+        holder = lease.data.get(HOLDER_KEY, "")
+        renew = float(lease.data.get(RENEW_KEY, "0"))
+        if holder and holder != self.identity and \
+                now - renew < self.lease_duration:
+            self._observe(holder)
+            return False
+        # our lease, or an expired one: take/renew it (optimistic write —
+        # a concurrent standby loses on the resource-version conflict)
+        lease.data[HOLDER_KEY] = self.identity
+        lease.data[RENEW_KEY] = str(now)
+        try:
+            self.store.update("configmaps", lease, skip_admission=True)
+        except (ConflictError, KeyError):
+            return False
+        return True
+
+    def _observe(self, holder: str) -> None:
+        if holder != self._observed_leader:
+            self._observed_leader = holder
+            if self.on_new_leader is not None:
+                self.on_new_leader(holder)
+
+    # -- loop ---------------------------------------------------------------
+
+    def step(self) -> bool:
+        """One election round; returns current leadership. Deterministic
+        entry point for tests and for external pacing."""
+        acquired = self._try_acquire_or_renew()
+        if acquired and not self.is_leader:
+            self.is_leader = True
+            self._observe(self.identity)
+            if self.on_started_leading is not None:
+                self.on_started_leading()
+        elif not acquired and self.is_leader:
+            self.is_leader = False
+            if self.on_stopped_leading is not None:
+                self.on_stopped_leading()
+        return self.is_leader
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            self.step()
+            self._stop.wait(self.retry_period)
+        self.release()
+
+    def start(self) -> threading.Thread:
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self._thread
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def release(self) -> None:
+        """Voluntarily give up the lease on shutdown (leader transition is
+        immediate instead of waiting out the lease)."""
+        if not self.is_leader:
+            return
+        lease = self.store.get("configmaps", self.lease_name, LOCK_NAMESPACE)
+        if lease is not None and lease.data.get(HOLDER_KEY) == self.identity:
+            lease.data[HOLDER_KEY] = ""
+            lease.data[RENEW_KEY] = "0"
+            try:
+                self.store.update("configmaps", lease, skip_admission=True)
+            except (ConflictError, KeyError):
+                pass
+        self.is_leader = False
+        if self.on_stopped_leading is not None:
+            self.on_stopped_leading()
