@@ -1,0 +1,38 @@
+"""Example out-of-tree scheduler plugin (reference: example/custom-plugin).
+
+Load with:  vc-cluster --plugins-dir examples/custom-plugin
+or install a package exposing it in the ``volcano_tpu.plugins`` entry-point
+group. The loader looks for ``New(arguments)`` and optionally ``Name()``.
+
+This plugin adds a node-order preference for nodes carrying a label.
+"""
+
+from volcano_tpu.framework.plugin import Plugin
+
+PLUGIN_NAME = "magic"
+
+
+class MagicPlugin(Plugin):
+    def __init__(self, arguments=None):
+        args = arguments or {}
+        get = args.get if hasattr(args, "get") else (lambda k, d=None: d)
+        self.label = str(get("magic.label", "magic") or "magic")
+        self.weight = float(get("magic.weight", 10) or 10)
+
+    def name(self) -> str:
+        return PLUGIN_NAME
+
+    def on_session_open(self, ssn) -> None:
+        def node_order_fn(task, node) -> float:
+            labels = node.node.metadata.labels if node.node is not None else {}
+            return self.weight if self.label in labels else 0.0
+
+        ssn.add_node_order_fn(PLUGIN_NAME, node_order_fn)
+
+
+def Name() -> str:
+    return PLUGIN_NAME
+
+
+def New(arguments):
+    return MagicPlugin(arguments)
