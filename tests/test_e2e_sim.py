@@ -1,0 +1,234 @@
+"""End-to-end simulated-cluster suites.
+
+The reference runs ginkgo e2e suites against a kind cluster with
+containerized fake nodes (SURVEY.md section 4.3: schedulingbase,
+schedulingaction, jobp, jobseq, vcctl). Here the same scenarios run against
+the in-process control plane (store + webhooks + controllers + scheduler +
+simulated kubelets) — no cluster required, same behavioral coverage.
+"""
+
+import pytest
+
+from tests.test_controllers import CONF, Cluster, make_job
+from volcano_tpu.cli import vcctl
+from volcano_tpu.models import objects as obj
+from volcano_tpu.models.objects import (Command, Container, JobAction,
+                                        JobPhase, LifecyclePolicy, ObjectMeta,
+                                        PodSpec, PodTemplate, TaskSpec)
+from volcano_tpu.utils.test_utils import build_node, build_queue
+
+
+def run_cli(cl, *argv):
+    import contextlib
+    import io
+    out, err = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+        code = vcctl.main(list(argv), client=cl.store)
+    return code, out.getvalue(), err.getvalue()
+
+
+class TestSchedulingBase:
+    """test/e2e/schedulingbase — basic gang scheduling and queues."""
+
+    def test_gang_waits_for_full_capacity(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "4", "memory": "8Gi"}))
+        # 6 x 1cpu gang cannot fit a 4-cpu cluster: stays Pending, no pods run
+        cl.store.create("jobs", make_job(replicas=6, min_available=6))
+        cl.converge(cycles=3)
+        job = cl.store.get("jobs", "job1")
+        assert job.status.state.phase == JobPhase.PENDING
+        assert all(not p.spec.node_name for p in cl.store.list("pods"))
+        # capacity arrives -> gang goes Running atomically
+        cl.store.create("nodes", build_node("n2", {"cpu": "8", "memory": "16Gi"}))
+        cl.converge(cycles=3)
+        assert cl.store.get("jobs", "job1").status.state.phase == JobPhase.RUNNING
+
+    def test_two_queues_share_by_weight(self):
+        cl = Cluster()
+        cl.store.create("queues", build_queue("q-heavy", weight=3))
+        cl.store.create("queues", build_queue("q-light", weight=1))
+        for i in range(2):
+            cl.store.create("nodes",
+                            build_node(f"n{i}", {"cpu": "8", "memory": "16Gi"}))
+        for q in ("q-heavy", "q-light"):
+            for j in range(4):
+                cl.store.create("jobs", make_job(
+                    name=f"{q}-j{j}", replicas=1, min_available=1, queue=q))
+        cl.converge(cycles=4)
+        running = [j.metadata.name for j in cl.store.list("jobs")
+                   if j.status.state.phase == JobPhase.RUNNING]
+        heavy = sum(1 for n in running if n.startswith("q-heavy"))
+        light = sum(1 for n in running if n.startswith("q-light"))
+        # 16 cpu total, 8 jobs x 1cpu -> everything fits; both queues served
+        assert heavy == 4 and light == 4
+
+    def test_job_to_closed_queue_rejected(self):
+        cl = Cluster()
+        q = build_queue("closed-q")
+        q.status.state = "Closed"
+        cl.store.create("queues", q, skip_admission=True)
+        from volcano_tpu.webhooks import AdmissionDenied
+        with pytest.raises(AdmissionDenied):
+            cl.store.create("jobs", make_job(name="jx", queue="closed-q"))
+
+
+class TestSchedulingAction:
+    """test/e2e/schedulingaction — allocate/backfill behaviors."""
+
+    def test_backfill_places_best_effort_pods(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "2", "memory": "4Gi"}))
+        # best-effort task: no requests at all
+        tasks = [TaskSpec(name="be", replicas=1, template=PodTemplate(
+            spec=PodSpec(containers=[Container()])))]
+        cl.store.create("jobs", make_job(tasks=tasks, min_available=1))
+        cl.converge(cycles=3)
+        assert cl.store.get("jobs", "job1").status.state.phase == JobPhase.RUNNING
+
+    def test_scale_up_job_replicas(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "16", "memory": "32Gi"}))
+        cl.store.create("jobs", make_job(replicas=2, min_available=2))
+        cl.converge(cycles=3)
+        assert len(cl.store.list("pods")) == 2
+        job = cl.store.get("jobs", "job1")
+        job.spec.tasks[0].replicas = 5
+        cl.store.update("jobs", job)
+        cl.converge(cycles=3)
+        assert len(cl.store.list("pods")) == 5
+
+    def test_scale_down_deletes_excess_pods(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "16", "memory": "32Gi"}))
+        cl.store.create("jobs", make_job(replicas=4, min_available=2))
+        cl.converge(cycles=3)
+        assert len(cl.store.list("pods")) == 4
+        job = cl.store.get("jobs", "job1")
+        job.spec.tasks[0].replicas = 2
+        cl.store.update("jobs", job)
+        cl.converge(cycles=3)
+        assert len(cl.store.list("pods")) == 2
+
+
+class TestJobP:
+    """test/e2e/jobp — lifecycle, admission, min-success."""
+
+    def test_min_success(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "16", "memory": "32Gi"}))
+        cl.store.create("jobs", make_job(replicas=4, min_available=4,
+                                         min_success=2))
+        cl.converge(cycles=3)
+        for i in range(2):
+            cl.kubelet.complete("default", f"job1-task-{i}")
+        cl.manager.sync()
+        assert cl.store.get("jobs", "job1").status.state.phase == \
+            JobPhase.COMPLETED
+
+    def test_job_phase_sequence_recorded(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "16", "memory": "32Gi"}))
+        job = make_job(min_success=1)
+        for t in job.spec.tasks:
+            t.template.metadata.annotations["volcano.sh/sim-duration"] = "5"
+        cl.store.create("jobs", job)
+        cl.manager.sync()
+        assert cl.store.get("jobs", "job1").status.state.phase == JobPhase.PENDING
+        cl.converge(cycles=3)
+        assert cl.store.get("jobs", "job1").status.state.phase == JobPhase.RUNNING
+        cl.clock.advance(6)
+        cl.converge(cycles=2)
+        assert cl.store.get("jobs", "job1").status.state.phase == JobPhase.COMPLETED
+
+
+class TestJobSeq:
+    """test/e2e/jobseq — distributed workloads + error-handling policies."""
+
+    def _mpi_job(self):
+        return make_job(
+            name="mpi", min_available=3,
+            plugins={"svc": [], "ssh": [], "env": []},
+            tasks=[
+                TaskSpec(name="mpimaster", replicas=1, template=PodTemplate(
+                    spec=PodSpec(containers=[Container(
+                        requests={"cpu": "1", "memory": "1Gi"})]))),
+                TaskSpec(name="mpiworker", replicas=2, template=PodTemplate(
+                    spec=PodSpec(containers=[Container(
+                        requests={"cpu": "2", "memory": "2Gi"})]))),
+            ])
+
+    def test_mpi_shaped_job_runs_with_hostfile_and_keys(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "16", "memory": "32Gi"}))
+        cl.store.create("jobs", self._mpi_job())
+        cl.converge(cycles=3)
+        assert cl.store.get("jobs", "mpi").status.state.phase == JobPhase.RUNNING
+        cm = cl.store.get("configmaps", "mpi-svc")
+        assert "mpi-mpiworker-0.mpi" in cm.data["mpiworker.host"]
+        assert cl.store.get("secrets", "mpi-ssh") is not None
+        # every pod sees the worker host list
+        pod = cl.store.get("pods", "mpi-mpimaster-0")
+        assert "mpi-mpiworker-1.mpi" in pod.spec.containers[0].env["VC_MPIWORKER_HOSTS"]
+
+    def test_pod_failed_policy_restart_task_level(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "16", "memory": "32Gi"}))
+        job = self._mpi_job()
+        job.spec.policies = [LifecyclePolicy(event="PodFailed",
+                                             action=JobAction.RESTART_JOB)]
+        cl.store.create("jobs", job)
+        cl.converge(cycles=3)
+        cl.kubelet.complete("default", "mpi-mpiworker-1", exit_code=1)
+        cl.manager.sync()
+        assert cl.store.get("jobs", "mpi").status.retry_count == 1
+        cl.converge(cycles=4)
+        assert cl.store.get("jobs", "mpi").status.state.phase == JobPhase.RUNNING
+
+    def test_unschedulable_condition_surfaces(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "2", "memory": "4Gi"}))
+        cl.store.create("jobs", make_job(name="big", replicas=4,
+                                         min_available=4))
+        cl.converge(cycles=3)
+        pg = cl.store.get("podgroups", "big")
+        assert pg is not None
+        assert any(c.type == "Unschedulable" for c in pg.status.conditions)
+
+
+class TestVcctlE2E:
+    """test/e2e/vcctl — CLI against the live control plane."""
+
+    def test_submit_watch_suspend_resume_delete(self):
+        cl = Cluster()
+        cl.store.create("nodes", build_node("n1", {"cpu": "16", "memory": "32Gi"}))
+        code, out, _ = run_cli(cl, "job", "run", "-N", "cli-job", "-r", "2",
+                               "-m", "2")
+        assert code == 0
+        cl.converge(cycles=3)
+        code, out, _ = run_cli(cl, "job", "list")
+        assert "cli-job" in out and "Running" in out
+        code, _, _ = run_cli(cl, "job", "suspend", "-N", "cli-job")
+        assert code == 0
+        cl.manager.sync()
+        assert cl.store.get("jobs", "cli-job").status.state.phase == \
+            JobPhase.ABORTED
+        code, _, _ = run_cli(cl, "job", "resume", "-N", "cli-job")
+        cl.converge(cycles=4)
+        assert cl.store.get("jobs", "cli-job").status.state.phase == \
+            JobPhase.RUNNING
+        code, _, _ = run_cli(cl, "job", "delete", "-N", "cli-job")
+        assert code == 0
+        cl.manager.sync()
+        assert cl.store.get("jobs", "cli-job") is None
+        assert cl.store.list("pods") == []
+
+    def test_queue_lifecycle_via_cli(self):
+        cl = Cluster()
+        assert run_cli(cl, "queue", "create", "-n", "team-a", "-w", "2")[0] == 0
+        assert run_cli(cl, "queue", "operate", "-n", "team-a",
+                       "-a", "close")[0] == 0
+        cl.manager.sync()
+        assert cl.store.get("queues", "team-a").status.state == "Closed"
+        assert run_cli(cl, "queue", "delete", "-n", "team-a")[0] == 0
+        assert cl.store.get("queues", "team-a") is None

@@ -1,0 +1,105 @@
+"""Store persistence: checkpoint/restore of the whole control-plane state.
+
+The reference's control-plane durability is the etcd-backed CRD store —
+every component is stateless and rebuilds from the API server on restart
+(SURVEY.md section 5.4). Here the ObjectStore is in-memory, so this module
+provides the same guarantee: serialize every object (via the JSON codec) to
+a snapshot file, and restore it into a fresh store on startup. Watches fire
+during restore exactly like an informer's initial list, so caches and
+controllers rebuild their state identically to a live replay.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from typing import Optional
+
+from .codec import decode_object, encode_object
+from .store import KINDS, ObjectStore
+
+SNAPSHOT_VERSION = 1
+
+
+def save_store(store: ObjectStore, path: str) -> int:
+    """Write an atomic snapshot; returns the number of objects saved."""
+    payload = {"version": SNAPSHOT_VERSION, "resource_version": store._rv,
+               "objects": {}}
+    count = 0
+    with store._lock:
+        for kind in sorted(KINDS):
+            items = list(store._objects[kind].values())
+            payload["objects"][kind] = [encode_object(kind, o) for o in items]
+            count += len(items)
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".snapshot-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)   # atomic on POSIX
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return count
+
+
+def load_store(path: str, store: Optional[ObjectStore] = None,
+               clock=None) -> ObjectStore:
+    """Restore a snapshot into ``store`` (or a new one). Objects replay
+    through create with admission skipped (they were admitted when first
+    written), firing watches like an informer's initial list."""
+    with open(path) as f:
+        payload = json.load(f)
+    if payload.get("version") != SNAPSHOT_VERSION:
+        raise ValueError(f"unsupported snapshot version "
+                         f"{payload.get('version')!r}")
+    if store is None:
+        store = ObjectStore(clock=clock) if clock is not None else ObjectStore()
+    for kind, items in payload["objects"].items():
+        if kind not in KINDS:
+            continue
+        for data in items:
+            o = decode_object(kind, data)
+            store.create(kind, o, skip_admission=True)
+    with store._lock:
+        store._rv = max(store._rv, int(payload.get("resource_version", 0)))
+    return store
+
+
+class StoreCheckpointer:
+    """Periodic snapshotting (the etcd WAL-interval equivalent)."""
+
+    def __init__(self, store: ObjectStore, path: str, interval: float = 30.0):
+        self.store = store
+        self.path = path
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def checkpoint(self) -> int:
+        return save_store(self.store, self.path)
+
+    def start(self) -> threading.Thread:
+        def loop():
+            while not self._stop.is_set():
+                self._stop.wait(self.interval)
+                if not self._stop.is_set():
+                    try:
+                        self.checkpoint()
+                    except Exception:
+                        pass   # next interval retries; state stays in memory
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self._thread
+
+    def stop(self, final_checkpoint: bool = True) -> None:
+        self._stop.set()
+        if final_checkpoint:
+            try:
+                self.checkpoint()
+            except Exception:
+                pass

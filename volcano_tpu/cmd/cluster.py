@@ -36,15 +36,23 @@ def build_cluster(port: int = 8181, nodes: int = 0,
                   node_resources: str = "cpu=8,memory=16Gi",
                   scheduler_conf: str = None, schedule_period: float = 1.0,
                   simulate_kubelet: bool = True,
-                  enabled_admission: str = None, plugins_dir: str = None):
+                  enabled_admission: str = None, plugins_dir: str = None,
+                  state_file: str = None):
+    import os
+
+    from ..apiserver.persistence import load_store
     store = ObjectStore()
     WebhookManager(store, enabled_admission=enabled_admission)
-    store.create("queues", Queue(metadata=ObjectMeta(name="default"),
-                                 spec=QueueSpec(weight=1)),
-                 skip_admission=True)
+    if state_file and os.path.exists(state_file):
+        load_store(state_file, store=store)   # control-plane resume
+    if store.get("queues", "default") is None:
+        store.create("queues", Queue(metadata=ObjectMeta(name="default"),
+                                     spec=QueueSpec(weight=1)),
+                     skip_admission=True)
     for i in range(nodes):
-        store.create("nodes", build_node(
-            f"node-{i}", parse_resource_list(node_resources)))
+        if store.get("nodes", f"node-{i}") is None:
+            store.create("nodes", build_node(
+                f"node-{i}", parse_resource_list(node_resources)))
     if plugins_dir:
         load_plugins_dir(plugins_dir)
     manager = ControllerManager(store)
@@ -72,6 +80,10 @@ def main(argv=None) -> int:
                         help="directory of custom scheduler plugin .py files")
     parser.add_argument("--listen-address", default=None,
                         help="host:port for the Prometheus /metrics endpoint")
+    parser.add_argument("--state-file", default=None,
+                        help="snapshot file for control-plane state "
+                             "(restored on start, checkpointed periodically)")
+    parser.add_argument("--checkpoint-interval", type=float, default=30.0)
     parser.add_argument("--version", action="store_true")
     args = parser.parse_args(argv)
     if args.version:
@@ -84,7 +96,14 @@ def main(argv=None) -> int:
         schedule_period=args.schedule_period,
         simulate_kubelet=not args.no_kubelet,
         enabled_admission=args.enabled_admission,
-        plugins_dir=args.plugins_dir)
+        plugins_dir=args.plugins_dir, state_file=args.state_file)
+
+    checkpointer = None
+    if args.state_file:
+        from ..apiserver.persistence import StoreCheckpointer
+        checkpointer = StoreCheckpointer(store, args.state_file,
+                                         interval=args.checkpoint_interval)
+        checkpointer.start()
 
     metrics_server = None
     if args.listen_address:
@@ -113,6 +132,8 @@ def main(argv=None) -> int:
         scheduler.stop()
         manager.stop()
         server.stop()
+        if checkpointer is not None:
+            checkpointer.stop()   # final checkpoint
         if metrics_server is not None:
             metrics_server.stop()
         sys.exit(0)
