@@ -206,3 +206,9 @@ class TestHTTPServer:
             assert store.get("jobs", "httpjob") is not None
         finally:
             server.stop()
+
+
+class TestVersion:
+    def test_vcctl_version(self, store):
+        code, out, _ = run(store, "version")
+        assert code == 0 and "volcano-tpu version" in out

@@ -122,3 +122,44 @@ class TestVersion:
     def test_version_string(self):
         s = version_string()
         assert "volcano-tpu version" in s and "Python version" in s
+
+
+class TestNumaAgent:
+    def test_publishes_topology_for_nodes(self):
+        from volcano_tpu.apiserver import ObjectStore
+        from volcano_tpu.utils.numa_agent import NumaAgent, NumaShape
+        from volcano_tpu.utils.test_utils import build_node
+        store = ObjectStore()
+        agent = NumaAgent(store, default_shape=NumaShape(
+            numa_count=2, cores_per_numa=4, threads_per_core=2,
+            topology_manager_policy="single-numa-node"))
+        store.create("nodes", build_node("n1", {"cpu": "16", "memory": "32Gi"}))
+        nt = store.get("numatopologies", "n1")
+        assert nt is not None
+        assert nt.policies["TopologyManagerPolicy"] == "single-numa-node"
+        assert len(nt.cpu_detail) == 16
+        assert nt.numa_res["cpu"].capacity == 16
+        # numa ids split evenly
+        numas = {c.numa_id for c in nt.cpu_detail.values()}
+        assert numas == {0, 1}
+        agent.stop()
+
+    def test_numa_scheduling_end_to_end_with_agent(self):
+        """Agent-published topology drives numaaware admission."""
+        from tests.harness import Harness
+        from tests.test_numaaware import CONF, guaranteed_pod
+        from volcano_tpu.utils.numa_agent import NumaAgent, NumaShape
+        from volcano_tpu.utils.test_utils import (build_node, build_pod_group,
+                                                  build_queue)
+        h = Harness(CONF)
+        NumaAgent(h.store, default_shape=NumaShape(
+            numa_count=2, cores_per_numa=2, threads_per_core=2,
+            topology_manager_policy="single-numa-node"))
+        h.add("queues", build_queue("default"))
+        h.add("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+        h.add("podgroups", build_pod_group("pg1", "ns1", "default", 1,
+                                           phase="Inqueue"))
+        h.add("pods", guaranteed_pod("ns1", "p0", "pg1", cpu="2",
+                                     policy="single-numa-node"))
+        h.run_actions("allocate").close_session()
+        assert h.binds == {"ns1/p0": "n1"}

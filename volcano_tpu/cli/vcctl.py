@@ -25,6 +25,8 @@ def build_parser() -> argparse.ArgumentParser:
                         help="control plane endpoint")
     sub = parser.add_subparsers(dest="group", required=True)
 
+    sub.add_parser("version", help="print client version")
+
     job = sub.add_parser("job", help="job operations").add_subparsers(
         dest="verb", required=True)
 
@@ -74,6 +76,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def dispatch(args, client=None) -> str:
+    if args.group == "version":
+        from ..version import version_string
+        return version_string()
     client = client if client is not None else get_client(args.server)
     if args.group == "job":
         if args.verb == "run":
