@@ -7,52 +7,169 @@ placement kernel exhaustively — every task x node fit evaluated, gang
 commit/rollback in-kernel — and reports wall latency for the full 50k-task
 backlog against 10k nodes.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-vs_baseline = baseline_ms / measured_ms (>1 means faster than the 1 s
-reference budget).
+Prints ONE JSON line to stdout: {"metric", "value", "unit", "vs_baseline"}
+where vs_baseline = baseline_ms / measured_ms (>1 means faster than the 1 s
+reference budget). All diagnostics go to stderr.
+
+Robustness: TPU backend bring-up over the tunnel can HANG (not just raise),
+so every measurement runs in a killable subprocess (--worker mode). The
+parent walks a (platform, shape) fallback ladder — TPU first, then CPU;
+full 50k x 10k first, then reduced shapes — until one worker returns a
+number.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
+import traceback
 
 BASELINE_MS = 1000.0
 N_TASKS = 50_000
 N_NODES = 10_000
+SHAPES = [(50_000, 10_000), (20_000, 4_000), (5_000, 1_000), (1_000, 256)]
+WORKER_TIMEOUT_S = float(os.environ.get("VOLCANO_BENCH_WORKER_TIMEOUT", 420))
 
 
-def main() -> None:
+def log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# worker: one (platform, shape) measurement in this process
+# ---------------------------------------------------------------------------
+
+def worker(platform: str, n_tasks: int, n_nodes: int, kernel: str,
+           runs: int = 3) -> None:
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")  # beat sitecustomize pin
     import jax.numpy as jnp
 
     from volcano_tpu.ops.allocate import gang_allocate
     from volcano_tpu.ops.score import ScoreWeights
     from volcano_tpu.utils.synth import synth_arrays
 
-    sa = synth_arrays(N_TASKS, N_NODES, gang_size=8, seed=42,
+    devs = jax.devices()
+    log(f"worker backend: {devs[0].platform} x{len(devs)}")
+
+    log(f"building synth arrays {n_tasks} tasks x {n_nodes} nodes")
+    sa = synth_arrays(n_tasks, n_nodes, gang_size=8, seed=42,
                       utilization=0.3)
     weights = ScoreWeights.make(sa.group_req.shape[1], binpack=1.0)
     args = [jnp.asarray(a) for a in sa.args] + [weights]
 
-    # warm-up (compile)
-    out = gang_allocate(*args)
-    jax.block_until_ready(out)
+    if kernel == "pallas":
+        from volcano_tpu.ops.pallas_allocate import gang_allocate_pallas
+        fn = lambda: gang_allocate_pallas(*args)
+    else:
+        fn = lambda: gang_allocate(*args)
 
-    runs = 3
+    log("compiling (warm-up run)")
+    t0 = time.perf_counter()
+    out = fn()
+    jax.block_until_ready(out[0])
+    log(f"warm-up done in {time.perf_counter() - t0:.1f}s; "
+        f"placed={int((out[0] >= 0).sum())}")
+
     best = float("inf")
-    for _ in range(runs):
+    for i in range(runs):
         t0 = time.perf_counter()
-        out = gang_allocate(*args)
-        jax.block_until_ready(out)
-        best = min(best, (time.perf_counter() - t0) * 1000.0)
+        out = fn()
+        jax.block_until_ready(out[0])
+        ms = (time.perf_counter() - t0) * 1000.0
+        best = min(best, ms)
+        log(f"run {i + 1}/{runs}: {ms:.2f} ms")
+    print(json.dumps({"best_ms": best, "platform": devs[0].platform,
+                      "kernel": kernel}))
+
+
+# ---------------------------------------------------------------------------
+# parent: fallback ladder over (platform, kernel, shape)
+# ---------------------------------------------------------------------------
+
+def try_worker(platform: str, n_tasks: int, n_nodes: int, kernel: str):
+    env = dict(os.environ)
+    if platform != "cpu":
+        env.pop("JAX_PLATFORMS", None)
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker", platform,
+           str(n_tasks), str(n_nodes), kernel]
+    log(f"spawning worker: platform={platform} kernel={kernel} "
+        f"shape={n_tasks}x{n_nodes} (timeout {WORKER_TIMEOUT_S:.0f}s)")
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=WORKER_TIMEOUT_S, env=env,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        log("worker timed out (killed)")
+        return None
+    for line in (r.stderr or "").splitlines():
+        print(line, file=sys.stderr)
+    if r.returncode != 0:
+        log(f"worker rc={r.returncode}; stdout tail: {(r.stdout or '')[-200:]!r}")
+        return None
+    try:
+        return json.loads((r.stdout or "").strip().splitlines()[-1])
+    except Exception:
+        log(f"worker output unparseable: {(r.stdout or '')[-200:]!r}")
+        return None
+
+
+def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "--worker":
+        try:
+            worker(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
+                   sys.argv[5])
+        except Exception:
+            log("worker failed:\n" + traceback.format_exc())
+            sys.exit(1)
+        return
+
+    # ladder: TPU pallas kernel, TPU XLA-scan kernel, CPU XLA-scan; shrink
+    # the shape only after every platform/kernel failed on the larger one.
+    # A global deadline and a sticky TPU-failure count keep the whole ladder
+    # inside the driver's patience.
+    deadline = time.monotonic() + float(
+        os.environ.get("VOLCANO_BENCH_DEADLINE", 1800))
+    tpu_failures = 0
+    for n_tasks, n_nodes in SHAPES:
+        for platform, kernel in (("tpu", "pallas"), ("tpu", "scan"),
+                                 ("cpu", "scan")):
+            if platform == "tpu" and tpu_failures >= 2:
+                continue   # TPU is down for this run; stop burning timeouts
+            if time.monotonic() > deadline:
+                log("global deadline reached")
+                break
+            res = try_worker(platform, n_tasks, n_nodes, kernel)
+            if res is None:
+                if platform == "tpu":
+                    tpu_failures += 1
+                continue
+            best = float(res["best_ms"])
+            full = (n_tasks, n_nodes) == (N_TASKS, N_NODES)
+            name = "schedule_cycle_latency_50k_tasks_x_10k_nodes" if full \
+                else (f"schedule_cycle_latency_{n_tasks}_tasks_x_"
+                      f"{n_nodes}_nodes_REDUCED")
+            print(json.dumps({
+                "metric": name,
+                "value": round(best, 2),
+                "unit": "ms",
+                "vs_baseline": round(BASELINE_MS / best, 3),
+                "platform": res.get("platform"),
+                "kernel": res.get("kernel"),
+            }))
+            return
 
     print(json.dumps({
         "metric": "schedule_cycle_latency_50k_tasks_x_10k_nodes",
-        "value": round(best, 2),
-        "unit": "ms",
-        "vs_baseline": round(BASELINE_MS / best, 3),
-    }))
+        "value": None, "unit": "ms", "vs_baseline": 0.0,
+        "error": "all platform/shape attempts failed"}))
 
 
 if __name__ == "__main__":

@@ -35,12 +35,17 @@ class Harness:
             self.open_session()
         for name in names:
             get_action(name).execute(self.ssn)
+        # bind/evict store writes are async (reference: cache.go:647-654);
+        # drain them so assertions see the final state (the reference tests'
+        # 3s bind-channel wait, allocate_test.go:270-276)
+        self.cache.flush_executors()
         return self
 
     def close_session(self):
         if self.ssn is not None:
             close_session(self.ssn)
             self.ssn = None
+        self.cache.flush_executors()
         return self
 
     @property

@@ -158,6 +158,7 @@ class TestBindEvict:
         job = self._setup(store, cache)
         task = next(iter(job.tasks.values()))
         cache.bind(task, "n1")
+        cache.flush_executors()
         # store pod got node_name; watch re-ingested it as Bound
         assert store.get("pods", "p1", "ns1").spec.node_name == "n1"
         task2 = next(iter(cache.jobs["ns1/pg1"].tasks.values()))
@@ -175,8 +176,10 @@ class TestBindEvict:
         job = self._setup(store, cache)
         task = next(iter(job.tasks.values()))
         cache.bind(task, "n1")
+        cache.flush_executors()
         task2 = next(iter(cache.jobs["ns1/pg1"].tasks.values()))
         cache.evict(task2, "preempted")
+        cache.flush_executors()
         assert store.get("pods", "p1", "ns1") is None
         assert cache.nodes["n1"].used.is_empty()
 
@@ -190,6 +193,7 @@ class TestBindEvict:
         store.create("pods", build_pod("ns1", "p1", "", "Pending", RL1, "pg1"))
         task = next(iter(cache.jobs["ns1/pg1"].tasks.values()))
         cache.bind(task, "n1")
+        cache.flush_executors()
         assert cache.binder.binds == {"ns1/p1": "n1"}
 
 

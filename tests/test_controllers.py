@@ -72,6 +72,7 @@ class Cluster:
                 get_action(name).execute(ssn)
         finally:
             close_session(ssn)
+        self.cache.flush_executors()   # deterministic bind visibility
 
     def converge(self, cycles=5):
         for _ in range(cycles):

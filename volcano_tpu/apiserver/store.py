@@ -13,13 +13,13 @@ slice; namespaced kinds key by "namespace/name", cluster-scoped by "name".
 
 from __future__ import annotations
 
-import copy
 import threading
 from collections import defaultdict
 from typing import Callable, Dict, List, Optional
 
 from ..models import objects as obj
 from ..utils.clock import GLOBAL_CLOCK, Clock
+from ..utils.fastclone import fast_clone
 
 NAMESPACED = {"pods", "podgroups", "jobs", "commands", "resourcequotas", "services",
               "configmaps", "secrets", "networkpolicies", "persistentvolumeclaims"}
@@ -177,14 +177,14 @@ class ObjectStore:
         key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
         with self._lock:
             o = self._objects[kind].get(key)
-        return copy.deepcopy(o) if o is not None else None
+        return fast_clone(o) if o is not None else None
 
     def list(self, kind: str, namespace: Optional[str] = None) -> list:
         with self._lock:
             items = list(self._objects[kind].values())
         if namespace is not None and kind in NAMESPACED:
             items = [o for o in items if o.metadata.namespace == namespace]
-        return [copy.deepcopy(o) for o in items]
+        return [fast_clone(o) for o in items]
 
     # -- watch -------------------------------------------------------------
 

@@ -6,14 +6,19 @@ deep-copy Snapshot per cycle (:793-882), Bind/Evict executors with resync
 on failure (:552-660, processResyncTask:772), PodGroup status writeback
 (UpdateJobStatus), and job status event recording.
 
-Differences by design: executors run inline against the in-process store
-(no goroutines needed -- the store write is cheap and the watch fan-out is
-synchronous), which removes the async bind/evict race window while keeping
-the resync path for executor failures.
+Executor model (matches cache.go:647-654): bind/evict mutate cache state
+synchronously (task -> Binding/Releasing, node accounting) but the store
+write runs on a background executor thread once ``run()`` has started it —
+off the scheduling cycle's critical path, with failures landing in the
+resync queue. Before ``run()`` (unit tests building the cache by hand) the
+same writes execute inline. Callers that need the write to be visible
+(tests, deterministic sims) call ``flush_executors()`` — the analogue of
+the reference tests' bind-channel wait.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 from collections import deque
 from typing import Dict, List, Optional
@@ -63,6 +68,19 @@ class SchedulerCache(EventHandlersMixin):
         self.err_tasks: deque = deque()      # resync queue (cache.go:116)
         self._watches: list = []
         self._running = False
+        # async executor for bind/evict store writes (the reference runs
+        # these in goroutines off the cycle's critical path, cache.go:647-654
+        # — failures land in the resync queue); FIFO so a bind and a later
+        # evict of the same pod execute in order. Inline until run() starts
+        # the worker, async afterwards; flush_executors() gives tests the
+        # reference's "wait on the bind channel" determinism.
+        self._exec_queue: deque = deque()
+        self._exec_lock = threading.Lock()
+        self._exec_event = threading.Event()
+        self._exec_idle = threading.Event()
+        self._exec_idle.set()
+        self._exec_thread: Optional[threading.Thread] = None
+        self._exec_stop = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -76,6 +94,7 @@ class SchedulerCache(EventHandlersMixin):
         if self._running:
             return
         self._running = True
+        self.start_executors()
         s = self.store
 
         def locked(fn):
@@ -113,6 +132,57 @@ class SchedulerCache(EventHandlersMixin):
             self.store.unwatch(w)
         self._watches = []
         self._running = False
+        self._exec_stop = True
+        self._exec_event.set()
+        if self._exec_thread is not None:
+            self._exec_thread.join(timeout=5.0)
+            self._exec_thread = None
+
+    # -- async executor (cache.go:647-654 goroutine equivalent) -------------
+
+    def _submit(self, fn) -> None:
+        with self._exec_lock:
+            worker = self._exec_thread
+            if worker is not None:
+                self._exec_queue.append(fn)
+                self._exec_idle.clear()
+                self._exec_event.set()
+                return
+        fn()   # inline mode (no worker started): execute synchronously
+
+    def _exec_loop(self) -> None:
+        while True:
+            self._exec_event.wait()
+            while True:
+                with self._exec_lock:
+                    if not self._exec_queue:
+                        self._exec_event.clear()
+                        self._exec_idle.set()
+                        break
+                    fn = self._exec_queue.popleft()
+                try:
+                    fn()   # submitted fns resync their own expected errors
+                except Exception:
+                    # an escaped error must not kill the worker: every later
+                    # bind/evict would silently queue forever
+                    logging.getLogger(__name__).exception(
+                        "cache executor task failed")
+            if self._exec_stop:
+                return
+
+    def start_executors(self) -> None:
+        """Start the async bind/evict worker (live mode)."""
+        with self._exec_lock:
+            if self._exec_thread is not None:
+                return
+            self._exec_stop = False
+            self._exec_thread = threading.Thread(
+                target=self._exec_loop, daemon=True, name="cache-executor")
+            self._exec_thread.start()
+
+    def flush_executors(self, timeout: float = 30.0) -> bool:
+        """Block until all submitted bind/evict writes have executed."""
+        return self._exec_idle.wait(timeout)
 
     def wait_for_cache_sync(self) -> bool:
         return self._running  # synchronous watches: always synced once run
@@ -187,13 +257,17 @@ class SchedulerCache(EventHandlersMixin):
                 job.update_task_status(task, original)
                 raise
             pod = task.pod
-        try:
-            self.binder.bind(pod, hostname)
-            self.store.record_event(
-                "pods", pod, "Normal", "Scheduled",
-                f"Successfully assigned {task.namespace}/{task.name} to {hostname}")
-        except Exception:
-            self.resync_task(task)
+
+        def do_bind():
+            try:
+                self.binder.bind(pod, hostname)
+                self.store.record_event(
+                    "pods", pod, "Normal", "Scheduled",
+                    f"Successfully assigned {task.namespace}/{task.name} "
+                    f"to {hostname}")
+            except Exception:
+                self.resync_task(task)
+        self._submit(do_bind)
 
     def evict(self, task_info: TaskInfo, reason: str) -> None:
         """Mark Releasing, update node accounting, then delete the pod
@@ -212,13 +286,16 @@ class SchedulerCache(EventHandlersMixin):
                 job.update_task_status(task, original)
                 raise
             pod = task.pod
-        try:
-            self.evictor.evict(pod, reason)
-        except Exception:
-            self.resync_task(task)
-        if job.pod_group is not None:
-            self.store.record_event("podgroups", job.pod_group, "Normal",
-                                    "Evict", reason)
+
+        def do_evict():
+            try:
+                self.evictor.evict(pod, reason)
+            except Exception:
+                self.resync_task(task)
+            if job.pod_group is not None:
+                self.store.record_event("podgroups", job.pod_group, "Normal",
+                                        "Evict", reason)
+        self._submit(do_evict)
 
     # -- resync (cache.go:768-791) ----------------------------------------
 

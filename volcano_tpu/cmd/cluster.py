@@ -115,8 +115,14 @@ def main(argv=None) -> int:
     stop = threading.Event()
 
     def tick_kubelet():
+        import logging
         while not stop.is_set():
-            kubelet.tick()
+            try:
+                kubelet.tick()
+            except Exception:
+                # e.g. a pod deleted by the job controller between the
+                # kubelet's get and update; next tick resyncs
+                logging.getLogger(__name__).exception("kubelet tick failed")
             stop.wait(0.2)
 
     manager.start()

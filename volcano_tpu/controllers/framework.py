@@ -10,8 +10,11 @@ binary equivalent, cmd/controller-manager/app/server.go).
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
 
 
 class Controller:
@@ -71,7 +74,15 @@ class ControllerManager:
         work (events produced by one controller may feed another)."""
         total = 0
         for _ in range(max_rounds):
-            processed = sum(c.process_pending() for c in self.controllers)
+            processed = 0
+            for c in self.controllers:
+                try:
+                    processed += c.process_pending()
+                except Exception:
+                    # one controller's transient failure (e.g. a store update
+                    # conflict racing another writer) must not stall the rest;
+                    # its watch queue redelivers on the next round
+                    log.exception("controller %s sync failed", c.name())
             total += processed
             if processed == 0:
                 return total
@@ -80,7 +91,10 @@ class ControllerManager:
     def start(self, interval: float = 0.05) -> threading.Thread:
         def loop():
             while not self._stop.is_set():
-                self.sync()
+                try:
+                    self.sync()
+                except Exception:
+                    log.exception("controller-manager sync loop failed")
                 self._stop.wait(interval)
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

@@ -77,6 +77,9 @@ class GarbageCollector(Controller):
             if finish_time + float(job.spec.ttl_seconds_after_finished) > now:
                 self._schedule(job)   # TTL extended since we queued it
                 continue
-            self.store.delete("jobs", name, ns, skip_admission=True)
+            try:
+                self.store.delete("jobs", name, ns, skip_admission=True)
+            except KeyError:
+                pass   # already deleted by another actor
             processed += 1
         return processed

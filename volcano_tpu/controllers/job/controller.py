@@ -170,6 +170,11 @@ class JobController(Controller):
         elif new.status.phase == "Succeeded" and old.status.phase != "Succeeded" \
                 and self.cache.task_completed(key, task_name):
             event = JobEvent.TASK_COMPLETED
+        elif new.status.phase in ("Pending", "Running") \
+                and self.cache.task_failed(key, task_name):
+            # job_controller_handler.go:270-273: the task's retries are
+            # exhausted, so policies keyed on TaskFailed fire
+            event = JobEvent.TASK_FAILED
         self._enqueue(Request(namespace=new.metadata.namespace, job_name=job_name,
                               task_name=task_name, event=event,
                               exit_code=exit_code, job_version=version))
@@ -245,7 +250,14 @@ class JobController(Controller):
                 self.store.record_event(
                     "jobs", job_info.job, "Warning", "ExecuteAction",
                     f"Job failed on action {action} for retry limit reached: {e}")
-                state.execute(JobAction.TERMINATE_JOB)
+                try:
+                    state.execute(JobAction.TERMINATE_JOB)
+                except Exception as te:
+                    # the terminal kill can fail the same way the original
+                    # action did; record it rather than killing the manager
+                    self.store.record_event(
+                        "jobs", job_info.job, "Warning", "ExecuteAction",
+                        f"Job termination after retry limit failed: {te}")
 
     @staticmethod
     def _req_key(req: Request) -> tuple:

@@ -8,6 +8,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Set
 
+from ..apiserver.store import ConflictError
 from ..models import objects as obj
 from ..models.objects import ObjectMeta, Pod, PodGroup
 from .framework import Controller
@@ -65,7 +66,14 @@ class PodGroupController(Controller):
             pod = self.store.get("pods", name, ns)
             if pod is None or obj.GROUP_NAME_ANNOTATION in pod.metadata.annotations:
                 continue
-            self._create_normal_pod_pg_if_not_exist(pod)
+            try:
+                self._create_normal_pod_pg_if_not_exist(pod)
+            except (ConflictError, KeyError):
+                # pod updated or deleted between get and update; requeue so
+                # the retry sees the fresh object
+                if key not in self._pending:
+                    self._pending.add(key)
+                    self.work.append(key)
             processed += 1
         return processed
 

@@ -9,6 +9,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Dict, Optional, Set
 
+from ...apiserver.store import ConflictError
 from ...models import objects as obj
 from ...models.objects import (JobAction, PodGroup, PodGroupPhase, Queue,
                                QueueState, QueueStatus)
@@ -106,7 +107,13 @@ class QueueController(Controller):
                 continue
             state = new_state(queue, self._sync_queue, self._open_queue,
                               self._close_queue)
-            state.execute(action or JobAction.SYNC_QUEUE)
+            try:
+                state.execute(action or JobAction.SYNC_QUEUE)
+            except (ConflictError, KeyError):
+                # another writer raced our get->update round trip; requeue to
+                # retry against the fresh object (the reference's workqueue
+                # AddRateLimited on sync failure)
+                self._enqueue(name, action)
             processed += 1
         return processed
 

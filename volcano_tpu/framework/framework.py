@@ -115,8 +115,8 @@ def job_status(ssn: Session, job: JobInfo):
 
 
 def _clone_status(status):
-    import copy
-    return copy.deepcopy(status)
+    from ..utils.fastclone import fast_clone
+    return fast_clone(status)
 
 
 # condition-writeback dedup window (job_updater.go:31-37)

@@ -9,11 +9,11 @@ CheckTaskMinAvailable:543, Ready:587), and annotation extraction
 
 from __future__ import annotations
 
-import copy
 import enum
 from collections import defaultdict
 from typing import Dict, List, Optional
 
+from ..utils.fastclone import fast_clone
 from . import objects
 from .objects import Pod, PodGroup, PodGroupCondition
 from .resource import Resource
@@ -273,7 +273,7 @@ class JobInfo:
         # phase, gang writes conditions) without writing through to the cache's
         # live object — writeback goes through the status updater instead
         # (reference: cache.go:793 Snapshot deep copy)
-        info.pod_group = copy.deepcopy(self.pod_group) if self.pod_group else None
+        info.pod_group = fast_clone(self.pod_group) if self.pod_group else None
         info.creation_timestamp = self.creation_timestamp
         info.scheduling_start_time = self.scheduling_start_time
         info.preemptable = self.preemptable

@@ -5,9 +5,12 @@ the configured actions in order, close the session.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Optional
+
+log = logging.getLogger(__name__)
 
 from .apiserver.store import ObjectStore
 from .cache import SchedulerCache
@@ -56,8 +59,9 @@ class Scheduler:
                     raise ValueError(f"unknown action {name!r}")
             with self._mutex:
                 self.conf = new_conf
-        except Exception:
-            pass  # keep previous conf
+        except Exception as e:
+            # validation-or-keep-previous: the running conf stays in effect
+            log.warning("scheduler conf reload failed, keeping previous: %s", e)
 
     def watch_conf(self) -> None:
         if self._conf_path is None:
@@ -91,7 +95,12 @@ class Scheduler:
         self.watch_conf()
         while not self._stop.is_set():
             cycle_start = time.monotonic()
-            self.run_once()
+            try:
+                self.run_once()
+            except Exception:
+                # a transient failure (e.g. a status-writeback conflict) must
+                # not kill the scheduling thread; next cycle resyncs
+                log.exception("scheduling cycle failed; retrying next period")
             elapsed = time.monotonic() - cycle_start
             self._stop.wait(max(0.0, self.schedule_period - elapsed))
 

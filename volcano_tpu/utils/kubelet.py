@@ -15,6 +15,7 @@ from __future__ import annotations
 import heapq
 from typing import List, Set, Tuple
 
+from ..apiserver.store import ConflictError
 from ..models.objects import Pod
 
 SIM_DURATION_KEY = "volcano.sh/sim-duration"
@@ -56,7 +57,13 @@ class SimulatedKubelet:
             return
         live.status.phase = "Running"
         live.status.host_ip = live.spec.node_name
-        self.store.update("pods", live, skip_admission=True)
+        try:
+            self.store.update("pods", live, skip_admission=True)
+        except (ConflictError, KeyError):
+            # raced the job controller updating/deleting this pod; the watch
+            # redelivers the fresh object and we restart it then
+            self._running.discard(key)
+            return
         duration = pod.metadata.annotations.get(SIM_DURATION_KEY)
         if duration is not None:
             due = self.store.clock.now() + float(duration)
@@ -66,6 +73,7 @@ class SimulatedKubelet:
         """Finish pods whose sim duration elapsed; returns pods finished."""
         now = self.store.clock.now()
         finished = 0
+        retries = []
         while self._timers and self._timers[0][0] <= now:
             _, key = heapq.heappop(self._timers)
             ns, name = key.split("/", 1)
@@ -76,8 +84,17 @@ class SimulatedKubelet:
             exit_code = int(pod.metadata.annotations.get(SIM_EXIT_CODE_KEY, "0"))
             pod.status.exit_code = exit_code
             pod.status.phase = "Succeeded" if exit_code == 0 else "Failed"
-            self.store.update("pods", pod, skip_admission=True)
+            try:
+                self.store.update("pods", pod, skip_admission=True)
+            except (ConflictError, KeyError):
+                # pod deleted or rewritten mid-completion (e.g. job restart);
+                # requeue AFTER the drain loop so the retry happens on the
+                # next tick against the fresh object, not a same-tick spin
+                retries.append(key)
+                continue
             finished += 1
+        for key in retries:
+            heapq.heappush(self._timers, (now, key))
         return finished
 
     def complete(self, namespace: str, name: str, exit_code: int = 0) -> None:
