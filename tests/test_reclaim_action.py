@@ -78,3 +78,51 @@ def test_no_reclaim_within_own_queue():
           build_pod("c1", "preemptor1", "", "Pending", RL1, "pg2"))
     h.run_actions("reclaim").close_session()
     assert len(h.evicts) == 0
+
+
+def test_reclaim_walks_nodes_until_covered():
+    """Reclaim's node walk evicts at nodes whose victims can't cover the
+    request and pipelines on the first covering node (reclaim.go:149-181:
+    per-node `reclaimed` resets, evictions stick). q1 stays overused after
+    node-a's small victims are taken, so node-b's big victim is reachable."""
+    conf = """
+actions: "reclaim"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: proportion
+  - name: nodeorder
+"""
+    h = Harness(conf)
+    h.add("queues", build_queue("q1", weight=1), build_queue("q2", weight=1))
+    h.add("nodes",
+          build_node("node-a", build_resource_list("11", "64Gi")),
+          build_node("node-b", build_resource_list("12", "64Gi")))
+    h.add("podgroups",
+          build_pod_group("v1", "ns1", "q1", 1, phase=PodGroupPhase.RUNNING),
+          build_pod_group("v2", "ns1", "q1", 1, phase=PodGroupPhase.RUNNING),
+          build_pod_group("v3", "ns1", "q1", 1, phase=PodGroupPhase.RUNNING),
+          pg("rc", "ns1", "q2", 1), pg("rc2", "ns1", "q2", 1))
+    h.add("pods",
+          build_pod("ns1", "va-1", "node-a", "Running",
+                    build_resource_list("1", "1Gi"), "v1"),
+          build_pod("ns1", "va-2", "node-a", "Running",
+                    build_resource_list("1", "1Gi"), "v2"),
+          build_pod("ns1", "vb-1", "node-b", "Running",
+                    build_resource_list("12", "1Gi"), "v3"),
+          build_pod("ns1", "rc-1", "", "Pending",
+                    build_resource_list("10", "1Gi"), "rc"),
+          build_pod("ns1", "rc2-1", "", "Pending",
+                    build_resource_list("10", "1Gi"), "rc2"))
+    h.run_actions("reclaim")
+    ssn = h.ssn
+    rec = next(t for j in ssn.jobs.values() for t in j.tasks.values()
+               if t.name == "rc-1")
+    evicted = {t.name for j in ssn.jobs.values() for t in j.tasks.values()
+               if t.status == TaskStatus.Releasing}
+    assert rec.status == TaskStatus.Pipelined
+    assert rec.node_name == "node-b"
+    assert "vb-1" in evicted
+    h.close_session()

@@ -2,30 +2,31 @@
 
 Mirrors pkg/scheduler/actions/preempt/preempt.go: classify starving jobs
 (JobStarving), then per queue pop preemptor jobs by JobOrder and their
-pending tasks by TaskOrder; for each preemptor the node choice and the
-victim prefix come from one kernel evaluation (ops/preempt.py) instead of
-the reference's per-node pop-until-fit loop; changes are staged on a
-Statement and committed only when the job reaches JobPipelined
-(preempt.go:132-138). Intra-job task preemption (preempt.go:146-183) and
-plugin VictimTasks eviction (preempt.go:273-284) follow.
+pending tasks by TaskOrder; changes are staged on a Statement and committed
+only when the job reaches JobPipelined (preempt.go:132-138). Intra-job task
+preemption (preempt.go:146-183) and plugin VictimTasks eviction
+(preempt.go:273-284) follow.
+
+Batched evaluation (framework/victims.py): the snapshot encode happens ONCE
+per action execution for every preemptor task, candidate victims live in a
+flat incremental index, and each preemptor costs one vectorized
+all-nodes feasibility pass plus plugin filtering for the few nodes actually
+visited in score order — instead of the reference's (and round 1's)
+per-preemptor full-cluster sweeps.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Callable, Dict, List, Optional
-
-import numpy as np
-
-import jax.numpy as jnp
+from typing import Dict, List
 
 from ..framework.plugin import Action
 from ..framework.registry import register_action
 from ..framework.statement import Statement
+from ..framework.victims import INTER_JOB, INTRA_JOB, PreemptContext
 from ..metrics import metrics as m
 from ..models.job_info import JobInfo, TaskInfo, TaskStatus
 from ..models.objects import PodGroupPhase
-from ..ops.preempt import pick_best_node, victim_prefix
 
 
 class PreemptAction(Action):
@@ -53,48 +54,51 @@ class PreemptAction(Action):
                 under_request.append(job)
                 preemptor_tasks[job.uid] = self._pending_tasks(ssn, job)
 
+        if not under_request:
+            self._victim_tasks(ssn)
+            return
+
+        # one batched encode for ALL preemptor tasks of the action
+        ctx = PreemptContext(
+            ssn, [(job, list(preemptor_tasks[job.uid]))
+                  for job in under_request if preemptor_tasks.get(job.uid)])
+
         job_key = functools.cmp_to_key(
             lambda a, b: -1 if ssn.job_order_fn(a, b) else 1)
 
-        # preemption between jobs within a queue (preempt.go:83-143)
+        # preemption between jobs within a queue (preempt.go:83-143);
+        # priority-queue pop/re-push like the reference's preemptorsQueue
+        # (rebuilding the order per pop is O(n^2 log n) at 5k starving jobs)
+        import heapq
         for queue in queues.values():
-            while True:
-                preemptors = preemptors_map.get(queue.name)
-                if not preemptors:
-                    break
-                preemptors.sort(key=job_key)
-                preemptor_job = preemptors.pop(0)
+            jobs_list = preemptors_map.get(queue.name)
+            if not jobs_list:
+                continue
+            heap = [job_key(j) for j in jobs_list]
+            heapq.heapify(heap)
+            while heap:
+                preemptor_job = heapq.heappop(heap).obj
 
                 stmt = Statement(ssn)
+                ctx.checkpoint()
                 assigned = False
                 while ssn.job_starving(preemptor_job):
                     tasks = preemptor_tasks.get(preemptor_job.uid)
                     if not tasks:
                         break
                     preemptor = tasks.pop(0)
-
-                    def job_filter(task: TaskInfo,
-                                   _pj=preemptor_job, _p=preemptor) -> bool:
-                        if task.status != TaskStatus.Running:
-                            return False
-                        if task.resreq.is_empty():
-                            return False
-                        victim_job = ssn.jobs.get(task.job)
-                        if victim_job is None:
-                            return False
-                        return (victim_job.queue == _pj.queue
-                                and _p.job != task.job)
-
-                    if self._preempt(ssn, stmt, preemptor, job_filter):
+                    if self._preempt(ssn, ctx, stmt, preemptor, INTER_JOB):
                         assigned = True
 
                 if ssn.job_pipelined(preemptor_job):
                     stmt.commit()
+                    ctx.commit()
                 else:
                     stmt.discard()
+                    ctx.rollback()
                     continue
                 if assigned:
-                    preemptors.append(preemptor_job)
+                    heapq.heappush(heap, job_key(preemptor_job))
 
         # preemption between tasks within a job (preempt.go:146-183)
         for job in under_request:
@@ -102,16 +106,10 @@ class PreemptAction(Action):
             while tasks:
                 preemptor = tasks.pop(0)
                 stmt = Statement(ssn)
-
-                def task_filter(task: TaskInfo, _p=preemptor) -> bool:
-                    if task.status != TaskStatus.Running:
-                        return False
-                    if task.resreq.is_empty():
-                        return False
-                    return _p.job == task.job
-
-                assigned = self._preempt(ssn, stmt, preemptor, task_filter)
+                ctx.checkpoint()
+                assigned = self._preempt(ssn, ctx, stmt, preemptor, INTRA_JOB)
                 stmt.commit()
+                ctx.commit()
                 if not assigned:
                     break
 
@@ -125,65 +123,28 @@ class PreemptAction(Action):
             lambda a, b: -1 if ssn.task_order_fn(a, b) else 1))
         return tasks
 
-    def _preempt(self, ssn, stmt: Statement, preemptor: TaskInfo,
-                 task_filter: Optional[Callable[[TaskInfo], bool]]) -> bool:
-        """One preemptor placement: kernel-evaluated node choice + victim
-        prefix (preempt.go:192-271)."""
-        job = ssn.jobs.get(preemptor.job)
-        if job is None:
-            return False
-        narr, mask, score = ssn.solver.task_feasibility(job, preemptor)
-        rindex = ssn.solver.rindex
-
-        # plugin victim sets per node, eviction-order sorted (lowest
-        # priority evicted first: the inverted TaskOrder, preempt.go:228-233)
-        evict_key = functools.cmp_to_key(
-            lambda a, b: -1 if not ssn.task_order_fn(a, b) else 1)
-        victims_by_node: List[List[TaskInfo]] = [[] for _ in narr.names]
-        vmax = 1
-        for i, name in enumerate(narr.names):
-            node = ssn.nodes.get(name)
-            if node is None or not mask[i]:
-                continue
-            # clone so victim status flips never touch the node's own
-            # accounting copies (preempt.go:215-218)
-            preemptees = [t.clone() for t in node.tasks.values()
-                          if task_filter is None or task_filter(t)]
-            if not preemptees:
-                continue
-            victims = ssn.preemptable(preemptor, preemptees)
-            m.update_preemption_victims(len(victims))
-            victims.sort(key=evict_key)
-            victims_by_node[i] = victims
-            vmax = max(vmax, len(victims))
-
-        n_pad = narr.idle.shape[0]
-        victim_res = np.zeros((n_pad, vmax, rindex.r), np.float32)
-        victim_valid = np.zeros((n_pad, vmax), bool)
-        for i, victims in enumerate(victims_by_node):
-            for v, t in enumerate(victims):
-                victim_res[i, v] = rindex.vec(t.resreq)
-                victim_valid[i, v] = True
-
-        req = rindex.vec(preemptor.init_resreq)
-        feasible, n_evict = victim_prefix(
-            jnp.asarray(req), jnp.asarray(mask),
-            jnp.asarray(narr.future_idle), jnp.asarray(victim_res),
-            jnp.asarray(victim_valid), jnp.asarray(rindex.eps))
-        best = int(pick_best_node(feasible, jnp.asarray(score)))
+    def _preempt(self, ssn, ctx: PreemptContext, stmt: Statement,
+                 preemptor: TaskInfo, mode: str) -> bool:
+        """One preemptor placement (preempt.go:192-271)."""
+        res = ctx.place(preemptor, mode,
+                        victim_cb=lambda v: m.update_preemption_victims(len(v)))
         m.register_preemption_attempt()
-        if best < 0:
+        if res is None:
             return False
-
-        for victim in victims_by_node[best][:int(np.asarray(n_evict)[best])]:
+        node_name, victims, _covered = res
+        for victim in victims:
+            # clone: status flips must not touch the node's accounting copy
+            # (preempt.go:215-218)
             try:
-                stmt.evict(victim, "preempt")
+                stmt.evict(victim.clone(), "preempt")
             except KeyError:
                 continue
+            ctx.apply_evict(node_name, victim)
         try:
-            stmt.pipeline(preemptor, narr.names[best])
+            stmt.pipeline(preemptor, node_name)
         except KeyError:
             return False
+        ctx.apply_pipeline(node_name, preemptor)
         return True
 
     def _victim_tasks(self, ssn) -> None:

@@ -5,9 +5,13 @@ QueueOrder (skipping Overused ones), their jobs by JobOrder, one pending
 task per turn; candidate victims are Running tasks of *other* queues whose
 queue allows reclamation (reclaim.go:124-141), filtered by the Reclaimable
 plugin intersection. Unlike preempt, evictions are immediate session evicts
-(not statement-staged) and the stop condition is the summed victim
-resources alone covering the request (reclaim.go:149-181); the node choice
-and victim prefix come from the reclaim_prefix kernel.
+(not statement-staged, reclaim.go:156-166) and the stop condition is the
+summed victim resources alone covering the request (reclaim.go:149-181).
+
+Uses the batched PreemptContext (framework/victims.py): one snapshot encode
+for every reclaimer, flat incremental victim index, per-reclaimer
+vectorized feasibility + lazy exact node descent — the reclaim_prefix
+kernel semantics without per-task re-encoding.
 """
 
 from __future__ import annotations
@@ -15,15 +19,11 @@ from __future__ import annotations
 import functools
 from typing import Dict, List
 
-import numpy as np
-
-import jax.numpy as jnp
-
 from ..framework.plugin import Action
 from ..framework.registry import register_action
+from ..framework.victims import CROSS_QUEUE, PreemptContext
 from ..models.job_info import JobInfo, TaskInfo, TaskStatus
 from ..models.objects import PodGroupPhase
-from ..ops.preempt import reclaim_prefix
 
 
 class ReclaimAction(Action):
@@ -62,6 +62,12 @@ class ReclaimAction(Action):
                 pending.sort(key=task_key)
                 preemptor_tasks[job.uid] = pending
 
+        if not preemptor_tasks:
+            return
+        ctx = PreemptContext(
+            ssn, [(job, list(preemptor_tasks[job.uid]))
+                  for jobs in preemptors_map.values() for job in jobs])
+
         # queue priority loop (reclaim.go:84-188): pop best queue each turn,
         # re-pushing it after a task was attempted
         while queue_list:
@@ -79,74 +85,43 @@ class ReclaimAction(Action):
                 continue
             task = tasks.pop(0)
 
-            assigned = self._reclaim(ssn, job, task)
+            assigned = self._reclaim(ssn, ctx, task)
             if assigned:
                 jobs.append(job)
             queue_list.append(queue)
 
     # ------------------------------------------------------------------
 
-    def _reclaim(self, ssn, job: JobInfo, task: TaskInfo) -> bool:
+    def _reclaim(self, ssn, ctx: PreemptContext, task: TaskInfo) -> bool:
         """Place one reclaimer by evicting cross-queue victims
-        (reclaim.go:114-182)."""
-        narr, mask, _score = ssn.solver.task_feasibility(job, task)
-        rindex = ssn.solver.rindex
-
-        victims_by_node: List[List[TaskInfo]] = [[] for _ in narr.names]
-        vmax = 1
-        for i, name in enumerate(narr.names):
-            node = ssn.nodes.get(name)
-            if node is None or not mask[i]:
-                continue
-            reclaimees = []
-            for t in node.tasks.values():
-                if t.status != TaskStatus.Running:
-                    continue
-                victim_job = ssn.jobs.get(t.job)
-                if victim_job is None or victim_job.queue == job.queue:
-                    continue
-                victim_queue = ssn.queues.get(victim_job.queue)
-                if victim_queue is None or not victim_queue.reclaimable():
-                    continue
-                reclaimees.append(t.clone())  # reclaim.go:138-140
-            if not reclaimees:
-                continue
-            victims = ssn.reclaimable(task, reclaimees)
-            victims_by_node[i] = victims
-            vmax = max(vmax, len(victims))
-
-        n_pad = narr.idle.shape[0]
-        victim_res = np.zeros((n_pad, vmax, rindex.r), np.float32)
-        victim_valid = np.zeros((n_pad, vmax), bool)
-        for i, victims in enumerate(victims_by_node):
-            for v, t in enumerate(victims):
-                victim_res[i, v] = rindex.vec(t.resreq)
-                victim_valid[i, v] = True
-
-        req = rindex.vec(task.init_resreq)
-        feasible, n_evict, covered = reclaim_prefix(
-            jnp.asarray(req), jnp.asarray(mask),
-            jnp.asarray(narr.future_idle), jnp.asarray(victim_res),
-            jnp.asarray(victim_valid), jnp.asarray(rindex.eps))
-        feasible = np.asarray(feasible)
-        n_evict = np.asarray(n_evict)
-        covered = np.asarray(covered)
-
-        # first feasible node in order; evictions are immediate and stick
-        # even when coverage fails (ssn.Evict, reclaim.go:156-166)
-        for i in np.flatnonzero(feasible):
-            for victim in victims_by_node[i][:int(n_evict[i])]:
+        (reclaim.go:114-182). The walk spans nodes: every visited node's
+        victims are evicted immediately and stick even when they don't
+        cover the request; the pipeline lands on the first covering node."""
+        ctx.checkpoint()
+        assigned = False
+        while True:
+            step = ctx.place(task, CROSS_QUEUE)
+            if step is None:
+                break
+            node_name, victims, covered = step
+            for victim in victims:
                 try:
-                    ssn.evict(victim, "reclaim")
+                    ssn.evict(victim.clone(), "reclaim")  # reclaim.go:138-140
                 except KeyError:
+                    ctx.mark_dead(victim)   # gone from session; don't retry
                     continue
-            if covered[i]:
-                try:
-                    ssn.pipeline(task, narr.names[i])
-                except KeyError:
-                    return False
-                return True
-        return False
+                ctx.apply_evict(node_name, victim)
+            if not covered:
+                continue   # walk on: later filters see post-eviction state
+            try:
+                ssn.pipeline(task, node_name)
+            except KeyError:
+                break
+            ctx.apply_pipeline(node_name, task)
+            assigned = True
+            break
+        ctx.commit()
+        return assigned
 
 
 register_action(ReclaimAction())

@@ -106,6 +106,7 @@ class Session:
         self.event_handlers: List[EventHandler] = []
         for m in _FN_MAPS:
             setattr(self, m, {})
+        self._enabled_fns_cache: Dict[str, list] = {}
         # TPU batch solver context, populated by open_session
         self.solver = None
 
@@ -115,6 +116,7 @@ class Session:
 
     def _add(self, map_name: str, plugin_name: str, fn) -> None:
         getattr(self, map_name)[plugin_name] = fn
+        self._enabled_fns_cache.pop(map_name, None)
 
     def add_job_order_fn(self, name, fn): self._add("job_order_fns", name, fn)
     def add_queue_order_fn(self, name, fn): self._add("queue_order_fns", name, fn)
@@ -156,16 +158,24 @@ class Session:
         return True
 
     def _enabled_fns(self, map_name: str):
-        """Yield (tier_index, plugin_option, fn) honoring enable flags."""
+        """(tier_index, plugin_option, fn) honoring enable flags. Memoized:
+        tiers and fn registrations are fixed after OnSessionOpen, and this
+        resolution sits under every order-fn comparison on the hot path."""
+        cached = self._enabled_fns_cache.get(map_name)
+        if cached is not None:
+            return cached
         fns = getattr(self, map_name)
         flag = _ENABLE_FOR.get(map_name)
+        out = []
         for ti, tier in enumerate(self.tiers):
             for opt in tier.plugins:
                 if flag is not None and not opt.is_enabled(flag):
                     continue
                 fn = fns.get(opt.name)
                 if fn is not None:
-                    yield ti, opt, fn
+                    out.append((ti, opt, fn))
+        self._enabled_fns_cache[map_name] = out
+        return out
 
     def _compare_dispatch(self, map_name: str, l, r) -> Optional[int]:
         """First plugin with a non-zero comparison wins."""

@@ -159,13 +159,20 @@ class NodeArrays:
 
 def _constraint_key(t: TaskInfo) -> tuple:
     """Scheduling-constraint fingerprint for grouping: tasks with identical
-    constraints share predicate masks."""
+    constraints share predicate masks. Cached on the TaskInfo (constraints
+    are immutable for a pod's lifetime; the repr() of affinity trees is the
+    expensive part at 50k tasks)."""
+    cached = t.constraint_key_cache
+    if cached is not None:
+        return cached
     spec = t.pod.spec
     sel = tuple(sorted(spec.node_selector.items()))
     tol = tuple(sorted((x.key, x.operator, x.value, x.effect)
                        for x in spec.tolerations))
     aff = repr(spec.affinity) if spec.affinity is not None else ""
-    return (sel, tol, aff)
+    key = (sel, tol, aff)
+    t.constraint_key_cache = key
+    return key
 
 
 def _req_key(t: TaskInfo) -> tuple:

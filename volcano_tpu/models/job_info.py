@@ -83,7 +83,8 @@ class TaskInfo:
     __slots__ = ("uid", "job", "name", "namespace", "resreq", "init_resreq",
                  "node_name", "status", "priority", "volume_ready",
                  "preemptable", "revocable_zone", "topology_policy", "pod",
-                 "best_effort", "last_transaction", "pod_volumes")
+                 "best_effort", "last_transaction", "pod_volumes",
+                 "constraint_key_cache")
 
     def __init__(self, pod: Pod):
         req = pod.resource_request()
@@ -105,6 +106,9 @@ class TaskInfo:
         self.best_effort: bool = self.init_resreq.is_empty()
         self.last_transaction = None
         self.pod_volumes = None
+        # lazy scheduling-constraint fingerprint (models/arrays.py grouping);
+        # pod scheduling constraints are immutable, so clones inherit it
+        self.constraint_key_cache = None
 
     @property
     def task_id(self) -> str:
@@ -112,10 +116,24 @@ class TaskInfo:
 
     def clone(self) -> "TaskInfo":
         c = TaskInfo.__new__(TaskInfo)
-        for s in TaskInfo.__slots__:
-            setattr(c, s, getattr(self, s))
+        c.uid = self.uid
+        c.job = self.job
+        c.name = self.name
+        c.namespace = self.namespace
         c.resreq = self.resreq.clone()
         c.init_resreq = self.init_resreq.clone()
+        c.node_name = self.node_name
+        c.status = self.status
+        c.priority = self.priority
+        c.volume_ready = self.volume_ready
+        c.preemptable = self.preemptable
+        c.revocable_zone = self.revocable_zone
+        c.topology_policy = self.topology_policy
+        c.pod = self.pod
+        c.best_effort = self.best_effort
+        c.last_transaction = self.last_transaction
+        c.pod_volumes = self.pod_volumes
+        c.constraint_key_cache = self.constraint_key_cache
         return c
 
     def key(self) -> str:

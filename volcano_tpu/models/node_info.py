@@ -263,6 +263,8 @@ class NodeInfo:
                 for i, d in self.gpu_devices.items()}
 
     def add_gpu_resource(self, pod) -> None:
+        if not self.gpu_devices:
+            return   # no shareable GPUs: skip the per-container req rebuild
         mem = get_gpu_memory_of_pod(pod)
         if mem <= EPS:
             return

@@ -243,6 +243,8 @@ class Resource:
             return l < r or abs(l - r) < EPS
         if not (le(self.milli_cpu, rr.milli_cpu) and le(self.memory, rr.memory)):
             return False
+        if not self.scalars and not rr.scalars:
+            return True   # fast path: the dominant case on the bind hot loop
         for l, r in self._scalar_pairs(rr, default):
             if r == math.inf:
                 continue

@@ -48,66 +48,75 @@ class ScoreWeights(NamedTuple):
         return cls(jnp.asarray(br), jnp.float32(binpack), jnp.float32(least),
                    jnp.float32(most), jnp.float32(balanced))
 
+    def host(self) -> "ScoreWeights":
+        """Host-value copy (numpy array + python floats) for xp=numpy
+        callers — converts device values ONCE instead of per call."""
+        import numpy as np
+        return ScoreWeights(np.asarray(self.binpack_res),
+                            float(self.binpack), float(self.least),
+                            float(self.most), float(self.balanced))
 
-def binpack_score(req: jax.Array, used: jax.Array, alloc: jax.Array,
-                  w_res: jax.Array) -> jax.Array:
+
+def binpack_score(req, used, alloc, w_res, xp=jnp):
     """Best-fit packing score, 0..100 (binpack.go:200-260).
 
     score_r = (used_r + req_r) * 100 / alloc_r for requested dims, weighted
     by w_res and normalized by the sum of participating weights.
-    req [R], used [N,R], alloc [N,R] -> [N].
+    req [R], used [N,R], alloc [N,R] -> [N]. ``xp`` selects the array
+    backend: jnp inside the kernels, numpy for host-side evaluation
+    (framework/victims.py) — ONE implementation, no hand-kept mirror.
     """
     requested = (req > 0) & (w_res > 0)
     denom_ok = alloc > 0
-    frac = jnp.where(denom_ok, (used + req[None, :]) / jnp.maximum(alloc, 1e-9), 2.0)
+    frac = xp.where(denom_ok, (used + req[None, :]) / xp.maximum(alloc, 1e-9), 2.0)
     # nodes where a requested dim overflows alloc contribute 0 (binpack
     # returns 0 when usedFinally > allocatable)
-    per_res = jnp.where(frac <= 1.0, frac * 100.0, 0.0)        # [N, R]
-    w = jnp.where(requested, w_res, 0.0)[None, :]               # [1, R]
-    wsum = jnp.maximum(jnp.sum(jnp.where(requested, w_res, 0.0)), 1e-9)
-    return jnp.sum(per_res * w, axis=-1) / wsum                 # [N]
+    per_res = xp.where(frac <= 1.0, frac * 100.0, 0.0)        # [N, R]
+    w = xp.where(requested, w_res, 0.0)[None, :]               # [1, R]
+    wsum = xp.maximum(xp.sum(xp.where(requested, w_res, 0.0)), 1e-9)
+    return xp.sum(per_res * w, axis=-1) / wsum                 # [N]
 
 
-def least_requested_score(req: jax.Array, used: jax.Array,
-                          alloc: jax.Array) -> jax.Array:
+def least_requested_score(req, used, alloc, xp=jnp):
     """(capacity - requested) * 100 / capacity over cpu+memory, averaged
     (k8s LeastAllocated via nodeorder.go)."""
     cpu_mem = slice(0, 2)
     a = alloc[:, cpu_mem]
     u = used[:, cpu_mem] + req[None, cpu_mem]
-    frac = jnp.where(a > 0, jnp.clip((a - u), 0.0, None) / jnp.maximum(a, 1e-9), 0.0)
-    return jnp.mean(frac * 100.0, axis=-1)
+    frac = xp.where(a > 0, xp.clip((a - u), 0.0, None) / xp.maximum(a, 1e-9), 0.0)
+    return xp.mean(frac * 100.0, axis=-1)
 
 
-def most_requested_score(req: jax.Array, used: jax.Array,
-                         alloc: jax.Array) -> jax.Array:
+def most_requested_score(req, used, alloc, xp=jnp):
     cpu_mem = slice(0, 2)
     a = alloc[:, cpu_mem]
     u = used[:, cpu_mem] + req[None, cpu_mem]
-    frac = jnp.where(a > 0, jnp.clip(u, 0.0, a) / jnp.maximum(a, 1e-9), 0.0)
-    return jnp.mean(frac * 100.0, axis=-1)
+    frac = xp.where(a > 0, xp.clip(u, 0.0, a) / xp.maximum(a, 1e-9), 0.0)
+    return xp.mean(frac * 100.0, axis=-1)
 
 
-def balanced_allocation_score(req: jax.Array, used: jax.Array,
-                              alloc: jax.Array) -> jax.Array:
+def balanced_allocation_score(req, used, alloc, xp=jnp):
     """100 - |cpu_fraction - mem_fraction| * 100 (k8s BalancedAllocation)."""
     a = alloc[:, 0:2]
     u = used[:, 0:2] + req[None, 0:2]
-    frac = jnp.where(a > 0, u / jnp.maximum(a, 1e-9), 0.0)
-    return 100.0 - jnp.abs(frac[:, 0] - frac[:, 1]) * 100.0
+    frac = xp.where(a > 0, u / xp.maximum(a, 1e-9), 0.0)
+    return 100.0 - xp.abs(frac[:, 0] - frac[:, 1]) * 100.0
 
 
-def node_score(req: jax.Array, idle: jax.Array, alloc: jax.Array,
-               weights: ScoreWeights, static_bonus: jax.Array) -> jax.Array:
+def node_score(req, idle, alloc, weights: ScoreWeights, static_bonus,
+               xp=jnp):
     """Combined per-node score for one task against the current node state.
 
     used is derived from the idle/alloc invariant (used = alloc - idle for
     schedulable accounting), so the scan carries only idle.
     req [R], idle [N,R], alloc [N,R], static_bonus [N] -> [N].
+    With xp=numpy, ``weights`` must hold host values (see
+    ScoreWeights.host()).
     """
     used = alloc - idle
-    s = weights.binpack * binpack_score(req, used, alloc, weights.binpack_res)
-    s = s + weights.least * least_requested_score(req, used, alloc)
-    s = s + weights.most * most_requested_score(req, used, alloc)
-    s = s + weights.balanced * balanced_allocation_score(req, used, alloc)
+    s = weights.binpack * binpack_score(req, used, alloc, weights.binpack_res,
+                                        xp)
+    s = s + weights.least * least_requested_score(req, used, alloc, xp)
+    s = s + weights.most * most_requested_score(req, used, alloc, xp)
+    s = s + weights.balanced * balanced_allocation_score(req, used, alloc, xp)
     return s + static_bonus
