@@ -131,6 +131,23 @@ def main() -> None:
             sys.exit(1)
         return
 
+    if len(sys.argv) > 1 and sys.argv[1] == "--all":
+        # the 5 BASELINE.md configs + full-cycle runOnce -> BENCH_DETAILS.json
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            import jax
+            jax.config.update("jax_platforms", "cpu")  # beat sitecustomize
+        from volcano_tpu.bench_suite import run_all
+        full = "--small" not in sys.argv
+        results = run_all(full_scale=full)
+        base = os.path.dirname(os.path.abspath(__file__)) \
+            if "__file__" in globals() else os.getcwd()
+        out = os.path.join(base, "BENCH_DETAILS.json")
+        with open(out, "w") as f:
+            json.dump(results, f, indent=1)
+        for r in results:
+            print(json.dumps(r))
+        return
+
     # ladder: TPU pallas kernel, TPU XLA-scan kernel, CPU XLA-scan; shrink
     # the shape only after every platform/kernel failed on the larger one.
     # A global deadline and a sticky TPU-failure count keep the whole ladder

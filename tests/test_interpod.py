@@ -1,0 +1,200 @@
+"""Inter-pod affinity predicate + batch scorer tests (mirroring the
+upstream interpodaffinity semantics the reference wires in
+pkg/scheduler/plugins/predicates/predicates.go:262-341 and
+pkg/scheduler/plugins/nodeorder/nodeorder.go:271-295)."""
+
+from tests.harness import Harness
+from volcano_tpu.models.objects import (Affinity, NodeSelectorRequirement,
+                                        PodAffinity, PodAffinityTerm,
+                                        PodGroupPhase,
+                                        WeightedPodAffinityTerm)
+from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                          build_pod_group, build_queue,
+                                          build_resource_list)
+
+CONF = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: nodeorder
+  - name: binpack
+"""
+
+RL = build_resource_list("1", "1Gi")
+HOSTNAME = "kubernetes.io/hostname"
+
+
+def term(key, value, topo=HOSTNAME):
+    return PodAffinityTerm(
+        label_selector=[NodeSelectorRequirement(key=key, operator="In",
+                                                values=[value])],
+        topology_key=topo)
+
+
+def affinity_pod(ns, name, labels, required=None, anti_required=None,
+                 preferred=None, group=""):
+    pod = build_pod(ns, name, "", "Pending", RL, group, labels=labels)
+    aff = Affinity()
+    if required or preferred:
+        aff.pod_affinity = PodAffinity(
+            required=required or [],
+            preferred=[WeightedPodAffinityTerm(weight=w, term=t)
+                       for w, t in (preferred or [])])
+    if anti_required:
+        aff.pod_anti_affinity = PodAffinity(required=anti_required)
+    pod.spec.affinity = aff
+    return pod
+
+
+def cluster(h, n_nodes=3, zone_of=None):
+    h.add("queues", build_queue("default", weight=1))
+    for i in range(n_nodes):
+        labels = {HOSTNAME: f"n{i}"}
+        if zone_of:
+            labels["zone"] = zone_of[i]
+        h.add("nodes", build_node(f"n{i}", {"cpu": "8", "memory": "16Gi"},
+                                  labels=labels))
+    return h
+
+
+def test_required_affinity_colocates_by_hostname():
+    """The incoming pod must land on the node hosting the app=web pod."""
+    h = cluster(Harness(CONF))
+    h.add("podgroups",
+          build_pod_group("web", "ns1", "default", 1,
+                          phase=PodGroupPhase.RUNNING),
+          build_pod_group("pg", "ns1", "default", 1,
+                          phase=PodGroupPhase.INQUEUE))
+    h.add("pods",
+          build_pod("ns1", "web-1", "n1", "Running", RL, "web",
+                    labels={"app": "web"}))
+    h.add("pods", affinity_pod("ns1", "pending-1", {"app": "backend"},
+                               required=[term("app", "web")], group="pg"))
+    h.run_actions("enqueue", "allocate").close_session()
+    assert h.binds == {"ns1/pending-1": "n1"}
+
+
+def test_required_affinity_by_zone_topology():
+    """Zone topology: any node in the matching pod's zone qualifies."""
+    h = cluster(Harness(CONF), zone_of=["a", "a", "b"])
+    h.add("podgroups",
+          build_pod_group("web", "ns1", "default", 1,
+                          phase=PodGroupPhase.RUNNING),
+          build_pod_group("pg", "ns1", "default", 1,
+                          phase=PodGroupPhase.INQUEUE))
+    h.add("pods",
+          build_pod("ns1", "web-1", "n0", "Running", RL, "web",
+                    labels={"app": "web"}))
+    h.add("pods", affinity_pod("ns1", "pending-1", {"app": "backend"},
+                               required=[term("app", "web", topo="zone")],
+                               group="pg"))
+    h.run_actions("enqueue", "allocate").close_session()
+    assert h.binds["ns1/pending-1"] in ("n0", "n1")
+
+
+def test_required_affinity_bootstrap_self_match():
+    """First pod of a self-affine group may found the topology (upstream
+    bootstrap exception)."""
+    h = cluster(Harness(CONF))
+    h.add("podgroups", build_pod_group("pg", "ns1", "default", 1,
+                                       phase=PodGroupPhase.INQUEUE))
+    h.add("pods", affinity_pod("ns1", "pending-1", {"app": "web"},
+                               required=[term("app", "web")], group="pg"))
+    h.run_actions("enqueue", "allocate").close_session()
+    assert "ns1/pending-1" in h.binds
+
+
+def test_required_affinity_unsatisfiable_blocks():
+    """No matching pod anywhere and no self-match: nothing schedules."""
+    h = cluster(Harness(CONF))
+    h.add("podgroups", build_pod_group("pg", "ns1", "default", 1,
+                                       phase=PodGroupPhase.INQUEUE))
+    h.add("pods", affinity_pod("ns1", "pending-1", {"app": "backend"},
+                               required=[term("app", "web")], group="pg"))
+    h.run_actions("enqueue", "allocate").close_session()
+    assert h.binds == {}
+
+
+def test_required_anti_affinity_avoids_matching_nodes():
+    h = cluster(Harness(CONF))
+    h.add("podgroups",
+          build_pod_group("web", "ns1", "default", 2,
+                          phase=PodGroupPhase.RUNNING),
+          build_pod_group("pg", "ns1", "default", 1,
+                          phase=PodGroupPhase.INQUEUE))
+    h.add("pods",
+          build_pod("ns1", "web-1", "n0", "Running", RL, "web",
+                    labels={"app": "web"}),
+          build_pod("ns1", "web-2", "n2", "Running", RL, "web",
+                    labels={"app": "web"}))
+    h.add("pods", affinity_pod("ns1", "pending-1", {"app": "backend"},
+                               anti_required=[term("app", "web")],
+                               group="pg"))
+    h.run_actions("enqueue", "allocate").close_session()
+    assert h.binds == {"ns1/pending-1": "n1"}
+
+
+def test_existing_anti_affinity_symmetry_blocks_incoming():
+    """An existing pod with required anti-affinity against app=backend
+    blocks backend pods from its topology (upstream symmetry rule)."""
+    h = cluster(Harness(CONF))
+    h.add("podgroups",
+          build_pod_group("iso", "ns1", "default", 1,
+                          phase=PodGroupPhase.RUNNING),
+          build_pod_group("pg", "ns1", "default", 1,
+                          phase=PodGroupPhase.INQUEUE))
+    iso = affinity_pod("ns1", "iso-1", {"app": "iso"},
+                       anti_required=[term("app", "backend")], group="iso")
+    iso.spec.node_name = "n1"
+    iso.status.phase = "Running"
+    h.add("pods", iso)
+    h.add("pods", build_pod("ns1", "pending-1", "", "Pending", RL, "pg",
+                            labels={"app": "backend"}))
+    h.run_actions("enqueue", "allocate").close_session()
+    assert h.binds.get("ns1/pending-1") in ("n0", "n2")
+
+
+def test_preferred_affinity_scores_matching_topology():
+    """Preferred affinity pulls the pod next to its peers when multiple
+    nodes fit."""
+    h = cluster(Harness(CONF))
+    h.add("podgroups",
+          build_pod_group("web", "ns1", "default", 1,
+                          phase=PodGroupPhase.RUNNING),
+          build_pod_group("pg", "ns1", "default", 1,
+                          phase=PodGroupPhase.INQUEUE))
+    h.add("pods",
+          build_pod("ns1", "web-1", "n2", "Running", RL, "web",
+                    labels={"app": "web"}))
+    h.add("pods", affinity_pod("ns1", "pending-1", {"app": "backend"},
+                               preferred=[(100, term("app", "web"))],
+                               group="pg"))
+    h.run_actions("enqueue", "allocate").close_session()
+    assert h.binds == {"ns1/pending-1": "n2"}
+
+
+def test_batch_node_order_fn_exposes_interpod_scores():
+    """Session-level BatchNodeOrderFn parity (nodeorder.go:271-295)."""
+    h = cluster(Harness(CONF))
+    h.add("podgroups",
+          build_pod_group("web", "ns1", "default", 1,
+                          phase=PodGroupPhase.RUNNING),
+          build_pod_group("pg", "ns1", "default", 1,
+                          phase=PodGroupPhase.INQUEUE))
+    h.add("pods",
+          build_pod("ns1", "web-1", "n1", "Running", RL, "web",
+                    labels={"app": "web"}))
+    task_pod = affinity_pod("ns1", "pending-1", {"app": "backend"},
+                            preferred=[(10, term("app", "web"))], group="pg")
+    h.add("pods", task_pod)
+    ssn = h.open_session()
+    task = next(t for j in ssn.jobs.values() for t in j.tasks.values()
+                if t.name == "pending-1")
+    scores = ssn.batch_node_order_fn(task, list(ssn.nodes.values()))
+    assert scores["n1"] > scores["n0"]
+    assert scores["n1"] > scores["n2"]
+    h.close_session()

@@ -54,3 +54,57 @@ def test_graft_entry_and_dryrun():
     assert (assign >= 0).sum() > 0
 
     mod.dryrun_multichip(8)
+
+
+def test_solver_mesh_parity_full_action_pipeline():
+    """Solver-level integration: the same cluster scheduled through the
+    full allocate action with the mesh-sharded solver must produce exactly
+    the binds of the single-device solver (SURVEY §7 step 6)."""
+    from tests.harness import Harness
+    from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                              build_pod_group, build_queue)
+
+    base_conf = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+    mesh_conf = base_conf + """
+configurations:
+- name: solver
+  arguments:
+    mesh.enable: "true"
+    mesh.devices: 8
+"""
+
+    def build(conf):
+        h = Harness(conf)
+        h.add("queues", build_queue("q1", weight=2),
+              build_queue("q2", weight=1))
+        for i in range(24):
+            h.add("nodes", build_node(
+                f"node-{i}", {"cpu": "16", "memory": "32Gi"},
+                labels={"rack": f"r{i % 4}"}))
+        for j in range(12):
+            q = "q1" if j % 2 == 0 else "q2"
+            h.add("podgroups", build_pod_group(f"pg-{j}", "ns1", q, 4,
+                                               phase="Inqueue"))
+            for t in range(4):
+                h.add("pods", build_pod(
+                    "ns1", f"p{j}-{t}", "", "Pending",
+                    {"cpu": "4", "memory": "8Gi"}, f"pg-{j}"))
+        h.run_actions("enqueue", "allocate").close_session()
+        return h.binds
+
+    single = build(base_conf)
+    sharded = build(mesh_conf)
+    assert single == sharded
+    assert len(sharded) == 48

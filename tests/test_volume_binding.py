@@ -1,0 +1,169 @@
+"""Volume binding + predicate sub-feature tests.
+
+Reference parity targets: the PV/PVC flow of cache/interface.go:56-74
+(GetPodVolumes / AllocateVolumes / BindVolumes), the predicate result
+cache (plugins/predicates/cache.go), and the proportional resource
+reserve (plugins/predicates/proportional.go)."""
+
+import pytest
+
+from tests.harness import Harness
+from volcano_tpu.cache.interface import StoreVolumeBinder, VolumeBindError
+from volcano_tpu.models.objects import (ObjectMeta, PersistentVolume,
+                                        PersistentVolumeClaim, PodGroupPhase)
+from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                          build_pod_group, build_queue,
+                                          build_resource_list)
+
+CONF = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: nodeorder
+"""
+
+RL = build_resource_list("1", "1Gi")
+
+
+def pvc(name, ns="ns1", storage="10Gi", cls=""):
+    return PersistentVolumeClaim(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec={"resources": {"requests": {"storage": storage}},
+              "storageClassName": cls})
+
+
+def pv(name, capacity="20Gi", cls="", nodes=None):
+    return PersistentVolume(metadata=ObjectMeta(name=name),
+                            capacity=capacity, storage_class=cls,
+                            node_affinity=nodes or [])
+
+
+def pod_with_pvc(ns, name, claim, group):
+    p = build_pod(ns, name, "", "Pending", RL, group)
+    p.spec.volumes = [{"name": "data",
+                       "persistentVolumeClaim": {"claimName": claim}}]
+    return p
+
+
+def test_pod_with_pvc_binds_volume_on_schedule():
+    h = Harness(CONF)
+    h.add("queues", build_queue("default", weight=1))
+    h.add("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+    h.add("persistentvolumeclaims", pvc("data-claim"))
+    h.add("persistentvolumes", pv("vol-1"))
+    h.add("podgroups", build_pod_group("pg", "ns1", "default", 1,
+                                       phase=PodGroupPhase.INQUEUE))
+    h.add("pods", pod_with_pvc("ns1", "p1", "data-claim", "pg"))
+    h.run_actions("enqueue", "allocate").close_session()
+    assert h.binds == {"ns1/p1": "n1"}
+    bound_pv = h.store.get("persistentvolumes", "vol-1")
+    bound_pvc = h.store.get("persistentvolumeclaims", "data-claim", "ns1")
+    assert bound_pv.phase == "Bound"
+    assert bound_pv.claim_ref == "ns1/data-claim"
+    assert bound_pvc.phase == "Bound"
+    assert bound_pvc.volume_name == "vol-1"
+
+
+def test_pod_without_matching_pv_does_not_schedule():
+    h = Harness(CONF)
+    h.add("queues", build_queue("default", weight=1))
+    h.add("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+    h.add("persistentvolumeclaims", pvc("data-claim", storage="100Gi"))
+    h.add("persistentvolumes", pv("vol-small", capacity="10Gi"))
+    h.add("podgroups", build_pod_group("pg", "ns1", "default", 1,
+                                       phase=PodGroupPhase.INQUEUE))
+    h.add("pods", pod_with_pvc("ns1", "p1", "data-claim", "pg"))
+    h.run_actions("enqueue", "allocate").close_session()
+    assert h.binds == {}
+    assert h.store.get("persistentvolumes", "vol-small").phase == "Available"
+
+
+def test_pv_node_affinity_restricts_reuse_and_class_matching():
+    binder = StoreVolumeBinder.__new__(StoreVolumeBinder)
+    from volcano_tpu.apiserver import ObjectStore
+    store = ObjectStore()
+    binder.store = store
+    binder._assumed = set()
+    store.create("persistentvolumeclaims", pvc("c1", cls="fast"))
+    store.create("persistentvolumes", pv("slow-1", cls="slow"))
+    store.create("persistentvolumes",
+                 pv("fast-1", cls="fast", nodes=["n2"]))
+
+    class T:
+        namespace = "ns1"
+        pod = pod_with_pvc("ns1", "p", "c1", "")
+
+    n1 = build_node("n1", {"cpu": "1"})
+    n2 = build_node("n2", {"cpu": "1"})
+    with pytest.raises(VolumeBindError):
+        binder.get_pod_volumes(T(), n1)   # fast-1 unreachable from n1
+    vols = binder.get_pod_volumes(T(), n2)
+    assert vols.bindings == [("ns1/c1", "fast-1")]
+    # assumption prevents double-booking until released
+    binder.allocate_volumes(T(), "n2", vols)
+    with pytest.raises(VolumeBindError):
+        binder.get_pod_volumes(T(), n2)
+    binder.release_volumes(T(), vols)
+    assert binder.get_pod_volumes(T(), n2).bindings == \
+        [("ns1/c1", "fast-1")]
+
+
+def test_predicate_cache_memoizes_stable_filters():
+    from volcano_tpu.framework.arguments import Arguments
+    from volcano_tpu.plugins.predicates import (POD_TEMPLATE_KEY,
+                                                PredicatesPlugin)
+    plugin = PredicatesPlugin(Arguments({"predicate.CacheEnable": "true"}))
+    assert plugin.cache_enable
+
+    h = Harness(CONF.replace("- name: predicates", """- name: predicates
+    arguments:
+      predicate.CacheEnable: "true\""""))
+    h.add("queues", build_queue("default", weight=1))
+    h.add("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"},
+                              labels={"disk": "ssd"}))
+    h.add("podgroups", build_pod_group("pg", "ns1", "default", 2,
+                                       phase=PodGroupPhase.INQUEUE))
+    for i in range(2):
+        p = build_pod("ns1", f"p{i}", "", "Pending", RL, "pg",
+                      selector={"disk": "ssd"})
+        p.metadata.annotations[POD_TEMPLATE_KEY] = "tmpl-1"
+        h.add("pods", p)
+    h.run_actions("enqueue", "allocate").close_session()
+    assert set(h.binds) == {"ns1/p0", "ns1/p1"}
+
+
+def test_proportional_reserve_blocks_cpu_hogs_on_gpu_nodes():
+    """A cpu-only gang must not squeeze a GPU node below the reserve; it
+    lands on the cpu-only node instead (proportional.go semantics)."""
+    conf = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: predicates
+    arguments:
+      predicate.ProportionalEnable: "true"
+      predicate.resources: "nvidia.com/gpu"
+      predicate.resources.nvidia.com/gpu.cpu: 4
+      predicate.resources.nvidia.com/gpu.memory: 8
+  - name: nodeorder
+"""
+    h = Harness(conf)
+    h.add("queues", build_queue("default", weight=1))
+    # gpu node: 8 idle gpus -> reserve 32 cpus; only 40 cpu total so a
+    # 16-cpu pod would leave 24 < 32: blocked
+    h.add("nodes", build_node("gpu-node", {"cpu": "40", "memory": "512Gi",
+                                           "nvidia.com/gpu": "8"}))
+    h.add("nodes", build_node("cpu-node", {"cpu": "40", "memory": "64Gi"}))
+    h.add("podgroups", build_pod_group("pg", "ns1", "default", 1,
+                                       phase=PodGroupPhase.INQUEUE))
+    h.add("pods", build_pod("ns1", "hog", "", "Pending",
+                            build_resource_list("16", "8Gi"), "pg"))
+    h.run_actions("enqueue", "allocate").close_session()
+    assert h.binds == {"ns1/hog": "cpu-node"}

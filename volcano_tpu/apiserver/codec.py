@@ -32,6 +32,7 @@ KIND_TYPES: Dict[str, type] = {
     "secrets": obj.Secret,
     "networkpolicies": obj.NetworkPolicy,
     "persistentvolumeclaims": obj.PersistentVolumeClaim,
+    "persistentvolumes": obj.PersistentVolume,
 }
 
 

@@ -23,7 +23,8 @@ from ..utils.fastclone import fast_clone
 
 NAMESPACED = {"pods", "podgroups", "jobs", "commands", "resourcequotas", "services",
               "configmaps", "secrets", "networkpolicies", "persistentvolumeclaims"}
-CLUSTER_SCOPED = {"nodes", "queues", "priorityclasses", "numatopologies"}
+CLUSTER_SCOPED = {"nodes", "queues", "priorityclasses", "numatopologies",
+                  "persistentvolumes"}
 KINDS = NAMESPACED | CLUSTER_SCOPED
 
 

@@ -1,0 +1,291 @@
+"""The five BASELINE.md benchmark configs + full-cycle measurements.
+
+Run via ``python bench.py --all`` (writes BENCH_DETAILS.json). The driver's
+headline metric stays the single 50k x 10k kernel line from ``bench.py``;
+this suite reports the full table:
+
+  1 example/job.yaml-shaped single PodGroup gang (cycle sanity)
+  2 1k tasks x 100 nodes, predicates + binpack (full cycle)
+  3 DRF multi-queue fair-share: 4 queues, 5k tasks (full cycle)
+  4 preempt victim selection: 5k starving tasks x 10k nodes (action)
+  5 50k tasks x 10k nodes topology-aware (rack affinity static score):
+    gang-allocate kernel, plus the node-axis-sharded variant on the mesh
+
+plus the end-to-end ``runOnce`` (snapshot -> encode -> place -> commit)
+latency at 50k x 10k — the reference's 1 s --schedule-period budget covers
+runOnce (pkg/scheduler/scheduler.go:90), not just the placement math.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict, List, Optional
+
+CONF_FULL = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+CONF_PREEMPT = """
+actions: "preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: nodeorder
+"""
+
+
+def log(msg: str) -> None:
+    print(f"[bench-suite] {msg}", file=sys.stderr, flush=True)
+
+
+def _cycle_env(conf_text: str):
+    from volcano_tpu.apiserver import ObjectStore
+    from volcano_tpu.cache import SchedulerCache
+    from volcano_tpu.framework import parse_scheduler_conf
+    from volcano_tpu.utils.test_utils import FakeBinder, FakeEvictor
+
+    store = ObjectStore()
+    binder = FakeBinder(store)
+    cache = SchedulerCache(store, binder=binder,
+                           evictor=FakeEvictor(store))
+    cache.run()
+    return store, cache, binder, parse_scheduler_conf(conf_text)
+
+
+def _run_cycle(cache, conf) -> float:
+    from volcano_tpu.framework import close_session, get_action, open_session
+
+    t0 = time.perf_counter()
+    ssn = open_session(cache, conf.tiers, conf.configurations)
+    try:
+        for name in conf.actions:
+            action = get_action(name)
+            if action is not None:
+                action.execute(ssn)
+    finally:
+        close_session(ssn)
+    return (time.perf_counter() - t0) * 1000.0
+
+
+def _populate(store, n_nodes, n_jobs, gang, queues=None, cpu="2",
+              mem="4Gi", node_cpu="64", node_mem="256Gi"):
+    from volcano_tpu.utils.synth import populate_store
+    populate_store(store, n_nodes=n_nodes, n_jobs=n_jobs, gang_size=gang,
+                   queues=queues, cpu_req=cpu, mem_req=mem,
+                   node_cpu=node_cpu, node_mem=node_mem)
+
+
+def config_1() -> Dict:
+    """Single gang-of-3 PodGroup (example/job.yaml shape), full cycle."""
+    store, cache, binder, conf = _cycle_env(CONF_FULL)
+    _populate(store, n_nodes=4, n_jobs=1, gang=3, node_cpu="8",
+              node_mem="16Gi")
+    ms = _run_cycle(cache, conf)           # includes compile
+    store2, cache2, binder2, _ = _cycle_env(CONF_FULL)
+    _populate(store2, n_nodes=4, n_jobs=1, gang=3, node_cpu="8",
+              node_mem="16Gi")
+    ms = _run_cycle(cache2, conf)
+    cache2.flush_executors()
+    assert len(binder2.binds) == 3, binder2.binds
+    return {"config": 1, "desc": "single gang-of-3 PodGroup, full cycle",
+            "value_ms": round(ms, 2), "binds": len(binder2.binds)}
+
+
+def config_2() -> Dict:
+    """1k tasks x 100 nodes, predicates + binpack, full cycle."""
+    conf_text = CONF_FULL
+    store, cache, binder, conf = _cycle_env(conf_text)
+    _populate(store, n_nodes=100, n_jobs=125, gang=8)
+    _run_cycle(cache, conf)                # compile warm-up
+    store2, cache2, binder2, _ = _cycle_env(conf_text)
+    _populate(store2, n_nodes=100, n_jobs=125, gang=8)
+    ms = _run_cycle(cache2, conf)
+    cache2.flush_executors()
+    return {"config": 2, "desc": "1k tasks x 100 nodes full cycle",
+            "value_ms": round(ms, 2), "binds": len(binder2.binds)}
+
+
+def config_3() -> Dict:
+    """DRF multi-queue fair share: 4 queues, 5k tasks, full cycle."""
+    queues = [(f"q{i}", w) for i, w in enumerate([1, 2, 3, 4])]
+    store, cache, binder, conf = _cycle_env(CONF_FULL)
+    _populate(store, n_nodes=1000, n_jobs=625, gang=8, queues=queues)
+    _run_cycle(cache, conf)
+    store2, cache2, binder2, _ = _cycle_env(CONF_FULL)
+    _populate(store2, n_nodes=1000, n_jobs=625, gang=8, queues=queues)
+    ms = _run_cycle(cache2, conf)
+    cache2.flush_executors()
+    return {"config": 3,
+            "desc": "drf 4-queue fair share, 5k tasks x 1k nodes full cycle",
+            "value_ms": round(ms, 2), "binds": len(binder2.binds)}
+
+
+def config_4(n_nodes=10000, n_low=1250, n_high=625) -> Dict:
+    """Preempt victim selection at 5k starving tasks x 10k nodes."""
+    from volcano_tpu.framework import get_action, open_session
+    from volcano_tpu.models.objects import ObjectMeta, PriorityClass
+    from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                              build_pod_group, build_queue)
+
+    store, cache, binder, conf = _cycle_env(CONF_PREEMPT)
+    store.create("queues", build_queue("default", weight=1))
+    store.create("priorityclasses",
+                 PriorityClass(metadata=ObjectMeta(name="high"), value=100))
+    store.create("priorityclasses",
+                 PriorityClass(metadata=ObjectMeta(name="low"), value=1))
+    for i in range(n_nodes):
+        store.create("nodes", build_node(f"node-{i}",
+                                         {"cpu": "16", "memory": "32Gi"}))
+    for j in range(n_low):
+        store.create("podgroups", build_pod_group(
+            f"lo-{j}", "ns1", "default", 8, phase="Running",
+            priority_class="low"))
+        for t in range(8):
+            store.create("pods", build_pod(
+                "ns1", f"lo-{j}-{t}", f"node-{(j * 8 + t) % n_nodes}",
+                "Running", {"cpu": "14", "memory": "28Gi"}, f"lo-{j}"))
+    for j in range(n_high):
+        store.create("podgroups", build_pod_group(
+            f"hi-{j}", "ns1", "default", 8, phase="Inqueue",
+            priority_class="high"))
+        for t in range(8):
+            store.create("pods", build_pod(
+                "ns1", f"hi-{j}-{t}", "", "Pending",
+                {"cpu": "8", "memory": "16Gi"}, f"hi-{j}"))
+    ssn = open_session(cache, conf.tiers, conf.configurations)
+    t0 = time.perf_counter()
+    get_action("preempt").execute(ssn)
+    ms = (time.perf_counter() - t0) * 1000.0
+    from volcano_tpu.models.job_info import TaskStatus
+    evicted = sum(1 for j in ssn.jobs.values() for t in j.tasks.values()
+                  if t.status == TaskStatus.Releasing)
+    return {"config": 4,
+            "desc": f"preempt {n_high * 8} starving x {n_nodes} nodes",
+            "value_ms": round(ms, 2), "evicted": evicted}
+
+
+def config_5(n_tasks=50_000, n_nodes=10_000, runs=3,
+             sharded_devices: Optional[int] = None) -> List[Dict]:
+    """50k x 10k rack-affinity kernel: single device + sharded mesh."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from volcano_tpu.ops.allocate import gang_allocate
+    from volcano_tpu.ops.score import ScoreWeights
+    from volcano_tpu.utils.synth import synth_arrays
+
+    out: List[Dict] = []
+    sa = synth_arrays(n_tasks, n_nodes, gang_size=8, seed=42,
+                      utilization=0.3, rack_affinity=True)
+    weights = ScoreWeights.make(sa.group_req.shape[1], binpack=1.0)
+    args = [jnp.asarray(a) for a in sa.args] + [weights]
+    r = gang_allocate(*args)
+    jax.block_until_ready(r[0])
+    best = float("inf")
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        r = gang_allocate(*args)
+        jax.block_until_ready(r[0])
+        best = min(best, (time.perf_counter() - t0) * 1000.0)
+    out.append({"config": 5,
+                "desc": f"{n_tasks // 1000}k x {n_nodes // 1000}k "
+                        "rack-affinity gang-allocate kernel",
+                "value_ms": round(best, 2),
+                "platform": jax.devices()[0].platform})
+
+    if sharded_devices and len(jax.devices()) >= sharded_devices:
+        from jax.sharding import Mesh
+
+        from volcano_tpu.ops.sharded import (make_sharded_gang_allocate,
+                                             shard_synth)
+        mesh = Mesh(np.array(jax.devices()[:sharded_devices]), ("nodes",))
+        n_pad = ((n_nodes + sharded_devices - 1) // sharded_devices) \
+            * sharded_devices
+        sa2 = synth_arrays(n_tasks, n_nodes, gang_size=8, seed=42,
+                           utilization=0.3, rack_affinity=True,
+                           node_pad_to=max(n_pad, 256))
+        fn = make_sharded_gang_allocate(mesh)
+        sargs = shard_synth(mesh, sa2)
+        r = fn(*sargs, weights)
+        jax.block_until_ready(r[0])
+        best = float("inf")
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            r = fn(*sargs, weights)
+            jax.block_until_ready(r[0])
+            best = min(best, (time.perf_counter() - t0) * 1000.0)
+        out.append({"config": 5,
+                    "desc": f"same, node-axis sharded over "
+                            f"{sharded_devices}-device mesh",
+                    "value_ms": round(best, 2),
+                    "platform": jax.devices()[0].platform})
+    return out
+
+
+def full_cycle_50k(n_tasks=50_000, n_nodes=10_000) -> Dict:
+    """End-to-end runOnce at 50k x 10k through the store-backed cache."""
+    log(f"building {n_tasks}x{n_nodes} cluster through the store "
+        "(this takes a while)")
+    store, cache, binder, conf = _cycle_env(CONF_FULL)
+    t0 = time.perf_counter()
+    _populate(store, n_nodes=n_nodes, n_jobs=n_tasks // 8, gang=8)
+    log(f"store populated in {time.perf_counter() - t0:.1f}s")
+    ms = _run_cycle(cache, conf)   # single cold cycle (includes compile)
+    log(f"cold cycle: {ms:.0f} ms")
+    # a second cluster measures the warm cycle (jit cache hit)
+    store2, cache2, binder2, _ = _cycle_env(CONF_FULL)
+    _populate(store2, n_nodes=n_nodes, n_jobs=n_tasks // 8, gang=8)
+    warm = _run_cycle(cache2, conf)
+    t0 = time.perf_counter()
+    cache2.flush_executors(timeout=600.0)
+    flush_ms = (time.perf_counter() - t0) * 1000.0
+    return {"config": "full_cycle",
+            "desc": f"end-to-end runOnce {n_tasks // 1000}k tasks x "
+                    f"{n_nodes // 1000}k nodes (snapshot+encode+place+"
+                    "commit; async bind flush reported separately)",
+            "value_ms": round(warm, 2),
+            "bind_flush_ms": round(flush_ms, 2),
+            "binds": len(binder2.binds)}
+
+
+def run_all(full_scale: bool = True) -> List[Dict]:
+    import jax
+
+    results: List[Dict] = []
+    for fn in (config_1, config_2, config_3):
+        log(f"running {fn.__name__}")
+        results.append(fn())
+        log(f"{fn.__name__}: {results[-1]}")
+    log("running config_4")
+    results.append(config_4() if full_scale else
+                   config_4(n_nodes=2000, n_low=250, n_high=125))
+    log(f"config_4: {results[-1]}")
+    log("running config_5")
+    n_dev = len(jax.devices())
+    results.extend(config_5(sharded_devices=n_dev if n_dev >= 2 else None)
+                   if full_scale else
+                   config_5(5_000, 1_000,
+                            sharded_devices=n_dev if n_dev >= 2 else None))
+    log(f"config_5: {results[-1]}")
+    if full_scale:
+        log("running full_cycle_50k")
+        results.append(full_cycle_50k())
+        log(f"full_cycle: {results[-1]}")
+    return results

@@ -32,8 +32,8 @@ from ..models.objects import (DEFAULT_QUEUE, DEFAULT_SCHEDULER_NAME, PodGroup,
                               PodGroupCondition, PodGroupPhase)
 from ..models.queue_info import NamespaceCollection, QueueInfo
 from .event_handlers import EventHandlersMixin
-from .interface import (NullVolumeBinder, StoreBinder, StoreEvictor,
-                        StoreStatusUpdater)
+from .interface import (StoreBinder, StoreEvictor, StoreStatusUpdater,
+                        StoreVolumeBinder)
 
 
 class SchedulerCache(EventHandlersMixin):
@@ -62,7 +62,8 @@ class SchedulerCache(EventHandlersMixin):
         self.evictor = evictor if evictor is not None else StoreEvictor(store)
         self.status_updater = (status_updater if status_updater is not None
                                else StoreStatusUpdater(store))
-        self.volume_binder = volume_binder if volume_binder is not None else NullVolumeBinder()
+        self.volume_binder = volume_binder if volume_binder is not None \
+            else StoreVolumeBinder(store)
 
         self.mutex = threading.RLock()
         self.err_tasks: deque = deque()      # resync queue (cache.go:116)

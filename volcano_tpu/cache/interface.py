@@ -79,8 +79,8 @@ class StoreStatusUpdater:
 
 
 class NullVolumeBinder:
-    """Volume scheduling is not modeled; all pods' volumes are always ready
-    (the reference's FakeVolumeBinder, util/test_utils.go:160-177)."""
+    """No-op binder; all pods' volumes are always ready (the reference's
+    FakeVolumeBinder, util/test_utils.go:160-177)."""
 
     def get_pod_volumes(self, task, node):
         return None
@@ -90,3 +90,144 @@ class NullVolumeBinder:
 
     def bind_volumes(self, task, pod_volumes) -> None:
         return None
+
+    def release_volumes(self, task, pod_volumes) -> None:
+        return None
+
+
+class PodVolumes:
+    """Planned PVC->PV bindings for one task on one node (the reference's
+    scheduling.PodVolumes, cache/interface.go:56-74)."""
+
+    def __init__(self, bindings=None):
+        # list of (pvc_key "ns/name", pv_name)
+        self.bindings = bindings or []
+
+
+class VolumeBindError(RuntimeError):
+    # RuntimeError so allocate's staging treats it as a placement failure
+    pass
+
+
+class StoreVolumeBinder:
+    """Real PV/PVC flow against store objects — the standalone equivalent
+    of the reference's k8s volumebinding-backed defaultVolumeBinder
+    (cache/cache.go GetPodVolumes/AllocateVolumes/BindVolumes):
+
+    * ``get_pod_volumes``: for each unbound PVC the pod mounts, pick an
+      Available PV (capacity, storage class, node reachability) that is
+      not already assumed by an in-flight placement;
+    * ``allocate_volumes``: assume the planned PVs so concurrent placements
+      in the same cycle can't double-book them;
+    * ``bind_volumes``: write the PV.claim_ref / PVC.volume_name pair
+      through the store (the API bind);
+    * ``release_volumes``: drop assumptions on statement rollback.
+    """
+
+    def __init__(self, store):
+        self.store = store
+        self._assumed: set = set()       # pv names reserved in-cycle
+        self._assumed_pvc: set = set()   # pvc keys already planned in-cycle
+
+    def reset_assumptions(self) -> None:
+        """Called at snapshot time: each cycle replans from scratch, so
+        assumptions that never reached bind (e.g. kept-pipelined gangs)
+        must not leak into the next cycle."""
+        self._assumed.clear()
+        self._assumed_pvc.clear()
+
+    def _pvc_names(self, pod) -> list:
+        names = []
+        for vol in pod.spec.volumes:
+            vol = vol or {}
+            # k8s shape {"persistentVolumeClaim": {"claimName": ...}} and
+            # the job controller's {"pvc": <claim>} entries
+            # (controllers/job/controller.py createJobIOIfNotExist)
+            claim = vol.get("persistentVolumeClaim")
+            if claim and claim.get("claimName"):
+                names.append(claim["claimName"])
+            elif vol.get("pvc"):
+                names.append(vol["pvc"])
+        return names
+
+    def get_pod_volumes(self, task, node):
+        pvc_names = self._pvc_names(task.pod)
+        if not pvc_names:
+            return None
+        node_name = node.metadata.name if node is not None else ""
+        bindings = []
+        planned = set()
+        for name in pvc_names:
+            pvc_key = f"{task.namespace}/{name}"
+            pvc = self.store.get("persistentvolumeclaims", name,
+                                 task.namespace)
+            if pvc is None:
+                raise VolumeBindError(f"pvc {pvc_key} not found")
+            if pvc.phase == "Bound" and pvc.volume_name:
+                continue   # already bound; nothing to plan
+            if pvc_key in self._assumed_pvc:
+                # another placement this cycle already plans to bind it;
+                # pods sharing a claim ride that binding
+                continue
+            pv = self._find_pv(pvc, node_name, planned)
+            if pv is None:
+                raise VolumeBindError(
+                    f"no available PV for pvc {task.namespace}/{name} "
+                    f"on node {node_name}")
+            planned.add(pv.metadata.name)
+            bindings.append((pvc_key, pv.metadata.name))
+        return PodVolumes(bindings)
+
+    def _find_pv(self, pvc, node_name: str, planned: set):
+        want = pvc.requested_bytes()
+        cls = pvc.storage_class()
+        best = None
+        for pv in self.store.list("persistentvolumes"):
+            if pv.phase != "Available" or pv.claim_ref:
+                continue
+            if pv.metadata.name in self._assumed or \
+                    pv.metadata.name in planned:
+                continue
+            if cls and pv.storage_class != cls:
+                continue
+            if pv.node_affinity and node_name not in pv.node_affinity:
+                continue
+            if pv.capacity_bytes() < want:
+                continue
+            # smallest satisfying volume wins (k8s smallest-fit)
+            if best is None or pv.capacity_bytes() < best.capacity_bytes():
+                best = pv
+        return best
+
+    def allocate_volumes(self, task, hostname, pod_volumes) -> None:
+        if pod_volumes is None:
+            return
+        for pvc_key, pv_name in pod_volumes.bindings:
+            self._assumed.add(pv_name)
+            self._assumed_pvc.add(pvc_key)
+
+    def release_volumes(self, task, pod_volumes) -> None:
+        if pod_volumes is None:
+            return
+        for pvc_key, pv_name in pod_volumes.bindings:
+            self._assumed.discard(pv_name)
+            self._assumed_pvc.discard(pvc_key)
+
+    def bind_volumes(self, task, pod_volumes) -> None:
+        if pod_volumes is None:
+            return
+        for pvc_key, pv_name in pod_volumes.bindings:
+            ns, name = pvc_key.split("/", 1)
+            pv = self.store.get("persistentvolumes", pv_name)
+            pvc = self.store.get("persistentvolumeclaims", name, ns)
+            if pv is None or pvc is None:
+                continue
+            pv.claim_ref = pvc_key
+            pv.phase = "Bound"
+            self.store.update("persistentvolumes", pv, skip_admission=True)
+            pvc.volume_name = pv_name
+            pvc.phase = "Bound"
+            self.store.update("persistentvolumeclaims", pvc,
+                              skip_admission=True)
+            self._assumed.discard(pv_name)
+            self._assumed_pvc.discard(pvc_key)

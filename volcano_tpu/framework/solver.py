@@ -62,6 +62,26 @@ class BatchSolver:
         self.bucket_fn: Optional[Callable] = None
         self.vectorized_plugins: set = set()
         self.enable_default_predicates = False
+        # node-axis sharding over a device mesh (SURVEY §7 step 6): enabled
+        # by the scheduler conf's `solver` configuration —
+        #   configurations:
+        #   - name: solver
+        #     arguments: {mesh.enable: "true", mesh.devices: 8}
+        # The sharded kernel (ops/sharded.py) is exact vs the single-device
+        # scan; tests/test_sharded.py holds the parity proof.
+        self.mesh = None
+        solver_args = (ssn.configurations or {}).get("solver")
+        if solver_args is not None and \
+                getattr(solver_args, "get_bool",
+                        lambda *_: False)("mesh.enable", False):
+            import jax
+            from jax.sharding import Mesh
+            n_dev = solver_args.get_int("mesh.devices", 0) or \
+                len(jax.devices())
+            devices = jax.devices()[:n_dev]
+            if len(devices) >= 2:
+                self.mesh = Mesh(np.array(devices), ("nodes",))
+        self._sharded_fns: Dict[bool, Callable] = {}
 
     # -- plugin contribution API ------------------------------------------
 
@@ -229,25 +249,36 @@ class BatchSolver:
                 task_bucket[t_idx] = keys.setdefault(key, len(keys))
                 pack_bonus[batch.task_group[t_idx]] = bonus
 
-        assign, pipelined, ready, kept, _ = gang_allocate(
-            jnp.asarray(batch.task_group), jnp.asarray(batch.task_job),
-            jnp.asarray(batch.task_valid), jnp.asarray(batch.group_req),
-            gmask, static_score,
-            jnp.asarray(task_bucket), jnp.asarray(pack_bonus),
-            jnp.asarray(batch.job_min_available),
-            jnp.asarray(batch.job_ready_base),
-            jnp.asarray(batch.job_task_start),
-            jnp.asarray(batch.job_n_tasks),
-            jnp.asarray(batch.job_queue),
-            jnp.asarray(batch.queue_job_start),
-            jnp.asarray(batch.queue_njobs),
-            jnp.asarray(q_deserved), jnp.asarray(q_alloc0),
-            jnp.asarray(narr.idle), jnp.asarray(narr.future_idle),
-            jnp.asarray(narr.allocatable), jnp.asarray(narr.n_tasks),
-            jnp.asarray(narr.max_tasks), eps, self.score_weights(),
-            allow_pipeline=allow_pipeline)
+        import time
 
-        assign = np.asarray(assign)
+        from ..metrics import metrics as m
+        t_kernel = time.perf_counter()
+        if self.mesh is not None:
+            assign, pipelined, ready, kept = self._run_sharded(
+                batch, narr, gmask, static_score, task_bucket, pack_bonus,
+                q_deserved, q_alloc0, eps, allow_pipeline)
+        else:
+            assign, pipelined, ready, kept, _ = gang_allocate(
+                jnp.asarray(batch.task_group), jnp.asarray(batch.task_job),
+                jnp.asarray(batch.task_valid), jnp.asarray(batch.group_req),
+                gmask, static_score,
+                jnp.asarray(task_bucket), jnp.asarray(pack_bonus),
+                jnp.asarray(batch.job_min_available),
+                jnp.asarray(batch.job_ready_base),
+                jnp.asarray(batch.job_task_start),
+                jnp.asarray(batch.job_n_tasks),
+                jnp.asarray(batch.job_queue),
+                jnp.asarray(batch.queue_job_start),
+                jnp.asarray(batch.queue_njobs),
+                jnp.asarray(q_deserved), jnp.asarray(q_alloc0),
+                jnp.asarray(narr.idle), jnp.asarray(narr.future_idle),
+                jnp.asarray(narr.allocatable), jnp.asarray(narr.n_tasks),
+                jnp.asarray(narr.max_tasks), eps, self.score_weights(),
+                allow_pipeline=allow_pipeline)
+
+        assign = np.asarray(assign)   # blocks until the device finishes
+        m.observe(m.SOLVER_KERNEL_LATENCY,
+                  (time.perf_counter() - t_kernel) * 1000.0)
         pipelined_np = np.asarray(pipelined)
         ready_np = np.asarray(ready)
         kept_np = np.asarray(kept)
@@ -282,6 +313,58 @@ class BatchSolver:
             result.placements[job.uid] = placements
             result.unplaced[job.uid] = unplaced
         return result
+
+    def _run_sharded(self, batch, narr, gmask, static_score, task_bucket,
+                     pack_bonus, q_deserved, q_alloc0, eps, allow_pipeline):
+        """Node-axis-sharded placement over the device mesh: each chip owns
+        N/D nodes' scan state, collectives ride ICI (ops/sharded.py)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..ops.sharded import make_sharded_gang_allocate
+
+        mesh = self.mesh
+        d = mesh.devices.size
+        n_pad = narr.idle.shape[0]
+        n2 = ((n_pad + d - 1) // d) * d
+
+        def pad_nodes(a, axis, fill=0):
+            if a.shape[axis] == n2:
+                return a
+            widths = [(0, 0)] * a.ndim
+            widths[axis] = (0, n2 - a.shape[axis])
+            return np.pad(np.asarray(a), widths, constant_values=fill)
+
+        fn = self._sharded_fns.get(allow_pipeline)
+        if fn is None:
+            fn = make_sharded_gang_allocate(mesh,
+                                            allow_pipeline=allow_pipeline)
+            self._sharded_fns[allow_pipeline] = fn
+
+        n = NamedSharding(mesh, P("nodes"))
+        nr = NamedSharding(mesh, P("nodes", None))
+        gn = NamedSharding(mesh, P(None, "nodes"))
+        rep = NamedSharding(mesh, P())
+        import jax
+        put = jax.device_put
+        assign, pipelined, ready, kept, _idle = fn(
+            put(batch.task_group, rep), put(batch.task_job, rep),
+            put(batch.task_valid, rep), put(batch.group_req, rep),
+            put(pad_nodes(gmask, 1, False), gn),
+            put(pad_nodes(static_score, 1, 0.0), gn),
+            put(task_bucket, rep), put(pack_bonus, rep),
+            put(batch.job_min_available, rep),
+            put(batch.job_ready_base, rep),
+            put(batch.job_task_start, rep), put(batch.job_n_tasks, rep),
+            put(batch.job_queue, rep), put(batch.queue_job_start, rep),
+            put(batch.queue_njobs, rep), put(q_deserved, rep),
+            put(q_alloc0, rep),
+            put(pad_nodes(narr.idle, 0), nr),
+            put(pad_nodes(narr.future_idle, 0), nr),
+            put(pad_nodes(narr.allocatable, 0), nr),
+            put(pad_nodes(narr.n_tasks, 0), n),
+            put(pad_nodes(narr.max_tasks, 0), n),
+            put(np.asarray(eps), rep), self.score_weights())
+        return assign, pipelined, ready, kept
 
     def _record_fit_errors(self, job: JobInfo, task: TaskInfo,
                            batch: TaskBatch, narr: NodeArrays,

@@ -98,6 +98,10 @@ class Statement:
         self.operations.append(_Operation("allocate", task))
 
     def _unallocate(self, task: TaskInfo) -> None:
+        if self.ssn.cache is not None and task.pod_volumes is not None:
+            self.ssn.cache.volume_binder.release_volumes(task,
+                                                         task.pod_volumes)
+            task.pod_volumes = None
         job = self.ssn.jobs.get(task.job)
         node = self.ssn.nodes.get(task.node_name)
         if node is not None:

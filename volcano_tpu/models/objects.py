@@ -523,6 +523,37 @@ class NetworkPolicy:
 class PersistentVolumeClaim:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: Dict[str, Any] = field(default_factory=dict)
+    # binding status (k8s PVC.status + spec.volumeName)
+    volume_name: str = ""
+    phase: str = "Pending"          # Pending | Bound | Lost
+
+    def requested_bytes(self) -> float:
+        from .quantity import parse_quantity
+        req = (self.spec.get("resources", {}) or {}).get("requests", {})
+        storage = req.get("storage", "0")
+        return float(parse_quantity(storage))
+
+    def storage_class(self) -> str:
+        return self.spec.get("storageClassName", "") or ""
+
+
+@dataclass
+class PersistentVolume:
+    """Cluster-scoped volume (the reference's PV informer feeds the real
+    k8s volumebinding plugin, cache/cache.go:84-96; here the store holds
+    PVs directly)."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    capacity: str = "0"             # storage quantity
+    storage_class: str = ""
+    access_modes: List[str] = field(default_factory=list)
+    # node names this PV is reachable from; empty = any node
+    node_affinity: List[str] = field(default_factory=list)
+    claim_ref: str = ""             # "ns/name" of the bound PVC
+    phase: str = "Available"        # Available | Bound | Released
+
+    def capacity_bytes(self) -> float:
+        from .quantity import parse_quantity
+        return float(parse_quantity(self.capacity))
 
 
 # ---------------------------------------------------------------------------

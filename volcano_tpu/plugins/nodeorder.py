@@ -10,11 +10,14 @@ BalancedResourceAllocation, NodeAffinity (preferred terms), TaintToleration
     balancedresource.weight  (default 1)
     nodeaffinity.weight      (default 1)
     tainttoleration.weight   (default 1)
-    podaffinity.weight       (default 1; batch scorer, see interpod module)
+    podaffinity.weight       (default 1)
 
 TPU-first: least/most/balanced run inside the allocate scan (dynamic state);
-nodeaffinity-preferred and PreferNoSchedule taints are cycle-static, so they
-are encoded per group x node once and added as a static score term.
+nodeaffinity-preferred, PreferNoSchedule taints and inter-pod preferred
+affinity (the reference's BatchNodeOrder scorer, nodeorder.go:271-295 —
+evaluated against the session-open snapshot there too, so cycle-static is
+exact; plugins/interpod.py) are encoded per group x node once and added as
+a static score term.
 """
 
 from __future__ import annotations
@@ -69,6 +72,7 @@ class NodeOrderPlugin(Plugin):
         self.balanced_w = get("balancedresource.weight", 1)
         self.node_affinity_w = get("nodeaffinity.weight", 1)
         self.taint_w = get("tainttoleration.weight", 1)
+        self.pod_affinity_w = get("podaffinity.weight", 1)
 
     def name(self) -> str:
         return NAME
@@ -100,9 +104,50 @@ class NodeOrderPlugin(Plugin):
 
         ssn.add_node_order_fn(NAME, node_order_fn)
 
+        def batch_node_order_fn(task, nodes):
+            """Inter-pod preferred affinity over a node set (the
+            reference's BatchNodeOrderFn, nodeorder.go:278-300)."""
+            from . import interpod
+            if not self.pod_affinity_w:
+                return {}
+            names = [n.name for n in ssn.node_list]
+            index = interpod.get_index(ssn, names)
+            raw = index.preference_score(task)
+            if raw is None:
+                return {}
+            norm = interpod.normalize(raw, float(self.pod_affinity_w))
+            by_name = dict(zip(names, norm))
+            return {node.name: float(by_name.get(node.name, 0.0))
+                    for node in nodes}
+
+        ssn.add_batch_node_order_fn(NAME, batch_node_order_fn)
+
     def _static_score(self, ssn):
+        from . import interpod
+
         def fn(batch, narr, feats):
             score = np.zeros((batch.g_pad, narr.n_pad), np.float32)
+            n = len(narr.names)
+            if self.pod_affinity_w:
+                # inter-pod preferred (anti-)affinity batch scorer
+                # (nodeorder.go:271-295); symmetry can score affinity-free
+                # groups, so gate on any affinity existing at all
+                own = {g for g, members in enumerate(batch.group_members)
+                       if interpod.task_has_pod_affinity(
+                           batch.tasks[members[0]])}
+                existing = any(interpod.task_has_pod_affinity(t)
+                               for node in ssn.nodes.values()
+                               for t in node.tasks.values())
+                if own or existing:
+                    index = interpod.get_index(ssn, narr.names)
+                    groups = set(range(len(batch.group_members))) \
+                        if index.pref_terms else own
+                    for g in groups:
+                        rep = batch.tasks[batch.group_members[g][0]]
+                        raw = index.preference_score(rep)
+                        if raw is not None:
+                            score[g, :n] += interpod.normalize(
+                                raw, float(self.pod_affinity_w))
             # PreferNoSchedule taints are rare: sweep only nodes that carry
             # one (taint-free nodes score a constant, which can't change the
             # per-task argmax and is omitted)

@@ -26,6 +26,72 @@ from ..models.unschedule_info import (FitError, NODE_AFFINITY_FAILED,
                                       NODE_PORT_FAILED, NODE_SELECTOR_FAILED,
                                       TAINT_FAILED)
 
+POD_AFFINITY_FAILED = "node(s) didn't match pod affinity/anti-affinity"
+POD_TEMPLATE_KEY = "volcano.sh/template-uid"   # batch/v1alpha1/labels.go:37
+
+
+class PredicateCache:
+    """Per-(node, pod-template-uid) fit memo (predicates/cache.go): pods
+    stamped with the same template annotation share one predicate verdict
+    per node. The vectorized solver path gets the same effect from task
+    grouping; this serves the host predicate path when
+    ``predicate.CacheEnable`` is set."""
+
+    def __init__(self):
+        self._cache = {}   # node -> {template_uid: fit}
+
+    @staticmethod
+    def template_uid(pod) -> str:
+        return pod.metadata.annotations.get(POD_TEMPLATE_KEY, "")
+
+    def get(self, node_name: str, pod):
+        uid = self.template_uid(pod)
+        if not uid:
+            return None
+        return self._cache.get(node_name, {}).get(uid)
+
+    def update(self, node_name: str, pod, fit: bool) -> None:
+        uid = self.template_uid(pod)
+        if uid:
+            self._cache.setdefault(node_name, {})[uid] = fit
+
+
+def _parse_proportional(args) -> dict:
+    """predicate.resources.<name>.{cpu,memory} rates
+    (predicates.go:124-151)."""
+    get_str = args.get_str if hasattr(args, "get_str") else \
+        (lambda k, d="": str(args.get(k, d) or d))
+    get_f = args.get_float if hasattr(args, "get_float") else \
+        (lambda k, d: float(args.get(k, d) or d))
+    out = {}
+    for res in get_str("predicate.resources", "").split(","):
+        res = res.strip()
+        if not res:
+            continue
+        cpu = get_f(f"predicate.resources.{res}.cpu", 1.0)
+        mem = get_f(f"predicate.resources.{res}.memory", 1.0)
+        out[res] = (cpu if cpu >= 0 else 1.0, mem if mem >= 0 else 1.0)
+    return out
+
+
+def _proportional_ok(task, node, proportional: dict) -> bool:
+    """Reserve cpu/memory in proportion to a node's idle special resource
+    (predicates/proportional.go): tasks NOT requesting the resource must
+    leave idle_cpu >= idle_res * rate_cpu and likewise for memory."""
+    for res in proportional:
+        if task.resreq.get(res) > 0:
+            return True   # requesters are exempt
+    for res, (cpu_rate, mem_rate) in proportional.items():
+        idle_res = node.idle.get(res)
+        if idle_res <= 0:
+            continue
+        cpu_reserved = idle_res * cpu_rate
+        mem_reserved = idle_res * mem_rate * 1000 * 1000
+        if node.idle.milli_cpu - task.resreq.milli_cpu < cpu_reserved or \
+                node.idle.memory - task.resreq.memory < mem_reserved:
+            return False
+    return True
+
 NAME = "predicates"
 
 
@@ -85,16 +151,40 @@ def _gpu_share_ok(task, node) -> bool:
 class PredicatesPlugin(Plugin):
     def __init__(self, arguments=None):
         self.arguments = arguments or {}
+        args = self.arguments
+        get_bool = args.get_bool if hasattr(args, "get_bool") else \
+            (lambda k, d=False: str(args.get(k, d)).lower() in
+             ("true", "1", "yes"))
+        self.cache_enable = get_bool("predicate.CacheEnable", False)
+        self.proportional = _parse_proportional(args) \
+            if get_bool("predicate.ProportionalEnable", False) else {}
+        self._pcache = PredicateCache()
 
     def name(self) -> str:
         return NAME
 
     def on_session_open(self, ssn) -> None:
+        from . import interpod
+
         # vectorized path: selector/taints/affinity matrices + extra masks
         if ssn.solver is not None and ssn.plugin_enabled(NAME, "enabledPredicate"):
             ssn.solver.enable_default_predicates = True
             ssn.solver.mark_vectorized(NAME)
             ssn.solver.add_mask_fn(self._ports_and_gpu_mask(ssn))
+            ssn.solver.add_mask_fn(self._interpod_mask(ssn))
+            if self.proportional:
+                ssn.solver.add_mask_fn(self._proportional_mask())
+
+        def stable_predicates(task, node):
+            """Selector/affinity/taints — the template-cacheable filters
+            (predicateByStablefilter, predicates.go:280-301)."""
+            if not _node_selector_ok(task, node):
+                return NODE_SELECTOR_FAILED
+            if not _node_affinity_ok(task, node):
+                return NODE_AFFINITY_FAILED
+            if not _taints_ok(task, node):
+                return TAINT_FAILED
+            return None
 
         def predicate_fn(task, node):
             """Host path for single-pair probes."""
@@ -102,15 +192,23 @@ class PredicatesPlugin(Plugin):
             if cap and len(node.tasks) >= cap:
                 raise FitException(FitError(task=task, node=node,
                                             reasons=[NODE_POD_NUMBER_EXCEEDED]))
-            if not _node_selector_ok(task, node):
-                raise FitException(FitError(task=task, node=node,
-                                            reasons=[NODE_SELECTOR_FAILED]))
-            if not _node_affinity_ok(task, node):
-                raise FitException(FitError(task=task, node=node,
-                                            reasons=[NODE_AFFINITY_FAILED]))
-            if not _taints_ok(task, node):
-                raise FitException(FitError(task=task, node=node,
-                                            reasons=[TAINT_FAILED]))
+            if self.cache_enable and PredicateCache.template_uid(task.pod):
+                fit = self._pcache.get(node.name, task.pod)
+                if fit is None:
+                    reason = stable_predicates(task, node)
+                    self._pcache.update(node.name, task.pod, reason is None)
+                    if reason is not None:
+                        raise FitException(FitError(task=task, node=node,
+                                                    reasons=[reason]))
+                elif not fit:
+                    raise FitException(FitError(
+                        task=task, node=node,
+                        reasons=["equivalence cache predicates failed"]))
+            else:
+                reason = stable_predicates(task, node)
+                if reason is not None:
+                    raise FitException(FitError(task=task, node=node,
+                                                reasons=[reason]))
             if not _ports_ok(task, node):
                 raise FitException(FitError(task=task, node=node,
                                             reasons=[NODE_PORT_FAILED]))
@@ -118,8 +216,84 @@ class PredicatesPlugin(Plugin):
                 raise FitException(FitError(
                     task=task, node=node,
                     reasons=["node(s) didn't have enough free gpu memory"]))
+            # InterPodAffinity filter (predicates.go:334-341)
+            names = [n.name for n in ssn.node_list]
+            index = interpod.get_index(ssn, names)
+            if index.anti_required or interpod.task_has_pod_affinity(task):
+                mask = index.required_mask(task)
+                if mask is not None:
+                    try:
+                        i = names.index(node.name)
+                    except ValueError:
+                        i = -1
+                    if i >= 0 and not mask[i]:
+                        raise FitException(FitError(
+                            task=task, node=node,
+                            reasons=[POD_AFFINITY_FAILED]))
+            # proportional resource reserve (predicates.go:353-361)
+            if self.proportional and \
+                    not _proportional_ok(task, node, self.proportional):
+                raise FitException(FitError(
+                    task=task, node=node,
+                    reasons=["proportional resource reserve check failed"]))
 
         ssn.add_predicate_fn(NAME, predicate_fn)
+
+    def _proportional_mask(self):
+        def mask_fn(batch, narr, feats):
+            """Vectorized proportional reserve: for groups NOT requesting a
+            proportional resource, nodes must keep idle cpu/mem above
+            idle_res x rate after placement (proportional.go)."""
+            mask = np.ones((batch.g_pad, narr.n_pad), bool)
+            rindex = narr.rindex
+            for res, (cpu_rate, mem_rate) in self.proportional.items():
+                ri = rindex.index.get(res)
+                if ri is None:
+                    continue
+                idle_res = narr.idle[:, ri] / rindex.scales[ri]   # raw units
+                applies_node = idle_res > 0                        # [N]
+                cpu_reserved = idle_res * cpu_rate                 # millicores
+                mem_reserved = idle_res * mem_rate * 1e6 * \
+                    rindex.scales[1]                               # scaled mem
+                for g, members in enumerate(batch.group_members):
+                    rep = batch.tasks[members[0]]
+                    if rep.resreq.get(res) > 0:
+                        continue   # requesters are exempt
+                    left_cpu = narr.idle[:, 0] - batch.group_req[g, 0]
+                    left_mem = narr.idle[:, 1] - batch.group_req[g, 1]
+                    ok = ~applies_node | ((left_cpu >= cpu_reserved)
+                                          & (left_mem >= mem_reserved))
+                    mask[g] &= ok
+            return mask
+        return mask_fn
+
+    def _interpod_mask(self, ssn):
+        from . import interpod
+
+        def mask_fn(batch, narr, feats):
+            mask = np.ones((batch.g_pad, narr.n_pad), bool)
+            needs = {g for g, members in enumerate(batch.group_members)
+                     if interpod.task_has_pod_affinity(
+                         batch.tasks[members[0]])}
+            # the symmetry rule can constrain affinity-free groups too, but
+            # only when some existing pod carries required anti-affinity —
+            # check cheaply before indexing everything
+            existing_aff = any(interpod.task_has_pod_affinity(t)
+                               for node in ssn.nodes.values()
+                               for t in node.tasks.values())
+            if not needs and not existing_aff:
+                return mask
+            index = interpod.get_index(ssn, narr.names)
+            if index.anti_required:
+                needs = set(range(len(batch.group_members)))
+            n = len(narr.names)
+            for g in needs:
+                members = batch.group_members[g]
+                m = index.required_mask(batch.tasks[members[0]])
+                if m is not None:
+                    mask[g, :n] &= m
+            return mask
+        return mask_fn
 
     def _ports_and_gpu_mask(self, ssn):
         def mask_fn(batch, narr, feats):
