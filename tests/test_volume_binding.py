@@ -83,11 +83,9 @@ def test_pod_without_matching_pv_does_not_schedule():
 
 
 def test_pv_node_affinity_restricts_reuse_and_class_matching():
-    binder = StoreVolumeBinder.__new__(StoreVolumeBinder)
     from volcano_tpu.apiserver import ObjectStore
     store = ObjectStore()
-    binder.store = store
-    binder._assumed = set()
+    binder = StoreVolumeBinder(store)
     store.create("persistentvolumeclaims", pvc("c1", cls="fast"))
     store.create("persistentvolumes", pv("slow-1", cls="slow"))
     store.create("persistentvolumes",
@@ -103,10 +101,19 @@ def test_pv_node_affinity_restricts_reuse_and_class_matching():
         binder.get_pod_volumes(T(), n1)   # fast-1 unreachable from n1
     vols = binder.get_pod_volumes(T(), n2)
     assert vols.bindings == [("ns1/c1", "fast-1")]
-    # assumption prevents double-booking until released
     binder.allocate_volumes(T(), "n2", vols)
+    # a pod sharing the same claim rides the in-flight binding (no new
+    # PV is planned for it) ...
+    assert binder.get_pod_volumes(T(), n2).bindings == []
+
+    class T2:
+        namespace = "ns1"
+        pod = pod_with_pvc("ns1", "q", "c2", "")
+
+    # ... but a different claim cannot double-book the assumed PV
+    store.create("persistentvolumeclaims", pvc("c2", cls="fast"))
     with pytest.raises(VolumeBindError):
-        binder.get_pod_volumes(T(), n2)
+        binder.get_pod_volumes(T2(), n2)
     binder.release_volumes(T(), vols)
     assert binder.get_pod_volumes(T(), n2).bindings == \
         [("ns1/c1", "fast-1")]
