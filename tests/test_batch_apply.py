@@ -1,0 +1,158 @@
+"""Batched placement apply: Statement.allocate_batch / cache.bind_batch /
+batched plugin events must be exactly equivalent to the per-task path.
+
+Reference parity targets: statement.go:232-393 (per-op staging + commit/
+discard), cache.go:605-655 (Bind), session_plugins events; the batch forms
+are our hot-path optimization and these tests pin their semantics.
+"""
+
+import pytest
+
+from tests.harness import Harness
+from volcano_tpu.framework.statement import Statement
+from volcano_tpu.models.job_info import TaskStatus
+from volcano_tpu.models.objects import PodGroupPhase
+from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                          build_pod_group, build_queue,
+                                          build_resource_list)
+
+CONF = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+RL = build_resource_list("1", "1Gi")
+
+
+def _gang_env(n_nodes=3, gang=4):
+    h = Harness(CONF)
+    h.add("queues", build_queue("default", weight=1))
+    for i in range(n_nodes):
+        h.add("nodes", build_node(f"n{i}", {"cpu": "8", "memory": "16Gi"}))
+    h.add("podgroups", build_pod_group("pg", "ns1", "default", gang,
+                                       phase=PodGroupPhase.INQUEUE))
+    for t in range(gang):
+        h.add("pods", build_pod("ns1", f"p{t}", "", "Pending", RL, "pg"))
+    return h
+
+
+def test_batch_apply_binds_whole_gang():
+    h = _gang_env()
+    h.run_actions("enqueue", "allocate").close_session()
+    assert len(h.binds) == 4
+    job = next(iter(h.cache.jobs.values()))
+    statuses = {t.status for t in job.tasks.values()}
+    assert statuses <= {TaskStatus.Binding, TaskStatus.Bound}
+
+
+def test_batch_apply_matches_per_task_shares():
+    """drf/proportion shares after a batched cycle == after per-task events."""
+    h = _gang_env()
+    h.run_actions("enqueue", "allocate")
+    ssn = h.ssn
+    # proportion's queue allocated must equal the sum of gang requests
+    prop = ssn.plugins["proportion"]
+    attr = prop.queue_opts["default"]
+    assert attr.allocated.milli_cpu == pytest.approx(4000.0)
+    drf = ssn.plugins["drf"]
+    jattr = next(iter(drf.job_attrs.values()))
+    assert jattr.allocated.milli_cpu == pytest.approx(4000.0)
+    h.close_session()
+
+
+def test_allocate_batch_rolls_back_failing_task_too():
+    """The failing placement's partial mutations must be undone: status,
+    node_name, pod node_name, and job.allocated all restored."""
+    h = _gang_env(n_nodes=1, gang=2)
+    ssn = h.open_session()
+    job = next(iter(ssn.jobs.values()))
+    tasks = sorted(job.tasks.values(), key=lambda t: t.name)
+    node = ssn.nodes["n0"]
+    stmt = Statement(ssn)
+    # second placement requests more than the node's remaining idle
+    big = tasks[1]
+    from volcano_tpu.models.resource import Resource
+    big.resreq = Resource.from_resource_list({"cpu": "100"})
+    before_alloc = job.allocated.milli_cpu
+    with pytest.raises(RuntimeError):
+        stmt.allocate_batch(job, [(tasks[0], node, False),
+                                  (big, node, False)])
+    assert tasks[0].status == TaskStatus.Pending
+    assert big.status == TaskStatus.Pending
+    assert tasks[0].node_name == "" and big.node_name == ""
+    assert tasks[0].pod.spec.node_name == ""
+    assert big.pod.spec.node_name == ""
+    assert job.allocated.milli_cpu == before_alloc
+    assert not node.tasks
+    h.close_session()
+
+
+def test_allocate_batch_keep_partial_keeps_prefix():
+    h = _gang_env(n_nodes=1, gang=3)
+    ssn = h.open_session()
+    job = next(iter(ssn.jobs.values()))
+    tasks = sorted(job.tasks.values(), key=lambda t: t.name)
+    node = ssn.nodes["n0"]
+    from volcano_tpu.models.resource import Resource
+    tasks[1].resreq = Resource.from_resource_list({"cpu": "100"})
+    stmt = Statement(ssn)
+    stmt.allocate_batch(job, [(t, node, False) for t in tasks],
+                        keep_partial=True)
+    # task 0 staged, task 1 failed and was undone, task 2 never attempted
+    assert tasks[0].status == TaskStatus.Allocated
+    assert tasks[1].status == TaskStatus.Pending
+    assert tasks[2].status == TaskStatus.Pending
+    stmt.discard()
+    assert tasks[0].status == TaskStatus.Pending
+    assert job.allocated.milli_cpu == 0
+    h.close_session()
+
+
+def test_batch_discard_restores_everything():
+    h = _gang_env()
+    ssn = h.open_session()
+    job = next(iter(ssn.jobs.values()))
+    tasks = sorted(job.tasks.values(), key=lambda t: t.name)
+    node = ssn.nodes["n0"]
+    idle_before = node.idle.milli_cpu
+    stmt = Statement(ssn)
+    stmt.allocate_batch(job, [(t, node, i % 2 == 1)
+                              for i, t in enumerate(tasks)])
+    assert node.idle.milli_cpu < idle_before
+    stmt.discard()
+    assert node.idle.milli_cpu == idle_before
+    assert node.pipelined.milli_cpu == 0
+    assert all(t.status == TaskStatus.Pending for t in tasks)
+    assert not node.tasks
+    # plugin shares restored too
+    prop = ssn.plugins["proportion"]
+    assert prop.queue_opts["default"].allocated.milli_cpu == 0
+    h.close_session()
+
+
+def test_bind_echo_fast_path_updates_annotations():
+    """update_pod's fast path must refresh annotation-derived fields."""
+    from volcano_tpu.models import objects
+    h = _gang_env(n_nodes=1, gang=1)
+    h.run_actions("enqueue", "allocate").close_session()
+    assert h.binds == {"ns1/p0": "n0"}
+    job = next(iter(h.cache.jobs.values()))
+    task = next(iter(job.tasks.values()))
+    assert not task.preemptable
+    # flip the preemptable annotation on the bound pod
+    pod = h.store.get("pods", "p0", "ns1")
+    pod.metadata.annotations[objects.PREEMPTABLE_KEY] = "true"
+    h.store.update("pods", pod, skip_admission=True)
+    task = next(iter(job.tasks.values()))
+    assert task.preemptable
+    node_view = h.cache.nodes["n0"].tasks.get(task.key())
+    assert node_view is not None and node_view.preemptable

@@ -156,23 +156,18 @@ class AllocateAction(Action):
     # -- session application ----------------------------------------------
 
     def _stage(self, ssn, phase_a, result_a) -> Dict[str, Statement]:
-        """Stage phase-A placements into session state via per-job statements."""
+        """Stage phase-A placements into session state via per-job statements
+        (one batched staging pass per gang — Statement.allocate_batch)."""
         staged: Dict[str, Statement] = {}
         for job, _ in phase_a:
             if not (result_a.committed[job.uid] or result_a.kept[job.uid]):
                 continue
             stmt = Statement(ssn)
-            ok = True
-            for p in result_a.placements[job.uid]:
-                try:
-                    if p.pipelined:
-                        stmt.pipeline(p.task, p.node_name)
-                    else:
-                        stmt.allocate(p.task, ssn.nodes[p.node_name])
-                except (KeyError, RuntimeError, AssertionError):
-                    ok = False
-                    break
-            if not ok:
+            try:
+                stmt.allocate_batch(
+                    job, [(p.task, ssn.nodes[p.node_name], p.pipelined)
+                          for p in result_a.placements[job.uid]])
+            except (KeyError, RuntimeError, AssertionError):
                 stmt.discard()
                 continue
             staged[job.uid] = stmt
@@ -184,14 +179,17 @@ class AllocateAction(Action):
             stmt = staged.get(job.uid)
             if stmt is None:
                 continue
-            for p in result_b.placements.get(shadow.uid, []):
-                try:
-                    if p.pipelined:
-                        stmt.pipeline(p.task, p.node_name)
-                    else:
-                        stmt.allocate(p.task, ssn.nodes[p.node_name])
-                except (KeyError, RuntimeError, AssertionError):
-                    break
+            try:
+                stmt.allocate_batch(
+                    job, [(p.task, ssn.nodes[p.node_name], p.pipelined)
+                          for p in result_b.placements.get(shadow.uid, [])
+                          if p.node_name in ssn.nodes],
+                    keep_partial=True)  # surplus is best-effort
+            except (KeyError, RuntimeError, AssertionError):
+                # a volume-mounting surplus task takes the per-task path
+                # inside allocate_batch and can still raise; the gang
+                # itself stays staged either way
+                pass
 
     def _finalize(self, ssn, phase_a, result_a, staged) -> None:
         """JobReady -> Commit; JobPipelined -> keep; else Discard."""

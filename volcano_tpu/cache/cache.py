@@ -270,6 +270,49 @@ class SchedulerCache(EventHandlersMixin):
                 self.resync_task(task)
         self._submit(do_bind)
 
+    def bind_batch(self, pairs) -> list:
+        """Bind a whole gang: ``[(task_info, hostname)]`` under one mutex
+        pass with a single executor submission (the per-gang form of
+        ``bind``; cache.go:605-655 pays mutex + goroutine per task).
+
+        Tasks whose job/task/node lookup fails are skipped — the per-task
+        commit path swallows the same KeyError — and the accepted tasks
+        are returned so the caller can advance their session status."""
+        accepted = []
+        bound = []
+        with self.mutex:
+            for task_info, hostname in pairs:
+                try:
+                    job, task = self._find_job_and_task(task_info)
+                except KeyError:
+                    continue
+                node = self.nodes.get(hostname)
+                if node is None:
+                    continue
+                original = task.status
+                job.move_task_status(task, TaskStatus.Binding)
+                try:
+                    node.add_task(task)
+                except RuntimeError:
+                    job.move_task_status(task, original)
+                    continue
+                accepted.append(task_info)
+                bound.append((task, task.pod, hostname))
+
+        def do_bind_all():
+            for task, pod, hostname in bound:
+                try:
+                    self.binder.bind(pod, hostname)
+                    self.store.record_event(
+                        "pods", pod, "Normal", "Scheduled",
+                        f"Successfully assigned {task.namespace}/"
+                        f"{task.name} to {hostname}")
+                except Exception:
+                    self.resync_task(task)
+        if bound:
+            self._submit(do_bind_all)
+        return accepted
+
     def evict(self, task_info: TaskInfo, reason: str) -> None:
         """Mark Releasing, update node accounting, then delete the pod
         (cache.go:552-601)."""

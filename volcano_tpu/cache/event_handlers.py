@@ -12,7 +12,8 @@ from __future__ import annotations
 from typing import Optional
 
 from ..models import objects as obj
-from ..models.job_info import (JobInfo, TaskInfo, get_job_id, is_terminated)
+from ..models.job_info import (JobInfo, TaskInfo, allocated_status,
+                               get_job_id, is_terminated)
 from ..models.node_info import NodeInfo
 from ..models.queue_info import NamespaceCollection, QueueInfo
 
@@ -69,6 +70,38 @@ class EventHandlersMixin:
             self.nodes[ti.node_name].remove_task(ti)
 
     def update_pod(self, old: obj.Pod, new: obj.Pod) -> None:
+        # Fast path for bind/status echoes: when the cached task and the
+        # new view sit on the same node with the same request, both in
+        # allocated-like states, the node accounting is unchanged — only
+        # the status index moves. A full cycle binds every placed pod, so
+        # the echo re-ingest (two TaskInfo rebuilds + delete/add
+        # accounting) would otherwise cost as much as the bind itself
+        # (event_handlers.go:207-230 pays the same via UpdateTask).
+        nt = TaskInfo(new)
+        job = self.jobs.get(nt.job) if nt.job else None
+        cached = job.tasks.get(nt.uid) if job is not None else None
+        if (cached is not None and cached.node_name
+                and cached.node_name == nt.node_name
+                and allocated_status(cached.status)
+                and allocated_status(nt.status)
+                and cached.resreq.equal(nt.resreq)):
+            job.move_task_status(cached, nt.status)
+            node = self.nodes.get(cached.node_name)
+            for view in (cached,) if node is None else \
+                    (cached, node.tasks.get(cached.key())):
+                if view is None:
+                    continue
+                # annotation/spec-derived fields must track the new pod
+                # even on the fast path (e.g. a flipped preemptable
+                # annotation feeds the tdm plugin's victim selection)
+                view.status = nt.status
+                view.pod = nt.pod
+                view.priority = nt.priority
+                view.preemptable = nt.preemptable
+                view.revocable_zone = nt.revocable_zone
+                view.topology_policy = nt.topology_policy
+                view.constraint_key_cache = nt.constraint_key_cache
+            return
         self._delete_task(TaskInfo(old))
         self.add_pod(new)
 

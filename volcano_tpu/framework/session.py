@@ -43,10 +43,22 @@ class Event:
 
 
 class EventHandler:
+    """Per-task Allocate/Deallocate hooks, with optional batched forms.
+
+    ``batch_allocate_func(job, tasks, total_resource)`` lets additive
+    plugins (drf, proportion) absorb a whole gang's placement in one call
+    instead of one share recompute per task; handlers without a batch form
+    are fed per-task events by the session's batched fire, so semantics
+    are identical either way."""
+
     def __init__(self, allocate_func: Optional[Callable] = None,
-                 deallocate_func: Optional[Callable] = None):
+                 deallocate_func: Optional[Callable] = None,
+                 batch_allocate_func: Optional[Callable] = None,
+                 batch_deallocate_func: Optional[Callable] = None):
         self.allocate_func = allocate_func
         self.deallocate_func = deallocate_func
+        self.batch_allocate_func = batch_allocate_func
+        self.batch_deallocate_func = batch_deallocate_func
 
 
 _FN_MAPS = (
@@ -394,6 +406,35 @@ class Session:
         for eh in self.event_handlers:
             if eh.deallocate_func is not None:
                 eh.deallocate_func(Event(task))
+
+    def _fire_allocate_batch(self, job, tasks) -> None:
+        """One event round for a whole gang's placements."""
+        if not tasks:
+            return
+        from ..models.resource import Resource
+        total = Resource()
+        for t in tasks:
+            total.add(t.resreq)
+        for eh in self.event_handlers:
+            if eh.batch_allocate_func is not None:
+                eh.batch_allocate_func(job, tasks, total)
+            elif eh.allocate_func is not None:
+                for t in tasks:
+                    eh.allocate_func(Event(t))
+
+    def _fire_deallocate_batch(self, job, tasks) -> None:
+        if not tasks:
+            return
+        from ..models.resource import Resource
+        total = Resource()
+        for t in tasks:
+            total.add(t.resreq)
+        for eh in self.event_handlers:
+            if eh.batch_deallocate_func is not None:
+                eh.batch_deallocate_func(job, tasks, total)
+            elif eh.deallocate_func is not None:
+                for t in tasks:
+                    eh.deallocate_func(Event(t))
 
     def pipeline(self, task: TaskInfo, hostname: str) -> None:
         """Assign onto releasing resources; session-state only."""

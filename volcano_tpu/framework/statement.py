@@ -20,6 +20,16 @@ class _Operation:
         self.reason = reason
 
 
+class _BatchOperation:
+    """One staged gang: [(task, node_info, pipelined)] applied together."""
+
+    name = "batch"
+
+    def __init__(self, job, items):
+        self.job = job
+        self.items = items
+
+
 class Statement:
     def __init__(self, ssn):
         self.ssn = ssn
@@ -112,6 +122,104 @@ class Statement:
         task.pod.spec.node_name = ""
         self.ssn._fire_deallocate(task)
 
+    # -- batch allocate (the hot path's staging) ---------------------------
+
+    def allocate_batch(self, job, placements, keep_partial: bool = False) -> None:
+        """Stage a whole gang's placements: ``[(task, node_info,
+        pipelined)]``.
+
+        Semantically identical to calling :meth:`pipeline` /
+        :meth:`allocate` once per task, but the plugin event round is
+        batched (one share recompute per gang instead of per task —
+        EventHandler.batch_allocate_func). Tasks whose pods mount volumes
+        take the per-task path because volume planning can fail per task.
+
+        On a failed placement: with ``keep_partial`` (best-effort surplus,
+        the reference's break-on-first-failure loop) the already-staged
+        prefix is kept; otherwise everything — including the failing
+        task's partial mutations — is rolled back and the error re-raised."""
+        ssn = self.ssn
+        fast = []
+        for task, node, pipelined in placements:
+            if ssn.cache is not None and task.pod.spec.volumes:
+                if pipelined:
+                    self.pipeline(task, node.name)
+                else:
+                    self.allocate(task, node)
+                continue
+            fast.append((task, node, pipelined))
+        if not fast:
+            return
+        applied = []
+        failure: Optional[BaseException] = None
+        for task, node, pipelined in fast:
+            job_of = ssn.jobs.get(task.job)
+            try:
+                if job_of is None:
+                    raise KeyError(f"failed to find job {task.job}")
+                if pipelined:
+                    job_of.move_task_status(task, TaskStatus.Pipelined)
+                else:
+                    task.pod.spec.node_name = node.name
+                    job_of.move_task_status(task, TaskStatus.Allocated)
+                task.node_name = node.name
+                node.add_task(task)
+            except Exception as e:
+                # undo this task's partial mutations; add_task itself is
+                # atomic on error (it mutates nothing before raising), so
+                # the node is untouched — only the job-side status move
+                # and the name fields can have landed
+                if job_of is not None and task.status != TaskStatus.Pending:
+                    job_of.move_task_status(task, TaskStatus.Pending)
+                task.node_name = ""
+                if not pipelined:
+                    task.pod.spec.node_name = ""
+                failure = e
+                break
+            applied.append((task, node, pipelined))
+        if failure is not None and not keep_partial:
+            for task, node, pipelined in reversed(applied):
+                node.remove_task(task)
+                job_of = ssn.jobs.get(task.job)
+                if job_of is not None:
+                    job_of.move_task_status(task, TaskStatus.Pending)
+                task.node_name = ""
+                if not pipelined:
+                    task.pod.spec.node_name = ""
+            raise failure
+        if applied:
+            ssn._fire_allocate_batch(job, [t for t, _, _ in applied])
+            self.operations.append(_BatchOperation(job, applied))
+
+    def _unbatch(self, op: _BatchOperation) -> None:
+        for task, node, pipelined in reversed(op.items):
+            node.remove_task(task)
+            job_of = self.ssn.jobs.get(task.job)
+            if job_of is not None:
+                job_of.move_task_status(task, TaskStatus.Pending)
+            task.node_name = ""
+            if not pipelined:
+                task.pod.spec.node_name = ""
+        self.ssn._fire_deallocate_batch(op.job, [t for t, _, _ in op.items])
+
+    def _commit_batch(self, op: _BatchOperation) -> None:
+        """Dispatch a staged gang: allocated tasks bind through the cache
+        in one locked pass (cache.bind_batch); pipelined ones stay
+        session-state only, exactly like the per-task ops."""
+        ssn = self.ssn
+        to_bind = [(task, node.name) for task, node, pipelined in op.items
+                   if not pipelined]
+        if not to_bind:
+            return
+        if ssn.cache is not None:
+            accepted = ssn.cache.bind_batch(to_bind)
+        else:
+            accepted = [t for t, _ in to_bind]
+        for task in accepted:
+            job_of = ssn.jobs.get(task.job)
+            if job_of is not None:
+                job_of.move_task_status(task, TaskStatus.Binding)
+
     # -- commit / discard (statement.go:350-393) ---------------------------
 
     def discard(self) -> None:
@@ -123,6 +231,8 @@ class Statement:
                 self._unpipeline(op.task)
             elif op.name == "allocate":
                 self._unallocate(op.task)
+            elif op.name == "batch":
+                self._unbatch(op)
         self.operations = []
 
     def commit(self) -> None:
@@ -142,3 +252,5 @@ class Statement:
                     self.ssn.dispatch(op.task, op.task.pod_volumes)
                 except KeyError:
                     pass
+            elif op.name == "batch":
+                self._commit_batch(op)

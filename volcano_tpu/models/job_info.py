@@ -120,8 +120,13 @@ class TaskInfo:
         c.job = self.job
         c.name = self.name
         c.namespace = self.namespace
-        c.resreq = self.resreq.clone()
-        c.init_resreq = self.init_resreq.clone()
+        # resreq/init_resreq are immutable after construction (nothing in
+        # the scheduler mutates a task's request in place — a changed pod
+        # spec arrives as a *new* TaskInfo via the event handlers), so
+        # clones share them; a cycle clones every task 3+ times and the
+        # defensive Resource copies dominated snapshot cost
+        c.resreq = self.resreq
+        c.init_resreq = self.init_resreq
         c.node_name = self.node_name
         c.status = self.status
         c.priority = self.priority
@@ -264,6 +269,32 @@ class JobInfo:
         task.status = status
         self.add_task_info(task)
 
+    def move_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
+        """In-place status move for a task already registered in this job.
+
+        Equivalent to :meth:`update_task_status` but skips the net-zero
+        total_request sub/add pair and only touches ``allocated`` when the
+        allocated-ness actually flips — the hot allocate/bind path moves
+        every placed task three times per cycle, so the saved Resource
+        arithmetic is significant at 50k tasks."""
+        stored = self.tasks.get(task.uid)
+        if stored is None:
+            raise KeyError(f"failed to find task <{task.namespace}/"
+                           f"{task.name}> in job <{self.namespace}/{self.name}>")
+        old = stored.status
+        idx = self.task_status_index[old]
+        idx.pop(task.uid, None)
+        if not idx:
+            del self.task_status_index[old]
+        was, now = allocated_status(old), allocated_status(status)
+        if was and not now:
+            self.allocated.sub(stored.resreq)
+        elif now and not was:
+            self.allocated.add(stored.resreq)
+        task.status = status
+        self.tasks[task.uid] = task
+        self.task_status_index[status][task.uid] = task
+
     def delete_task_info(self, ti: TaskInfo) -> None:
         task = self.tasks.get(ti.uid)
         if task is None:
@@ -299,8 +330,20 @@ class JobInfo:
         info.budget = self.budget.clone()
         info.task_min_available = dict(self.task_min_available)
         info.task_min_available_total = self.task_min_available_total
-        for task in self.tasks.values():
-            info.add_task_info(task.clone())
+        # direct task copy: the status index and allocated/total aggregates
+        # are cloned rather than re-derived one add_task_info at a time —
+        # at 50k tasks the replay's per-task Resource arithmetic dominated
+        # the snapshot (cache.go:827-876 pays the same via deepcopy-gen)
+        tasks: Dict[str, TaskInfo] = {}
+        index: Dict[TaskStatus, Dict[str, TaskInfo]] = defaultdict(dict)
+        for uid, task in self.tasks.items():
+            c = task.clone()
+            tasks[uid] = c
+            index[c.status][uid] = c
+        info.tasks = tasks
+        info.task_status_index = index
+        info.allocated = self.allocated.clone()
+        info.total_request = self.total_request.clone()
         return info
 
     # -- readiness accounting ---------------------------------------------

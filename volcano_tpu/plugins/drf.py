@@ -283,50 +283,49 @@ class DrfPlugin(Plugin):
 
             ssn.add_namespace_order_fn(NAME, namespace_order_fn)
 
-        def on_allocate(event):
-            attr = self.job_attrs.get(event.task.job)
-            job = ssn.jobs.get(event.task.job)
-            if attr is None or job is None:
+        def _apply_total(job, total, sign):
+            """The single share-update body (drf.go:466-511): per-task
+            events pass one task's resreq, batched events a whole gang's
+            sum — the arithmetic is identical because shares are recomputed
+            from the running ``allocated`` aggregate either way."""
+            if job is None:
                 return
-            attr.allocated.add(event.task.resreq)
+            attr = self.job_attrs.get(job.uid)
+            if attr is None:
+                return
+            if sign > 0:
+                attr.allocated.add(total)
+            else:
+                attr.allocated.sub(total)
             attr.dominant, attr.share = _share_of(attr.allocated, self.total)
             m.update_job_share(job.namespace, job.name, attr.share)
             if ns_enabled:
-                ns = self.namespace_opts.setdefault(event.task.namespace,
-                                                    _DrfAttr())
-                ns.allocated.add(event.task.resreq)
+                ns = self.namespace_opts.setdefault(job.namespace, _DrfAttr())
+                if sign > 0:
+                    ns.allocated.add(total)
+                else:
+                    ns.allocated.sub(total)
                 ns.dominant, ns.share = _share_of(ns.allocated, self.total)
-                m.update_namespace_share(event.task.namespace, ns.share)
+                m.update_namespace_share(job.namespace, ns.share)
             if hier_enabled and job.queue in ssn.queues:
                 queue = ssn.queues[job.queue]
-                self.total_allocated.add(event.task.resreq)
+                if sign > 0:
+                    self.total_allocated.add(total)
+                else:
+                    self.total_allocated.sub(total)
                 self._update_hierarchical_share(
                     self.root, self.total_allocated, job, attr,
                     queue.hierarchy, queue.hierarchical_weights)
 
-        def on_deallocate(event):
-            attr = self.job_attrs.get(event.task.job)
-            job = ssn.jobs.get(event.task.job)
-            if attr is None or job is None:
-                return
-            attr.allocated.sub(event.task.resreq)
-            attr.dominant, attr.share = _share_of(attr.allocated, self.total)
-            m.update_job_share(job.namespace, job.name, attr.share)
-            if ns_enabled:
-                ns = self.namespace_opts.setdefault(event.task.namespace,
-                                                    _DrfAttr())
-                ns.allocated.sub(event.task.resreq)
-                ns.dominant, ns.share = _share_of(ns.allocated, self.total)
-                m.update_namespace_share(event.task.namespace, ns.share)
-            if hier_enabled and job.queue in ssn.queues:
-                queue = ssn.queues[job.queue]
-                self.total_allocated.sub(event.task.resreq)
-                self._update_hierarchical_share(
-                    self.root, self.total_allocated, job, attr,
-                    queue.hierarchy, queue.hierarchical_weights)
-
-        ssn.add_event_handler(EventHandler(allocate_func=on_allocate,
-                                           deallocate_func=on_deallocate))
+        ssn.add_event_handler(EventHandler(
+            allocate_func=lambda e:
+                _apply_total(ssn.jobs.get(e.task.job), e.task.resreq, +1),
+            deallocate_func=lambda e:
+                _apply_total(ssn.jobs.get(e.task.job), e.task.resreq, -1),
+            batch_allocate_func=lambda job, tasks, total:
+                _apply_total(job, total, +1),
+            batch_deallocate_func=lambda job, tasks, total:
+                _apply_total(job, total, -1)))
 
     # -- share math --------------------------------------------------------
 

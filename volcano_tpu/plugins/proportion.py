@@ -173,30 +173,30 @@ class ProportionPlugin(Plugin):
 
         ssn.add_job_enqueueable_fn(NAME, job_enqueueable_fn)
 
-        def on_allocate(event):
-            job = ssn.jobs.get(event.task.job)
+        def _apply_total(job, total, sign):
+            """The single queue-share update body (proportion.go events):
+            per-task events pass one resreq, batched events a gang's sum."""
             if job is None or job.queue not in self.queue_opts:
                 return
             attr = self.queue_opts[job.queue]
-            attr.allocated.add(event.task.resreq)
+            if sign > 0:
+                attr.allocated.add(total)
+            else:
+                attr.allocated.sub(total)
             attr.share = _share(attr.allocated, attr.deserved)
             m.update_queue_allocated(attr.name, attr.allocated.milli_cpu,
                                      attr.allocated.memory)
             m.update_queue_share(attr.name, attr.share)
 
-        def on_deallocate(event):
-            job = ssn.jobs.get(event.task.job)
-            if job is None or job.queue not in self.queue_opts:
-                return
-            attr = self.queue_opts[job.queue]
-            attr.allocated.sub(event.task.resreq)
-            attr.share = _share(attr.allocated, attr.deserved)
-            m.update_queue_allocated(attr.name, attr.allocated.milli_cpu,
-                                     attr.allocated.memory)
-            m.update_queue_share(attr.name, attr.share)
-
-        ssn.add_event_handler(EventHandler(allocate_func=on_allocate,
-                                           deallocate_func=on_deallocate))
+        ssn.add_event_handler(EventHandler(
+            allocate_func=lambda e:
+                _apply_total(ssn.jobs.get(e.task.job), e.task.resreq, +1),
+            deallocate_func=lambda e:
+                _apply_total(ssn.jobs.get(e.task.job), e.task.resreq, -1),
+            batch_allocate_func=lambda job, tasks, total:
+                _apply_total(job, total, +1),
+            batch_deallocate_func=lambda job, tasks, total:
+                _apply_total(job, total, -1)))
 
     # -- the water-fill kernel --------------------------------------------
 
