@@ -32,6 +32,17 @@ from ..ops.allocate import gang_allocate
 from ..ops.fit import group_fit_mask, selector_mask, static_predicate_mask, taint_mask
 from ..ops.score import ScoreWeights
 
+import logging
+
+_logger = logging.getLogger(__name__)
+_logged_once: set = set()
+
+
+def _log_once(msg: str) -> None:
+    if msg not in _logged_once:
+        _logged_once.add(msg)
+        _logger.warning(msg)
+
 
 @dataclass
 class Placement:
@@ -70,17 +81,28 @@ class BatchSolver:
         # The sharded kernel (ops/sharded.py) is exact vs the single-device
         # scan; tests/test_sharded.py holds the parity proof.
         self.mesh = None
+        # kernel selection (the production analogue of the reference's hot
+        # path always running in-process, allocate.go:201-262):
+        #   configurations:
+        #   - name: solver
+        #     arguments: {kernel: pallas|scan|auto}
+        # `auto` (default) picks the Pallas kernel on a TPU backend when the
+        # resource axis fits its sublane budget, else the XLA scan; `pallas`
+        # forces it (interpret mode off-TPU, for parity tests).
+        self.kernel = "auto"
         solver_args = (ssn.configurations or {}).get("solver")
-        if solver_args is not None and \
-                getattr(solver_args, "get_bool",
-                        lambda *_: False)("mesh.enable", False):
-            import jax
-            from jax.sharding import Mesh
-            n_dev = solver_args.get_int("mesh.devices", 0) or \
-                len(jax.devices())
-            devices = jax.devices()[:n_dev]
-            if len(devices) >= 2:
-                self.mesh = Mesh(np.array(devices), ("nodes",))
+        if solver_args is not None:
+            if getattr(solver_args, "get_bool",
+                       lambda *_: False)("mesh.enable", False):
+                import jax
+                from jax.sharding import Mesh
+                n_dev = solver_args.get_int("mesh.devices", 0) or \
+                    len(jax.devices())
+                devices = jax.devices()[:n_dev]
+                if len(devices) >= 2:
+                    self.mesh = Mesh(np.array(devices), ("nodes",))
+            self.kernel = solver_args.get_str("kernel", "auto") \
+                if hasattr(solver_args, "get_str") else "auto"
         self._sharded_fns: Dict[bool, Callable] = {}
 
     # -- plugin contribution API ------------------------------------------
@@ -138,11 +160,22 @@ class BatchSolver:
     # -- placement ---------------------------------------------------------
 
     def _host_predicate_mask(self, batch: TaskBatch, narr: NodeArrays) -> Optional[np.ndarray]:
-        """Fallback for plugins that registered only host predicate fns."""
+        """Fallback for plugins that registered only host predicate fns.
+
+        O(G x N) Python — out-of-tree plugins trade solver speed for
+        generality here, so the first use logs which plugins forced the
+        sweep. A predicate veto is a raised exception (the reference's
+        PredicateFn error contract, scheduler_helper.go:95-127); only
+        AssertionError/KeyError/RuntimeError/ValueError count as vetoes —
+        anything else is a plugin bug and is logged (once per plugin) and
+        re-raised rather than silently read as "node infeasible"."""
         extra = {name: fn for name, fn in self.ssn.predicate_fns.items()
                  if name not in self.vectorized_plugins}
         if not extra:
             return None
+        _log_once("host-predicate fallback active for plugins "
+                  f"{sorted(extra)}: per-node Python sweep (register a "
+                  "vectorized mask_fn for solver-speed predicates)")
         mask = np.ones((batch.g_pad, narr.n_pad), bool)
         for g, members in enumerate(batch.group_members):
             rep = batch.tasks[members[0]]
@@ -150,12 +183,17 @@ class BatchSolver:
                 i = narr.name_to_idx.get(name)
                 if i is None:
                     continue
-                for fn in extra.values():
+                for pname, fn in extra.items():
                     try:
                         fn(rep, node)
-                    except Exception:
+                    except (AssertionError, KeyError, RuntimeError,
+                            ValueError):
                         mask[g, i] = False
                         break
+                    except Exception:
+                        _log_once(f"host predicate {pname!r} raised an "
+                                  "unexpected error (plugin bug?)")
+                        raise
         return mask
 
     def _build_context(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]]):
@@ -171,30 +209,33 @@ class BatchSolver:
         eps = jnp.asarray(self.rindex.eps)
         fit_cap = group_fit_mask(jnp.asarray(batch.group_req),
                                  jnp.asarray(narr.capability), eps)
+        gmask = jnp.asarray(narr.valid)[None, :] & fit_cap
         if self.enable_default_predicates:
-            sel_ok = selector_mask(jnp.asarray(feats.node_pairs),
-                                   jnp.asarray(feats.group_requires),
-                                   jnp.asarray(feats.group_require_counts))
-            taint_ok = taint_mask(jnp.asarray(feats.node_taints),
-                                  jnp.asarray(feats.group_tolerates))
-            affinity_ok = jnp.asarray(feats.group_affinity_ok)
-        else:
-            shape = (batch.g_pad, narr.n_pad)
-            sel_ok = jnp.ones(shape, bool)
-            taint_ok = jnp.ones(shape, bool)
-            affinity_ok = jnp.ones(shape, bool)
+            gmask = gmask & selector_mask(
+                jnp.asarray(feats.node_pairs),
+                jnp.asarray(feats.group_requires),
+                jnp.asarray(feats.group_require_counts))
+            gmask = gmask & taint_mask(jnp.asarray(feats.node_taints),
+                                       jnp.asarray(feats.group_tolerates))
+            if feats.group_affinity_ok is not None:
+                gmask = gmask & jnp.asarray(feats.group_affinity_ok)
 
-        gmask = static_predicate_mask(jnp.asarray(narr.valid), fit_cap,
-                                      sel_ok, taint_ok, affinity_ok)
+        # mask/score contributions return None when trivially pass-through:
+        # a dense [G, N] host array is tens-to-hundreds of MB at 50k x 10k
+        # and host->device shipping it would dominate a tunneled-TPU cycle
         for fn in self.mask_fns:
-            gmask = gmask & jnp.asarray(fn(batch, narr, feats))
+            contrib = fn(batch, narr, feats)
+            if contrib is not None:
+                gmask = gmask & jnp.asarray(contrib)
         host_mask = self._host_predicate_mask(batch, narr)
         if host_mask is not None:
             gmask = gmask & jnp.asarray(host_mask)
 
         static_score = jnp.zeros((batch.g_pad, narr.n_pad), jnp.float32)
         for fn in self.static_score_fns:
-            static_score = static_score + jnp.asarray(fn(batch, narr, feats))
+            contrib = fn(batch, narr, feats)
+            if contrib is not None:
+                static_score = static_score + jnp.asarray(contrib)
         return narr, batch, gmask, static_score
 
     def task_feasibility(self, job: JobInfo, task: TaskInfo):
@@ -214,6 +255,26 @@ class BatchSolver:
         pods_ok = (narr.max_tasks == 0) | (narr.n_tasks < narr.max_tasks)
         mask = np.asarray(gmask[g]) & pods_ok
         return narr, mask, np.asarray(score)
+
+    def _select_kernel(self) -> Tuple[Callable, Dict]:
+        """Resolve the placement kernel per the `solver` conf: the Pallas
+        TPU kernel when requested (or `auto` on a TPU backend) and the
+        resource axis fits its sublane budget, else the XLA scan."""
+        from ..ops.pallas_allocate import R_PAD, gang_allocate_pallas
+        if self.kernel == "pallas":
+            import jax
+            if self.rindex.r > R_PAD:
+                _log_once(f"solver kernel=pallas but {self.rindex.r} "
+                          f"resource dims exceed R_PAD={R_PAD}; "
+                          "falling back to the XLA scan")
+                return gang_allocate, {}
+            interpret = jax.default_backend() != "tpu"
+            return gang_allocate_pallas, {"interpret": interpret}
+        if self.kernel == "auto":
+            import jax
+            if jax.default_backend() == "tpu" and self.rindex.r <= R_PAD:
+                return gang_allocate_pallas, {}
+        return gang_allocate, {}
 
     def place(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]],
               allow_pipeline: bool = True) -> PlacementResult:
@@ -258,7 +319,8 @@ class BatchSolver:
                 batch, narr, gmask, static_score, task_bucket, pack_bonus,
                 q_deserved, q_alloc0, eps, allow_pipeline)
         else:
-            assign, pipelined, ready, kept, _ = gang_allocate(
+            kernel_fn, kernel_kwargs = self._select_kernel()
+            assign, pipelined, ready, kept, _ = kernel_fn(
                 jnp.asarray(batch.task_group), jnp.asarray(batch.task_job),
                 jnp.asarray(batch.task_valid), jnp.asarray(batch.group_req),
                 gmask, static_score,
@@ -274,7 +336,7 @@ class BatchSolver:
                 jnp.asarray(narr.idle), jnp.asarray(narr.future_idle),
                 jnp.asarray(narr.allocatable), jnp.asarray(narr.n_tasks),
                 jnp.asarray(narr.max_tasks), eps, self.score_weights(),
-                allow_pipeline=allow_pipeline)
+                allow_pipeline=allow_pipeline, **kernel_kwargs)
 
         assign = np.asarray(assign)   # blocks until the device finishes
         m.observe(m.SOLVER_KERNEL_LATENCY,
@@ -282,11 +344,11 @@ class BatchSolver:
         pipelined_np = np.asarray(pipelined)
         ready_np = np.asarray(ready)
         kept_np = np.asarray(kept)
-        gmask_np = np.asarray(gmask)
 
         uid_to_j = {uid: j for j, uid in enumerate(batch.job_uids)}
         result = PlacementResult(batch=batch, committed={}, kept={},
                                  placements={}, unplaced={})
+        unplaced_records: List[Tuple[JobInfo, TaskInfo, int]] = []
         for job, jtasks in ordered_jobs:
             j = uid_to_j.get(job.uid, -1)
             if not jtasks or j < 0:
@@ -308,10 +370,19 @@ class BatchSolver:
                                                 bool(pipelined_np[t_idx])))
                 else:
                     unplaced.append(task)
-                    self._record_fit_errors(job, task, batch, narr, gmask_np,
-                                            t_idx)
+                    unplaced_records.append(
+                        (job, task, int(batch.task_group[t_idx])))
             result.placements[job.uid] = placements
             result.unplaced[job.uid] = unplaced
+        if unplaced_records:
+            # fit errors need the predicate mask rows of only the unplaced
+            # groups — a full [G, N] device->host pull costs seconds over a
+            # tunneled TPU, so gather just those rows in one transfer
+            gs = sorted({g for _, _, g in unplaced_records})
+            rows = np.asarray(gmask[jnp.asarray(np.array(gs, np.int32))])
+            row_of = {g: rows[i] for i, g in enumerate(gs)}
+            for job, task, g in unplaced_records:
+                self._record_fit_errors(job, task, narr, row_of[g])
         return result
 
     def _run_sharded(self, batch, narr, gmask, static_score, task_bucket,
@@ -367,13 +438,11 @@ class BatchSolver:
         return assign, pipelined, ready, kept
 
     def _record_fit_errors(self, job: JobInfo, task: TaskInfo,
-                           batch: TaskBatch, narr: NodeArrays,
-                           gmask: np.ndarray, t_idx: int) -> None:
+                           narr: NodeArrays, mask_row: np.ndarray) -> None:
         """Summarize why a task found no node (FitErrors analogue)."""
-        g = batch.task_group[t_idx]
         fe = FitErrors()
         n_real = len(narr.names)
-        blocked = int(n_real - gmask[g, :n_real].sum())
+        blocked = int(n_real - mask_row[:n_real].sum())
         if blocked:
             fe.set_error(f"{blocked}/{n_real} nodes are unavailable for task "
                          f"{task.namespace}/{task.name}: predicates failed "

@@ -340,7 +340,10 @@ class PredicateFeatures:
     * ``node_taints`` [N, K]: node carries (NoSchedule|NoExecute) taint k
     * ``group_tolerates`` [G, K]: group tolerates taint k
     * ``group_affinity_ok`` [G, N]: OR-of-terms node affinity evaluated for
-      expression forms beyond In-pairs (Exists/Gt/Lt/NotIn), host-encoded
+      expression forms beyond In-pairs (Exists/Gt/Lt/NotIn), host-encoded;
+      ``None`` when no group carries required node affinity — a [G, N]
+      all-ones matrix is ~64MB at 50k x 10k and host->device shipping it
+      every cycle would dominate the solver on a tunneled TPU
     """
 
     node_pairs: np.ndarray
@@ -348,7 +351,7 @@ class PredicateFeatures:
     group_require_counts: np.ndarray
     node_taints: np.ndarray
     group_tolerates: np.ndarray
-    group_affinity_ok: np.ndarray
+    group_affinity_ok: Optional[np.ndarray]
 
     @classmethod
     def build(cls, nodes: Dict[str, NodeInfo], node_arrays: NodeArrays,
@@ -404,13 +407,16 @@ class PredicateFeatures:
                     group_tolerates[g, tid] = 1.0
 
         # full node-affinity evaluation (any expression form), host-encoded
-        # per group x node; groups without affinity default to all-ok
-        group_affinity_ok = np.ones((g_pad, n_pad), bool)
+        # per group x node; built only when some group actually carries
+        # required affinity (None otherwise — see class docstring)
+        group_affinity_ok = None
         for g, members in enumerate(batch.group_members):
             t = batch.tasks[members[0]]
             aff = t.pod.spec.affinity
             if aff is None or aff.node_affinity is None or not aff.node_affinity.required:
                 continue
+            if group_affinity_ok is None:
+                group_affinity_ok = np.ones((g_pad, n_pad), bool)
             terms = aff.node_affinity.required
             for name, i in node_arrays.name_to_idx.items():
                 labels = nodes[name].node.metadata.labels if nodes[name].node else {}

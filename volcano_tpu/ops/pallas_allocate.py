@@ -343,7 +343,7 @@ def _pallas_gang_allocate(s_task_group, s_job_start, s_job_ntasks,
                 pl.BlockSpec(memory_space=pltpu.VMEM),   # maxtasks
                 pl.BlockSpec(memory_space=pltpu.VMEM),   # eps
                 pl.BlockSpec(memory_space=pltpu.VMEM),   # weights
-                pl.BlockSpec(memory_space=pltpu.ANY),    # gscore (HBM)
+                pl.BlockSpec(memory_space=pl.ANY),    # gscore (HBM)
             ],
             out_specs=pl.BlockSpec((8, 8), lambda t, *_: (t // 8, 0),
                                    memory_space=pltpu.SMEM),

@@ -127,6 +127,7 @@ class NodeOrderPlugin(Plugin):
 
         def fn(batch, narr, feats):
             score = np.zeros((batch.g_pad, narr.n_pad), np.float32)
+            touched = False   # all-zero -> return None (no [G,N] transfer)
             n = len(narr.names)
             if self.pod_affinity_w:
                 # inter-pod preferred (anti-)affinity batch scorer
@@ -148,6 +149,7 @@ class NodeOrderPlugin(Plugin):
                         if raw is not None:
                             score[g, :n] += interpod.normalize(
                                 raw, float(self.pod_affinity_w))
+                            touched = True
             # PreferNoSchedule taints are rare: sweep only nodes that carry
             # one (taint-free nodes score a constant, which can't change the
             # per-task argmax and is omitted)
@@ -167,12 +169,14 @@ class NodeOrderPlugin(Plugin):
                             if ssn.nodes[name].node else {}
                         score[g, i] += self.node_affinity_w * \
                             _preferred_affinity_score(rep, labels)
-                if self.taint_w:
+                    touched = True
+                if self.taint_w and taint_nodes:
+                    touched = True
                     for name, i in taint_nodes:
                         # relative to the taint-free constant of 100
                         score[g, i] += self.taint_w * (
                             _prefer_no_schedule_score(rep, ssn.nodes[name]) - 100.0)
-            return score
+            return score if touched else None
         return fn
 
 

@@ -244,12 +244,14 @@ class PredicatesPlugin(Plugin):
             """Vectorized proportional reserve: for groups NOT requesting a
             proportional resource, nodes must keep idle cpu/mem above
             idle_res x rate after placement (proportional.go)."""
-            mask = np.ones((batch.g_pad, narr.n_pad), bool)
+            mask = None   # None = pass-through (no dense [G,N] transfer)
             rindex = narr.rindex
             for res, (cpu_rate, mem_rate) in self.proportional.items():
                 ri = rindex.index.get(res)
                 if ri is None:
                     continue
+                if mask is None:
+                    mask = np.ones((batch.g_pad, narr.n_pad), bool)
                 idle_res = narr.idle[:, ri] / rindex.scales[ri]   # raw units
                 applies_node = idle_res > 0                        # [N]
                 cpu_reserved = idle_res * cpu_rate                 # millicores
@@ -271,7 +273,6 @@ class PredicatesPlugin(Plugin):
         from . import interpod
 
         def mask_fn(batch, narr, feats):
-            mask = np.ones((batch.g_pad, narr.n_pad), bool)
             needs = {g for g, members in enumerate(batch.group_members)
                      if interpod.task_has_pod_affinity(
                          batch.tasks[members[0]])}
@@ -282,7 +283,8 @@ class PredicatesPlugin(Plugin):
                                for node in ssn.nodes.values()
                                for t in node.tasks.values())
             if not needs and not existing_aff:
-                return mask
+                return None   # pass-through, no dense [G,N] transfer
+            mask = np.ones((batch.g_pad, narr.n_pad), bool)
             index = interpod.get_index(ssn, narr.names)
             if index.anti_required:
                 needs = set(range(len(batch.group_members)))
@@ -297,7 +299,7 @@ class PredicatesPlugin(Plugin):
 
     def _ports_and_gpu_mask(self, ssn):
         def mask_fn(batch, narr, feats):
-            mask = np.ones((batch.g_pad, narr.n_pad), bool)
+            mask = None   # None = pass-through (no dense [G,N] transfer)
             # only sweep groups that actually use host ports or shared GPUs
             for g, members in enumerate(batch.group_members):
                 rep = batch.tasks[members[0]]
@@ -305,6 +307,8 @@ class PredicatesPlugin(Plugin):
                 uses_gpu = rep.resreq.get(GPU_MEMORY_RESOURCE) > 0
                 if not (uses_ports or uses_gpu):
                     continue
+                if mask is None:
+                    mask = np.ones((batch.g_pad, narr.n_pad), bool)
                 for name, i in narr.name_to_idx.items():
                     node = ssn.nodes[name]
                     if uses_ports and not _ports_ok(rep, node):

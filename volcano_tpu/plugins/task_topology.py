@@ -390,14 +390,14 @@ class TaskTopologyPlugin(Plugin):
         cumsum/argmax over bucket mates — no per-node Python scoring."""
         rindex = ssn.solver.rindex
         n_pad = narr.idle.shape[0]
-        out = np.zeros((batch.g_pad, n_pad), np.float32)
         if not self.managers:
-            return out
+            return None   # pass-through (no dense [G,N] transfer)
         relevant = [(g, batch.tasks[m[0]]) for g, m in
                     enumerate(batch.group_members)
                     if batch.tasks[m[0]].job in self.managers]
         if not relevant:
-            return out
+            return None
+        out = np.zeros((batch.g_pad, n_pad), np.float32)
         # idle + releasing per node (topology.go:136), one host pass
         max_res = np.zeros((n_pad, rindex.r), np.float32)
         for i, name in enumerate(narr.names):

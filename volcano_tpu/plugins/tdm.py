@@ -213,6 +213,8 @@ class TdmPlugin(Plugin):
     def _solver_mask(self, ssn):
         def mask_fn(batch, narr, feats):
             revocable, active = self._node_zone_state(ssn, narr)
+            if not revocable.any():
+                return None   # no revocable nodes: nothing to mask
             task_rz = np.zeros(batch.g_pad, bool)
             for g, members in enumerate(batch.group_members):
                 task_rz[g] = bool(batch.tasks[members[0]].revocable_zone)
@@ -223,6 +225,8 @@ class TdmPlugin(Plugin):
     def _solver_score(self, ssn):
         def score_fn(batch, narr, feats):
             revocable, active = self._node_zone_state(ssn, narr)
+            if not (revocable & active).any():
+                return None   # nothing to attract: no [G,N] transfer
             task_rz = np.zeros(batch.g_pad, bool)
             for g, members in enumerate(batch.group_members):
                 task_rz[g] = bool(batch.tasks[members[0]].revocable_zone)
