@@ -57,7 +57,7 @@ class EnqueueAction(Action):
             if (job.pod_group.spec.min_resources is None
                     or ssn.job_enqueueable(job)):
                 ssn.job_enqueued(job)
-                job.pod_group.status.phase = PodGroupPhase.INQUEUE
+                job.own_pod_group().status.phase = PodGroupPhase.INQUEUE
 
             queue_list.append(queue)
 

@@ -69,18 +69,33 @@ def _cycle_env(conf_text: str):
 
 
 def _run_cycle(cache, conf) -> float:
+    """One measured cycle under the production GC policy: the scheduler
+    loop freezes the long-lived graph and pauses cyclic GC inside runOnce
+    (scheduler.py run/run_once), so the bench does the same."""
+    import gc
+
     from volcano_tpu.framework import close_session, get_action, open_session
 
-    t0 = time.perf_counter()
-    ssn = open_session(cache, conf.tiers, conf.configurations)
+    gc.collect()
+    gc.freeze()
+    was_enabled = gc.isenabled()
+    if was_enabled:
+        gc.disable()
     try:
-        for name in conf.actions:
-            action = get_action(name)
-            if action is not None:
-                action.execute(ssn)
+        t0 = time.perf_counter()
+        ssn = open_session(cache, conf.tiers, conf.configurations)
+        try:
+            for name in conf.actions:
+                action = get_action(name)
+                if action is not None:
+                    action.execute(ssn)
+        finally:
+            close_session(ssn)
+        return (time.perf_counter() - t0) * 1000.0
     finally:
-        close_session(ssn)
-    return (time.perf_counter() - t0) * 1000.0
+        if was_enabled:
+            gc.enable()
+        gc.unfreeze()
 
 
 def _populate(store, n_nodes, n_jobs, gang, queues=None, cpu="2",
@@ -97,10 +112,11 @@ def config_1() -> Dict:
     _populate(store, n_nodes=4, n_jobs=1, gang=3, node_cpu="8",
               node_mem="16Gi")
     ms = _run_cycle(cache, conf)           # includes compile
-    store2, cache2, binder2, _ = _cycle_env(CONF_FULL)
+    cache.flush_executors()                # isolate the warm measurement
+    store2, cache2, binder2, conf2 = _cycle_env(CONF_FULL)
     _populate(store2, n_nodes=4, n_jobs=1, gang=3, node_cpu="8",
               node_mem="16Gi")
-    ms = _run_cycle(cache2, conf)
+    ms = _run_cycle(cache2, conf2)
     cache2.flush_executors()
     assert len(binder2.binds) == 3, binder2.binds
     return {"config": 1, "desc": "single gang-of-3 PodGroup, full cycle",
@@ -113,9 +129,10 @@ def config_2() -> Dict:
     store, cache, binder, conf = _cycle_env(conf_text)
     _populate(store, n_nodes=100, n_jobs=125, gang=8)
     _run_cycle(cache, conf)                # compile warm-up
-    store2, cache2, binder2, _ = _cycle_env(conf_text)
+    cache.flush_executors()                # isolate the warm measurement
+    store2, cache2, binder2, conf2 = _cycle_env(conf_text)
     _populate(store2, n_nodes=100, n_jobs=125, gang=8)
-    ms = _run_cycle(cache2, conf)
+    ms = _run_cycle(cache2, conf2)
     cache2.flush_executors()
     return {"config": 2, "desc": "1k tasks x 100 nodes full cycle",
             "value_ms": round(ms, 2), "binds": len(binder2.binds)}
@@ -127,9 +144,10 @@ def config_3() -> Dict:
     store, cache, binder, conf = _cycle_env(CONF_FULL)
     _populate(store, n_nodes=1000, n_jobs=625, gang=8, queues=queues)
     _run_cycle(cache, conf)
-    store2, cache2, binder2, _ = _cycle_env(CONF_FULL)
+    cache.flush_executors(timeout=120.0)   # isolate the warm measurement
+    store2, cache2, binder2, conf2 = _cycle_env(CONF_FULL)
     _populate(store2, n_nodes=1000, n_jobs=625, gang=8, queues=queues)
-    ms = _run_cycle(cache2, conf)
+    ms = _run_cycle(cache2, conf2)
     cache2.flush_executors()
     return {"config": 3,
             "desc": "drf 4-queue fair share, 5k tasks x 1k nodes full cycle",
@@ -249,10 +267,12 @@ def full_cycle_50k(n_tasks=50_000, n_nodes=10_000) -> Dict:
     log(f"store populated in {time.perf_counter() - t0:.1f}s")
     ms = _run_cycle(cache, conf)   # single cold cycle (includes compile)
     log(f"cold cycle: {ms:.0f} ms")
+    cache.flush_executors(timeout=600.0)   # don't let the cold cycle's
+    # async binds steal the GIL from the warm measurement
     # a second cluster measures the warm cycle (jit cache hit)
-    store2, cache2, binder2, _ = _cycle_env(CONF_FULL)
+    store2, cache2, binder2, conf2 = _cycle_env(CONF_FULL)
     _populate(store2, n_nodes=n_nodes, n_jobs=n_tasks // 8, gang=8)
-    warm = _run_cycle(cache2, conf)
+    warm = _run_cycle(cache2, conf2)
     t0 = time.perf_counter()
     cache2.flush_executors(timeout=600.0)
     flush_ms = (time.perf_counter() - t0) * 1000.0

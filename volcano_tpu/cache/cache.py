@@ -151,6 +151,12 @@ class SchedulerCache(EventHandlersMixin):
                 return
         fn()   # inline mode (no worker started): execute synchronously
 
+    def submit_background(self, fn) -> None:
+        """Run fn on the bind/evict executor (inline before run()) — used
+        by the session's job updater to push status writes off the cycle's
+        critical path, in FIFO order with the binds they follow."""
+        self._submit(fn)
+
     def _exec_loop(self) -> None:
         while True:
             self._exec_event.wait()
@@ -377,6 +383,7 @@ class SchedulerCache(EventHandlersMixin):
             pg = self.status_updater.update_pod_group(job.pod_group)
             if pg is not None:
                 job.pod_group = pg
+                job.pod_group_owned = True
         return job
 
     def record_job_status_event(self, job: JobInfo) -> None:

@@ -29,8 +29,9 @@ def open_session(cache, tiers, configurations=None) -> Session:
     # pre-session PodGroup statuses for jitter-deduped writeback
     ssn.pod_group_status: Dict[str, object] = {}
     for job in ssn.jobs.values():
-        if job.pod_group is not None and job.pod_group.status.conditions:
-            ssn.pod_group_status[job.uid] = _clone_status(job.pod_group.status)
+        if job.pod_group is not None:
+            ssn.pod_group_status[job.uid] = _status_snapshot(
+                job.pod_group.status)
     ssn.total_resource = Resource()
     for n in ssn.nodes.values():
         ssn.total_resource.add(n.allocatable)
@@ -81,7 +82,7 @@ def update_pod_group_condition(ssn: Session, job: JobInfo,
     if job.pod_group is None:
         return
     condition.last_transition_time = _time.time()
-    conditions = job.pod_group.status.conditions
+    conditions = job.own_pod_group().status.conditions
     for i, c in enumerate(conditions):
         if c.type == condition.type:
             conditions[i] = condition
@@ -90,33 +91,47 @@ def update_pod_group_condition(ssn: Session, job: JobInfo,
 
 
 def job_status(ssn: Session, job: JobInfo):
-    """Roll task counts into a PodGroup status (session.go:190-228)."""
+    """Roll task counts into a PodGroup status (session.go:190-228).
+
+    Copy-on-write aware: the candidate values are computed first and the
+    (possibly shared) PodGroup is only claimed and mutated when something
+    actually changed."""
     status = job.pod_group.status
     unschedulable = any(
         c.type == PodGroupConditionType.UNSCHEDULABLE and c.status == "True"
         and c.transition_id == ssn.uid
         for c in status.conditions)
     running = len(job.task_status_index.get(TaskStatus.Running, {}))
+    phase = status.phase
     if running and unschedulable:
-        status.phase = PodGroupPhase.UNKNOWN
+        phase = PodGroupPhase.UNKNOWN
     else:
         allocated = 0
         for st, tasks in job.task_status_index.items():
             if allocated_status(st) or st == TaskStatus.Succeeded:
                 allocated += len(tasks)
         if allocated >= job.pod_group.spec.min_member:
-            status.phase = PodGroupPhase.RUNNING
-        elif job.pod_group.status.phase != PodGroupPhase.INQUEUE:
-            status.phase = PodGroupPhase.PENDING
-    status.running = running
-    status.failed = len(job.task_status_index.get(TaskStatus.Failed, {}))
-    status.succeeded = len(job.task_status_index.get(TaskStatus.Succeeded, {}))
+            phase = PodGroupPhase.RUNNING
+        elif status.phase != PodGroupPhase.INQUEUE:
+            phase = PodGroupPhase.PENDING
+    failed = len(job.task_status_index.get(TaskStatus.Failed, {}))
+    succeeded = len(job.task_status_index.get(TaskStatus.Succeeded, {}))
+    if (phase, running, failed, succeeded) != \
+            (status.phase, status.running, status.failed, status.succeeded):
+        status = job.own_pod_group().status
+        status.phase = phase
+        status.running = running
+        status.failed = failed
+        status.succeeded = succeeded
     return status
 
 
-def _clone_status(status):
-    from ..utils.fastclone import fast_clone
-    return fast_clone(status)
+def _status_snapshot(status) -> tuple:
+    """Cheap immutable fingerprint of a PodGroup status for writeback
+    dedup (replaces a deep clone per job per cycle)."""
+    return (status.phase, status.running, status.succeeded, status.failed,
+            tuple((c.type, c.status, c.reason, c.message,
+                   c.last_transition_time) for c in status.conditions))
 
 
 # condition-writeback dedup window (job_updater.go:31-37)
@@ -135,30 +150,52 @@ class JobUpdater:
         self.job_queue = [j for j in ssn.jobs.values() if j.pod_group is not None]
 
     def update_all(self) -> None:
+        """Compute statuses foreground, push the store writes on the cache
+        executor — the reference parallelizes the API writes over 16
+        goroutines (job_updater.go:51); with the GIL the equivalent is
+        getting them off the cycle's critical path entirely (failures land
+        in events/log, state reconverges via the watch echo)."""
+        updates = []
         for job in self.job_queue:
-            self.update_job(job)
+            updates.append((job, self.prepare_job(job)))
+        cache = self.ssn.cache
+        if cache is None:
+            return
+        if updates:
+            cache.submit_background(
+                lambda: [cache.update_job_status(job, update_pg)
+                         for job, update_pg in updates])
 
     def update_job(self, job: JobInfo) -> None:
+        """Synchronous single-job form (kept for callers outside the
+        session-close batch)."""
+        if self.ssn.cache is not None:
+            self.ssn.cache.update_job_status(job, self.prepare_job(job))
+
+    def prepare_job(self, job: JobInfo) -> bool:
+        """Roll up the job's status; True if the PodGroup must be pushed."""
         ssn = self.ssn
-        job_status(ssn, job)
+        status = job_status(ssn, job)
         old = getattr(ssn, "pod_group_status", {}).get(job.uid)
-        update_pg = old is None or self._status_updated(job.pod_group.status, old)
-        ssn.cache.update_job_status(job, update_pg)
+        return old is None or self._status_updated(status, old)
 
     @staticmethod
-    def _status_updated(new, old) -> bool:
+    def _status_updated(new, old: tuple) -> bool:
+        """Compare a live status against its open-session fingerprint
+        (_status_snapshot tuple)."""
+        o_phase, o_running, o_succeeded, o_failed, o_conds = old
         if (new.phase, new.running, new.succeeded, new.failed) != \
-                (old.phase, old.running, old.succeeded, old.failed):
+                (o_phase, o_running, o_succeeded, o_failed):
             return True
-        if len(new.conditions) != len(old.conditions):
+        if len(new.conditions) != len(o_conds):
             return True
-        for nc, oc in zip(new.conditions, old.conditions):
+        for nc, (o_type, o_status, o_reason, o_message, o_ltt) in \
+                zip(new.conditions, o_conds):
             # jitter dedup: a condition refreshed within the update window
             # counts as unchanged (TimeJitterAfter)
-            if nc.last_transition_time - oc.last_transition_time > \
-                    JOB_CONDITION_UPDATE_TIME:
+            if nc.last_transition_time - o_ltt > JOB_CONDITION_UPDATE_TIME:
                 return True
             if (nc.type, nc.status, nc.reason, nc.message) != \
-                    (oc.type, oc.status, oc.reason, oc.message):
+                    (o_type, o_status, o_reason, o_message):
                 return True
         return False

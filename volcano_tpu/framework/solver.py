@@ -18,7 +18,7 @@ honored through a per-group fallback sweep, trading speed for generality.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
@@ -44,8 +44,9 @@ def _log_once(msg: str) -> None:
         _logger.warning(msg)
 
 
-@dataclass
-class Placement:
+class Placement(NamedTuple):
+    # NamedTuple over dataclass: a cycle materializes one per placed task
+    # (50k at the target scale) and tuple allocation is ~3x cheaper
     task: TaskInfo
     node_name: str
     pipelined: bool
@@ -165,14 +166,18 @@ class BatchSolver:
         O(G x N) Python — out-of-tree plugins trade solver speed for
         generality here, so the first use logs which plugins forced the
         sweep. A predicate veto is a raised exception (the reference's
-        PredicateFn error contract, scheduler_helper.go:95-127); only
-        AssertionError/KeyError/RuntimeError/ValueError count as vetoes —
-        anything else is a plugin bug and is logged (once per plugin) and
-        re-raised rather than silently read as "node infeasible"."""
+        PredicateFn error contract, scheduler_helper.go:95-127); veto
+        types are FitException and the assertion/lookup/runtime errors a
+        filter naturally raises — anything else is a plugin bug and is
+        logged (once per plugin) and re-raised rather than silently read
+        as "node infeasible"."""
         extra = {name: fn for name, fn in self.ssn.predicate_fns.items()
                  if name not in self.vectorized_plugins}
         if not extra:
             return None
+        from ..plugins.predicates import FitException
+        veto_types = (FitException, AssertionError, KeyError, RuntimeError,
+                      ValueError)
         _log_once("host-predicate fallback active for plugins "
                   f"{sorted(extra)}: per-node Python sweep (register a "
                   "vectorized mask_fn for solver-speed predicates)")
@@ -186,8 +191,7 @@ class BatchSolver:
                 for pname, fn in extra.items():
                     try:
                         fn(rep, node)
-                    except (AssertionError, KeyError, RuntimeError,
-                            ValueError):
+                    except veto_types:
                         mask[g, i] = False
                         break
                     except Exception:

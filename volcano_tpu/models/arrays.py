@@ -176,8 +176,13 @@ def _constraint_key(t: TaskInfo) -> tuple:
 
 
 def _req_key(t: TaskInfo) -> tuple:
+    cached = t.req_key_cache
+    if cached is not None:
+        return cached
     r = t.resreq
-    return (r.milli_cpu, r.memory, tuple(sorted(r.scalars.items())))
+    key = (r.milli_cpu, r.memory, tuple(sorted(r.scalars.items())))
+    t.req_key_cache = key
+    return key
 
 
 @dataclass

@@ -84,7 +84,7 @@ class TaskInfo:
                  "node_name", "status", "priority", "volume_ready",
                  "preemptable", "revocable_zone", "topology_policy", "pod",
                  "best_effort", "last_transaction", "pod_volumes",
-                 "constraint_key_cache")
+                 "constraint_key_cache", "req_key_cache")
 
     def __init__(self, pod: Pod):
         req = pod.resource_request()
@@ -106,9 +106,11 @@ class TaskInfo:
         self.best_effort: bool = self.init_resreq.is_empty()
         self.last_transaction = None
         self.pod_volumes = None
-        # lazy scheduling-constraint fingerprint (models/arrays.py grouping);
-        # pod scheduling constraints are immutable, so clones inherit it
+        # lazy scheduling-constraint / request fingerprints (models/arrays.py
+        # grouping); pod constraints and resreq are immutable, so clones
+        # inherit them
         self.constraint_key_cache = None
+        self.req_key_cache = None
 
     @property
     def task_id(self) -> str:
@@ -139,6 +141,7 @@ class TaskInfo:
         c.last_transaction = self.last_transaction
         c.pod_volumes = self.pod_volumes
         c.constraint_key_cache = self.constraint_key_cache
+        c.req_key_cache = self.req_key_cache
         return c
 
     def key(self) -> str:
@@ -180,6 +183,9 @@ class JobInfo:
         self.total_request: Resource = Resource()
         self.creation_timestamp: float = 0.0
         self.pod_group: Optional[PodGroup] = None
+        # copy-on-write marker: snapshot clones share the cache's PodGroup
+        # until a session-side mutation claims it (own_pod_group)
+        self.pod_group_owned: bool = True
         # stamped when the cache first sees the job, so the reservation
         # election's "longest waiting" survives per-cycle snapshot clones
         # (clone() copies it; the reference's ScheduleStartTimestamp analogue)
@@ -208,9 +214,19 @@ class JobInfo:
         self.task_min_available = dict(pg.spec.min_task_member)
         self.task_min_available_total = sum(self.task_min_available.values())
         self.pod_group = pg
+        self.pod_group_owned = True
 
     def unset_pod_group(self) -> None:
         self.pod_group = None
+
+    def own_pod_group(self) -> Optional[PodGroup]:
+        """Claim a private PodGroup copy before a session-side mutation
+        (copy-on-write counterpart of clone()); writeback goes through the
+        status updater, never through the cache's shared object."""
+        if not self.pod_group_owned and self.pod_group is not None:
+            self.pod_group = fast_clone(self.pod_group)
+            self.pod_group_owned = True
+        return self.pod_group
 
     @staticmethod
     def _extract_waiting_time(pg: PodGroup) -> Optional[float]:
@@ -318,11 +334,13 @@ class JobInfo:
         info.min_available = self.min_available
         info.waiting_time = self.waiting_time
         info.nodes_fit_errors = {}
-        # deep-copy the PodGroup: the snapshot must be mutable (enqueue flips
-        # phase, gang writes conditions) without writing through to the cache's
-        # live object — writeback goes through the status updater instead
-        # (reference: cache.go:793 Snapshot deep copy)
-        info.pod_group = fast_clone(self.pod_group) if self.pod_group else None
+        # copy-on-write PodGroup: the snapshot shares the cache's object
+        # until a session-side mutation (enqueue phase flip, condition or
+        # status write) claims a private copy via own_pod_group() — most
+        # jobs per cycle are never mutated, and the deep copy dominated
+        # snapshot cost (reference pays it via cache.go:793 deepcopy)
+        info.pod_group = self.pod_group
+        info.pod_group_owned = False
         info.creation_timestamp = self.creation_timestamp
         info.scheduling_start_time = self.scheduling_start_time
         info.preemptable = self.preemptable

@@ -257,8 +257,10 @@ class NodeInfo:
         c.pipelined = self.pipelined.clone()
         c.idle = self.idle.clone()
         c.used = self.used.clone()
-        c.allocatable = self.allocatable.clone()
-        c.capability = self.capability.clone()
+        # capacity vectors are only ever replaced wholesale (set_node),
+        # never mutated in place — share them across clones
+        c.allocatable = self.allocatable
+        c.capability = self.capability
         c.tasks = {k: t.clone() for k, t in self.tasks.items()}
         c.numa_info = self.numa_info
         c.numa_scheduler_info = (self.numa_scheduler_info.clone()
@@ -274,7 +276,7 @@ class NodeInfo:
         c.gpu_devices = devices
         c.oversubscription_node = self.oversubscription_node
         c.offline_job_evicting = self.offline_job_evicting
-        c.oversubscription_resource = self.oversubscription_resource.clone()
+        c.oversubscription_resource = self.oversubscription_resource
         return c
 
     def pods(self):
