@@ -135,3 +135,40 @@ def test_conformance_shields_critical_pods():
           build_pod("c1", "preemptor1", "", "Pending", RL1, "pg2"))
     h.run_actions("preempt").close_session()
     assert len(h.evicts) == 0
+
+
+def test_persistent_rejection_gate():
+    """Cross-job rejection persistence is only sound for the monotone
+    builtin preemptable plugins with a share-monotone pop order; mixed
+    preemptor priorities with drf enabled must disable it (a later
+    lower-share preemptor may be allowed what an earlier one was not)."""
+    from volcano_tpu.framework.victims import PreemptContext
+    from volcano_tpu.models.objects import ObjectMeta, PriorityClass
+
+    conf_drf = CONF + """
+- plugins:
+  - name: drf
+"""
+
+    def ctx_for(mixed):
+        h = Harness(conf_drf)
+        h.add("queues", build_queue("default", weight=1))
+        h.add("priorityclasses",
+              PriorityClass(metadata=ObjectMeta(name="high"), value=100))
+        h.add("nodes", build_node("n0", {"cpu": "8", "memory": "16Gi"}))
+        for j, pc in enumerate(["high", "high" if not mixed else ""]):
+            h.add("podgroups", build_pod_group(
+                f"pg{j}", "ns1", "default", 1, phase="Inqueue",
+                priority_class=pc))
+            h.add("pods", build_pod("ns1", f"p{j}", "", "Pending",
+                                    build_resource_list("1", "1Gi"),
+                                    f"pg{j}"))
+        ssn = h.open_session()
+        jobs = [(job, list(job.tasks.values()))
+                for job in ssn.jobs.values()]
+        ctx = PreemptContext(ssn, jobs)
+        h.close_session()
+        return ctx
+
+    assert ctx_for(mixed=False)._persist_ok
+    assert not ctx_for(mixed=True)._persist_ok

@@ -166,12 +166,6 @@ class JobUpdater:
                 lambda: [cache.update_job_status(job, update_pg)
                          for job, update_pg in updates])
 
-    def update_job(self, job: JobInfo) -> None:
-        """Synchronous single-job form (kept for callers outside the
-        session-close batch)."""
-        if self.ssn.cache is not None:
-            self.ssn.cache.update_job_status(job, self.prepare_job(job))
-
     def prepare_job(self, job: JobInfo) -> bool:
         """Roll up the job's status; True if the PodGroup must be pushed."""
         ssn = self.ssn
