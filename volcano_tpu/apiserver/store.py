@@ -124,7 +124,12 @@ class ObjectStore:
             watches = list(self._watches[kind])
         for w in watches:
             if w.on_add and w._passes(o):
-                w.on_add(o)
+                # per-watcher copies: delivered objects are the watcher's
+                # informer cache to mutate; the store's internal state (and
+                # other watchers' views) must never alias them — the
+                # scheduler writes task.pod.spec.node_name on its copy
+                # exactly like the reference mutates informer pods
+                w.on_add(fast_clone(o))
         return o
 
     # API-server semantics: reads hand out copies so callers can never mutate
@@ -151,10 +156,13 @@ class ObjectStore:
             watches = list(self._watches[kind])
         for w in watches:
             old_p, new_p = w._passes(old), w._passes(o)
+            # `old` left the store at replacement time, so it is exclusive
+            # here; handlers receive it read-only and do not retain it —
+            # only the live object needs per-watcher copies
             if old_p and new_p and w.on_update:
-                w.on_update(old, o)
+                w.on_update(old, fast_clone(o))
             elif not old_p and new_p and w.on_add:
-                w.on_add(o)
+                w.on_add(fast_clone(o))
             elif old_p and not new_p and w.on_delete:
                 w.on_delete(old)
         return o
@@ -172,7 +180,7 @@ class ObjectStore:
             watches = list(self._watches[kind])
         for w in watches:
             if w.on_delete and w._passes(old):
-                w.on_delete(old)
+                w.on_delete(old)   # removed from the store: exclusive now
 
     def get(self, kind: str, name: str, namespace: str = "default"):
         key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
@@ -199,7 +207,7 @@ class ObjectStore:
             existing = list(self._objects[kind].values()) if sync else []
         for o in existing:
             if w.on_add and w._passes(o):
-                w.on_add(o)
+                w.on_add(fast_clone(o))
         return w
 
     def unwatch(self, w: Watch) -> None:

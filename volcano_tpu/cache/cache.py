@@ -157,16 +157,39 @@ class SchedulerCache(EventHandlersMixin):
         critical path, in FIFO order with the binds they follow."""
         self._submit(fn)
 
+    # retry interval for pending reconciliations while the executor is
+    # otherwise idle (the reference's processResyncTask wait.Until period)
+    RESYNC_RETRY_SECONDS = 1.0
+
     def _exec_loop(self) -> None:
         while True:
-            self._exec_event.wait()
+            # while reconciliations are pending, wake periodically even
+            # with no new submissions (a stuck err_task must not wait for
+            # the next bind to be retried — cache.go:772-791 runs resync
+            # on its own loop)
+            self._exec_event.wait(
+                timeout=self.RESYNC_RETRY_SECONDS if self.err_tasks
+                else None)
             while True:
                 with self._exec_lock:
-                    if not self._exec_queue:
-                        self._exec_event.clear()
-                        self._exec_idle.set()
-                        break
-                    fn = self._exec_queue.popleft()
+                    fn = self._exec_queue.popleft() if self._exec_queue \
+                        else None
+                if fn is None:
+                    # queue drained: reconcile failed binds/evicts before
+                    # going idle; keep going while passes make progress
+                    before = len(self.err_tasks)
+                    if before:
+                        self.process_resync_tasks()
+                    if self.err_tasks and len(self.err_tasks) < before:
+                        continue   # progressed: keep reconciling
+                    with self._exec_lock:
+                        if not self._exec_queue:
+                            self._exec_event.clear()
+                            # idle = submitted writes executed; pending
+                            # reconciliations retry on the timed wakeup
+                            self._exec_idle.set()
+                            break
+                    continue
                 try:
                     fn()   # submitted fns resync their own expected errors
                 except Exception:
@@ -353,11 +376,19 @@ class SchedulerCache(EventHandlersMixin):
         self.err_tasks.append(task)
 
     def process_resync_tasks(self) -> None:
-        """Refetch each errored pod from the store and reconcile the cache."""
+        """Refetch each errored pod from the store and reconcile the cache.
+        A task whose reconciliation itself fails goes back on the queue
+        (the reference re-queues on error, cache.go:781-787) — it must not
+        be lost to an escaped exception."""
         n = len(self.err_tasks)
         for _ in range(n):
             task = self.err_tasks.popleft()
-            self.sync_task(task)
+            try:
+                self.sync_task(task)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "resync of task %s failed; requeued", task.uid)
+                self.err_tasks.append(task)
 
     def sync_task(self, old_task: TaskInfo) -> None:
         pod = self.store.get("pods", old_task.name, old_task.namespace)
