@@ -198,3 +198,88 @@ def test_batch_node_order_fn_exposes_interpod_scores():
     assert scores["n1"] > scores["n0"]
     assert scores["n1"] > scores["n2"]
     h.close_session()
+
+
+def test_vectorized_index_matches_naive_oracle():
+    """matching_topologies / preference_score computed through the coded
+    vector path must equal a naive per-pod sweep on randomized pods."""
+    import random
+
+    import numpy as np
+
+    from volcano_tpu.models.objects import (Affinity, NodeSelectorRequirement,
+                                            PodAffinity, PodAffinityTerm,
+                                            WeightedPodAffinityTerm)
+    from volcano_tpu.plugins.interpod import InterPodIndex, _term_matches
+
+    rng = random.Random(7)
+    n_nodes, n_pods = 60, 400
+    h = Harness(CONF)
+    h.add("queues", build_queue("default", weight=1))
+    for i in range(n_nodes):
+        h.add("nodes", build_node(
+            f"n{i}", {"cpu": "64", "memory": "128Gi"},
+            labels={"zone": f"z{i % 7}", "rack": f"r{i % 13}"}))
+    h.add("podgroups", build_pod_group("pg", "ns1", "default", 1,
+                                       phase="Inqueue"))
+    for p in range(n_pods):
+        ns = rng.choice(["ns1", "ns2"])
+        pod = build_pod(ns, f"p{p}", f"n{rng.randrange(n_nodes)}", "Running",
+                        build_resource_list("1", "1Gi"), "pg" if ns == "ns1" else "")
+        pod.metadata.labels = {"app": rng.choice(["web", "db", "cache"]),
+                               "tier": rng.choice(["a", "b"])}
+        if rng.random() < 0.5:
+            del pod.metadata.labels["tier"]
+        h.add("pods", pod)
+    ssn = h.open_session()
+    names = [n.name for n in ssn.node_list]
+    index = InterPodIndex(ssn, names)
+
+    terms = [
+        PodAffinityTerm(label_selector=[NodeSelectorRequirement(
+            key="app", operator="In", values=["web"])],
+            topology_key="zone"),
+        PodAffinityTerm(label_selector=[NodeSelectorRequirement(
+            key="tier", operator="NotIn", values=["a"])],
+            topology_key="rack", namespaces=["ns2"]),
+        PodAffinityTerm(label_selector=[NodeSelectorRequirement(
+            key="tier", operator="Exists")], topology_key="zone",
+            namespaces=["ns1", "ns2"]),
+        PodAffinityTerm(label_selector=[NodeSelectorRequirement(
+            key="app", operator="DoesNotExist")], topology_key="rack"),
+    ]
+    for term in terms:
+        got = index.matching_topologies(term, "ns1")
+        codes, _ = index.topo_codes(term.topology_key)
+        want = set()
+        for labels, pns, i in index.pods:
+            c = codes[i]
+            if c >= 0 and _term_matches(term, labels, pns, "ns1"):
+                want.add(int(c))
+        assert got == want, (term.topology_key, got, want)
+
+    # preference_score parity for a task with preferred (anti-)affinity
+    class T:
+        namespace = "ns1"
+        pod = build_pod("ns1", "probe", "", "Pending",
+                        build_resource_list("1", "1Gi"), "pg")
+    T.pod.spec.affinity = Affinity(pod_affinity=PodAffinity(
+        preferred=[WeightedPodAffinityTerm(weight=3, term=terms[0])]),
+        pod_anti_affinity=PodAffinity(
+            preferred=[WeightedPodAffinityTerm(weight=2, term=terms[1])]))
+    got = index.preference_score(T())
+    raw = np.zeros(len(names))
+    for wt, sign in ((T.pod.spec.affinity.pod_affinity.preferred[0], 1.0),
+                     (T.pod.spec.affinity.pod_anti_affinity.preferred[0],
+                      -1.0)):
+        codes, _ = index.topo_codes(wt.term.topology_key)
+        counts = {}
+        for labels, pns, i in index.pods:
+            c = codes[i]
+            if c >= 0 and _term_matches(wt.term, labels, pns, "ns1"):
+                counts[int(c)] = counts.get(int(c), 0) + 1
+        for c, k in counts.items():
+            raw[codes == c] += sign * wt.weight * k
+    assert got is not None
+    np.testing.assert_allclose(got, raw, rtol=1e-9)
+    h.close_session()

@@ -91,6 +91,16 @@ class InterPodIndex:
                         ([wt.term], t.namespace, i, -float(wt.weight)))
         self._topo_codes: Dict[str, np.ndarray] = {}
         self._topo_values: Dict[str, Dict[str, int]] = {}
+        # lazy vector encodings over the assigned-pod set: label values and
+        # namespaces become integer codes once per cycle, so each term's
+        # selector is evaluated on the (tiny) distinct-value vocabulary and
+        # applied to all pods with isin/bincount — O(pods) Python sweeps
+        # per (term x group) were the round-2 hot spot at 10k nodes
+        self._pod_node: Optional[np.ndarray] = None     # [M] node idx
+        self._pod_ns: Optional[np.ndarray] = None       # [M] ns code
+        self._ns_vocab: Dict[str, int] = {}
+        self._pod_label_codes: Dict[str, tuple] = {}    # key -> (codes, vocab)
+        self._term_match_cache: Dict[tuple, np.ndarray] = {}
 
     def topo_codes(self, key: str) -> Tuple[np.ndarray, Dict[str, int]]:
         """[n_real] int topology code per node (-1 = label missing)."""
@@ -107,18 +117,77 @@ class InterPodIndex:
         self._topo_values[key] = values
         return codes, values
 
+    # -- vector encodings ----------------------------------------------------
+
+    def _ensure_pod_arrays(self) -> None:
+        if self._pod_node is not None:
+            return
+        m = len(self.pods)
+        self._pod_node = np.fromiter((i for _, _, i in self.pods),
+                                     np.int64, m)
+        ns_codes = np.empty(m, np.int32)
+        for p, (_, ns, _) in enumerate(self.pods):
+            ns_codes[p] = self._ns_vocab.setdefault(ns, len(self._ns_vocab))
+        self._pod_ns = ns_codes
+
+    def _pod_codes(self, key: str) -> tuple:
+        """([M] value code per pod (-1 = label absent), value vocab)."""
+        cached = self._pod_label_codes.get(key)
+        if cached is not None:
+            return cached
+        self._ensure_pod_arrays()
+        vocab: Dict[str, int] = {}
+        codes = np.full(len(self.pods), -1, np.int32)
+        for p, (labels, _, _) in enumerate(self.pods):
+            v = labels.get(key)
+            if v is not None:
+                codes[p] = vocab.setdefault(v, len(vocab))
+        self._pod_label_codes[key] = (codes, vocab)
+        return codes, vocab
+
+    @staticmethod
+    def _term_signature(term: PodAffinityTerm, namespaces: tuple) -> tuple:
+        return (namespaces,
+                tuple((r.key, r.operator, tuple(r.values or []))
+                      for r in term.label_selector))
+
+    def _term_match(self, term: PodAffinityTerm,
+                    default_ns: str) -> np.ndarray:
+        """[M] bool: pods the term selects. Semantically identical to
+        mapping _term_matches over self.pods — each selector requirement is
+        evaluated once per *distinct label value* through the same
+        ``req.matches`` oracle, then broadcast by code."""
+        self._ensure_pod_arrays()
+        namespaces = tuple(term.namespaces or [default_ns])
+        sig = self._term_signature(term, namespaces)
+        cached = self._term_match_cache.get(sig)
+        if cached is not None:
+            return cached
+        ns_codes = [self._ns_vocab[n] for n in namespaces
+                    if n in self._ns_vocab]
+        out = np.isin(self._pod_ns, ns_codes) if ns_codes \
+            else np.zeros(len(self.pods), bool)
+        for req in term.label_selector:
+            codes, vocab = self._pod_codes(req.key)
+            ok_codes = [c for v, c in vocab.items()
+                        if req.matches({req.key: v})]
+            if req.matches({}):   # absent-label semantics via the oracle
+                ok_codes.append(-1)
+            out = out & np.isin(codes, ok_codes)
+        self._term_match_cache[sig] = out
+        return out
+
     def matching_topologies(self, term: PodAffinityTerm,
                             default_ns: str) -> Set[int]:
         """Topology codes (under term.topology_key) hosting >=1 pod the
         term selects."""
+        if not self.pods:
+            return set()
         codes, _ = self.topo_codes(term.topology_key)
-        out: Set[int] = set()
-        for labels, ns, i in self.pods:
-            c = codes[i]
-            if c >= 0 and c not in out \
-                    and _term_matches(term, labels, ns, default_ns):
-                out.add(int(c))
-        return out
+        self._ensure_pod_arrays()
+        pc = codes[self._pod_node]
+        sel = self._term_match(term, default_ns) & (pc >= 0)
+        return {int(c) for c in np.unique(pc[sel])}
 
     # -- predicate ---------------------------------------------------------
 
@@ -189,17 +258,19 @@ class InterPodIndex:
                      else [])
         for weighted, sign in ((pref, 1.0), (anti_pref, -1.0)):
             for wt in weighted:
+                if not self.pods:
+                    continue
                 term = wt.term
-                codes, _ = self.topo_codes(term.topology_key)
-                counts: Dict[int, int] = {}
-                for labels, pns, i in self.pods:
-                    c = codes[i]
-                    if c >= 0 and _term_matches(term, labels, pns, ns):
-                        counts[int(c)] = counts.get(int(c), 0) + 1
-                if counts:
+                codes, values = self.topo_codes(term.topology_key)
+                self._ensure_pod_arrays()
+                pc = codes[self._pod_node]
+                sel = self._term_match(term, ns) & (pc >= 0)
+                if sel.any():
                     touched = True
-                    for c, k in counts.items():
-                        raw[codes == c] += sign * wt.weight * k
+                    counts = np.bincount(pc[sel],
+                                         minlength=max(1, len(values)))
+                    raw += sign * wt.weight * np.where(
+                        codes >= 0, counts[np.maximum(codes, 0)], 0)
 
         # symmetry: existing pods' preferred terms toward the incoming pod
         for terms_e, ns_e, i, w in self.pref_terms:
