@@ -101,6 +101,7 @@ class InterPodIndex:
         self._ns_vocab: Dict[str, int] = {}
         self._pod_label_codes: Dict[str, tuple] = {}    # key -> (codes, vocab)
         self._term_match_cache: Dict[tuple, np.ndarray] = {}
+        self._pod_topo_cache: Dict[str, np.ndarray] = {}  # key -> [M] codes
 
     def topo_codes(self, key: str) -> Tuple[np.ndarray, Dict[str, int]]:
         """[n_real] int topology code per node (-1 = label missing)."""
@@ -177,15 +178,23 @@ class InterPodIndex:
         self._term_match_cache[sig] = out
         return out
 
+    def _pod_topo(self, key: str) -> np.ndarray:
+        """[M] topology code of each pod's node under `key`, cached."""
+        pc = self._pod_topo_cache.get(key)
+        if pc is None:
+            codes, _ = self.topo_codes(key)
+            self._ensure_pod_arrays()
+            pc = codes[self._pod_node]
+            self._pod_topo_cache[key] = pc
+        return pc
+
     def matching_topologies(self, term: PodAffinityTerm,
                             default_ns: str) -> Set[int]:
         """Topology codes (under term.topology_key) hosting >=1 pod the
         term selects."""
         if not self.pods:
             return set()
-        codes, _ = self.topo_codes(term.topology_key)
-        self._ensure_pod_arrays()
-        pc = codes[self._pod_node]
+        pc = self._pod_topo(term.topology_key)
         sel = self._term_match(term, default_ns) & (pc >= 0)
         return {int(c) for c in np.unique(pc[sel])}
 
@@ -258,12 +267,9 @@ class InterPodIndex:
                      else [])
         for weighted, sign in ((pref, 1.0), (anti_pref, -1.0)):
             for wt in weighted:
-                if not self.pods:
-                    continue
                 term = wt.term
                 codes, values = self.topo_codes(term.topology_key)
-                self._ensure_pod_arrays()
-                pc = codes[self._pod_node]
+                pc = self._pod_topo(term.topology_key)
                 sel = self._term_match(term, ns) & (pc >= 0)
                 if sel.any():
                     touched = True
