@@ -125,21 +125,30 @@ def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
         score = node_score(req, state.idle, node_alloc, weights,
                            group_static_score[g] + pack * group_pack_bonus[g])
 
-        # -- cross-chip: does ANY chip have an idle fit? (1 int over ICI)
-        any_idle = jax.lax.psum(jnp.any(fits_idle).astype(jnp.int32), axis) > 0
+        # -- cross-chip: ONE all-gather of a [4] payload per chip carries
+        # both candidate sets' (score, global index) pairs; the idle-vs-
+        # future choice is made globally from the gathered idle scores.
+        # Identical semantics to the psum + two all_gathers formulation
+        # (prefer idle fits anywhere; ties by lowest global node index:
+        # per-chip argmax picks the lowest local index, min-index across
+        # chips picks the lowest global) at a third of the per-step ICI
+        # latency. Node indices ride as f32 (exact to 2^24 nodes).
+        masked_idle = jnp.where(fits_idle, score, NEG)
+        li = jnp.argmax(masked_idle)
         if allow_pipeline:
-            cand = jnp.where(any_idle, fits_idle, fits_future)
+            masked_fut = jnp.where(fits_future, score, NEG)
+            lf = jnp.argmax(masked_fut)
         else:
-            cand = fits_idle
-
-        masked = jnp.where(cand, score, NEG)
-        local_best = jnp.argmax(masked)
-        local_score = masked[local_best]
-        local_gidx = offset + local_best.astype(jnp.int32)
-
-        # -- cross-chip: all-gather one (score, index) pair per chip
-        scores = jax.lax.all_gather(local_score, axis)      # [D]
-        gidxs = jax.lax.all_gather(local_gidx, axis)        # [D]
+            masked_fut = jnp.full_like(masked_idle, NEG)
+            lf = jnp.int32(0)
+        payload = jnp.stack([
+            masked_idle[li], (offset + li).astype(jnp.float32),
+            masked_fut[lf], (offset + lf).astype(jnp.float32)])
+        gathered = jax.lax.all_gather(payload, axis)         # [D, 4]
+        any_idle = jnp.any(gathered[:, 0] > NEG * 0.5)
+        scores = jnp.where(any_idle, gathered[:, 0], gathered[:, 2])
+        gidxs = jnp.where(any_idle, gathered[:, 1],
+                          gathered[:, 3]).astype(jnp.int32)
         best_score = jnp.max(scores)
         winner = scores >= best_score
         sel_g = jnp.min(jnp.where(winner, gidxs, jnp.int32(2**30)))
