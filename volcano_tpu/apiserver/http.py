@@ -77,6 +77,20 @@ class StoreHTTPServer:
                 return json.loads(self.rfile.read(length)) if length else None
 
             def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                if parsed.path == "/rv":
+                    return self._send(200, {"rv": store.current_rv()})
+                if parsed.path == "/watch":
+                    q = urllib.parse.parse_qs(parsed.query)
+                    since = int(q.get("since", ["0"])[0])
+                    timeout = min(60.0, float(q.get("timeout", ["25"])[0]))
+                    events, rv, resync = store.events_since(since, timeout)
+                    return self._send(200, {
+                        "rv": rv, "resync": resync,
+                        "events": [{"rv": erv, "action": action,
+                                    "kind": kind,
+                                    "object": encode_object(kind, o)}
+                                   for erv, action, kind, o in events]})
                 route = self._parse()
                 if route is None:
                     return self._send(404, {"error": "not found"})
@@ -92,6 +106,29 @@ class StoreHTTPServer:
                 return self._send(200, encode_object(kind, o))
 
             def do_POST(self):
+                parsed = urllib.parse.urlparse(self.path)
+                if parsed.path == "/events":
+                    body = self._body()
+                    o = decode_object(body["kind"], body["object"]) \
+                        if body.get("object") else None
+                    store.record_event(body["kind"], o, body["event_type"],
+                                       body["reason"], body["message"])
+                    return self._send(201, {"status": "recorded"})
+                if parsed.path == "/admissionwebhooks":
+                    # the webhook-manager's self-registration: the store
+                    # will call back over HTTP on matching operations
+                    # (cmd/webhook-manager/app/server.go:64-87 registers
+                    # WebhookConfigurations with CA bundle; the callback
+                    # plays the apiserver->webhook TLS call)
+                    body = self._body()
+                    from .remote import RemoteAdmissionHook
+                    store.register_admission(RemoteAdmissionHook(
+                        kind=body["kind"], path=body.get("path", ""),
+                        url=body["url"],
+                        operations=tuple(body.get("operations",
+                                                  ("CREATE",)))),
+                        replace=True)
+                    return self._send(201, {"status": "registered"})
                 route = self._parse()
                 if route is None:
                     return self._send(404, {"error": "not found"})
@@ -127,8 +164,8 @@ class StoreHTTPServer:
                     return self._send(404, {"error": "not found"})
                 kind, ns, name, _q = route
                 try:
-                    store.delete(kind, name, ns)
-                    return self._send(200, {"status": "deleted"})
+                    rv = store.delete(kind, name, ns)
+                    return self._send(200, {"status": "deleted", "rv": rv})
                 except AdmissionError as e:
                     return self._send(422, {"error": str(e)})
                 except KeyError as e:
@@ -151,6 +188,7 @@ class ApiError(Exception):
     def __init__(self, code: int, message: str):
         super().__init__(message)
         self.code = code
+        self.message = message
 
 
 class StoreClient:
@@ -208,5 +246,5 @@ class StoreClient:
             encode_object(kind, o))
         return decode_object(kind, data)
 
-    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
-        self._request("DELETE", self._path(kind, name, namespace))
+    def delete(self, kind: str, name: str, namespace: str = "default"):
+        return self._request("DELETE", self._path(kind, name, namespace))

@@ -1,0 +1,300 @@
+"""Multi-process deployment seam: a remote, watchable store client.
+
+The reference deploys three binaries against the Kubernetes API server
+(installer/volcano-development.yaml): informers watch-stream state in, and
+writes go out as REST calls. :class:`RemoteStore` gives the standalone
+framework the same topology over :mod:`volcano_tpu.apiserver.http`:
+
+* a local mirror ``ObjectStore`` is primed by a full list and kept current
+  by a long-poll watch thread (`GET /watch?since=rv` against the serving
+  process's change journal) — scheduler cache / controllers register their
+  watches on the mirror exactly as they would in-process;
+* writes (create/update/delete/events) are REST calls to the serving
+  process, where admission runs (including webhook-manager callbacks,
+  :class:`RemoteAdmissionHook`);
+* a journal gap (client slower than the journal window) triggers a full
+  re-list, like an informer's resync after watch expiry.
+
+Deployment recipe: docs/deployment.md; e2e proof: tests/test_multiprocess.py.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.request
+from typing import Optional
+
+from ..utils.fastclone import fast_clone
+from .codec import decode_object, encode_object
+from .http import StoreClient
+from .store import CLUSTER_SCOPED as _CLUSTER_SCOPED
+from .store import KINDS, AdmissionError, ObjectStore
+
+log = logging.getLogger(__name__)
+
+
+class RemoteAdmissionHook:
+    """Server-side half of a remotely-registered webhook: POSTs the
+    admission review to the webhook-manager's endpoint and applies the
+    verdict (and any mutation) — the apiserver->webhook call."""
+
+    def __init__(self, kind: str, url: str, path: str = "",
+                 operations: tuple = ("CREATE",), timeout: float = 10.0):
+        self.kind = kind
+        self.path = path
+        self.url = url
+        self.operations = operations
+        self.timeout = timeout
+        self.validate = None   # the combined review runs in mutate()
+
+    def mutate(self, operation: str, new_obj, old_obj=None) -> None:
+        payload = {
+            "path": self.path, "kind": self.kind, "operation": operation,
+            "object": encode_object(self.kind, new_obj)
+            if new_obj is not None else None,
+            "old": encode_object(self.kind, old_obj)
+            if old_obj is not None else None,
+        }
+        req = urllib.request.Request(
+            self.url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                review = json.loads(resp.read().decode())
+        except Exception as e:
+            # failurePolicy: Fail (the reference's default for its
+            # validating webhooks) — an unreachable webhook rejects
+            raise AdmissionError(
+                f"admission webhook {self.path!r} unreachable: {e}")
+        if not review.get("allowed", False):
+            raise AdmissionError(review.get("message", "denied"))
+        mutated = review.get("object")
+        if mutated is not None and new_obj is not None:
+            patched = decode_object(self.kind, mutated)
+            new_obj.__dict__.update(patched.__dict__)
+
+
+class RemoteStore:
+    """ObjectStore-compatible facade over a remote apiserver process."""
+
+    def __init__(self, base_url: str, poll_timeout: float = 25.0):
+        self.client = StoreClient(base_url)
+        self.base_url = base_url.rstrip("/")
+        self.mirror = ObjectStore()
+        self.poll_timeout = poll_timeout
+        self._rv = 0
+        # read-your-writes: a component must observe its own successful
+        # writes immediately (the in-process store's synchronous watches
+        # gave controllers exactly that; without it, get+mutate+update
+        # round trips conflict against the component's own lagging
+        # mirror). Successful writes self-apply to the mirror; the poll
+        # stream's redeliveries are deduped by server resource_version.
+        self._seen: dict = {}
+        self._seen_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._resync()
+        self.events = self.mirror.events   # local event record view
+
+    # -- sync loop ---------------------------------------------------------
+
+    def _resync(self) -> None:
+        """Prime (or re-prime) the mirror with a full list per kind.
+
+        List+watch anchoring: the SERVER's current rv is read FIRST, the
+        lists reflect state at or after it, and the poll resumes from that
+        anchor — replayed events older than a listed object's server rv
+        are skipped by the _seen dedup (the mirror stamps its own local
+        rvs, which must never be confused with the server's)."""
+        try:
+            resp = json.loads(urllib.request.urlopen(
+                f"{self.base_url}/rv", timeout=10.0).read().decode())
+            anchor = int(resp.get("rv", 0))
+        except Exception:
+            log.exception("rv anchor fetch failed during resync")
+            anchor = self._rv
+        for kind in KINDS:
+            try:
+                remote = {self.mirror.key_of(kind, o): o
+                          for o in self.client.list(kind)}
+            except Exception:
+                log.exception("list %s failed during resync", kind)
+                continue
+            with self.mirror._lock:
+                local_keys = set(self.mirror._objects[kind])
+            for key in local_keys - set(remote):
+                ns, _, name = key.rpartition("/")
+                with self._seen_lock:
+                    self._seen[(kind, key)] = max(
+                        self._seen.get((kind, key), 0), anchor)
+                try:
+                    self.mirror.delete(kind, name, ns or "default",
+                                       skip_admission=True)
+                except KeyError:
+                    pass
+            for key, o in remote.items():
+                self._apply("MODIFIED" if key in local_keys else "ADDED",
+                            kind, o, o.metadata.resource_version)
+        self._rv = max(self._rv, anchor)
+
+    def _apply(self, action: str, kind: str, o, rv: int = 0) -> None:
+        key = self.mirror.key_of(kind, o)
+        with self._seen_lock:
+            if rv and self._seen.get((kind, key), 0) >= rv:
+                return   # already applied (self-write or newer event)
+            if rv:
+                self._seen[(kind, key)] = rv
+        if action == "DELETED":
+            try:
+                self.mirror.delete(kind, o.metadata.name,
+                                   o.metadata.namespace, skip_admission=True)
+            except KeyError:
+                pass
+            return
+        with self.mirror._lock:
+            exists = key in self.mirror._objects[kind]
+        try:
+            if exists:
+                o.metadata.resource_version = 0   # mirror manages its own rv
+                self.mirror.update(kind, o, skip_admission=True)
+            else:
+                self.mirror.create(kind, o, skip_admission=True)
+        except KeyError:
+            log.exception("mirror apply %s %s failed", action, kind)
+
+    def _poll_loop(self) -> None:
+        import urllib.parse
+        while not self._stop.is_set():
+            url = (f"{self.base_url}/watch?since={self._rv}"
+                   f"&timeout={self.poll_timeout}")
+            try:
+                with urllib.request.urlopen(
+                        url, timeout=self.poll_timeout + 10.0) as resp:
+                    data = json.loads(resp.read().decode())
+            except Exception:
+                if not self._stop.is_set():
+                    log.warning("watch poll failed; retrying", exc_info=True)
+                    self._stop.wait(1.0)
+                continue
+            if data.get("resync"):
+                self._resync()
+                self._rv = max(self._rv, int(data.get("rv", self._rv)))
+                continue
+            for ev in data.get("events", []):
+                o = decode_object(ev["kind"], ev["object"])
+                self._apply(ev["action"], ev["kind"], o, int(ev["rv"]))
+                self._rv = max(self._rv, int(ev["rv"]))
+
+    def run(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._poll_loop, daemon=True,
+                                        name="remote-store-watch")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- ObjectStore interface ---------------------------------------------
+
+    key_of = staticmethod(ObjectStore.key_of)
+
+    @property
+    def clock(self):
+        return self.mirror.clock
+
+    @staticmethod
+    def _map_error(e):
+        """HTTP status -> the in-process store's exception types, so
+        controllers' retry/conflict handling works unchanged."""
+        from .http import ApiError
+        from .store import ConflictError
+        if isinstance(e, ApiError):
+            if e.code == 409 and "resource_version" in e.message:
+                return ConflictError(e.message)
+            if e.code in (404, 409):
+                return KeyError(e.message)
+            if e.code == 422:
+                return AdmissionError(e.message)
+        return e
+
+    def create(self, kind: str, o, skip_admission: bool = False):
+        try:
+            created = self.client.create(kind, o)
+        except Exception as e:
+            raise self._map_error(e) from None
+        # the in-process store stamps uid/rv on the caller's object in
+        # place; callers chain writes on the same object, so mirror that
+        # contract (otherwise the very next update conflicts on rv)
+        o.metadata.uid = created.metadata.uid
+        o.metadata.creation_timestamp = created.metadata.creation_timestamp
+        o.metadata.resource_version = created.metadata.resource_version
+        # the mirror gets its own copy: _apply restamps mirror-local rvs
+        # and retains the instance, and the caller's returned object must
+        # keep the authoritative server rv untouched
+        self._apply("ADDED", kind, fast_clone(created),
+                    created.metadata.resource_version)
+        return created
+
+    def update(self, kind: str, o, skip_admission: bool = False):
+        try:
+            updated = self.client.update(kind, o)
+        except Exception as e:
+            raise self._map_error(e) from None
+        o.metadata.resource_version = updated.metadata.resource_version
+        self._apply("MODIFIED", kind, fast_clone(updated),
+                    updated.metadata.resource_version)
+        return updated
+
+    def delete(self, kind: str, name: str, namespace: str = "default",
+               skip_admission: bool = False):
+        try:
+            resp = self.client.delete(kind, name, namespace)
+        except Exception as e:
+            raise self._map_error(e) from None
+        rv = int((resp or {}).get("rv", 0)) if isinstance(resp, dict) else 0
+        with self._seen_lock:
+            if rv:
+                key = name if kind in _CLUSTER_SCOPED else                     f"{namespace}/{name}"
+                self._seen[(kind, key)] = rv
+        try:
+            self.mirror.delete(kind, name, namespace, skip_admission=True)
+        except KeyError:
+            pass
+
+    def get(self, kind: str, name: str, namespace: str = "default"):
+        # reads go to the source of truth: controllers do get+mutate+update
+        # round trips that need the live resource_version
+        return self.client.get(kind, name, namespace)
+
+    def list(self, kind: str, namespace=None) -> list:
+        return self.client.list(kind, namespace)
+
+    def watch(self, kind: str, on_add=None, on_update=None, on_delete=None,
+              filter_fn=None, sync: bool = True):
+        return self.mirror.watch(kind, on_add, on_update, on_delete,
+                                 filter_fn, sync)
+
+    def unwatch(self, w) -> None:
+        self.mirror.unwatch(w)
+
+    def register_admission(self, hook) -> None:
+        raise NotImplementedError(
+            "admission hooks register on the serving process; run a "
+            "webhook-manager with --server to register remotely")
+
+    def record_event(self, kind: str, o, event_type: str, reason: str,
+                     message: str) -> None:
+        payload = {"kind": kind,
+                   "object": encode_object(kind, o) if o is not None else None,
+                   "event_type": event_type, "reason": reason,
+                   "message": message}
+        req = urllib.request.Request(
+            f"{self.base_url}/events", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=10.0).close()
+        except Exception:
+            log.warning("event record failed", exc_info=True)

@@ -72,6 +72,8 @@ class Watch:
 class ObjectStore:
     """Thread-safe typed object store with admission + watch."""
 
+    JOURNAL_CAPACITY = 65536
+
     def __init__(self, clock: Clock = GLOBAL_CLOCK):
         self._objects: Dict[str, Dict[str, object]] = {k: {} for k in KINDS}
         self._watches: Dict[str, List[Watch]] = defaultdict(list)
@@ -80,6 +82,12 @@ class ObjectStore:
         self._lock = threading.RLock()
         self.clock = clock
         self.events: List[tuple] = []   # (kind, type, reason, message) event records
+        # change journal for remote watchers (the watch-stream seam of the
+        # multi-process deployment, docs/deployment.md): (rv, action, kind,
+        # object ref — safe to hold, internals are replaced never mutated)
+        from collections import deque as _deque
+        self._journal = _deque(maxlen=self.JOURNAL_CAPACITY)
+        self._journal_cond = threading.Condition(self._lock)
 
     # -- keys --------------------------------------------------------------
 
@@ -90,7 +98,15 @@ class ObjectStore:
 
     # -- admission ---------------------------------------------------------
 
-    def register_admission(self, hook: AdmissionHook) -> None:
+    def register_admission(self, hook: AdmissionHook,
+                           replace: bool = False) -> None:
+        """replace=True drops existing hooks with the same (kind, path)
+        first — a webhook-manager restart re-registers its services and
+        must not leave stale duplicates calling dead endpoints."""
+        if replace:
+            self._hooks = [h for h in self._hooks
+                           if not (h.kind == hook.kind
+                                   and getattr(h, "path", "") == hook.path)]
         self._hooks.append(hook)
 
     def _admit(self, kind: str, operation: str, new_obj, old_obj=None) -> None:
@@ -108,9 +124,11 @@ class ObjectStore:
     # -- CRUD --------------------------------------------------------------
 
     def create(self, kind: str, o, skip_admission: bool = False):
+        # admission runs outside the store lock: remote admission hooks
+        # (webhook-manager callbacks) must not stall every other writer
+        if not skip_admission:
+            self._admit(kind, "CREATE", o)
         with self._lock:
-            if not skip_admission:
-                self._admit(kind, "CREATE", o)
             key = self.key_of(kind, o)
             if key in self._objects[kind]:
                 raise KeyError(f"{kind} {key!r} already exists")
@@ -121,6 +139,8 @@ class ObjectStore:
             self._rv += 1
             o.metadata.resource_version = self._rv
             self._objects[kind][key] = o
+            self._journal.append((self._rv, "ADDED", kind, o))
+            self._journal_cond.notify_all()
             watches = list(self._watches[kind])
         for w in watches:
             if w.on_add and w._passes(o):
@@ -138,8 +158,14 @@ class ObjectStore:
     # phase-transition detection in controllers).
 
     def update(self, kind: str, o, skip_admission: bool = False):
+        key = self.key_of(kind, o)
+        if not skip_admission:
+            with self._lock:
+                old_pre = self._objects[kind].get(key)
+            if old_pre is None:
+                raise KeyError(f"{kind} {key!r} not found")
+            self._admit(kind, "UPDATE", o, old_pre)   # outside the lock
         with self._lock:
-            key = self.key_of(kind, o)
             old = self._objects[kind].get(key)
             if old is None:
                 raise KeyError(f"{kind} {key!r} not found")
@@ -148,11 +174,11 @@ class ObjectStore:
                 raise ConflictError(
                     f"{kind} {key!r}: stale resource_version "
                     f"{o.metadata.resource_version} != {old.metadata.resource_version}")
-            if not skip_admission:
-                self._admit(kind, "UPDATE", o, old)
             self._rv += 1
             o.metadata.resource_version = self._rv
             self._objects[kind][key] = o
+            self._journal.append((self._rv, "MODIFIED", kind, o))
+            self._journal_cond.notify_all()
             watches = list(self._watches[kind])
         for w in watches:
             old_p, new_p = w._passes(old), w._passes(o)
@@ -168,19 +194,30 @@ class ObjectStore:
         return o
 
     def delete(self, kind: str, name: str, namespace: str = "default",
-               skip_admission: bool = False) -> None:
+               skip_admission: bool = False) -> int:
+        """Returns the deletion's resource version (remote mirrors dedup
+        journal replays against it)."""
         key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
+        if not skip_admission:
+            with self._lock:
+                old_pre = self._objects[kind].get(key)
+            if old_pre is None:
+                raise KeyError(f"{kind} {key!r} not found")
+            self._admit(kind, "DELETE", None, old_pre)   # outside the lock
         with self._lock:
             old = self._objects[kind].get(key)
             if old is None:
                 raise KeyError(f"{kind} {key!r} not found")
-            if not skip_admission:
-                self._admit(kind, "DELETE", None, old)
+            self._rv += 1
+            deleted_rv = self._rv
+            self._journal.append((self._rv, "DELETED", kind, old))
+            self._journal_cond.notify_all()
             del self._objects[kind][key]
             watches = list(self._watches[kind])
         for w in watches:
             if w.on_delete and w._passes(old):
                 w.on_delete(old)   # removed from the store: exclusive now
+        return deleted_rv
 
     def get(self, kind: str, name: str, namespace: str = "default"):
         key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
@@ -209,6 +246,30 @@ class ObjectStore:
             if w.on_add and w._passes(o):
                 w.on_add(fast_clone(o))
         return w
+
+    def current_rv(self) -> int:
+        with self._lock:
+            return self._rv
+
+    def events_since(self, rv: int, timeout: float = 25.0):
+        """Long-poll the change journal: block until an event with
+        resource_version > rv exists (or timeout), then return
+        (events, current_rv, resync) where events is [(rv, action, kind,
+        object)] and resync=True means rv predates the journal window —
+        the caller must re-list everything and restart from current_rv."""
+        import itertools
+        with self._journal_cond:
+            if not self._journal_cond.wait_for(
+                    lambda: self._rv > rv, timeout=timeout):
+                return [], self._rv, False
+            if self._journal and self._journal[0][0] > rv + 1:
+                return [], self._rv, True   # gap: journal rolled past rv
+            # journal rvs are contiguous (every rv bump appends exactly one
+            # entry), so the slice start is an O(1) offset, not a scan
+            start = max(0, rv + 1 - self._journal[0][0]) if self._journal \
+                else 0
+            events = list(itertools.islice(self._journal, start, None))
+            return events, self._rv, False
 
     def unwatch(self, w: Watch) -> None:
         with self._lock:

@@ -1,0 +1,60 @@
+"""vc-apiserver: the standalone API-server process of the multi-process
+deployment (docs/deployment.md).
+
+Serves the object store over HTTP — CRUD, the long-poll change journal
+(`/watch`), event recording (`/events`), and remote admission-webhook
+registration (`/admissionwebhooks`). The other components (vc-scheduler,
+vc-controller-manager, vc-webhook-manager, vcctl) connect with `--server`.
+The reference's analogue is the Kubernetes API server itself plus volcano's
+CRDs (installer/volcano-development.yaml).
+
+    python -m volcano_tpu.cmd.apiserver --port 8181 [--nodes 4 \
+        --node-resources cpu=16,memory=32Gi] [--default-queue]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+
+from ..apiserver.http import StoreHTTPServer
+from ..apiserver.store import ObjectStore
+from ..cli.util import parse_resource_list
+from ..models.objects import (Node, NodeStatus, ObjectMeta, Queue, QueueSpec)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="vc-apiserver")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8181)
+    parser.add_argument("--nodes", type=int, default=0,
+                        help="pre-create N simulated nodes")
+    parser.add_argument("--node-resources", default="cpu=16,memory=32Gi")
+    parser.add_argument("--default-queue", action="store_true",
+                        help="pre-create the default queue")
+    parser.add_argument("--version", action="store_true")
+    args = parser.parse_args(argv)
+    if args.version:
+        from ..version import print_version_and_exit
+        print_version_and_exit()
+
+    store = ObjectStore()
+    if args.default_queue:
+        store.create("queues", Queue(metadata=ObjectMeta(name="default"),
+                                     spec=QueueSpec(weight=1)))
+    if args.nodes:
+        rl = parse_resource_list(args.node_resources)
+        for i in range(args.nodes):
+            store.create("nodes", Node(
+                metadata=ObjectMeta(name=f"node-{i}"),
+                status=NodeStatus(allocatable=dict(rl), capacity=dict(rl))))
+    server = StoreHTTPServer(store, host=args.host, port=args.port)
+    server.start()
+    print(f"vc-apiserver serving on {args.host}:{server.port}", flush=True)
+    threading.Event().wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,8 @@ from ..utils.leaderelection import LeaderElector
 
 
 def add_flags(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--server", default=None,
+                        help="remote apiserver URL (multi-process mode)")
     parser.add_argument("--worker-num", type=int, default=4,
                         help="job controller worker shard count")
     parser.add_argument("--max-requeue-num", type=int, default=15)
@@ -49,9 +51,15 @@ def main(argv=None) -> int:
     if args.version:
         from ..version import print_version_and_exit
         print_version_and_exit()
-    store = ObjectStore()
+    if args.server:
+        from ..apiserver.remote import RemoteStore
+        store = RemoteStore(args.server)
+        store.run()
+    else:
+        store = ObjectStore()
     run_controllers(store, args)
-    print("vc-controller-manager running (embedded store)")
+    print("vc-controller-manager running against "
+          + (args.server or "embedded store"), flush=True)
     threading.Event().wait()
     return 0
 

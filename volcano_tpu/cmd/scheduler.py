@@ -20,6 +20,9 @@ from ..utils.leaderelection import LeaderElector
 
 def add_flags(parser: argparse.ArgumentParser) -> None:
     """cmd/scheduler/app/options/options.go:81-108"""
+    parser.add_argument("--server", default=None,
+                        help="remote apiserver URL (multi-process mode, "
+                             "docs/deployment.md); default: embedded store")
     parser.add_argument("--scheduler-name", default="volcano")
     parser.add_argument("--scheduler-conf", default=None)
     parser.add_argument("--schedule-period", type=float, default=1.0)
@@ -60,12 +63,18 @@ def main(argv=None) -> int:
     if args.version:
         from ..version import print_version_and_exit
         print_version_and_exit()
-    store = ObjectStore()
+    if args.server:
+        from ..apiserver.remote import RemoteStore
+        store = RemoteStore(args.server)
+        store.run()
+    else:
+        store = ObjectStore()
     run_scheduler(store, args)
     from ..metrics.server import MetricsServer
     host, _, port_s = args.listen_address.rpartition(":")
     MetricsServer(host or "127.0.0.1", int(port_s)).start()
-    print("vc-scheduler running (embedded store)")
+    print("vc-scheduler running against "
+          + (args.server or "embedded store"), flush=True)
     threading.Event().wait()
     return 0
 

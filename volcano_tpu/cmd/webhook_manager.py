@@ -18,6 +18,10 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--enabled-admission", default=None,
                         help="comma-separated admission service paths")
     parser.add_argument("--port", type=int, default=8443)
+    parser.add_argument("--server", default=None,
+                        help="remote apiserver URL: serve the admission "
+                             "endpoint and self-register the webhooks "
+                             "(multi-process mode, docs/deployment.md)")
     parser.add_argument("--version", action="store_true")
 
 
@@ -28,12 +32,29 @@ def main(argv=None) -> int:
     if args.version:
         from ..version import print_version_and_exit
         print_version_and_exit()
+    if args.server:
+        # multi-process mode: serve the admission endpoint; the apiserver
+        # calls back per matching operation after self-registration
+        from ..apiserver.remote import RemoteStore
+        from ..webhooks.router import AdmissionHTTPServer
+        lookups = RemoteStore(args.server)
+        lookups.run()
+        endpoint = AdmissionHTTPServer(
+            lookups, enabled_admission=args.enabled_admission,
+            port=args.port)
+        endpoint.start()
+        endpoint.register_with(args.server)
+        print(f"vc-webhook-manager serving {len(endpoint.services)} "
+              f"admission services on :{endpoint.port}, registered with "
+              f"{args.server}", flush=True)
+        threading.Event().wait()
+        return 0
     store = ObjectStore()
     manager = WebhookManager(store, enabled_admission=args.enabled_admission)
     server = StoreHTTPServer(store, port=args.port)
     server.start()
     print(f"vc-webhook-manager serving {len(manager.services)} admission "
-          f"services on :{server.port}")
+          f"services on :{server.port}", flush=True)
     threading.Event().wait()
     return 0
 

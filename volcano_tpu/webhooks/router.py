@@ -49,6 +49,19 @@ def all_services() -> List[AdmissionService]:
     return list(_services.values())
 
 
+def enabled_services(enabled_admission: Optional[str]):
+    """Filter registered services by the --enabled-admission flag
+    (None enables all) — shared by the in-process manager and the
+    multi-process admission endpoint."""
+    if enabled_admission is None:
+        enabled = None
+    else:
+        enabled = {p.strip() for p in enabled_admission.split(",")
+                   if p.strip()}
+    return [s for s in all_services()
+            if enabled is None or s.path in enabled]
+
+
 class WebhookManager:
     """Registers enabled admission services with the store
     (cmd/webhook-manager/app/server.go:64-87 registers webhook
@@ -59,13 +72,8 @@ class WebhookManager:
         """enabled_admission: comma-separated service paths
         (the --enabled-admission flag); None enables all."""
         self.store = store
-        if enabled_admission is None:
-            enabled = None
-        else:
-            enabled = {p.strip() for p in enabled_admission.split(",") if p.strip()}
-        self.services: List[AdmissionService] = [
-            s for s in all_services()
-            if enabled is None or s.path in enabled]
+        self.services: List[AdmissionService] = \
+            enabled_services(enabled_admission)
         self._hooks: List[AdmissionHook] = []
         for svc in self.services:
             hook = AdmissionHook(
@@ -83,3 +91,90 @@ class WebhookManager:
         def bound(operation, new_obj, old_obj):
             return fn(store, operation, new_obj, old_obj)
         return bound
+
+
+class AdmissionHTTPServer:
+    """The webhook-manager's serving half in multi-process mode: exposes
+    the enabled admission services over HTTP and self-registers them with
+    a remote apiserver, which calls back per matching operation
+    (cmd/webhook-manager/app/server.go:64-87 + router/server.go).
+
+    Request:  POST <service path> {"operation", "object", "old"}
+    Response: {"allowed": bool, "message": str, "object": mutated-or-null}
+    """
+
+    def __init__(self, store, enabled_admission: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        import json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from ..apiserver.codec import decode_object, encode_object
+
+        self.services: Dict[str, AdmissionService] = {
+            s.path: s for s in enabled_services(enabled_admission)}
+        self.host = host
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def do_POST(self):
+                svc = outer.services.get(self.path)
+                if svc is None:
+                    return self._send(404, {"allowed": False,
+                                            "message": "unknown path"})
+                body = json.loads(self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))).decode())
+                new_obj = decode_object(svc.kind, body["object"]) \
+                    if body.get("object") else None
+                old_obj = decode_object(svc.kind, body["old"]) \
+                    if body.get("old") else None
+                op = body.get("operation", "CREATE")
+                try:
+                    if svc.mutate is not None:
+                        svc.mutate(store, op, new_obj, old_obj)
+                    if svc.validate is not None:
+                        svc.validate(store, op, new_obj, old_obj)
+                except AdmissionError as e:
+                    return self._send(200, {"allowed": False,
+                                            "message": str(e)})
+                return self._send(200, {
+                    "allowed": True, "message": "",
+                    "object": encode_object(svc.kind, new_obj)
+                    if new_obj is not None else None})
+
+            def _send(self, code, payload):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_port
+
+    def start(self):
+        import threading
+        t = threading.Thread(target=self.httpd.serve_forever, daemon=True,
+                             name="webhook-admission-server")
+        t.start()
+        return t
+
+    def stop(self):
+        self.httpd.shutdown()
+
+    def register_with(self, apiserver_url: str) -> None:
+        """Self-register every service with the remote apiserver."""
+        import json
+        import urllib.request
+        for svc in self.services.values():
+            payload = {"kind": svc.kind, "path": svc.path,
+                       "operations": list(svc.operations),
+                       "url": f"http://{self.host}:{self.port}{svc.path}"}
+            req = urllib.request.Request(
+                f"{apiserver_url.rstrip('/')}/admissionwebhooks",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            urllib.request.urlopen(req, timeout=10.0).close()
