@@ -1,0 +1,30 @@
+# CI-style gates (the reference's Makefile:115-141 equivalents).
+
+PYTHON ?= python
+
+.PHONY: test unit-test e2e bench bench-all multichip-dryrun
+
+# the standard unit gate (reference: make unit-test, go test -p 8 -race ...)
+# tests force the virtual 8-device CPU mesh (tests/conftest.py); the
+# concurrency suite is the -race-equivalent adversarial gate
+test: unit-test
+
+unit-test:
+	$(PYTHON) -m pytest tests/ -q
+
+# the multi-process control-plane e2e alone (four OS processes)
+e2e:
+	$(PYTHON) -m pytest tests/test_multiprocess.py tests/test_e2e_sim.py -q
+
+# headline benchmark (one JSON line; TPU when available)
+bench:
+	$(PYTHON) bench.py
+
+# the five BASELINE.md configs + full-cycle runOnce -> BENCH_DETAILS.json
+bench-all:
+	$(PYTHON) bench.py --all
+
+# multi-chip sharding dryrun on the virtual CPU mesh
+multichip-dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

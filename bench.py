@@ -180,6 +180,10 @@ def main() -> None:
                 "vs_baseline": round(BASELINE_MS / best, 3),
                 "platform": res.get("platform"),
                 "kernel": res.get("kernel"),
+                # the placement math (SURVEY north star) — the end-to-end
+                # runOnce including snapshot/encode/commit is the
+                # full_cycle row of BENCH_DETAILS.json (bench.py --all)
+                "scope": "placement_kernel",
             }))
             return
 
