@@ -86,3 +86,23 @@ def test_pallas_gang_rollback_matches_scan():
     h_scan, h_pl = env(CONF_SCAN), env(CONF_PALLAS)
     assert h_scan.binds == h_pl.binds
     assert set(h_pl.binds) == {"ns1/o0", "ns1/o1"}
+
+
+def test_host_context_matches_device_context():
+    """build_host_context (the preempt/reclaim path) must produce the
+    same predicate mask and static score as the device _build_context."""
+    import numpy as np
+
+    h = _populate(Harness(CONF_SCAN), n_jobs=4, gang=3, n_nodes=12)
+    # add constraints so selector/taint/fit all engage
+    from volcano_tpu.models.objects import Taint
+    ssn = h.open_session()
+    ordered = [(job, list(job.tasks.values())) for job in ssn.jobs.values()]
+    narr_d, batch_d, gmask_d, static_d = ssn.solver._build_context(ordered)
+    narr_h, batch_h, gmask_h, static_h = \
+        ssn.solver.build_host_context(ordered)
+    assert narr_h.names == narr_d.names
+    assert batch_h.job_uids == batch_d.job_uids
+    np.testing.assert_array_equal(np.asarray(gmask_d), gmask_h)
+    np.testing.assert_allclose(np.asarray(static_d), static_h, rtol=1e-6)
+    h.close_session()

@@ -242,6 +242,47 @@ class BatchSolver:
                 static_score = static_score + jnp.asarray(contrib)
         return narr, batch, gmask, static_score
 
+    def build_host_context(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]]):
+        """Numpy mirror of :meth:`_build_context` for host-driven actions
+        (preempt/reclaim walk nodes in Python): identical mask/score
+        semantics with zero device traffic — pulling the [G, N] mask and
+        static score back from a tunneled TPU costs seconds at 50k x 10k,
+        while the preempt walk only ever reads a few rows."""
+        ssn = self.ssn
+        narr = NodeArrays.build(ssn.nodes, [n.name for n in ssn.node_list],
+                                self.rindex)
+        batch = TaskBatch.build(ordered_jobs, self.rindex)
+        feats = PredicateFeatures.build(ssn.nodes, narr, batch)
+
+        eps = self.rindex.eps
+        gmask = np.ones((batch.g_pad, narr.n_pad), bool)
+        gmask &= narr.valid[None, :]
+        for c in range(self.rindex.r):
+            # group_fit_mask, column-wise (no [G, N, R] temporaries)
+            gmask &= batch.group_req[:, c:c + 1] <= \
+                (narr.capability[None, :, c] + eps[c])
+        if self.enable_default_predicates:
+            got = feats.group_requires @ feats.node_pairs.T
+            gmask &= got >= feats.group_require_counts[:, None] - 0.5
+            violations = (1.0 - feats.group_tolerates) @ feats.node_taints.T
+            gmask &= violations < 0.5
+            if feats.group_affinity_ok is not None:
+                gmask &= feats.group_affinity_ok
+        for fn in self.mask_fns:
+            contrib = fn(batch, narr, feats)
+            if contrib is not None:
+                gmask &= np.asarray(contrib)
+        host_mask = self._host_predicate_mask(batch, narr)
+        if host_mask is not None:
+            gmask &= host_mask
+
+        static_score = np.zeros((batch.g_pad, narr.n_pad), np.float32)
+        for fn in self.static_score_fns:
+            contrib = fn(batch, narr, feats)
+            if contrib is not None:
+                static_score = static_score + np.asarray(contrib)
+        return narr, batch, gmask, static_score
+
     def task_feasibility(self, job: JobInfo, task: TaskInfo):
         """Predicate mask + score over all nodes for a single task against
         the session's current node state (the PredicateNodes +

@@ -190,10 +190,11 @@ class PreemptContext:
         self.ssn = ssn
         solver = ssn.solver
         self.rindex = solver.rindex
-        self.narr, self.batch, gmask, static_score = \
-            solver._build_context(ordered_jobs)
-        self.gmask = np.asarray(gmask)
-        self.static = np.asarray(static_score)
+        # host-native context: the preempt/reclaim walk reads a handful of
+        # mask/score rows in numpy; building on-device and pulling [G, N]
+        # matrices back over a TPU tunnel costs seconds at 5k x 10k
+        self.narr, self.batch, self.gmask, self.static = \
+            solver.build_host_context(ordered_jobs)
         self.weights = solver.score_weights().host()
         # live mirrors, sync'd to session state at build time
         self.idle = self.narr.idle.copy()

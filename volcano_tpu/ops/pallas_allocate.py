@@ -23,6 +23,7 @@ over the padded resource axis (R_PAD=8 sublanes).
 from __future__ import annotations
 
 import functools
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -384,8 +385,55 @@ def gang_allocate_pallas(task_group, task_job, task_valid, group_req,
                          weights: ScoreWeights, allow_pipeline: bool = True,
                          interpret: bool = False):
     """Drop-in for ops.allocate.gang_allocate, returning
-    (assign, pipelined, ready, kept, None)."""
-    task_group = jnp.asarray(task_group, jnp.int32)
+    (assign, pipelined, ready, kept, None).
+
+    The group-bucket reduction needs host numpy (scatter by group), so it
+    runs here; everything else is one jitted program — the wrapper's ~30
+    individual op dispatches cost real latency on a tunneled TPU."""
+    G = int(group_req.shape[0])
+    # group_bucket from per-task buckets (uniform within a group by
+    # construction; see solver.place bucket_fn keyed on job+task annotations)
+    tb = np.asarray(task_bucket)
+    tg = np.asarray(task_group)
+    gb = np.full(G, -1, np.int32)
+    valid_np = np.asarray(task_valid, bool)
+    sel = valid_np & (tb >= 0)
+    gb[tg[sel]] = tb[sel]
+    return _gang_allocate_pallas_jit(
+        jnp.asarray(task_group, jnp.int32), jnp.asarray(task_job),
+        jnp.asarray(task_valid, bool), jnp.asarray(group_req, jnp.float32),
+        jnp.asarray(group_mask, bool),
+        jnp.asarray(group_static_score, jnp.float32),
+        jnp.asarray(gb), jnp.asarray(group_pack_bonus, jnp.float32),
+        jnp.asarray(job_min_available, jnp.int32),
+        jnp.asarray(job_ready_base, jnp.int32),
+        jnp.asarray(job_task_start, jnp.int32),
+        jnp.asarray(job_n_tasks, jnp.int32),
+        jnp.asarray(job_queue, jnp.int32),
+        jnp.asarray(queue_job_start, jnp.int32),
+        jnp.asarray(queue_njobs, jnp.int32),
+        jnp.asarray(queue_deserved, jnp.float32),
+        jnp.asarray(queue_alloc0, jnp.float32),
+        jnp.asarray(node_idle, jnp.float32),
+        jnp.asarray(node_future, jnp.float32),
+        jnp.asarray(node_alloc, jnp.float32),
+        jnp.asarray(node_ntasks, jnp.int32),
+        jnp.asarray(node_max_tasks, jnp.int32),
+        jnp.asarray(eps, jnp.float32), weights,
+        allow_pipeline=allow_pipeline, interpret=interpret)
+
+
+@partial(jax.jit, static_argnames=("allow_pipeline", "interpret"))
+def _gang_allocate_pallas_jit(task_group, task_job, task_valid, group_req,
+                              group_mask, group_static_score, gb,
+                              group_pack_bonus, job_min_available,
+                              job_ready_base, job_task_start, job_n_tasks,
+                              job_queue, queue_job_start, queue_njobs,
+                              queue_deserved, queue_alloc0, node_idle,
+                              node_future, node_alloc, node_ntasks,
+                              node_max_tasks, eps, weights: ScoreWeights,
+                              allow_pipeline: bool = True,
+                              interpret: bool = False):
     T = int(task_group.shape[0])
     J = int(job_min_available.shape[0])
     G = int(group_req.shape[0])
@@ -396,15 +444,6 @@ def gang_allocate_pallas(task_group, task_job, task_valid, group_req,
     Q = int(queue_njobs.shape[0])
     Q8 = max(8, ((Q + 7) // 8) * 8)
     G8 = ((G + 7) // 8) * 8
-
-    # group_bucket from per-task buckets (uniform within a group by
-    # construction; see solver.place bucket_fn keyed on job+task annotations)
-    tb = np.asarray(task_bucket)
-    tg = np.asarray(task_group)
-    gb = np.full(G, -1, np.int32)
-    valid_np = np.asarray(task_valid, bool)
-    sel = valid_np & (tb >= 0)
-    gb[tg[sel]] = tb[sel]
 
     s_task_group = jnp.where(jnp.asarray(task_valid, bool),
                              task_group, -1).astype(jnp.int32)
