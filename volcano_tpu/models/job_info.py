@@ -34,11 +34,14 @@ class TaskStatus(enum.IntFlag):
     Unknown = 1 << 9
 
 
+_ALLOCATED_STATUSES = frozenset((TaskStatus.Bound, TaskStatus.Binding,
+                                 TaskStatus.Running, TaskStatus.Allocated))
+
+
 def allocated_status(status: TaskStatus) -> bool:
     """Statuses that occupy node resources from the scheduler's viewpoint
     (reference: pkg/scheduler/api/job_info.go AllocatedStatus)."""
-    return status in (TaskStatus.Bound, TaskStatus.Binding,
-                      TaskStatus.Running, TaskStatus.Allocated)
+    return status in _ALLOCATED_STATUSES
 
 
 def is_terminated(status: TaskStatus) -> bool:

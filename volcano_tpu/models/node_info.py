@@ -159,7 +159,7 @@ class NodeInfo:
     def _allocate_idle(self, ti: TaskInfo) -> None:
         if not ti.resreq.less_equal(self.idle, ZERO):
             raise RuntimeError("selected node NotReady")
-        self.idle.sub(ti.resreq)
+        self.idle.sub_unchecked(ti.resreq)   # checked on the line above
 
     def add_task(self, task: TaskInfo) -> None:
         """Add a task; accounting depends on its status (node_info.go:341-384).

@@ -147,6 +147,12 @@ class Resource:
         """Subtract; requires rr <= self under Zero defaults (resource_info.go:195)."""
         assert rr.less_equal(self, ZERO), \
             f"resource is not sufficient to do operation: <{self}> sub <{rr}>"
+        return self.sub_unchecked(rr)
+
+    def sub_unchecked(self, rr: "Resource") -> "Resource":
+        """sub() without the sufficiency assertion — for hot paths whose
+        caller has just performed the same less_equal check (e.g.
+        NodeInfo._allocate_idle); the assertion would re-run it per call."""
         self.milli_cpu -= rr.milli_cpu
         self.memory -= rr.memory
         if not self.scalars:
