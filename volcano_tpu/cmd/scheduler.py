@@ -57,6 +57,8 @@ def run_scheduler(store: ObjectStore, args) -> Scheduler:
 
 
 def main(argv=None) -> int:
+    from ..utils.platform import apply_env_platform
+    apply_env_platform()
     parser = argparse.ArgumentParser(prog="vc-scheduler")
     add_flags(parser)
     args = parser.parse_args(argv)

@@ -43,8 +43,8 @@ import jax.numpy as jnp
 
 from .score import ScoreWeights, node_score
 
-NEG = jnp.float32(-1e30)
-BIG = jnp.float32(1e30)
+NEG = -1e30   # plain floats: no backend init at import
+BIG = 1e30
 
 
 class AllocState(NamedTuple):

@@ -15,7 +15,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-INF = jnp.float32(jnp.inf)
+INF = float("inf")   # plain float: no backend init at import
 
 
 class _WFState(NamedTuple):

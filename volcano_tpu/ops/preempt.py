@@ -19,7 +19,9 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-NEG = jnp.float32(-1e30)
+NEG = -1e30   # plain float: a module-level jnp constant would
+              # initialize the device backend at import time (and
+              # hang on a dead TPU tunnel before main() can pin cpu)
 
 
 @jax.jit

@@ -38,8 +38,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .allocate import queue_overused, queue_share
 from .score import ScoreWeights, node_score
 
-NEG = jnp.float32(-1e30)
-BIG = jnp.float32(1e30)
+NEG = -1e30   # plain floats: no backend init at import
+BIG = 1e30
 
 
 class ShardState(NamedTuple):

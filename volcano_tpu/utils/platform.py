@@ -1,0 +1,20 @@
+"""Make JAX honor $JAX_PLATFORMS even when sitecustomize pinned another
+platform at interpreter start (the axon tunnel pin, see tests/conftest.py
+and __graft_entry__._force_virtual_cpu_mesh). Component binaries call this
+first so `JAX_PLATFORMS=cpu vc-scheduler ...` cannot hang on a dead TPU
+tunnel."""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_env_platform() -> None:
+    env = os.environ.get("JAX_PLATFORMS")
+    if not env:
+        return
+    try:
+        import jax
+        jax.config.update("jax_platforms", env)
+    except Exception:
+        pass   # jax absent or config fixed: leave as-is
