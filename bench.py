@@ -141,7 +141,9 @@ def main() -> None:
         results = run_all(full_scale=full)
         base = os.path.dirname(os.path.abspath(__file__)) \
             if "__file__" in globals() else os.getcwd()
-        out = os.path.join(base, "BENCH_DETAILS.json")
+        # --small is a smoke run: never clobber the full-scale artifact
+        out = os.path.join(base, "BENCH_DETAILS.json" if full
+                           else "BENCH_DETAILS_SMALL.json")
         with open(out, "w") as f:
             json.dump(results, f, indent=1)
         for r in results:
