@@ -54,6 +54,11 @@ def log(msg: str) -> None:
     print(f"[bench-suite] {msg}", file=sys.stderr, flush=True)
 
 
+def _platform() -> str:
+    import jax
+    return jax.devices()[0].platform
+
+
 def _cycle_env(conf_text: str):
     from volcano_tpu.apiserver import ObjectStore
     from volcano_tpu.cache import SchedulerCache
@@ -120,7 +125,8 @@ def config_1() -> Dict:
     cache2.flush_executors()
     assert len(binder2.binds) == 3, binder2.binds
     return {"config": 1, "desc": "single gang-of-3 PodGroup, full cycle",
-            "value_ms": round(ms, 2), "binds": len(binder2.binds)}
+            "value_ms": round(ms, 2), "binds": len(binder2.binds),
+            "platform": _platform()}
 
 
 def config_2() -> Dict:
@@ -135,7 +141,8 @@ def config_2() -> Dict:
     ms = _run_cycle(cache2, conf2)
     cache2.flush_executors()
     return {"config": 2, "desc": "1k tasks x 100 nodes full cycle",
-            "value_ms": round(ms, 2), "binds": len(binder2.binds)}
+            "value_ms": round(ms, 2), "binds": len(binder2.binds),
+            "platform": _platform()}
 
 
 def config_3() -> Dict:
@@ -151,7 +158,8 @@ def config_3() -> Dict:
     cache2.flush_executors()
     return {"config": 3,
             "desc": "drf 4-queue fair share, 5k tasks x 1k nodes full cycle",
-            "value_ms": round(ms, 2), "binds": len(binder2.binds)}
+            "value_ms": round(ms, 2), "binds": len(binder2.binds),
+            "platform": _platform()}
 
 
 def config_4(n_nodes=10000, n_low=1250, n_high=625) -> Dict:
@@ -195,7 +203,8 @@ def config_4(n_nodes=10000, n_low=1250, n_high=625) -> Dict:
                   if t.status == TaskStatus.Releasing)
     return {"config": 4,
             "desc": f"preempt {n_high * 8} starving x {n_nodes} nodes",
-            "value_ms": round(ms, 2), "evicted": evicted}
+            "value_ms": round(ms, 2), "evicted": evicted,
+            "platform": _platform()}
 
 
 def config_5(n_tasks=50_000, n_nodes=10_000, runs=3,
@@ -226,7 +235,7 @@ def config_5(n_tasks=50_000, n_nodes=10_000, runs=3,
                 "desc": f"{n_tasks // 1000}k x {n_nodes // 1000}k "
                         "rack-affinity gang-allocate kernel",
                 "value_ms": round(best, 2),
-                "platform": jax.devices()[0].platform})
+                "platform": _platform()})
 
     if sharded_devices and len(jax.devices()) >= sharded_devices:
         from jax.sharding import Mesh
@@ -253,7 +262,7 @@ def config_5(n_tasks=50_000, n_nodes=10_000, runs=3,
                     "desc": f"same, node-axis sharded over "
                             f"{sharded_devices}-device mesh",
                     "value_ms": round(best, 2),
-                    "platform": jax.devices()[0].platform})
+                    "platform": _platform()})
     return out
 
 
@@ -282,7 +291,8 @@ def full_cycle_50k(n_tasks=50_000, n_nodes=10_000) -> Dict:
                     "commit; async bind flush reported separately)",
             "value_ms": round(warm, 2),
             "bind_flush_ms": round(flush_ms, 2),
-            "binds": len(binder2.binds)}
+            "binds": len(binder2.binds),
+            "platform": _platform()}
 
 
 def capture_traces() -> None:

@@ -199,6 +199,11 @@ class JobInfo:
         self.budget: DisruptionBudget = DisruptionBudget()
         self.task_min_available: Dict[str, int] = {}
         self.task_min_available_total: int = 0
+        # status-index version: bumped on any task/status mutation so the
+        # readiness counters can memoize (preempt calls ready_task_num
+        # tens of thousands of times between mutations)
+        self._status_version: int = 0
+        self._ready_cache: tuple = (-1, 0)
         for t in tasks:
             self.add_task_info(t)
 
@@ -277,6 +282,7 @@ class JobInfo:
     # -- task management ---------------------------------------------------
 
     def add_task_info(self, ti: TaskInfo) -> None:
+        self._status_version += 1
         self.tasks[ti.uid] = ti
         self.task_status_index[ti.status][ti.uid] = ti
         if allocated_status(ti.status):
@@ -300,6 +306,7 @@ class JobInfo:
         if stored is None:
             raise KeyError(f"failed to find task <{task.namespace}/"
                            f"{task.name}> in job <{self.namespace}/{self.name}>")
+        self._status_version += 1
         old = stored.status
         idx = self.task_status_index[old]
         idx.pop(task.uid, None)
@@ -315,6 +322,7 @@ class JobInfo:
         self.task_status_index[status][task.uid] = task
 
     def delete_task_info(self, ti: TaskInfo) -> None:
+        self._status_version += 1
         task = self.tasks.get(ti.uid)
         if task is None:
             raise KeyError(f"failed to find task <{ti.namespace}/{ti.name}> "
@@ -371,13 +379,17 @@ class JobInfo:
 
     def ready_task_num(self) -> int:
         """Allocated-ish + Succeeded + best-effort Pending
-        (reference: job_info.go:509-527)."""
+        (reference: job_info.go:509-527). Memoized per status version."""
+        cached_version, cached = self._ready_cache
+        if cached_version == self._status_version:
+            return cached
         occupied = 0
         for status, tasks in self.task_status_index.items():
             if allocated_status(status) or status == TaskStatus.Succeeded:
                 occupied += len(tasks)
             elif status == TaskStatus.Pending:
                 occupied += sum(1 for t in tasks.values() if t.init_resreq.is_empty())
+        self._ready_cache = (self._status_version, occupied)
         return occupied
 
     def waiting_task_num(self) -> int:

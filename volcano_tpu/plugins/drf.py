@@ -45,12 +45,16 @@ def _share_of(allocated: Resource, total: Resource) -> (str, float):
 
 
 class _DrfAttr:
-    __slots__ = ("share", "dominant", "allocated")
+    __slots__ = ("share", "dominant", "allocated", "version")
 
     def __init__(self, allocated: Optional[Resource] = None):
         self.share = 0.0
         self.dominant = ""
         self.allocated = allocated if allocated is not None else Resource()
+        # bumped on every allocated mutation: preemptable_fn memoizes the
+        # preemptor-side share against it (5k preemptors x ~3 node visits
+        # re-derived the same clone+add+share chain otherwise)
+        self.version = 0
 
 
 class _HNode:
@@ -147,6 +151,8 @@ class DrfPlugin(Plugin):
                 opt.dominant, opt.share = _share_of(opt.allocated, self.total)
                 m.update_namespace_share(ns, opt.share)
 
+        _ls_memo: Dict[tuple, float] = {}
+
         def preemptable_fn(preemptor, preemptees):
             """Preemption allowed only while it narrows the share gap
             (drf.go:246-330)."""
@@ -185,8 +191,12 @@ class DrfPlugin(Plugin):
                 preemptees = undecided
 
             latt = self.job_attrs.get(preemptor.job, _DrfAttr())
-            lalloc = latt.allocated.clone().add(preemptor.resreq)
-            _, ls = _share_of(lalloc, self.total)
+            lkey = (preemptor.job, latt.version, id(preemptor.resreq))
+            ls = _ls_memo.get(lkey)
+            if ls is None:
+                lalloc = latt.allocated.clone().add(preemptor.resreq)
+                _, ls = _share_of(lalloc, self.total)
+                _ls_memo[lkey] = ls
 
             allocations: Dict[str, Resource] = {}
             for preemptee in preemptees:
@@ -297,6 +307,7 @@ class DrfPlugin(Plugin):
                 attr.allocated.add(total)
             else:
                 attr.allocated.sub(total)
+            attr.version += 1
             attr.dominant, attr.share = _share_of(attr.allocated, self.total)
             m.update_job_share(job.namespace, job.name, attr.share)
             if ns_enabled:
