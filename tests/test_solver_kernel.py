@@ -106,3 +106,16 @@ def test_host_context_matches_device_context():
     np.testing.assert_array_equal(np.asarray(gmask_d), gmask_h)
     np.testing.assert_allclose(np.asarray(static_d), static_h, rtol=1e-6)
     h.close_session()
+
+
+def test_scheduling_is_deterministic():
+    """Same snapshot in, same bindings out (SURVEY §7: seeded tie-breaking
+    replaces the reference's rand.Intn node selection)."""
+    def run():
+        h = _populate(Harness(CONF_SCAN), n_jobs=6, gang=4, n_nodes=16)
+        h.run_actions("enqueue", "allocate").close_session()
+        return dict(h.binds)
+    first = run()
+    assert first
+    for _ in range(2):
+        assert run() == first
