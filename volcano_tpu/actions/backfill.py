@@ -22,19 +22,38 @@ class BackfillAction(Action):
         return "backfill"
 
     def execute(self, ssn) -> None:
+        jobs_tasks = []
         for job in list(ssn.jobs.values()):
             if job.pod_group.status.phase == PodGroupPhase.PENDING:
                 continue
             vr = ssn.job_valid(job)
             if vr is not None and not vr.passed:
                 continue
-            for task in list(job.task_status_index.get(
-                    TaskStatus.Pending, {}).values()):
-                if not task.init_resreq.is_empty():
+            tasks = [t for t in job.task_status_index.get(
+                         TaskStatus.Pending, {}).values()
+                     if t.init_resreq.is_empty()]
+            if tasks:
+                jobs_tasks.append((job, tasks))
+        if not jobs_tasks:
+            return
+
+        # one host-side predicate context for ALL best-effort tasks
+        # (previously one device context build per task)
+        narr, batch, gmask, _static = ssn.solver.build_host_context(jobs_tasks)
+        n_real = len(narr.names)
+        n_tasks = narr.n_tasks.copy()
+        max_tasks = narr.max_tasks
+        uid_to_g = {t.uid: g for t, g in zip(batch.tasks, batch.task_group)}
+        for job, tasks in jobs_tasks:
+            for task in tasks:
+                g = uid_to_g.get(task.uid)
+                if g is None:
                     continue
-                narr, mask, _score = ssn.solver.task_feasibility(job, task)
+                pods_ok = (max_tasks[:n_real] == 0) | \
+                    (n_tasks[:n_real] < max_tasks[:n_real])
+                mask = gmask[g, :n_real] & pods_ok
                 allocated = False
-                for i in np.flatnonzero(mask[:len(narr.names)]):
+                for i in np.flatnonzero(mask):
                     node = ssn.nodes.get(narr.names[int(i)])
                     if node is None:
                         continue
@@ -42,6 +61,7 @@ class BackfillAction(Action):
                         ssn.allocate(task, node)
                     except (KeyError, RuntimeError):
                         continue
+                    n_tasks[int(i)] += 1
                     allocated = True
                     break
                 if not allocated:
