@@ -165,7 +165,6 @@ class RemoteStore:
             log.exception("mirror apply %s %s failed", action, kind)
 
     def _poll_loop(self) -> None:
-        import urllib.parse
         while not self._stop.is_set():
             url = (f"{self.base_url}/watch?since={self._rv}"
                    f"&timeout={self.poll_timeout}")

@@ -111,54 +111,48 @@ def _populate(store, n_nodes, n_jobs, gang, queues=None, cpu="2",
                    node_cpu=node_cpu, node_mem=node_mem)
 
 
-def config_1() -> Dict:
-    """Single gang-of-3 PodGroup (example/job.yaml shape), full cycle."""
-    store, cache, binder, conf = _cycle_env(CONF_FULL)
-    _populate(store, n_nodes=4, n_jobs=1, gang=3, node_cpu="8",
-              node_mem="16Gi")
-    ms = _run_cycle(cache, conf)           # includes compile
-    cache.flush_executors()                # isolate the warm measurement
-    store2, cache2, binder2, conf2 = _cycle_env(CONF_FULL)
-    _populate(store2, n_nodes=4, n_jobs=1, gang=3, node_cpu="8",
-              node_mem="16Gi")
+
+def _warm_cycle(conf_text: str, **populate_kwargs):
+    """Cold cycle (compile) on one env, then the measured warm cycle on a
+    fresh identical env with the warm-up's executor drained first.
+    Returns (warm_ms, binder)."""
+    store, cache, binder, conf = _cycle_env(conf_text)
+    _populate(store, **populate_kwargs)
+    _run_cycle(cache, conf)                # includes compile
+    cache.flush_executors(timeout=120.0)   # isolate the warm measurement
+    store2, cache2, binder2, conf2 = _cycle_env(conf_text)
+    _populate(store2, **populate_kwargs)
     ms = _run_cycle(cache2, conf2)
     cache2.flush_executors()
-    assert len(binder2.binds) == 3, binder2.binds
+    return ms, binder2
+
+
+def config_1() -> Dict:
+    """Single gang-of-3 PodGroup (example/job.yaml shape), full cycle."""
+    ms, binder = _warm_cycle(CONF_FULL, n_nodes=4, n_jobs=1, gang=3,
+                             node_cpu="8", node_mem="16Gi")
+    assert len(binder.binds) == 3, binder.binds
     return {"config": 1, "desc": "single gang-of-3 PodGroup, full cycle",
-            "value_ms": round(ms, 2), "binds": len(binder2.binds),
+            "value_ms": round(ms, 2), "binds": len(binder.binds),
             "platform": _platform()}
 
 
 def config_2() -> Dict:
     """1k tasks x 100 nodes, predicates + binpack, full cycle."""
-    conf_text = CONF_FULL
-    store, cache, binder, conf = _cycle_env(conf_text)
-    _populate(store, n_nodes=100, n_jobs=125, gang=8)
-    _run_cycle(cache, conf)                # compile warm-up
-    cache.flush_executors()                # isolate the warm measurement
-    store2, cache2, binder2, conf2 = _cycle_env(conf_text)
-    _populate(store2, n_nodes=100, n_jobs=125, gang=8)
-    ms = _run_cycle(cache2, conf2)
-    cache2.flush_executors()
+    ms, binder = _warm_cycle(CONF_FULL, n_nodes=100, n_jobs=125, gang=8)
     return {"config": 2, "desc": "1k tasks x 100 nodes full cycle",
-            "value_ms": round(ms, 2), "binds": len(binder2.binds),
+            "value_ms": round(ms, 2), "binds": len(binder.binds),
             "platform": _platform()}
 
 
 def config_3() -> Dict:
     """DRF multi-queue fair share: 4 queues, 5k tasks, full cycle."""
     queues = [(f"q{i}", w) for i, w in enumerate([1, 2, 3, 4])]
-    store, cache, binder, conf = _cycle_env(CONF_FULL)
-    _populate(store, n_nodes=1000, n_jobs=625, gang=8, queues=queues)
-    _run_cycle(cache, conf)
-    cache.flush_executors(timeout=120.0)   # isolate the warm measurement
-    store2, cache2, binder2, conf2 = _cycle_env(CONF_FULL)
-    _populate(store2, n_nodes=1000, n_jobs=625, gang=8, queues=queues)
-    ms = _run_cycle(cache2, conf2)
-    cache2.flush_executors()
+    ms, binder = _warm_cycle(CONF_FULL, n_nodes=1000, n_jobs=625, gang=8,
+                             queues=queues)
     return {"config": 3,
             "desc": "drf 4-queue fair share, 5k tasks x 1k nodes full cycle",
-            "value_ms": round(ms, 2), "binds": len(binder2.binds),
+            "value_ms": round(ms, 2), "binds": len(binder.binds),
             "platform": _platform()}
 
 

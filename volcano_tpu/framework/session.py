@@ -23,6 +23,7 @@ from ..models.cluster_info import ClusterInfo
 from ..models.job_info import JobInfo, TaskInfo, TaskStatus
 from ..models.node_info import NodeInfo
 from ..models.queue_info import NamespaceInfo, QueueInfo
+from ..models.resource import Resource
 
 # plugin voting values (reference: plugins/util/util.go:31-36)
 PERMIT = 1
@@ -411,7 +412,6 @@ class Session:
         """One event round for a whole gang's placements."""
         if not tasks:
             return
-        from ..models.resource import Resource
         total = Resource()
         for t in tasks:
             total.add(t.resreq)
@@ -425,7 +425,6 @@ class Session:
     def _fire_deallocate_batch(self, job, tasks) -> None:
         if not tasks:
             return
-        from ..models.resource import Resource
         total = Resource()
         for t in tasks:
             total.add(t.resreq)

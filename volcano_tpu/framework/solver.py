@@ -29,10 +29,11 @@ from ..models.arrays import (NodeArrays, PredicateFeatures, ResourceIndex,
 from ..models.job_info import JobInfo, TaskInfo
 from ..models.unschedule_info import FitError, FitErrors
 from ..ops.allocate import gang_allocate
-from ..ops.fit import group_fit_mask, selector_mask, static_predicate_mask, taint_mask
+from ..ops.fit import group_fit_mask, selector_mask, taint_mask
 from ..ops.score import ScoreWeights
 
 import logging
+import time
 
 _logger = logging.getLogger(__name__)
 _logged_once: set = set()
@@ -243,9 +244,16 @@ class BatchSolver:
         return narr, batch, gmask, static_score
 
     def build_host_context(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]]):
-        """Numpy mirror of :meth:`_build_context` for host-driven actions
-        (preempt/reclaim walk nodes in Python): identical mask/score
-        semantics with zero device traffic — pulling the [G, N] mask and
+        """Numpy mirror of :meth:`_build_context` for host-driven actions.
+
+        KEEP IN SYNC with _build_context: the two formulations differ on
+        purpose (device kernels vs column-wise numpy without [G, N, R]
+        temporaries), and tests/test_solver_kernel.py's
+        test_host_context_matches_device_context pins their equivalence.
+
+        Host-driven actions
+        (preempt/reclaim) walk nodes in Python with identical mask/score
+        semantics and zero device traffic — pulling the [G, N] mask and
         static score back from a tunneled TPU costs seconds at 50k x 10k,
         while the preempt walk only ever reads a few rows."""
         ssn = self.ssn
@@ -354,8 +362,6 @@ class BatchSolver:
                 key, bonus = res
                 task_bucket[t_idx] = keys.setdefault(key, len(keys))
                 pack_bonus[batch.task_group[t_idx]] = bonus
-
-        import time
 
         from ..metrics import metrics as m
         t_kernel = time.perf_counter()

@@ -150,6 +150,19 @@ class Statement:
             fast.append((task, node, pipelined))
         if not fast:
             return
+
+        def undo(task, node, pipelined, registered: bool) -> None:
+            """Revert one staged placement (add_task itself is atomic on
+            error, so an unregistered task never touched the node)."""
+            if registered:
+                node.remove_task(task)
+            job_of = ssn.jobs.get(task.job)
+            if job_of is not None and task.status != TaskStatus.Pending:
+                job_of.move_task_status(task, TaskStatus.Pending)
+            task.node_name = ""
+            if not pipelined:
+                task.pod.spec.node_name = ""
+
         applied = []
         failure: Optional[BaseException] = None
         for task, node, pipelined in fast:
@@ -165,27 +178,13 @@ class Statement:
                 task.node_name = node.name
                 node.add_task(task)
             except Exception as e:
-                # undo this task's partial mutations; add_task itself is
-                # atomic on error (it mutates nothing before raising), so
-                # the node is untouched — only the job-side status move
-                # and the name fields can have landed
-                if job_of is not None and task.status != TaskStatus.Pending:
-                    job_of.move_task_status(task, TaskStatus.Pending)
-                task.node_name = ""
-                if not pipelined:
-                    task.pod.spec.node_name = ""
+                undo(task, node, pipelined, registered=False)
                 failure = e
                 break
             applied.append((task, node, pipelined))
         if failure is not None and not keep_partial:
             for task, node, pipelined in reversed(applied):
-                node.remove_task(task)
-                job_of = ssn.jobs.get(task.job)
-                if job_of is not None:
-                    job_of.move_task_status(task, TaskStatus.Pending)
-                task.node_name = ""
-                if not pipelined:
-                    task.pod.spec.node_name = ""
+                undo(task, node, pipelined, registered=True)
             raise failure
         if applied:
             ssn._fire_allocate_batch(job, [t for t, _, _ in applied])
