@@ -123,3 +123,82 @@ def test_four_process_control_plane(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_scheduler_leader_failover():
+    """Two schedulers race on the store lease; killing the leader hands
+    scheduling over to the standby (cmd/scheduler/app/server.go:45-46
+    leader election; lease in a ConfigMap resource lock)."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        api_port = s.getsockname()[1]
+    url = f"http://127.0.0.1:{api_port}"
+    procs = []
+    try:
+        procs.append(_spawn("volcano_tpu.cmd.apiserver",
+                            "--port", str(api_port), "--nodes", "4",
+                            "--node-resources", "cpu=8,memory=16Gi",
+                            "--default-queue"))
+        client = StoreClient(url)
+        assert _wait_ready(client), "apiserver did not come up"
+        procs.append(_spawn("volcano_tpu.cmd.controller_manager",
+                            "--server", url))
+        scheds = [_spawn("volcano_tpu.cmd.scheduler", "--server", url,
+                         "--schedule-period", "0.5", "--leader-elect",
+                         "--listen-address", f":{api_port + 1 + i}")
+                  for i in range(2)]
+        procs.extend(scheds)
+
+        from volcano_tpu.models.objects import (Container, Job, JobSpec,
+                                                ObjectMeta, PodSpec,
+                                                PodTemplate, TaskSpec)
+
+        def submit(name):
+            client.create("jobs", Job(
+                metadata=ObjectMeta(name=name, namespace="default"),
+                spec=JobSpec(min_available=2, queue="default",
+                             tasks=[TaskSpec(
+                                 name="main", replicas=2,
+                                 template=PodTemplate(
+                                     metadata=ObjectMeta(name="main"),
+                                     spec=PodSpec(containers=[Container(
+                                         name="main",
+                                         requests={"cpu": "1",
+                                                   "memory": "1Gi"})])))])))
+
+        def wait_bound(prefix, timeout):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                bound = [p for p in client.list("pods", "default")
+                         if p.metadata.name.startswith(prefix)
+                         and p.spec.node_name]
+                if len(bound) >= 2:
+                    return True
+                time.sleep(0.5)
+            return False
+
+        submit("pre")
+        assert wait_bound("pre-", 90), "no leader ever scheduled"
+
+        # find and kill the current leader by the lease's holder pid
+        lease = client.get("configmaps", "vc-scheduler", "volcano-system")
+        assert lease is not None
+        holder = lease.data["holderIdentity"]
+        leader_pid = int(holder.rsplit("-", 1)[1])
+        leader = next(p for p in scheds if p.pid == leader_pid)
+        leader.kill()
+        leader.wait(timeout=10)
+
+        submit("post")
+        # standby must acquire the lapsed lease (15s duration + retries)
+        # and schedule the new job
+        assert wait_bound("post-", 120), "standby never took over"
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -74,7 +74,14 @@ def main(argv=None) -> int:
     run_scheduler(store, args)
     from ..metrics.server import MetricsServer
     host, _, port_s = args.listen_address.rpartition(":")
-    MetricsServer(host or "127.0.0.1", int(port_s)).start()
+    try:
+        MetricsServer(host or "127.0.0.1", int(port_s)).start()
+    except OSError as e:
+        # a second candidate on the same host must not die over the
+        # metrics port (the reference runs candidates in separate pods);
+        # leader election and scheduling proceed without exposition
+        print(f"metrics endpoint unavailable ({e}); continuing without",
+              file=sys.stderr)
     print("vc-scheduler running against "
           + (args.server or "embedded store"), flush=True)
     threading.Event().wait()
