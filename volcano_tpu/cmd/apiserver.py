@@ -35,6 +35,11 @@ def main(argv=None) -> int:
     parser.add_argument("--node-resources", default="cpu=16,memory=32Gi")
     parser.add_argument("--default-queue", action="store_true",
                         help="pre-create the default queue")
+    parser.add_argument("--data-dir", default=None,
+                        help="persist the store to DIR/snapshot.json and "
+                             "restore it on startup (the etcd durability "
+                             "role; apiserver/persistence.py)")
+    parser.add_argument("--checkpoint-interval", type=float, default=30.0)
     parser.add_argument("--version", action="store_true")
     args = parser.parse_args(argv)
     if args.version:
@@ -42,19 +47,48 @@ def main(argv=None) -> int:
         print_version_and_exit()
 
     store = ObjectStore()
+    checkpointer = None
+    if args.data_dir:
+        import os as _os
+
+        from ..apiserver.persistence import StoreCheckpointer, load_store
+        snapshot = _os.path.join(args.data_dir, "snapshot.json")
+        if _os.path.exists(snapshot):
+            load_store(snapshot, store)
+            total = sum(len(v) for v in store._objects.values())
+            print(f"restored {total} objects from {snapshot}", flush=True)
+        checkpointer = StoreCheckpointer(store, snapshot,
+                                         interval=args.checkpoint_interval)
+        checkpointer.start()
+    def ensure(kind, obj_):
+        try:
+            store.create(kind, obj_)
+        except KeyError:
+            pass   # already restored from the snapshot
+
     if args.default_queue:
-        store.create("queues", Queue(metadata=ObjectMeta(name="default"),
-                                     spec=QueueSpec(weight=1)))
+        ensure("queues", Queue(metadata=ObjectMeta(name="default"),
+                               spec=QueueSpec(weight=1)))
     if args.nodes:
         rl = parse_resource_list(args.node_resources)
         for i in range(args.nodes):
-            store.create("nodes", Node(
+            ensure("nodes", Node(
                 metadata=ObjectMeta(name=f"node-{i}"),
                 status=NodeStatus(allocatable=dict(rl), capacity=dict(rl))))
     server = StoreHTTPServer(store, host=args.host, port=args.port)
     server.start()
     print(f"vc-apiserver serving on {args.host}:{server.port}", flush=True)
-    threading.Event().wait()
+    stop = threading.Event()
+    if checkpointer is not None:
+        import signal as _signal
+
+        def _graceful(signum, frame):
+            stop.set()
+        for sig in (_signal.SIGTERM, _signal.SIGINT):
+            _signal.signal(sig, _graceful)
+    stop.wait()
+    if checkpointer is not None:
+        checkpointer.stop(final_checkpoint=True)   # durable shutdown
     return 0
 
 
