@@ -30,7 +30,7 @@ class TestSnapshotRoundTrip:
         n = save_store(store, path)
         assert n == 5
 
-        restored = load_store(path)
+        restored, _ = load_store(path)
         assert restored.get("queues", "default").spec.weight == 2
         node = restored.get("nodes", "n1")
         assert node.metadata.labels == {"zone": "a"}
@@ -44,7 +44,7 @@ class TestSnapshotRoundTrip:
         store = populated_store()
         path = str(tmp_path / "state.json")
         save_store(store, path)
-        restored = load_store(path)
+        restored, _ = load_store(path)
         # new writes continue from beyond the snapshot's version
         q = restored.get("queues", "default")
         old_rv = q.metadata.resource_version
@@ -60,7 +60,7 @@ class TestSnapshotRoundTrip:
         path = str(tmp_path / "state.json")
         save_store(store, path)
 
-        restored = load_store(path)
+        restored, _ = load_store(path)
         cache = SchedulerCache(restored)
         cache.run()
         assert "n1" in cache.nodes
@@ -75,5 +75,38 @@ class TestSnapshotRoundTrip:
         path = str(tmp_path / "ck.json")
         ck = StoreCheckpointer(store, path, interval=3600)
         ck.stop(final_checkpoint=True)
-        restored = load_store(path)
+        restored, _ = load_store(path)
         assert restored.get("nodes", "n1") is not None
+
+
+def test_restore_forces_watch_resync():
+    """After a snapshot restore, a remote watcher holding a pre-restart
+    resource version must get resync=True — the replayed journal carries
+    restart-local rvs and cannot prove coverage (store.events_since)."""
+    import tempfile
+
+    from volcano_tpu.models.objects import ObjectMeta, Queue, QueueSpec
+
+    store = ObjectStore()
+    q = store.create("queues", Queue(metadata=ObjectMeta(name="a"),
+                                     spec=QueueSpec(weight=1)))
+    for w in range(2, 6):          # updates push rv well past object count
+        q.spec.weight = w
+        q = store.update("queues", q)
+    pre_rv = store.current_rv()
+    assert pre_rv > 1
+    with tempfile.TemporaryDirectory() as d:
+        path = f"{d}/snap.json"
+        save_store(store, path)
+        restored, count = load_store(path)
+    assert count == 1
+    assert restored.current_rv() == pre_rv
+    # stale watcher: empty journal + rv behind -> resync, not silence
+    events, rv, resync = restored.events_since(pre_rv - 2, timeout=0.1)
+    assert resync and not events
+    # a fresh watcher anchored at the current rv sees new events normally
+    q2 = restored.get("queues", "a")
+    q2.spec.weight = 9
+    restored.update("queues", q2)
+    events, rv, resync = restored.events_since(pre_rv, timeout=1.0)
+    assert not resync and len(events) == 1

@@ -48,10 +48,15 @@ def save_store(store: ObjectStore, path: str) -> int:
 
 
 def load_store(path: str, store: Optional[ObjectStore] = None,
-               clock=None) -> ObjectStore:
+               clock=None):
     """Restore a snapshot into ``store`` (or a new one). Objects replay
     through create with admission skipped (they were admitted when first
-    written), firing watches like an informer's initial list."""
+    written), firing watches like an informer's initial list.
+
+    Returns (store, object_count). The change journal is cleared after the
+    replay: the replayed creates carry restart-local rvs that misrepresent
+    history, and remote watchers from before the restart must see a
+    journal gap (resync) rather than silently missing events."""
     with open(path) as f:
         payload = json.load(f)
     if payload.get("version") != SNAPSHOT_VERSION:
@@ -59,15 +64,18 @@ def load_store(path: str, store: Optional[ObjectStore] = None,
                          f"{payload.get('version')!r}")
     if store is None:
         store = ObjectStore(clock=clock) if clock is not None else ObjectStore()
+    count = 0
     for kind, items in payload["objects"].items():
         if kind not in KINDS:
             continue
         for data in items:
             o = decode_object(kind, data)
             store.create(kind, o, skip_admission=True)
+            count += 1
     with store._lock:
         store._rv = max(store._rv, int(payload.get("resource_version", 0)))
-    return store
+        store._journal.clear()
+    return store, count
 
 
 class StoreCheckpointer:
@@ -86,7 +94,10 @@ class StoreCheckpointer:
     def start(self) -> threading.Thread:
         def loop():
             while not self._stop.is_set():
-                self._stop.wait(self.interval)
+                # interval <= 0 means shutdown-checkpoint only (a zero
+                # wait would busy-spin full-store serializations)
+                self._stop.wait(self.interval if self.interval > 0
+                                else None)
                 if not self._stop.is_set():
                     try:
                         self.checkpoint()
@@ -98,6 +109,11 @@ class StoreCheckpointer:
 
     def stop(self, final_checkpoint: bool = True) -> None:
         self._stop.set()
+        if self._thread is not None:
+            # an in-flight periodic checkpoint must not finish AFTER the
+            # final one and clobber it with older state
+            self._thread.join(timeout=30.0)
+            self._thread = None
         if final_checkpoint:
             try:
                 self.checkpoint()

@@ -262,8 +262,11 @@ class ObjectStore:
             if not self._journal_cond.wait_for(
                     lambda: self._rv > rv, timeout=timeout):
                 return [], self._rv, False
-            if self._journal and self._journal[0][0] > rv + 1:
-                return [], self._rv, True   # gap: journal rolled past rv
+            if not self._journal or self._journal[0][0] > rv + 1:
+                # gap: the journal cannot prove coverage of rv+1 (rolled
+                # past it, or cleared by a snapshot restore) — the caller
+                # must re-list
+                return [], self._rv, True
             # journal rvs are contiguous (every rv bump appends exactly one
             # entry), so the slice start is an O(1) offset, not a scan
             start = max(0, rv + 1 - self._journal[0][0]) if self._journal \

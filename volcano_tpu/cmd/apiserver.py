@@ -54,8 +54,7 @@ def main(argv=None) -> int:
         from ..apiserver.persistence import StoreCheckpointer, load_store
         snapshot = _os.path.join(args.data_dir, "snapshot.json")
         if _os.path.exists(snapshot):
-            load_store(snapshot, store)
-            total = sum(len(v) for v in store._objects.values())
+            _, total = load_store(snapshot, store)
             print(f"restored {total} objects from {snapshot}", flush=True)
         checkpointer = StoreCheckpointer(store, snapshot,
                                          interval=args.checkpoint_interval)
@@ -88,6 +87,9 @@ def main(argv=None) -> int:
             _signal.signal(sig, _graceful)
     stop.wait()
     if checkpointer is not None:
+        # stop accepting writes BEFORE the final checkpoint: an acked
+        # write landing after the last save would be lost on restart
+        server.stop()
         checkpointer.stop(final_checkpoint=True)   # durable shutdown
     return 0
 
