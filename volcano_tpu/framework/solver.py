@@ -400,6 +400,8 @@ class BatchSolver:
         result = PlacementResult(batch=batch, committed={}, kept={},
                                  placements={}, unplaced={})
         unplaced_records: List[Tuple[JobInfo, TaskInfo, int]] = []
+        all_tasks = batch.tasks
+        task_group_np = batch.task_group
         for job, jtasks in ordered_jobs:
             j = uid_to_j.get(job.uid, -1)
             if not jtasks or j < 0:
@@ -412,17 +414,26 @@ class BatchSolver:
                 was_kept = bool(kept_np[j])
             result.committed[job.uid] = ok
             result.kept[job.uid] = was_kept
-            placements, unplaced = [], []
-            for t_idx in range(batch.job_task_start[j], batch.job_task_end[j]):
-                task = batch.tasks[t_idx]
-                node_i = int(assign[t_idx])
-                if (ok or was_kept) and node_i >= 0:
-                    placements.append(Placement(task, narr.names[node_i],
-                                                bool(pipelined_np[t_idx])))
-                else:
-                    unplaced.append(task)
-                    unplaced_records.append(
-                        (job, task, int(batch.task_group[t_idx])))
+            start = int(batch.job_task_start[j])
+            end = int(batch.job_task_end[j])
+            span = assign[start:end]
+            if ok or was_kept:
+                placed_rel = np.flatnonzero(span >= 0)
+                pipe_span = pipelined_np[start:end]
+                names = narr.names
+                placements = [
+                    Placement(all_tasks[start + k], names[span[k]],
+                              bool(pipe_span[k]))
+                    for k in placed_rel]
+                unplaced_rel = np.flatnonzero(span < 0)
+            else:
+                placements = []
+                unplaced_rel = np.arange(end - start)
+            unplaced = [all_tasks[start + k] for k in unplaced_rel]
+            for k in unplaced_rel:
+                unplaced_records.append(
+                    (job, all_tasks[start + k],
+                     int(task_group_np[start + k])))
             result.placements[job.uid] = placements
             result.unplaced[job.uid] = unplaced
         if unplaced_records:
