@@ -429,11 +429,12 @@ class BatchSolver:
             else:
                 placements = []
                 unplaced_rel = np.arange(end - start)
-            unplaced = [all_tasks[start + k] for k in unplaced_rel]
+            unplaced = []
             for k in unplaced_rel:
+                task = all_tasks[start + k]
+                unplaced.append(task)
                 unplaced_records.append(
-                    (job, all_tasks[start + k],
-                     int(task_group_np[start + k])))
+                    (job, task, int(task_group_np[start + k])))
             result.placements[job.uid] = placements
             result.unplaced[job.uid] = unplaced
         if unplaced_records:
