@@ -82,6 +82,10 @@ class ReclaimAction(Action):
             job = jobs.pop(0)
             tasks = preemptor_tasks.get(job.uid)
             if not tasks:
+                # reference-exact: a popped job with no tasks left drops
+                # the queue from this cycle's rotation (reclaim.go:107-111
+                # continues without re-pushing) — its siblings reclaim in
+                # subsequent cycles
                 continue
             task = tasks.pop(0)
 
