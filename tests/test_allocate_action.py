@@ -207,3 +207,26 @@ class TestAllocate:
         h.add("pods", build_pod("ns1", "p0", "", "Pending", RL2, "pg1"))
         h.run_actions("allocate").close_session()
         assert h.binds == {}
+
+
+def test_namespace_round_robin_interleaves_contended_queue():
+    """Two namespaces sharing one queue under contention must split the
+    capacity (allocate.go:123-139 namespace turns), not first-namespace-
+    takes-all."""
+    h = Harness(CONF)
+    h.add("queues", build_queue("default", weight=1))
+    # room for exactly 4 single-task gangs
+    h.add("nodes", build_node("n0", {"cpu": "4", "memory": "8Gi"}))
+    for ns in ("aaa", "bbb"):
+        for j in range(4):
+            h.add("podgroups", build_pod_group(f"{ns}-{j}", ns, "default", 1,
+                                               phase=PodGroupPhase.INQUEUE))
+            h.add("pods", build_pod(ns, f"{ns}-{j}-t", "", "Pending",
+                                    build_resource_list("1", "1Gi"),
+                                    f"{ns}-{j}"))
+    h.run_actions("enqueue", "allocate").close_session()
+    by_ns = {"aaa": 0, "bbb": 0}
+    for key in h.binds:
+        by_ns[key.split("/")[0]] += 1
+    assert sum(by_ns.values()) == 4
+    assert by_ns["aaa"] == 2 and by_ns["bbb"] == 2, by_ns

@@ -54,22 +54,35 @@ class AllocateAction(Action):
                 .setdefault(job.queue, []).append(job)
 
         import functools
+        import itertools
         ns_sorted = sorted(
             jobs_by_ns_queue,
             key=functools.cmp_to_key(
                 lambda a, b: -1 if ssn.namespace_order_fn(a, b) else 1))
+        job_key = functools.cmp_to_key(
+            lambda a, b: -1 if ssn.job_order_fn(a, b) else 1)
 
+        qnames = {q for per_q in jobs_by_ns_queue.values() for q in per_q}
+        queues = [ssn.queues[q] for q in qnames
+                  if not ssn.overused(ssn.queues[q])]
+        queues.sort(key=functools.cmp_to_key(
+            lambda a, b: -1 if ssn.queue_order_fn(a, b) else 1))
+
+        # namespace round-robin within each queue (allocate.go:123-139:
+        # the reference pops one job per namespace turn, namespaces by
+        # NamespaceOrder): the kernel re-orders queues dynamically by live
+        # share and breaks within-queue ties by encode order, so the
+        # interleaved encoding realizes namespace fairness
         ordered: List[JobInfo] = []
-        for ns in ns_sorted:
-            queues = [ssn.queues[q] for q in jobs_by_ns_queue[ns]
-                      if not ssn.overused(ssn.queues[q])]
-            queues.sort(key=functools.cmp_to_key(
-                lambda a, b: -1 if ssn.queue_order_fn(a, b) else 1))
-            for q in queues:
-                jobs = jobs_by_ns_queue[ns][q.name]
-                jobs.sort(key=functools.cmp_to_key(
-                    lambda a, b: -1 if ssn.job_order_fn(a, b) else 1))
-                ordered.extend(jobs)
+        for q in queues:
+            per_ns = []
+            for ns in ns_sorted:
+                jobs = jobs_by_ns_queue[ns].get(q.name)
+                if jobs:
+                    jobs.sort(key=job_key)
+                    per_ns.append(jobs)
+            for round_jobs in itertools.zip_longest(*per_ns):
+                ordered.extend(j for j in round_jobs if j is not None)
         return ordered
 
     def _pending_tasks(self, ssn, job: JobInfo) -> List[TaskInfo]:

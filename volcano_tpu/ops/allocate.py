@@ -27,10 +27,12 @@ All of that happens inside one ``lax.scan``:
   plugin's Overused gate) stop being selected, at job granularity, exactly
   like allocate.go:141-146.
 
-Known divergence from the reference: namespaces are not round-robined as a
-separate outer priority queue (allocate.go:123-139); queue selection is
-global with ties broken by encode order. Namespace-fair ordering only
-changes outcomes when multiple namespaces share a queue under contention.
+Namespace fairness (allocate.go:123-139's outer namespace priority
+queue) is realized at encode time: the allocate action interleaves each
+queue's jobs round-robin across namespaces (actions/allocate.py
+_ordered_jobs), and the kernel breaks within-queue ties by encode order.
+Remaining divergence: the reference re-orders namespaces by live weighted
+share between turns; the interleave uses the session-open namespace order.
 """
 
 from __future__ import annotations
