@@ -34,6 +34,22 @@ class PreemptAction(Action):
         return "preempt"
 
     def execute(self, ssn) -> None:
+        # metric updates are lock round-trips; accumulate per action and
+        # flush once (gauge keeps last-set semantics, counter the total)
+        self._attempts = 0
+        self._last_victims = -1
+        try:
+            self._execute(ssn)
+        finally:
+            if self._attempts:
+                m.inc(m.PREEMPTION_ATTEMPTS, float(self._attempts))
+            if self._last_victims >= 0:
+                m.set_gauge(m.PREEMPTION_VICTIMS, self._last_victims)
+
+    def _note_victims(self, victims) -> None:
+        self._last_victims = len(victims)
+
+    def _execute(self, ssn) -> None:
         preemptors_map: Dict[str, List[JobInfo]] = {}   # queue -> jobs
         preemptor_tasks: Dict[str, List[TaskInfo]] = {}  # job uid -> tasks
         under_request: List[JobInfo] = []
@@ -126,9 +142,8 @@ class PreemptAction(Action):
     def _preempt(self, ssn, ctx: PreemptContext, stmt: Statement,
                  preemptor: TaskInfo, mode: str) -> bool:
         """One preemptor placement (preempt.go:192-271)."""
-        res = ctx.place(preemptor, mode,
-                        victim_cb=lambda v: m.update_preemption_victims(len(v)))
-        m.register_preemption_attempt()
+        res = ctx.place(preemptor, mode, victim_cb=self._note_victims)
+        self._attempts += 1
         if res is None:
             return False
         node_name, victims, _covered = res

@@ -370,6 +370,53 @@ class SchedulerCache(EventHandlersMixin):
                                         "Evict", reason)
         self._submit(do_evict)
 
+    def evict_batch(self, items) -> None:
+        """Evict ``[(task_info, reason)]`` under one mutex pass with a
+        single executor submission (the per-statement form of :meth:`evict`
+        — preempt/reclaim commit hundreds of statements, and per-evict
+        mutex + submission wakeups dominate the action's tail).
+
+        Tasks whose job/task/node lookup fails are skipped, matching the
+        per-task commit path's KeyError swallow."""
+        staged = []
+        with self.mutex:
+            for task_info, reason in items:
+                try:
+                    job, task = self._find_job_and_task(task_info)
+                except KeyError:
+                    continue
+                node = self.nodes.get(task.node_name)
+                if node is None:
+                    continue
+                original = task.status
+                job.move_task_status(task, TaskStatus.Releasing)
+                try:
+                    node.transition_task(task)
+                except RuntimeError:
+                    # node-side accounting refused the flip (drifted clone):
+                    # roll back and reconcile from the store rather than
+                    # silently skipping — the session already assumes this
+                    # eviction happened
+                    job.move_task_status(task, original)
+                    logging.getLogger(__name__).exception(
+                        "evict_batch: node accounting rejected %s; "
+                        "scheduling resync", task.uid)
+                    self.resync_task(task)
+                    continue
+                staged.append((task, task.pod, job.pod_group, reason))
+
+        def do_evict_all():
+            for task, pod, pod_group, reason in staged:
+                try:
+                    self.evictor.evict(pod, reason)
+                except Exception:
+                    self.resync_task(task)
+                if pod_group is not None:
+                    self.store.record_event("podgroups", pod_group,
+                                            "Normal", "Evict", reason)
+        if staged:
+            self._submit(do_evict_all)
+
     # -- resync (cache.go:768-791) ----------------------------------------
 
     def resync_task(self, task: TaskInfo) -> None:

@@ -120,6 +120,7 @@ class Session:
         for m in _FN_MAPS:
             setattr(self, m, {})
         self._enabled_fns_cache: Dict[str, list] = {}
+        self._victims_chain_cache: Dict[str, list] = {}
         # TPU batch solver context, populated by open_session
         self.solver = None
 
@@ -130,6 +131,7 @@ class Session:
     def _add(self, map_name: str, plugin_name: str, fn) -> None:
         getattr(self, map_name)[plugin_name] = fn
         self._enabled_fns_cache.pop(map_name, None)
+        self._victims_chain_cache.pop(map_name, None)
 
     def add_job_order_fn(self, name, fn): self._add("job_order_fns", name, fn)
     def add_queue_order_fn(self, name, fn): self._add("queue_order_fns", name, fn)
@@ -265,16 +267,18 @@ class Session:
         abstaining plugins skip; an empty candidate set (or an empty
         intersection) vetoes the tier and dispatch falls through to the next
         tier; the first tier producing a non-empty set decides."""
-        for ti, tier in enumerate(self.tiers):
+        chain = self._victims_chain_cache.get(map_name)
+        if chain is None:
+            # [(tier_index, [fn, ...])] — resolved once; fn maps are fixed
+            # after OnSessionOpen (same contract as _enabled_fns)
+            by_tier: Dict[int, list] = {}
+            for ti, _, fn in self._enabled_fns(map_name):
+                by_tier.setdefault(ti, []).append(fn)
+            chain = sorted(by_tier.items())
+            self._victims_chain_cache[map_name] = chain
+        for ti, fns in chain:
             victims: Optional[list] = None
-            flag = _ENABLE_FOR[map_name]
-            fns = getattr(self, map_name)
-            for opt in tier.plugins:
-                if not opt.is_enabled(flag):
-                    continue
-                fn = fns.get(opt.name)
-                if fn is None:
-                    continue
+            for fn in fns:
                 candidates, abstain = fn(claimer, claimees)
                 if abstain == ABSTAIN:
                     continue

@@ -216,12 +216,16 @@ class BatchSolver:
                                  jnp.asarray(narr.capability), eps)
         gmask = jnp.asarray(narr.valid)[None, :] & fit_cap
         if self.enable_default_predicates:
-            gmask = gmask & selector_mask(
-                jnp.asarray(feats.node_pairs),
-                jnp.asarray(feats.group_requires),
-                jnp.asarray(feats.group_require_counts))
-            gmask = gmask & taint_mask(jnp.asarray(feats.node_taints),
-                                       jnp.asarray(feats.group_tolerates))
+            # all-trivial features (no selectors / no taints anywhere) make
+            # these masks all-ones: skip the [G, N] matmuls + transfers
+            if feats.group_require_counts.any():
+                gmask = gmask & selector_mask(
+                    jnp.asarray(feats.node_pairs),
+                    jnp.asarray(feats.group_requires),
+                    jnp.asarray(feats.group_require_counts))
+            if feats.node_taints.any():
+                gmask = gmask & taint_mask(jnp.asarray(feats.node_taints),
+                                           jnp.asarray(feats.group_tolerates))
             if feats.group_affinity_ok is not None:
                 gmask = gmask & jnp.asarray(feats.group_affinity_ok)
 
@@ -270,10 +274,14 @@ class BatchSolver:
             gmask &= batch.group_req[:, c:c + 1] <= \
                 (narr.capability[None, :, c] + eps[c])
         if self.enable_default_predicates:
-            got = feats.group_requires @ feats.node_pairs.T
-            gmask &= got >= feats.group_require_counts[:, None] - 0.5
-            violations = (1.0 - feats.group_tolerates) @ feats.node_taints.T
-            gmask &= violations < 0.5
+            # KEEP IN SYNC with _build_context's trivial-feature skips
+            if feats.group_require_counts.any():
+                got = feats.group_requires @ feats.node_pairs.T
+                gmask &= got >= feats.group_require_counts[:, None] - 0.5
+            if feats.node_taints.any():
+                violations = (1.0 - feats.group_tolerates) @ \
+                    feats.node_taints.T
+                gmask &= violations < 0.5
             if feats.group_affinity_ok is not None:
                 gmask &= feats.group_affinity_ok
         for fn in self.mask_fns:

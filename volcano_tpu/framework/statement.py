@@ -46,8 +46,8 @@ class Statement:
         node = self.ssn.nodes.get(reclaimee.node_name)
         if node is None:
             raise KeyError(f"failed to find node {reclaimee.node_name}")
-        job.update_task_status(reclaimee, TaskStatus.Releasing)
-        node.update_task(reclaimee)
+        job.move_task_status(reclaimee, TaskStatus.Releasing)
+        node.transition_task(reclaimee)
         self.ssn._fire_deallocate(reclaimee)
         self.operations.append(_Operation("evict", reclaimee, reason))
 
@@ -55,9 +55,9 @@ class Statement:
         job = self.ssn.jobs.get(reclaimee.job)
         node = self.ssn.nodes.get(reclaimee.node_name)
         if job is not None:
-            job.update_task_status(reclaimee, TaskStatus.Running)
+            job.move_task_status(reclaimee, TaskStatus.Running)
         if node is not None:
-            node.update_task(reclaimee)
+            node.transition_task(reclaimee)
         self.ssn._fire_allocate(reclaimee)
 
     # -- pipeline (statement.go:136-230) ----------------------------------
@@ -235,16 +235,27 @@ class Statement:
         self.operations = []
 
     def commit(self) -> None:
-        """Replay staged operations against the cache."""
+        """Replay staged operations against the cache. Consecutive evicts
+        dispatch as one ``cache.evict_batch`` (one mutex pass + one executor
+        submission; order within the statement is preserved)."""
         ops, self.operations = self.operations, []
+        evicts: List[_Operation] = []
+
+        def flush_evicts() -> None:
+            if not evicts:
+                return
+            if self.ssn.cache is not None:
+                self.ssn.cache.evict_batch(
+                    [(e.task, e.reason) for e in evicts])
+            evicts.clear()
+
         for op in ops:
             if op.name == "evict":
                 if self.ssn.cache is not None:
-                    try:
-                        self.ssn.cache.evict(op.task, op.reason)
-                    except KeyError:
-                        pass
-            elif op.name == "pipeline":
+                    evicts.append(op)
+                continue
+            flush_evicts()
+            if op.name == "pipeline":
                 pass  # session-state only until resources actually release
             elif op.name == "allocate":
                 try:
@@ -253,3 +264,4 @@ class Statement:
                     pass
             elif op.name == "batch":
                 self._commit_batch(op)
+        flush_evicts()

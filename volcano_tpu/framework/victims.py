@@ -165,7 +165,26 @@ class VictimIndex:
     def node_candidates(self, i: int, mode: str, pj: int, pq: int):
         """(tasks, res rows) of alive filter-passing candidates on node i,
         eviction order preserved."""
-        s, e = self.node_start[i], self.node_start[i + 1]
+        s, e = int(self.node_start[i]), int(self.node_start[i + 1])
+        if e - s <= 8:
+            # tiny segment (the common case: a handful of running tasks per
+            # node): plain-Python filtering beats seven numpy dispatches
+            rows = []
+            for v in range(s, e):
+                if not self.alive[v]:
+                    continue
+                jv, qv = self.job_of[v], self.queue_of[v]
+                if mode == INTER_JOB:
+                    if qv != pq or jv == pj:
+                        continue
+                elif mode == INTRA_JOB:
+                    if jv != pj:
+                        continue
+                else:
+                    if qv == pq or not self.q_reclaimable[qv]:
+                        continue
+                rows.append(v)
+            return [self.tasks[v] for v in rows], self.res[rows]
         sel = self.alive[s:e].copy()
         jseg = self.job_of[s:e]
         qseg = self.queue_of[s:e]
@@ -254,6 +273,13 @@ class PreemptContext:
         # exact re-tests at visit time catching staleness the other way
         self._walk_key: Optional[tuple] = None
         self._walk_masked: Optional[np.ndarray] = None
+        # shared descending-score visit order per score key: scores are
+        # action-invariant (see _score_cache), so one stable argsort serves
+        # every walk with that key — the pointer walk below replaces a
+        # masked argmax per visited node (~N floats per visit at 10k nodes)
+        self._order_cache: Dict[object, np.ndarray] = {}
+        self._walk_order: Optional[np.ndarray] = None
+        self._walk_ptr: int = 0
         enabled = set()
         for tier in ssn.tiers:
             for opt in tier.plugins:
@@ -294,6 +320,8 @@ class PreemptContext:
         self._persistent_reject.clear()
         self._walk_key = None
         self._walk_masked = None
+        self._walk_order = None
+        self._walk_ptr = 0
 
     def mark_dead(self, victim: TaskInfo) -> None:
         """Drop a victim from the candidate index without any node-state
@@ -400,6 +428,19 @@ class PreemptContext:
             # per-node staleness is re-tested at visit below
             masked = self._walk_masked
         else:
+            if use_cache:
+                # invalidate any prior resume state up front: the early
+                # returns below must not leave a stale key paired with
+                # another walk's order/masked
+                self._walk_key = None
+                self._walk_masked = None
+                # descending-score visit order, shared across walks with
+                # this score key (stable sort == argmax's first-index
+                # tie-break); dead/rejected nodes are skipped via masked
+                order = self._order_cache.get(skey)
+                if order is None:
+                    order = np.argsort(-score, kind="stable")
+                    self._order_cache[skey] = order
             pods_ok = (self.max_tasks == 0) | (self.n_tasks < self.max_tasks)
             mask = self.gmask[g] & pods_ok
             mask[n_real:] = False
@@ -432,15 +473,35 @@ class PreemptContext:
                 return None
             masked = np.where(visit_ok, score, -np.inf)
             if use_cache:
+                # seek past the already-consumed/-rejected prefix in one
+                # vector op — per-position Python stepping is O(jobs x
+                # consumed) across the action
+                self._walk_order = order
+                self._walk_ptr = int(np.argmax(masked[order] != -np.inf))
                 self._walk_key, self._walk_masked = key, masked
 
         select = ssn.reclaimable if mode == CROSS_QUEUE else ssn.preemptable
-        # lazy best-first walk: one masked argmax per visited node instead
-        # of a full argsort — the first node usually wins
+        # lazy best-first walk. use_cache: pointer sweep over the shared
+        # descending-score order (each position consumed once per job; a
+        # winning node holds its position so the job's next task re-tests
+        # it). CROSS_QUEUE: masked argmax per visit (no resumable state —
+        # the caller applies evictions between calls).
+        neg_inf = -np.inf
+        order = self._walk_order if use_cache else None
+        n_order = len(order) if order is not None else 0
         while True:
-            i = int(np.argmax(masked))
-            if masked[i] == -np.inf:
-                break
+            if use_cache:
+                ptr = self._walk_ptr
+                while ptr < n_order and masked[order[ptr]] == neg_inf:
+                    ptr += 1
+                self._walk_ptr = ptr
+                if ptr >= n_order:
+                    break
+                i = int(order[ptr])
+            else:
+                i = int(np.argmax(masked))
+                if masked[i] == neg_inf:
+                    break
             masked[i] = -np.inf
             if self.max_tasks[i] and self.n_tasks[i] >= self.max_tasks[i]:
                 continue   # pod-slot cap re-test (stale on a resumed walk)
@@ -460,6 +521,26 @@ class PreemptContext:
             # reclaim_prefix kernel semantics, ops/preempt.py)
             uid_pos = {t.uid: v for v, t in enumerate(cands)}
             victims.sort(key=lambda t: uid_pos[t.uid])
+            if mode != CROSS_QUEUE and len(victims) <= 4:
+                # scalar prefix walk: at 1-4 victims (the common shape) the
+                # np.stack/cumsum/all formulation is five array dispatches
+                # for a handful of floats
+                fut = self.future[i]
+                run = [float(fut[c]) for c in range(self.rindex.r)]
+                k = -1
+                for p in range(len(victims) + 1):
+                    if all(req[c] <= run[c] + self.eps[c]
+                           for c in range(self.rindex.r)):
+                        k = p
+                        break
+                    if p < len(victims):
+                        row = res[uid_pos[victims[p].uid]]
+                        for c in range(self.rindex.r):
+                            run[c] += float(row[c])
+                if k < 0:
+                    continue
+                masked[i] = score[i]
+                return self.narr.names[i], victims[:k], True
             vres = np.stack([res[uid_pos[t.uid]] for t in victims])
             if mode == CROSS_QUEUE:
                 if not np.all(req <= self.future[i] + vres.sum(axis=0)

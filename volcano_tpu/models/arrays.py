@@ -388,11 +388,13 @@ class PredicateFeatures:
 
         f_pad = bucket(max(1, len(pair_ids)), 8)
         node_pairs = np.zeros((n_pad, f_pad), np.float32)
-        for name, i in node_arrays.name_to_idx.items():
-            labels = nodes[name].node.metadata.labels if nodes[name].node else {}
-            for (k, v), pid in pair_ids.items():
-                if labels.get(k) == v:
-                    node_pairs[i, pid] = 1.0
+        if pair_ids:   # no referenced pairs -> skip the 10k-node label sweep
+            for name, i in node_arrays.name_to_idx.items():
+                labels = nodes[name].node.metadata.labels \
+                    if nodes[name].node else {}
+                for (k, v), pid in pair_ids.items():
+                    if labels.get(k) == v:
+                        node_pairs[i, pid] = 1.0
 
         group_requires = np.zeros((g_pad, f_pad), np.float32)
         for g, pids in enumerate(group_pairs):

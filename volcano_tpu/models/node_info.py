@@ -214,6 +214,28 @@ class NodeInfo:
         self.remove_task(ti)
         self.add_task(ti)
 
+    def transition_task(self, ti: TaskInfo) -> None:
+        """Status-only transition for a task already on this node.
+
+        Equivalent to :meth:`update_task` but applies the accounting
+        *delta* for the Running<->Releasing flip (the preempt/reclaim
+        eviction pair) instead of fully reversing and replaying six
+        Resource ops plus a task clone — idle/used cancel out, only
+        ``releasing`` moves (node_info.go:388-420 replayed pairwise)."""
+        stored = self.tasks.get(ti.key())
+        if stored is None or self.node is None:
+            self.update_task(ti)
+            return
+        old, new = stored.status, ti.status
+        if old == TaskStatus.Running and new == TaskStatus.Releasing:
+            self.releasing.add(stored.resreq)
+        elif old == TaskStatus.Releasing and new == TaskStatus.Running:
+            self.releasing.sub(stored.resreq)
+        elif old != new:
+            self.update_task(ti)
+            return
+        stored.status = new
+
     def set_node(self, node: Node) -> None:
         """Re-ingest node object, rebasing Idle on allocatable minus current
         usage (node_info.go:291-327)."""
