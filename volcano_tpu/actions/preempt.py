@@ -34,22 +34,20 @@ class PreemptAction(Action):
         return "preempt"
 
     def execute(self, ssn) -> None:
-        # metric updates are lock round-trips; accumulate per action and
-        # flush once (gauge keeps last-set semantics, counter the total)
-        self._attempts = 0
-        self._last_victims = -1
+        # metric updates are lock round-trips; accumulate per execution and
+        # flush once (gauge keeps last-set semantics, counter the total).
+        # Local state, not attributes: the registered action instance is a
+        # process-global singleton.
+        stats = {"attempts": 0, "last_victims": -1}
         try:
-            self._execute(ssn)
+            self._execute(ssn, stats)
         finally:
-            if self._attempts:
-                m.inc(m.PREEMPTION_ATTEMPTS, float(self._attempts))
-            if self._last_victims >= 0:
-                m.set_gauge(m.PREEMPTION_VICTIMS, self._last_victims)
+            if stats["attempts"]:
+                m.inc(m.PREEMPTION_ATTEMPTS, float(stats["attempts"]))
+            if stats["last_victims"] >= 0:
+                m.set_gauge(m.PREEMPTION_VICTIMS, stats["last_victims"])
 
-    def _note_victims(self, victims) -> None:
-        self._last_victims = len(victims)
-
-    def _execute(self, ssn) -> None:
+    def _execute(self, ssn, stats) -> None:
         preemptors_map: Dict[str, List[JobInfo]] = {}   # queue -> jobs
         preemptor_tasks: Dict[str, List[TaskInfo]] = {}  # job uid -> tasks
         under_request: List[JobInfo] = []
@@ -103,7 +101,7 @@ class PreemptAction(Action):
                     if not tasks:
                         break
                     preemptor = tasks.pop(0)
-                    if self._preempt(ssn, ctx, stmt, preemptor, INTER_JOB):
+                    if self._preempt(ssn, ctx, stmt, preemptor, INTER_JOB, stats):
                         assigned = True
 
                 if ssn.job_pipelined(preemptor_job):
@@ -123,7 +121,7 @@ class PreemptAction(Action):
                 preemptor = tasks.pop(0)
                 stmt = Statement(ssn)
                 ctx.checkpoint()
-                assigned = self._preempt(ssn, ctx, stmt, preemptor, INTRA_JOB)
+                assigned = self._preempt(ssn, ctx, stmt, preemptor, INTRA_JOB, stats)
                 stmt.commit()
                 ctx.commit()
                 if not assigned:
@@ -140,10 +138,14 @@ class PreemptAction(Action):
         return tasks
 
     def _preempt(self, ssn, ctx: PreemptContext, stmt: Statement,
-                 preemptor: TaskInfo, mode: str) -> bool:
+                 preemptor: TaskInfo, mode: str, stats) -> bool:
         """One preemptor placement (preempt.go:192-271)."""
-        res = ctx.place(preemptor, mode, victim_cb=self._note_victims)
-        self._attempts += 1
+
+        def note(victims):
+            stats["last_victims"] = len(victims)
+
+        res = ctx.place(preemptor, mode, victim_cb=note)
+        stats["attempts"] += 1
         if res is None:
             return False
         node_name, victims, _covered = res

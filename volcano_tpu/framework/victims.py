@@ -280,6 +280,14 @@ class PreemptContext:
         self._order_cache: Dict[object, np.ndarray] = {}
         self._walk_order: Optional[np.ndarray] = None
         self._walk_ptr: int = 0
+        # per-group predicate-row hash: lets walks key on CONTENT so
+        # consecutive preemptor jobs with identical (mode, request, queue,
+        # predicate row) and no own-job candidates share one walk state —
+        # sound under the same monotonicity that backs _persistent_reject
+        # (scores static; cover/caps/candidates only shrink; rollback
+        # clears the state)
+        self._gmask_hash: Dict[int, int] = {}
+        self._gmask_intern: Dict[bytes, int] = {}
         enabled = set()
         for tier in ssn.tiers:
             for opt in tier.plugins:
@@ -395,10 +403,21 @@ class PreemptContext:
         req = self.batch.group_req[g]
         n_real = len(self.narr.names)
         use_cache = mode != CROSS_QUEUE
-        # walk resume key: the group id encodes (job, task spec, request,
-        # scheduling constraints), so a resumed masked-score array can
-        # never leak one group's predicate mask to another
-        key = (mode, g)
+        # walk resume key: content-keyed when persistence is sound (see
+        # _gmask_hash) so identical consecutive jobs resume one walk; else
+        # the group id, which encodes (job, task spec, request, scheduling
+        # constraints) — a resumed masked-score array can never leak one
+        # group's predicate mask to another either way
+        if use_cache and self._persist_ok and self._static_trivial:
+            h = self._gmask_hash.get(g)
+            if h is None:
+                row = self.gmask[g].tobytes()
+                h = self._gmask_intern.setdefault(
+                    row, len(self._gmask_intern))
+                self._gmask_hash[g] = h
+            key = (mode, req.tobytes(), pj, pq, h)
+        else:
+            key = (mode, g)
         persist = None
         if use_cache and self._persist_ok:
             # keyed by (mode, request, preemptor job/queue codes), NOT by
