@@ -169,22 +169,146 @@ class AllocateAction(Action):
     # -- session application ----------------------------------------------
 
     def _stage(self, ssn, phase_a, result_a) -> Dict[str, Statement]:
-        """Stage phase-A placements into session state via per-job statements
-        (one batched staging pass per gang — Statement.allocate_batch)."""
+        """Stage phase-A placements into session state.
+
+        Phase-level bulk apply: placements are grouped per *node* across
+        all committed jobs (the kernel's spreading scorers land ~T/N tasks
+        per node, so per-gang node groups degenerate to singletons), fits
+        are validated upfront against each node's idle, and the node
+        accounting runs once per node instead of once per task. Each job
+        still gets its own Statement (commit/discard unchanged) and its
+        own batched plugin-event round. Jobs with volume-mounting tasks,
+        missing nodes, or any validation failure take the per-job
+        ``Statement.allocate_batch`` path, which re-validates from
+        scratch."""
         staged: Dict[str, Statement] = {}
-        for job, _ in phase_a:
+        slow: List = []    # (phase-A position, job, placements)
+        bulk: List = []    # (job, [(task, node, pipelined)])
+        pos_of: Dict[str, int] = {}
+        for pos, (job, _) in enumerate(phase_a):
             if not (result_a.committed[job.uid] or result_a.kept[job.uid]):
                 continue
+            pos_of[job.uid] = pos
+            pls = result_a.placements[job.uid]
+            items = []
+            for p in pls:
+                node = ssn.nodes.get(p.node_name)
+                if node is None:
+                    items = None
+                    break
+                items.append((p.task, node, p.pipelined))
+            if items is None:
+                slow.append((pos, job, pls))
+                continue
+            if ssn.cache is not None and \
+                    any(t.pod.spec.volumes for t, _, _ in items):
+                slow.append((pos, job, pls))
+                continue
+            bulk.append((job, items))
+
+        if bulk:
+            failed = self._stage_bulk(ssn, bulk, staged)
+            # fallbacks re-stage in phase-A priority order with the rest
+            slow.extend((pos_of[job.uid], job, pls) for job, pls in failed)
+            slow.sort(key=lambda e: e[0])
+
+        for _, job, pls in slow:
             stmt = Statement(ssn)
             try:
                 stmt.allocate_batch(
                     job, [(p.task, ssn.nodes[p.node_name], p.pipelined)
-                          for p in result_a.placements[job.uid]])
+                          for p in pls])
             except (KeyError, RuntimeError, AssertionError):
                 stmt.discard()
                 continue
             staged[job.uid] = stmt
         return staged
+
+    def _stage_bulk(self, ssn, bulk, staged: Dict[str, Statement]) -> List:
+        """Apply ``bulk`` = [(job, [(task, node, pipelined)])] with
+        per-node accounting. Returns the jobs that must retry on the
+        per-job path (as (job, placements-like) pairs rebuilt lazily).
+        On any unexpected apply failure everything staged here is undone
+        and ALL bulk jobs are returned for the per-job path."""
+        from ..models.resource import Resource, ZERO
+
+        # upfront fit validation per (node, allocated) group
+        groups: Dict[int, tuple] = {}
+        for job, items in bulk:
+            for task, node, pipelined in items:
+                key = (id(node), pipelined)
+                g = groups.get(key)
+                if g is None:
+                    g = (node, pipelined, [])
+                    groups[key] = g
+                g[2].append((task, job))
+        failed_uids = set()
+        for node, pipelined, entries in groups.values():
+            if pipelined or node.node is None:
+                continue
+            total = Resource()
+            for task, _ in entries:
+                total.add(task.resreq)
+            if not total.less_equal(node.idle, ZERO):
+                failed_uids.update(j.uid for _, j in entries)
+
+        moved: List = []   # (job, tasks, prior-status) applied status moves
+        added: List = []   # (node, pipelined, tasks) applied node adds
+        try:
+            ok_jobs = []
+            for job, items in bulk:
+                if job.uid in failed_uids:
+                    continue
+                alloc = [t for t, _, p in items if not p]
+                pipe = [t for t, _, p in items if p]
+                try:
+                    if alloc:
+                        job.move_tasks_status_bulk(alloc,
+                                                   TaskStatus.Allocated)
+                        moved.append((job, alloc))
+                    if pipe:
+                        job.move_tasks_status_bulk(pipe,
+                                                   TaskStatus.Pipelined)
+                        moved.append((job, pipe))
+                except KeyError:
+                    if alloc and moved and moved[-1][0] is job:
+                        moved.pop()
+                        job.move_tasks_status_bulk(alloc,
+                                                   TaskStatus.Pending)
+                    failed_uids.add(job.uid)
+                    continue
+                ok_jobs.append((job, items))
+            for node, pipelined, entries in groups.values():
+                tasks = [t for t, j in entries
+                         if j.uid not in failed_uids]
+                if not tasks:
+                    continue
+                node.add_tasks_bulk(tasks, pipelined)
+                added.append((node, pipelined, tasks))
+                if not pipelined:
+                    name = node.name
+                    for t in tasks:
+                        t.pod.spec.node_name = name
+        except BaseException:
+            # unexpected apply failure (pre-validated, so ~impossible):
+            # undo everything staged here and retry all jobs per-job
+            for node, pipelined, tasks in reversed(added):
+                for t in tasks:
+                    node.remove_task(t)
+                    t.node_name = ""
+                    if not pipelined:
+                        t.pod.spec.node_name = ""
+            for job, tasks in reversed(moved):
+                job.move_tasks_status_bulk(tasks, TaskStatus.Pending)
+            return [(job, [_P(t, n.name, p) for t, n, p in items])
+                    for job, items in bulk]
+
+        for job, items in ok_jobs:
+            stmt = Statement(ssn)
+            stmt.record_batch(job, items)
+            staged[job.uid] = stmt
+        return [(job, [_P(t, n.name, p) for t, n, p in items])
+                for job, items in bulk if job.uid in failed_uids]
 
     def _apply_extra(self, ssn, staged, result_b, phase_b) -> None:
         """Stage surplus placements onto the same statements."""
@@ -218,6 +342,18 @@ class AllocateAction(Action):
             else:
                 stmt.discard()
                 m.register_schedule_attempt("unschedulable")
+
+
+class _P:
+    """Minimal placement record (task, node_name, pipelined) for routing
+    bulk-stage fallbacks through the per-job path."""
+
+    __slots__ = ("task", "node_name", "pipelined")
+
+    def __init__(self, task, node_name, pipelined):
+        self.task = task
+        self.node_name = node_name
+        self.pipelined = pipelined
 
 
 class _ZeroMinJob:

@@ -88,14 +88,18 @@ def _run_cycle(cache, conf) -> float:
         gc.disable()
     try:
         t0 = time.perf_counter()
-        ssn = open_session(cache, conf.tiers, conf.configurations)
+        cache.begin_cycle()
         try:
-            for name in conf.actions:
-                action = get_action(name)
-                if action is not None:
-                    action.execute(ssn)
+            ssn = open_session(cache, conf.tiers, conf.configurations)
+            try:
+                for name in conf.actions:
+                    action = get_action(name)
+                    if action is not None:
+                        action.execute(ssn)
+            finally:
+                close_session(ssn)
         finally:
-            close_session(ssn)
+            cache.end_cycle()
         return (time.perf_counter() - t0) * 1000.0
     finally:
         if was_enabled:

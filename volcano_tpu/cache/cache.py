@@ -82,6 +82,22 @@ class SchedulerCache(EventHandlersMixin):
         self._exec_idle.set()
         self._exec_thread: Optional[threading.Thread] = None
         self._exec_stop = False
+        # write-behind cache mutations (bind/evict batch): the foreground
+        # commit only records what to apply; the per-task status moves and
+        # node accounting run on the executor (before the store writes they
+        # order) or at the next snapshot(), whichever comes first. Entries
+        # run exactly once, in submission order, under self.mutex.
+        self._pending_apply: deque = deque()
+        self._apply_lock = threading.Lock()
+        # cleared while a scheduling cycle is in flight: the executor backs
+        # off so its (GIL-bound) store writes don't contend with the
+        # cycle's host path — submitted work flushes in the schedule-period
+        # gap instead. The yield is bounded (2 s) and taken at most once
+        # per cycle generation, so back-to-back cycles can't starve the
+        # bind/evict backlog.
+        self._cycle_idle = threading.Event()
+        self._cycle_idle.set()
+        self._cycle_gen = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -162,6 +178,7 @@ class SchedulerCache(EventHandlersMixin):
     RESYNC_RETRY_SECONDS = 1.0
 
     def _exec_loop(self) -> None:
+        last_yield_gen = -1
         while True:
             # while reconciliations are pending, wake periodically even
             # with no new submissions (a stuck err_task must not wait for
@@ -190,6 +207,14 @@ class SchedulerCache(EventHandlersMixin):
                             self._exec_idle.set()
                             break
                     continue
+                # yield to a live cycle — once per cycle generation, so
+                # long or back-to-back cycles delay the backlog by at most
+                # 2 s each rather than 2 s per queued item
+                if not self._cycle_idle.is_set():
+                    gen = self._cycle_gen
+                    if gen != last_yield_gen:
+                        self._cycle_idle.wait(timeout=2.0)
+                        last_yield_gen = gen
                 try:
                     fn()   # submitted fns resync their own expected errors
                 except Exception:
@@ -210,12 +235,46 @@ class SchedulerCache(EventHandlersMixin):
                 target=self._exec_loop, daemon=True, name="cache-executor")
             self._exec_thread.start()
 
+    def begin_cycle(self) -> None:
+        """Mark a scheduling cycle in flight: the executor backs off so
+        background store writes don't contend with the cycle's host path."""
+        self._cycle_gen += 1
+        self._cycle_idle.clear()
+
+    def end_cycle(self) -> None:
+        self._cycle_idle.set()
+
     def flush_executors(self, timeout: float = 30.0) -> bool:
         """Block until all submitted bind/evict writes have executed."""
         return self._exec_idle.wait(timeout)
 
     def wait_for_cache_sync(self) -> bool:
         return self._running  # synchronous watches: always synced once run
+
+    # -- write-behind applies ----------------------------------------------
+
+    def _queue_apply(self, fn) -> bool:
+        """Queue a cache mutation for write-behind execution. Returns False
+        when no executor worker is live (inline mode) — the caller then
+        runs the mutation synchronously, preserving the pre-run() unit-test
+        semantics."""
+        with self._exec_lock:
+            if self._exec_thread is None:
+                return False
+        with self._apply_lock:
+            self._pending_apply.append(fn)
+        return True
+
+    def _drain_applies_locked(self) -> None:
+        """Run all pending write-behind mutations. Caller must hold
+        ``self.mutex``; pop+execute is atomic under it, so a drain that
+        finds the deque empty knows every prior apply has completed."""
+        while True:
+            with self._apply_lock:
+                if not self._pending_apply:
+                    return
+                fn = self._pending_apply.popleft()
+            fn()
 
     def client(self) -> ObjectStore:
         """The plugins'/actions' handle to the API (Cache.Client analogue)."""
@@ -228,6 +287,7 @@ class SchedulerCache(EventHandlersMixin):
         only jobs with a PodGroup and an existing queue; job priority resolved
         from PriorityClass here."""
         with self.mutex:
+            self._drain_applies_locked()
             snap = ClusterInfo()
             snap.node_list = list(self.node_list)
             for node in self.nodes.values():
@@ -300,16 +360,25 @@ class SchedulerCache(EventHandlersMixin):
         self._submit(do_bind)
 
     def bind_batch(self, pairs) -> list:
-        """Bind a whole gang: ``[(task_info, hostname)]`` under one mutex
-        pass with a single executor submission (the per-gang form of
-        ``bind``; cache.go:605-655 pays mutex + goroutine per task).
+        """Bind a whole gang: ``[(task_info, hostname)]`` with a single
+        executor submission (the per-gang form of ``bind``; cache.go:605-655
+        pays mutex + goroutine per task).
 
-        Tasks whose job/task/node lookup fails are skipped — the per-task
-        commit path swallows the same KeyError — and the accepted tasks
-        are returned so the caller can advance their session status."""
-        accepted = []
-        bound = []
-        with self.mutex:
+        Write-behind: with a live executor the foreground call only records
+        the pairs; the per-task cache mutations run on the executor ordered
+        before the store writes (FIFO), or at the next ``snapshot()`` if
+        that comes first. The return is then the full (optimistic) task
+        list — a task whose pod vanished mid-cycle is skipped at apply time
+        and reconverges from the store, matching the per-task commit path's
+        KeyError swallow. Inline mode (no worker; unit tests building the
+        cache by hand) keeps the synchronous accepted-list semantics."""
+        pairs = list(pairs)
+        if not pairs:
+            return []
+        accepted: list = []
+        bound: list = []
+
+        def apply():
             for task_info, hostname in pairs:
                 try:
                     job, task = self._find_job_and_task(task_info)
@@ -329,6 +398,8 @@ class SchedulerCache(EventHandlersMixin):
                 bound.append((task, task.pod, hostname))
 
         def do_bind_all():
+            with self.mutex:
+                self._drain_applies_locked()
             for task, pod, hostname in bound:
                 try:
                     self.binder.bind(pod, hostname)
@@ -338,8 +409,13 @@ class SchedulerCache(EventHandlersMixin):
                         f"{task.name} to {hostname}")
                 except Exception:
                     self.resync_task(task)
-        if bound:
+
+        if self._queue_apply(apply):
             self._submit(do_bind_all)
+            return [t for t, _ in pairs]
+        with self.mutex:
+            apply()
+        do_bind_all()
         return accepted
 
     def evict(self, task_info: TaskInfo, reason: str) -> None:
@@ -377,9 +453,16 @@ class SchedulerCache(EventHandlersMixin):
         mutex + submission wakeups dominate the action's tail).
 
         Tasks whose job/task/node lookup fails are skipped, matching the
-        per-task commit path's KeyError swallow."""
-        staged = []
-        with self.mutex:
+        per-task commit path's KeyError swallow. Write-behind like
+        :meth:`bind_batch`: with a live executor the cache mutations run on
+        the executor (before the pod deletes they order) or at the next
+        ``snapshot()``."""
+        items = list(items)
+        if not items:
+            return
+        staged: list = []
+
+        def apply():
             for task_info, reason in items:
                 try:
                     job, task = self._find_job_and_task(task_info)
@@ -406,6 +489,8 @@ class SchedulerCache(EventHandlersMixin):
                 staged.append((task, task.pod, job.pod_group, reason))
 
         def do_evict_all():
+            with self.mutex:
+                self._drain_applies_locked()
             for task, pod, pod_group, reason in staged:
                 try:
                     self.evictor.evict(pod, reason)
@@ -414,8 +499,13 @@ class SchedulerCache(EventHandlersMixin):
                 if pod_group is not None:
                     self.store.record_event("podgroups", pod_group,
                                             "Normal", "Evict", reason)
-        if staged:
+
+        if self._queue_apply(apply):
             self._submit(do_evict_all)
+            return
+        with self.mutex:
+            apply()
+        do_evict_all()
 
     # -- resync (cache.go:768-791) ----------------------------------------
 

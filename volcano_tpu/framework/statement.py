@@ -151,6 +151,18 @@ class Statement:
         if not fast:
             return
 
+        applied = self._stage_fast_seq(fast, keep_partial)
+        if applied:
+            ssn._fire_allocate_batch(job, [t for t, _, _ in applied])
+            self.operations.append(_BatchOperation(job, applied))
+
+    def _stage_fast_seq(self, fast, keep_partial: bool) -> list:
+        """Sequential per-task staging: all-or-nothing by default, prefix
+        (keep-partial) semantics on request. This is the fallback path —
+        the allocate action's phase-level bulk apply
+        (AllocateAction._stage_bulk) handles the hot case."""
+        ssn = self.ssn
+
         def undo(task, node, pipelined, registered: bool) -> None:
             """Revert one staged placement (add_task itself is atomic on
             error, so an unregistered task never touched the node)."""
@@ -186,9 +198,15 @@ class Statement:
             for task, node, pipelined in reversed(applied):
                 undo(task, node, pipelined, registered=True)
             raise failure
-        if applied:
-            ssn._fire_allocate_batch(job, [t for t, _, _ in applied])
-            self.operations.append(_BatchOperation(job, applied))
+        return applied
+
+    def record_batch(self, job, items) -> None:
+        """Register an externally staged gang (the allocate action's
+        phase-level bulk apply) for commit/discard: fires the batched
+        plugin events and appends the operation, exactly like
+        :meth:`allocate_batch` does after its own staging."""
+        self.ssn._fire_allocate_batch(job, [t for t, _, _ in items])
+        self.operations.append(_BatchOperation(job, items))
 
     def _unbatch(self, op: _BatchOperation) -> None:
         for task, node, pipelined in reversed(op.items):

@@ -321,6 +321,48 @@ class JobInfo:
         self.tasks[task.uid] = task
         self.task_status_index[status][task.uid] = task
 
+    def move_tasks_status_bulk(self, tasks: List[TaskInfo],
+                               status: TaskStatus) -> None:
+        """:meth:`move_task_status` over many registered tasks with the
+        allocated-resource flips accumulated into one Resource op pair and
+        a single index-version bump. Raises before any mutation if a task
+        is unknown (the bulk callers stage whole gangs all-or-nothing)."""
+        stored_list = []
+        for task in tasks:
+            stored = self.tasks.get(task.uid)
+            if stored is None:
+                raise KeyError(f"failed to find task <{task.namespace}/"
+                               f"{task.name}> in job "
+                               f"<{self.namespace}/{self.name}>")
+            stored_list.append(stored)
+        self._status_version += 1
+        now = allocated_status(status)
+        flip_add = None
+        flip_sub = None
+        new_idx = self.task_status_index[status]
+        for task, stored in zip(tasks, stored_list):
+            old = stored.status
+            idx = self.task_status_index[old]
+            idx.pop(task.uid, None)
+            if not idx and old != status:   # never drop the target index
+                del self.task_status_index[old]
+            was = allocated_status(old)
+            if was and not now:
+                if flip_sub is None:
+                    flip_sub = Resource()
+                flip_sub.add(stored.resreq)
+            elif now and not was:
+                if flip_add is None:
+                    flip_add = Resource()
+                flip_add.add(stored.resreq)
+            task.status = status
+            self.tasks[task.uid] = task
+            new_idx[task.uid] = task
+        if flip_add is not None:
+            self.allocated.add(flip_add)
+        if flip_sub is not None:
+            self.allocated.sub(flip_sub)
+
     def delete_task_info(self, ti: TaskInfo) -> None:
         self._status_version += 1
         task = self.tasks.get(ti.uid)

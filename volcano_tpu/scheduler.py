@@ -54,6 +54,10 @@ class Scheduler:
         try:
             with open(self._conf_path) as f:
                 new_conf = parse_scheduler_conf(f.read())
+            if not new_conf.actions:
+                # an empty document (e.g. the file read mid-rewrite) parses
+                # cleanly but is never a valid scheduler conf
+                raise ValueError("conf has no actions")
             for name in new_conf.actions:
                 if get_action(name) is None:
                     raise ValueError(f"unknown action {name!r}")
@@ -87,6 +91,9 @@ class Scheduler:
         gc_was_enabled = gc.isenabled()
         if gc_was_enabled:
             gc.disable()
+        begin = getattr(self.cache, "begin_cycle", None)
+        if begin is not None:
+            begin()
         try:
             ssn = open_session(self.cache, conf.tiers, conf.configurations)
             try:
@@ -99,6 +106,9 @@ class Scheduler:
             finally:
                 close_session(ssn)
         finally:
+            end = getattr(self.cache, "end_cycle", None)
+            if end is not None:
+                end()
             if gc_was_enabled:
                 gc.enable()
         m.update_e2e_duration(time.perf_counter() - start)
