@@ -232,28 +232,28 @@ class AllocateAction(Action):
         and ALL bulk jobs are returned for the per-job path."""
         from ..models.resource import Resource, ZERO
 
-        # upfront fit validation per (node, allocated) group
+        # upfront fit validation per (node, allocated) group; the group
+        # totals are kept and reused by add_tasks_bulk below
         groups: Dict[int, tuple] = {}
         for job, items in bulk:
             for task, node, pipelined in items:
                 key = (id(node), pipelined)
                 g = groups.get(key)
                 if g is None:
-                    g = (node, pipelined, [])
+                    g = (node, pipelined, [], Resource())
                     groups[key] = g
                 g[2].append((task, job))
+                g[3].add(task.resreq)
         failed_uids = set()
-        for node, pipelined, entries in groups.values():
+        for node, pipelined, entries, total in groups.values():
             if pipelined or node.node is None:
                 continue
-            total = Resource()
-            for task, _ in entries:
-                total.add(task.resreq)
             if not total.less_equal(node.idle, ZERO):
                 failed_uids.update(j.uid for _, j in entries)
 
         moved: List = []   # (job, tasks, prior-status) applied status moves
         added: List = []   # (node, pipelined, tasks) applied node adds
+        flips: Dict[str, Optional[Resource]] = {}   # job uid -> alloc sum
         try:
             ok_jobs = []
             for job, items in bulk:
@@ -263,8 +263,8 @@ class AllocateAction(Action):
                 pipe = [t for t, _, p in items if p]
                 try:
                     if alloc:
-                        job.move_tasks_status_bulk(alloc,
-                                                   TaskStatus.Allocated)
+                        flips[job.uid] = job.move_tasks_status_bulk(
+                            alloc, TaskStatus.Allocated)
                         moved.append((job, alloc))
                     if pipe:
                         job.move_tasks_status_bulk(pipe,
@@ -278,12 +278,16 @@ class AllocateAction(Action):
                     failed_uids.add(job.uid)
                     continue
                 ok_jobs.append((job, items))
-            for node, pipelined, entries in groups.values():
-                tasks = [t for t, j in entries
-                         if j.uid not in failed_uids]
+            for node, pipelined, entries, total in groups.values():
+                if any(j.uid in failed_uids for _, j in entries):
+                    tasks = [t for t, j in entries
+                             if j.uid not in failed_uids]
+                    total = None   # stale sum: includes dropped jobs
+                else:
+                    tasks = [t for t, _ in entries]
                 if not tasks:
                     continue
-                node.add_tasks_bulk(tasks, pipelined)
+                node.add_tasks_bulk(tasks, pipelined, total=total)
                 added.append((node, pipelined, tasks))
                 if not pipelined:
                     name = node.name
@@ -305,7 +309,11 @@ class AllocateAction(Action):
 
         for job, items in ok_jobs:
             stmt = Statement(ssn)
-            stmt.record_batch(job, items)
+            # the allocated-flip sum equals the gang total only when no
+            # task was pipelined (flip excludes Pipelined status)
+            total = flips.get(job.uid) \
+                if all(not p for _, _, p in items) else None
+            stmt.record_batch(job, items, total=total)
             staged[job.uid] = stmt
         return [(job, [_P(t, n.name, p) for t, n, p in items])
                 for job, items in bulk if job.uid in failed_uids]

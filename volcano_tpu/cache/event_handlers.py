@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..models import objects as obj
+from ..models.arrays import _group_sig
 from ..models.job_info import (JobInfo, TaskInfo, allocated_status,
                                get_job_id, is_terminated)
 from ..models.node_info import NodeInfo
@@ -33,6 +34,10 @@ class EventHandlersMixin:
         return self.jobs[ti.job]
 
     def _add_task(self, ti: TaskInfo) -> None:
+        # precompute the encode-group fingerprint at ingest (watch thread)
+        # so scheduling cycles inherit it through snapshot clones and the
+        # 50k-task encode loop is pure attribute reads
+        _group_sig(ti)
         if ti.node_name:
             if ti.node_name not in self.nodes:
                 # pods bound to unknown nodes create a placeholder so their
@@ -85,6 +90,7 @@ class EventHandlersMixin:
                 and allocated_status(cached.status)
                 and allocated_status(nt.status)
                 and cached.resreq.equal(nt.resreq)):
+            _group_sig(nt)   # re-derive eagerly (watch thread), off-cycle
             job.move_task_status(cached, nt.status)
             node = self.nodes.get(cached.node_name)
             for view in (cached,) if node is None else \
@@ -101,6 +107,7 @@ class EventHandlersMixin:
                 view.revocable_zone = nt.revocable_zone
                 view.topology_policy = nt.topology_policy
                 view.constraint_key_cache = nt.constraint_key_cache
+                view.group_sig_cache = nt.group_sig_cache
             return
         self._delete_task(TaskInfo(old))
         self.add_pod(new)

@@ -412,13 +412,15 @@ class Session:
             if eh.deallocate_func is not None:
                 eh.deallocate_func(Event(task))
 
-    def _fire_allocate_batch(self, job, tasks) -> None:
-        """One event round for a whole gang's placements."""
+    def _fire_allocate_batch(self, job, tasks, total=None) -> None:
+        """One event round for a whole gang's placements. ``total`` may be
+        passed by callers that already hold the gang's resource sum."""
         if not tasks:
             return
-        total = Resource()
-        for t in tasks:
-            total.add(t.resreq)
+        if total is None:
+            total = Resource()
+            for t in tasks:
+                total.add(t.resreq)
         for eh in self.event_handlers:
             if eh.batch_allocate_func is not None:
                 eh.batch_allocate_func(job, tasks, total)

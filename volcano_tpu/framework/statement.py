@@ -200,12 +200,13 @@ class Statement:
             raise failure
         return applied
 
-    def record_batch(self, job, items) -> None:
+    def record_batch(self, job, items, total=None) -> None:
         """Register an externally staged gang (the allocate action's
         phase-level bulk apply) for commit/discard: fires the batched
         plugin events and appends the operation, exactly like
-        :meth:`allocate_batch` does after its own staging."""
-        self.ssn._fire_allocate_batch(job, [t for t, _, _ in items])
+        :meth:`allocate_batch` does after its own staging. ``total`` may
+        carry the gang's precomputed resource sum."""
+        self.ssn._fire_allocate_batch(job, [t for t, _, _ in items], total)
         self.operations.append(_BatchOperation(job, items))
 
     def _unbatch(self, op: _BatchOperation) -> None:

@@ -26,6 +26,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import threading
+
 import numpy as np
 
 from .job_info import JobInfo, TaskInfo
@@ -170,6 +172,45 @@ class NodeArrays:
         return self.idle + self.releasing - self.pipelined
 
 
+_SIG_INTERN: Dict[tuple, int] = {}
+_SIG_LOCK = threading.Lock()
+_SIG_NEXT = 0                      # monotone: ids are never reused
+_SIG_INTERN_MAX = 1_000_000        # keys (incl. affinity reprs) are dropped
+#                                    past this; a re-interned key gets a NEW
+#                                    id, which can only split a group (safe),
+#                                    never merge two distinct ones
+
+
+def _group_sig(t: TaskInfo) -> int:
+    """Small-int intern of (task template, request, constraints): the
+    group identity of a task within its job, so the 50k-task encode loop
+    hashes two ints per task instead of a nested tuple-of-tuples.
+
+    Cached on the *Pod* object (not just the TaskInfo): session tasks are
+    fresh clones every cycle, but they share the cache's pod until an
+    update replaces it — exactly the lifetime over which all three key
+    parts are immutable. The TaskInfo-level cache then short-circuits
+    repeat encodes within one session (preempt/reclaim contexts)."""
+    sig = t.group_sig_cache
+    if sig is None:
+        pod = t.pod
+        sig = pod.__dict__.get("_sched_group_sig")
+        if sig is None:
+            global _SIG_NEXT
+            key = (t.task_id, _req_key(t), _constraint_key(t))
+            with _SIG_LOCK:
+                sig = _SIG_INTERN.get(key)
+                if sig is None:
+                    if len(_SIG_INTERN) >= _SIG_INTERN_MAX:
+                        _SIG_INTERN.clear()   # bound memory; ids stay unique
+                    sig = _SIG_NEXT
+                    _SIG_NEXT += 1
+                    _SIG_INTERN[key] = sig
+            pod._sched_group_sig = sig
+        t.group_sig_cache = sig
+    return sig
+
+
 def _constraint_key(t: TaskInfo) -> tuple:
     """Scheduling-constraint fingerprint for grouping: tasks with identical
     constraints share predicate masks. Cached on the TaskInfo (constraints
@@ -179,13 +220,20 @@ def _constraint_key(t: TaskInfo) -> tuple:
     if cached is not None:
         return cached
     spec = t.pod.spec
-    sel = tuple(sorted(spec.node_selector.items()))
-    tol = tuple(sorted((x.key, x.operator, x.value, x.effect)
-                       for x in spec.tolerations))
-    aff = repr(spec.affinity) if spec.affinity is not None else ""
-    key = (sel, tol, aff)
+    if not spec.node_selector and not spec.tolerations \
+            and spec.affinity is None:
+        key = _TRIVIAL_CONSTRAINT          # the overwhelmingly common shape
+    else:
+        sel = tuple(sorted(spec.node_selector.items()))
+        tol = tuple(sorted((x.key, x.operator, x.value, x.effect)
+                           for x in spec.tolerations))
+        aff = repr(spec.affinity) if spec.affinity is not None else ""
+        key = (sel, tol, aff)
     t.constraint_key_cache = key
     return key
+
+
+_TRIVIAL_CONSTRAINT = ((), (), "")
 
 
 def _req_key(t: TaskInfo) -> tuple:
@@ -193,7 +241,10 @@ def _req_key(t: TaskInfo) -> tuple:
     if cached is not None:
         return cached
     r = t.resreq
-    key = (r.milli_cpu, r.memory, tuple(sorted(r.scalars.items())))
+    if r.scalars:
+        key = (r.milli_cpu, r.memory, tuple(sorted(r.scalars.items())))
+    else:
+        key = (r.milli_cpu, r.memory)
     t.req_key_cache = key
     return key
 
@@ -281,7 +332,7 @@ class TaskBatch:
                 job_start.append(len(tasks))
                 job_queue.append(q_idx)
                 for t in jtasks:
-                    key = (j_idx, t.task_id, _req_key(t), _constraint_key(t))
+                    key = (j_idx, _group_sig(t))
                     g = group_ids.get(key)
                     if g is None:
                         g = len(group_reqs)

@@ -87,7 +87,8 @@ class TaskInfo:
                  "node_name", "status", "priority", "volume_ready",
                  "preemptable", "revocable_zone", "topology_policy", "pod",
                  "best_effort", "last_transaction", "pod_volumes",
-                 "constraint_key_cache", "req_key_cache")
+                 "constraint_key_cache", "req_key_cache",
+                 "group_sig_cache")
 
     def __init__(self, pod: Pod):
         req = pod.resource_request()
@@ -114,6 +115,7 @@ class TaskInfo:
         # inherit them
         self.constraint_key_cache = None
         self.req_key_cache = None
+        self.group_sig_cache = None
 
     @property
     def task_id(self) -> str:
@@ -145,6 +147,7 @@ class TaskInfo:
         c.pod_volumes = self.pod_volumes
         c.constraint_key_cache = self.constraint_key_cache
         c.req_key_cache = self.req_key_cache
+        c.group_sig_cache = self.group_sig_cache
         return c
 
     def key(self) -> str:
@@ -322,7 +325,7 @@ class JobInfo:
         self.task_status_index[status][task.uid] = task
 
     def move_tasks_status_bulk(self, tasks: List[TaskInfo],
-                               status: TaskStatus) -> None:
+                               status: TaskStatus) -> Optional[Resource]:
         """:meth:`move_task_status` over many registered tasks with the
         allocated-resource flips accumulated into one Resource op pair and
         a single index-version bump. Raises before any mutation if a task
@@ -362,6 +365,7 @@ class JobInfo:
             self.allocated.add(flip_add)
         if flip_sub is not None:
             self.allocated.sub(flip_sub)
+        return flip_add
 
     def delete_task_info(self, ti: TaskInfo) -> None:
         self._status_version += 1
@@ -447,6 +451,8 @@ class JobInfo:
 
     def check_task_min_available(self) -> bool:
         """Per-task-type minAvailable check (reference: job_info.go:543-569)."""
+        if not self.task_min_available:
+            return True   # no per-type minimums: skip the status sweep
         if self.min_available < self.task_min_available_total:
             return True
         actual: Dict[str, int] = defaultdict(int)

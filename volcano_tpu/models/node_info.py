@@ -189,7 +189,8 @@ class NodeInfo:
         ti.node_name = self.name
         self.tasks[key] = ti
 
-    def add_tasks_bulk(self, tasks: List[TaskInfo], pipelined: bool) -> None:
+    def add_tasks_bulk(self, tasks: List[TaskInfo], pipelined: bool,
+                       total: Optional[Resource] = None) -> None:
         """Add many same-status tasks with one resource-accounting pass
         (the per-node form of :meth:`add_task` — the allocate hot path
         lands ~5 tasks per node per cycle, and per-task idle checks plus
@@ -202,7 +203,9 @@ class NodeInfo:
         (keep-partial) semantics use the per-task path."""
         keys = []
         seen = set()
-        total = Resource()
+        summing = total is None
+        if summing:
+            total = Resource()
         for task in tasks:
             if task.node_name and self.name and task.node_name != self.name:
                 raise RuntimeError(
@@ -214,7 +217,8 @@ class NodeInfo:
                                    f"already on node <{self.name}>")
             keys.append(key)
             seen.add(key)
-            total.add(task.resreq)
+            if summing:
+                total.add(task.resreq)
         if self.node is not None and not pipelined \
                 and not total.less_equal(self.idle, ZERO):
             raise RuntimeError("selected node NotReady")

@@ -108,6 +108,7 @@ class DrfPlugin(Plugin):
         self.job_attrs: Dict[str, _DrfAttr] = {}
         self.namespace_opts: Dict[str, _DrfAttr] = {}
         self.root = _HNode("root", 1.0)
+        self._touched_jobs: set = set()
 
     def name(self) -> str:
         return NAME
@@ -309,7 +310,9 @@ class DrfPlugin(Plugin):
                 attr.allocated.sub(total)
             attr.version += 1
             attr.dominant, attr.share = _share_of(attr.allocated, self.total)
-            m.update_job_share(job.namespace, job.name, attr.share)
+            # job/namespace share gauges are swept once at session close,
+            # restricted to jobs an event actually touched
+            self._touched_jobs.add(job.uid)
             if ns_enabled:
                 ns = self.namespace_opts.setdefault(job.namespace, _DrfAttr())
                 if sign > 0:
@@ -317,7 +320,6 @@ class DrfPlugin(Plugin):
                 else:
                     ns.allocated.sub(total)
                 ns.dominant, ns.share = _share_of(ns.allocated, self.total)
-                m.update_namespace_share(job.namespace, ns.share)
             if hier_enabled and job.queue in ssn.queues:
                 queue = ssn.queues[job.queue]
                 if sign > 0:
@@ -454,6 +456,14 @@ class DrfPlugin(Plugin):
         self._update_tree(root, demanding)
 
     def on_session_close(self, ssn) -> None:
+        for uid in self._touched_jobs:
+            attr = self.job_attrs.get(uid)
+            job = ssn.jobs.get(uid)
+            if attr is not None and job is not None:
+                m.update_job_share(job.namespace, job.name, attr.share)
+        self._touched_jobs = set()
+        for ns, attr in self.namespace_opts.items():
+            m.update_namespace_share(ns, attr.share)
         self.total = Resource()
         self.total_allocated = Resource()
         self.job_attrs = {}

@@ -184,9 +184,8 @@ class ProportionPlugin(Plugin):
             else:
                 attr.allocated.sub(total)
             attr.share = _share(attr.allocated, attr.deserved)
-            m.update_queue_allocated(attr.name, attr.allocated.milli_cpu,
-                                     attr.allocated.memory)
-            m.update_queue_share(attr.name, attr.share)
+            # queue gauges are last-write-wins: one sweep at session close
+            # replaces a pair of gauge updates per placed gang
 
         ssn.add_event_handler(EventHandler(
             allocate_func=lambda e:
@@ -238,6 +237,10 @@ class ProportionPlugin(Plugin):
             m.update_queue_share(a.name, a.share)
 
     def on_session_close(self, ssn) -> None:
+        for attr in self.queue_opts.values():
+            m.update_queue_allocated(attr.name, attr.allocated.milli_cpu,
+                                     attr.allocated.memory)
+            m.update_queue_share(attr.name, attr.share)
         self.queue_opts = {}
         self.total = Resource()
 
