@@ -156,3 +156,129 @@ def test_bind_echo_fast_path_updates_annotations():
     assert task.preemptable
     node_view = h.cache.nodes["n0"].tasks.get(task.key())
     assert node_view is not None and node_view.preemptable
+
+
+def test_snapshot_drains_pending_write_behind_applies():
+    """The write-behind invariant: a snapshot taken before the executor ran
+    the queued bind applies must still observe the bound state — otherwise
+    the next cycle would double-place the same tasks."""
+    import threading
+
+    from volcano_tpu.apiserver import ObjectStore
+    from volcano_tpu.cache import SchedulerCache
+    from volcano_tpu.utils.test_utils import FakeBinder, FakeEvictor
+
+    store = ObjectStore()
+    cache = SchedulerCache(store, binder=FakeBinder(store),
+                           evictor=FakeEvictor(store))
+    cache.run()
+    store.create("queues", build_queue("default", weight=1))
+    store.create("nodes", build_node("n0", {"cpu": "8", "memory": "16Gi"}))
+    store.create("podgroups", build_pod_group("pg", "ns1", "default", 2,
+                                              phase=PodGroupPhase.INQUEUE))
+    for t in range(2):
+        store.create("pods", build_pod("ns1", f"p{t}", "", "Pending", RL, "pg"))
+
+    # wedge the executor so queued applies cannot run before the snapshot
+    gate = threading.Event()
+    cache._submit(lambda: gate.wait(5.0))
+
+    with cache.mutex:
+        job = next(iter(cache.jobs.values()))
+        infos = sorted(job.tasks.values(), key=lambda t: t.name)
+    accepted = cache.bind_batch([(infos[0], "n0"), (infos[1], "n0")])
+    assert len(accepted) == 2      # optimistic in live mode
+
+    snap = cache.snapshot()        # must drain the pending applies itself
+    sjob = next(iter(snap.jobs.values()))
+    statuses = {t.name: t.status for t in sjob.tasks.values()}
+    assert statuses == {"p0": TaskStatus.Binding, "p1": TaskStatus.Binding}
+    assert snap.nodes["n0"].idle.milli_cpu == 6000.0
+    assert len(snap.nodes["n0"].tasks) == 2
+
+    gate.set()
+    assert cache.flush_executors(timeout=10)
+    # the store writes still ran exactly once after the snapshot's drain
+    assert store.get("pods", "p0", "ns1").spec.node_name == "n0"
+    assert store.get("pods", "p1", "ns1").spec.node_name == "n0"
+    cache.stop()
+
+
+def test_evict_batch_write_behind_converges():
+    """evict_batch applies write-behind too: cache state flips Releasing at
+    the next snapshot even with a wedged executor, then the pod deletes
+    flow once the executor drains."""
+    import threading
+
+    from volcano_tpu.apiserver import ObjectStore
+    from volcano_tpu.cache import SchedulerCache
+    from volcano_tpu.utils.test_utils import FakeBinder, FakeEvictor
+
+    store = ObjectStore()
+    evictor = FakeEvictor(store)
+    cache = SchedulerCache(store, binder=FakeBinder(store), evictor=evictor)
+    cache.run()
+    store.create("queues", build_queue("default", weight=1))
+    store.create("nodes", build_node("n0", {"cpu": "8", "memory": "16Gi"}))
+    store.create("podgroups", build_pod_group("pg", "ns1", "default", 1,
+                                              phase=PodGroupPhase.RUNNING))
+    store.create("pods", build_pod("ns1", "p0", "n0", "Running", RL, "pg"))
+
+    gate = threading.Event()
+    cache._submit(lambda: gate.wait(5.0))
+    with cache.mutex:
+        job = next(iter(cache.jobs.values()))
+        info = next(iter(job.tasks.values()))
+    cache.evict_batch([(info, "preempted")])
+
+    snap = cache.snapshot()
+    stask = next(iter(next(iter(snap.jobs.values())).tasks.values()))
+    assert stask.status == TaskStatus.Releasing
+    # Releasing keeps used but marks the resources releasing
+    assert snap.nodes["n0"].releasing.milli_cpu == 1000.0
+
+    gate.set()
+    assert cache.flush_executors(timeout=10)
+    assert evictor.evicts == ["ns1/p0"]
+    assert store.get("pods", "p0", "ns1") is None
+    cache.stop()
+
+
+def test_bulk_status_move_and_bulk_add_match_singles():
+    """move_tasks_status_bulk / add_tasks_bulk == their per-task forms."""
+    from volcano_tpu.models.job_info import JobInfo, TaskInfo
+    from volcano_tpu.models.node_info import NodeInfo
+
+    def mk_env():
+        node = NodeInfo(build_node("n0", {"cpu": "8", "memory": "16Gi"}))
+        job = JobInfo("j1")
+        tasks = []
+        for i in range(4):
+            t = TaskInfo(build_pod("ns1", f"p{i}", "", "Pending", RL, "pg"))
+            job.add_task_info(t)
+            tasks.append(t)
+        return node, job, tasks
+
+    n1, j1, t1 = mk_env()
+    for t in t1:
+        j1.move_task_status(t, TaskStatus.Allocated)
+        n1.add_task(t)
+    n2, j2, t2 = mk_env()
+    j2.move_tasks_status_bulk(t2, TaskStatus.Allocated)
+    n2.add_tasks_bulk(t2, pipelined=False)
+
+    assert j1.allocated.milli_cpu == j2.allocated.milli_cpu == 4000.0
+    assert n1.idle.milli_cpu == n2.idle.milli_cpu == 4000.0
+    assert n1.used.milli_cpu == n2.used.milli_cpu == 4000.0
+    assert set(n1.tasks) == set(n2.tasks)
+    assert {t.status for t in j2.tasks.values()} == {TaskStatus.Allocated}
+
+    # bulk overcommit refuses atomically: nothing staged
+    n3, j3, t3 = mk_env()
+    for t in t3:
+        t.resreq = t.resreq.clone()
+        t.resreq.milli_cpu = 3000.0
+    with pytest.raises(RuntimeError):
+        n3.add_tasks_bulk(t3, pipelined=False)   # 12 cpu > 8 cpu idle
+    assert not n3.tasks
+    assert n3.idle.milli_cpu == 8000.0

@@ -287,7 +287,8 @@ class AllocateAction(Action):
                     tasks = [t for t, _ in entries]
                 if not tasks:
                     continue
-                node.add_tasks_bulk(tasks, pipelined, total=total)
+                node.add_tasks_bulk(tasks, pipelined, total=total,
+                                    share_objects=True)
                 added.append((node, pipelined, tasks))
                 if not pipelined:
                     name = node.name

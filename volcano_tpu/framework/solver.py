@@ -410,39 +410,59 @@ class BatchSolver:
         unplaced_records: List[Tuple[JobInfo, TaskInfo, int]] = []
         all_tasks = batch.tasks
         task_group_np = batch.task_group
+        # one pass over the assign vector instead of a span scan per job:
+        # placed/unplaced indices are global sorted arrays, each job reads
+        # its window via searchsorted boundaries
+        n_real = len(all_tasks)
+        a_real = assign[:n_real]
+        placed_all = np.flatnonzero(a_real >= 0)
+        unplaced_all = np.flatnonzero(a_real < 0)
+        names_obj = np.empty(narr.idle.shape[0], object)
+        names_obj[:len(narr.names)] = narr.names
+        if placed_all.size:
+            pnames = names_obj[a_real[placed_all]].tolist()
+            ppipe = pipelined_np[placed_all].astype(bool).tolist()
+        else:
+            pnames, ppipe = [], []
+        pidx = placed_all.tolist()
+        uidx = unplaced_all.tolist()
+        plo = np.searchsorted(placed_all, batch.job_task_start).tolist()
+        phi = np.searchsorted(placed_all, batch.job_task_end).tolist()
+        ulo = np.searchsorted(unplaced_all, batch.job_task_start).tolist()
+        uhi = np.searchsorted(unplaced_all, batch.job_task_end).tolist()
+        starts = batch.job_task_start.tolist()
+        ends = batch.job_task_end.tolist()
+        ready_list = ready_np.astype(bool).tolist()
+        kept_list = kept_np.astype(bool).tolist()
         for job, jtasks in ordered_jobs:
             j = uid_to_j.get(job.uid, -1)
             if not jtasks or j < 0:
                 # job contributed no tasks to the scan: readiness is decided
                 # by its pre-existing occupancy alone
                 ok = job.ready_task_num() >= job.min_available
-                was_kept = ok
-            else:
-                ok = bool(ready_np[j])
-                was_kept = bool(kept_np[j])
+                result.committed[job.uid] = ok
+                result.kept[job.uid] = ok
+                result.placements[job.uid] = []
+                result.unplaced[job.uid] = []
+                continue
+            ok = ready_list[j]
+            was_kept = kept_list[j]
             result.committed[job.uid] = ok
             result.kept[job.uid] = was_kept
-            start = int(batch.job_task_start[j])
-            end = int(batch.job_task_end[j])
-            span = assign[start:end]
             if ok or was_kept:
-                placed_rel = np.flatnonzero(span >= 0)
-                pipe_span = pipelined_np[start:end]
-                names = narr.names
                 placements = [
-                    Placement(all_tasks[start + k], names[span[k]],
-                              bool(pipe_span[k]))
-                    for k in placed_rel]
-                unplaced_rel = np.flatnonzero(span < 0)
+                    Placement(all_tasks[pidx[k]], pnames[k], ppipe[k])
+                    for k in range(plo[j], phi[j])]
+                un_iter = (uidx[k] for k in range(ulo[j], uhi[j]))
             else:
                 placements = []
-                unplaced_rel = np.arange(end - start)
+                un_iter = range(starts[j], ends[j])
             unplaced = []
-            for k in unplaced_rel:
-                task = all_tasks[start + k]
+            for t_idx in un_iter:
+                task = all_tasks[t_idx]
                 unplaced.append(task)
                 unplaced_records.append(
-                    (job, task, int(task_group_np[start + k])))
+                    (job, task, int(task_group_np[t_idx])))
             result.placements[job.uid] = placements
             result.unplaced[job.uid] = unplaced
         if unplaced_records:

@@ -233,10 +233,15 @@ class Statement:
             accepted = ssn.cache.bind_batch(to_bind)
         else:
             accepted = [t for t, _ in to_bind]
-        for task in accepted:
-            job_of = ssn.jobs.get(task.job)
-            if job_of is not None:
-                job_of.move_task_status(task, TaskStatus.Binding)
+        job_of = ssn.jobs.get(op.job.uid)
+        if job_of is not None and \
+                all(t.job == op.job.uid for t in accepted):
+            job_of.move_tasks_status_bulk(accepted, TaskStatus.Binding)
+        else:   # mixed/foreign tasks: per-task fallback
+            for task in accepted:
+                job_t = ssn.jobs.get(task.job)
+                if job_t is not None:
+                    job_t.move_task_status(task, TaskStatus.Binding)
 
     # -- commit / discard (statement.go:350-393) ---------------------------
 

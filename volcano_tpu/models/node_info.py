@@ -190,7 +190,8 @@ class NodeInfo:
         self.tasks[key] = ti
 
     def add_tasks_bulk(self, tasks: List[TaskInfo], pipelined: bool,
-                       total: Optional[Resource] = None) -> None:
+                       total: Optional[Resource] = None,
+                       share_objects: bool = False) -> None:
         """Add many same-status tasks with one resource-accounting pass
         (the per-node form of :meth:`add_task` — the allocate hot path
         lands ~5 tasks per node per cycle, and per-task idle checks plus
@@ -228,8 +229,15 @@ class NodeInfo:
             else:
                 self.idle.sub_unchecked(total)
                 self.used.add(total)
+        # share_objects: store the caller's TaskInfo instead of a clone.
+        # Safe ONLY when no status-class-crossing transition can hit the
+        # stored view while it is on the node — the session staging path
+        # qualifies (victim selection is Running-only, staged tasks are
+        # Allocated/Pipelined/Binding, and discard removes before the
+        # status moves back). The cache keeps clones: its evict path
+        # relies on the stored view holding the pre-transition status.
         for key, task in zip(keys, tasks):
-            ti = task.clone()
+            ti = task if share_objects else task.clone()
             if self.node is not None and not pipelined:
                 self.add_gpu_resource(ti.pod)
             task.node_name = self.name
