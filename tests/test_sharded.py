@@ -108,3 +108,50 @@ configurations:
     sharded = build(mesh_conf)
     assert single == sharded
     assert len(sharded) == 48
+
+
+@pytest.mark.parametrize("chunk", [1, 3, 16])
+@pytest.mark.parametrize("scenario", ["base", "buckets", "pipelined", "tight"])
+def test_chunked_sharded_exactness(chunk, scenario):
+    """The chunked-candidate kernel must match the single-device kernel
+    bit-for-bit (placements, pipelined flags, ready/kept) across chunk
+    sizes and adversarial state shapes: task-topology pack attraction,
+    future-idle (pipelined) placements, and gang rollbacks."""
+    n_dev = 4
+    devices = jax.devices()[:n_dev]
+    if len(devices) < n_dev:
+        pytest.skip("not enough virtual devices")
+    mesh = Mesh(np.array(devices), ("nodes",))
+
+    sa = synth_arrays(120, 8 * n_dev, gang_size=5, node_pad_to=8 * n_dev,
+                      seed=11, utilization=0.45, n_queues=3)
+    rng = np.random.default_rng(7)
+    if scenario == "buckets":
+        # every gang is one topology bucket with pack attraction
+        sa.task_bucket[:120] = np.repeat(np.arange(24, dtype=np.int32), 5)
+        sa.group_pack_bonus[:24] = 5.0
+    elif scenario == "pipelined":
+        # drain idle everywhere but leave future room (releasing
+        # resources): every placement must pipeline
+        sa.node_idle *= 0.02
+        sa.node_future = sa.node_idle * 40.0
+    elif scenario == "tight":
+        # barely any capacity: most gangs roll back
+        sa.node_idle *= 0.12
+        sa.node_future[:] = sa.node_idle
+
+    weights = ScoreWeights.make(sa.group_req.shape[1], binpack=1.0)
+    a_s, p_s, r_s, k_s, _ = _single(sa, weights)
+
+    fn = make_sharded_gang_allocate(mesh, chunk=chunk)
+    args = shard_synth(mesh, sa)
+    a_m, p_m, r_m, k_m, _ = fn(*args, weights)
+
+    np.testing.assert_array_equal(np.asarray(a_s), np.asarray(a_m))
+    np.testing.assert_array_equal(np.asarray(p_s), np.asarray(p_m))
+    np.testing.assert_array_equal(np.asarray(r_s), np.asarray(r_m))
+    np.testing.assert_array_equal(np.asarray(k_s), np.asarray(k_m))
+    if scenario == "tight":
+        assert not np.asarray(r_s).all()     # rollbacks actually happened
+    if scenario == "pipelined":
+        assert np.asarray(p_s).any()         # pipelining actually happened

@@ -103,6 +103,9 @@ class BatchSolver:
                 devices = jax.devices()[:n_dev]
                 if len(devices) >= 2:
                     self.mesh = Mesh(np.array(devices), ("nodes",))
+                # collective cadence: one candidate all-gather per `chunk`
+                # placements (ops/sharded.py chunked kernel; exact)
+                self.mesh_chunk = solver_args.get_int("mesh.chunk", 16)
             self.kernel = solver_args.get_str("kernel", "auto") \
                 if hasattr(solver_args, "get_str") else "auto"
         self._sharded_fns: Dict[bool, Callable] = {}
@@ -498,8 +501,9 @@ class BatchSolver:
 
         fn = self._sharded_fns.get(allow_pipeline)
         if fn is None:
-            fn = make_sharded_gang_allocate(mesh,
-                                            allow_pipeline=allow_pipeline)
+            fn = make_sharded_gang_allocate(
+                mesh, allow_pipeline=allow_pipeline,
+                chunk=getattr(self, "mesh_chunk", 16))
             self._sharded_fns[allow_pipeline] = fn
 
         n = NamedSharding(mesh, P("nodes"))

@@ -233,6 +233,8 @@ class Statement:
             accepted = ssn.cache.bind_batch(to_bind)
         else:
             accepted = [t for t, _ in to_bind]
+        if not accepted:
+            return
         job_of = ssn.jobs.get(op.job.uid)
         if job_of is not None and \
                 all(t.job == op.job.uid for t in accepted):

@@ -63,6 +63,87 @@ class ShardState(NamedTuple):
     kept: jax.Array          # [J] bool replicated
 
 
+def _make_queue_select(queue_deserved, queue_njobs, queue_job_start, eps):
+    """The replicated dynamic queue-selection closure shared by both
+    sharded bodies: next (queue, job) by live share, overuse-gated."""
+    def select(q_alloc, q_cursor):
+        share = queue_share(q_alloc, queue_deserved)
+        eligible = (q_cursor < queue_njobs) & \
+            ~queue_overused(q_alloc, queue_deserved, eps)
+        q = jnp.argmin(jnp.where(eligible, share, BIG)).astype(jnp.int32)
+        ok = eligible[q]
+        job = queue_job_start[q] + q_cursor[q]
+        return jnp.where(ok, q, -1), jnp.where(ok, job, -1)
+    return select
+
+
+def _init_shard_state(select, node_idle, node_future, node_ntasks,
+                      queue_alloc0, queue_njobs, eps, n_jobs):
+    Nl = node_idle.shape[0]
+    q0, j0 = select(queue_alloc0, jnp.zeros_like(queue_njobs))
+    return ShardState(
+        idle=node_idle, future=node_future, n_tasks=node_ntasks,
+        ckpt_idle=node_idle, ckpt_future=node_future, ckpt_ntasks=node_ntasks,
+        cur_bucket=jnp.int32(-1),
+        pack_nodes=jnp.zeros(Nl, jnp.float32),
+        q_alloc=queue_alloc0, q_cursor=jnp.zeros_like(queue_njobs),
+        cur_q=q0, cur_job=j0, t_off=jnp.int32(0),
+        placed=jnp.int32(0), placed_alloc=jnp.int32(0),
+        placed_res=jnp.zeros_like(eps),
+        ready=jnp.zeros(n_jobs, bool), kept=jnp.zeros(n_jobs, bool))
+
+
+def _job_boundary(state: ShardState, select, active, job,
+                  job_n_tasks, job_ready_base, job_min_available):
+    """Gang commit/rollback + next-job selection at a job boundary
+    (replicated math, no communication). Shared by both sharded bodies.
+    Returns (state, roll)."""
+    complete = active & (state.t_off >= job_n_tasks[job])
+    base = job_ready_base[job]
+    minavail = job_min_available[job]
+    is_ready = complete & (base + state.placed_alloc >= minavail)
+    is_kept = complete & (base + state.placed >= minavail)
+    keep = is_ready | is_kept
+    roll = complete & ~keep
+
+    idle = jnp.where(roll, state.ckpt_idle, state.idle)
+    future = jnp.where(roll, state.ckpt_future, state.future)
+    n_tasks = jnp.where(roll, state.ckpt_ntasks, state.n_tasks)
+    q = jnp.maximum(state.cur_q, 0)
+    q_alloc = state.q_alloc.at[q].add(
+        jnp.where(keep, state.placed_res, 0.0))
+    q_cursor = state.q_cursor.at[q].add(jnp.where(complete, 1, 0))
+    ready = state.ready.at[job].set(is_ready | state.ready[job])
+    kept = state.kept.at[job].set(is_kept | state.kept[job])
+
+    nq, nj = select(q_alloc, q_cursor)
+    cur_q = jnp.where(complete, nq, state.cur_q)
+    cur_job = jnp.where(complete, nj, state.cur_job)
+
+    return state._replace(
+        idle=idle, future=future, n_tasks=n_tasks,
+        ckpt_idle=jnp.where(complete, idle, state.ckpt_idle),
+        ckpt_future=jnp.where(complete, future, state.ckpt_future),
+        ckpt_ntasks=jnp.where(complete, n_tasks, state.ckpt_ntasks),
+        q_alloc=q_alloc, q_cursor=q_cursor,
+        cur_q=cur_q, cur_job=cur_job,
+        t_off=jnp.where(complete, 0, state.t_off),
+        placed=jnp.where(complete, 0, state.placed),
+        placed_alloc=jnp.where(complete, 0, state.placed_alloc),
+        placed_res=jnp.where(complete, 0.0, state.placed_res),
+        ready=ready, kept=kept), roll
+
+
+def _finalize_outputs(state: ShardState, emit_t, emit_sel, emit_pipe,
+                      task_job, task_valid, T):
+    assign = jnp.full(T + 1, -1, jnp.int32).at[emit_t].set(emit_sel)[:T]
+    pipelined = jnp.zeros(T + 1, bool).at[emit_t].set(emit_pipe)[:T]
+    ok = (state.ready[task_job] | state.kept[task_job]) & task_valid
+    assign = jnp.where(ok, assign, -1)
+    pipelined = pipelined & ok
+    return assign, pipelined, state.ready, state.kept, state.idle
+
+
 def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
                   group_static_score, task_bucket, group_pack_bonus,
                   job_min_available, job_ready_base,
@@ -78,26 +159,10 @@ def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
     shard = jax.lax.axis_index(axis)
     offset = shard * Nl
 
-    def select(q_alloc, q_cursor):
-        share = queue_share(q_alloc, queue_deserved)
-        eligible = (q_cursor < queue_njobs) & \
-            ~queue_overused(q_alloc, queue_deserved, eps)
-        q = jnp.argmin(jnp.where(eligible, share, BIG)).astype(jnp.int32)
-        ok = eligible[q]
-        job = queue_job_start[q] + q_cursor[q]
-        return jnp.where(ok, q, -1), jnp.where(ok, job, -1)
-
-    q0, j0 = select(queue_alloc0, jnp.zeros_like(queue_njobs))
-    init = ShardState(
-        idle=node_idle, future=node_future, n_tasks=node_ntasks,
-        ckpt_idle=node_idle, ckpt_future=node_future, ckpt_ntasks=node_ntasks,
-        cur_bucket=jnp.int32(-1),
-        pack_nodes=jnp.zeros(Nl, jnp.float32),
-        q_alloc=queue_alloc0, q_cursor=jnp.zeros_like(queue_njobs),
-        cur_q=q0, cur_job=j0, t_off=jnp.int32(0),
-        placed=jnp.int32(0), placed_alloc=jnp.int32(0),
-        placed_res=jnp.zeros_like(eps),
-        ready=jnp.zeros(J, bool), kept=jnp.zeros(J, bool))
+    select = _make_queue_select(queue_deserved, queue_njobs,
+                                queue_job_start, eps)
+    init = _init_shard_state(select, node_idle, node_future, node_ntasks,
+                             queue_alloc0, queue_njobs, eps, J)
 
     def step(state: ShardState, _):
         active = state.cur_job >= 0
@@ -177,58 +242,192 @@ def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
             placed_alloc=state.placed_alloc + take_idle.astype(jnp.int32),
             placed_res=state.placed_res + jnp.where(placed_ok, req, 0.0))
 
-        # ---- job boundary (replicated math, no communication)
-        complete = active & (state.t_off >= job_n_tasks[job])
-        base = job_ready_base[job]
-        minavail = job_min_available[job]
-        is_ready = complete & (base + state.placed_alloc >= minavail)
-        is_kept = complete & (base + state.placed >= minavail)
-        keep = is_ready | is_kept
-        roll = complete & ~keep
-
-        idle = jnp.where(roll, state.ckpt_idle, state.idle)
-        future = jnp.where(roll, state.ckpt_future, state.future)
-        n_tasks = jnp.where(roll, state.ckpt_ntasks, state.n_tasks)
-        q = jnp.maximum(state.cur_q, 0)
-        q_alloc = state.q_alloc.at[q].add(
-            jnp.where(keep, state.placed_res, 0.0))
-        q_cursor = state.q_cursor.at[q].add(jnp.where(complete, 1, 0))
-        ready = state.ready.at[job].set(is_ready | state.ready[job])
-        kept = state.kept.at[job].set(is_kept | state.kept[job])
-
-        nq, nj = select(q_alloc, q_cursor)
-        cur_q = jnp.where(complete, nq, state.cur_q)
-        cur_job = jnp.where(complete, nj, state.cur_job)
-
-        state = state._replace(
-            idle=idle, future=future, n_tasks=n_tasks,
-            ckpt_idle=jnp.where(complete, idle, state.ckpt_idle),
-            ckpt_future=jnp.where(complete, future, state.ckpt_future),
-            ckpt_ntasks=jnp.where(complete, n_tasks, state.ckpt_ntasks),
-            q_alloc=q_alloc, q_cursor=q_cursor,
-            cur_q=cur_q, cur_job=cur_job,
-            t_off=jnp.where(complete, 0, state.t_off),
-            placed=jnp.where(complete, 0, state.placed),
-            placed_alloc=jnp.where(complete, 0, state.placed_alloc),
-            placed_res=jnp.where(complete, 0.0, state.placed_res),
-            ready=ready, kept=kept)
+        state, _ = _job_boundary(state, select, active, job, job_n_tasks,
+                                 job_ready_base, job_min_available)
         emit_t = jnp.where(valid, t_idx, T)
         emit_sel = jnp.where(placed_ok, sel_g, -1)
         return state, (emit_t, emit_sel, pipelined)
 
     state, (emit_t, emit_sel, emit_pipe) = jax.lax.scan(
         step, init, None, length=T)
+    return _finalize_outputs(state, emit_t, emit_sel, emit_pipe,
+                             task_job, task_valid, T)
 
-    assign = jnp.full(T + 1, -1, jnp.int32).at[emit_t].set(emit_sel)[:T]
-    pipelined = jnp.zeros(T + 1, bool).at[emit_t].set(emit_pipe)[:T]
-    ok = (state.ready[task_job] | state.kept[task_job]) & task_valid
-    assign = jnp.where(ok, assign, -1)
-    pipelined = pipelined & ok
-    return assign, pipelined, state.ready, state.kept, state.idle
+
+def _sharded_body_chunked(task_group, task_job, task_valid, group_req,
+                          group_mask, group_static_score, task_bucket,
+                          group_pack_bonus, job_min_available,
+                          job_ready_base, job_task_start, job_n_tasks,
+                          job_queue, queue_job_start, queue_njobs,
+                          queue_deserved, queue_alloc0, node_idle,
+                          node_future, node_alloc, node_ntasks,
+                          node_max_tasks, eps, weights,
+                          allow_pipeline: bool, axis: str, chunk: int):
+    """Chunked-candidate variant of :func:`_sharded_body`: instead of one
+    all-gather per scan step, each shard gathers its top-``chunk``
+    candidates per fit class (idle / future) into a replicated candidate
+    table, and up to ``chunk`` consecutive placements are served from the
+    table with no communication. The table refreshes on group change,
+    after a gang rollback, or when ``chunk`` steps have been served.
+
+    This is EXACT, tie-breaks included, not an approximation: within a
+    chunk only placed-on nodes change score/feasibility, and every placed
+    node is in the table (placements are chosen from it). For an untouched
+    node outside the table, its shard kept ``chunk`` statically-better
+    candidates, of which at most ``chunk - 1`` have been touched — so an
+    untouched, at-least-as-good (score, then lower global index) candidate
+    remains in the table whenever the outside node would have won.
+    ``lax.top_k``'s lowest-index tie order matches the kernel's global
+    lowest-node-index tie-break.
+    """
+    T = task_group.shape[0]
+    J = job_min_available.shape[0]
+    Nl = node_idle.shape[0]
+    R = node_idle.shape[1]
+    C = min(chunk, Nl)   # a shard can't offer more candidates than nodes
+    shard = jax.lax.axis_index(axis)
+    offset = shard * Nl
+    n_dev = jax.lax.axis_size(axis)
+    K = 2 * C * n_dev
+    F = 5 + 3 * R   # gidx, static, pack, ntasks, maxtasks, idle, future, alloc
+
+    select = _make_queue_select(queue_deserved, queue_njobs,
+                                queue_job_start, eps)
+    init = _init_shard_state(select, node_idle, node_future, node_ntasks,
+                             queue_alloc0, queue_njobs, eps, J)
+    cand0 = jnp.full((K, F), NEG, jnp.float32).at[:, 0].set(-1.0)
+    carry0 = (init, cand0, jnp.int32(C), jnp.int32(-1), jnp.int32(-1),
+              jnp.bool_(True))
+
+    def step(carry, _):
+        state, cand, since, prev_g, prev_b, force = carry
+        active = state.cur_job >= 0
+        job = jnp.maximum(state.cur_job, 0)
+        t_idx = jnp.clip(job_task_start[job] + state.t_off, 0, T - 1)
+        g = task_group[t_idx]
+        b = task_bucket[t_idx]
+        valid = task_valid[t_idx] & active & \
+            (state.t_off < job_n_tasks[job])
+        req = group_req[g]
+
+        need = force | (since >= C) | (g != prev_g) | (b != prev_b)
+
+        def refresh(_):
+            static_ok = group_mask[g]
+            pods_ok = (node_max_tasks == 0) | \
+                (state.n_tasks < node_max_tasks)
+            base_ok = static_ok & pods_ok
+            pack_eff = jnp.where((b >= 0) & (b == state.cur_bucket),
+                                 state.pack_nodes, 0.0)
+            score = node_score(req, state.idle, node_alloc, weights,
+                               group_static_score[g])
+            fits_idle = jnp.all(req[None, :] <= state.idle + eps[None, :],
+                                axis=-1) & base_ok
+            fits_fut = jnp.all(req[None, :] <= state.future + eps[None, :],
+                               axis=-1) & base_ok
+            # the top-C ranking must use the same order as the in-chunk
+            # argmax: score including the pack bonus, ties by index
+            score_b = score + pack_eff * group_pack_bonus[g]
+            rows = []
+            for m in (jnp.where(fits_idle, score_b, NEG),
+                      jnp.where(fits_fut, score_b, NEG)
+                      if allow_pipeline else jnp.full(Nl, NEG)):
+                vals, idxs = jax.lax.top_k(m, C)
+                ok_row = vals > NEG * 0.5
+                row = jnp.concatenate([
+                    jnp.where(ok_row, (offset + idxs).astype(jnp.float32),
+                              -1.0)[:, None],
+                    group_static_score[g][idxs][:, None],
+                    pack_eff[idxs][:, None],
+                    state.n_tasks[idxs].astype(jnp.float32)[:, None],
+                    node_max_tasks[idxs].astype(jnp.float32)[:, None],
+                    state.idle[idxs], state.future[idxs],
+                    node_alloc[idxs]], axis=1)
+                rows.append(row)
+            local = jnp.concatenate(rows, axis=0)        # [2C, F]
+            return jax.lax.all_gather(local, axis).reshape(K, F)
+
+        cand = jax.lax.cond(need, refresh, lambda _: cand, None)
+        since = jnp.where(need, 1, since + 1)
+
+        gidx_f = cand[:, 0]
+        row_live = gidx_f >= 0.0
+        ntasks_c = cand[:, 3]
+        maxt_c = cand[:, 4]
+        idle_c = cand[:, 5:5 + R]
+        fut_c = cand[:, 5 + R:5 + 2 * R]
+        alloc_c = cand[:, 5 + 2 * R:]
+        pods_ok_c = (maxt_c == 0) | (ntasks_c < maxt_c)
+        sb = (b >= 0) & (b == state.cur_bucket)
+        static_eff = cand[:, 1] + \
+            jnp.where(sb, cand[:, 2], 0.0) * group_pack_bonus[g]
+        score_c = node_score(req, idle_c, alloc_c, weights, static_eff)
+        base_c = row_live & pods_ok_c & valid
+        fits_idle_c = jnp.all(req[None, :] <= idle_c + eps[None, :],
+                              axis=-1) & base_c
+        if allow_pipeline:
+            fits_fut_c = jnp.all(req[None, :] <= fut_c + eps[None, :],
+                                 axis=-1) & base_c
+        else:
+            fits_fut_c = jnp.zeros_like(fits_idle_c)
+        any_idle = jnp.any(fits_idle_c)
+        cls = jnp.where(any_idle, fits_idle_c, fits_fut_c)
+        scores = jnp.where(cls, score_c, NEG)
+        best_score = jnp.max(scores)
+        winner = scores >= best_score
+        gidx_i = gidx_f.astype(jnp.int32)
+        sel_g = jnp.min(jnp.where(winner, gidx_i, jnp.int32(2**30)))
+        placed_ok = best_score > NEG * 0.5
+        pipelined = placed_ok & ~any_idle if allow_pipeline \
+            else jnp.bool_(False)
+
+        # apply to the candidate table (every row of the selected node)
+        hit = placed_ok & (gidx_i == sel_g) & row_live
+        take_idle = placed_ok & ~pipelined
+        cand = cand.at[:, 5:5 + R].add(
+            jnp.where((hit & take_idle)[:, None], -req[None, :], 0.0))
+        cand = cand.at[:, 5 + R:5 + 2 * R].add(
+            jnp.where(hit[:, None], -req[None, :], 0.0))
+        cand = cand.at[:, 3].add(jnp.where(hit, 1.0, 0.0))
+        cand = cand.at[:, 2].add(jnp.where(hit & valid, 1.0, 0.0))
+
+        # apply to the owner shard's local state (as in _sharded_body)
+        is_owner = (sel_g >= offset) & (sel_g < offset + Nl)
+        sel_l = jnp.clip(sel_g - offset, 0, Nl - 1)
+        idle = state.idle.at[sel_l].add(
+            jnp.where(is_owner & take_idle, -req, 0.0))
+        future = state.future.at[sel_l].add(
+            jnp.where(is_owner & placed_ok, -req, 0.0))
+        n_tasks = state.n_tasks.at[sel_l].add(
+            jnp.where(is_owner & placed_ok, 1, 0))
+        pack = jnp.where(sb, state.pack_nodes, 0.0)
+        state = state._replace(
+            idle=idle, future=future, n_tasks=n_tasks,
+            cur_bucket=jnp.where(valid, b, state.cur_bucket),
+            pack_nodes=pack.at[sel_l].add(
+                jnp.where(is_owner & placed_ok & valid, 1.0, 0.0)),
+            t_off=state.t_off + jnp.where(active, 1, 0),
+            placed=state.placed + placed_ok.astype(jnp.int32),
+            placed_alloc=state.placed_alloc + take_idle.astype(jnp.int32),
+            placed_res=state.placed_res + jnp.where(placed_ok, req, 0.0))
+
+        state, roll = _job_boundary(state, select, active, job,
+                                    job_n_tasks, job_ready_base,
+                                    job_min_available)
+        emit_t = jnp.where(valid, t_idx, T)
+        emit_sel = jnp.where(placed_ok, sel_g, -1)
+        return (state, cand, since, g, b, roll), \
+            (emit_t, emit_sel, pipelined)
+
+    (state, *_), (emit_t, emit_sel, emit_pipe) = jax.lax.scan(
+        step, carry0, None, length=T)
+    return _finalize_outputs(state, emit_t, emit_sel, emit_pipe,
+                             task_job, task_valid, T)
 
 
 def make_sharded_gang_allocate(mesh: Mesh, axis: str = "nodes",
-                               allow_pipeline: bool = True):
+                               allow_pipeline: bool = True,
+                               chunk: int = 16):
     """Build the jitted node-sharded gang-allocate for a device mesh.
 
     Node-axis inputs ([N,...] and [G,N]) must be padded so N divides the mesh
@@ -246,7 +445,12 @@ def make_sharded_gang_allocate(mesh: Mesh, axis: str = "nodes",
                 nr, nr, nr, n, n, rep,
                 ScoreWeights(rep, rep, rep, rep, rep))
     out_specs = (rep, rep, rep, rep, nr)
-    body = partial(_sharded_body, allow_pipeline=allow_pipeline, axis=axis)
+    if chunk and chunk > 1:
+        body = partial(_sharded_body_chunked, allow_pipeline=allow_pipeline,
+                       axis=axis, chunk=int(chunk))
+    else:
+        body = partial(_sharded_body, allow_pipeline=allow_pipeline,
+                       axis=axis)
     try:
         sm = shard_map(body, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs, check_vma=False)
