@@ -68,6 +68,9 @@ def worker(platform: str, n_tasks: int, n_nodes: int, kernel: str,
     if kernel == "pallas":
         from volcano_tpu.ops.pallas_allocate import gang_allocate_pallas
         fn = lambda: gang_allocate_pallas(*args)
+    elif kernel == "chunked":
+        from volcano_tpu.ops.allocate import gang_allocate_chunked
+        fn = lambda: gang_allocate_chunked(*args)
     else:
         fn = lambda: gang_allocate(*args)
 
@@ -186,8 +189,8 @@ def main() -> None:
         os.environ.get("VOLCANO_BENCH_DEADLINE", 1800))
     tpu_failures = 0
     for n_tasks, n_nodes in SHAPES:
-        for platform, kernel in (("tpu", "pallas"), ("tpu", "scan"),
-                                 ("cpu", "scan")):
+        for platform, kernel in (("tpu", "pallas"), ("tpu", "chunked"),
+                                 ("cpu", "chunked"), ("cpu", "scan")):
             if platform == "tpu" and tpu_failures >= 2:
                 continue   # TPU is down for this run; stop burning timeouts
             if time.monotonic() > deadline:

@@ -246,3 +246,19 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
     assign = jnp.where(ok, assign, -1)
     pipelined = pipelined & ok
     return assign, pipelined, state.ready, state.kept, state
+
+
+@partial(jax.jit, static_argnames=("allow_pipeline", "chunk"))
+def gang_allocate_chunked(*args, allow_pipeline: bool = True,
+                          chunk: int = 16):
+    """Chunked-candidate form of :func:`gang_allocate`: identical
+    semantics (ops/sharded.py holds the exactness argument), but each
+    scan step works on a top-``chunk``-per-fit-class candidate table that
+    refreshes once per chunk/group-change/rollback — the O(N) node sweep
+    (fit compares, scoring, argmax) runs once per chunk instead of once
+    per task. Same positional arguments as :func:`gang_allocate`; the
+    fifth output is the final node idle matrix rather than the full
+    AllocState."""
+    from .sharded import _sharded_body_chunked
+    return _sharded_body_chunked(*args, allow_pipeline=allow_pipeline,
+                                 axis=None, chunk=chunk)

@@ -285,9 +285,12 @@ def _sharded_body_chunked(task_group, task_job, task_valid, group_req,
     Nl = node_idle.shape[0]
     R = node_idle.shape[1]
     C = min(chunk, Nl)   # a shard can't offer more candidates than nodes
-    shard = jax.lax.axis_index(axis)
-    offset = shard * Nl
-    n_dev = jax.lax.axis_size(axis)
+    if axis is None:     # single-device form (ops.allocate.gang_allocate_chunked)
+        offset = jnp.int32(0)
+        n_dev = 1
+    else:
+        offset = jax.lax.axis_index(axis) * Nl
+        n_dev = jax.lax.axis_size(axis)
     K = 2 * C * n_dev
     F = 5 + 3 * R   # gidx, static, pack, ntasks, maxtasks, idle, future, alloc
 
@@ -345,6 +348,8 @@ def _sharded_body_chunked(task_group, task_job, task_valid, group_req,
                     node_alloc[idxs]], axis=1)
                 rows.append(row)
             local = jnp.concatenate(rows, axis=0)        # [2C, F]
+            if axis is None:
+                return local
             return jax.lax.all_gather(local, axis).reshape(K, F)
 
         cand = jax.lax.cond(need, refresh, lambda _: cand, None)

@@ -120,9 +120,18 @@ class GangPlugin(Plugin):
                         job.nodes_fit_errors[task.uid] = fe
                 m.update_unschedulable_task_count(job.name, max(0, unready))
             else:
-                fw.update_pod_group_condition(ssn, job, PodGroupCondition(
-                    type=PodGroupConditionType.SCHEDULED, status="True",
-                    transition_id=ssn.uid, reason=POD_GROUP_READY))
+                # refreshing an identical Scheduled condition would only
+                # bump transition_id (nothing reads it for Scheduled —
+                # job_status consults it for Unschedulable only), but it
+                # claims a COW PodGroup per ready job per cycle; skip when
+                # an equivalent condition is already present
+                if not any(c.type == PodGroupConditionType.SCHEDULED
+                           and c.status == "True"
+                           and c.reason == POD_GROUP_READY
+                           for c in job.pod_group.status.conditions):
+                    fw.update_pod_group_condition(ssn, job, PodGroupCondition(
+                        type=PodGroupConditionType.SCHEDULED, status="True",
+                        transition_id=ssn.uid, reason=POD_GROUP_READY))
                 m.update_unschedulable_task_count(job.name, 0)
         m.set_gauge(m.UNSCHEDULE_JOB_COUNT, unschedulable_jobs)
 
