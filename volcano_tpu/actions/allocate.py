@@ -201,7 +201,7 @@ class AllocateAction(Action):
                 slow.append((pos, job, pls))
                 continue
             if ssn.cache is not None and \
-                    any(t.pod.spec.volumes for t, _, _ in items):
+                    any(t.has_volumes for t, _, _ in items):
                 slow.append((pos, job, pls))
                 continue
             bulk.append((job, items))
@@ -278,8 +278,11 @@ class AllocateAction(Action):
                     failed_uids.add(job.uid)
                     continue
                 ok_jobs.append((job, items))
+            no_failures = not failed_uids
             for node, pipelined, entries, total in groups.values():
-                if any(j.uid in failed_uids for _, j in entries):
+                if no_failures:
+                    tasks = [t for t, _ in entries]
+                elif any(j.uid in failed_uids for _, j in entries):
                     tasks = [t for t, j in entries
                              if j.uid not in failed_uids]
                     total = None   # stale sum: includes dropped jobs

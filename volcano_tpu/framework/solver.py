@@ -342,7 +342,10 @@ class BatchSolver:
             import jax
             if jax.default_backend() == "tpu" and self.rindex.r <= R_PAD:
                 return gang_allocate_pallas, {}
-            return gang_allocate_chunked, {}
+            # the candidate-table refresh only pays off once the node
+            # sweep is expensive; small clusters keep the plain scan
+            if len(self.ssn.nodes) >= 1024:
+                return gang_allocate_chunked, {}
         if self.kernel == "chunked":
             return gang_allocate_chunked, {}
         return gang_allocate, {}

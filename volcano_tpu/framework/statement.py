@@ -141,7 +141,7 @@ class Statement:
         ssn = self.ssn
         fast = []
         for task, node, pipelined in placements:
-            if ssn.cache is not None and task.pod.spec.volumes:
+            if ssn.cache is not None and task.has_volumes:
                 if pipelined:
                     self.pipeline(task, node.name)
                 else:
@@ -280,10 +280,14 @@ class Statement:
                 if self.ssn.cache is not None:
                     evicts.append(op)
                 continue
-            flush_evicts()
             if op.name == "pipeline":
-                pass  # session-state only until resources actually release
-            elif op.name == "allocate":
+                # session-state only until resources actually release — no
+                # cache dispatch, so it needs no evict barrier (preempt
+                # interleaves evict/pipeline per victim; flushing here
+                # degraded the batched dispatch to one evict per call)
+                continue
+            flush_evicts()
+            if op.name == "allocate":
                 try:
                     self.ssn.dispatch(op.task, op.task.pod_volumes)
                 except KeyError:

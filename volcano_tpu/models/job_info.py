@@ -88,7 +88,7 @@ class TaskInfo:
                  "preemptable", "revocable_zone", "topology_policy", "pod",
                  "best_effort", "last_transaction", "pod_volumes",
                  "constraint_key_cache", "req_key_cache",
-                 "group_sig_cache")
+                 "group_sig_cache", "has_volumes")
 
     def __init__(self, pod: Pod):
         req = pod.resource_request()
@@ -116,6 +116,7 @@ class TaskInfo:
         self.constraint_key_cache = None
         self.req_key_cache = None
         self.group_sig_cache = None
+        self.has_volumes = bool(pod.spec.volumes)
 
     @property
     def task_id(self) -> str:
@@ -148,6 +149,7 @@ class TaskInfo:
         c.constraint_key_cache = self.constraint_key_cache
         c.req_key_cache = self.req_key_cache
         c.group_sig_cache = self.group_sig_cache
+        c.has_volumes = self.has_volumes
         return c
 
     def key(self) -> str:
