@@ -1,0 +1,177 @@
+"""Deferred session materialization (Session.materialize): allocate records
+placements as per-job deltas + node_name strings; the object-model apply
+runs lazily. These tests pin the delta-aware accounting, the materialize
+trigger points, and drop/discard semantics."""
+
+import pytest
+
+from tests.harness import Harness
+from volcano_tpu.models.job_info import TaskStatus
+from volcano_tpu.models.objects import PodGroupPhase
+from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                          build_pod_group, build_queue,
+                                          build_resource_list)
+
+CONF = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+CONF_CHAIN = CONF.replace('"enqueue, allocate"',
+                          '"enqueue, allocate, backfill, preempt, reclaim"')
+
+RL = build_resource_list("1", "1Gi")
+
+
+def _env(conf=CONF, gangs=3, gang=4, nodes=4):
+    h = Harness(conf)
+    h.add("queues", build_queue("default", weight=1))
+    for i in range(nodes):
+        h.add("nodes", build_node(f"n{i}", {"cpu": "8", "memory": "16Gi"}))
+    for j in range(gangs):
+        h.add("podgroups", build_pod_group(f"pg{j}", "ns1", "default", gang,
+                                           phase=PodGroupPhase.INQUEUE))
+        for t in range(gang):
+            h.add("pods", build_pod("ns1", f"pg{j}-{t}", "", "Pending", RL,
+                                    f"pg{j}"))
+    return h
+
+
+def test_deferred_cycle_binds_and_podgroup_running():
+    """A deferred-only cycle must still bind everything and roll the
+    PodGroup phase to Running (delta-aware job_status)."""
+    h = _env()
+    h.run_actions("enqueue", "allocate").close_session()
+    h.cache.flush_executors(timeout=30)
+    assert len(h.binds) == 12
+    for pg in h.store.list("podgroups"):
+        assert pg.status.phase == PodGroupPhase.RUNNING, \
+            (pg.metadata.name, pg.status.phase)
+
+
+def test_deferred_deltas_feed_readiness_and_clear_on_materialize():
+    from volcano_tpu.framework import get_action
+    h = _env(gangs=1)
+    ssn = h.open_session()
+    get_action("enqueue").execute(ssn)
+    get_action("allocate").execute(ssn)
+    job = next(iter(ssn.jobs.values()))
+    # placements are deferred: statuses still Pending, deltas carry them
+    statuses = {t.status for t in job.tasks.values()}
+    if job.deferred_alloc:            # deferred mode active
+        assert statuses == {TaskStatus.Pending}
+        assert job.ready_task_num() == 4
+        node_names = {t.node_name for t in job.tasks.values()}
+        assert "" not in node_names   # eager node_name for event handlers
+        ssn.materialize()
+        assert job.deferred_alloc == 0
+        statuses = {t.status for t in job.tasks.values()}
+        assert statuses == {TaskStatus.Allocated}
+        assert job.ready_task_num() == 4   # unchanged across materialize
+        used = sum(n.used.milli_cpu for n in ssn.nodes.values())
+        assert used == pytest.approx(4000.0)
+    h.close_session()
+
+
+def test_later_actions_see_materialized_state():
+    """backfill/preempt/reclaim in the same cycle must observe allocate's
+    placements (solver context builds materialize)."""
+    h = _env(CONF_CHAIN)
+    h.run_actions("enqueue", "allocate", "backfill", "preempt", "reclaim")
+    ssn = h.ssn
+    h.close_session()
+    h.cache.flush_executors(timeout=30)
+    assert len(h.binds) == 12
+    # session node accounting was materialized by the later actions
+    used = sum(n.used.milli_cpu for n in ssn.nodes.values())
+    assert used == pytest.approx(12000.0)
+
+
+def test_deferred_drop_reverses_deltas():
+    """Discarding an unapplied deferred gang must reverse deltas, shares
+    and node_name without touching statuses or node accounting."""
+    from volcano_tpu.framework.statement import Statement
+    h = _env(gangs=1)
+    ssn = h.open_session()
+    job = next(iter(ssn.jobs.values()))
+    tasks = sorted(job.tasks.values(), key=lambda t: t.name)
+    node = ssn.nodes["n0"]
+    stmt = Statement(ssn)
+    for t in tasks:
+        t.node_name = node.name
+    stmt.record_batch_deferred(job, [(t, node, False) for t in tasks])
+    assert job.deferred_alloc == 4
+    assert job.ready_task_num() == 4
+    prop = ssn.plugins["proportion"]
+    assert prop.queue_opts["default"].allocated.milli_cpu == \
+        pytest.approx(4000.0)
+    stmt.discard()
+    assert job.deferred_alloc == 0
+    assert job.ready_task_num() == 0
+    assert all(t.status == TaskStatus.Pending for t in job.tasks.values())
+    assert all(t.node_name == "" for t in job.tasks.values())
+    assert not node.tasks
+    assert prop.queue_opts["default"].allocated.milli_cpu == pytest.approx(0)
+    # the dropped op stays queued but inert (applied flag): a later
+    # materialize must not resurrect it
+    ssn.materialize()
+    assert all(t.status == TaskStatus.Pending for t in job.tasks.values())
+    assert not node.tasks
+    h.close_session()
+
+
+def test_kept_pipelined_gang_reports_unready_after_materialize():
+    """A gang that can only pipeline (no idle anywhere) is kept, not
+    ready; gang close must materialize it and report unready with real
+    statuses."""
+    h = Harness(CONF)
+    h.add("queues", build_queue("default", weight=1))
+    node = build_node("n0", {"cpu": "4", "memory": "8Gi"})
+    h.add("nodes", node)
+    # a running pod consumes the node; deleting it marks releasing
+    h.add("podgroups", build_pod_group("busy", "ns1", "default", 1,
+                                       phase=PodGroupPhase.RUNNING))
+    busy = build_pod("ns1", "busy-0", "n0", "Running",
+                     build_resource_list("4", "8Gi"), "busy")
+    busy.metadata.deletion_timestamp = 123.0     # terminating => Releasing
+    h.add("pods", busy)
+    h.add("podgroups", build_pod_group("pg", "ns1", "default", 2,
+                                       phase=PodGroupPhase.INQUEUE))
+    for t in range(2):
+        h.add("pods", build_pod("ns1", f"p{t}", "", "Pending",
+                                build_resource_list("2", "4Gi"), "pg"))
+    h.run_actions("enqueue", "allocate")
+    ssn = h.ssn
+    h.close_session()
+    h.cache.flush_executors(timeout=30)
+    assert len(h.binds) == 0          # pipelined: no real binds yet
+    job = next(j for j in ssn.jobs.values() if j.name == "pg")
+    # materialized by gang close: statuses are Pipelined, not Pending
+    assert {t.status for t in job.tasks.values()} == {TaskStatus.Pipelined}
+    assert job.deferred_pipe == 0
+    pg = h.store.get("podgroups", "pg", "ns1")
+    assert any(c.type == "Unschedulable" for c in pg.status.conditions)
+
+
+def test_eager_conf_matches_deferred_binds():
+    conf_eager = CONF + """
+configurations:
+- name: solver
+  arguments: {apply: eager}
+"""
+    h1 = _env()
+    h1.run_actions("enqueue", "allocate").close_session()
+    h1.cache.flush_executors(timeout=30)
+    h2 = _env(conf_eager)
+    h2.run_actions("enqueue", "allocate").close_session()
+    h2.cache.flush_executors(timeout=30)
+    assert h1.binds == h2.binds

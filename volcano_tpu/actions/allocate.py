@@ -212,6 +212,10 @@ class AllocateAction(Action):
             slow.extend((pos_of[job.uid], job, pls) for job, pls in failed)
             slow.sort(key=lambda e: e[0])
 
+        if slow:
+            # per-task staging validates against live node state, so any
+            # deferred placements must be applied first
+            ssn.materialize()
         for _, job, pls in slow:
             stmt = Statement(ssn)
             try:
@@ -250,6 +254,21 @@ class AllocateAction(Action):
                 continue
             if not total.less_equal(node.idle, ZERO):
                 failed_uids.update(j.uid for _, j in entries)
+
+        if getattr(ssn.solver, "deferred_apply", False):
+            # deferred mode: record node_name strings + per-job deltas;
+            # the object-model staging runs at Session.materialize (only
+            # if something reads session placement state this cycle)
+            for job, items in bulk:
+                if job.uid in failed_uids:
+                    continue
+                for t, node, pipelined in items:
+                    t.node_name = node.name
+                stmt = Statement(ssn)
+                stmt.record_batch_deferred(job, items)
+                staged[job.uid] = stmt
+            return [(job, [_P(t, n.name, p) for t, n, p in items])
+                    for job, items in bulk if job.uid in failed_uids]
 
         moved: List = []   # (job, tasks, prior-status) applied status moves
         added: List = []   # (node, pipelined, tasks) applied node adds

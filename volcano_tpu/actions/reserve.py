@@ -25,6 +25,7 @@ class ReserveAction(Action):
             return
         RESERVATION.target_job = target
         if not target.ready():
+            ssn.materialize()   # node idle must include deferred placements
             ssn.reserved_nodes()
         else:
             RESERVATION.reset()

@@ -15,6 +15,7 @@ score terms into, replacing per-task goroutine fan-out with jitted kernels.
 from __future__ import annotations
 
 import itertools
+import logging
 import uuid
 from typing import Callable, Dict, List, Optional
 
@@ -99,6 +100,9 @@ _ENABLE_FOR = {
 }
 
 
+_session_log = logging.getLogger(__name__)
+
+
 class Session:
     """One scheduling cycle's context."""
 
@@ -123,6 +127,57 @@ class Session:
         self._victims_chain_cache: Dict[str, list] = {}
         # TPU batch solver context, populated by open_session
         self.solver = None
+        # deferred-apply queue: gangs whose object-model staging (status
+        # moves, node accounting, pod spec writes) is postponed until
+        # something actually reads session placement state — see
+        # materialize(). Readiness/rollups stay exact via the per-job
+        # deferred_alloc/deferred_pipe deltas.
+        self._deferred_ops: List[object] = []
+
+    # ------------------------------------------------------------------
+    # deferred apply (allocate's burst-cycle fast path)
+    # ------------------------------------------------------------------
+
+    def defer_apply(self, op) -> None:
+        """Queue a staged gang (a Statement _BatchOperation with
+        ``applied=False``) for lazy object-model application."""
+        self._deferred_ops.append(op)
+
+    def _apply_deferred(self, op) -> None:
+        try:
+            op.apply(self)
+        except Exception:
+            # the kernel validated these fits against this same snapshot;
+            # an apply failure means internal drift — apply() rolled its
+            # partial work back and kept the delta-based accounting
+            # (still exact for rollups), so just surface the bug
+            _session_log.exception(
+                "deferred apply failed for job %s; keeping "
+                "delta-based accounting", op.job.uid)
+
+    def materialize(self) -> None:
+        """Apply every pending deferred gang to the session's object model
+        (in staging order). Called by anything that reads placement state:
+        solver context builds, later actions, gang's unready reporting.
+        No-op when nothing is deferred."""
+        if not self._deferred_ops:
+            return
+        ops, self._deferred_ops = self._deferred_ops, []
+        for op in ops:
+            self._apply_deferred(op)
+
+    def materialize_job(self, job) -> None:
+        """Materialize only the deferred gangs of one job (gang's
+        unready-condition reporting touches single jobs)."""
+        if not self._deferred_ops:
+            return
+        keep = []
+        for op in self._deferred_ops:
+            if op.job.uid == job.uid:
+                self._apply_deferred(op)
+            else:
+                keep.append(op)
+        self._deferred_ops = keep
 
     # ------------------------------------------------------------------
     # registration (AddXxxFn, session_plugins.go:37-140)

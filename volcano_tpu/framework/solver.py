@@ -93,6 +93,11 @@ class BatchSolver:
         # scan (gang_allocate_chunked); `pallas` forces Pallas (interpret
         # mode off-TPU, for parity tests); `scan` forces the plain scan.
         self.kernel = "auto"
+        # deferred object-model apply (Session.materialize): allocate
+        # records placements as per-job deltas + node_name strings and the
+        # 50k-task object staging runs only if something reads session
+        # placement state. `apply: eager` restores immediate staging.
+        self.deferred_apply = True
         solver_args = (ssn.configurations or {}).get("solver")
         if solver_args is not None:
             if getattr(solver_args, "get_bool",
@@ -109,6 +114,9 @@ class BatchSolver:
                 self.mesh_chunk = solver_args.get_int("mesh.chunk", 16)
             self.kernel = solver_args.get_str("kernel", "auto") \
                 if hasattr(solver_args, "get_str") else "auto"
+            if hasattr(solver_args, "get_str") and \
+                    solver_args.get_str("apply", "deferred") == "eager":
+                self.deferred_apply = False
         self._sharded_fns: Dict[bool, Callable] = {}
 
     # -- plugin contribution API ------------------------------------------
@@ -210,6 +218,7 @@ class BatchSolver:
         predicate mask + static score for the batch: (narr, batch, gmask,
         static_score)."""
         ssn = self.ssn
+        ssn.materialize()   # deferred placements must be visible to arrays
         narr = NodeArrays.build(ssn.nodes, [n.name for n in ssn.node_list],
                                 self.rindex)
         batch = TaskBatch.build(ordered_jobs, self.rindex)
@@ -265,6 +274,7 @@ class BatchSolver:
         static score back from a tunneled TPU costs seconds at 50k x 10k,
         while the preempt walk only ever reads a few rows."""
         ssn = self.ssn
+        ssn.materialize()   # deferred placements must be visible to arrays
         narr = NodeArrays.build(ssn.nodes, [n.name for n in ssn.node_list],
                                 self.rindex)
         batch = TaskBatch.build(ordered_jobs, self.rindex)

@@ -24,10 +24,79 @@ class _BatchOperation:
     """One staged gang: [(task, node_info, pipelined)] applied together."""
 
     name = "batch"
+    applied = True
 
     def __init__(self, job, items):
         self.job = job
         self.items = items
+
+
+class _DeferredBatch(_BatchOperation):
+    """A staged gang whose object-model apply is deferred
+    (Session.materialize). Until ``apply`` runs, the placements exist as
+    task.node_name strings plus the job's deferred_alloc/deferred_pipe
+    deltas; statuses stay Pending and node accounting is untouched."""
+
+    applied = False
+
+    def apply(self, ssn) -> None:
+        """The postponed staging: bulk status moves, per-node bulk
+        accounting, pod spec writes. All-or-nothing: on any failure the
+        partial mutations are undone, the deltas stay in force (rollups
+        remain exact for the committed gang) and the error re-raises;
+        ``applied``/delta bookkeeping only flips after full success."""
+        if self.applied:
+            return
+        job = self.job
+        alloc = [t for t, _, p in self.items if not p]
+        pipe = [t for t, _, p in self.items if p]
+        moved: List = []
+        added: List = []
+        try:
+            if alloc:
+                job.move_tasks_status_bulk(alloc, TaskStatus.Allocated)
+                moved.append(alloc)
+            if pipe:
+                job.move_tasks_status_bulk(pipe, TaskStatus.Pipelined)
+                moved.append(pipe)
+            groups: dict = {}
+            for task, node, pipelined in self.items:
+                g = groups.setdefault((id(node), pipelined),
+                                      (node, pipelined, []))
+                g[2].append(task)
+            for node, pipelined, tasks in groups.values():
+                node.add_tasks_bulk(tasks, pipelined, share_objects=True)
+                added.append((node, pipelined, tasks))
+                if not pipelined:
+                    name = node.name
+                    for t in tasks:
+                        t.pod.spec.node_name = name
+        except BaseException:
+            for node, pipelined, tasks in reversed(added):
+                for t in tasks:
+                    node.remove_task(t)
+                    t.node_name = node.name   # keep the deferred marker
+                    if not pipelined:
+                        t.pod.spec.node_name = ""
+            for tasks in reversed(moved):
+                job.move_tasks_status_bulk(tasks, TaskStatus.Pending)
+            raise
+        self.applied = True
+        job.deferred_alloc -= len(alloc)
+        job.deferred_pipe -= len(pipe)
+
+    def drop(self, ssn) -> None:
+        """Discard before apply: reverse the deltas and the eager
+        node_name/event effects; nothing else was mutated. Marks the op
+        applied so a queued materialize skips it."""
+        self.applied = True
+        job = self.job
+        alloc_n = sum(1 for _, _, p in self.items if not p)
+        job.deferred_alloc -= alloc_n
+        job.deferred_pipe -= len(self.items) - alloc_n
+        for task, _, _ in self.items:
+            task.node_name = ""
+        ssn._fire_deallocate_batch(job, [t for t, _, _ in self.items])
 
 
 class Statement:
@@ -209,6 +278,19 @@ class Statement:
         self.ssn._fire_allocate_batch(job, [t for t, _, _ in items], total)
         self.operations.append(_BatchOperation(job, items))
 
+    def record_batch_deferred(self, job, items, total=None) -> None:
+        """Register a gang with DEFERRED object-model staging: fires the
+        batched plugin events now (handlers read task.resreq/node_name,
+        both already set), bumps the job's readiness deltas, and queues
+        the apply for Session.materialize."""
+        op = _DeferredBatch(job, items)
+        alloc_n = sum(1 for _, _, p in items if not p)
+        job.deferred_alloc += alloc_n
+        job.deferred_pipe += len(items) - alloc_n
+        self.ssn._fire_allocate_batch(job, [t for t, _, _ in items], total)
+        self.ssn.defer_apply(op)
+        self.operations.append(op)
+
     def _unbatch(self, op: _BatchOperation) -> None:
         for task, node, pipelined in reversed(op.items):
             node.remove_task(task)
@@ -235,6 +317,8 @@ class Statement:
             accepted = [t for t, _ in to_bind]
         if not accepted:
             return
+        if not op.applied:
+            return   # statuses still deferred; deltas carry the accounting
         job_of = ssn.jobs.get(op.job.uid)
         if job_of is not None and \
                 all(t.job == op.job.uid for t in accepted):
@@ -257,7 +341,13 @@ class Statement:
             elif op.name == "allocate":
                 self._unallocate(op.task)
             elif op.name == "batch":
-                self._unbatch(op)
+                if op.applied:
+                    self._unbatch(op)
+                else:
+                    # deferred and never materialized: reverse the deltas;
+                    # drop() marks the op applied so the queued
+                    # materialize entry becomes a no-op (no O(n) removal)
+                    op.drop(self.ssn)
         self.operations = []
 
     def commit(self) -> None:

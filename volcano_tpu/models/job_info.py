@@ -209,6 +209,12 @@ class JobInfo:
         # tens of thousands of times between mutations)
         self._status_version: int = 0
         self._ready_cache: tuple = (-1, 0)
+        # session-scope deferred-apply deltas (Session.materialize):
+        # placements recorded by the allocate action whose object-model
+        # apply (status moves, node accounting) has not run yet. Readiness
+        # and status rollups stay exact by adding the deltas.
+        self.deferred_alloc: int = 0
+        self.deferred_pipe: int = 0
         for t in tasks:
             self.add_task_info(t)
 
@@ -430,7 +436,7 @@ class JobInfo:
         (reference: job_info.go:509-527). Memoized per status version."""
         cached_version, cached = self._ready_cache
         if cached_version == self._status_version:
-            return cached
+            return cached + self.deferred_alloc
         occupied = 0
         for status, tasks in self.task_status_index.items():
             if allocated_status(status) or status == TaskStatus.Succeeded:
@@ -438,10 +444,11 @@ class JobInfo:
             elif status == TaskStatus.Pending:
                 occupied += sum(1 for t in tasks.values() if t.init_resreq.is_empty())
         self._ready_cache = (self._status_version, occupied)
-        return occupied
+        return occupied + self.deferred_alloc
 
     def waiting_task_num(self) -> int:
-        return len(self.task_status_index.get(TaskStatus.Pipelined, {}))
+        return len(self.task_status_index.get(TaskStatus.Pipelined, {})) \
+            + self.deferred_pipe
 
     def valid_task_num(self) -> int:
         occupied = 0

@@ -104,6 +104,9 @@ class GangPlugin(Plugin):
             if job.pod_group is None:
                 continue
             if not job.ready():
+                # deferred placements of kept (pipelined) gangs must be
+                # real before the unready report reads task statuses
+                ssn.materialize_job(job)
                 unready = job.min_available - job.ready_task_num()
                 msg = (f"{unready}/{len(job.tasks)} tasks in gang "
                        f"unschedulable: {job.fit_error()}")
