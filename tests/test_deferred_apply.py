@@ -175,3 +175,46 @@ configurations:
     h2.run_actions("enqueue", "allocate").close_session()
     h2.cache.flush_executors(timeout=30)
     assert h1.binds == h2.binds
+
+
+def test_reclaim_after_deferred_allocate_does_not_double_place():
+    """Regression: reclaim's Pending scan runs before its context build,
+    so deferred-committed tasks (status still Pending) must be
+    materialized at action entry — otherwise they are collected as
+    reclaimers and evict other queues' running pods for capacity they
+    already hold."""
+    # no drf: its share gate (fed by the eagerly-fired events) would mask
+    # the bug; proportion's queue-level reclaimable drives victims here
+    conf = CONF.replace('"enqueue, allocate"', '"enqueue, allocate, reclaim"') \
+               .replace("  - name: drf\n", "")
+    h = Harness(conf)
+    # q2 heavily weighted: still underserved even after its gang lands,
+    # so reclaim actually walks its "pending" tasks
+    h.add("queues", build_queue("q1", weight=1))
+    h.add("queues", build_queue("q2", weight=3))
+    for i in range(4):
+        h.add("nodes", build_node(f"n{i}", {"cpu": "8", "memory": "16Gi"}))
+    # q1: running pods filling two nodes (potential reclaim victims)
+    h.add("podgroups", build_pod_group("q1pg", "ns1", "q1", 2,
+                                       phase=PodGroupPhase.RUNNING))
+    for t in range(2):
+        h.add("pods", build_pod("ns1", f"q1p{t}", f"n{t}", "Running",
+                                build_resource_list("8", "16Gi"), "q1pg"))
+    # q2: a gang that fits on the free nodes — placed this cycle (deferred)
+    h.add("podgroups", build_pod_group("q2pg", "ns1", "q2", 2,
+                                       phase=PodGroupPhase.INQUEUE))
+    for t in range(2):
+        h.add("pods", build_pod("ns1", f"q2p{t}", "", "Pending",
+                                build_resource_list("8", "16Gi"), "q2pg"))
+    h.run_actions("enqueue", "allocate", "reclaim")
+    ssn = h.ssn
+    h.close_session()
+    h.cache.flush_executors(timeout=30)
+    assert len(h.binds) == 2                       # q2 placed on free nodes
+    assert not h.evicts, f"reclaim evicted running pods: {h.evicts}"
+    # no task ended up double-accounted on two nodes
+    seen = {}
+    for n in ssn.nodes.values():
+        for key in n.tasks:
+            assert key not in seen, f"{key} on both {seen[key]} and {n.name}"
+            seen[key] = n.name

@@ -22,6 +22,7 @@ class BackfillAction(Action):
         return "backfill"
 
     def execute(self, ssn) -> None:
+        ssn.materialize()   # Pending scans must not see deferred placements
         jobs_tasks = []
         for job in list(ssn.jobs.values()):
             if job.pod_group.status.phase == PodGroupPhase.PENDING:

@@ -34,6 +34,7 @@ class PreemptAction(Action):
         return "preempt"
 
     def execute(self, ssn) -> None:
+        ssn.materialize()   # Pending scans must not see deferred placements
         # metric updates are lock round-trips; accumulate per execution and
         # flush once (gauge keeps last-set semantics, counter the total).
         # Local state, not attributes: the registered action instance is a

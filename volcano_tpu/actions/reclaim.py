@@ -31,6 +31,10 @@ class ReclaimAction(Action):
         return "reclaim"
 
     def execute(self, ssn) -> None:
+        # deferred placements must be real before the Pending scans below
+        # collect reclaimers (a deferred-committed task is still Pending
+        # in the status index and would double-place)
+        ssn.materialize()
         queue_list = []
         queue_seen = set()
         preemptors_map: Dict[str, List[JobInfo]] = {}

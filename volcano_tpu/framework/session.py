@@ -132,7 +132,9 @@ class Session:
         # something actually reads session placement state — see
         # materialize(). Readiness/rollups stay exact via the per-job
         # deferred_alloc/deferred_pipe deltas.
-        self._deferred_ops: List[object] = []
+        # insertion-ordered uid -> [ops]: materialize() walks all values,
+        # materialize_job() pops one key in O(1)
+        self._deferred_ops: Dict[str, List[object]] = {}
 
     # ------------------------------------------------------------------
     # deferred apply (allocate's burst-cycle fast path)
@@ -141,7 +143,7 @@ class Session:
     def defer_apply(self, op) -> None:
         """Queue a staged gang (a Statement _BatchOperation with
         ``applied=False``) for lazy object-model application."""
-        self._deferred_ops.append(op)
+        self._deferred_ops.setdefault(op.job.uid, []).append(op)
 
     def _apply_deferred(self, op) -> None:
         try:
@@ -162,22 +164,16 @@ class Session:
         No-op when nothing is deferred."""
         if not self._deferred_ops:
             return
-        ops, self._deferred_ops = self._deferred_ops, []
-        for op in ops:
-            self._apply_deferred(op)
+        by_job, self._deferred_ops = self._deferred_ops, {}
+        for ops in by_job.values():
+            for op in ops:
+                self._apply_deferred(op)
 
     def materialize_job(self, job) -> None:
         """Materialize only the deferred gangs of one job (gang's
         unready-condition reporting touches single jobs)."""
-        if not self._deferred_ops:
-            return
-        keep = []
-        for op in self._deferred_ops:
-            if op.job.uid == job.uid:
-                self._apply_deferred(op)
-            else:
-                keep.append(op)
-        self._deferred_ops = keep
+        for op in self._deferred_ops.pop(job.uid, ()):
+            self._apply_deferred(op)
 
     # ------------------------------------------------------------------
     # registration (AddXxxFn, session_plugins.go:37-140)

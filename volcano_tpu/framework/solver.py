@@ -225,8 +225,14 @@ class BatchSolver:
         feats = PredicateFeatures.build(ssn.nodes, narr, batch)
 
         eps = jnp.asarray(self.rindex.eps)
-        fit_cap = group_fit_mask(jnp.asarray(batch.group_req),
-                                 jnp.asarray(narr.capability), eps)
+        # capability fit through unique capability rows: clusters have a
+        # handful of node shapes, so the [G,N,R] broadcast reduce becomes
+        # [G,U,R] (tiny) + one [G,N] gather
+        uniq_cap, inv = np.unique(narr.capability, axis=0,
+                                  return_inverse=True)
+        fit_u = group_fit_mask(jnp.asarray(batch.group_req),
+                               jnp.asarray(uniq_cap), eps)
+        fit_cap = fit_u[:, jnp.asarray(inv.astype(np.int32))]
         gmask = jnp.asarray(narr.valid)[None, :] & fit_cap
         if self.enable_default_predicates:
             # all-trivial features (no selectors / no taints anywhere) make

@@ -44,7 +44,12 @@ class _DeferredBatch(_BatchOperation):
         accounting, pod spec writes. All-or-nothing: on any failure the
         partial mutations are undone, the deltas stay in force (rollups
         remain exact for the committed gang) and the error re-raises;
-        ``applied``/delta bookkeeping only flips after full success."""
+        ``applied``/delta bookkeeping only flips after full success.
+
+        KEEP IN SYNC with AllocateAction._stage_bulk's eager branch: that
+        path stages the same mutations cross-job (with per-node group
+        totals and per-job failure routing); this one applies a single
+        already-validated gang."""
         if self.applied:
             return
         job = self.job
