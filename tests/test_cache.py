@@ -220,3 +220,51 @@ def test_run_after_objects_created_replays_in_dependency_order():
     snap = cache.snapshot()
     assert len(snap.nodes["n1"].tasks) == 1
     assert snap.nodes["n1"].idle.get("cpu") == 3000.0
+
+
+def test_snapshot_prebuild_reuse_and_invalidation():
+    """After a cycle ends, the executor prebuilds the next snapshot in the
+    gap; snapshot() returns it only when nothing mutated since."""
+    from volcano_tpu.apiserver import ObjectStore
+    from volcano_tpu.cache import SchedulerCache
+    from volcano_tpu.utils.test_utils import (FakeBinder, FakeEvictor,
+                                              build_node, build_pod,
+                                              build_pod_group, build_queue,
+                                              build_resource_list)
+
+    store = ObjectStore()
+    cache = SchedulerCache(store, binder=FakeBinder(store),
+                           evictor=FakeEvictor(store))
+    cache.run()
+    store.create("queues", build_queue("default", weight=1))
+    store.create("nodes", build_node("n0", {"cpu": "8", "memory": "16Gi"}))
+    store.create("podgroups", build_pod_group("pg", "ns1", "default", 1,
+                                              phase="Inqueue"))
+    store.create("pods", build_pod("ns1", "p0", "", "Pending",
+                                   build_resource_list("1", "1Gi"), "pg"))
+
+    cache.begin_cycle()
+    snap1 = cache.snapshot()
+    cache.end_cycle()                      # schedules the prebuild
+    assert cache.flush_executors(timeout=10)
+    assert cache._prebuilt is not None
+    prebuilt = cache._prebuilt[1]
+
+    # untouched cache: snapshot() hands out the prebuilt clone
+    snap2 = cache.snapshot()
+    assert snap2 is prebuilt
+    assert cache._prebuilt is None         # consumed, never reused
+    assert len(snap2.jobs) == len(snap1.jobs)
+
+    # a mutation after the next prebuild invalidates it
+    cache.end_cycle()
+    assert cache.flush_executors(timeout=10)
+    assert cache._prebuilt is not None
+    stale = cache._prebuilt[1]
+    store.create("pods", build_pod("ns1", "p1", "", "Pending",
+                                   build_resource_list("1", "1Gi"), "pg"))
+    snap3 = cache.snapshot()
+    assert snap3 is not stale
+    job = next(iter(snap3.jobs.values()))
+    assert len(job.tasks) == 2             # fresh clone includes the event
+    cache.stop()

@@ -98,6 +98,12 @@ class SchedulerCache(EventHandlersMixin):
         self._cycle_idle = threading.Event()
         self._cycle_idle.set()
         self._cycle_gen = 0
+        # snapshot prebuild: after a cycle ends, the executor clones the
+        # cache state in the schedule-period gap; the next snapshot() is
+        # O(1) when nothing mutated since (version-guarded). Any cache
+        # mutation bumps _state_version and invalidates the prebuilt.
+        self._state_version = 0
+        self._prebuilt: Optional[tuple] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -117,6 +123,7 @@ class SchedulerCache(EventHandlersMixin):
         def locked(fn):
             def wrapper(*args):
                 with self.mutex:
+                    self._state_version += 1
                     try:
                         fn(*args)
                     except KeyError:
@@ -243,6 +250,20 @@ class SchedulerCache(EventHandlersMixin):
 
     def end_cycle(self) -> None:
         self._cycle_idle.set()
+        # rebuild the snapshot clone in the inter-cycle gap (after the
+        # executor drains this cycle's binds and their watch echoes)
+        if self._exec_thread is not None:
+            self._submit(self._prebuild_snapshot)
+
+    def _prebuild_snapshot(self) -> None:
+        if not self._cycle_idle.is_set():
+            # a new cycle is already in flight: the clone would hold the
+            # mutex against the hot path and be invalidated by that same
+            # cycle's mutations anyway; the next end_cycle resubmits
+            return
+        with self.mutex:
+            self._drain_applies_locked()
+            self._prebuilt = (self._state_version, self._snapshot_locked())
 
     def flush_executors(self, timeout: float = 30.0) -> bool:
         """Block until all submitted bind/evict writes have executed."""
@@ -274,6 +295,7 @@ class SchedulerCache(EventHandlersMixin):
                 if not self._pending_apply:
                     return
                 fn = self._pending_apply.popleft()
+            self._state_version += 1
             fn()
 
     def client(self) -> ObjectStore:
@@ -288,34 +310,41 @@ class SchedulerCache(EventHandlersMixin):
         from PriorityClass here."""
         with self.mutex:
             self._drain_applies_locked()
-            snap = ClusterInfo()
-            snap.node_list = list(self.node_list)
-            for node in self.nodes.values():
-                node.refresh_numa_scheduler_info()
-            for node in self.nodes.values():
-                if not node.ready():
-                    continue
-                cloned = node.clone()
-                snap.nodes[node.name] = cloned
-                if node.revocable_zone:
-                    snap.revocable_nodes[node.name] = cloned
-            for q in self.queues.values():
-                snap.queues[q.uid] = q.clone()
-            for name, coll in self.namespace_collection.items():
-                info = coll.snapshot()
-                snap.namespaces[info.name] = info
-            for job in self.jobs.values():
-                if job.pod_group is None:
-                    continue
-                if job.queue not in snap.queues:
-                    continue
-                job.priority = self.default_priority
-                pri_name = job.pod_group.spec.priority_class_name
-                pc = self.priority_classes.get(pri_name)
-                if pc is not None:
-                    job.priority = pc.value
-                snap.jobs[job.uid] = job.clone()
-            return snap
+            pre, self._prebuilt = self._prebuilt, None
+            if pre is not None and pre[0] == self._state_version:
+                return pre[1]
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> ClusterInfo:
+        """Snapshot body; caller holds the mutex (applies drained)."""
+        snap = ClusterInfo()
+        snap.node_list = list(self.node_list)
+        for node in self.nodes.values():
+            node.refresh_numa_scheduler_info()
+        for node in self.nodes.values():
+            if not node.ready():
+                continue
+            cloned = node.clone()
+            snap.nodes[node.name] = cloned
+            if node.revocable_zone:
+                snap.revocable_nodes[node.name] = cloned
+        for q in self.queues.values():
+            snap.queues[q.uid] = q.clone()
+        for name, coll in self.namespace_collection.items():
+            info = coll.snapshot()
+            snap.namespaces[info.name] = info
+        for job in self.jobs.values():
+            if job.pod_group is None:
+                continue
+            if job.queue not in snap.queues:
+                continue
+            job.priority = self.default_priority
+            pri_name = job.pod_group.spec.priority_class_name
+            pc = self.priority_classes.get(pri_name)
+            if pc is not None:
+                job.priority = pc.value
+            snap.jobs[job.uid] = job.clone()
+        return snap
 
     # -- find helpers ------------------------------------------------------
 
@@ -334,6 +363,7 @@ class SchedulerCache(EventHandlersMixin):
         """Mark Binding in cache, add to node, then execute the store bind
         (cache.go:605-655). Executor failure enqueues a resync."""
         with self.mutex:
+            self._state_version += 1
             job, task = self._find_job_and_task(task_info)
             node = self.nodes.get(hostname)
             if node is None:
@@ -414,6 +444,7 @@ class SchedulerCache(EventHandlersMixin):
             self._submit(do_bind_all)
             return [t for t, _ in pairs]
         with self.mutex:
+            self._state_version += 1
             apply()
         do_bind_all()
         return accepted
@@ -422,6 +453,7 @@ class SchedulerCache(EventHandlersMixin):
         """Mark Releasing, update node accounting, then delete the pod
         (cache.go:552-601)."""
         with self.mutex:
+            self._state_version += 1
             job, task = self._find_job_and_task(task_info)
             node = self.nodes.get(task.node_name)
             if node is None:
@@ -504,6 +536,7 @@ class SchedulerCache(EventHandlersMixin):
             self._submit(do_evict_all)
             return
         with self.mutex:
+            self._state_version += 1
             apply()
         do_evict_all()
 
@@ -530,6 +563,7 @@ class SchedulerCache(EventHandlersMixin):
     def sync_task(self, old_task: TaskInfo) -> None:
         pod = self.store.get("pods", old_task.name, old_task.namespace)
         with self.mutex:
+            self._state_version += 1
             if pod is None:
                 self._delete_task(old_task)
                 return
@@ -576,6 +610,7 @@ class SchedulerCache(EventHandlersMixin):
     def update_scheduler_numa_info(self, node_res_sets: Dict[str, Dict[str, set]]) -> None:
         """Write allocated NUMA sets back (numaaware plugin session close)."""
         with self.mutex:
+            self._state_version += 1
             for node_name, res_sets in node_res_sets.items():
                 node = self.nodes.get(node_name)
                 if node is not None and node.numa_scheduler_info is not None:
