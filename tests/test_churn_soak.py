@@ -1,0 +1,139 @@
+"""Randomized churn soak: many cycles of arrivals, deletions, node drains
+and preemption pressure, with global invariants checked after every cycle.
+This is the semantic stress gate for the write-behind cache applies,
+deferred session materialization, and snapshot prebuild working together."""
+
+import random
+
+from volcano_tpu.apiserver import ObjectStore
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.models.job_info import TaskStatus
+from volcano_tpu.models.objects import ObjectMeta, PriorityClass
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.utils.test_utils import (FakeBinder, FakeEvictor, build_node,
+                                          build_pod, build_pod_group,
+                                          build_queue, build_resource_list)
+
+CONF = """
+actions: "enqueue, allocate, backfill, preempt, reclaim"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+EPS = 0.5
+
+
+def _invariants(store, cache):
+    with cache.mutex:
+        # cache tasks mirror store pods exactly
+        cache_keys = {t.key() for j in cache.jobs.values()
+                      for t in j.tasks.values()}
+        store_keys = {p.metadata.key() for p in store.list("pods")}
+        assert cache_keys == store_keys, \
+            (cache_keys - store_keys, store_keys - cache_keys)
+        seen = {}
+        for n in cache.nodes.values():
+            used = 0.0
+            for key, t in n.tasks.items():
+                assert key not in seen, \
+                    f"{key} on both {seen[key]} and {n.name}"
+                seen[key] = n.name
+                if t.status != TaskStatus.Pipelined:
+                    used += t.resreq.milli_cpu
+            assert abs(n.used.milli_cpu - used) < EPS, \
+                (n.name, n.used.milli_cpu, used)
+            assert n.idle.milli_cpu >= -EPS, (n.name, n.idle.milli_cpu)
+            total = n.idle.milli_cpu + n.used.milli_cpu
+            assert abs(total - n.allocatable.milli_cpu) < EPS, \
+                (n.name, total, n.allocatable.milli_cpu)
+        # every bound pod's node exists and accounts for it
+        for p in store.list("pods"):
+            if p.spec.node_name:
+                assert p.spec.node_name in cache.nodes, p.metadata.name
+
+
+def test_churn_soak():
+    rng = random.Random(1234)
+    store = ObjectStore()
+    binder = FakeBinder(store)
+    evictor = FakeEvictor(store)
+    cache = SchedulerCache(store, binder=binder, evictor=evictor)
+    cache.run()
+    sched = Scheduler(store, scheduler_conf=CONF, cache=cache)
+    store.create("queues", build_queue("q1", weight=2))
+    store.create("queues", build_queue("q2", weight=1))
+    store.create("priorityclasses",
+                 PriorityClass(metadata=ObjectMeta(name="high"), value=100))
+    for i in range(12):
+        store.create("nodes", build_node(f"n{i:02d}",
+                                         {"cpu": "16", "memory": "32Gi"}))
+
+    next_id = 0
+    live_groups = []
+    for cycle in range(25):
+        # arrivals: 0-3 gangs
+        for _ in range(rng.randrange(4)):
+            name = f"g{next_id}"
+            next_id += 1
+            size = rng.randrange(1, 5)
+            cpu = rng.choice(["1", "2", "4"])
+            queue = rng.choice(["q1", "q2"])
+            pc = "high" if rng.random() < 0.2 else ""
+            store.create("podgroups", build_pod_group(
+                name, "ns1", queue, size, phase="Inqueue",
+                priority_class=pc))
+            for t in range(size):
+                store.create("pods", build_pod(
+                    "ns1", f"{name}-{t}", "", "Pending",
+                    build_resource_list(cpu, "1Gi"), name))
+            live_groups.append((name, size))
+
+        # kubelet sim: bound pods become Running
+        for p in store.list("pods"):
+            if p.spec.node_name and p.status.phase == "Pending":
+                p.status.phase = "Running"
+                store.update("pods", p, skip_admission=True)
+
+        # churn: random pod deletion (completed/killed)
+        if live_groups and rng.random() < 0.4:
+            name, size = rng.choice(live_groups)
+            t = rng.randrange(size)
+            try:
+                store.delete("pods", f"{name}-{t}", "ns1")
+            except KeyError:
+                pass
+
+        # churn: drain a node occasionally (then it comes back next cycle)
+        if rng.random() < 0.15:
+            node = store.get("nodes", f"n{rng.randrange(12):02d}")
+            node.spec.unschedulable = not node.spec.unschedulable
+            store.update("nodes", node, skip_admission=True)
+
+        before = dict(binder.binds)
+        sched.run_once()
+        assert cache.flush_executors(timeout=60)
+        _invariants(store, cache)
+
+        # gang atomicity: every gang here has size == min_member, so a
+        # job binding for the first time must bind its whole gang in one
+        # cycle (all-or-nothing; a pod deleted pre-placement invalidates
+        # the gang entirely instead)
+        prev_jobs = {k.rsplit("-", 1)[0] for k in before}
+        new_by_job = {}
+        for key in set(binder.binds) - set(before):
+            new_by_job[key.rsplit("-", 1)[0]] =                 new_by_job.get(key.rsplit("-", 1)[0], 0) + 1
+        mins = {f"ns1/{name}": size for name, size in live_groups}
+        for jkey, count in new_by_job.items():
+            if jkey not in prev_jobs and jkey in mins:
+                assert count == mins[jkey],                     f"gang {jkey} first-bound {count}/{mins[jkey]}"
+    # end: nothing pending that fits should remain unplaced forever
+    assert binder.binds, "soak produced no binds at all"
