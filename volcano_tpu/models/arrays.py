@@ -306,12 +306,8 @@ class TaskBatch:
             by_queue[qname].append((job, jtasks))
 
         tasks: List[TaskInfo] = []
-        task_group: List[int] = []
+        task_sig: List[int] = []
         task_job: List[int] = []
-        group_ids: Dict[tuple, int] = {}
-        group_reqs: List[np.ndarray] = []
-        group_members: List[List[int]] = []
-        group_keys: List[tuple] = []
         job_uids: List[str] = []
         job_min: List[int] = []
         job_base: List[int] = []
@@ -331,20 +327,41 @@ class TaskBatch:
                 job_base.append(job.ready_task_num())
                 job_start.append(len(tasks))
                 job_queue.append(q_idx)
-                for t in jtasks:
-                    key = (j_idx, _group_sig(t))
-                    g = group_ids.get(key)
-                    if g is None:
-                        g = len(group_reqs)
-                        group_ids[key] = g
-                        group_reqs.append(rindex.vec(t.resreq))
-                        group_members.append([])
-                        group_keys.append(key)
-                    group_members[g].append(len(tasks))
-                    task_group.append(g)
-                    task_job.append(j_idx)
-                    tasks.append(t)
+                tasks.extend(jtasks)
+                task_sig.extend(t.group_sig_cache if t.group_sig_cache
+                                is not None else _group_sig(t)
+                                for t in jtasks)
+                task_job.extend([j_idx] * len(jtasks))
                 job_end.append(len(tasks))
+
+        # group assignment, vectorized: pack (job, sig) into one int64 and
+        # unique it. Group ids come out key-sorted (job-major) instead of
+        # first-appearance — opaque to every consumer (they index rows).
+        if tasks:
+            sig_arr = np.asarray(task_sig, np.int64)
+            if sig_arr.size and int(sig_arr.max()) >= (1 << 32):
+                # the monotone intern ids passed 2^32 (years of churn):
+                # densify this batch's sigs to 0..K-1 (K <= T) so the
+                # 32-bit pack stays collision-free and exact
+                _, sig_arr = np.unique(sig_arr, return_inverse=True)
+                sig_arr = sig_arr.astype(np.int64)
+            packed = (np.asarray(task_job, np.int64) << 32) | sig_arr
+            uniq_keys, first_idx, inverse = np.unique(
+                packed, return_index=True, return_inverse=True)
+            task_group = inverse.astype(np.int32)
+            group_reqs = [rindex.vec(tasks[i].resreq) for i in first_idx]
+            order = np.argsort(inverse, kind="stable")
+            counts = np.bincount(inverse, minlength=len(uniq_keys))
+            bounds = np.cumsum(counts)[:-1]
+            group_members = [m.tolist()
+                             for m in np.split(order, bounds)]
+            group_keys = [(int(k >> 32), int(k & 0xFFFFFFFF))
+                          for k in uniq_keys]
+        else:
+            task_group = np.zeros(0, np.int32)
+            group_reqs = []
+            group_members = []
+            group_keys = []
 
         t_pad = bucket(len(tasks), task_bucket)
         g_pad = bucket(max(1, len(group_reqs)), group_bucket)
