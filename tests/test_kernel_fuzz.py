@@ -1,0 +1,72 @@
+"""Randomized parity fuzz: gang_allocate_chunked (the off-TPU production
+default at scale) must match the plain scan bit-for-bit across randomized
+cluster shapes — mixed gang sizes via mixed groups, finite queue budgets,
+task-topology buckets, releasing capacity (pipelined fits), tight
+capacity (rollbacks), and pipeline-disabled mode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from volcano_tpu.ops.allocate import gang_allocate, gang_allocate_chunked
+from volcano_tpu.ops.score import ScoreWeights
+from volcano_tpu.utils.synth import synth_arrays
+
+
+def _mutate(sa, rng):
+    """Random adversarial state mutations on a synth scenario."""
+    n = sa.node_idle.shape[0]
+    choice = rng.integers(0, 5)
+    if choice == 0:      # tight capacity: most gangs roll back
+        sa.node_idle *= rng.uniform(0.05, 0.2)
+        sa.node_future[:] = sa.node_idle
+    elif choice == 1:    # releasing room: pipelined placements
+        sa.node_idle *= rng.uniform(0.0, 0.1)
+        sa.node_future = sa.node_idle + np.abs(sa.node_future) * 3.0
+    elif choice == 2:    # buckets with pack attraction
+        t = sa.task_bucket.shape[0]
+        sa.task_bucket[:] = rng.integers(-1, 6, t).astype(np.int32)
+        sa.group_pack_bonus[:] = rng.uniform(0.0, 8.0,
+                                             sa.group_pack_bonus.shape)
+    elif choice == 3:    # finite queue budgets: overuse gating mid-scan
+        q = sa.queue_deserved.shape[0]
+        totals = sa.node_idle.sum(axis=0)
+        sa.queue_deserved[:] = totals[None, :] * \
+            rng.uniform(0.05, 0.6, (q, 1)).astype(np.float32)
+    elif choice == 4:    # pod-count caps bite
+        sa.node_max_tasks[:] = rng.integers(1, 4, n).astype(np.int32)
+    return sa
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_chunked_matches_scan_fuzz(seed):
+    rng = np.random.default_rng(seed)
+    n_tasks = int(rng.integers(40, 400))
+    n_nodes = int(rng.integers(8, 160))
+    gang = int(rng.integers(1, 9))
+    n_queues = int(rng.integers(1, 5))
+    sa = synth_arrays(n_tasks, n_nodes, gang_size=gang,
+                      seed=seed * 7 + 1,
+                      utilization=float(rng.uniform(0.0, 0.8)),
+                      rack_affinity=bool(rng.integers(0, 2)),
+                      n_queues=n_queues)
+    sa = _mutate(sa, rng)
+    weights = ScoreWeights.make(
+        sa.group_req.shape[1],
+        binpack=float(rng.uniform(0, 2)),
+        least=float(rng.uniform(0, 2)),
+        most=float(rng.uniform(0, 1)),
+        balanced=float(rng.uniform(0, 2)))
+    allow_pipeline = bool(rng.integers(0, 2))
+    chunk = int(rng.integers(2, 33))
+
+    args = [jnp.asarray(a) for a in sa.args] + [weights]
+    a1, p1, r1, k1, _ = gang_allocate(*args, allow_pipeline=allow_pipeline)
+    a2, p2, r2, k2, _ = gang_allocate_chunked(
+        *args, allow_pipeline=allow_pipeline, chunk=chunk)
+    ctx = f"seed={seed} T={n_tasks} N={n_nodes} gang={gang} chunk={chunk}"
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2), ctx)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2), ctx)
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2), ctx)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2), ctx)
