@@ -125,7 +125,6 @@ def test_chunked_sharded_exactness(chunk, scenario):
 
     sa = synth_arrays(120, 8 * n_dev, gang_size=5, node_pad_to=8 * n_dev,
                       seed=11, utilization=0.45, n_queues=3)
-    rng = np.random.default_rng(7)
     if scenario == "buckets":
         # every gang is one topology bucket with pack attraction
         sa.task_bucket[:120] = np.repeat(np.arange(24, dtype=np.int32), 5)

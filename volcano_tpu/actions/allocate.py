@@ -23,6 +23,7 @@ from typing import Dict, List
 
 from ..framework.plugin import Action
 from ..framework.registry import register_action
+from ..framework.solver import Placement
 from ..framework.statement import Statement
 from ..metrics import metrics as m
 from ..models.job_info import JobInfo, TaskInfo, TaskStatus
@@ -267,7 +268,7 @@ class AllocateAction(Action):
                 stmt = Statement(ssn)
                 stmt.record_batch_deferred(job, items)
                 staged[job.uid] = stmt
-            return [(job, [_P(t, n.name, p) for t, n, p in items])
+            return [(job, [Placement(t, n.name, p) for t, n, p in items])
                     for job, items in bulk if job.uid in failed_uids]
 
         moved: List = []   # (job, tasks, prior-status) applied status moves
@@ -327,7 +328,7 @@ class AllocateAction(Action):
                         t.pod.spec.node_name = ""
             for job, tasks in reversed(moved):
                 job.move_tasks_status_bulk(tasks, TaskStatus.Pending)
-            return [(job, [_P(t, n.name, p) for t, n, p in items])
+            return [(job, [Placement(t, n.name, p) for t, n, p in items])
                     for job, items in bulk]
 
         for job, items in ok_jobs:
@@ -338,7 +339,7 @@ class AllocateAction(Action):
                 if all(not p for _, _, p in items) else None
             stmt.record_batch(job, items, total=total)
             staged[job.uid] = stmt
-        return [(job, [_P(t, n.name, p) for t, n, p in items])
+        return [(job, [Placement(t, n.name, p) for t, n, p in items])
                 for job, items in bulk if job.uid in failed_uids]
 
     def _apply_extra(self, ssn, staged, result_b, phase_b) -> None:
@@ -373,18 +374,6 @@ class AllocateAction(Action):
             else:
                 stmt.discard()
                 m.register_schedule_attempt("unschedulable")
-
-
-class _P:
-    """Minimal placement record (task, node_name, pipelined) for routing
-    bulk-stage fallbacks through the per-job path."""
-
-    __slots__ = ("task", "node_name", "pipelined")
-
-    def __init__(self, task, node_name, pipelined):
-        self.task = task
-        self.node_name = node_name
-        self.pipelined = pipelined
 
 
 class _ZeroMinJob:

@@ -192,10 +192,14 @@ def config_4(n_nodes=10000, n_low=1250, n_high=625) -> Dict:
             store.create("pods", build_pod(
                 "ns1", f"hi-{j}-{t}", "", "Pending",
                 {"cpu": "8", "memory": "16Gi"}, f"hi-{j}"))
-    ssn = open_session(cache, conf.tiers, conf.configurations)
-    t0 = time.perf_counter()
-    get_action("preempt").execute(ssn)
-    ms = (time.perf_counter() - t0) * 1000.0
+    cache.begin_cycle()    # production runs actions inside a cycle window
+    try:
+        ssn = open_session(cache, conf.tiers, conf.configurations)
+        t0 = time.perf_counter()
+        get_action("preempt").execute(ssn)
+        ms = (time.perf_counter() - t0) * 1000.0
+    finally:
+        cache.end_cycle()
     from volcano_tpu.models.job_info import TaskStatus
     evicted = sum(1 for j in ssn.jobs.values() for t in j.tasks.values()
                   if t.status == TaskStatus.Releasing)

@@ -28,8 +28,8 @@ from ..models import objects as obj
 from ..models.cluster_info import ClusterInfo
 from ..models.job_info import JobInfo, TaskInfo, TaskStatus
 from ..models.node_info import NodeInfo
-from ..models.objects import (DEFAULT_QUEUE, DEFAULT_SCHEDULER_NAME, PodGroup,
-                              PodGroupCondition, PodGroupPhase)
+from ..models.objects import (DEFAULT_QUEUE, DEFAULT_SCHEDULER_NAME,
+                              PodGroupPhase)
 from ..models.queue_info import NamespaceCollection, QueueInfo
 from .event_handlers import EventHandlersMixin
 from .interface import (StoreBinder, StoreEvictor, StoreStatusUpdater,

@@ -14,12 +14,10 @@ score terms into, replacing per-task goroutine fan-out with jitted kernels.
 
 from __future__ import annotations
 
-import itertools
 import logging
 import uuid
 from typing import Callable, Dict, List, Optional
 
-from ..models import objects as objlib
 from ..models.cluster_info import ClusterInfo
 from ..models.job_info import JobInfo, TaskInfo, TaskStatus
 from ..models.node_info import NodeInfo

@@ -17,7 +17,7 @@ honored through a per-group fallback sweep, trading speed for generality.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np

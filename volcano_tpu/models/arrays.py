@@ -23,7 +23,7 @@ Key encodings:
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import threading
@@ -279,7 +279,6 @@ class TaskBatch:
     queue_names: List[str]           # first-appearance queue order
     queue_job_start: np.ndarray      # [Q] i32 jobs grouped by queue
     queue_njobs: np.ndarray          # [Q] i32
-    group_keys: List[tuple] = field(default_factory=list)
 
     @property
     def job_n_tasks(self) -> np.ndarray:
@@ -355,13 +354,10 @@ class TaskBatch:
             bounds = np.cumsum(counts)[:-1]
             group_members = [m.tolist()
                              for m in np.split(order, bounds)]
-            group_keys = [(int(k >> 32), int(k & 0xFFFFFFFF))
-                          for k in uniq_keys]
         else:
             task_group = np.zeros(0, np.int32)
             group_reqs = []
             group_members = []
-            group_keys = []
 
         t_pad = bucket(len(tasks), task_bucket)
         g_pad = bucket(max(1, len(group_reqs)), group_bucket)
@@ -399,7 +395,6 @@ class TaskBatch:
             queue_names=queue_names,
             queue_job_start=pad1(queue_job_start, q_pad, np.int32),
             queue_njobs=pad1(queue_njobs, q_pad, np.int32),
-            group_keys=group_keys,
         )
 
     @property
