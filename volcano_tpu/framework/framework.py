@@ -167,7 +167,12 @@ class JobUpdater:
                          for job, update_pg in updates])
 
     def prepare_job(self, job: JobInfo) -> bool:
-        """Roll up the job's status; True if the PodGroup must be pushed."""
+        """Roll up the job's status; True if the PodGroup must be pushed.
+
+        No version-based skip here: task transitions arriving BETWEEN
+        cycles leave the session-internal status version untouched while
+        the stored PodGroup status is stale, so the rollup comparison
+        itself is the only sound change check."""
         ssn = self.ssn
         status = job_status(ssn, job)
         old = getattr(ssn, "pod_group_status", {}).get(job.uid)
