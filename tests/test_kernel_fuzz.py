@@ -70,3 +70,49 @@ def test_chunked_matches_scan_fuzz(seed):
     np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2), ctx)
     np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2), ctx)
     np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2), ctx)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_sharded_chunked_matches_scan_fuzz(seed):
+    """Same randomized parity, over a device mesh: the node-axis-sharded
+    chunked kernel must match the single-device plain scan exactly."""
+    from jax.sharding import Mesh
+
+    from volcano_tpu.ops.sharded import (make_sharded_gang_allocate,
+                                         shard_synth)
+
+    rng = np.random.default_rng(seed + 100)
+    n_dev = int(rng.choice([2, 4]))
+    devices = jax.devices()[:n_dev]
+    if len(devices) < n_dev:
+        pytest.skip("not enough virtual devices")
+    mesh = Mesh(np.array(devices), ("nodes",))
+    n_tasks = int(rng.integers(40, 240))
+    n_nodes = int(rng.integers(2, 12)) * n_dev
+    gang = int(rng.integers(1, 7))
+    sa = synth_arrays(n_tasks, n_nodes, gang_size=gang, seed=seed * 11 + 3,
+                      utilization=float(rng.uniform(0.0, 0.7)),
+                      rack_affinity=bool(rng.integers(0, 2)),
+                      n_queues=int(rng.integers(1, 4)),
+                      node_pad_to=max(n_nodes, 8))
+    sa = _mutate(sa, rng)
+    weights = ScoreWeights.make(
+        sa.group_req.shape[1],
+        binpack=float(rng.uniform(0, 2)),
+        least=float(rng.uniform(0, 2)),
+        most=float(rng.uniform(0, 1)),
+        balanced=float(rng.uniform(0, 2)))
+    chunk = int(rng.integers(1, 17))        # 1 = the per-step sharded body
+    allow_pipeline = bool(rng.integers(0, 2))
+
+    args = [jnp.asarray(a) for a in sa.args] + [weights]
+    a1, p1, r1, k1, _ = gang_allocate(*args, allow_pipeline=allow_pipeline)
+    fn = make_sharded_gang_allocate(mesh, chunk=chunk,
+                                    allow_pipeline=allow_pipeline)
+    sargs = shard_synth(mesh, sa)
+    a2, p2, r2, k2, _ = fn(*sargs, weights)
+    ctx = f"seed={seed} D={n_dev} T={n_tasks} N={n_nodes} chunk={chunk}"
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2), ctx)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2), ctx)
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2), ctx)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2), ctx)

@@ -117,18 +117,23 @@ def _populate(store, n_nodes, n_jobs, gang, queues=None, cpu="2",
 
 
 def _warm_cycle(conf_text: str, **populate_kwargs):
-    """Cold cycle (compile) on one env, then the measured warm cycle on a
-    fresh identical env with the warm-up's executor drained first.
-    Returns (warm_ms, binder)."""
+    """Cold cycle (compile) on one env, then the measured warm cycle on
+    fresh identical envs with the previous env's executor drained first.
+    Takes the min of two warm measurements — single-shot wall numbers on
+    a shared machine carry +-25% co-tenant noise. Returns (ms, binder)."""
     store, cache, binder, conf = _cycle_env(conf_text)
     _populate(store, **populate_kwargs)
     _run_cycle(cache, conf)                # includes compile
     cache.flush_executors(timeout=120.0)   # isolate the warm measurement
-    store2, cache2, binder2, conf2 = _cycle_env(conf_text)
-    _populate(store2, **populate_kwargs)
-    ms = _run_cycle(cache2, conf2)
-    cache2.flush_executors()
-    return ms, binder2
+    best, best_binder = float("inf"), None
+    for _ in range(2):
+        store2, cache2, binder2, conf2 = _cycle_env(conf_text)
+        _populate(store2, **populate_kwargs)
+        ms = _run_cycle(cache2, conf2)
+        cache2.flush_executors(timeout=120.0)
+        if ms < best:
+            best, best_binder = ms, binder2
+    return best, best_binder
 
 
 def config_1() -> Dict:
