@@ -25,7 +25,6 @@ from ..framework.registry import register_plugin_builder
 from ..framework.session import PERMIT, EventHandler
 from ..metrics import metrics as m
 from ..models.arrays import ResourceIndex
-from ..models.job_info import allocated_status
 from ..models.resource import Resource
 
 NAME = "drf"
@@ -127,11 +126,11 @@ class DrfPlugin(Plugin):
         # initial shares: one dense kernel call over [J, R]
         jobs = list(ssn.jobs.values())
         for job in jobs:
-            attr = _DrfAttr()
-            for status, tasks in job.task_status_index.items():
-                if allocated_status(status):
-                    for t in tasks.values():
-                        attr.allocated.add(t.resreq)
+            # JobInfo.allocated is maintained as exactly the sum of
+            # allocated-status task requests (add/delete/move paths), so
+            # the per-task resum is one clone (drf.go:202-230 sums tasks
+            # because Go's JobInfo lacks the running aggregate)
+            attr = _DrfAttr(job.allocated.clone())
             self.job_attrs[job.uid] = attr
         self._batch_update_shares(jobs)
         for job in jobs:

@@ -23,7 +23,6 @@ from ..framework.registry import register_plugin_builder
 from ..framework.session import PERMIT, REJECT, EventHandler
 from ..metrics import metrics as m
 from ..models.arrays import ResourceIndex
-from ..models.job_info import allocated_status
 from ..models.job_info import TaskStatus
 from ..models.objects import PodGroupPhase
 from ..models.resource import INFINITY, ZERO, Resource
@@ -81,14 +80,13 @@ class ProportionPlugin(Plugin):
             if attr is None:
                 attr = _QueueAttr(ssn.queues[job.queue])
                 self.queue_opts[job.queue] = attr
-            for status, tasks in job.task_status_index.items():
-                if allocated_status(status):
-                    for t in tasks.values():
-                        attr.allocated.add(t.resreq)
-                        attr.request.add(t.resreq)
-                elif status == TaskStatus.Pending:
-                    for t in tasks.values():
-                        attr.request.add(t.resreq)
+            # allocated-status sum is maintained on JobInfo; only the
+            # Pending portion of `request` needs a task walk
+            attr.allocated.add(job.allocated)
+            attr.request.add(job.allocated)
+            for t in job.task_status_index.get(TaskStatus.Pending,
+                                               {}).values():
+                attr.request.add(t.resreq)
             if job.pod_group.status.phase == PodGroupPhase.INQUEUE:
                 attr.inqueue.add(job.get_min_resources())
 
