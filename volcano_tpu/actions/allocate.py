@@ -42,6 +42,15 @@ class AllocateAction(Action):
 
     def _ordered_jobs(self, ssn) -> List[JobInfo]:
         """(namespace, queue, job) nested ordering, flattened."""
+        # steady-state fast path: with no Pending task anywhere there is
+        # nothing to order or place. (Per-job skipping would be wrong in
+        # mixed cycles: a taskless job still occupies its namespace's turn
+        # in the round-robin interleave below, exactly like the reference's
+        # per-namespace pops.)
+        if not any(job.task_status_index.get(TaskStatus.Pending)
+                   for job in ssn.jobs.values()):
+            return []
+
         jobs_by_ns_queue: Dict[str, Dict[str, List[JobInfo]]] = {}
         for job in ssn.jobs.values():
             if job.pod_group.status.phase == PodGroupPhase.PENDING:
