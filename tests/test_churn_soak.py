@@ -8,7 +8,8 @@ import random
 from volcano_tpu.apiserver import ObjectStore
 from volcano_tpu.cache import SchedulerCache
 from volcano_tpu.models.job_info import TaskStatus
-from volcano_tpu.models.objects import ObjectMeta, PriorityClass
+from volcano_tpu.models.objects import (GROUP_NAME_ANNOTATION, ObjectMeta,
+                                        PriorityClass)
 from volcano_tpu.scheduler import Scheduler
 from volcano_tpu.utils.test_utils import (FakeBinder, FakeEvictor, build_node,
                                           build_pod, build_pod_group,
@@ -137,3 +138,83 @@ def test_churn_soak():
                 assert count == mins[jkey],                     f"gang {jkey} first-bound {count}/{mins[jkey]}"
     # end: nothing pending that fits should remain unplaced forever
     assert binder.binds, "soak produced no binds at all"
+
+
+def test_churn_soak_destructive():
+    """Harsher churn: whole-node deletion with resident tasks, podgroup
+    deletion mid-flight, and node re-creation — the cache must converge
+    with the store and keep accounting consistent every cycle."""
+    rng = random.Random(4321)
+    store = ObjectStore()
+    binder = FakeBinder(store)
+    cache = SchedulerCache(store, binder=binder, evictor=FakeEvictor(store))
+    cache.run()
+    sched = Scheduler(store, scheduler_conf=CONF, cache=cache)
+    store.create("queues", build_queue("q1", weight=1))
+    for i in range(8):
+        store.create("nodes", build_node(f"n{i:02d}",
+                                         {"cpu": "16", "memory": "32Gi"}))
+
+    next_id = 0
+    killed_nodes = []
+    for cycle in range(20):
+        for _ in range(rng.randrange(3)):
+            name = f"d{next_id}"
+            next_id += 1
+            size = rng.randrange(1, 4)
+            store.create("podgroups", build_pod_group(
+                name, "ns1", "q1", size, phase="Inqueue"))
+            for t in range(size):
+                store.create("pods", build_pod(
+                    "ns1", f"{name}-{t}", "", "Pending",
+                    build_resource_list("2", "2Gi"), name))
+
+        for p in store.list("pods"):
+            if p.spec.node_name and p.status.phase == "Pending":
+                p.status.phase = "Running"
+                store.update("pods", p, skip_admission=True)
+
+        # destroy a node outright (its pods die with it, like a lost VM)
+        if rng.random() < 0.25:
+            victims = store.list("nodes")
+            if victims:
+                node = rng.choice(victims)
+                for p in store.list("pods"):
+                    if p.spec.node_name == node.metadata.name:
+                        try:
+                            store.delete("pods", p.metadata.name,
+                                         p.metadata.namespace)
+                        except KeyError:
+                            pass
+                store.delete("nodes", node.metadata.name)
+                killed_nodes.append(node.metadata.name)
+
+        # delete a whole podgroup + its pods (job cancelled)
+        if rng.random() < 0.3:
+            pgs = store.list("podgroups")
+            if pgs:
+                pg = rng.choice(pgs)
+                for p in store.list("pods"):
+                    if p.metadata.annotations.get(
+                            GROUP_NAME_ANNOTATION) == pg.metadata.name:
+                        try:
+                            store.delete("pods", p.metadata.name,
+                                         p.metadata.namespace)
+                        except KeyError:
+                            pass
+                try:
+                    store.delete("podgroups", pg.metadata.name,
+                                 pg.metadata.namespace)
+                except KeyError:
+                    pass
+
+        # occasionally resurrect a killed node
+        if killed_nodes and rng.random() < 0.5:
+            name = killed_nodes.pop()
+            store.create("nodes", build_node(name,
+                                             {"cpu": "16", "memory": "32Gi"}))
+
+        sched.run_once()
+        assert cache.flush_executors(timeout=60)
+        _invariants(store, cache)
+    assert binder.binds
