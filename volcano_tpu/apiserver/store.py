@@ -73,6 +73,7 @@ class ObjectStore:
     """Thread-safe typed object store with admission + watch."""
 
     JOURNAL_CAPACITY = 65536
+    EVENTS_CAPACITY = 16384
 
     def __init__(self, clock: Clock = GLOBAL_CLOCK):
         self._objects: Dict[str, Dict[str, object]] = {k: {} for k in KINDS}
@@ -81,11 +82,14 @@ class ObjectStore:
         self._rv = 0
         self._lock = threading.RLock()
         self.clock = clock
-        self.events: List[tuple] = []   # (kind, type, reason, message) event records
+        from collections import deque as _deque
+        # (kind, key, type, reason, message) records; bounded like the
+        # reference's TTL'd core/v1 Events — unbounded growth was the one
+        # leak a 100-cycle churn soak surfaced
+        self.events = _deque(maxlen=self.EVENTS_CAPACITY)
         # change journal for remote watchers (the watch-stream seam of the
         # multi-process deployment, docs/deployment.md): (rv, action, kind,
         # object ref — safe to hold, internals are replaced never mutated)
-        from collections import deque as _deque
         self._journal = _deque(maxlen=self.JOURNAL_CAPACITY)
         self._journal_cond = threading.Condition(self._lock)
 
