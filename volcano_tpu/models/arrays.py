@@ -142,27 +142,45 @@ class NodeArrays:
         views = (arr.idle, arr.used, arr.releasing, arr.pipelined,
                  arr.allocatable, arr.capability)
         index = rindex.index
-        for i, name in enumerate(names):
-            ni = nodes[name]
-            arr.valid[i] = True
-            # direct field writes instead of rindex.vec() (6 temp-array
-            # allocations per node dominated the encode at 10k nodes);
-            # scaling applied once per block below
-            for view, res in zip(views, (ni.idle, ni.used, ni.releasing,
-                                         ni.pipelined, ni.allocatable,
-                                         ni.capability)):
-                row = view[i]
-                row[0] = res.milli_cpu
-                row[1] = res.memory
-                if res.scalars:
-                    for sname, quant in res.scalars.items():
-                        si = index.get(sname)
-                        if si is not None:
-                            row[si] = quant
-            arr.max_tasks[i] = ni.allocatable.max_task_num
-            arr.n_tasks[i] = len(ni.tasks)
-            arr.revocable[i] = bool(ni.revocable_zone)
-            arr.oversubscription[i] = ni.oversubscription_node
+        n = len(names)
+        infos = [nodes[name] for name in names]
+        arr.valid[:n] = True
+        if r == 2:
+            # no scalar dimensions anywhere: column-wise fromiter fills
+            # (the per-node row loop cost ~4 us x 10k nodes per build)
+            for view, attr in zip(views, ("idle", "used", "releasing",
+                                          "pipelined", "allocatable",
+                                          "capability")):
+                view[:n, 0] = np.fromiter(
+                    (getattr(ni, attr).milli_cpu for ni in infos),
+                    np.float32, n)
+                view[:n, 1] = np.fromiter(
+                    (getattr(ni, attr).memory for ni in infos),
+                    np.float32, n)
+        else:
+            for i, ni in enumerate(infos):
+                # direct field writes instead of rindex.vec() (6 temp-array
+                # allocations per node dominated the encode at 10k nodes);
+                # scaling applied once per block below
+                for view, res in zip(views, (ni.idle, ni.used, ni.releasing,
+                                             ni.pipelined, ni.allocatable,
+                                             ni.capability)):
+                    row = view[i]
+                    row[0] = res.milli_cpu
+                    row[1] = res.memory
+                    if res.scalars:
+                        for sname, quant in res.scalars.items():
+                            si = index.get(sname)
+                            if si is not None:
+                                row[si] = quant
+        arr.max_tasks[:n] = np.fromiter(
+            (ni.allocatable.max_task_num for ni in infos), np.int32, n)
+        arr.n_tasks[:n] = np.fromiter(
+            (len(ni.tasks) for ni in infos), np.int32, n)
+        arr.revocable[:n] = np.fromiter(
+            (bool(ni.revocable_zone) for ni in infos), bool, n)
+        arr.oversubscription[:n] = np.fromiter(
+            (ni.oversubscription_node for ni in infos), bool, n)
         for view in views:
             view *= rindex.scales[None, :]
         return arr
