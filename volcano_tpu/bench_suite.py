@@ -116,30 +116,35 @@ def _populate(store, n_nodes, n_jobs, gang, queues=None, cpu="2",
 
 
 
-def _warm_cycle(conf_text: str, **populate_kwargs):
-    """Cold cycle (compile) on one env, then the measured warm cycle on
-    fresh identical envs with the previous env's executor drained first.
-    Takes the min of two warm measurements — single-shot wall numbers on
-    a shared machine carry +-25% co-tenant noise. Returns (ms, binder)."""
+def _warm_cycle(conf_text: str, runs: int = 2, flush_timeout: float = 120.0,
+                **populate_kwargs):
+    """Cold cycle (compile) on one env, then measured warm cycles on fresh
+    identical envs with the previous env's executor drained first. Takes
+    the min of ``runs`` warm measurements — single-shot wall numbers on a
+    shared machine carry +-25% co-tenant noise. Returns
+    (ms, flush_ms, binder, cache, conf) of the winning env."""
     store, cache, binder, conf = _cycle_env(conf_text)
     _populate(store, **populate_kwargs)
     _run_cycle(cache, conf)                # includes compile
-    cache.flush_executors(timeout=120.0)   # isolate the warm measurement
-    best, best_binder = float("inf"), None
-    for _ in range(2):
+    cache.flush_executors(timeout=flush_timeout)
+    best = (float("inf"), 0.0, None, None, None)
+    for _ in range(runs):
         store2, cache2, binder2, conf2 = _cycle_env(conf_text)
         _populate(store2, **populate_kwargs)
         ms = _run_cycle(cache2, conf2)
-        cache2.flush_executors(timeout=120.0)
-        if ms < best:
-            best, best_binder = ms, binder2
-    return best, best_binder
+        t0 = time.perf_counter()
+        cache2.flush_executors(timeout=flush_timeout)
+        flush_ms = (time.perf_counter() - t0) * 1000.0
+        if ms < best[0]:
+            best = (ms, flush_ms, binder2, cache2, conf2)
+    return best
 
 
 def config_1() -> Dict:
     """Single gang-of-3 PodGroup (example/job.yaml shape), full cycle."""
-    ms, binder = _warm_cycle(CONF_FULL, n_nodes=4, n_jobs=1, gang=3,
-                             node_cpu="8", node_mem="16Gi")
+    ms, _, binder, _, _ = _warm_cycle(CONF_FULL, n_nodes=4, n_jobs=1,
+                                      gang=3, node_cpu="8",
+                                      node_mem="16Gi")
     assert len(binder.binds) == 3, binder.binds
     return {"config": 1, "desc": "single gang-of-3 PodGroup, full cycle",
             "value_ms": round(ms, 2), "binds": len(binder.binds),
@@ -148,7 +153,8 @@ def config_1() -> Dict:
 
 def config_2() -> Dict:
     """1k tasks x 100 nodes, predicates + binpack, full cycle."""
-    ms, binder = _warm_cycle(CONF_FULL, n_nodes=100, n_jobs=125, gang=8)
+    ms, _, binder, _, _ = _warm_cycle(CONF_FULL, n_nodes=100,
+                                      n_jobs=125, gang=8)
     return {"config": 2, "desc": "1k tasks x 100 nodes full cycle",
             "value_ms": round(ms, 2), "binds": len(binder.binds),
             "platform": _platform()}
@@ -157,8 +163,8 @@ def config_2() -> Dict:
 def config_3() -> Dict:
     """DRF multi-queue fair share: 4 queues, 5k tasks, full cycle."""
     queues = [(f"q{i}", w) for i, w in enumerate([1, 2, 3, 4])]
-    ms, binder = _warm_cycle(CONF_FULL, n_nodes=1000, n_jobs=625, gang=8,
-                             queues=queues)
+    ms, _, binder, _, _ = _warm_cycle(CONF_FULL, n_nodes=1000, n_jobs=625,
+                                      gang=8, queues=queues)
     return {"config": 3,
             "desc": "drf 4-queue fair share, 5k tasks x 1k nodes full cycle",
             "value_ms": round(ms, 2), "binds": len(binder.binds),
@@ -277,23 +283,12 @@ def full_cycle_50k(n_tasks=50_000, n_nodes=10_000) -> Dict:
     """End-to-end runOnce at 50k x 10k through the store-backed cache."""
     log(f"building {n_tasks}x{n_nodes} cluster through the store "
         "(this takes a while)")
-    store, cache, binder, conf = _cycle_env(CONF_FULL)
-    t0 = time.perf_counter()
-    _populate(store, n_nodes=n_nodes, n_jobs=n_tasks // 8, gang=8)
-    log(f"store populated in {time.perf_counter() - t0:.1f}s")
-    ms = _run_cycle(cache, conf)   # single cold cycle (includes compile)
-    log(f"cold cycle: {ms:.0f} ms")
-    cache.flush_executors(timeout=600.0)   # don't let the cold cycle's
-    # async binds steal the GIL from the warm measurement
-    # a second cluster measures the warm cycle (jit cache hit)
-    store2, cache2, binder2, conf2 = _cycle_env(CONF_FULL)
-    _populate(store2, n_nodes=n_nodes, n_jobs=n_tasks // 8, gang=8)
-    warm = _run_cycle(cache2, conf2)
-    t0 = time.perf_counter()
-    cache2.flush_executors(timeout=600.0)
-    flush_ms = (time.perf_counter() - t0) * 1000.0
+    warm, flush_ms, binder2, cache2, conf2 = _warm_cycle(
+        CONF_FULL, flush_timeout=600.0,
+        n_nodes=n_nodes, n_jobs=n_tasks // 8, gang=8)
     # the steady-state duty cycle: everything bound, nothing pending —
-    # what the scheduler runs every period between arrivals
+    # what the scheduler runs every period between arrivals (on the
+    # winning env, whose flush completed)
     steady = min(_run_cycle(cache2, conf2) for _ in range(2))
     return {"config": "full_cycle",
             "desc": f"end-to-end runOnce {n_tasks // 1000}k tasks x "
