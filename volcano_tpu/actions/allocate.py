@@ -247,9 +247,14 @@ class AllocateAction(Action):
         from ..models.resource import Resource, ZERO
 
         # upfront fit validation per (node, allocated) group; the group
-        # totals are kept and reused by add_tasks_bulk below
+        # totals are kept and reused by add_tasks_bulk below, the per-job
+        # totals by the batched plugin events
+        deferred = getattr(ssn.solver, "deferred_apply", False)
         groups: Dict[int, tuple] = {}
+        job_totals: Dict[str, Resource] = {}
         for job, items in bulk:
+            jt = job_totals.setdefault(job.uid, Resource()) if deferred \
+                else None
             for task, node, pipelined in items:
                 key = (id(node), pipelined)
                 g = groups.get(key)
@@ -258,6 +263,8 @@ class AllocateAction(Action):
                     groups[key] = g
                 g[2].append((task, job))
                 g[3].add(task.resreq)
+                if jt is not None:
+                    jt.add(task.resreq)
         failed_uids = set()
         for node, pipelined, entries, total in groups.values():
             if pipelined or node.node is None:
@@ -265,7 +272,7 @@ class AllocateAction(Action):
             if not total.less_equal(node.idle, ZERO):
                 failed_uids.update(j.uid for _, j in entries)
 
-        if getattr(ssn.solver, "deferred_apply", False):
+        if deferred:
             # deferred mode: record node_name strings + per-job deltas;
             # the object-model staging runs at Session.materialize (only
             # if something reads session placement state this cycle)
@@ -275,7 +282,8 @@ class AllocateAction(Action):
                 for t, node, pipelined in items:
                     t.node_name = node.name
                 stmt = Statement(ssn)
-                stmt.record_batch_deferred(job, items)
+                stmt.record_batch_deferred(job, items,
+                                           total=job_totals[job.uid])
                 staged[job.uid] = stmt
             return [(job, [Placement(t, n.name, p) for t, n, p in items])
                     for job, items in bulk if job.uid in failed_uids]
