@@ -12,7 +12,7 @@ from __future__ import annotations
 import base64
 import dataclasses
 import typing
-from typing import Any, Dict, Optional, Type
+from typing import Any, Dict, Optional
 
 from ..models import objects as obj
 

@@ -7,7 +7,7 @@ queue_controller_handler}.go).
 from __future__ import annotations
 
 from collections import deque
-from typing import Dict, Optional, Set
+from typing import Dict, Set
 
 from ...apiserver.store import ConflictError
 from ...models import objects as obj

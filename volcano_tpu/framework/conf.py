@@ -21,7 +21,7 @@ bare plugin name enables everything the plugin registers.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import yaml
 

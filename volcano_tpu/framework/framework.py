@@ -11,7 +11,7 @@ invalid gangs and write the Unschedulable condition).
 from __future__ import annotations
 
 import time as _time
-from typing import Dict, List
+from typing import Dict
 
 from ..models.job_info import JobInfo, TaskStatus, allocated_status
 from ..models.objects import (PodGroupCondition, PodGroupConditionType,

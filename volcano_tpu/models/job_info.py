@@ -15,7 +15,7 @@ from typing import Dict, List, Optional
 
 from ..utils.fastclone import fast_clone
 from . import objects
-from .objects import Pod, PodGroup, PodGroupCondition
+from .objects import Pod, PodGroup
 from .resource import Resource
 from .unschedule_info import FitErrors
 

@@ -3,7 +3,7 @@ namespace_info.go)."""
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 from . import objects
 from .objects import Queue, ResourceQuota

@@ -12,8 +12,6 @@ plugin's JobReady/JobPipelined formulas.
 
 from __future__ import annotations
 
-from collections import defaultdict
-
 from ..framework.plugin import Plugin
 from ..framework.registry import register_plugin_builder
 from ..framework.session import PERMIT, REJECT, ValidateResult

@@ -13,7 +13,7 @@ from __future__ import annotations
 from ..framework.arguments import Arguments
 from ..framework.plugin import Plugin
 from ..framework.registry import register_plugin_builder
-from ..framework.session import ABSTAIN, PERMIT, REJECT
+from ..framework.session import PERMIT, REJECT
 from ..models.objects import PodGroupPhase
 from ..models.resource import Resource, ZERO
 

@@ -19,8 +19,7 @@ import numpy as np
 
 from ..framework.plugin import Plugin
 from ..framework.registry import register_plugin_builder
-from ..models.node_info import get_gpu_memory_of_pod
-from ..models.resource import GPU_MEMORY_RESOURCE, ZERO
+from ..models.resource import GPU_MEMORY_RESOURCE
 from ..models.unschedule_info import (FitError, NODE_AFFINITY_FAILED,
                                       NODE_POD_NUMBER_EXCEEDED,
                                       NODE_PORT_FAILED, NODE_SELECTOR_FAILED,

@@ -6,7 +6,7 @@ excludes locked nodes for every other job until the target schedules.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 
 class ResourceReservation:

@@ -11,7 +11,7 @@ name per group (the `--admission-conf` resourceGroups file).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from ..controllers.podgroup import generate_podgroup_name
 from ..models import objects as obj

@@ -11,7 +11,7 @@ apiserver calling out to the webhook's TLS endpoint.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..apiserver.store import AdmissionError, AdmissionHook, ObjectStore
