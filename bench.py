@@ -97,6 +97,39 @@ def worker(platform: str, n_tasks: int, n_nodes: int, kernel: str,
 # parent: fallback ladder over (platform, kernel, shape)
 # ---------------------------------------------------------------------------
 
+def tpu_alive(timeout_s: float = None) -> bool:
+    """Cheap pre-probe: TPU backend bring-up over the tunnel can HANG for a
+    whole session, and each hung worker burns its full WORKER_TIMEOUT (a
+    dead tunnel used to cost 14 min of timeouts before the ladder reached
+    the CPU fallback). Probe `jax.devices()` in a killable child first so a
+    hung tunnel costs seconds."""
+    if timeout_s is None:
+        # generous enough for a slow-but-alive cold bring-up (healthy
+        # tunnels answer in seconds; the failure mode being guarded is an
+        # indefinite hang), small enough that a dead tunnel costs ~2 min
+        # instead of two 420 s worker timeouts
+        timeout_s = float(os.environ.get("VOLCANO_BENCH_TPU_PROBE_TIMEOUT",
+                                         120))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    code = "import jax; print(jax.devices()[0].platform)"
+    log(f"pre-probing TPU backend (timeout {timeout_s:.0f}s)")
+    t0 = time.monotonic()
+    try:
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           text=True, timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired:
+        log(f"TPU pre-probe HUNG ({timeout_s:.0f}s); skipping all TPU workers")
+        return False
+    # last line only: sitecustomize / runtime banners may precede the print
+    lines = (r.stdout or "").strip().splitlines()
+    plat = lines[-1].strip() if lines else ""
+    alive = r.returncode == 0 and plat == "tpu"
+    log(f"TPU pre-probe: rc={r.returncode} platform={plat!r} "
+        f"({time.monotonic() - t0:.1f}s) -> {'alive' if alive else 'dead'}")
+    return alive
+
+
 def try_worker(platform: str, n_tasks: int, n_nodes: int, kernel: str):
     env = dict(os.environ)
     if platform != "cpu":
@@ -158,7 +191,8 @@ def main() -> None:
         # the suite runs in a killable child: TPU first, CPU fallback.
         extra = [a for a in sys.argv[2:]]
         timeout_s = float(os.environ.get("VOLCANO_BENCH_ALL_TIMEOUT", 2400))
-        for platform in ("tpu", "cpu"):
+        platforms = ("tpu", "cpu") if tpu_alive() else ("cpu",)
+        for platform in platforms:
             env = dict(os.environ)
             if platform == "cpu":
                 env["JAX_PLATFORMS"] = "cpu"
@@ -187,11 +221,14 @@ def main() -> None:
     # inside the driver's patience.
     deadline = time.monotonic() + float(
         os.environ.get("VOLCANO_BENCH_DEADLINE", 1800))
+    # a dead tunnel is detected by the pre-probe in minutes instead of two
+    # full worker timeouts; workers that fail later also mark it down
+    tpu_down = not tpu_alive()
     tpu_failures = 0
     for n_tasks, n_nodes in SHAPES:
         for platform, kernel in (("tpu", "pallas"), ("tpu", "chunked"),
                                  ("cpu", "chunked"), ("cpu", "scan")):
-            if platform == "tpu" and tpu_failures >= 2:
+            if platform == "tpu" and (tpu_down or tpu_failures >= 2):
                 continue   # TPU is down for this run; stop burning timeouts
             if time.monotonic() > deadline:
                 log("global deadline reached")

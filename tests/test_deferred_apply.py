@@ -218,3 +218,57 @@ def test_reclaim_after_deferred_allocate_does_not_double_place():
         for key in n.tasks:
             assert key not in seen, f"{key} on both {seen[key]} and {n.name}"
             seen[key] = n.name
+
+
+def test_apply_failure_before_commit_drops_gang(monkeypatch):
+    """A deferred apply that fails BEFORE the statement committed must drop
+    the gang: deltas reversed, node_name cleared, commit dispatches no
+    bind, discard skips the un-stage."""
+    from volcano_tpu.framework.statement import Statement, _DeferredBatch
+    h = _env(gangs=1)
+    ssn = h.open_session()
+    job = next(iter(ssn.jobs.values()))
+    tasks = sorted(job.tasks.values(), key=lambda t: t.name)
+    node = ssn.nodes["n0"]
+    stmt = Statement(ssn)
+    for t in tasks:
+        t.node_name = node.name
+    stmt.record_batch_deferred(job, [(t, node, False) for t in tasks])
+    monkeypatch.setattr(_DeferredBatch, "apply",
+                        lambda self, ssn: (_ for _ in ()).throw(
+                            RuntimeError("synthetic apply failure")))
+    ssn.materialize()
+    assert job.deferred_alloc == 0
+    assert all(t.node_name == "" for t in job.tasks.values())
+    assert all(t.status == TaskStatus.Pending for t in job.tasks.values())
+    assert not node.tasks
+    stmt.commit()          # dead op: no bind may be dispatched
+    h.close_session()
+    h.cache.flush_executors(timeout=30)
+    assert len(h.binds) == 0
+
+
+def test_apply_failure_after_commit_keeps_deltas(monkeypatch):
+    """A deferred apply that fails AFTER the binds were dispatched must
+    keep the delta accounting (the pods are really binding) and the binds
+    must land."""
+    from volcano_tpu.framework.statement import _DeferredBatch
+
+    def boom(self, ssn):
+        raise RuntimeError("synthetic apply failure")
+
+    h = _env(gangs=1)
+    ssn = h.open_session()
+    from volcano_tpu.framework import get_action
+    get_action("enqueue").execute(ssn)
+    get_action("allocate").execute(ssn)   # stages deferred + commits
+    job = next(iter(ssn.jobs.values()))
+    # the env must exercise the deferred path, else this test guards nothing
+    assert job.deferred_alloc == 4, "deferred mode not active for this env"
+    monkeypatch.setattr(_DeferredBatch, "apply", boom)
+    ssn.materialize()
+    assert job.deferred_alloc == 4        # deltas stand post-commit
+    assert job.ready_task_num() == 4
+    h.close_session()
+    h.cache.flush_executors(timeout=30)
+    assert len(h.binds) == 4

@@ -1,0 +1,106 @@
+"""Phase-level timing of one full runOnce at bench scale (CPU by default).
+
+Instruments the production cycle path with perf_counter wrappers (snapshot,
+plugin opens, solver context build, kernel, staging, finalize, close, bind
+flush) and prints a phase table — the measurement harness behind
+docs/design/perf.md's budget rows.
+
+Usage:  JAX_PLATFORMS=cpu python tools/phase_timer.py [n_tasks] [n_nodes]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")   # beat sitecustomize pin
+
+TIMES: dict = {}
+COUNTS: dict = {}
+
+
+def wrap(obj, name: str, label: str) -> None:
+    orig = getattr(obj, name)
+
+    def timed(*a, **k):
+        t0 = time.perf_counter()
+        try:
+            return orig(*a, **k)
+        finally:
+            dt = time.perf_counter() - t0
+            TIMES[label] = TIMES.get(label, 0.0) + dt
+            COUNTS[label] = COUNTS.get(label, 0) + 1
+    setattr(obj, name, timed)
+
+
+def main() -> None:
+    n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+    n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
+
+    from volcano_tpu import bench_suite as bs
+    from volcano_tpu.actions.allocate import AllocateAction
+    from volcano_tpu.cache.cache import SchedulerCache
+    from volcano_tpu.framework import framework as fw
+    from volcano_tpu.framework.solver import BatchSolver
+
+    def log(msg):
+        print(f"[phase] {msg}", file=sys.stderr, flush=True)
+
+    # cold env: compile
+    log(f"building cold env {n_tasks}x{n_nodes}")
+    store, cache, binder, conf = bs._cycle_env(bs.CONF_FULL)
+    bs._populate(store, n_nodes=n_nodes, n_jobs=n_tasks // 8, gang=8)
+    log("cold cycle (compile)")
+    bs._run_cycle(cache, conf)
+    cache.flush_executors(timeout=600.0)
+    del store, cache, binder
+
+    # instrument
+    wrap(SchedulerCache, "snapshot", "snapshot")
+    wrap(BatchSolver, "_build_context", "build_context")
+    wrap(BatchSolver, "place", "place_total")
+    wrap(AllocateAction, "_ordered_jobs", "ordered_jobs")
+    wrap(AllocateAction, "_stage", "stage")
+    wrap(AllocateAction, "_finalize", "finalize")
+    wrap(fw, "open_session", "open_session")
+    wrap(fw, "close_session", "close_session")
+
+    log(f"building measured env {n_tasks}x{n_nodes}")
+    store, cache, binder, conf = bs._cycle_env(bs.CONF_FULL)
+    bs._populate(store, n_nodes=n_nodes, n_jobs=n_tasks // 8, gang=8)
+    log("measured cycle")
+    ms = bs._run_cycle(cache, conf)
+    t0 = time.perf_counter()
+    cache.flush_executors(timeout=600.0)
+    flush_ms = (time.perf_counter() - t0) * 1000.0
+
+    kernel = TIMES.get("place_total", 0.0) - TIMES.get("build_context", 0.0)
+    opens = TIMES.get("open_session", 0.0) - TIMES.get("snapshot", 0.0)
+    print(f"\n=== phase table ({n_tasks}x{n_nodes}, "
+          f"binds={len(binder.binds)}) ===")
+    rows = [
+        ("full runOnce", ms),
+        ("  open_session", TIMES.get("open_session", 0.0) * 1000),
+        ("    snapshot", TIMES.get("snapshot", 0.0) * 1000),
+        ("    plugin opens + valid", opens * 1000),
+        ("  ordered_jobs", TIMES.get("ordered_jobs", 0.0) * 1000),
+        ("  place (kernel+context)", TIMES.get("place_total", 0.0) * 1000),
+        ("    build_context (encode)", TIMES.get("build_context", 0.0) * 1000),
+        ("    kernel+decode", kernel * 1000),
+        ("  stage", TIMES.get("stage", 0.0) * 1000),
+        ("  finalize", TIMES.get("finalize", 0.0) * 1000),
+        ("  close_session", TIMES.get("close_session", 0.0) * 1000),
+        ("bind flush (background)", flush_ms),
+    ]
+    for label, v in rows:
+        print(f"{label:<30} {v:>10.1f} ms")
+    # steady-state cycle after flush
+    steady = min(bs._run_cycle(cache, conf) for _ in range(2))
+    print(f"{'steady-state runOnce':<30} {steady:>10.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -19,7 +19,7 @@ replaced by the batched TPU solver:
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ..framework.plugin import Action
 from ..framework.registry import register_action

@@ -147,13 +147,30 @@ class Session:
         try:
             op.apply(self)
         except Exception:
-            # the kernel validated these fits against this same snapshot;
-            # an apply failure means internal drift — apply() rolled its
-            # partial work back and kept the delta-based accounting
-            # (still exact for rollups), so just surface the bug
-            _session_log.exception(
-                "deferred apply failed for job %s; keeping "
-                "delta-based accounting", op.job.uid)
+            # the kernel validated these fits against this same snapshot, so
+            # an apply failure means internal drift. apply() rolled its
+            # partial work back; continuing with only the delta accounting
+            # would split state for the rest of the cycle (node accounting
+            # missing the gang while readiness rollups count it, so
+            # backfill/preempt could over-place against those nodes).
+            if op.committed:
+                # the gang's binds were already dispatched to the cache:
+                # the pods are really binding, so the deltas must stand
+                # (rollups stay exact); the cycle ends with optimistic node
+                # accounting and the cache reconverges from the store
+                _session_log.exception(
+                    "deferred apply failed for job %s AFTER its binds were "
+                    "dispatched; keeping delta-based accounting", op.job.uid)
+            else:
+                # not committed yet: drop the gang entirely — reverse the
+                # deltas, clear the node_name markers, fire the deallocate
+                # events, and mark the op dead so its statement's commit
+                # skips the bind and discard skips the un-stage
+                _session_log.exception(
+                    "deferred apply failed for job %s; dropping the gang "
+                    "(it re-enters as Pending next cycle)", op.job.uid)
+                op.drop(self)
+                op.dead = True
 
     def materialize(self) -> None:
         """Apply every pending deferred gang to the session's object model

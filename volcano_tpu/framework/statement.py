@@ -25,6 +25,13 @@ class _BatchOperation:
 
     name = "batch"
     applied = True
+    # set by Session._apply_deferred when a deferred apply failed and the
+    # gang was dropped: commit must not bind it, discard must not un-stage it
+    dead = False
+    # flipped by _commit_batch once the gang's binds were dispatched to the
+    # cache: a later deferred-apply failure must NOT drop the gang then —
+    # the pods are really binding, so the delta accounting has to stand
+    committed = False
 
     def __init__(self, job, items):
         self.job = job
@@ -312,16 +319,19 @@ class Statement:
         in one locked pass (cache.bind_batch); pipelined ones stay
         session-state only, exactly like the per-task ops."""
         ssn = self.ssn
+        if op.dead:
+            return   # apply failed mid-cycle; the gang was dropped
         to_bind = [(task, node.name) for task, node, pipelined in op.items
                    if not pipelined]
         if not to_bind:
-            return
+            return   # all-pipelined gang: nothing dispatched, drop stays safe
         if ssn.cache is not None:
             accepted = ssn.cache.bind_batch(to_bind)
         else:
             accepted = [t for t, _ in to_bind]
         if not accepted:
             return
+        op.committed = True
         if not op.applied:
             return   # statuses still deferred; deltas carry the accounting
         job_of = ssn.jobs.get(op.job.uid)
@@ -346,6 +356,8 @@ class Statement:
             elif op.name == "allocate":
                 self._unallocate(op.task)
             elif op.name == "batch":
+                if op.dead:
+                    continue   # already dropped by Session._apply_deferred
                 if op.applied:
                     self._unbatch(op)
                 else:
