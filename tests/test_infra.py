@@ -163,3 +163,99 @@ class TestNumaAgent:
                                      policy="single-numa-node"))
         h.run_actions("allocate").close_session()
         assert h.binds == {"ns1/p0": "n1"}
+
+
+class TestPatchBatch:
+    """ObjectStore.patch_batch: the bulk bind write path."""
+
+    def _store_with_pods(self, n=3):
+        from volcano_tpu.utils.test_utils import build_pod
+        store = ObjectStore()
+        for i in range(n):
+            store.create("pods", build_pod("ns1", f"p{i}", "", "Pending",
+                                           {"cpu": "1", "memory": "1Gi"}))
+        return store
+
+    def test_patches_apply_and_watchers_fire(self):
+        store = self._store_with_pods()
+        events = []
+        bulk = []
+        store.watch("pods", on_update=lambda o, n: events.append(
+            (o.spec.node_name, n.spec.node_name)), sync=False)
+        store.watch("pods", on_bulk_update=lambda pairs: bulk.extend(pairs),
+                    sync=False)
+
+        def setter(host):
+            def fn(p):
+                p.spec.node_name = host
+            return fn
+
+        pairs, missing = store.patch_batch(
+            "pods", [("p0", "ns1", setter("n0")), ("p1", "ns1", setter("n1")),
+                     ("nope", "ns1", setter("nx"))])
+        assert len(pairs) == 2 and missing == [("nope", "ns1")]
+        # stored state reflects the patches with bumped rvs
+        assert store.get("pods", "p0", "ns1").spec.node_name == "n0"
+        assert store.get("pods", "p1", "ns1").spec.node_name == "n1"
+        rvs = [n.metadata.resource_version for _, n in pairs]
+        assert rvs == sorted(rvs) and rvs[0] > 0
+        # per-event watcher saw both updates; bulk watcher got one delivery
+        assert events == [("", "n0"), ("", "n1")]
+        assert [(o.metadata.name, n.spec.node_name) for o, n in bulk] == \
+            [("p0", "n0"), ("p1", "n1")]
+
+    def test_raising_fn_keeps_store_watchers_consistent(self):
+        """A patch fn that raises mid-batch must leave the committed prefix
+        announced (journal + watchers) and the failing item unapplied."""
+        import pytest
+        store = self._store_with_pods()
+        seen = []
+        store.watch("pods", on_bulk_update=lambda pairs: seen.extend(pairs),
+                    sync=False)
+        rv_before = store.current_rv()
+
+        def ok(p):
+            p.spec.node_name = "n0"
+
+        def boom(p):
+            raise RuntimeError("bad patch")
+
+        with pytest.raises(RuntimeError):
+            store.patch_batch("pods", [("p0", "ns1", ok),
+                                       ("p1", "ns1", boom),
+                                       ("p2", "ns1", ok)])
+        # p0 committed and delivered; p1/p2 untouched
+        assert [o.metadata.name for o, _ in seen] == ["p0"]
+        assert store.get("pods", "p0", "ns1").spec.node_name == "n0"
+        assert store.get("pods", "p1", "ns1").spec.node_name == ""
+        assert store.get("pods", "p2", "ns1").spec.node_name == ""
+        events, _, resync = store.events_since(rv_before, timeout=0.1)
+        assert not resync and len(events) == 1   # journal matches the store
+
+    def test_non_bind_patch_reaches_cache_views(self):
+        """A patch_batch that flips an annotation must NOT take the cache's
+        bind-echo fast path: derived fields (preemptable) must refresh."""
+        from volcano_tpu.cache import SchedulerCache
+        from volcano_tpu.models.objects import PREEMPTABLE_KEY
+        from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                                  build_pod_group,
+                                                  build_queue)
+        store = ObjectStore()
+        cache = SchedulerCache(store)
+        cache.run()
+        store.create("queues", build_queue("default"))
+        store.create("nodes", build_node("n0", {"cpu": "8",
+                                                "memory": "16Gi"}))
+        store.create("podgroups", build_pod_group("pg", "ns1", "default", 1))
+        store.create("pods", build_pod("ns1", "p0", "n0", "Running",
+                                       {"cpu": "1", "memory": "1Gi"}, "pg"))
+        cache.flush_executors()
+
+        def flip(p):
+            p.metadata.annotations[PREEMPTABLE_KEY] = "true"
+
+        store.patch_batch("pods", [("p0", "ns1", flip)])
+        cache.flush_executors()
+        with cache.mutex:
+            task = next(iter(cache.jobs["ns1/pg"].tasks.values()))
+            assert task.preemptable is True

@@ -14,6 +14,8 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 if os.environ.get("JAX_PLATFORMS") == "cpu":
     import jax
     jax.config.update("jax_platforms", "cpu")   # beat sitecustomize pin

@@ -272,7 +272,10 @@ class RemoteStore:
         return self.client.list(kind, namespace)
 
     def watch(self, kind: str, on_add=None, on_update=None, on_delete=None,
-              filter_fn=None, sync: bool = True):
+              filter_fn=None, sync: bool = True, on_bulk_update=None):
+        # bulk delivery is an in-process fast path; the remote mirror
+        # replays journal events one at a time, so bulk subscribers simply
+        # receive per-event on_update calls (same semantics)
         return self.mirror.watch(kind, on_add, on_update, on_delete,
                                  filter_fn, sync)
 

@@ -58,15 +58,33 @@ class AdmissionHook:
 
 class Watch:
     def __init__(self, kind: str, on_add=None, on_update=None, on_delete=None,
-                 filter_fn: Optional[Callable] = None):
+                 filter_fn: Optional[Callable] = None,
+                 on_bulk_update: Optional[Callable] = None):
         self.kind = kind
         self.on_add = on_add
         self.on_update = on_update
         self.on_delete = on_delete
         self.filter_fn = filter_fn
+        # optional batched delivery: on_bulk_update([(old, new), ...]) for
+        # patch_batch bursts (a 50k-bind flush otherwise pays per-event
+        # handler dispatch + locking); watchers without it get per-pair
+        # on_update calls
+        self.on_bulk_update = on_bulk_update
 
     def _passes(self, o) -> bool:
         return self.filter_fn is None or self.filter_fn(o)
+
+
+def _derive_pod(o) -> None:
+    # compute the pod's aggregate resource request once at admission (the
+    # apiserver computes derived defaults the same way): the memo rides
+    # every clone handed out afterwards — watch ingest copies, bind patch
+    # copies, echo copies — so TaskInfo rebuilds never re-parse quantities
+    o.resource_request()
+
+
+# kind -> derived-field computation run once when an object enters the store
+_DERIVED = {"pods": _derive_pod}
 
 
 class ObjectStore:
@@ -132,6 +150,9 @@ class ObjectStore:
         # (webhook-manager callbacks) must not stall every other writer
         if not skip_admission:
             self._admit(kind, "CREATE", o)
+        derive = _DERIVED.get(kind)
+        if derive is not None:
+            derive(o)   # after admission: mutating hooks may change the spec
         with self._lock:
             key = self.key_of(kind, o)
             if key in self._objects[kind]:
@@ -169,6 +190,9 @@ class ObjectStore:
             if old_pre is None:
                 raise KeyError(f"{kind} {key!r} not found")
             self._admit(kind, "UPDATE", o, old_pre)   # outside the lock
+        derive = _DERIVED.get(kind)
+        if derive is not None:
+            derive(o)
         with self._lock:
             old = self._objects[kind].get(key)
             if old is None:
@@ -196,6 +220,73 @@ class ObjectStore:
             elif old_p and not new_p and w.on_delete:
                 w.on_delete(old)
         return o
+
+    def patch_batch(self, kind: str, patches) -> tuple:
+        """Apply ``[(name, namespace, fn)]`` under ONE lock pass: each fn
+        mutates a fresh clone of the stored object, which becomes the new
+        stored version (rv bump + journal entry each). Admission is skipped
+        by design — the only caller is the bind path, and the reference's
+        POST .../binding does not re-run pod admission either.
+
+        Returns ``(pairs, missing)`` where pairs is [(old, new)] of applied
+        patches and missing the [(name, namespace)] whose object was gone.
+
+        Watch delivery: watchers exposing ``on_bulk_update`` get one call
+        with their [(old, new)] list, where ``new`` is the STORE'S OWN
+        object delivered transiently — the handler must neither mutate nor
+        retain it (clone first to keep anything); this saves one deep pod
+        copy per patch on the 50k-bind flush. Watchers without a bulk
+        handler get per-pair on_update with the usual per-watcher copy."""
+        pairs: list = []
+        missing: list = []
+        watches: list = []
+        try:
+            with self._lock:
+                try:
+                    for name, namespace, fn in patches:
+                        key = name if kind in CLUSTER_SCOPED \
+                            else f"{namespace}/{name}"
+                        old = self._objects[kind].get(key)
+                        if old is None:
+                            missing.append((name, namespace))
+                            continue
+                        new = fast_clone(old)
+                        fn(new)   # a raising fn aborts THIS item pre-commit;
+                        #           already-committed items still notify and
+                        #           deliver below (finally) before re-raise
+                        self._rv += 1
+                        new.metadata.resource_version = self._rv
+                        self._objects[kind][key] = new
+                        self._journal.append((self._rv, "MODIFIED", kind, new))
+                        pairs.append((old, new))
+                finally:
+                    if pairs:
+                        self._journal_cond.notify_all()
+                        watches = list(self._watches[kind])
+        finally:
+            for w in watches:
+                if w.on_bulk_update is not None:
+                    delivery = []
+                    for old, new in pairs:
+                        old_p, new_p = w._passes(old), w._passes(new)
+                        if old_p and new_p:
+                            delivery.append((old, new))
+                        elif not old_p and new_p and w.on_add:
+                            w.on_add(fast_clone(new))
+                        elif old_p and not new_p and w.on_delete:
+                            w.on_delete(old)
+                    if delivery:
+                        w.on_bulk_update(delivery)
+                    continue
+                for old, new in pairs:
+                    old_p, new_p = w._passes(old), w._passes(new)
+                    if old_p and new_p and w.on_update:
+                        w.on_update(old, fast_clone(new))
+                    elif not old_p and new_p and w.on_add:
+                        w.on_add(fast_clone(new))
+                    elif old_p and not new_p and w.on_delete:
+                        w.on_delete(old)
+        return pairs, missing
 
     def delete(self, kind: str, name: str, namespace: str = "default",
                skip_admission: bool = False) -> int:
@@ -239,10 +330,12 @@ class ObjectStore:
     # -- watch -------------------------------------------------------------
 
     def watch(self, kind: str, on_add=None, on_update=None, on_delete=None,
-              filter_fn=None, sync: bool = True) -> Watch:
+              filter_fn=None, sync: bool = True,
+              on_bulk_update=None) -> Watch:
         """Subscribe to events for a kind; with sync=True, existing objects
         are replayed through on_add first (informer list+watch semantics)."""
-        w = Watch(kind, on_add, on_update, on_delete, filter_fn)
+        w = Watch(kind, on_add, on_update, on_delete, filter_fn,
+                  on_bulk_update=on_bulk_update)
         with self._lock:
             self._watches[kind].append(w)
             existing = list(self._objects[kind].values()) if sync else []

@@ -140,7 +140,8 @@ class SchedulerCache(EventHandlersMixin):
         w.append(s.watch("queues", locked(self.add_queue), locked(self.update_queue),
                          locked(self.delete_queue)))
         w.append(s.watch("pods", locked(self.add_pod), locked(self.update_pod),
-                         locked(self.delete_pod), filter_fn=self._responsible_for))
+                         locked(self.delete_pod), filter_fn=self._responsible_for,
+                         on_bulk_update=self.update_pods_bulk))
         w.append(s.watch("priorityclasses", locked(self.add_priority_class),
                          locked(self.update_priority_class),
                          locked(self.delete_priority_class)))
@@ -430,6 +431,25 @@ class SchedulerCache(EventHandlersMixin):
         def do_bind_all():
             with self.mutex:
                 self._drain_applies_locked()
+            bind_all = getattr(self.binder, "bind_batch", None)
+            if bind_all is not None:
+                try:
+                    missing = bind_all([(pod, hostname)
+                                        for _, pod, hostname in bound])
+                except Exception:
+                    for task, _, _ in bound:
+                        self.resync_task(task)
+                    return
+                gone = {id(pod) for pod, _ in missing}
+                for task, pod, hostname in bound:
+                    if id(pod) in gone:
+                        self.resync_task(task)
+                    else:
+                        self.store.record_event(
+                            "pods", pod, "Normal", "Scheduled",
+                            f"Successfully assigned {task.namespace}/"
+                            f"{task.name} to {hostname}")
+                return
             for task, pod, hostname in bound:
                 try:
                     self.binder.bind(pod, hostname)

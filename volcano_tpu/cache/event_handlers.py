@@ -14,7 +14,8 @@ from typing import Optional
 from ..models import objects as obj
 from ..models.arrays import _group_sig
 from ..models.job_info import (JobInfo, TaskInfo, allocated_status,
-                               get_job_id, is_terminated)
+                               get_job_id, get_task_status, is_terminated)
+from ..utils.fastclone import fast_clone
 from ..models.node_info import NodeInfo
 from ..models.queue_info import NamespaceCollection, QueueInfo
 
@@ -111,6 +112,58 @@ class EventHandlersMixin:
             return
         self._delete_task(TaskInfo(old))
         self.add_pod(new)
+
+    def update_pods_bulk(self, pairs) -> None:
+        """Batched echo ingest for patch_batch bursts (bind writes): one
+        mutex pass and one state-version bump for the whole delivery.
+
+        The delivered ``new`` objects are the store's own (transient,
+        read-only — see ObjectStore.patch_batch). A pure bind echo — same
+        node, allocated-like on both sides, same request — reduces to a
+        status-index move plus a resource_version refresh on the pod the
+        cache already holds, with the transient object dropped: zero
+        clones, no TaskInfo rebuild. Anything else falls back to
+        :meth:`update_pod` on a private copy."""
+        with self.mutex:
+            self._state_version += 1
+            for old, new in pairs:
+                jid = get_job_id(new)
+                job = self.jobs.get(jid) if jid else None
+                cached = None
+                if job is not None:
+                    uid = new.metadata.uid or new.metadata.key()
+                    cached = job.tasks.get(uid)
+                if cached is not None and cached.node_name \
+                        and cached.node_name == new.spec.node_name \
+                        and allocated_status(cached.status) \
+                        and old.metadata.annotations == new.metadata.annotations \
+                        and old.spec.priority == new.spec.priority \
+                        and (old.metadata.deletion_timestamp
+                             == new.metadata.deletion_timestamp):
+                    # the three guards above prove the patch changed nothing
+                    # the per-event fast path would re-derive (priority,
+                    # preemptable, revocable zone, topology policy, releasing
+                    # state) — patch_batch is a generic store API, so a
+                    # future non-bind patch must fall through to update_pod
+                    new_status = get_task_status(new)
+                    rr = new.__dict__.get("_rr")
+                    if allocated_status(new_status) and rr is not None \
+                            and cached.resreq.equal(rr):
+                        job.move_task_status(cached, new_status)
+                        node = self.nodes.get(cached.node_name)
+                        stored = node.tasks.get(cached.key()) \
+                            if node is not None else None
+                        rv = new.metadata.resource_version
+                        for view in ((cached,) if stored is None
+                                     or stored is cached
+                                     else (cached, stored)):
+                            view.status = new_status
+                            view.pod.metadata.resource_version = rv
+                        continue
+                try:
+                    self.update_pod(old, fast_clone(new))
+                except KeyError:
+                    pass   # e.g. pod bound to a node we haven't seen yet
 
     def delete_pod(self, pod: obj.Pod) -> None:
         self._delete_task(TaskInfo(pod))

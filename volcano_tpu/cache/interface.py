@@ -28,6 +28,50 @@ class VolumeBinder(Protocol):
     def bind_volumes(self, task, pod_volumes) -> None: ...
 
 
+def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool) -> tuple:
+    """Shared engine behind StoreBinder/FakeBinder ``bind_batch``: one
+    ``patch_batch`` store pass (one lock acquisition, one bulk watch
+    delivery) instead of a get+update round trip per pod.
+
+    Falls back to per-pod ``per_pod_bind`` calls when the store has no
+    ``patch_batch`` (remote stores) or ``batch_ok`` is False (a binder
+    subclass overrode ``bind`` — failure injection and custom transports
+    keep their semantics).
+
+    Returns ``(failed, used_batch)``: the [(pod, hostname)] that did NOT
+    bind (pod gone, or bind raised) for the caller to resync, and whether
+    the batch path ran (per-pod fallback already went through the
+    caller's own bind)."""
+    patch_fn = getattr(store, "patch_batch", None) if store is not None \
+        else None
+    if patch_fn is None or not batch_ok:
+        failed = []
+        for pod, hostname in items:
+            try:
+                per_pod_bind(pod, hostname)
+            except Exception:
+                failed.append((pod, hostname))
+        return failed, False
+
+    def setter(host):
+        def fn(p):
+            p.spec.node_name = host
+            p.resource_request()   # seed the parse cache: the new stored
+            #                        version and every watcher echo copy
+            #                        share it (TaskInfo rebuilds skip the
+            #                        quantity parse)
+        return fn
+
+    _, missing_keys = patch_fn(
+        "pods", [(pod.metadata.name, pod.metadata.namespace,
+                  setter(hostname)) for pod, hostname in items])
+    if not missing_keys:
+        return [], True
+    gone = set(missing_keys)
+    return [(pod, hostname) for pod, hostname in items
+            if (pod.metadata.name, pod.metadata.namespace) in gone], True
+
+
 class StoreBinder:
     """Default binder: writes pod.spec.node_name through the object store
     (the standalone equivalent of POST .../binding, cache.go:214-230)."""
@@ -41,6 +85,13 @@ class StoreBinder:
             raise KeyError(f"pod {pod.metadata.key()} not found")
         live.spec.node_name = hostname
         self.store.update("pods", live, skip_admission=True)
+
+    def bind_batch(self, items) -> list:
+        """Batched bind; see :func:`bind_pods_batch`. Returns the failed
+        [(pod, hostname)] for the caller to resync."""
+        failed, _ = bind_pods_batch(self.store, items, self.bind,
+                                    type(self).bind is StoreBinder.bind)
+        return failed
 
 
 class StoreEvictor:

@@ -284,11 +284,15 @@ class Resource:
 
     def equal(self, rr: "Resource", default: str = ZERO) -> bool:
         """Equal within EPS in every dimension (resource_info.go:398-424)."""
-        def eq(l, r):
-            return l == r or abs(l - r) < EPS
-        if not (eq(self.milli_cpu, rr.milli_cpu) and eq(self.memory, rr.memory)):
+        if not ((self.milli_cpu == rr.milli_cpu
+                 or abs(self.milli_cpu - rr.milli_cpu) < EPS)
+                and (self.memory == rr.memory
+                     or abs(self.memory - rr.memory) < EPS)):
             return False
-        return all(eq(l, r) for l, r in self._scalar_pairs(rr, default))
+        if not self.scalars and not rr.scalars:
+            return True   # fast path: the dominant case on the echo hot loop
+        return all(l == r or abs(l - r) < EPS
+                   for l, r in self._scalar_pairs(rr, default))
 
     # -- dunder sugar ------------------------------------------------------
 

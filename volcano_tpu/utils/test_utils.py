@@ -34,6 +34,26 @@ class FakeBinder:
                 live.spec.node_name = hostname
                 self.store.update("pods", live, skip_admission=True)
 
+    def bind_batch(self, items) -> list:
+        """Batched form sharing StoreBinder's engine
+        (:func:`volcano_tpu.cache.interface.bind_pods_batch`): records the
+        binds, returns the pairs that did not bind. Subclasses overriding
+        :meth:`bind` (e.g. failure injection) get per-pod calls through
+        their override, which record for themselves."""
+        from ..cache.interface import bind_pods_batch
+        failed, used_batch = bind_pods_batch(
+            self.store, items, self.bind,
+            type(self).bind is FakeBinder.bind)
+        if used_batch:
+            gone = set(map(id, (pod for pod, _ in failed)))
+            for pod, hostname in items:
+                if id(pod) in gone:
+                    continue
+                key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+                self.binds[key] = hostname
+                self.channel.append(key)
+        return failed
+
 
 class FakeEvictor:
     """Records evicted pod keys (test_utils.go:119-141)."""
