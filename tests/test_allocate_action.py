@@ -209,11 +209,8 @@ class TestAllocate:
         assert h.binds == {}
 
 
-def test_namespace_round_robin_interleaves_contended_queue():
-    """Two namespaces sharing one queue under contention must split the
-    capacity (allocate.go:123-139 namespace turns), not first-namespace-
-    takes-all."""
-    h = Harness(CONF)
+def _two_ns_contended(conf):
+    h = Harness(conf)
     h.add("queues", build_queue("default", weight=1))
     # room for exactly 4 single-task gangs
     h.add("nodes", build_node("n0", {"cpu": "4", "memory": "8Gi"}))
@@ -228,5 +225,38 @@ def test_namespace_round_robin_interleaves_contended_queue():
     by_ns = {"aaa": 0, "bbb": 0}
     for key in h.binds:
         by_ns[key.split("/")[0]] += 1
+    return by_ns
+
+
+def test_namespace_order_static_drains_first_namespace():
+    """Without a live namespace order fn the reference's namespace priority
+    queue falls back to name order and re-pops the same least namespace
+    after every job (session_plugins.go:532-535 + allocate.go:273): the
+    first namespace drains before the second sees a turn."""
+    by_ns = _two_ns_contended(CONF)
     assert sum(by_ns.values()) == 4
-    assert by_ns["aaa"] == 2 and by_ns["bbb"] == 2, by_ns
+    assert by_ns == {"aaa": 4, "bbb": 0}, by_ns
+
+
+CONF_NS_DRF = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+    enabledNamespaceOrder: true
+  - name: predicates
+  - name: nodeorder
+"""
+
+
+def test_namespace_order_live_share_interleaves():
+    """With drf's namespace order active, the kernel re-selects the
+    namespace by live weighted dominant share at every job boundary
+    (allocate.go:120-139 + drf ns ordering): contended capacity splits
+    across namespaces instead of first-name-takes-all."""
+    by_ns = _two_ns_contended(CONF_NS_DRF)
+    assert sum(by_ns.values()) == 4
+    assert by_ns == {"aaa": 2, "bbb": 2}, by_ns

@@ -1,0 +1,215 @@
+"""Namespace-primary job selection: the gang-allocate kernels vs a pure
+NumPy oracle of the reference's allocate loop (allocate.go:120-275 —
+namespace priority queue, per-namespace queue pick, per-job gang
+commit/rollback) across randomized multi-namespace clusters, with the
+namespace key either static (name order; the reference's fallback,
+session_plugins.go:532-535) or live weighted dominant share (drf's
+NamespaceOrderFn)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from volcano_tpu.ops.allocate import gang_allocate, gang_allocate_chunked
+from volcano_tpu.ops.score import ScoreWeights, node_score
+from volcano_tpu.utils.synth import synth_arrays
+
+
+def allocate_oracle(sa, weights, allow_pipeline=True, ns_live=False):
+    """Literal re-implementation of the reference's selection + placement
+    loop over the synth arrays (no task-topology buckets)."""
+    w = weights.host()
+    idle = sa.node_idle.copy()
+    future = sa.node_future.copy()
+    ntasks = sa.node_ntasks.copy()
+    q_alloc = sa.queue_alloc0.copy()
+    ns_alloc = sa.ns_alloc0.copy()
+    eps = sa.eps
+    P = len(sa.pool_njobs)
+    cursor = np.zeros(P, np.int64)
+    t_pad = sa.task_group.shape[0]
+    assign = np.full(t_pad, -1, np.int32)
+    pipelined = np.zeros(t_pad, bool)
+    n_jobs = sa.job_min_available.shape[0]
+    ready = np.zeros(n_jobs, bool)
+    kept = np.zeros(n_jobs, bool)
+
+    def q_share(q):
+        des, al = sa.queue_deserved[q], q_alloc[q]
+        safe = np.where(des == 0.0, 1.0, des)
+        frac = np.where(np.isinf(des), 0.0,
+                        np.where(des == 0.0,
+                                 np.where(al == 0.0, 0.0, 1.0), al / safe))
+        return float(np.max(frac))
+
+    def q_over(q):
+        des, al = sa.queue_deserved[q], q_alloc[q]
+        return bool(np.any(~((al <= des + eps) | np.isinf(des))))
+
+    def ns_key(ns):
+        if not ns_live:
+            return float(ns)
+        tot = sa.ns_total
+        frac = np.where(tot > 0.0,
+                        ns_alloc[ns] / np.where(tot > 0.0, tot, 1.0),
+                        np.where(ns_alloc[ns] == 0.0, 0.0, 1.0))
+        return float(np.max(frac) / sa.ns_weight[ns])
+
+    while True:
+        pool_ok = [bool(cursor[p] < sa.pool_njobs[p]
+                        and not q_over(sa.pool_queue[p])) for p in range(P)]
+        ns_cands = sorted({int(sa.pool_ns[p]) for p in range(P)
+                           if pool_ok[p]})
+        if not ns_cands:
+            break
+        ns_sel = min(ns_cands, key=lambda n: (ns_key(n), n))
+        pools = [p for p in range(P)
+                 if pool_ok[p] and sa.pool_ns[p] == ns_sel]
+        p_sel = min(pools, key=lambda p: (q_share(sa.pool_queue[p]), p))
+        j = int(sa.pool_job_start[p_sel] + cursor[p_sel])
+        cursor[p_sel] += 1
+
+        ck = (idle.copy(), future.copy(), ntasks.copy())
+        placed = placed_alloc = 0
+        placed_res = np.zeros_like(eps)
+        placements = []
+        start = int(sa.job_task_start[j])
+        for t in range(start, start + int(sa.job_n_tasks[j])):
+            g = int(sa.task_group[t])
+            req = sa.group_req[g]
+            base_ok = sa.group_mask[g] & ((sa.node_max_tasks == 0)
+                                          | (ntasks < sa.node_max_tasks))
+            fits_idle = np.all(req[None, :] <= idle + eps[None, :],
+                               axis=-1) & base_ok
+            any_idle = bool(fits_idle.any())
+            if any_idle or not allow_pipeline:
+                cand = fits_idle
+            else:
+                cand = np.all(req[None, :] <= future + eps[None, :],
+                              axis=-1) & base_ok
+            if not cand.any():
+                continue
+            score = node_score(req, idle, sa.node_alloc, w,
+                               sa.group_static_score[g], xp=np)
+            sel = int(np.argmax(np.where(cand, score, -1e30)))
+            pipe = allow_pipeline and not any_idle
+            if not pipe:
+                idle[sel] = idle[sel] - req
+                placed_alloc += 1
+            future[sel] = future[sel] - req
+            ntasks[sel] += 1
+            placed += 1
+            placed_res = placed_res + req
+            placements.append((t, sel, pipe))
+        base = int(sa.job_ready_base[j])
+        mina = int(sa.job_min_available[j])
+        is_ready = base + placed_alloc >= mina
+        is_kept = base + placed >= mina
+        if is_ready or is_kept:
+            q_alloc[sa.pool_queue[p_sel]] = \
+                q_alloc[sa.pool_queue[p_sel]] + placed_res
+            ns_alloc[ns_sel] = ns_alloc[ns_sel] + placed_res
+            ready[j] = ready[j] or is_ready
+            kept[j] = kept[j] or is_kept
+            for t, sel, pipe in placements:
+                assign[t] = sel
+                pipelined[t] = pipe
+        else:
+            idle, future, ntasks = ck
+    return assign, pipelined, ready, kept
+
+
+def _scenario(seed):
+    rng = np.random.default_rng(seed)
+    n_tasks = int(rng.integers(30, 250))
+    n_nodes = int(rng.integers(8, 96))
+    gang = int(rng.integers(1, 7))
+    sa = synth_arrays(n_tasks, n_nodes, gang_size=gang, seed=seed * 13 + 5,
+                      utilization=float(rng.uniform(0.0, 0.8)),
+                      rack_affinity=bool(rng.integers(0, 2)),
+                      n_queues=int(rng.integers(1, 4)),
+                      n_namespaces=int(rng.integers(2, 5)))
+    choice = rng.integers(0, 3)
+    if choice == 0:      # tight capacity: rollbacks interleave namespaces
+        sa.node_idle *= rng.uniform(0.05, 0.3)
+        sa.node_future[:] = sa.node_idle
+    elif choice == 1:    # finite queue budgets: overuse drops pools
+        q = sa.queue_deserved.shape[0]
+        totals = sa.node_idle.sum(axis=0)
+        sa.queue_deserved[:] = totals[None, :] * \
+            rng.uniform(0.05, 0.6, (q, 1)).astype(np.float32)
+    # randomized namespace weights + pre-existing allocations (live mode)
+    ns = sa.ns_weight.shape[0]
+    sa.ns_weight[:] = rng.choice([1.0, 1.0, 2.0, 5.0], ns)
+    sa.ns_alloc0[:] = (sa.ns_total[None, :]
+                       * rng.uniform(0.0, 0.2, (ns, 1))).astype(np.float32)
+    weights = ScoreWeights.make(
+        sa.group_req.shape[1],
+        binpack=float(rng.uniform(0, 2)),
+        least=float(rng.uniform(0, 2)),
+        balanced=float(rng.uniform(0, 2)))
+    return sa, weights, rng
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("ns_live", [False, True])
+def test_kernel_matches_reference_oracle(seed, ns_live):
+    sa, weights, rng = _scenario(seed)
+    allow_pipeline = bool(rng.integers(0, 2))
+    args = [jnp.asarray(a) for a in sa.args] + [weights]
+    a1, p1, r1, k1, _ = gang_allocate(*args, allow_pipeline=allow_pipeline,
+                                      ns_live=ns_live)
+    a2, p2, r2, k2 = allocate_oracle(sa, weights,
+                                     allow_pipeline=allow_pipeline,
+                                     ns_live=ns_live)
+    ctx = f"seed={seed} ns_live={ns_live} pipeline={allow_pipeline}"
+    np.testing.assert_array_equal(np.asarray(a1), a2, ctx)
+    np.testing.assert_array_equal(np.asarray(p1), p2, ctx)
+    np.testing.assert_array_equal(np.asarray(r1), r2, ctx)
+    np.testing.assert_array_equal(np.asarray(k1), k2, ctx)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_chunked_matches_scan_multi_namespace(seed):
+    """The chunked-candidate production kernel must carry the identical
+    namespace-primary selection."""
+    sa, weights, rng = _scenario(seed + 50)
+    ns_live = bool(rng.integers(0, 2))
+    args = [jnp.asarray(a) for a in sa.args] + [weights]
+    a1, p1, r1, k1, _ = gang_allocate(*args, ns_live=ns_live)
+    a2, p2, r2, k2, _ = gang_allocate_chunked(
+        *args, ns_live=ns_live, chunk=int(rng.integers(2, 17)))
+    ctx = f"seed={seed} ns_live={ns_live}"
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2), ctx)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2), ctx)
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2), ctx)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2), ctx)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_pallas_single_namespace_pool_wrapper_matches_scan(seed):
+    """The Pallas wrapper reconstructs queue-selection arrays from the
+    degenerate single-namespace pools (pallas_allocate.py); its placements
+    must match the scan, and multi-namespace batches must be refused."""
+    from volcano_tpu.ops.pallas_allocate import gang_allocate_pallas
+
+    rng = np.random.default_rng(seed + 300)
+    sa = synth_arrays(int(rng.integers(40, 160)), int(rng.integers(8, 64)),
+                      gang_size=int(rng.integers(1, 6)), seed=seed * 3 + 2,
+                      utilization=float(rng.uniform(0.0, 0.6)),
+                      n_queues=int(rng.integers(2, 4)))
+    weights = ScoreWeights.make(sa.group_req.shape[1],
+                                least=float(rng.uniform(0, 2)),
+                                balanced=float(rng.uniform(0, 2)))
+    args = [jnp.asarray(a) for a in sa.args] + [weights]
+    a1, p1, r1, k1, _ = gang_allocate(*args)
+    a2, p2, r2, k2, _ = gang_allocate_pallas(*sa.args, weights,
+                                             interpret=True)
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
+
+    multi = _scenario(seed)[0]
+    with pytest.raises(ValueError, match="single-namespace"):
+        gang_allocate_pallas(*multi.args, weights, interpret=True)

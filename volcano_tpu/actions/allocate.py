@@ -64,7 +64,6 @@ class AllocateAction(Action):
                 .setdefault(job.queue, []).append(job)
 
         import functools
-        import itertools
         ns_sorted = sorted(
             jobs_by_ns_queue,
             key=functools.cmp_to_key(
@@ -78,21 +77,20 @@ class AllocateAction(Action):
         queues.sort(key=functools.cmp_to_key(
             lambda a, b: -1 if ssn.queue_order_fn(a, b) else 1))
 
-        # namespace round-robin within each queue (allocate.go:123-139:
-        # the reference pops one job per namespace turn, namespaces by
-        # NamespaceOrder): the kernel re-orders queues dynamically by live
-        # share and breaks within-queue ties by encode order, so the
-        # interleaved encoding realizes namespace fairness
+        # namespace-major encode (allocate.go:120-162): jobs are fed in
+        # session-open namespace order, then queue order, then job order —
+        # the kernel re-selects the namespace (live weighted share when the
+        # drf namespace order is active, else this static order) and the
+        # best non-overused queue within it at every job boundary, so the
+        # encode order only decides ties (models/arrays.py TaskBatch)
         ordered: List[JobInfo] = []
-        for q in queues:
-            per_ns = []
-            for ns in ns_sorted:
-                jobs = jobs_by_ns_queue[ns].get(q.name)
+        for ns in ns_sorted:
+            per_q = jobs_by_ns_queue[ns]
+            for q in queues:
+                jobs = per_q.get(q.name)
                 if jobs:
                     jobs.sort(key=job_key)
-                    per_ns.append(jobs)
-            for round_jobs in itertools.zip_longest(*per_ns):
-                ordered.extend(j for j in round_jobs if j is not None)
+                    ordered.extend(jobs)
         return ordered
 
     def _pending_tasks(self, ssn, job: JobInfo) -> List[TaskInfo]:

@@ -72,6 +72,7 @@ class BatchSolver:
         self.mask_fns: List[Callable] = []
         self.static_score_fns: List[Callable] = []
         self.queue_budget_fns: List[Callable] = []
+        self.namespace_budget_fn: Optional[Callable] = None
         self.bucket_fn: Optional[Callable] = None
         self.vectorized_plugins: set = set()
         self.enable_default_predicates = False
@@ -150,6 +151,18 @@ class BatchSolver:
         its queue's in-scan allocation stays within deserved (the proportion
         plugin's Overused semantics, at job granularity)."""
         self.queue_budget_fns.append(fn)
+
+    def set_namespace_budget_fn(self, fn: Callable) -> None:
+        """fn(ns_name, rindex) -> None | (allocated [R], weight).
+
+        Feeds the kernel's LIVE namespace re-selection (drf's
+        NamespaceOrderFn, allocate.go:120-139): at every job boundary the
+        namespace with the lowest weighted dominant share — over these
+        session-open allocations plus in-scan placements — is chosen first.
+        Without this hook the kernel selects namespaces by the encode's
+        static order (the host's session-open namespace sort), matching the
+        reference's priority queue when no namespace order fn is live."""
+        self.namespace_budget_fn = fn
 
     def set_bucket_fn(self, fn: Callable) -> None:
         """fn(task) -> None | (bucket_key, per_mate_bonus). Tasks sharing a
@@ -337,26 +350,30 @@ class BatchSolver:
         mask = np.asarray(gmask[g]) & pods_ok
         return narr, mask, np.asarray(score)
 
-    def _select_kernel(self) -> Tuple[Callable, Dict]:
+    def _select_kernel(self, n_namespaces: int = 1) -> Tuple[Callable, Dict]:
         """Resolve the placement kernel per the `solver` conf: the Pallas
         TPU kernel when requested (or `auto` on a TPU backend) and the
         resource axis fits its sublane budget; the chunked-candidate scan
         (ops/allocate.gang_allocate_chunked, ~4x the plain scan off-TPU)
-        for `auto`/`chunked` elsewhere; the plain XLA scan on request."""
+        for `auto`/`chunked` elsewhere; the plain XLA scan on request.
+        Multi-namespace batches never route to Pallas — the namespace-
+        primary selection lives in the XLA kernels."""
         from ..ops.allocate import gang_allocate_chunked
         from ..ops.pallas_allocate import R_PAD, gang_allocate_pallas
         if self.kernel == "pallas":
             import jax
-            if self.rindex.r > R_PAD:
-                _log_once(f"solver kernel=pallas but {self.rindex.r} "
-                          f"resource dims exceed R_PAD={R_PAD}; "
+            if self.rindex.r > R_PAD or n_namespaces > 1:
+                why = ("resource dims exceed R_PAD" if self.rindex.r > R_PAD
+                       else "the batch spans multiple namespaces")
+                _log_once(f"solver kernel=pallas but {why}; "
                           "falling back to the chunked scan")
                 return gang_allocate_chunked, {}
             interpret = jax.default_backend() != "tpu"
             return gang_allocate_pallas, {"interpret": interpret}
         if self.kernel == "auto":
             import jax
-            if jax.default_backend() == "tpu" and self.rindex.r <= R_PAD:
+            if jax.default_backend() == "tpu" and self.rindex.r <= R_PAD \
+                    and n_namespaces <= 1:
                 return gang_allocate_pallas, {}
             # the candidate-table refresh only pays off once the node
             # sweep is expensive; small clusters keep the plain scan
@@ -385,6 +402,27 @@ class BatchSolver:
                     q_deserved[qi] = deserved
                     break
 
+        # namespace fairness state (live weighted-share re-selection when
+        # the drf namespace order is active; static encode order otherwise);
+        # bucket-padded like the other axes so namespace-count churn does
+        # not recompile the kernel (padding rows have no pools -> inert)
+        from ..models.arrays import bucket as _bucket
+        ns_pad = _bucket(max(1, len(batch.ns_names)), 8)
+        ns_weight = np.ones(ns_pad, np.float32)
+        ns_alloc0 = np.zeros((ns_pad, self.rindex.r), np.float32)
+        ns_live = self.namespace_budget_fn is not None \
+            and len(batch.ns_names) > 1
+        if ns_live:
+            for ni, nsname in enumerate(batch.ns_names):
+                budget = self.namespace_budget_fn(nsname, self.rindex)
+                if budget is not None:
+                    allocated, weight = budget
+                    ns_alloc0[ni] = allocated
+                    ns_weight[ni] = max(float(weight), 1e-9)
+        ns_total = self.rindex.vec(self.ssn.total_resource) \
+            if getattr(self.ssn, "total_resource", None) is not None \
+            else np.ones(self.rindex.r, np.float32)
+
         # task-topology buckets: same-bucket tasks attract within the scan
         task_bucket = np.full(batch.task_group.shape[0], -1, np.int32)
         pack_bonus = np.zeros(batch.g_pad, np.float32)
@@ -405,9 +443,11 @@ class BatchSolver:
         if self.mesh is not None:
             assign, pipelined, ready, kept = self._run_sharded(
                 batch, narr, gmask, static_score, task_bucket, pack_bonus,
-                q_deserved, q_alloc0, eps, allow_pipeline)
+                q_deserved, q_alloc0, ns_weight, ns_alloc0, ns_total,
+                ns_live, eps, allow_pipeline)
         else:
-            kernel_fn, kernel_kwargs = self._select_kernel()
+            kernel_fn, kernel_kwargs = self._select_kernel(
+                len(batch.ns_names))
             assign, pipelined, ready, kept, _ = kernel_fn(
                 jnp.asarray(batch.task_group), jnp.asarray(batch.task_job),
                 jnp.asarray(batch.task_valid), jnp.asarray(batch.group_req),
@@ -418,13 +458,18 @@ class BatchSolver:
                 jnp.asarray(batch.job_task_start),
                 jnp.asarray(batch.job_n_tasks),
                 jnp.asarray(batch.job_queue),
-                jnp.asarray(batch.queue_job_start),
-                jnp.asarray(batch.queue_njobs),
+                jnp.asarray(batch.pool_queue),
+                jnp.asarray(batch.pool_ns),
+                jnp.asarray(batch.pool_job_start),
+                jnp.asarray(batch.pool_njobs),
+                jnp.asarray(ns_weight), jnp.asarray(ns_alloc0),
+                jnp.asarray(ns_total),
                 jnp.asarray(q_deserved), jnp.asarray(q_alloc0),
                 jnp.asarray(narr.idle), jnp.asarray(narr.future_idle),
                 jnp.asarray(narr.allocatable), jnp.asarray(narr.n_tasks),
                 jnp.asarray(narr.max_tasks), eps, self.score_weights(),
-                allow_pipeline=allow_pipeline, **kernel_kwargs)
+                allow_pipeline=allow_pipeline, ns_live=ns_live,
+                **kernel_kwargs)
 
         assign = np.asarray(assign)   # blocks until the device finishes
         m.observe(m.SOLVER_KERNEL_LATENCY,
@@ -506,7 +551,8 @@ class BatchSolver:
         return result
 
     def _run_sharded(self, batch, narr, gmask, static_score, task_bucket,
-                     pack_bonus, q_deserved, q_alloc0, eps, allow_pipeline):
+                     pack_bonus, q_deserved, q_alloc0, ns_weight, ns_alloc0,
+                     ns_total, ns_live, eps, allow_pipeline):
         """Node-axis-sharded placement over the device mesh: each chip owns
         N/D nodes' scan state, collectives ride ICI (ops/sharded.py)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -525,12 +571,12 @@ class BatchSolver:
             widths[axis] = (0, n2 - a.shape[axis])
             return np.pad(np.asarray(a), widths, constant_values=fill)
 
-        fn = self._sharded_fns.get(allow_pipeline)
+        fn = self._sharded_fns.get((allow_pipeline, ns_live))
         if fn is None:
             fn = make_sharded_gang_allocate(
-                mesh, allow_pipeline=allow_pipeline,
+                mesh, allow_pipeline=allow_pipeline, ns_live=ns_live,
                 chunk=getattr(self, "mesh_chunk", 16))
-            self._sharded_fns[allow_pipeline] = fn
+            self._sharded_fns[(allow_pipeline, ns_live)] = fn
 
         n = NamedSharding(mesh, P("nodes"))
         nr = NamedSharding(mesh, P("nodes", None))
@@ -547,9 +593,11 @@ class BatchSolver:
             put(batch.job_min_available, rep),
             put(batch.job_ready_base, rep),
             put(batch.job_task_start, rep), put(batch.job_n_tasks, rep),
-            put(batch.job_queue, rep), put(batch.queue_job_start, rep),
-            put(batch.queue_njobs, rep), put(q_deserved, rep),
-            put(q_alloc0, rep),
+            put(batch.job_queue, rep), put(batch.pool_queue, rep),
+            put(batch.pool_ns, rep), put(batch.pool_job_start, rep),
+            put(batch.pool_njobs, rep), put(ns_weight, rep),
+            put(ns_alloc0, rep), put(ns_total, rep),
+            put(q_deserved, rep), put(q_alloc0, rep),
             put(pad_nodes(narr.idle, 0), nr),
             put(pad_nodes(narr.future_idle, 0), nr),
             put(pad_nodes(narr.allocatable, 0), nr),

@@ -271,10 +271,13 @@ def _req_key(t: TaskInfo) -> tuple:
 class TaskBatch:
     """An ordered batch of pending tasks to place, with group compression.
 
-    Jobs are regrouped so that each queue's jobs form one contiguous span
-    (first-appearance queue order = the session's queue ordering, used for
-    tie-breaking); the kernel then *dynamically* interleaves jobs across
-    queues by live share, so the encode order only decides ties.
+    Jobs are regrouped so that each (namespace, queue) POOL's jobs form one
+    contiguous span. Namespace indices follow first appearance (the caller
+    feeds jobs namespace-sorted by the session's NamespaceOrderFn, so the
+    static index order IS the session-open namespace order); queue indices
+    follow first appearance across the batch. The kernel *dynamically*
+    re-selects the namespace, then the queue, at every job boundary
+    (allocate.go:120-162), so the encode order only decides ties.
     """
 
     rindex: ResourceIndex
@@ -295,8 +298,11 @@ class TaskBatch:
     job_task_end: np.ndarray         # [J] i32
     job_queue: np.ndarray            # [J] i32 queue index (padding: 0)
     queue_names: List[str]           # first-appearance queue order
-    queue_job_start: np.ndarray      # [Q] i32 jobs grouped by queue
-    queue_njobs: np.ndarray          # [Q] i32
+    ns_names: List[str]              # first-appearance namespace order
+    pool_queue: np.ndarray           # [P] i32 queue of each (ns, queue) pool
+    pool_ns: np.ndarray              # [P] i32 namespace of each pool
+    pool_job_start: np.ndarray       # [P] i32 jobs grouped by pool
+    pool_njobs: np.ndarray           # [P] i32
 
     @property
     def job_n_tasks(self) -> np.ndarray:
@@ -307,20 +313,33 @@ class TaskBatch:
               rindex: ResourceIndex,
               task_bucket: int = TASK_BUCKET,
               group_bucket: int = GROUP_BUCKET) -> "TaskBatch":
-        # regroup jobs by queue, stable: queue order = first appearance;
-        # zero-task jobs are excluded (each job consumes scan steps equal to
-        # its task count, so empty jobs would starve the T-step budget — the
-        # caller resolves their readiness from existing occupancy instead)
+        # regroup jobs by (namespace, queue) pool, stable: namespace and
+        # queue order = first appearance; zero-task jobs are excluded (each
+        # job consumes scan steps equal to its task count, so empty jobs
+        # would starve the T-step budget — the caller resolves their
+        # readiness from existing occupancy instead)
         queue_names: List[str] = []
-        by_queue: Dict[str, list] = {}
+        queue_idx: Dict[str, int] = {}
+        ns_names: List[str] = []
+        ns_idx: Dict[str, int] = {}
+        pool_order: List[Tuple[int, int]] = []     # (ns, queue) per pool
+        by_pool: Dict[Tuple[int, int], list] = {}
         for job, jtasks in ordered_jobs:
             if not jtasks:
                 continue
             qname = getattr(job, "queue", "") or ""
-            if qname not in by_queue:
-                by_queue[qname] = []
+            if qname not in queue_idx:
+                queue_idx[qname] = len(queue_names)
                 queue_names.append(qname)
-            by_queue[qname].append((job, jtasks))
+            nsname = getattr(job, "namespace", "") or ""
+            if nsname not in ns_idx:
+                ns_idx[nsname] = len(ns_names)
+                ns_names.append(nsname)
+            key = (ns_idx[nsname], queue_idx[qname])
+            if key not in by_pool:
+                by_pool[key] = []
+                pool_order.append(key)
+            by_pool[key].append((job, jtasks))
 
         tasks: List[TaskInfo] = []
         task_sig: List[int] = []
@@ -331,13 +350,18 @@ class TaskBatch:
         job_start: List[int] = []
         job_end: List[int] = []
         job_queue: List[int] = []
-        queue_job_start: List[int] = []
-        queue_njobs: List[int] = []
+        pool_queue: List[int] = []
+        pool_ns: List[int] = []
+        pool_job_start: List[int] = []
+        pool_njobs: List[int] = []
 
-        for q_idx, qname in enumerate(queue_names):
-            queue_job_start.append(len(job_uids))
-            queue_njobs.append(len(by_queue[qname]))
-            for job, jtasks in by_queue[qname]:
+        for key in pool_order:
+            ns_i, q_idx = key
+            pool_ns.append(ns_i)
+            pool_queue.append(q_idx)
+            pool_job_start.append(len(job_uids))
+            pool_njobs.append(len(by_pool[key]))
+            for job, jtasks in by_pool[key]:
                 j_idx = len(job_uids)
                 job_uids.append(job.uid)
                 job_min.append(job.min_available)
@@ -380,10 +404,11 @@ class TaskBatch:
         t_pad = bucket(len(tasks), task_bucket)
         g_pad = bucket(max(1, len(group_reqs)), group_bucket)
         # one spare sentinel job absorbs padding tasks: it is never selected
-        # (it belongs to no queue span) and its ready/kept stay False
+        # (it belongs to no pool span) and its ready/kept stay False
         sentinel = len(job_uids)
         j_pad = bucket(len(job_uids) + 1, group_bucket)
         q_pad = bucket(max(1, len(queue_names)), 8)
+        p_pad = bucket(max(1, len(pool_queue)), 8)
         r = rindex.r
 
         def pad1(a, n, dtype, fill=0):
@@ -411,8 +436,11 @@ class TaskBatch:
             job_task_end=pad1(job_end, j_pad, np.int32),
             job_queue=pad1(job_queue, j_pad, np.int32),
             queue_names=queue_names,
-            queue_job_start=pad1(queue_job_start, q_pad, np.int32),
-            queue_njobs=pad1(queue_njobs, q_pad, np.int32),
+            ns_names=ns_names,
+            pool_queue=pad1(pool_queue, p_pad, np.int32),
+            pool_ns=pad1(pool_ns, p_pad, np.int32),
+            pool_job_start=pad1(pool_job_start, p_pad, np.int32),
+            pool_njobs=pad1(pool_njobs, p_pad, np.int32),
         )
 
     @property

@@ -20,19 +20,23 @@ All of that happens inside one ``lax.scan``:
   ``idle`` for the next);
 * when the current job's span ends, the gang check either keeps the
   placements or restores the checkpoint (Statement.Commit/Discard), charges
-  the queue's allocation, and the next (queue, job) pair is selected by
-  live dominant share over the queue budgets — the in-kernel equivalent of
-  the reference's re-sorted queue priority queue;
+  the queue's (and namespace's) allocation, and the next job is selected by
+  the reference's two-level rule — the in-kernel equivalent of its
+  namespace and queue priority queues;
 * queues whose allocation exceeds their deserved budget (the proportion
   plugin's Overused gate) stop being selected, at job granularity, exactly
   like allocate.go:141-146.
 
-Namespace fairness (allocate.go:123-139's outer namespace priority
-queue) is realized at encode time: the allocate action interleaves each
-queue's jobs round-robin across namespaces (actions/allocate.py
-_ordered_jobs), and the kernel breaks within-queue ties by encode order.
-Remaining divergence: the reference re-orders namespaces by live weighted
-share between turns; the interleave uses the session-open namespace order.
+Namespace fairness (allocate.go:120-162's outer namespace priority queue)
+is first-class in the kernel: jobs are encoded in (namespace, queue)
+POOLS, and at every job boundary the next namespace is re-selected — by
+live weighted dominant share (``ns_live=True``, drf's NamespaceOrderFn
+over in-scan allocations) or by the encode's static namespace order (the
+host's session-open NamespaceOrderFn sort, matching the reference's
+priority queue when no live order fn is registered) — then the best
+non-overused queue within it by live share (QueueOrderFn), then that
+pool's next job. A single-namespace batch degenerates to pools == queues
+and reproduces the previous queue-only selection exactly, ties included.
 """
 
 from __future__ import annotations
@@ -59,8 +63,9 @@ class AllocState(NamedTuple):
     cur_bucket: jax.Array    # i32 task-topology bucket of the running chain
     pack_nodes: jax.Array    # [N] f32 current-bucket placements per node
     q_alloc: jax.Array       # [Q, R] live queue allocations
-    q_cursor: jax.Array      # [Q] i32 next-job offset per queue
-    cur_q: jax.Array         # i32 selected queue (-1 when done)
+    ns_alloc: jax.Array      # [NS, R] live namespace allocations
+    p_cursor: jax.Array      # [P] i32 next-job offset per (ns, queue) pool
+    cur_pool: jax.Array      # i32 selected pool (-1 when done)
     cur_job: jax.Array       # i32 selected job (-1 when done)
     t_off: jax.Array         # i32 offset inside the current job's span
     placed: jax.Array        # i32 tasks placed for cur_job (any kind)
@@ -88,7 +93,51 @@ def queue_overused(q_alloc: jax.Array, q_deserved: jax.Array,
     return ~jnp.all(le, axis=-1)
 
 
-@partial(jax.jit, static_argnames=("allow_pipeline",))
+def namespace_share(ns_alloc: jax.Array, ns_total: jax.Array,
+                    ns_weight: jax.Array) -> jax.Array:
+    """Weighted dominant share per namespace: max_r alloc/total with
+    0/0=0, x/0=1, divided by the namespace weight (drf.py _share_of +
+    namespace_order_fn; reference drf.go:621-646 + namespace ordering)."""
+    frac = jnp.where(ns_total[None, :] > 0.0,
+                     ns_alloc / jnp.where(ns_total[None, :] > 0.0,
+                                          ns_total[None, :], 1.0),
+                     jnp.where(ns_alloc == 0.0, 0.0, 1.0))
+    return jnp.max(frac, axis=-1) / ns_weight
+
+
+def make_pool_select(queue_deserved, pool_queue, pool_ns, pool_job_start,
+                     pool_njobs, ns_weight, ns_total, eps, ns_live: bool):
+    """The two-level (namespace, queue) job selection closure shared by the
+    scan and sharded kernel bodies (allocate.go:120-162): first the
+    namespace — live weighted share when ``ns_live`` (drf's
+    NamespaceOrderFn), else the static encode rank (the host's session-open
+    namespace sort, i.e. a priority queue over fixed keys) — then the best
+    non-overused queue with jobs left inside it, by live queue share, then
+    that pool's next job. Ties break toward the lower encode index at both
+    levels. Returns (pool, job), -1/-1 when nothing is selectable."""
+    n_ns = ns_weight.shape[0]
+
+    def select(q_alloc, ns_alloc, p_cursor):
+        share = queue_share(q_alloc, queue_deserved)           # [Q]
+        over = queue_overused(q_alloc, queue_deserved, eps)    # [Q]
+        pool_ok = (p_cursor < pool_njobs) & ~over[pool_queue]  # [P]
+        ns_has = jnp.zeros(n_ns, jnp.int32).at[pool_ns].max(
+            pool_ok.astype(jnp.int32)) > 0
+        if ns_live:
+            ns_key = namespace_share(ns_alloc, ns_total, ns_weight)
+        else:
+            ns_key = jnp.arange(n_ns, dtype=jnp.float32)
+        ns_sel = jnp.argmin(jnp.where(ns_has, ns_key, BIG)).astype(jnp.int32)
+        pool_key = share[pool_queue]
+        eligible = pool_ok & (pool_ns == ns_sel)
+        p = jnp.argmin(jnp.where(eligible, pool_key, BIG)).astype(jnp.int32)
+        ok = ns_has[ns_sel]
+        job = pool_job_start[p] + p_cursor[p]
+        return jnp.where(ok, p, -1), jnp.where(ok, job, -1)
+    return select
+
+
+@partial(jax.jit, static_argnames=("allow_pipeline", "ns_live"))
 def gang_allocate(task_group: jax.Array,      # [T] i32
                   task_job: jax.Array,        # [T] i32 (padding -> sentinel)
                   task_valid: jax.Array,      # [T] bool
@@ -102,8 +151,13 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
                   job_task_start: jax.Array,      # [J] i32 span start
                   job_n_tasks: jax.Array,         # [J] i32 span length
                   job_queue: jax.Array,           # [J] i32
-                  queue_job_start: jax.Array,     # [Q] i32 jobs grouped/queue
-                  queue_njobs: jax.Array,         # [Q] i32
+                  pool_queue: jax.Array,          # [P] i32 queue of pool
+                  pool_ns: jax.Array,             # [P] i32 namespace of pool
+                  pool_job_start: jax.Array,      # [P] i32 jobs grouped/pool
+                  pool_njobs: jax.Array,          # [P] i32
+                  ns_weight: jax.Array,           # [NS] f32
+                  ns_alloc0: jax.Array,           # [NS, R] f32
+                  ns_total: jax.Array,            # [R] f32 cluster total
                   queue_deserved: jax.Array,      # [Q, R] f32 (+inf ungated)
                   queue_alloc0: jax.Array,        # [Q, R] f32
                   node_idle: jax.Array,       # [N, R] f32
@@ -113,31 +167,26 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
                   node_max_tasks: jax.Array,  # [N] i32 (0 = uncapped)
                   eps: jax.Array,             # [R] f32
                   weights: ScoreWeights,
-                  allow_pipeline: bool = True):
+                  allow_pipeline: bool = True,
+                  ns_live: bool = False):
     """Returns (assign [T] node-or--1, pipelined [T] bool, ready [J] bool,
     kept [J] bool, final AllocState)."""
     T = task_group.shape[0]
     J = job_min_available.shape[0]
 
-    def select(q_alloc, q_cursor):
-        """Next (queue, job): min live share among queues with jobs left and
-        budget headroom; ties by encode order."""
-        share = queue_share(q_alloc, queue_deserved)
-        eligible = (q_cursor < queue_njobs) & \
-            ~queue_overused(q_alloc, queue_deserved, eps)
-        q = jnp.argmin(jnp.where(eligible, share, BIG)).astype(jnp.int32)
-        ok = eligible[q]
-        job = queue_job_start[q] + q_cursor[q]
-        return jnp.where(ok, q, -1), jnp.where(ok, job, -1)
+    select = make_pool_select(queue_deserved, pool_queue, pool_ns,
+                              pool_job_start, pool_njobs, ns_weight,
+                              ns_total, eps, ns_live)
 
-    q0, j0 = select(queue_alloc0, jnp.zeros_like(queue_njobs))
+    p0, j0 = select(queue_alloc0, ns_alloc0, jnp.zeros_like(pool_njobs))
     init = AllocState(
         idle=node_idle, future=node_future, n_tasks=node_ntasks,
         ckpt_idle=node_idle, ckpt_future=node_future, ckpt_ntasks=node_ntasks,
         cur_bucket=jnp.int32(-1),
         pack_nodes=jnp.zeros(node_ntasks.shape[0], jnp.float32),
-        q_alloc=queue_alloc0, q_cursor=jnp.zeros_like(queue_njobs),
-        cur_q=q0, cur_job=j0, t_off=jnp.int32(0),
+        q_alloc=queue_alloc0, ns_alloc=ns_alloc0,
+        p_cursor=jnp.zeros_like(pool_njobs),
+        cur_pool=p0, cur_job=j0, t_off=jnp.int32(0),
         placed=jnp.int32(0), placed_alloc=jnp.int32(0),
         placed_res=jnp.zeros_like(eps),
         ready=jnp.zeros(J, bool), kept=jnp.zeros(J, bool))
@@ -196,7 +245,7 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
             placed_alloc=state.placed_alloc + take_idle.astype(jnp.int32),
             placed_res=state.placed_res + jnp.where(placed_ok, req, 0.0))
 
-        # ---- job boundary: gang commit/rollback + queue charge + select
+        # ---- job boundary: gang commit/rollback + charges + select
         complete = active & (state.t_off >= job_n_tasks[job])
         base = job_ready_base[job]
         minavail = job_min_available[job]
@@ -208,15 +257,18 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
         idle = jnp.where(roll, state.ckpt_idle, state.idle)
         future = jnp.where(roll, state.ckpt_future, state.future)
         n_tasks = jnp.where(roll, state.ckpt_ntasks, state.n_tasks)
-        q = jnp.maximum(state.cur_q, 0)
-        q_alloc = state.q_alloc.at[q].add(
-            jnp.where(keep, state.placed_res, 0.0))
-        q_cursor = state.q_cursor.at[q].add(jnp.where(complete, 1, 0))
+        p = jnp.maximum(state.cur_pool, 0)
+        q = pool_queue[p]
+        ns = pool_ns[p]
+        charged = jnp.where(keep, state.placed_res, 0.0)
+        q_alloc = state.q_alloc.at[q].add(charged)
+        ns_alloc = state.ns_alloc.at[ns].add(charged)
+        p_cursor = state.p_cursor.at[p].add(jnp.where(complete, 1, 0))
         ready = state.ready.at[job].set(is_ready | state.ready[job])
         kept = state.kept.at[job].set(is_kept | state.kept[job])
 
-        nq, nj = select(q_alloc, q_cursor)
-        cur_q = jnp.where(complete, nq, state.cur_q)
+        np_, nj = select(q_alloc, ns_alloc, p_cursor)
+        cur_pool = jnp.where(complete, np_, state.cur_pool)
         cur_job = jnp.where(complete, nj, state.cur_job)
 
         state = state._replace(
@@ -224,8 +276,8 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
             ckpt_idle=jnp.where(complete, idle, state.ckpt_idle),
             ckpt_future=jnp.where(complete, future, state.ckpt_future),
             ckpt_ntasks=jnp.where(complete, n_tasks, state.ckpt_ntasks),
-            q_alloc=q_alloc, q_cursor=q_cursor,
-            cur_q=cur_q, cur_job=cur_job,
+            q_alloc=q_alloc, ns_alloc=ns_alloc, p_cursor=p_cursor,
+            cur_pool=cur_pool, cur_job=cur_job,
             t_off=jnp.where(complete, 0, state.t_off),
             placed=jnp.where(complete, 0, state.placed),
             placed_alloc=jnp.where(complete, 0, state.placed_alloc),
@@ -248,9 +300,9 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
     return assign, pipelined, state.ready, state.kept, state
 
 
-@partial(jax.jit, static_argnames=("allow_pipeline", "chunk"))
+@partial(jax.jit, static_argnames=("allow_pipeline", "ns_live", "chunk"))
 def gang_allocate_chunked(*args, allow_pipeline: bool = True,
-                          chunk: int = 16):
+                          ns_live: bool = False, chunk: int = 16):
     """Chunked-candidate form of :func:`gang_allocate`: identical
     semantics (ops/sharded.py holds the exactness argument), but each
     scan step works on a top-``chunk``-per-fit-class candidate table that
@@ -261,4 +313,4 @@ def gang_allocate_chunked(*args, allow_pipeline: bool = True,
     AllocState."""
     from .sharded import _sharded_body_chunked
     return _sharded_body_chunked(*args, allow_pipeline=allow_pipeline,
-                                 axis=None, chunk=chunk)
+                                 ns_live=ns_live, axis=None, chunk=chunk)

@@ -379,17 +379,43 @@ def gang_allocate_pallas(task_group, task_job, task_valid, group_req,
                          group_mask, group_static_score, task_bucket,
                          group_pack_bonus, job_min_available, job_ready_base,
                          job_task_start, job_n_tasks, job_queue,
-                         queue_job_start, queue_njobs, queue_deserved,
+                         pool_queue, pool_ns, pool_job_start, pool_njobs,
+                         ns_weight, ns_alloc0, ns_total, queue_deserved,
                          queue_alloc0, node_idle, node_future, node_alloc,
                          node_ntasks, node_max_tasks, eps,
                          weights: ScoreWeights, allow_pipeline: bool = True,
-                         interpret: bool = False):
+                         ns_live: bool = False, interpret: bool = False):
     """Drop-in for ops.allocate.gang_allocate, returning
     (assign, pipelined, ready, kept, None).
+
+    Single-namespace only: with one namespace the (ns, queue) pools
+    degenerate to queues and this kernel's live queue selection is exactly
+    the two-level rule; for multi-namespace batches the solver routes to
+    the chunked XLA kernel instead (BatchSolver._select_kernel), which
+    carries the namespace-primary selection in full.
 
     The group-bucket reduction needs host numpy (scatter by group), so it
     runs here; everything else is one jitted program — the wrapper's ~30
     individual op dispatches cost real latency on a tunneled TPU."""
+    n_ns = int(np.asarray(ns_weight).shape[0])
+    if n_ns > 1 and len(np.unique(np.asarray(pool_ns)[
+            np.asarray(pool_njobs) > 0])) > 1:
+        raise ValueError(
+            "gang_allocate_pallas handles single-namespace batches only; "
+            "route multi-namespace batches to gang_allocate_chunked")
+    # pools -> queue-indexed selection arrays (exact for one namespace:
+    # pool order is queue first-appearance order)
+    pq = np.asarray(pool_queue)
+    Qn = int(np.asarray(queue_deserved).shape[0])
+    queue_job_start = np.zeros(Qn, np.int32)
+    queue_njobs = np.zeros(Qn, np.int32)
+    pjs = np.asarray(pool_job_start)
+    pnj = np.asarray(pool_njobs)
+    for i in range(min(len(pq), Qn)):
+        q = int(pq[i])
+        if q < Qn and pnj[i] > 0:
+            queue_job_start[q] = pjs[i]
+            queue_njobs[q] = pnj[i]
     G = int(group_req.shape[0])
     # group_bucket from per-task buckets (uniform within a group by
     # construction; see solver.place bucket_fn keyed on job+task annotations)

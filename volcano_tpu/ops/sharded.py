@@ -35,7 +35,7 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .allocate import queue_overused, queue_share
+from .allocate import make_pool_select
 from .score import ScoreWeights, node_score
 
 NEG = -1e30   # plain floats: no backend init at import
@@ -52,8 +52,9 @@ class ShardState(NamedTuple):
     cur_bucket: jax.Array    # i32 replicated
     pack_nodes: jax.Array    # [Nl] f32 local current-bucket placements
     q_alloc: jax.Array       # [Q, R] replicated
-    q_cursor: jax.Array      # [Q] replicated
-    cur_q: jax.Array         # i32 replicated
+    ns_alloc: jax.Array      # [NS, R] replicated
+    p_cursor: jax.Array      # [P] replicated
+    cur_pool: jax.Array      # i32 replicated
     cur_job: jax.Array       # i32 replicated
     t_off: jax.Array
     placed: jax.Array
@@ -63,38 +64,25 @@ class ShardState(NamedTuple):
     kept: jax.Array          # [J] bool replicated
 
 
-def _make_queue_select(queue_deserved, queue_njobs, queue_job_start, eps):
-    """The replicated dynamic queue-selection closure shared by both
-    sharded bodies: next (queue, job) by live share, overuse-gated."""
-    def select(q_alloc, q_cursor):
-        share = queue_share(q_alloc, queue_deserved)
-        eligible = (q_cursor < queue_njobs) & \
-            ~queue_overused(q_alloc, queue_deserved, eps)
-        q = jnp.argmin(jnp.where(eligible, share, BIG)).astype(jnp.int32)
-        ok = eligible[q]
-        job = queue_job_start[q] + q_cursor[q]
-        return jnp.where(ok, q, -1), jnp.where(ok, job, -1)
-    return select
-
-
 def _init_shard_state(select, node_idle, node_future, node_ntasks,
-                      queue_alloc0, queue_njobs, eps, n_jobs):
+                      queue_alloc0, ns_alloc0, pool_njobs, eps, n_jobs):
     Nl = node_idle.shape[0]
-    q0, j0 = select(queue_alloc0, jnp.zeros_like(queue_njobs))
+    p0, j0 = select(queue_alloc0, ns_alloc0, jnp.zeros_like(pool_njobs))
     return ShardState(
         idle=node_idle, future=node_future, n_tasks=node_ntasks,
         ckpt_idle=node_idle, ckpt_future=node_future, ckpt_ntasks=node_ntasks,
         cur_bucket=jnp.int32(-1),
         pack_nodes=jnp.zeros(Nl, jnp.float32),
-        q_alloc=queue_alloc0, q_cursor=jnp.zeros_like(queue_njobs),
-        cur_q=q0, cur_job=j0, t_off=jnp.int32(0),
+        q_alloc=queue_alloc0, ns_alloc=ns_alloc0,
+        p_cursor=jnp.zeros_like(pool_njobs),
+        cur_pool=p0, cur_job=j0, t_off=jnp.int32(0),
         placed=jnp.int32(0), placed_alloc=jnp.int32(0),
         placed_res=jnp.zeros_like(eps),
         ready=jnp.zeros(n_jobs, bool), kept=jnp.zeros(n_jobs, bool))
 
 
-def _job_boundary(state: ShardState, select, active, job,
-                  job_n_tasks, job_ready_base, job_min_available):
+def _job_boundary(state: ShardState, select, active, job, pool_queue,
+                  pool_ns, job_n_tasks, job_ready_base, job_min_available):
     """Gang commit/rollback + next-job selection at a job boundary
     (replicated math, no communication). Shared by both sharded bodies.
     Returns (state, roll)."""
@@ -109,15 +97,16 @@ def _job_boundary(state: ShardState, select, active, job,
     idle = jnp.where(roll, state.ckpt_idle, state.idle)
     future = jnp.where(roll, state.ckpt_future, state.future)
     n_tasks = jnp.where(roll, state.ckpt_ntasks, state.n_tasks)
-    q = jnp.maximum(state.cur_q, 0)
-    q_alloc = state.q_alloc.at[q].add(
-        jnp.where(keep, state.placed_res, 0.0))
-    q_cursor = state.q_cursor.at[q].add(jnp.where(complete, 1, 0))
+    p = jnp.maximum(state.cur_pool, 0)
+    charged = jnp.where(keep, state.placed_res, 0.0)
+    q_alloc = state.q_alloc.at[pool_queue[p]].add(charged)
+    ns_alloc = state.ns_alloc.at[pool_ns[p]].add(charged)
+    p_cursor = state.p_cursor.at[p].add(jnp.where(complete, 1, 0))
     ready = state.ready.at[job].set(is_ready | state.ready[job])
     kept = state.kept.at[job].set(is_kept | state.kept[job])
 
-    nq, nj = select(q_alloc, q_cursor)
-    cur_q = jnp.where(complete, nq, state.cur_q)
+    np_, nj = select(q_alloc, ns_alloc, p_cursor)
+    cur_pool = jnp.where(complete, np_, state.cur_pool)
     cur_job = jnp.where(complete, nj, state.cur_job)
 
     return state._replace(
@@ -125,8 +114,8 @@ def _job_boundary(state: ShardState, select, active, job,
         ckpt_idle=jnp.where(complete, idle, state.ckpt_idle),
         ckpt_future=jnp.where(complete, future, state.ckpt_future),
         ckpt_ntasks=jnp.where(complete, n_tasks, state.ckpt_ntasks),
-        q_alloc=q_alloc, q_cursor=q_cursor,
-        cur_q=cur_q, cur_job=cur_job,
+        q_alloc=q_alloc, ns_alloc=ns_alloc, p_cursor=p_cursor,
+        cur_pool=cur_pool, cur_job=cur_job,
         t_off=jnp.where(complete, 0, state.t_off),
         placed=jnp.where(complete, 0, state.placed),
         placed_alloc=jnp.where(complete, 0, state.placed_alloc),
@@ -147,11 +136,12 @@ def _finalize_outputs(state: ShardState, emit_t, emit_sel, emit_pipe,
 def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
                   group_static_score, task_bucket, group_pack_bonus,
                   job_min_available, job_ready_base,
-                  job_task_start, job_n_tasks, job_queue, queue_job_start,
-                  queue_njobs, queue_deserved, queue_alloc0,
+                  job_task_start, job_n_tasks, job_queue, pool_queue,
+                  pool_ns, pool_job_start, pool_njobs, ns_weight,
+                  ns_alloc0, ns_total, queue_deserved, queue_alloc0,
                   node_idle, node_future, node_alloc, node_ntasks,
                   node_max_tasks, eps, weights, allow_pipeline: bool,
-                  axis: str):
+                  ns_live: bool, axis: str):
     """Runs inside shard_map: node-axis arrays are the local shard."""
     T = task_group.shape[0]
     J = job_min_available.shape[0]
@@ -159,10 +149,11 @@ def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
     shard = jax.lax.axis_index(axis)
     offset = shard * Nl
 
-    select = _make_queue_select(queue_deserved, queue_njobs,
-                                queue_job_start, eps)
+    select = make_pool_select(queue_deserved, pool_queue, pool_ns,
+                              pool_job_start, pool_njobs, ns_weight,
+                              ns_total, eps, ns_live)
     init = _init_shard_state(select, node_idle, node_future, node_ntasks,
-                             queue_alloc0, queue_njobs, eps, J)
+                             queue_alloc0, ns_alloc0, pool_njobs, eps, J)
 
     def step(state: ShardState, _):
         active = state.cur_job >= 0
@@ -242,7 +233,8 @@ def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
             placed_alloc=state.placed_alloc + take_idle.astype(jnp.int32),
             placed_res=state.placed_res + jnp.where(placed_ok, req, 0.0))
 
-        state, _ = _job_boundary(state, select, active, job, job_n_tasks,
+        state, _ = _job_boundary(state, select, active, job, pool_queue,
+                                 pool_ns, job_n_tasks,
                                  job_ready_base, job_min_available)
         emit_t = jnp.where(valid, t_idx, T)
         emit_sel = jnp.where(placed_ok, sel_g, -1)
@@ -258,11 +250,13 @@ def _sharded_body_chunked(task_group, task_job, task_valid, group_req,
                           group_mask, group_static_score, task_bucket,
                           group_pack_bonus, job_min_available,
                           job_ready_base, job_task_start, job_n_tasks,
-                          job_queue, queue_job_start, queue_njobs,
+                          job_queue, pool_queue, pool_ns, pool_job_start,
+                          pool_njobs, ns_weight, ns_alloc0, ns_total,
                           queue_deserved, queue_alloc0, node_idle,
                           node_future, node_alloc, node_ntasks,
                           node_max_tasks, eps, weights,
-                          allow_pipeline: bool, axis: str, chunk: int):
+                          allow_pipeline: bool, ns_live: bool, axis: str,
+                          chunk: int):
     """Chunked-candidate variant of :func:`_sharded_body`: instead of one
     all-gather per scan step, each shard gathers its top-``chunk``
     candidates per fit class (idle / future) into a replicated candidate
@@ -294,10 +288,11 @@ def _sharded_body_chunked(task_group, task_job, task_valid, group_req,
     K = 2 * C * n_dev
     F = 5 + 3 * R   # gidx, static, pack, ntasks, maxtasks, idle, future, alloc
 
-    select = _make_queue_select(queue_deserved, queue_njobs,
-                                queue_job_start, eps)
+    select = make_pool_select(queue_deserved, pool_queue, pool_ns,
+                              pool_job_start, pool_njobs, ns_weight,
+                              ns_total, eps, ns_live)
     init = _init_shard_state(select, node_idle, node_future, node_ntasks,
-                             queue_alloc0, queue_njobs, eps, J)
+                             queue_alloc0, ns_alloc0, pool_njobs, eps, J)
     cand0 = jnp.full((K, F), NEG, jnp.float32).at[:, 0].set(-1.0)
     carry0 = (init, cand0, jnp.int32(C), jnp.int32(-1), jnp.int32(-1),
               jnp.bool_(True))
@@ -417,6 +412,7 @@ def _sharded_body_chunked(task_group, task_job, task_valid, group_req,
             placed_res=state.placed_res + jnp.where(placed_ok, req, 0.0))
 
         state, roll = _job_boundary(state, select, active, job,
+                                    pool_queue, pool_ns,
                                     job_n_tasks, job_ready_base,
                                     job_min_available)
         emit_t = jnp.where(valid, t_idx, T)
@@ -432,7 +428,7 @@ def _sharded_body_chunked(task_group, task_job, task_valid, group_req,
 
 def make_sharded_gang_allocate(mesh: Mesh, axis: str = "nodes",
                                allow_pipeline: bool = True,
-                               chunk: int = 16):
+                               chunk: int = 16, ns_live: bool = False):
     """Build the jitted node-sharded gang-allocate for a device mesh.
 
     Node-axis inputs ([N,...] and [G,N]) must be padded so N divides the mesh
@@ -446,16 +442,17 @@ def make_sharded_gang_allocate(mesh: Mesh, axis: str = "nodes",
     rep = P()
     in_specs = (rep, rep, rep, rep, gn, gn, rep, rep,
                 rep, rep, rep, rep, rep,
-                rep, rep, rep, rep,
+                rep, rep, rep, rep, rep, rep, rep,
+                rep, rep,
                 nr, nr, nr, n, n, rep,
                 ScoreWeights(rep, rep, rep, rep, rep))
     out_specs = (rep, rep, rep, rep, nr)
     if chunk and chunk > 1:
         body = partial(_sharded_body_chunked, allow_pipeline=allow_pipeline,
-                       axis=axis, chunk=int(chunk))
+                       ns_live=ns_live, axis=axis, chunk=int(chunk))
     else:
         body = partial(_sharded_body, allow_pipeline=allow_pipeline,
-                       axis=axis)
+                       ns_live=ns_live, axis=axis)
     try:
         sm = shard_map(body, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs, check_vma=False)
@@ -481,9 +478,11 @@ def shard_synth(mesh: Mesh, sa, axis: str = "nodes"):
         put(sa.task_bucket, rep), put(sa.group_pack_bonus, rep),
         put(sa.job_min_available, rep), put(sa.job_ready_base, rep),
         put(sa.job_task_start, rep), put(sa.job_n_tasks, rep),
-        put(sa.job_queue, rep), put(sa.queue_job_start, rep),
-        put(sa.queue_njobs, rep), put(sa.queue_deserved, rep),
-        put(sa.queue_alloc0, rep),
+        put(sa.job_queue, rep), put(sa.pool_queue, rep),
+        put(sa.pool_ns, rep), put(sa.pool_job_start, rep),
+        put(sa.pool_njobs, rep), put(sa.ns_weight, rep),
+        put(sa.ns_alloc0, rep), put(sa.ns_total, rep),
+        put(sa.queue_deserved, rep), put(sa.queue_alloc0, rep),
         put(sa.node_idle, nr), put(sa.node_future, nr),
         put(sa.node_alloc, nr), put(sa.node_ntasks, n),
         put(sa.node_max_tasks, n), put(sa.eps, rep)]

@@ -150,6 +150,18 @@ class DrfPlugin(Plugin):
             for ns, opt in self.namespace_opts.items():
                 opt.dominant, opt.share = _share_of(opt.allocated, self.total)
                 m.update_namespace_share(ns, opt.share)
+            if ssn.solver is not None:
+                def ns_budget(ns_name, rindex):
+                    """Session-open namespace allocation + weight for the
+                    kernel's live namespace re-selection (the in-scan form
+                    of namespace_order_fn below; drf.go ns ordering)."""
+                    opt = self.namespace_opts.get(ns_name)
+                    info = ssn.namespace_info.get(ns_name)
+                    weight = info.get_weight() if info else 1
+                    alloc = rindex.vec(opt.allocated) if opt is not None \
+                        else np.zeros(rindex.r, np.float32)
+                    return alloc, float(weight)
+                ssn.solver.set_namespace_budget_fn(ns_budget)
 
         _ls_memo: Dict[tuple, float] = {}
 

@@ -36,8 +36,13 @@ class SynthArrays:
     job_task_start: np.ndarray      # [J] i32
     job_n_tasks: np.ndarray         # [J] i32
     job_queue: np.ndarray           # [J] i32
-    queue_job_start: np.ndarray     # [Q] i32
-    queue_njobs: np.ndarray         # [Q] i32
+    pool_queue: np.ndarray          # [P] i32 (single-ns: pools == queues)
+    pool_ns: np.ndarray             # [P] i32
+    pool_job_start: np.ndarray      # [P] i32
+    pool_njobs: np.ndarray          # [P] i32
+    ns_weight: np.ndarray           # [NS] f32
+    ns_alloc0: np.ndarray           # [NS, R] f32
+    ns_total: np.ndarray            # [R] f32
     queue_deserved: np.ndarray      # [Q, R] f32
     queue_alloc0: np.ndarray        # [Q, R] f32
     node_idle: np.ndarray       # [N, R] f32
@@ -56,7 +61,9 @@ class SynthArrays:
                 self.task_bucket, self.group_pack_bonus,
                 self.job_min_available, self.job_ready_base,
                 self.job_task_start, self.job_n_tasks, self.job_queue,
-                self.queue_job_start, self.queue_njobs, self.queue_deserved,
+                self.pool_queue, self.pool_ns, self.pool_job_start,
+                self.pool_njobs, self.ns_weight, self.ns_alloc0,
+                self.ns_total, self.queue_deserved,
                 self.queue_alloc0, self.node_idle, self.node_future,
                 self.node_alloc, self.node_ntasks, self.node_max_tasks,
                 self.eps]
@@ -71,7 +78,8 @@ class SynthArrays:
 def synth_arrays(n_tasks: int, n_nodes: int, *, gang_size: int = 8,
                  n_racks: int = 32, r: int = 4, seed: int = 0,
                  utilization: float = 0.3, node_pad_to: Optional[int] = None,
-                 rack_affinity: bool = True, n_queues: int = 1) -> SynthArrays:
+                 rack_affinity: bool = True, n_queues: int = 1,
+                 n_namespaces: int = 1) -> SynthArrays:
     """A gang-heavy pending backlog over a partially utilized cluster.
 
     Nodes: 64-core/256GiB-shaped with uniform random pre-existing usage around
@@ -126,13 +134,19 @@ def synth_arrays(n_tasks: int, n_nodes: int, *, gang_size: int = 8,
     job_n_tasks = np.zeros(j_pad, np.int32)
     job_n_tasks[:n_jobs] = gang_size
 
-    # queues: jobs striped round-robin then grouped contiguously per queue
+    # queues/namespaces: jobs striped round-robin then regrouped so each
+    # (namespace, queue) pool's jobs are contiguous, namespace-major (the
+    # encode convention: namespace index order = static selection order)
     q_pad = bucket(n_queues, 8)
     job_queue = np.zeros(j_pad, np.int32)
     job_queue[:n_jobs] = np.arange(n_jobs) % n_queues
-    order = np.argsort(job_queue[:n_jobs], kind="stable")
-    # regroup job spans so each queue's jobs are contiguous
-    if n_queues > 1:
+    job_ns = np.zeros(j_pad, np.int32)
+    if n_namespaces > 1:
+        job_ns[:n_jobs] = rng.integers(0, n_namespaces, n_jobs)
+    if n_queues > 1 or n_namespaces > 1:
+        key = job_ns[:n_jobs].astype(np.int64) * n_queues \
+            + job_queue[:n_jobs]
+        order = np.argsort(key, kind="stable")
         # rebuild task arrays in regrouped job order
         new_task_order = np.concatenate(
             [np.arange(j * gang_size, (j + 1) * gang_size) for j in order])
@@ -141,14 +155,37 @@ def synth_arrays(n_tasks: int, n_nodes: int, *, gang_size: int = 8,
         remap[order] = np.arange(n_jobs)
         task_job[:n_tasks] = remap[task_job[:n_tasks][new_task_order]]
         job_queue[:n_jobs] = job_queue[:n_jobs][order]
-    queue_job_start = np.zeros(q_pad, np.int32)
-    queue_njobs = np.zeros(q_pad, np.int32)
-    for q in range(n_queues):
-        members = np.nonzero(job_queue[:n_jobs] == q)[0]
-        queue_job_start[q] = members[0] if len(members) else 0
-        queue_njobs[q] = len(members)
+        job_ns[:n_jobs] = job_ns[:n_jobs][order]
     queue_deserved = np.full((q_pad, r), np.inf, np.float32)
     queue_alloc0 = np.zeros((q_pad, r), np.float32)
+    # pools: contiguous (ns, queue) runs over the regrouped jobs
+    run_keys: list = []
+    pool_queue_l: list = []
+    pool_ns_l: list = []
+    pool_start_l: list = []
+    pool_n_l: list = []
+    for j in range(n_jobs):
+        k = (int(job_ns[j]), int(job_queue[j]))
+        if not run_keys or run_keys[-1] != k:
+            run_keys.append(k)
+            pool_ns_l.append(k[0])
+            pool_queue_l.append(k[1])
+            pool_start_l.append(j)
+            pool_n_l.append(0)
+        pool_n_l[-1] += 1
+    p_pad = bucket(max(1, len(run_keys)), 8)
+    pool_queue = np.zeros(p_pad, np.int32)
+    pool_queue[:len(run_keys)] = pool_queue_l
+    pool_ns = np.zeros(p_pad, np.int32)
+    pool_ns[:len(run_keys)] = pool_ns_l
+    pool_job_start = np.zeros(p_pad, np.int32)
+    pool_job_start[:len(run_keys)] = pool_start_l
+    pool_njobs = np.zeros(p_pad, np.int32)
+    pool_njobs[:len(run_keys)] = pool_n_l
+    ns_pad = max(1, n_namespaces)
+    ns_weight = np.ones(ns_pad, np.float32)
+    ns_alloc0 = np.zeros((ns_pad, r), np.float32)
+    ns_total = cap[:n_nodes].sum(axis=0).astype(np.float32)
 
     # static predicates: valid nodes only; static score: rack affinity
     group_mask = np.zeros((g_pad, n_pad), bool)
@@ -170,9 +207,10 @@ def synth_arrays(n_tasks: int, n_nodes: int, *, gang_size: int = 8,
         group_pack_bonus=np.zeros(g_pad, np.float32),
         job_min_available=job_min_available, job_ready_base=job_ready_base,
         job_task_start=job_task_start, job_n_tasks=job_n_tasks,
-        job_queue=job_queue, queue_job_start=queue_job_start,
-        queue_njobs=queue_njobs, queue_deserved=queue_deserved,
-        queue_alloc0=queue_alloc0,
+        job_queue=job_queue, pool_queue=pool_queue, pool_ns=pool_ns,
+        pool_job_start=pool_job_start, pool_njobs=pool_njobs,
+        ns_weight=ns_weight, ns_alloc0=ns_alloc0, ns_total=ns_total,
+        queue_deserved=queue_deserved, queue_alloc0=queue_alloc0,
         node_idle=idle, node_future=idle.copy(), node_alloc=cap,
         node_ntasks=node_ntasks, node_max_tasks=node_max_tasks, eps=eps)
 
