@@ -43,10 +43,9 @@ class AllocateAction(Action):
     def _ordered_jobs(self, ssn) -> List[JobInfo]:
         """(namespace, queue, job) nested ordering, flattened."""
         # steady-state fast path: with no Pending task anywhere there is
-        # nothing to order or place. (Per-job skipping would be wrong in
-        # mixed cycles: a taskless job still occupies its namespace's turn
-        # in the round-robin interleave below, exactly like the reference's
-        # per-namespace pops.)
+        # nothing to order or place (taskless jobs are excluded from the
+        # encode anyway — TaskBatch.build — and resolve their readiness
+        # from existing occupancy in place())
         if not any(job.task_status_index.get(TaskStatus.Pending)
                    for job in ssn.jobs.values()):
             return []

@@ -38,6 +38,10 @@ import time
 _logger = logging.getLogger(__name__)
 _logged_once: set = set()
 
+# rotating start offset for the sampling window (the reference's package-
+# level node cursor, scheduler_helper.go:95); advances per sampled session
+_node_cursor = 0
+
 
 def _log_once(msg: str) -> None:
     if msg not in _logged_once:
@@ -99,6 +103,22 @@ class BatchSolver:
         # 50k-task object staging runs only if something reads session
         # placement state. `apply: eager` restores immediate staging.
         self.deferred_apply = True
+        # adaptive node sampling (the reference's CPU cost-control,
+        # pkg/scheduler/util/scheduler_helper.go:49-68 +
+        # --percentage-nodes-to-find): OFF by default — the TPU kernels
+        # evaluate every node exhaustively. A non-TPU deployment that must
+        # meet the 1 s cycle budget can opt in:
+        #   configurations:
+        #   - name: solver
+        #     arguments: {sampling.enable: "true",
+        #                 sampling.percentage: 0,    # 0 = adaptive
+        #                 sampling.minNodes: 100}
+        # Each session considers a rotating window of the node list
+        # (the reference's moving node cursor), trading placement quality
+        # for cycle latency exactly like the reference does.
+        self.sampling = False
+        self.sampling_pct = 0.0
+        self.sampling_min = 100
         solver_args = (ssn.configurations or {}).get("solver")
         if solver_args is not None:
             if getattr(solver_args, "get_bool",
@@ -118,7 +138,44 @@ class BatchSolver:
             if hasattr(solver_args, "get_str") and \
                     solver_args.get_str("apply", "deferred") == "eager":
                 self.deferred_apply = False
+            if getattr(solver_args, "get_bool",
+                       lambda *_: False)("sampling.enable", False):
+                self.sampling = True
+                self.sampling_pct = solver_args.get_float(
+                    "sampling.percentage", 0.0)
+                self.sampling_min = solver_args.get_int(
+                    "sampling.minNodes", 100)
         self._sharded_fns: Dict[bool, Callable] = {}
+        self._sampled_names: Optional[List[str]] = None
+
+    def _node_order(self) -> List[str]:
+        """The node-name order the contexts are built over: every ready
+        node, or — with sampling enabled — a rotating window of
+        max(minNodes, pct% of N) names (CalculateNumOfFeasibleNodesToFind:
+        adaptive pct = 50 - N/125 clamped to >= 5, scheduler_helper.go:
+        36,49-68; the window start advances like the reference's node
+        cursor so successive cycles cover the whole cluster)."""
+        names = [n.name for n in self.ssn.node_list]
+        if not self.sampling:
+            return names
+        if self._sampled_names is not None:   # stable within the session
+            return self._sampled_names
+        n = len(names)
+        k = n
+        if n > self.sampling_min:
+            pct = self.sampling_pct or max(5.0, 50.0 - n / 125.0)
+            k = min(n, max(self.sampling_min, int(n * pct / 100.0)))
+        if k >= n:
+            self._sampled_names = names
+            return names
+        global _node_cursor
+        start = _node_cursor % n
+        _node_cursor += k
+        window = names[start:start + k]
+        if len(window) < k:
+            window += names[:k - len(window)]
+        self._sampled_names = window
+        return window
 
     # -- plugin contribution API ------------------------------------------
 
@@ -232,7 +289,7 @@ class BatchSolver:
         static_score)."""
         ssn = self.ssn
         ssn.materialize()   # deferred placements must be visible to arrays
-        narr = NodeArrays.build(ssn.nodes, [n.name for n in ssn.node_list],
+        narr = NodeArrays.build(ssn.nodes, self._node_order(),
                                 self.rindex)
         batch = TaskBatch.build(ordered_jobs, self.rindex)
         feats = PredicateFeatures.build(ssn.nodes, narr, batch)
@@ -294,7 +351,7 @@ class BatchSolver:
         while the preempt walk only ever reads a few rows."""
         ssn = self.ssn
         ssn.materialize()   # deferred placements must be visible to arrays
-        narr = NodeArrays.build(ssn.nodes, [n.name for n in ssn.node_list],
+        narr = NodeArrays.build(ssn.nodes, self._node_order(),
                                 self.rindex)
         batch = TaskBatch.build(ordered_jobs, self.rindex)
         feats = PredicateFeatures.build(ssn.nodes, narr, batch)
