@@ -126,3 +126,58 @@ tiers:
     assert rec.node_name == "node-b"
     assert "vb-1" in evicted
     h.close_session()
+
+
+def test_pipeline_invalidates_cross_queue_persisted_rejections():
+    """A reclaimer pipeline raises its queue's live allocated (proportion),
+    which can flip that queue's victims eligible for OTHER reclaimers:
+    apply_pipeline must clear persisted cross-queue rejections on every
+    node holding that queue's candidates (and only those), and drop any
+    resumed cross-queue walk."""
+    from volcano_tpu.framework.victims import (CROSS_QUEUE, PreemptContext)
+
+    h = Harness(CONF)
+    h.add("queues", build_queue("q1", weight=1), build_queue("q2", weight=1))
+    h.add("podgroups", pg("pg1", "c1", "q1", 1), pg("pg2", "c1", "q2", 1))
+    h.add("nodes", build_node("n1", build_resource_list("3", "3Gi")),
+          build_node("n2", build_resource_list("3", "3Gi")))
+    h.add("pods",
+          build_pod("c1", "victim-a", "n1", "Running", RL1, "pg2"),
+          build_pod("c1", "victim-b", "n2", "Running", RL1, "pg1"),
+          build_pod("c1", "claimer", "", "Pending", RL1, "pg2"))
+    ssn = h.open_session()
+    job2 = next(j for j in ssn.jobs.values() if j.name == "pg2")
+    claimer = next(t for t in job2.tasks.values()
+                   if t.status == TaskStatus.Pending)
+    ctx = PreemptContext(ssn, [(job2, [claimer])])
+    assert ctx._persist_ok_reclaim
+
+    # persist rejections for two different cross-queue keys
+    import numpy as np
+    n_real = len(ctx.narr.names)
+    k1 = (CROSS_QUEUE, b"req-a", 0, 0)   # claimer from queue code 0
+    k2 = (CROSS_QUEUE, b"req-b", 1, 1)   # claimer from queue code 1
+    ctx._persistent_reject[k1] = np.ones(n_real, bool)
+    ctx._persistent_reject[k2] = np.ones(n_real, bool)
+    ctx._walk_key = (CROSS_QUEUE, "some-task")
+    ctx._walk_masked = np.zeros(n_real)
+
+    # pipeline a task of pg2 (queue q2): nodes holding q2's candidates
+    # (victim-a's node) must clear in persist entries whose claimer queue
+    # is NOT q2; the q2-claimer entry keeps its bits
+    q2_code = ctx.victims.queue_code["q2"]
+    node_a = ctx.node_idx[ssn.jobs[job2.uid].tasks[
+        next(u for u, t in job2.tasks.items()
+             if t.name == "victim-a")].node_name]
+    ctx.apply_pipeline("n2", claimer)
+    for pkey, mask in ctx._persistent_reject.items():
+        if pkey[3] != q2_code:
+            assert not mask[node_a], pkey     # cleared where q2 has victims
+        else:
+            # same-queue claimers unaffected by their own queue's growth
+            # (its victims are never their candidates) except the
+            # pipelined node itself, which every entry clears
+            expected = np.ones(n_real, bool)
+            expected[ctx.node_idx["n2"]] = False
+            assert (mask == expected).all(), pkey
+    assert ctx._walk_key is None              # resumed walk dropped

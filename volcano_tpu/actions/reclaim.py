@@ -73,17 +73,30 @@ class ReclaimAction(Action):
                   for jobs in preemptors_map.values() for job in jobs])
 
         # queue priority loop (reclaim.go:84-188): pop best queue each turn,
-        # re-pushing it after a task was attempted
-        while queue_list:
-            queue_list.sort(key=queue_key)
-            queue = queue_list.pop(0)
+        # re-pushing it after a task was attempted. Priority HEAPS (the
+        # reference's util.PriorityQueue, same shape as preempt.py): the
+        # cmp_to_key wrappers invoke the live order fns at every heap-sift
+        # comparison — exactly a Go heap whose LessFn reads live shares —
+        # so entries already in the heap see drifted keys, which the
+        # reference tolerates identically. Re-sorting the job list on every
+        # one of ~5k turns instead cost O(turns x J log J) order-fn
+        # dispatches at the 5k x 10k benchmark.
+        import heapq
+        job_heaps: Dict[str, list] = {}
+        for qname, jobs in preemptors_map.items():
+            heap = [job_key(job) for job in jobs]
+            heapq.heapify(heap)
+            job_heaps[qname] = heap
+        queue_heap = [queue_key(q) for q in queue_list]
+        heapq.heapify(queue_heap)
+        while queue_heap:
+            queue = heapq.heappop(queue_heap).obj
             if ssn.overused(queue):
                 continue
-            jobs = preemptors_map.get(queue.name)
-            if not jobs:
+            heap = job_heaps.get(queue.name)
+            if not heap:
                 continue
-            jobs.sort(key=job_key)
-            job = jobs.pop(0)
+            job = heapq.heappop(heap).obj
             tasks = preemptor_tasks.get(job.uid)
             if not tasks:
                 # reference-exact: a popped job with no tasks left drops
@@ -95,8 +108,8 @@ class ReclaimAction(Action):
 
             assigned = self._reclaim(ssn, ctx, task)
             if assigned:
-                jobs.append(job)
-            queue_list.append(queue)
+                heapq.heappush(heap, job_key(job))
+            heapq.heappush(queue_heap, queue_key(queue))
 
     # ------------------------------------------------------------------
 

@@ -306,6 +306,148 @@ def full_cycle_50k(n_tasks=50_000, n_nodes=10_000) -> Dict:
             "platform": _platform()}
 
 
+def churn_load(n_nodes=10_000, resident_jobs=6_250, gang=8,
+               arrival_jobs=125, cycles=50) -> Dict:
+    """Sustained-churn duty cycle: ``arrival_jobs`` gangs arrive and the
+    oldest as many complete EVERY cycle against a full resident cluster,
+    with node churn on; cycles run back-to-back (the executor's
+    write-behind backlog competes with the foreground exactly as in a
+    sustained burst). Reports p50/p95 runOnce latency over ``cycles``
+    measured cycles — the headline duty-cycle number (a quiet-cluster
+    steady state flatters the scheduler; real clusters churn)."""
+    import numpy as np
+
+    from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                              build_pod_group)
+
+    store, cache, binder, conf = _cycle_env(CONF_FULL)
+    log(f"churn_load: building resident {resident_jobs * gang} tasks "
+        f"x {n_nodes} nodes")
+    _populate(store, n_nodes=n_nodes, n_jobs=resident_jobs, gang=gang)
+    _run_cycle(cache, conf)            # compile + place the resident set
+    cache.flush_executors(timeout=600.0)
+
+    live_jobs = list(range(resident_jobs))
+    next_job = resident_jobs
+    next_node = n_nodes
+    lat = []
+    t_wall = time.perf_counter()
+    for c in range(cycles):
+        # arrivals: new Inqueue gangs
+        for j in range(next_job, next_job + arrival_jobs):
+            store.create("podgroups", build_pod_group(
+                f"pg-{j}", "default", "default", gang, phase="Inqueue"))
+            for t in range(gang):
+                store.create("pods", build_pod(
+                    "default", f"job{j}-task{t}", "", "Pending",
+                    {"cpu": "2", "memory": "4Gi"}, groupname=f"pg-{j}"))
+            live_jobs.append(j)
+        next_job += arrival_jobs
+        # completions: the oldest gangs finish and their objects go away
+        for j in live_jobs[:arrival_jobs]:
+            for t in range(gang):
+                try:
+                    store.delete("pods", f"job{j}-task{t}", "default",
+                                 skip_admission=True)
+                except KeyError:
+                    pass
+            try:
+                store.delete("podgroups", f"pg-{j}", "default",
+                             skip_admission=True)
+            except KeyError:
+                pass
+        live_jobs = live_jobs[arrival_jobs:]
+        # node churn: one node leaves, a fresh one joins
+        try:
+            store.delete("nodes", f"node-{(next_node - n_nodes) % n_nodes}",
+                         skip_admission=True)
+        except KeyError:
+            pass
+        store.create("nodes", build_node(
+            f"node-{next_node}", {"cpu": "64", "memory": "256Gi",
+                                  "pods": "110"}))
+        next_node += 1
+        ms = _run_cycle(cache, conf)
+        lat.append(ms)
+    wall_s = time.perf_counter() - t_wall
+    t0 = time.perf_counter()
+    cache.flush_executors(timeout=600.0)
+    drain_ms = (time.perf_counter() - t0) * 1000.0
+    p50, p95 = np.percentile(lat, [50, 95])
+    return {"config": "churn_load",
+            "desc": f"sustained churn: {arrival_jobs * gang} arrivals + "
+                    f"completions/cycle at {resident_jobs * gang} resident "
+                    f"x {n_nodes} nodes, node churn on, {cycles} "
+                    "back-to-back cycles",
+            "p50_ms": round(float(p50), 2), "p95_ms": round(float(p95), 2),
+            "max_ms": round(float(max(lat)), 2),
+            "wall_s": round(wall_s, 1),
+            "final_drain_ms": round(drain_ms, 2),
+            "binds": len(binder.binds), "platform": _platform()}
+
+
+CONF_RECLAIM = """
+actions: "reclaim"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def config_reclaim(n_nodes=10_000, n_running=1_250, n_pending=625) -> Dict:
+    """Cross-queue reclaim at scale (reclaim.go:84-188): q-over holds the
+    whole cluster with Running gangs while q-under's pending jobs reclaim
+    their deserved share; measures the reclaim action's execute latency."""
+    from volcano_tpu.framework import get_action, open_session
+    from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                              build_pod_group, build_queue)
+
+    store, cache, binder, conf = _cycle_env(CONF_RECLAIM)
+    store.create("queues", build_queue("q-over", weight=1))
+    store.create("queues", build_queue("q-under", weight=1))
+    for i in range(n_nodes):
+        store.create("nodes", build_node(f"node-{i}",
+                                         {"cpu": "16", "memory": "32Gi"}))
+    for j in range(n_running):
+        store.create("podgroups", build_pod_group(
+            f"ov-{j}", "ns1", "q-over", 8, phase="Running"))
+        for t in range(8):
+            store.create("pods", build_pod(
+                "ns1", f"ov-{j}-{t}", f"node-{(j * 8 + t) % n_nodes}",
+                "Running", {"cpu": "14", "memory": "28Gi"}, f"ov-{j}"))
+    for j in range(n_pending):
+        store.create("podgroups", build_pod_group(
+            f"un-{j}", "ns1", "q-under", 8, phase="Inqueue"))
+        for t in range(8):
+            store.create("pods", build_pod(
+                "ns1", f"un-{j}-{t}", "", "Pending",
+                {"cpu": "8", "memory": "16Gi"}, f"un-{j}"))
+    cache.begin_cycle()
+    try:
+        ssn = open_session(cache, conf.tiers, conf.configurations)
+        t0 = time.perf_counter()
+        get_action("reclaim").execute(ssn)
+        ms = (time.perf_counter() - t0) * 1000.0
+    finally:
+        cache.end_cycle()
+    from volcano_tpu.models.job_info import TaskStatus
+    evicted = sum(1 for j in ssn.jobs.values() for t in j.tasks.values()
+                  if t.status == TaskStatus.Releasing)
+    return {"config": "reclaim",
+            "desc": f"cross-queue reclaim {n_pending * 8} reclaimers x "
+                    f"{n_nodes} nodes ({n_running * 8} running victims "
+                    "pool)",
+            "value_ms": round(ms, 2), "evicted": evicted,
+            "platform": _platform()}
+
+
 def capture_traces() -> None:
     """jax.profiler trace artifacts (SURVEY §5.1), captured AFTER the
     measurements — host-side tracing inflates full-cycle latency up to
@@ -343,6 +485,11 @@ def run_all(full_scale: bool = True) -> List[Dict]:
     results.append(config_4() if full_scale else
                    config_4(n_nodes=2000, n_low=250, n_high=125))
     log(f"config_4: {results[-1]}")
+    log("running config_reclaim")
+    results.append(config_reclaim() if full_scale else
+                   config_reclaim(n_nodes=2000, n_running=250,
+                                  n_pending=125))
+    log(f"config_reclaim: {results[-1]}")
     log("running config_5")
     n_dev = len(jax.devices())
     results.extend(config_5(sharded_devices=n_dev if n_dev >= 2 else None)
@@ -354,5 +501,13 @@ def run_all(full_scale: bool = True) -> List[Dict]:
         log("running full_cycle_50k")
         results.append(full_cycle_50k())
         log(f"full_cycle: {results[-1]}")
+        log("running churn_load")
+        results.append(churn_load())
+        log(f"churn_load: {results[-1]}")
+    else:
+        log("running churn_load (reduced)")
+        results.append(churn_load(n_nodes=1000, resident_jobs=625,
+                                  arrival_jobs=25, cycles=10))
+        log(f"churn_load: {results[-1]}")
     capture_traces()
     return results

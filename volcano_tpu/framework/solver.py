@@ -155,11 +155,11 @@ class BatchSolver:
         adaptive pct = 50 - N/125 clamped to >= 5, scheduler_helper.go:
         36,49-68; the window start advances like the reference's node
         cursor so successive cycles cover the whole cluster)."""
+        if self.sampling and self._sampled_names is not None:
+            return self._sampled_names       # stable within the session
         names = [n.name for n in self.ssn.node_list]
         if not self.sampling:
             return names
-        if self._sampled_names is not None:   # stable within the session
-            return self._sampled_names
         n = len(names)
         k = n
         if n > self.sampling_min:

@@ -124,13 +124,23 @@ class VictimIndex:
         self.queue_sum = np.zeros((self.n_pad, qn, self.rindex.r), np.float32)
         if len(self.node_of):
             np.add.at(self.queue_sum, (self.node_of, self.queue_of), self.res)
+        # running sum over RECLAIMABLE queues (the cross-queue totals'
+        # common part): totals_for's per-queue loop was O(Q x N x R) per
+        # reclaimer place() call
+        self.reclaimable_sum = np.zeros((self.n_pad, self.rindex.r),
+                                        np.float32)
+        for qc in range(len(self.queue_code)):
+            if self.q_reclaimable[qc]:
+                self.reclaimable_sum += self.queue_sum[:, qc]
         self.rows_by_job: Dict[int, np.ndarray] = {}
         for jc in range(len(self.job_code)):
             self.rows_by_job[jc] = np.flatnonzero(self.job_of == jc)
 
     def _flip_sum(self, row: int, sign: float) -> None:
-        self.queue_sum[self.node_of[row], self.queue_of[row]] += \
-            sign * self.res[row]
+        qc = self.queue_of[row]
+        self.queue_sum[self.node_of[row], qc] += sign * self.res[row]
+        if self.q_reclaimable[qc]:
+            self.reclaimable_sum[self.node_of[row]] += sign * self.res[row]
 
     def totals_for(self, mode: str, pj: int, pq: int) -> np.ndarray:
         """[N_pad, R] summed alive candidate resources per node under the
@@ -155,11 +165,9 @@ class VictimIndex:
                     np.add.at(out, self.node_of[live], self.res[live])
             return out
         # cross-queue reclaim: all reclaimable queues except the claimer's
-        out = np.zeros((self.n_pad, r), np.float32)
-        for qc in range(len(self.queue_code)):
-            if qc == pq or not self.q_reclaimable[qc]:
-                continue
-            out += self.queue_sum[:, qc]
+        out = self.reclaimable_sum.copy()
+        if 0 <= pq < len(self.queue_code) and self.q_reclaimable[pq]:
+            out -= self.queue_sum[:, pq]
         return out
 
     def node_candidates(self, i: int, mode: str, pj: int, pq: int):
@@ -299,6 +307,29 @@ class PreemptContext:
         if "drf" in enabled and self._persist_ok:
             prios = {j.priority for j, _ in ordered_jobs}
             self._persist_ok = len(prios) <= 1
+        # cross-queue (reclaim) empty-victim persistence: sound when every
+        # enabled reclaimable plugin's per-victim acceptance only SHRINKS
+        # over the action's eviction sequence —
+        #   proportion: evictions only lower a victim queue's allocated
+        #     toward deserved, so the above-deserved test and the
+        #     less_partly(reclaimer.resreq) guard only reject more. The
+        #     one acceptance-GROWING event is a reclaimer PIPELINE: it
+        #     raises the reclaimer queue's allocated, which can flip that
+        #     queue's victims eligible for OTHER reclaimers —
+        #     apply_pipeline invalidates the affected persist bits;
+        #   gang: victim-job occupancy only drops (the pipelined
+        #     reclaimer's own job is never a cross-queue candidate);
+        #   conformance: static.
+        # drf's hierarchical what-if tree has no such monotonicity, and
+        # out-of-tree plugins may grow acceptance — both disable it.
+        enabled_r = set()
+        for tier in ssn.tiers:
+            for opt in tier.plugins:
+                if opt.is_enabled("enabledReclaimable") and \
+                        opt.name in ssn.reclaimable_fns:
+                    enabled_r.add(opt.name)
+        self._persist_ok_reclaim = \
+            enabled_r <= {"gang", "conformance", "proportion"}
 
     # -- state deltas (mirror Statement.evict / pipeline) ------------------
     # Deltas are logged so a Statement.discard can be mirrored exactly:
@@ -367,6 +398,30 @@ class PreemptContext:
             self._reject_mask[i] = False
             for mask in self._persistent_reject.values():
                 mask[i] = False
+        # the pipeline's allocate event raised the task's queue's live
+        # allocated (proportion), which can flip that queue's victims from
+        # ineligible to eligible for OTHER reclaimers: clear cross-queue
+        # persisted rejections on every node holding live candidates of
+        # that queue (reclaim.go re-runs Reclaimable per walk and would
+        # accept them)
+        job = self.ssn.jobs.get(task.job)
+        qname = job.queue if job is not None else ""
+        qc = self.victims.queue_code.get(qname)
+        if qc is not None and self._persistent_reject:
+            rows = np.flatnonzero((self.victims.queue_of == qc)
+                                  & self.victims.alive)
+            if len(rows):
+                n_real = len(self.narr.names)
+                nodes = np.unique(self.victims.node_of[rows])
+                nodes = nodes[nodes < n_real]
+                for pkey, mask in self._persistent_reject.items():
+                    if pkey[0] == CROSS_QUEUE and pkey[3] != qc:
+                        mask[nodes] = False
+                # a resumed cross-queue walk may also hold stale exclusions
+                if self._walk_key is not None \
+                        and self._walk_key[0] == CROSS_QUEUE:
+                    self._walk_key = None
+                    self._walk_masked = None
 
     # -- per-preemptor evaluation ------------------------------------------
 
@@ -407,7 +462,13 @@ class PreemptContext:
         # _gmask_hash) so identical consecutive jobs resume one walk; else
         # the group id, which encodes (job, task spec, request, scheduling
         # constraints) — a resumed masked-score array can never leak one
-        # group's predicate mask to another either way
+        # group's predicate mask to another either way. CROSS_QUEUE keys
+        # on the reclaimer itself: its multi-step walk (the caller applies
+        # evictions between place() calls) resumes instead of rebuilding —
+        # sound unconditionally because it mirrors the reference's single
+        # pass over the node list per reclaimer (reclaim.go:114-182), and
+        # unvisited nodes' future/totals are untouched by the walk's own
+        # evictions
         if use_cache and self._persist_ok and self._static_trivial:
             h = self._gmask_hash.get(g)
             if h is None:
@@ -416,10 +477,13 @@ class PreemptContext:
                     row, len(self._gmask_intern))
                 self._gmask_hash[g] = h
             key = (mode, req.tobytes(), pj, pq, h)
-        else:
+        elif use_cache:
             key = (mode, g)
+        else:
+            key = (mode, preemptor.uid)
         persist = None
-        if use_cache and self._persist_ok:
+        if (use_cache and self._persist_ok) or \
+                (mode == CROSS_QUEUE and self._persist_ok_reclaim):
             # keyed by (mode, request, preemptor job/queue codes), NOT by
             # group: a victim-empty verdict depends on the preemptor's
             # request (drf's ls term), its structural filter identity
@@ -441,18 +505,18 @@ class PreemptContext:
                                           xp=np))[:n_real]
             self._score_cache[skey] = score
 
-        if use_cache and key == self._walk_key and \
-                self._walk_masked is not None:
-            # resume task k's walk for task k+1 (same job/mode/request):
-            # per-node staleness is re-tested at visit below
+        if key == self._walk_key and self._walk_masked is not None:
+            # resume task k's walk for task k+1 (same job/mode/request), or
+            # the same reclaimer's next step (CROSS_QUEUE): per-node
+            # staleness is re-tested at visit below
             masked = self._walk_masked
         else:
+            # invalidate any prior resume state up front: the early
+            # returns below must not leave a stale key paired with
+            # another walk's order/masked
+            self._walk_key = None
+            self._walk_masked = None
             if use_cache:
-                # invalidate any prior resume state up front: the early
-                # returns below must not leave a stale key paired with
-                # another walk's order/masked
-                self._walk_key = None
-                self._walk_masked = None
                 # descending-score visit order, shared across walks with
                 # this score key (stable sort == argmax's first-index
                 # tie-break); dead/rejected nodes are skipped via masked
@@ -476,18 +540,17 @@ class PreemptContext:
             # rejection cache key: same job AND mode AND request — drf's
             # allowance depends on the preemptor's resreq (ls =
             # share(allocated + resreq)), so a smaller later task must not
-            # inherit rejections recorded for a bigger one; reclaim
-            # (CROSS_QUEUE) never caches (its what-if tree filter has no
-            # usable monotonicity)
+            # inherit rejections recorded for a bigger one; CROSS_QUEUE
+            # persistence is separately gated (_persist_ok_reclaim)
             if use_cache:
                 if key != self._reject_key:
                     self._reject_mask[:] = False
                     self._reject_key = key
                 visit_ok = opt_ok[:n_real] & ~self._reject_mask[:n_real]
-                if persist is not None:
-                    visit_ok &= ~persist
             else:
                 visit_ok = opt_ok[:n_real]
+            if persist is not None:
+                visit_ok &= ~persist
             if not visit_ok.any():
                 return None
             masked = np.where(visit_ok, score, -np.inf)
@@ -497,14 +560,16 @@ class PreemptContext:
                 # consumed) across the action
                 self._walk_order = order
                 self._walk_ptr = int(np.argmax(masked[order] != -np.inf))
-                self._walk_key, self._walk_masked = key, masked
+            else:
+                self._walk_order = None
+            self._walk_key, self._walk_masked = key, masked
 
         select = ssn.reclaimable if mode == CROSS_QUEUE else ssn.preemptable
         # lazy best-first walk. use_cache: pointer sweep over the shared
         # descending-score order (each position consumed once per job; a
         # winning node holds its position so the job's next task re-tests
-        # it). CROSS_QUEUE: masked argmax per visit (no resumable state —
-        # the caller applies evictions between calls).
+        # it). CROSS_QUEUE: masked argmax per visit, with the masked array
+        # resuming across the reclaimer's multi-step walk.
         neg_inf = -np.inf
         order = self._walk_order if use_cache else None
         n_order = len(order) if order is not None else 0
@@ -533,8 +598,8 @@ class PreemptContext:
             if not victims:
                 if use_cache:
                     self._reject_mask[i] = True
-                    if persist is not None:
-                        persist[i] = True
+                if persist is not None:
+                    persist[i] = True
                 continue
             # eviction order + smallest feasible prefix (the victim_prefix /
             # reclaim_prefix kernel semantics, ops/preempt.py)
