@@ -262,3 +262,58 @@ def test_apiserver_restart_durability(tmp_path):
             api.wait(timeout=10)
         except subprocess.TimeoutExpired:
             api.kill()
+
+
+def test_webhook_tls_handshake(tmp_path):
+    """The admission endpoint serves HTTPS with a generated CA-signed
+    cert; the apiserver-side callback verifies against the registered CA
+    bundle. A hook registered with the WRONG CA must fail closed
+    (failurePolicy: Fail), and plain HTTP against the TLS port must not
+    be admitted as a verdict."""
+    from volcano_tpu.apiserver.remote import RemoteAdmissionHook
+    from volcano_tpu.apiserver.store import AdmissionError, ObjectStore
+    from volcano_tpu.utils.certs import ensure_webhook_certs, read_pem
+    from volcano_tpu.utils.test_utils import build_pod
+    from volcano_tpu.webhooks.router import AdmissionHTTPServer
+
+    store = ObjectStore()
+    server = AdmissionHTTPServer(store, host="127.0.0.1", port=0,
+                                 tls_cert_dir=str(tmp_path / "certs"))
+    assert server.scheme == "https" and server.ca_bundle
+    server.start()
+    try:
+        # drive the real /pods/mutate review through the TLS socket with
+        # a verified CA bundle: must complete (allowed), not error
+        path = "/pods/mutate"
+        svc = server.services[path]
+        good = RemoteAdmissionHook(
+            kind=svc.kind, path=path,
+            url=f"https://127.0.0.1:{server.port}{path}",
+            ca_bundle=server.ca_bundle)
+        pod = build_pod("ns1", "p0", "", "Pending",
+                        {"cpu": "1", "memory": "1Gi"})
+        good.mutate("CREATE", pod)   # raises on any verification failure
+
+        # wrong CA: verification must fail -> fail closed
+        other_ca, _, _ = ensure_webhook_certs(str(tmp_path / "other"))
+        bad = RemoteAdmissionHook(
+            kind=svc.kind, path=path,
+            url=f"https://127.0.0.1:{server.port}{path}",
+            ca_bundle=read_pem(other_ca))
+        try:
+            bad.mutate("CREATE", pod)
+            raise AssertionError("wrong CA bundle was accepted")
+        except AdmissionError as e:
+            assert "unreachable" in str(e), e
+
+        # plain http against the TLS socket: also fails closed
+        plain = RemoteAdmissionHook(
+            kind=svc.kind, path=path,
+            url=f"http://127.0.0.1:{server.port}{path}")
+        try:
+            plain.mutate("CREATE", pod)
+            raise AssertionError("plain HTTP to a TLS endpoint succeeded")
+        except AdmissionError as e:
+            assert "unreachable" in str(e), e
+    finally:
+        server.stop()

@@ -116,17 +116,20 @@ class StoreHTTPServer:
                     return self._send(201, {"status": "recorded"})
                 if parsed.path == "/admissionwebhooks":
                     # the webhook-manager's self-registration: the store
-                    # will call back over HTTP on matching operations
-                    # (cmd/webhook-manager/app/server.go:64-87 registers
-                    # WebhookConfigurations with CA bundle; the callback
-                    # plays the apiserver->webhook TLS call)
+                    # calls back over HTTPS on matching operations,
+                    # verifying the webhook's serving certificate against
+                    # the registered CA bundle (the reference registers
+                    # WebhookConfigurations carrying caBundle,
+                    # cmd/webhook-manager/app/server.go:64-87 +
+                    # util.go:37-130)
                     body = self._body()
                     from .remote import RemoteAdmissionHook
                     store.register_admission(RemoteAdmissionHook(
                         kind=body["kind"], path=body.get("path", ""),
                         url=body["url"],
                         operations=tuple(body.get("operations",
-                                                  ("CREATE",)))),
+                                                  ("CREATE",))),
+                        ca_bundle=body.get("ca_bundle", "")),
                         replace=True)
                     return self._send(201, {"status": "registered"})
                 route = self._parse()

@@ -38,16 +38,31 @@ log = logging.getLogger(__name__)
 class RemoteAdmissionHook:
     """Server-side half of a remotely-registered webhook: POSTs the
     admission review to the webhook-manager's endpoint and applies the
-    verdict (and any mutation) — the apiserver->webhook call."""
+    verdict (and any mutation) — the apiserver->webhook TLS call, with
+    the serving certificate verified against the webhook configuration's
+    CA bundle (the reference's caBundle trust bootstrap,
+    cmd/webhook-manager/app/util.go:37-130)."""
 
     def __init__(self, kind: str, url: str, path: str = "",
-                 operations: tuple = ("CREATE",), timeout: float = 10.0):
+                 operations: tuple = ("CREATE",), timeout: float = 10.0,
+                 ca_bundle: str = ""):
         self.kind = kind
         self.path = path
         self.url = url
         self.operations = operations
         self.timeout = timeout
         self.validate = None   # the combined review runs in mutate()
+        self._ssl_ctx = None
+        if url.startswith("https"):
+            import ssl
+            if ca_bundle:
+                # trust exactly the registered CA (hostname/IP-SAN checks
+                # stay on — the serving cert carries the endpoint's SANs)
+                self._ssl_ctx = ssl.create_default_context(
+                    cadata=ca_bundle)
+            else:
+                # https endpoint registered without a bundle: system trust
+                self._ssl_ctx = ssl.create_default_context()
 
     def mutate(self, operation: str, new_obj, old_obj=None) -> None:
         payload = {
@@ -61,7 +76,8 @@ class RemoteAdmissionHook:
             self.url, data=json.dumps(payload).encode(),
             headers={"Content-Type": "application/json"}, method="POST")
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self._ssl_ctx) as resp:
                 review = json.loads(resp.read().decode())
         except Exception as e:
             # failurePolicy: Fail (the reference's default for its

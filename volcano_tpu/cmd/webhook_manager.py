@@ -22,6 +22,15 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         help="remote apiserver URL: serve the admission "
                              "endpoint and self-register the webhooks "
                              "(multi-process mode, docs/deployment.md)")
+    parser.add_argument("--tls-cert-dir", default=None,
+                        help="directory for the self-signed CA + serving "
+                             "cert (generated on first start; default: a "
+                             "per-process temp dir). The CA is registered "
+                             "as the webhooks' trust bundle.")
+    parser.add_argument("--insecure-http", action="store_true",
+                        help="serve the admission endpoint over plain "
+                             "HTTP (TLS is on by default in --server "
+                             "mode, matching the reference)")
     parser.add_argument("--version", action="store_true")
 
 
@@ -41,14 +50,25 @@ def main(argv=None) -> int:
         from ..webhooks.router import AdmissionHTTPServer
         lookups = RemoteStore(args.server)
         lookups.run()
+        tls_dir = None
+        if not args.insecure_http:
+            tls_dir = args.tls_cert_dir
+            if tls_dir is None:
+                import atexit
+                import shutil
+                import tempfile
+                tls_dir = tempfile.mkdtemp(prefix="vc-webhook-certs-")
+                # ephemeral keys: regenerated + re-registered every start,
+                # so nothing needs them after exit
+                atexit.register(shutil.rmtree, tls_dir, ignore_errors=True)
         endpoint = AdmissionHTTPServer(
             lookups, enabled_admission=args.enabled_admission,
-            port=args.port)
+            port=args.port, tls_cert_dir=tls_dir)
         endpoint.start()
         endpoint.register_with(args.server)
         print(f"vc-webhook-manager serving {len(endpoint.services)} "
-              f"admission services on :{endpoint.port}, registered with "
-              f"{args.server}", flush=True)
+              f"admission services on {endpoint.scheme}://127.0.0.1:"
+              f"{endpoint.port}, registered with {args.server}", flush=True)
         threading.Event().wait()
         return 0
     store = ObjectStore()

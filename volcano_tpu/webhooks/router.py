@@ -95,16 +95,23 @@ class WebhookManager:
 
 class AdmissionHTTPServer:
     """The webhook-manager's serving half in multi-process mode: exposes
-    the enabled admission services over HTTP and self-registers them with
-    a remote apiserver, which calls back per matching operation
-    (cmd/webhook-manager/app/server.go:64-87 + router/server.go).
+    the enabled admission services over HTTPS and self-registers them —
+    with the CA bundle — with a remote apiserver, which calls back per
+    matching operation, verifying the serving certificate against that
+    bundle (cmd/webhook-manager/app/server.go:64-87 + util.go:37-130 +
+    router/server.go).
+
+    ``tls_cert_dir``: directory for the self-signed CA + CA-signed serving
+    pair (generated on first start, utils/certs.py); ``None`` serves plain
+    HTTP (the --insecure-http escape hatch).
 
     Request:  POST <service path> {"operation", "object", "old"}
     Response: {"allowed": bool, "message": str, "object": mutated-or-null}
     """
 
     def __init__(self, store, enabled_admission: Optional[str] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 tls_cert_dir: Optional[str] = None):
         import json
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -152,7 +159,43 @@ class AdmissionHTTPServer:
                 self.end_headers()
                 self.wfile.write(data)
 
-        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        class TLSServer(ThreadingHTTPServer):
+            """Handshake runs in the per-request thread, NOT the accept
+            loop: wrapping the listening socket would let one stalled
+            client park accept() inside do_handshake and block every
+            admission callback cluster-wide (fail-closed means all writes
+            rejected)."""
+
+            ssl_context = None
+
+            def finish_request(self, request, client_address):
+                if self.ssl_context is not None:
+                    request.settimeout(10.0)   # bound a stalled handshake
+                    try:
+                        request = self.ssl_context.wrap_socket(
+                            request, server_side=True)
+                    except OSError:
+                        return   # bad handshake: drop this connection only
+                    request.settimeout(None)
+                super().finish_request(request, client_address)
+
+        self.scheme = "http"
+        self.ca_bundle: Optional[str] = None
+        if tls_cert_dir is not None:
+            import ssl
+
+            from ..utils.certs import ensure_webhook_certs, read_pem
+            ca_crt, tls_crt, tls_key = ensure_webhook_certs(
+                tls_cert_dir, hosts=(host, "localhost"))
+            # stdlib-hardened server defaults (TLS >= 1.2, vetted ciphers)
+            ctx = ssl.create_default_context(ssl.Purpose.CLIENT_AUTH)
+            ctx.load_cert_chain(tls_crt, tls_key)
+            self.httpd = TLSServer((host, port), Handler)
+            self.httpd.ssl_context = ctx
+            self.scheme = "https"
+            self.ca_bundle = read_pem(ca_crt)
+        else:
+            self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_port
 
     def start(self):
@@ -166,13 +209,18 @@ class AdmissionHTTPServer:
         self.httpd.shutdown()
 
     def register_with(self, apiserver_url: str) -> None:
-        """Self-register every service with the remote apiserver."""
+        """Self-register every service — CA bundle included — with the
+        remote apiserver (the reference registers Validating/Mutating
+        WebhookConfigurations carrying caBundle, util.go:37-101)."""
         import json
         import urllib.request
         for svc in self.services.values():
             payload = {"kind": svc.kind, "path": svc.path,
                        "operations": list(svc.operations),
-                       "url": f"http://{self.host}:{self.port}{svc.path}"}
+                       "url": f"{self.scheme}://{self.host}:{self.port}"
+                              f"{svc.path}"}
+            if self.ca_bundle is not None:
+                payload["ca_bundle"] = self.ca_bundle
             req = urllib.request.Request(
                 f"{apiserver_url.rstrip('/')}/admissionwebhooks",
                 data=json.dumps(payload).encode(),
