@@ -81,7 +81,12 @@ def test_allocate_batch_rolls_back_failing_task_too():
     # second placement requests more than the node's remaining idle
     big = tasks[1]
     from volcano_tpu.models.resource import Resource
+    # swap the request through the task API (resreq is immutable by
+    # contract — JobInfo maintains running aggregates over it)
+    job.delete_task_info(big)
     big.resreq = Resource.from_resource_list({"cpu": "100"})
+    big.init_resreq = big.resreq
+    job.add_task_info(big)
     before_alloc = job.allocated.milli_cpu
     with pytest.raises(RuntimeError):
         stmt.allocate_batch(job, [(tasks[0], node, False),
@@ -103,7 +108,10 @@ def test_allocate_batch_keep_partial_keeps_prefix():
     tasks = sorted(job.tasks.values(), key=lambda t: t.name)
     node = ssn.nodes["n0"]
     from volcano_tpu.models.resource import Resource
+    job.delete_task_info(tasks[1])
     tasks[1].resreq = Resource.from_resource_list({"cpu": "100"})
+    tasks[1].init_resreq = tasks[1].resreq
+    job.add_task_info(tasks[1])
     stmt = Statement(ssn)
     stmt.allocate_batch(job, [(t, node, False) for t in tasks],
                         keep_partial=True)

@@ -42,10 +42,11 @@ def main() -> None:
     n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
     n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
 
+    import volcano_tpu.framework as fw   # bench resolves these lazily
     from volcano_tpu import bench_suite as bs
     from volcano_tpu.actions.allocate import AllocateAction
+    from volcano_tpu.actions.enqueue import EnqueueAction
     from volcano_tpu.cache.cache import SchedulerCache
-    from volcano_tpu.framework import framework as fw
     from volcano_tpu.framework.solver import BatchSolver
 
     def log(msg):
@@ -69,6 +70,7 @@ def main() -> None:
     wrap(AllocateAction, "_finalize", "finalize")
     wrap(fw, "open_session", "open_session")
     wrap(fw, "close_session", "close_session")
+    wrap(EnqueueAction, "execute", "enqueue_action")
 
     log(f"building measured env {n_tasks}x{n_nodes}")
     store, cache, binder, conf = bs._cycle_env(bs.CONF_FULL)
@@ -88,6 +90,7 @@ def main() -> None:
         ("  open_session", TIMES.get("open_session", 0.0) * 1000),
         ("    snapshot", TIMES.get("snapshot", 0.0) * 1000),
         ("    plugin opens + valid", opens * 1000),
+        ("  enqueue action", TIMES.get("enqueue_action", 0.0) * 1000),
         ("  ordered_jobs", TIMES.get("ordered_jobs", 0.0) * 1000),
         ("  place (kernel+context)", TIMES.get("place_total", 0.0) * 1000),
         ("    build_context (encode)", TIMES.get("build_context", 0.0) * 1000),

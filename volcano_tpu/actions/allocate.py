@@ -214,7 +214,7 @@ class AllocateAction(Action):
             bulk.append((job, items))
 
         if bulk:
-            failed = self._stage_bulk(ssn, bulk, staged)
+            failed = self._stage_bulk(ssn, bulk, staged, result_a)
             # fallbacks re-stage in phase-A priority order with the rest
             slow.extend((pos_of[job.uid], job, pls) for job, pls in failed)
             slow.sort(key=lambda e: e[0])
@@ -235,18 +235,55 @@ class AllocateAction(Action):
             staged[job.uid] = stmt
         return staged
 
-    def _stage_bulk(self, ssn, bulk, staged: Dict[str, Statement]) -> List:
+    def _stage_bulk(self, ssn, bulk, staged: Dict[str, Statement],
+                    result=None) -> List:
         """Apply ``bulk`` = [(job, [(task, node, pipelined)])] with
         per-node accounting. Returns the jobs that must retry on the
         per-job path (as (job, placements-like) pairs rebuilt lazily).
         On any unexpected apply failure everything staged here is undone
         and ALL bulk jobs are returned for the per-job path."""
+        import numpy as np
+
         from ..models.resource import Resource, ZERO
+
+        deferred = getattr(ssn.solver, "deferred_apply", False)
+        if deferred and result is not None \
+                and result.job_total_vec is not None:
+            # deferred fast path: the kernel's vectorized totals replace
+            # the per-task Resource sums (100k+ adds per 50k burst), and
+            # the fit re-validation is one array compare — non-empty only
+            # on internal drift, which routes everything to the slow path
+            rindex = ssn.solver.rindex
+            narr = result.narr
+            failed_uids = set()
+            if result.node_alloc_vec is not None:
+                over = (result.node_alloc_vec >
+                        narr.idle + rindex.eps[None, :]).any(axis=1)
+                if over.any():
+                    bad = {narr.names[i] for i in np.flatnonzero(over)
+                           if i < len(narr.names)}
+                    for job, items in bulk:
+                        if any((not p) and node.name in bad
+                               for _, node, p in items):
+                            failed_uids.add(job.uid)
+            for job, items in bulk:
+                if job.uid in failed_uids:
+                    continue
+                for t, node, pipelined in items:
+                    t.node_name = node.name
+                stmt = Statement(ssn)
+                vec = result.job_total_vec.get(job.uid)
+                stmt.record_batch_deferred(
+                    job, items,
+                    total=rindex.resource(vec) if vec is not None
+                    else None)
+                staged[job.uid] = stmt
+            return [(job, [Placement(t, n.name, p) for t, n, p in items])
+                    for job, items in bulk if job.uid in failed_uids]
 
         # upfront fit validation per (node, allocated) group; the group
         # totals are kept and reused by add_tasks_bulk below, the per-job
         # totals by the batched plugin events
-        deferred = getattr(ssn.solver, "deferred_apply", False)
         groups: Dict[int, tuple] = {}
         job_totals: Dict[str, Resource] = {}
         for job, items in bulk:

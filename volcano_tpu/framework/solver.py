@@ -22,6 +22,7 @@ from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..models.arrays import (NodeArrays, PredicateFeatures, ResourceIndex,
@@ -41,6 +42,29 @@ _logged_once: set = set()
 # rotating start offset for the sampling window (the reference's package-
 # level node cursor, scheduler_helper.go:95); advances per sampled session
 _node_cursor = 0
+
+# shared all-zeros [G, N] device buffers by shape (read-only: the kernels
+# never write their static-score input); one slot — shapes are bucketed so
+# consecutive cycles at a stable scale reuse the same buffer
+_zeros_cache: Dict[tuple, object] = {}
+
+
+def _shared_zeros(shape: tuple):
+    buf = _zeros_cache.get(shape)
+    if buf is None:
+        if len(_zeros_cache) > 4:   # bound: shape churn must not leak
+            _zeros_cache.clear()
+        buf = jnp.zeros(shape, jnp.float32)
+        _zeros_cache[shape] = buf
+    return buf
+
+
+@jax.jit
+def _fused_static_mask(group_req, uniq_cap, inv, valid, eps):
+    """valid & capability-fit for every group x node, via unique capability
+    rows, fused to one [G, N] output."""
+    fit_u = group_fit_mask(group_req, uniq_cap, eps)      # [G, U]
+    return valid[None, :] & fit_u[:, inv]
 
 
 def _log_once(msg: str) -> None:
@@ -64,6 +88,11 @@ class PlacementResult:
     kept: Dict[str, bool]                       # job uid -> JobPipelined (keep)
     placements: Dict[str, List[Placement]]      # job uid -> placements
     unplaced: Dict[str, List[TaskInfo]]         # job uid -> tasks left pending
+    # vectorized accounting for the staging fast path (avoids one
+    # Resource.add per placed task — 100k+ calls per 50k-burst cycle):
+    narr: Optional[NodeArrays] = None
+    job_total_vec: Optional[Dict[str, np.ndarray]] = None  # uid -> [R]
+    node_alloc_vec: Optional[np.ndarray] = None  # [N_pad, R] idle-claims
 
 
 class BatchSolver:
@@ -283,79 +312,93 @@ class BatchSolver:
                         raise
         return mask
 
-    def _build_context(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]]):
-        """Snapshot the session's current node state and compute the static
-        predicate mask + static score for the batch: (narr, batch, gmask,
-        static_score)."""
+    def _context_arrays(self, ordered_jobs):
+        """Shared front half of both context builds: materialize deferred
+        placements, then the SoA encodes."""
         ssn = self.ssn
         ssn.materialize()   # deferred placements must be visible to arrays
         narr = NodeArrays.build(ssn.nodes, self._node_order(),
                                 self.rindex)
         batch = TaskBatch.build(ordered_jobs, self.rindex)
         feats = PredicateFeatures.build(ssn.nodes, narr, batch)
+        return narr, batch, feats
 
-        eps = jnp.asarray(self.rindex.eps)
-        # capability fit through unique capability rows: clusters have a
-        # handful of node shapes, so the [G,N,R] broadcast reduce becomes
-        # [G,U,R] (tiny) + one [G,N] gather
-        uniq_cap, inv = np.unique(narr.capability, axis=0,
-                                  return_inverse=True)
-        fit_u = group_fit_mask(jnp.asarray(batch.group_req),
-                               jnp.asarray(uniq_cap), eps)
-        fit_cap = fit_u[:, jnp.asarray(inv.astype(np.int32))]
-        gmask = jnp.asarray(narr.valid)[None, :] & fit_cap
+    def _apply_masks_and_scores(self, gmask, batch, narr, feats, xp):
+        """Shared back half of both context builds — ONE formulation of
+        the feature masks, plugin mask/score contributions and the host
+        predicate fallback; ``xp`` (jnp or numpy) decides only where the
+        arrays live. Contributions return None when trivially
+        pass-through: a dense [G, N] array is tens-to-hundreds of MB at
+        50k x 10k, and all-ones feature masks skip their matmuls
+        entirely."""
         if self.enable_default_predicates:
-            # all-trivial features (no selectors / no taints anywhere) make
-            # these masks all-ones: skip the [G, N] matmuls + transfers
             if feats.group_require_counts.any():
                 gmask = gmask & selector_mask(
-                    jnp.asarray(feats.node_pairs),
-                    jnp.asarray(feats.group_requires),
-                    jnp.asarray(feats.group_require_counts))
+                    xp.asarray(feats.node_pairs),
+                    xp.asarray(feats.group_requires),
+                    xp.asarray(feats.group_require_counts))
             if feats.node_taints.any():
-                gmask = gmask & taint_mask(jnp.asarray(feats.node_taints),
-                                           jnp.asarray(feats.group_tolerates))
+                gmask = gmask & taint_mask(
+                    xp.asarray(feats.node_taints),
+                    xp.asarray(feats.group_tolerates))
             if feats.group_affinity_ok is not None:
-                gmask = gmask & jnp.asarray(feats.group_affinity_ok)
-
-        # mask/score contributions return None when trivially pass-through:
-        # a dense [G, N] host array is tens-to-hundreds of MB at 50k x 10k
-        # and host->device shipping it would dominate a tunneled-TPU cycle
+                gmask = gmask & xp.asarray(feats.group_affinity_ok)
         for fn in self.mask_fns:
             contrib = fn(batch, narr, feats)
             if contrib is not None:
-                gmask = gmask & jnp.asarray(contrib)
+                gmask = gmask & xp.asarray(contrib)
         host_mask = self._host_predicate_mask(batch, narr)
         if host_mask is not None:
-            gmask = gmask & jnp.asarray(host_mask)
+            gmask = gmask & xp.asarray(host_mask)
 
-        static_score = jnp.zeros((batch.g_pad, narr.n_pad), jnp.float32)
+        static_score = None
         for fn in self.static_score_fns:
             contrib = fn(batch, narr, feats)
             if contrib is not None:
-                static_score = static_score + jnp.asarray(contrib)
+                contrib = xp.asarray(contrib)
+                static_score = contrib if static_score is None \
+                    else static_score + contrib
+        return gmask, static_score
+
+    def _build_context(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]]):
+        """Snapshot the session's current node state and compute the static
+        predicate mask + static score for the batch: (narr, batch, gmask,
+        static_score) — the DEVICE formulation (the [G, N] arrays stay on
+        the accelerator; only the small inputs cross the link)."""
+        narr, batch, feats = self._context_arrays(ordered_jobs)
+        eps = jnp.asarray(self.rindex.eps)
+        # capability fit through unique capability rows: clusters have a
+        # handful of node shapes, so the [G,N,R] broadcast reduce becomes
+        # [G,U,R] (tiny) + one [G,N] gather; the whole chain is one jitted
+        # program so XLA fuses it into a single [G,N] materialization
+        # (separate dispatches each produced a 64 MB intermediate at
+        # 50k x 10k)
+        uniq_cap, inv = np.unique(narr.capability, axis=0,
+                                  return_inverse=True)
+        gmask = _fused_static_mask(jnp.asarray(batch.group_req),
+                                   jnp.asarray(uniq_cap),
+                                   jnp.asarray(inv.astype(np.int32)),
+                                   jnp.asarray(narr.valid), eps)
+        gmask, static_score = self._apply_masks_and_scores(
+            gmask, batch, narr, feats, jnp)
+        if static_score is None:
+            # no static contributions (the common conf): a [G, N] zeros is
+            # ~256 MB at 50k x 10k and allocating one per context build
+            # dominated the encode — share one cached buffer per shape
+            # (the kernels only ever READ static rows)
+            static_score = _shared_zeros((batch.g_pad, narr.n_pad))
         return narr, batch, gmask, static_score
 
     def build_host_context(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]]):
-        """Numpy mirror of :meth:`_build_context` for host-driven actions.
-
-        KEEP IN SYNC with _build_context: the two formulations differ on
-        purpose (device kernels vs column-wise numpy without [G, N, R]
-        temporaries), and tests/test_solver_kernel.py's
-        test_host_context_matches_device_context pins their equivalence.
-
-        Host-driven actions
-        (preempt/reclaim) walk nodes in Python with identical mask/score
-        semantics and zero device traffic — pulling the [G, N] mask and
-        static score back from a tunneled TPU costs seconds at 50k x 10k,
-        while the preempt walk only ever reads a few rows."""
-        ssn = self.ssn
-        ssn.materialize()   # deferred placements must be visible to arrays
-        narr = NodeArrays.build(ssn.nodes, self._node_order(),
-                                self.rindex)
-        batch = TaskBatch.build(ordered_jobs, self.rindex)
-        feats = PredicateFeatures.build(ssn.nodes, narr, batch)
-
+        """Numpy twin of :meth:`_build_context` for host-driven actions
+        (preempt/reclaim): they walk nodes in Python reading a handful of
+        mask/score rows, and pulling [G, N] matrices back from a tunneled
+        TPU costs seconds at 50k x 10k. The feature/contribution semantics
+        are the SAME code (_apply_masks_and_scores); only the capability
+        fit differs — column-wise numpy without [G, N, R] temporaries —
+        and tests/test_solver_kernel.py's
+        test_host_context_matches_device_context pins that equivalence."""
+        narr, batch, feats = self._context_arrays(ordered_jobs)
         eps = self.rindex.eps
         gmask = np.ones((batch.g_pad, narr.n_pad), bool)
         gmask &= narr.valid[None, :]
@@ -363,30 +406,10 @@ class BatchSolver:
             # group_fit_mask, column-wise (no [G, N, R] temporaries)
             gmask &= batch.group_req[:, c:c + 1] <= \
                 (narr.capability[None, :, c] + eps[c])
-        if self.enable_default_predicates:
-            # KEEP IN SYNC with _build_context's trivial-feature skips
-            if feats.group_require_counts.any():
-                got = feats.group_requires @ feats.node_pairs.T
-                gmask &= got >= feats.group_require_counts[:, None] - 0.5
-            if feats.node_taints.any():
-                violations = (1.0 - feats.group_tolerates) @ \
-                    feats.node_taints.T
-                gmask &= violations < 0.5
-            if feats.group_affinity_ok is not None:
-                gmask &= feats.group_affinity_ok
-        for fn in self.mask_fns:
-            contrib = fn(batch, narr, feats)
-            if contrib is not None:
-                gmask &= np.asarray(contrib)
-        host_mask = self._host_predicate_mask(batch, narr)
-        if host_mask is not None:
-            gmask &= host_mask
-
-        static_score = np.zeros((batch.g_pad, narr.n_pad), np.float32)
-        for fn in self.static_score_fns:
-            contrib = fn(batch, narr, feats)
-            if contrib is not None:
-                static_score = static_score + np.asarray(contrib)
+        gmask, static_score = self._apply_masks_and_scores(
+            gmask, batch, narr, feats, np)
+        if static_score is None:
+            static_score = np.zeros((batch.g_pad, narr.n_pad), np.float32)
         return narr, batch, gmask, static_score
 
     def task_feasibility(self, job: JobInfo, task: TaskInfo):
@@ -537,7 +560,7 @@ class BatchSolver:
 
         uid_to_j = {uid: j for j, uid in enumerate(batch.job_uids)}
         result = PlacementResult(batch=batch, committed={}, kept={},
-                                 placements={}, unplaced={})
+                                 placements={}, unplaced={}, narr=narr)
         unplaced_records: List[Tuple[JobInfo, TaskInfo, int]] = []
         all_tasks = batch.tasks
         task_group_np = batch.task_group
@@ -548,6 +571,22 @@ class BatchSolver:
         a_real = assign[:n_real]
         placed_all = np.flatnonzero(a_real >= 0)
         unplaced_all = np.flatnonzero(a_real < 0)
+        if placed_all.size:
+            # vectorized per-job and per-node placement totals (consumed by
+            # the staging fast path instead of per-task Resource sums)
+            rows_req = batch.group_req[task_group_np[placed_all]]
+            jt = np.zeros((len(batch.job_uids), self.rindex.r), np.float32)
+            np.add.at(jt, batch.task_job[placed_all], rows_req)
+            result.job_total_vec = {uid: jt[j]
+                                    for uid, j in uid_to_j.items()
+                                    if jt[j].any()}
+            alloc_rows = ~pipelined_np[placed_all].astype(bool)
+            if alloc_rows.any():
+                nv = np.zeros((narr.idle.shape[0], self.rindex.r),
+                              np.float32)
+                np.add.at(nv, a_real[placed_all][alloc_rows],
+                          rows_req[alloc_rows])
+                result.node_alloc_vec = nv
         names_obj = np.empty(narr.idle.shape[0], object)
         names_obj[:len(narr.names)] = narr.names
         if placed_all.size:

@@ -80,6 +80,15 @@ class ResourceIndex:
                 v[i] = quant
         return v * self.scales
 
+    def resource(self, v: np.ndarray) -> Resource:
+        """Inverse of :meth:`vec`: a Resource from a scaled row."""
+        unscaled = np.asarray(v, np.float64) / self.scales
+        r = Resource(milli_cpu=float(unscaled[0]), memory=float(unscaled[1]))
+        for i in range(2, self.r):
+            if unscaled[i]:
+                r.set_scalar(self.names[i], float(unscaled[i]))
+        return r
+
     def vec_capability(self, r: Resource) -> np.ndarray:
         """Capability-style vector: dimensions the resource does not mention
         are unbounded (the Infinity dimension default, resource_info.go:43)."""

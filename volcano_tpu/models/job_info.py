@@ -189,6 +189,10 @@ class JobInfo:
         self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = defaultdict(dict)
         self.allocated: Resource = Resource()
         self.total_request: Resource = Resource()
+        # running sum of Pending tasks' requests (proportion's queue
+        # `request` walk was one Resource.add per pending task per cycle —
+        # 50k adds at the burst benchmark)
+        self.pending_request: Resource = Resource()
         self.creation_timestamp: float = 0.0
         self.pod_group: Optional[PodGroup] = None
         # copy-on-write marker: snapshot clones share the cache's PodGroup
@@ -298,6 +302,8 @@ class JobInfo:
         self.task_status_index[ti.status][ti.uid] = ti
         if allocated_status(ti.status):
             self.allocated.add(ti.resreq)
+        elif ti.status == TaskStatus.Pending:
+            self.pending_request.add(ti.resreq)
         self.total_request.add(ti.resreq)
 
     def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
@@ -328,6 +334,10 @@ class JobInfo:
             self.allocated.sub(stored.resreq)
         elif now and not was:
             self.allocated.add(stored.resreq)
+        if old == TaskStatus.Pending and status != TaskStatus.Pending:
+            self.pending_request.sub(stored.resreq)
+        elif status == TaskStatus.Pending and old != TaskStatus.Pending:
+            self.pending_request.add(stored.resreq)
         task.status = status
         self.tasks[task.uid] = task
         self.task_status_index[status][task.uid] = task
@@ -348,8 +358,11 @@ class JobInfo:
             stored_list.append(stored)
         self._status_version += 1
         now = allocated_status(status)
+        now_pending = status == TaskStatus.Pending
         flip_add = None
         flip_sub = None
+        pend_add = None
+        pend_sub = None
         new_idx = self.task_status_index[status]
         for task, stored in zip(tasks, stored_list):
             old = stored.status
@@ -366,6 +379,15 @@ class JobInfo:
                 if flip_add is None:
                     flip_add = Resource()
                 flip_add.add(stored.resreq)
+            was_pending = old == TaskStatus.Pending
+            if was_pending and not now_pending:
+                if pend_sub is None:
+                    pend_sub = Resource()
+                pend_sub.add(stored.resreq)
+            elif now_pending and not was_pending:
+                if pend_add is None:
+                    pend_add = Resource()
+                pend_add.add(stored.resreq)
             task.status = status
             self.tasks[task.uid] = task
             new_idx[task.uid] = task
@@ -373,6 +395,10 @@ class JobInfo:
             self.allocated.add(flip_add)
         if flip_sub is not None:
             self.allocated.sub(flip_sub)
+        if pend_add is not None:
+            self.pending_request.add(pend_add)
+        if pend_sub is not None:
+            self.pending_request.sub(pend_sub)
         return flip_add
 
     def delete_task_info(self, ti: TaskInfo) -> None:
@@ -383,6 +409,8 @@ class JobInfo:
                            f"in job <{self.namespace}/{self.name}>")
         if allocated_status(task.status):
             self.allocated.sub(task.resreq)
+        elif task.status == TaskStatus.Pending:
+            self.pending_request.sub(task.resreq)
         self.total_request.sub(task.resreq)
         del self.tasks[task.uid]
         idx = self.task_status_index[task.status]
@@ -391,7 +419,16 @@ class JobInfo:
             del self.task_status_index[task.status]
 
     def clone(self) -> "JobInfo":
-        info = JobInfo(self.uid)
+        # __new__ + explicit fields: JobInfo() runs the full constructor
+        # (time.time(), defaultdicts, ~25 defaults) only for clone() to
+        # overwrite nearly all of it — measurable at 6k jobs per snapshot
+        info = JobInfo.__new__(JobInfo)
+        info.uid = self.uid
+        info.job_fit_errors = ""
+        info._status_version = 0
+        info._ready_cache = (-1, 0)
+        info.deferred_alloc = 0
+        info.deferred_pipe = 0
         info.name = self.name
         info.namespace = self.namespace
         info.queue = self.queue
@@ -427,6 +464,7 @@ class JobInfo:
         info.task_status_index = index
         info.allocated = self.allocated.clone()
         info.total_request = self.total_request.clone()
+        info.pending_request = self.pending_request.clone()
         return info
 
     # -- readiness accounting ---------------------------------------------

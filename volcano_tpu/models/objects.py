@@ -674,7 +674,46 @@ def _clone_pod(p: "Pod") -> "Pod":
     return new
 
 
+def _clone_pod_group_status(st: "PodGroupStatus") -> "PodGroupStatus":
+    new = object.__new__(PodGroupStatus)
+    d = new.__dict__
+    d.update(st.__dict__)              # phase + counters (scalars)
+    # condition entries are replaced/appended, never mutated in place
+    # (framework.update_pod_group_condition rebinds conditions[i]), so the
+    # elements are shared and only the list is copied
+    d["conditions"] = list(st.conditions)
+    return new
+
+
+def _clone_pod_group_spec(sp: "PodGroupSpec") -> "PodGroupSpec":
+    # a flat copy (the job controller mutates a gotten pg's spec in place
+    # before update, so specs are NOT shareable across clones): scalars +
+    # two shallow dict copies with scalar values
+    new = object.__new__(PodGroupSpec)
+    d = new.__dict__
+    d.update(sp.__dict__)
+    d["min_task_member"] = dict(sp.min_task_member)
+    if sp.min_resources is not None:
+        d["min_resources"] = dict(sp.min_resources)
+    return new
+
+
+def _clone_pod_group(pg: "PodGroup") -> "PodGroup":
+    """PodGroup clones run once per status-writing job per cycle (the
+    copy-on-write claim in JobInfo.own_pod_group) and once per job per
+    snapshot echo: rebuild the three shells without generic recursion."""
+    new = object.__new__(PodGroup)
+    d = new.__dict__
+    d["metadata"] = _clone_object_meta(pg.metadata)
+    d["spec"] = _clone_pod_group_spec(pg.spec)
+    d["status"] = _clone_pod_group_status(pg.status)
+    return new
+
+
 register_cloner(ObjectMeta, _clone_object_meta)
 register_cloner(PodStatus, _clone_pod_status)
 register_cloner(PodSpec, _clone_pod_spec)
 register_cloner(Pod, _clone_pod)
+register_cloner(PodGroupStatus, _clone_pod_group_status)
+register_cloner(PodGroupSpec, _clone_pod_group_spec)
+register_cloner(PodGroup, _clone_pod_group)

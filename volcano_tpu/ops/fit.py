@@ -30,16 +30,17 @@ def group_fit_mask(group_req: jax.Array, node_avail: jax.Array,
                    axis=-1)
 
 
-def selector_mask(node_pairs: jax.Array, group_requires: jax.Array,
-                  group_require_counts: jax.Array) -> jax.Array:
+def selector_mask(node_pairs, group_requires, group_require_counts):
     """Conjunctive label-pair matching as a matmul (MXU path).
     node_pairs [N,F], group_requires [G,F] -> [G,N] bool: node satisfies all
-    of the group's required pairs."""
+    of the group's required pairs. Backend-generic: the input arrays decide
+    (jnp inside the device context build, numpy for the host context) —
+    ONE implementation for both."""
     got = group_requires @ node_pairs.T           # [G, N] matched-pair counts
     return got >= group_require_counts[:, None] - 0.5
 
 
-def taint_mask(node_taints: jax.Array, group_tolerates: jax.Array) -> jax.Array:
+def taint_mask(node_taints, group_tolerates):
     """[N,K] x [G,K] -> [G,N] bool: no untolerated NoSchedule/NoExecute taint.
     (TaintToleration filter, predicates.go:316-329)."""
     violations = (1.0 - group_tolerates) @ node_taints.T   # [G, N]

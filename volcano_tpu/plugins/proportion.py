@@ -80,13 +80,12 @@ class ProportionPlugin(Plugin):
             if attr is None:
                 attr = _QueueAttr(ssn.queues[job.queue])
                 self.queue_opts[job.queue] = attr
-            # allocated-status sum is maintained on JobInfo; only the
-            # Pending portion of `request` needs a task walk
+            # allocated-status and pending-request sums are maintained as
+            # running aggregates on JobInfo (one add per job instead of
+            # one per task — 50k adds per cycle at the burst benchmark)
             attr.allocated.add(job.allocated)
             attr.request.add(job.allocated)
-            for t in job.task_status_index.get(TaskStatus.Pending,
-                                               {}).values():
-                attr.request.add(t.resreq)
+            attr.request.add(job.pending_request)
             if job.pod_group.status.phase == PodGroupPhase.INQUEUE:
                 attr.inqueue.add(job.get_min_resources())
 
