@@ -221,10 +221,12 @@ class ObjectStore:
                 w.on_delete(old)
         return o
 
-    def patch_batch(self, kind: str, patches) -> tuple:
+    def patch_batch(self, kind: str, patches, clone_fn=None) -> tuple:
         """Apply ``[(name, namespace, fn)]`` under ONE lock pass: each fn
         mutates a fresh clone of the stored object, which becomes the new
-        stored version (rv bump + journal entry each). Admission is skipped
+        stored version (rv bump + journal entry each). ``clone_fn``
+        overrides the clone used to derive the new version (the bind path
+        passes a shell-only pod cloner). Admission is skipped
         by design — the only caller is the bind path, and the reference's
         POST .../binding does not re-run pod admission either.
 
@@ -250,7 +252,7 @@ class ObjectStore:
                         if old is None:
                             missing.append((name, namespace))
                             continue
-                        new = fast_clone(old)
+                        new = (clone_fn or fast_clone)(old)
                         fn(new)   # a raising fn aborts THIS item pre-commit;
                         #           already-committed items still notify and
                         #           deliver below (finally) before re-raise

@@ -89,6 +89,15 @@ class SchedulerCache(EventHandlersMixin):
         # run exactly once, in submission order, under self.mutex.
         self._pending_apply: deque = deque()
         self._apply_lock = threading.Lock()
+        # coalesced bind flush: per-gang commits only record their bound
+        # lists; ONE drainer submission per burst executes a single
+        # binder.bind_batch over every gang recorded by its run time —
+        # one store lock pass and one bulk watch delivery for the whole
+        # burst instead of one per gang. Safe to coalesce across gangs: a
+        # bind enqueued after an evict of the same pod cannot exist (a
+        # task re-binds only via a new pod object after delete+recreate).
+        self._pending_binds: list = []
+        self._bind_drain_queued = False
         # cleared while a scheduling cycle is in flight: the executor backs
         # off so its (GIL-bound) store writes don't contend with the
         # cycle's host path — submitted work flushes in the schedule-period
@@ -428,46 +437,72 @@ class SchedulerCache(EventHandlersMixin):
                 accepted.append(task_info)
                 bound.append((task, task.pod, hostname))
 
-        def do_bind_all():
-            with self.mutex:
-                self._drain_applies_locked()
-            bind_all = getattr(self.binder, "bind_batch", None)
-            if bind_all is not None:
-                try:
-                    missing = bind_all([(pod, hostname)
-                                        for _, pod, hostname in bound])
-                except Exception:
-                    for task, _, _ in bound:
-                        self.resync_task(task)
-                    return
-                gone = {id(pod) for pod, _ in missing}
-                for task, pod, hostname in bound:
-                    if id(pod) in gone:
-                        self.resync_task(task)
-                    else:
-                        self.store.record_event(
-                            "pods", pod, "Normal", "Scheduled",
-                            f"Successfully assigned {task.namespace}/"
-                            f"{task.name} to {hostname}")
-                return
-            for task, pod, hostname in bound:
-                try:
-                    self.binder.bind(pod, hostname)
-                    self.store.record_event(
-                        "pods", pod, "Normal", "Scheduled",
-                        f"Successfully assigned {task.namespace}/"
-                        f"{task.name} to {hostname}")
-                except Exception:
-                    self.resync_task(task)
-
-        if self._queue_apply(apply):
-            self._submit(do_bind_all)
+        with self._exec_lock:
+            worker_live = self._exec_thread is not None
+        if worker_live:
+            # ONE lock acquisition appends both the apply and the bound
+            # record: a gang visible in _pending_binds always has its
+            # apply in _pending_apply, so the drainer's apply drain
+            # covers every gang it pops
+            with self._apply_lock:
+                self._pending_apply.append(apply)
+                self._pending_binds.append(bound)
+                need_drain = not self._bind_drain_queued
+                self._bind_drain_queued = True
+            if need_drain:
+                self._submit(self._drain_binds)
             return [t for t, _ in pairs]
         with self.mutex:
             self._state_version += 1
             apply()
-        do_bind_all()
+        self._bind_store_writes(bound)
         return accepted
+
+    def _drain_binds(self) -> None:
+        """Executor half of the coalesced bind flush: pop the recorded
+        gangs, drain the pending cache applies (they order BEFORE the
+        store writes — popping first guarantees every popped gang's apply
+        is covered), then execute one store bind pass for the burst."""
+        with self._apply_lock:
+            batches, self._pending_binds = self._pending_binds, []
+            self._bind_drain_queued = False
+        with self.mutex:
+            self._drain_applies_locked()
+        bound = [x for b in batches for x in b]
+        if bound:
+            self._bind_store_writes(bound)
+
+    def _bind_store_writes(self, bound) -> None:
+        """One binder pass + Scheduled events for [(task, pod, hostname)];
+        failures land in the resync queue (cache.go:605-655)."""
+        bind_all = getattr(self.binder, "bind_batch", None)
+        if bind_all is not None:
+            try:
+                missing = bind_all([(pod, hostname)
+                                    for _, pod, hostname in bound])
+            except Exception:
+                for task, _, _ in bound:
+                    self.resync_task(task)
+                return
+            gone = {id(pod) for pod, _ in missing}
+            for task, pod, hostname in bound:
+                if id(pod) in gone:
+                    self.resync_task(task)
+                else:
+                    self.store.record_event(
+                        "pods", pod, "Normal", "Scheduled",
+                        f"Successfully assigned {task.namespace}/"
+                        f"{task.name} to {hostname}")
+            return
+        for task, pod, hostname in bound:
+            try:
+                self.binder.bind(pod, hostname)
+                self.store.record_event(
+                    "pods", pod, "Normal", "Scheduled",
+                    f"Successfully assigned {task.namespace}/"
+                    f"{task.name} to {hostname}")
+            except Exception:
+                self.resync_task(task)
 
     def evict(self, task_info: TaskInfo, reason: str) -> None:
         """Mark Releasing, update node accounting, then delete the pod

@@ -62,9 +62,21 @@ def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool) -> tuple:
             #                        quantity parse)
         return fn
 
+    from ..models.objects import clone_pod_for_bind
+    # feature-detect clone_fn support up front: catching TypeError around
+    # the executing call would re-run a partially committed batch when a
+    # patch fn itself raised TypeError (double rv bumps + double watch
+    # deliveries for the committed prefix)
+    kwargs = {}
+    try:
+        import inspect
+        if "clone_fn" in inspect.signature(patch_fn).parameters:
+            kwargs["clone_fn"] = clone_pod_for_bind
+    except (TypeError, ValueError):   # builtins/remote proxies: no kwarg
+        pass
     _, missing_keys = patch_fn(
         "pods", [(pod.metadata.name, pod.metadata.namespace,
-                  setter(hostname)) for pod, hostname in items])
+                  setter(hostname)) for pod, hostname in items], **kwargs)
     if not missing_keys:
         return [], True
     gone = set(missing_keys)

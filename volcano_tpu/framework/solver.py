@@ -433,11 +433,12 @@ class BatchSolver:
     def _select_kernel(self, n_namespaces: int = 1) -> Tuple[Callable, Dict]:
         """Resolve the placement kernel per the `solver` conf: the Pallas
         TPU kernel when requested (or `auto` on a TPU backend) and the
-        resource axis fits its sublane budget; the chunked-candidate scan
-        (ops/allocate.gang_allocate_chunked, ~4x the plain scan off-TPU)
-        for `auto`/`chunked` elsewhere; the plain XLA scan on request.
-        Multi-namespace batches never route to Pallas — the namespace-
-        primary selection lives in the XLA kernels."""
+        resource axis fits its sublane budget; off-TPU `auto` prefers the
+        native C++ solver (ops/native.py, bit-exact vs the scan) and falls
+        back to the chunked-candidate XLA scan; `chunked`/`scan`/`native`
+        force a specific kernel. Multi-namespace batches never route to
+        Pallas — the namespace-primary selection lives in the other
+        kernels."""
         from ..ops.allocate import gang_allocate_chunked
         from ..ops.pallas_allocate import R_PAD, gang_allocate_pallas
         if self.kernel == "pallas":
@@ -450,14 +451,29 @@ class BatchSolver:
                 return gang_allocate_chunked, {}
             interpret = jax.default_backend() != "tpu"
             return gang_allocate_pallas, {"interpret": interpret}
-        if self.kernel == "auto":
+        if self.kernel in ("auto", "native"):
             import jax
-            if jax.default_backend() == "tpu" and self.rindex.r <= R_PAD \
-                    and n_namespaces <= 1:
+            on_tpu = jax.default_backend() == "tpu"
+            if self.kernel == "auto" and on_tpu \
+                    and self.rindex.r <= R_PAD and n_namespaces <= 1:
                 return gang_allocate_pallas, {}
+            # native is the off-TPU path only: on a TPU backend `auto`
+            # stays on the XLA kernels when the Pallas gate fails (running
+            # the host solver there would ship every device input back)
+            if self.rindex.r <= 8 and (not on_tpu or self.kernel == "native"):
+                from ..ops.native import available, gang_allocate_native
+                if available():
+                    return gang_allocate_native, {}
+                if self.kernel == "native":
+                    _log_once("solver kernel=native but the native library "
+                              "is unavailable; falling back to chunked")
+            elif self.kernel == "native":
+                _log_once("solver kernel=native but resource dims exceed "
+                          "the native solver's budget (r>8); falling back "
+                          "to chunked")
             # the candidate-table refresh only pays off once the node
             # sweep is expensive; small clusters keep the plain scan
-            if len(self.ssn.nodes) >= 1024:
+            if self.kernel == "native" or len(self.ssn.nodes) >= 1024:
                 return gang_allocate_chunked, {}
         if self.kernel == "chunked":
             return gang_allocate_chunked, {}

@@ -160,6 +160,26 @@ class TaskInfo:
                 f"job {self.job}, status {self.status.name}, pri {self.priority}")
 
 
+_fm_cache = None
+_fm_tried = False
+
+
+def _fastmodel():
+    """Lazy handle to the native snapshot accelerators (None = fallback)."""
+    global _fm_cache, _fm_tried
+    if not _fm_tried:
+        _fm_tried = True
+        try:
+            from ..native.build import fastmodel
+            mod = fastmodel()
+            if mod is not None:
+                mod.register_task_type(TaskInfo)
+                _fm_cache = mod
+        except Exception:
+            _fm_cache = None
+    return _fm_cache
+
+
 class DisruptionBudget:
     """Job disruption budget (reference: job_info.go:38-58)."""
 
@@ -453,13 +473,25 @@ class JobInfo:
         # direct task copy: the status index and allocated/total aggregates
         # are cloned rather than re-derived one add_task_info at a time —
         # at 50k tasks the replay's per-task Resource arithmetic dominated
-        # the snapshot (cache.go:827-876 pays the same via deepcopy-gen)
-        tasks: Dict[str, TaskInfo] = {}
-        index: Dict[TaskStatus, Dict[str, TaskInfo]] = defaultdict(dict)
-        for uid, task in self.tasks.items():
-            c = task.clone()
-            tasks[uid] = c
-            index[c.status][uid] = c
+        # the snapshot (cache.go:827-876 pays the same via deepcopy-gen).
+        # The C fast path (native/fastmodel.c) does the verbatim slot
+        # copies + index build in one pass; exact-type tables only.
+        tasks = None
+        fm = _fastmodel()
+        if fm is not None:
+            try:
+                tasks, plain = fm.clone_task_table(self.tasks)
+                index = defaultdict(dict)
+                index.update(plain)
+            except TypeError:     # subclassed tasks: python fallback
+                tasks = None
+        if tasks is None:
+            tasks = {}
+            index = defaultdict(dict)
+            for uid, task in self.tasks.items():
+                c = task.clone()
+                tasks[uid] = c
+                index[c.status][uid] = c
         info.tasks = tasks
         info.task_status_index = index
         info.allocated = self.allocated.clone()

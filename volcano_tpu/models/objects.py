@@ -674,6 +674,33 @@ def _clone_pod(p: "Pod") -> "Pod":
     return new
 
 
+def clone_pod_for_bind(p: "Pod") -> "Pod":
+    """Minimal pod clone for the store's bind patch: only the mutated
+    shells (metadata for the resource_version bump, spec for node_name)
+    are fresh; labels/annotations/status and every spec subtree are
+    SHARED with the stored object. Safe because stored objects are never
+    mutated in place (store reads hand out copies; admission mutates
+    inbound objects pre-store) — the 50k-bind flush pays two dict.update
+    calls per pod instead of a structured deep clone."""
+    new = object.__new__(Pod)
+    d = new.__dict__
+    s = p.__dict__
+    m = object.__new__(ObjectMeta)
+    m.__dict__.update(s["metadata"].__dict__)   # labels/annotations shared
+    d["metadata"] = m
+    sp = object.__new__(PodSpec)
+    sp.__dict__.update(s["spec"].__dict__)      # subtrees shared
+    d["spec"] = sp
+    d["status"] = s["status"]                   # shared (bind leaves it)
+    rr = s.get("_rr")
+    if rr is not None:
+        d["_rr"] = rr
+    sig = s.get("_sched_group_sig")
+    if sig is not None:
+        d["_sched_group_sig"] = sig
+    return new
+
+
 def _clone_pod_group_status(st: "PodGroupStatus") -> "PodGroupStatus":
     new = object.__new__(PodGroupStatus)
     d = new.__dict__

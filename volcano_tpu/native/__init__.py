@@ -1,0 +1,6 @@
+"""Native runtime pieces: the C++ CPU solver and C snapshot accelerators.
+
+Sources ship with the package (solver.cc, fastmodel.c) and are compiled on
+demand by :mod:`volcano_tpu.native.build`; everything degrades gracefully
+to the XLA/pure-Python paths when no toolchain is present.
+"""

@@ -1,0 +1,133 @@
+"""Build the native solver shared library on demand.
+
+The native runtime pieces of this framework are C++ (the reference's hot
+path is native Go; ours is a C++ kernel for off-TPU deployments plus the
+Pallas kernel on TPU). The library is compiled once per source change with
+the toolchain baked into the image (g++); no network, no pip.
+
+Float parity with XLA:CPU requires IEEE semantics: no -ffast-math and
+-ffp-contract=off (FMA contraction would change last-ulp results and with
+them argmax tie-breaks, breaking the bit-exact parity the fuzz tests pin).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import subprocess
+import threading
+
+_log = logging.getLogger(__name__)
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "solver.cc")
+_LOCK = threading.Lock()
+_cached_path = None
+
+
+def _host_tag() -> str:
+    """Cache key component for the HOST the library was compiled on:
+    -march=native binaries must never be reused on a different CPU (a
+    foreign .so hash-matching the source would SIGILL the scheduler)."""
+    import platform
+    cpu = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("model name", "flags")):
+                    cpu += line
+                    if cpu.count("\n") >= 2:
+                        break
+    except OSError:
+        pass
+    h = hashlib.sha256((platform.machine() + cpu).encode()).hexdigest()[:8]
+    return f"{platform.machine()}-{h}"
+
+
+def _src_tag() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def lib_path() -> str:
+    """Path of the built library for the current source (not yet built)."""
+    return os.path.join(_DIR, f"libvcsolver-{_src_tag()}-{_host_tag()}.so")
+
+
+def ensure_built() -> str:
+    """Compile solver.cc if needed; returns the .so path.
+
+    Raises on compiler failure — callers gate on availability and fall
+    back to the XLA kernels.
+    """
+    global _cached_path
+    with _LOCK:
+        if _cached_path is not None and os.path.exists(_cached_path):
+            return _cached_path
+        path = lib_path()
+        if not os.path.exists(path):
+            tmp = path + f".tmp{os.getpid()}"
+            # -march=native vectorizes the sweep (AVX2/AVX-512 where the
+            # host has it) — still bit-exact: elementwise IEEE float ops
+            # are identical per lane, and -ffp-contract=off forbids FMA
+            # -fno-trapping-math lets the compiler speculate the masked
+            # divisions (if-conversion), enabling vectorization; computed
+            # VALUES stay IEEE-exact — only unobserved FP flags differ
+            cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+                   "-fno-fast-math", "-ffp-contract=off", "-march=native",
+                   "-fno-trapping-math", "-fno-math-errno",
+                   "-o", tmp, _SRC]
+            _log.info("building native solver: %s", " ".join(cmd))
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=300)
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"native solver build failed:\n{r.stderr[-2000:]}")
+            os.replace(tmp, path)   # atomic: concurrent builders race safely
+            # leave older-hash libraries in place (running processes may
+            # still map them); the directory holds at most a few
+        _cached_path = path
+        return path
+
+
+_FM_SRC = os.path.join(_DIR, "fastmodel.c")
+_fm_module = None
+_fm_failed = False
+
+
+def fastmodel():
+    """Import (building on demand) the fastmodel C extension; returns the
+    module or None when the toolchain/headers are unavailable."""
+    global _fm_module, _fm_failed
+    if _fm_module is not None or _fm_failed:
+        return _fm_module
+    with _LOCK:
+        if _fm_module is not None or _fm_failed:
+            return _fm_module
+        try:
+            import importlib.util
+            import sys
+            import sysconfig
+            with open(_FM_SRC, "rb") as f:
+                tag = hashlib.sha256(f.read()).hexdigest()[:16]
+            tag += f"-py{sys.version_info[0]}{sys.version_info[1]}"
+            so = os.path.join(_DIR, f"fastmodel-{tag}-{_host_tag()}.so")
+            if not os.path.exists(so):
+                inc = sysconfig.get_paths()["include"]
+                tmp = so + f".tmp{os.getpid()}"
+                cmd = ["gcc", "-O2", "-fPIC", "-shared", f"-I{inc}",
+                       "-o", tmp, _FM_SRC]
+                r = subprocess.run(cmd, capture_output=True, text=True,
+                                   timeout=300)
+                if r.returncode != 0:
+                    raise RuntimeError(
+                        f"fastmodel build failed:\n{r.stderr[-1500:]}")
+                os.replace(tmp, so)
+            spec = importlib.util.spec_from_file_location("fastmodel", so)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _fm_module = mod
+        except Exception as e:
+            _fm_failed = True
+            _log.warning("fastmodel unavailable: %s", e)
+        return _fm_module

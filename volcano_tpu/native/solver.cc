@@ -1,0 +1,779 @@
+// Native CPU gang-allocate solver.
+//
+// The production TPU path is the Pallas kernel (ops/pallas_allocate.py);
+// off-TPU the framework previously ran the chunked XLA scan
+// (ops/allocate.py gang_allocate_chunked).  XLA-on-CPU pays per-step scan
+// overhead plus a full [N,R] checkpoint copy at every gang boundary; this
+// native kernel implements the same decision procedure directly:
+//
+//   * a top-C2-per-fit-class candidate table (C2 >= the XLA kernel's
+//     chunk) refreshed on group-CONTENT change (rows memcmp-verified),
+//     bucket-chain change, or budget exhaustion — shape-identical gang
+//     bursts (the production conf) sweep nodes ~T/C2 times total;
+//   * a branchless two-pass node sweep over plane-transposed state
+//     (auto-vectorizes; the XLA kernel materializes the same sweep per
+//     refresh inside lax.scan);
+//   * gang rollback via an undo log holding pre-placement values (the XLA
+//     kernel restores a full [N,R] checkpoint copy per boundary);
+//   * per-row cached serve scores, recomputed only on touch / sb change.
+//
+// EXACTNESS: decisions (assign / pipelined / ready / kept) match
+// ops/allocate.gang_allocate (the plain scan, the semantic ground truth)
+// bit-for-bit.  The dominance argument mirrors ops/sharded.py's chunked
+// kernel: within a table's lifetime at most C2-1 nodes are touched, only
+// placed-on nodes change score/feasibility, every placed-on node is in the
+// table, and an untouched node outside the table is dominated (score desc,
+// index asc — lax.top_k's tie order) by at least one untouched in-table
+// entry of its own class.  Table reuse across jobs additionally requires
+// the (req row, mask row, static row, pack bonus) CONTENT to be equal,
+// which is verified by memcmp, and a bucket change forces a refresh
+// exactly like the XLA kernel's `b != prev_b` condition.  Float32
+// arithmetic follows ops/score.py's operation order; the build forbids
+// FMA contraction (-ffp-contract=off) so results match XLA:CPU bitwise.
+// Parity is pinned by tests/test_native_kernel.py fuzz vs the scan.
+//
+// Reference semantics: pkg/scheduler/actions/allocate/allocate.go:120-270
+// (namespace/queue priority queues, per-task predicate+score+argmax,
+// Statement commit/discard) — see ops/allocate.py's docstring for the
+// mapping.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <cstdlib>
+#include <cmath>
+#include <chrono>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+constexpr float NEG = -1e30f;
+constexpr float BIG = 1e30f;
+
+struct Weights {
+  const float* binpack_res;  // [R]
+  float binpack, least, most, balanced;
+};
+
+// node_score (ops/score.py node_score): used = alloc - idle;
+// s = w_bp*binpack + w_least*least + w_most*most + w_bal*balanced.
+// Operation order matches the jnp formulation exactly; the caller adds
+// static_bonus (jnp adds it last: `return s + static_bonus`).
+static inline float node_score_base(const float* req, const float* idle,
+                                    const float* alloc, const Weights& w,
+                                    int R) {
+  float bp;
+  {
+    float acc = 0.0f;
+    float wsum = 0.0f;
+    for (int r = 0; r < R; ++r) {
+      bool requested = (req[r] > 0.0f) && (w.binpack_res[r] > 0.0f);
+      float used = alloc[r] - idle[r];
+      float denom = std::max(alloc[r], 1e-9f);
+      float frac = (alloc[r] > 0.0f) ? (used + req[r]) / denom : 2.0f;
+      float per = (frac <= 1.0f) ? frac * 100.0f : 0.0f;
+      float wr = requested ? w.binpack_res[r] : 0.0f;
+      acc += per * wr;
+      wsum += wr;
+    }
+    wsum = std::max(wsum, 1e-9f);
+    bp = acc / wsum;
+  }
+  float fl[2], fm[2], fb[2];
+  for (int r = 0; r < 2; ++r) {
+    float a = alloc[r];
+    float u = (a - idle[r]) + req[r];
+    float denom = std::max(a, 1e-9f);
+    fl[r] = (a > 0.0f) ? std::max(a - u, 0.0f) / denom : 0.0f;
+    fm[r] = (a > 0.0f) ? std::min(std::max(u, 0.0f), a) / denom : 0.0f;
+    fb[r] = (a > 0.0f) ? u / denom : 0.0f;
+  }
+  float least = (fl[0] * 100.0f + fl[1] * 100.0f) / 2.0f;
+  float most = (fm[0] * 100.0f + fm[1] * 100.0f) / 2.0f;
+  float balanced = 100.0f - std::fabs(fb[0] - fb[1]) * 100.0f;
+  float s = w.binpack * bp;
+  s = s + w.least * least;
+  s = s + w.most * most;
+  s = s + w.balanced * balanced;
+  return s;
+}
+
+static inline bool fits(const float* req, const float* avail,
+                        const float* eps, int R) {
+  for (int r = 0; r < R; ++r)
+    if (!(req[r] <= avail[r] + eps[r])) return false;
+  return true;
+}
+
+static inline float queue_share_one(const float* alloc, const float* dsrv,
+                                    int R) {
+  float m = 0.0f;
+  for (int r = 0; r < R; ++r) {
+    float d = dsrv[r];
+    float frac;
+    if (std::isinf(d)) frac = 0.0f;
+    else if (d == 0.0f) frac = (alloc[r] == 0.0f) ? 0.0f : 1.0f;
+    else frac = alloc[r] / d;
+    m = std::max(m, frac);
+  }
+  return m;
+}
+
+static inline bool queue_overused_one(const float* alloc, const float* dsrv,
+                                      const float* eps, int R) {
+  for (int r = 0; r < R; ++r) {
+    bool le = (alloc[r] <= dsrv[r] + eps[r]) || std::isinf(dsrv[r]);
+    if (!le) return true;
+  }
+  return false;
+}
+
+static inline float ns_share_one(const float* alloc, const float* total,
+                                 float weight, int R) {
+  float m = 0.0f;
+  for (int r = 0; r < R; ++r) {
+    float frac = (total[r] > 0.0f) ? alloc[r] / total[r]
+                                   : (alloc[r] == 0.0f ? 0.0f : 1.0f);
+    m = std::max(m, frac);
+  }
+  return m / weight;
+}
+
+struct Args {
+  int32_t T, G, J, Q, P, NS, N, R;
+  int32_t C2;                 // candidate-table size per fit class
+  const int32_t* task_group;
+  const int32_t* task_job;
+  const uint8_t* task_valid;
+  const float* group_req;     // [G,R]
+  const uint8_t* group_mask;  // [G,N]
+  const float* group_static;  // [G,N]
+  const int32_t* task_bucket;
+  const float* pack_bonus;    // [G]
+  const int32_t* job_min;     // [J]
+  const int32_t* job_base;
+  const int32_t* job_start;
+  const int32_t* job_ntasks;
+  const int32_t* pool_queue;  // [P]
+  const int32_t* pool_ns;
+  const int32_t* pool_job_start;
+  const int32_t* pool_njobs;
+  const float* ns_weight;     // [NS]
+  const float* ns_alloc0;     // [NS,R]
+  const float* ns_total;      // [R]
+  const float* q_deserved;    // [Q,R]
+  const float* q_alloc0;      // [Q,R]
+  const float* node_idle;     // [N,R]
+  const float* node_future;
+  const float* node_alloc;
+  const int32_t* node_ntasks; // [N]
+  const int32_t* node_max;    // [N]
+  const float* eps;           // [R]
+  const float* binpack_res;   // [R]
+  float w_binpack, w_least, w_most, w_balanced;
+  int32_t allow_pipeline, ns_live;
+  // outputs
+  int32_t* assign;            // [T]
+  uint8_t* out_pipelined;     // [T]
+  uint8_t* out_ready;         // [J]
+  uint8_t* out_kept;          // [J]
+  float* out_idle;            // [N,R]
+};
+
+struct Solver {
+  const Args& a;
+  int N, R;
+  Weights w;
+  // cluster state in PLANE layout [r][n] (auto-vectorizable sweeps);
+  // alloc planes are read-only copies of the input
+  std::vector<float> idleT, futT, allocT;   // [R*N]
+  std::vector<int32_t> ntasks;              // [N]
+  // pack chain state (NOT rolled back on gang discard — scan semantics)
+  std::vector<float> pack_val;              // [N]
+  std::vector<int32_t> pack_epoch;          // [N]
+  int32_t epoch = 1;
+  int32_t cur_bucket = -1;
+  // bookkeeping
+  std::vector<float> q_alloc, ns_alloc;     // [Q,R] / [NS,R]
+  std::vector<int32_t> p_cursor;            // [P]
+  std::vector<uint8_t> ready, kept;         // [J]
+
+  // sweep buffers (pass A writes, pass B reads)
+  std::vector<float> sw_rank, sw_serve;     // [N]
+  std::vector<uint8_t> sw_fi, sw_ff;        // [N]
+
+  // candidate table: 2 classes x C2 rows (idle-class then future-class)
+  struct Row {
+    int32_t gidx;       // -1 = dead
+    float stat;         // static score column
+    float pack;         // pack column (pack_eff at refresh + hits)
+    float ntasks, maxt; // f32 like the XLA table
+    float idle[8], fut[8], alloc[8];       // [R] (R <= 8 enforced)
+    float score;        // cached serve score
+    uint8_t fi, ff;     // cached fits per class
+  };
+  std::vector<Row> rows;                  // [2*C2]
+  std::vector<float> s_idle, s_fut;       // [2*C2] masked serve scores
+  std::vector<int32_t> rowmap_i, rowmap_f;
+  std::vector<int32_t> rowmap_ep;         // [N]
+  int32_t rowmap_gen = 1;
+  int table_group = -1;
+  int verified_group = -1;                // last group memcmp'd == table's
+  int32_t table_bucket = -2;
+  int touched = 0;                        // gross serves since refresh
+  bool have_table = false;
+  bool serve_valid = false;
+  bool serve_sb = false;
+
+  // stats (VOLCANO_NATIVE_STATS=1)
+  bool stats = false;
+  int64_t t_refresh = 0, t_memcmp = 0, t_serve = 0, t_apply = 0;
+  int64_t n_refresh = 0, n_memcmp = 0, n_serve = 0, n_rollback = 0;
+  int64_t t_passa = 0, t_passb = 0, t_install = 0;
+  static inline int64_t now_ns() {
+    return std::chrono::duration_cast<std::chrono::nanoseconds>(
+        std::chrono::steady_clock::now().time_since_epoch()).count();
+  }
+
+  // undo log for the current gang (pre-placement values)
+  struct Undo {
+    int32_t node;
+    float idle[8], fut[8];
+    int32_t ntasks;
+    int32_t row_i, row_f;
+    Row ri, rf;          // full row copies (small)
+  };
+  std::vector<Undo> undo;
+
+  explicit Solver(const Args& args)
+      : a(args), N(args.N), R(args.R) {
+    stats = std::getenv("VOLCANO_NATIVE_STATS") != nullptr;
+    w.binpack_res = a.binpack_res;
+    w.binpack = a.w_binpack; w.least = a.w_least;
+    w.most = a.w_most; w.balanced = a.w_balanced;
+    idleT.resize((size_t)R * N);
+    futT.resize((size_t)R * N);
+    allocT.resize((size_t)R * N);
+    for (int n = 0; n < N; ++n)
+      for (int r = 0; r < R; ++r) {
+        idleT[(size_t)r * N + n] = a.node_idle[(size_t)n * R + r];
+        futT[(size_t)r * N + n] = a.node_future[(size_t)n * R + r];
+        allocT[(size_t)r * N + n] = a.node_alloc[(size_t)n * R + r];
+      }
+    ntasks.assign(a.node_ntasks, a.node_ntasks + N);
+    pack_val.assign(N, 0.0f);
+    pack_epoch.assign(N, 0);
+    q_alloc.assign(a.q_alloc0, a.q_alloc0 + (size_t)a.Q * R);
+    ns_alloc.assign(a.ns_alloc0, a.ns_alloc0 + (size_t)a.NS * R);
+    p_cursor.assign(a.P, 0);
+    ready.assign(a.J, 0);
+    kept.assign(a.J, 0);
+    sw_rank.assign(N, NEG);
+    sw_serve.assign(N, NEG);
+    sw_fi.assign(N, 0);
+    sw_ff.assign(N, 0);
+    int k = 2 * a.C2;
+    rows.assign(k, Row{});
+    for (auto& r : rows) r.gidx = -1;
+    s_idle.assign(k, NEG);
+    s_fut.assign(k, NEG);
+    rowmap_i.assign(N, -1);
+    rowmap_f.assign(N, -1);
+    rowmap_ep.assign(N, 0);
+  }
+
+  inline float pack_of(int n) const {
+    return pack_epoch[n] == epoch ? pack_val[n] : 0.0f;
+  }
+
+  // two-level (namespace, queue) selection (ops/allocate.make_pool_select)
+  void select(int32_t* out_pool, int32_t* out_job) {
+    float share[64]; uint8_t over[64];
+    std::vector<float> share_v; std::vector<uint8_t> over_v;
+    float* sh = share; uint8_t* ov = over;
+    if (a.Q > 64) {
+      share_v.resize(a.Q); over_v.resize(a.Q);
+      sh = share_v.data(); ov = over_v.data();
+    }
+    for (int q = 0; q < a.Q; ++q) {
+      sh[q] = queue_share_one(&q_alloc[(size_t)q * R],
+                              &a.q_deserved[(size_t)q * R], R);
+      ov[q] = queue_overused_one(&q_alloc[(size_t)q * R],
+                                 &a.q_deserved[(size_t)q * R], a.eps, R);
+    }
+    std::vector<uint8_t> ns_has(a.NS, 0);
+    for (int p = 0; p < a.P; ++p) {
+      bool ok = (p_cursor[p] < a.pool_njobs[p]) && !ov[a.pool_queue[p]];
+      if (ok) ns_has[a.pool_ns[p]] = 1;
+    }
+    int ns_sel = 0;
+    {
+      float best = BIG;
+      for (int ns = 0; ns < a.NS; ++ns) {
+        float key = a.ns_live
+            ? ns_share_one(&ns_alloc[(size_t)ns * R], a.ns_total,
+                           a.ns_weight[ns], R)
+            : (float)ns;
+        float v = ns_has[ns] ? key : BIG;
+        if (v < best) { best = v; ns_sel = ns; }
+      }
+    }
+    int psel = 0;
+    {
+      float best = BIG;
+      for (int p = 0; p < a.P; ++p) {
+        bool ok = (p_cursor[p] < a.pool_njobs[p]) && !ov[a.pool_queue[p]]
+                  && (a.pool_ns[p] == ns_sel);
+        float v = ok ? sh[a.pool_queue[p]] : BIG;
+        if (v < best) { best = v; psel = p; }
+      }
+    }
+    if (ns_has[ns_sel]) {
+      *out_pool = psel;
+      *out_job = a.pool_job_start[psel] + p_cursor[psel];
+    } else {
+      *out_pool = -1;
+      *out_job = -1;
+    }
+  }
+
+  // serve score + fits for one table row under (req, bonus, sb)
+  inline void row_score(Row& r, const float* req, float bonus, bool sb,
+                        int k) {
+    if (r.gidx < 0) { s_idle[k] = NEG; s_fut[k] = NEG; return; }
+    float static_eff = r.stat + (sb ? r.pack : 0.0f) * bonus;
+    float s = node_score_base(req, r.idle, r.alloc, w, R);
+    r.score = s + static_eff;
+    bool pods_ok = (r.maxt == 0.0f) || (r.ntasks < r.maxt);
+    r.fi = pods_ok && fits(req, r.idle, a.eps, R);
+    r.ff = a.allow_pipeline && pods_ok && fits(req, r.fut, a.eps, R);
+    s_idle[k] = r.fi ? r.score : NEG;
+    s_fut[k] = r.ff ? r.score : NEG;
+  }
+
+  // Full node sweep: rebuild the top-C2-per-class table for group g.
+  // Pass A is branchless over plane arrays (auto-vectorized); pass B
+  // feeds the per-class heaps.
+  void refresh(int g, int32_t b, const float* req, float bonus) {
+    int64_t _t0 = stats ? now_ns() : 0;
+    const uint8_t* mask = &a.group_mask[(size_t)g * N];
+    const float* stat = &a.group_static[(size_t)g * N];
+    bool chain = (b >= 0) && (b == cur_bucket);
+    const float* eps = a.eps;
+
+    // ---- pass A: fits + scores for every node, branchless
+    float* rank = sw_rank.data();
+    float* serve = sw_serve.data();
+    uint8_t* fi = sw_fi.data();
+    uint8_t* ff = sw_ff.data();
+    for (int n = 0; n < N; ++n) {
+      uint8_t ok = mask[n] &&
+          ((a.node_max[n] == 0) | (ntasks[n] < a.node_max[n]));
+      fi[n] = ok; ff[n] = ok;
+    }
+    for (int r = 0; r < R; ++r) {
+      const float* ip = &idleT[(size_t)r * N];
+      const float* fp = &futT[(size_t)r * N];
+      float rq = req[r], ep = eps[r];
+      for (int n = 0; n < N; ++n) {
+        fi[n] &= (uint8_t)(rq <= ip[n] + ep);
+        ff[n] &= (uint8_t)(rq <= fp[n] + ep);
+      }
+    }
+    // score terms, accumulated per plane in node_score_base's exact order:
+    // bp = acc/wsum; s = w_bp*bp; s += w_l*least; ... (see above)
+    {
+      float wsum = 0.0f;
+      for (int r = 0; r < R; ++r) {
+        bool requested = (req[r] > 0.0f) && (w.binpack_res[r] > 0.0f);
+        wsum += requested ? w.binpack_res[r] : 0.0f;
+      }
+      wsum = std::max(wsum, 1e-9f);
+      std::vector<float>& accv = sw_acc; accv.assign(N, 0.0f);
+      float* acc = accv.data();
+      for (int r = 0; r < R; ++r) {
+        const float* ip = &idleT[(size_t)r * N];
+        const float* ap = &allocT[(size_t)r * N];
+        bool requested = (req[r] > 0.0f) && (w.binpack_res[r] > 0.0f);
+        float wr = requested ? w.binpack_res[r] : 0.0f;
+        float rq = req[r];
+        for (int n = 0; n < N; ++n) {
+          float al = ap[n];
+          float used = al - ip[n];
+          float denom = std::max(al, 1e-9f);
+          float frac = (al > 0.0f) ? (used + rq) / denom : 2.0f;
+          float per = (frac <= 1.0f) ? frac * 100.0f : 0.0f;
+          acc[n] += per * wr;
+        }
+      }
+      // least/most/balanced over dims 0..1
+      std::vector<float>& f0v = sw_f0; f0v.resize(3 * (size_t)N);
+      float* fl0 = f0v.data();         // reuse one buffer: fl,fm,fb r=0
+      float* fm0 = fl0 + N;
+      float* fb0 = fm0 + N;
+      std::vector<float>& f1v = sw_f1; f1v.resize(3 * (size_t)N);
+      float* fl1 = f1v.data();
+      float* fm1 = fl1 + N;
+      float* fb1 = fm1 + N;
+      for (int r = 0; r < 2; ++r) {
+        const float* ip = &idleT[(size_t)r * N];
+        const float* ap = &allocT[(size_t)r * N];
+        float rq = req[r];
+        float* fl = r == 0 ? fl0 : fl1;
+        float* fm = r == 0 ? fm0 : fm1;
+        float* fb = r == 0 ? fb0 : fb1;
+        for (int n = 0; n < N; ++n) {
+          float al = ap[n];
+          float u = (al - ip[n]) + rq;
+          float denom = std::max(al, 1e-9f);
+          bool pos = al > 0.0f;
+          fl[n] = pos ? std::max(al - u, 0.0f) / denom : 0.0f;
+          fm[n] = pos ? std::min(std::max(u, 0.0f), al) / denom : 0.0f;
+          fb[n] = pos ? u / denom : 0.0f;
+        }
+      }
+      float wb = w.binpack, wl = w.least, wm = w.most, wba = w.balanced;
+      for (int n = 0; n < N; ++n) {
+        float bp = acc[n] / wsum;
+        float least = (fl0[n] * 100.0f + fl1[n] * 100.0f) / 2.0f;
+        float most = (fm0[n] * 100.0f + fm1[n] * 100.0f) / 2.0f;
+        float balanced = 100.0f - std::fabs(fb0[n] - fb1[n]) * 100.0f;
+        float s = wb * bp;
+        s = s + wl * least;
+        s = s + wm * most;
+        s = s + wba * balanced;
+        // rank = (s + static) + pack_eff*bonus   (XLA refresh order)
+        // serve = s + (static + pack_eff*bonus)  (XLA serve/scan order)
+        float pe = chain && pack_epoch[n] == epoch ? pack_val[n] : 0.0f;
+        rank[n] = (s + stat[n]) + pe * bonus;
+        serve[n] = s + (stat[n] + pe * bonus);
+      }
+    }
+
+    if (stats) { int64_t t = now_ns(); t_passa += t - _t0; _t0 = t; }
+    // ---- pass B: per-class top-C2 heaps keyed (score asc, idx desc)
+    int C2 = a.C2;
+    struct HC { float s; int32_t n; };
+    auto worse = [](const HC& x, const HC& y) {
+      if (x.s != y.s) return x.s < y.s;
+      return x.n > y.n;
+    };
+    auto heap_cmp = [&](const HC& x, const HC& y) { return !worse(x, y); };
+    std::vector<HC> hi, hf;
+    hi.reserve(C2 + 1); hf.reserve(C2 + 1);
+    for (int n = 0; n < N; ++n) {
+      if (!(fi[n] | (a.allow_pipeline ? ff[n] : 0))) continue;
+      float sb_score = rank[n];
+      if (sb_score <= NEG * 0.5f) continue;   // lax.top_k dead-row cutoff
+      HC c{sb_score, n};
+      if (fi[n]) {
+        if ((int)hi.size() < C2) {
+          hi.push_back(c); std::push_heap(hi.begin(), hi.end(), heap_cmp);
+        } else if (worse(hi.front(), c)) {
+          std::pop_heap(hi.begin(), hi.end(), heap_cmp);
+          hi.back() = c; std::push_heap(hi.begin(), hi.end(), heap_cmp);
+        }
+      }
+      if (a.allow_pipeline && ff[n]) {
+        if ((int)hf.size() < C2) {
+          hf.push_back(c); std::push_heap(hf.begin(), hf.end(), heap_cmp);
+        } else if (worse(hf.front(), c)) {
+          std::pop_heap(hf.begin(), hf.end(), heap_cmp);
+          hf.back() = c; std::push_heap(hf.begin(), hf.end(), heap_cmp);
+        }
+      }
+    }
+    if (stats) { int64_t t = now_ns(); t_passb += t - _t0; _t0 = t; }
+    // ---- install rows + serve caches (values straight from pass A)
+    rowmap_gen++;
+    auto install = [&](std::vector<HC>& h, int base, bool is_idle_class) {
+      int cnt = (int)h.size();
+      for (int i = 0; i < C2; ++i) {
+        int k = base + i;
+        Row& r = rows[k];
+        if (i < cnt) {
+          int n = h[i].n;
+          r.gidx = n;
+          r.stat = stat[n];
+          r.pack = chain && pack_epoch[n] == epoch ? pack_val[n] : 0.0f;
+          r.ntasks = (float)ntasks[n];
+          r.maxt = (float)a.node_max[n];
+          for (int rr = 0; rr < R; ++rr) {
+            r.idle[rr] = idleT[(size_t)rr * N + n];
+            r.fut[rr] = futT[(size_t)rr * N + n];
+            r.alloc[rr] = allocT[(size_t)rr * N + n];
+          }
+          r.score = serve[n];
+          r.fi = fi[n];
+          r.ff = a.allow_pipeline ? ff[n] : 0;
+          s_idle[k] = r.fi ? r.score : NEG;
+          s_fut[k] = r.ff ? r.score : NEG;
+          if (rowmap_ep[n] != rowmap_gen) {
+            rowmap_ep[n] = rowmap_gen;
+            rowmap_i[n] = -1; rowmap_f[n] = -1;
+          }
+          if (is_idle_class) rowmap_i[n] = k;
+          else rowmap_f[n] = k;
+        } else {
+          r.gidx = -1;
+          s_idle[k] = NEG;
+          s_fut[k] = NEG;
+        }
+      }
+    };
+    install(hi, 0, true);
+    install(hf, a.C2, false);
+    table_group = g;
+    verified_group = g;
+    table_bucket = b;
+    touched = 0;
+    have_table = true;
+    serve_valid = true;
+    serve_sb = chain;
+    if (stats) t_install += now_ns() - _t0;
+  }
+
+  std::vector<float> sw_acc, sw_f0, sw_f1;   // refresh scratch
+
+  inline bool same_content(int g1, int g2) const {
+    if (g1 == g2) return true;
+    if (g1 < 0 || g2 < 0) return false;
+    if (a.pack_bonus[g1] != a.pack_bonus[g2]) return false;
+    if (std::memcmp(&a.group_req[(size_t)g1 * R],
+                    &a.group_req[(size_t)g2 * R], R * sizeof(float)))
+      return false;
+    if (std::memcmp(&a.group_mask[(size_t)g1 * N],
+                    &a.group_mask[(size_t)g2 * N], N)) return false;
+    if (std::memcmp(&a.group_static[(size_t)g1 * N],
+                    &a.group_static[(size_t)g2 * N],
+                    (size_t)N * sizeof(float))) return false;
+    return true;
+  }
+
+  void run() {
+    int32_t cur_pool, cur_job;
+    select(&cur_pool, &cur_job);
+    int32_t t_off = 0, placed = 0, placed_alloc = 0;
+    std::vector<float> placed_res(R, 0.0f);
+    for (int32_t step = 0; step < a.T && cur_job >= 0; ++step) {
+      int job = cur_job;
+      int32_t t_idx = a.job_start[job] + t_off;
+      if (t_idx > a.T - 1) t_idx = a.T - 1;
+      if (t_idx < 0) t_idx = 0;
+      int g = a.task_group[t_idx];
+      int32_t b = a.task_bucket[t_idx];
+      bool valid = a.task_valid[t_idx] && (t_off < a.job_ntasks[job]);
+      const float* req = &a.group_req[(size_t)g * R];
+      float bonus = a.pack_bonus[g];
+      bool sb = (b >= 0) && (b == cur_bucket);
+
+      bool placed_ok = false, pipelined = false;
+      int32_t sel = -1;
+      if (valid) {
+        // table validity: touch budget + bucket-chain + group CONTENT
+        // (memcmp once per group transition, cached in verified_group)
+        bool content_ok = have_table &&
+            (g == table_group || g == verified_group);
+        if (have_table && !content_ok) {
+          int64_t t0 = stats ? now_ns() : 0;
+          if (same_content(g, table_group)) {
+            verified_group = g;
+            content_ok = true;
+          }
+          if (stats) { t_memcmp += now_ns() - t0; n_memcmp++; }
+        }
+        bool need = !have_table || touched >= a.C2 ||
+                    b != table_bucket || !content_ok;
+        if (need) {
+          int64_t t0 = stats ? now_ns() : 0;
+          refresh(g, b, req, bonus);
+          if (stats) { t_refresh += now_ns() - t0; n_refresh++; }
+        } else if (!serve_valid || serve_sb != sb) {
+          // serve-cache rebuild over table rows only; exact because the
+          // serving group's content equals the table group's (verified)
+          for (int k = 0; k < 2 * a.C2; ++k)
+            row_score(rows[k], req, bonus, sb, k);
+          serve_valid = true;
+          serve_sb = sb;
+        }
+        // argmax: idle fits first, ties by lowest node index
+        int64_t ts0 = stats ? now_ns() : 0;
+        int K = 2 * a.C2;
+        float best = NEG;
+        for (int k = 0; k < K; ++k) best = std::max(best, s_idle[k]);
+        bool any_idle = best > NEG * 0.5f;
+        const std::vector<float>& sc = any_idle ? s_idle : s_fut;
+        if (!any_idle) {
+          best = NEG;
+          for (int k = 0; k < K; ++k) best = std::max(best, sc[k]);
+        }
+        if (stats) { t_serve += now_ns() - ts0; n_serve++; }
+        if (best > NEG * 0.5f) {
+          int32_t min_idx = INT32_MAX;
+          for (int k = 0; k < K; ++k)
+            if (sc[k] >= best && rows[k].gidx >= 0 &&
+                rows[k].gidx < min_idx)
+              min_idx = rows[k].gidx;
+          sel = min_idx;
+          placed_ok = true;
+          pipelined = a.allow_pipeline && !any_idle;
+        }
+      }
+
+      if (placed_ok) {
+        int64_t ta0 = stats ? now_ns() : 0;
+        bool take_idle = !pipelined;
+        Undo u;
+        u.node = sel;
+        for (int r = 0; r < R; ++r) {
+          u.idle[r] = idleT[(size_t)r * N + sel];
+          u.fut[r] = futT[(size_t)r * N + sel];
+        }
+        u.ntasks = ntasks[sel];
+        bool mapped = rowmap_ep[sel] == rowmap_gen;
+        u.row_i = mapped ? rowmap_i[sel] : -1;
+        u.row_f = mapped ? rowmap_f[sel] : -1;
+        if (u.row_i >= 0) u.ri = rows[u.row_i];
+        if (u.row_f >= 0) u.rf = rows[u.row_f];
+        undo.push_back(u);
+        // state apply (same arithmetic as the scan's .add(-req))
+        for (int r = 0; r < R; ++r) {
+          if (take_idle) idleT[(size_t)r * N + sel] += -req[r];
+          futT[(size_t)r * N + sel] += -req[r];
+        }
+        ntasks[sel] += 1;
+        // pack chain: pack_nodes = where(sb, pack_nodes, 0), then +1 at
+        // sel (scan semantics — resets the whole array when the chain
+        // breaks; epoch tags make the reset O(1))
+        if (!sb) epoch++;
+        if (pack_epoch[sel] != epoch) {
+          pack_epoch[sel] = epoch; pack_val[sel] = 0.0f;
+        }
+        pack_val[sel] += 1.0f;
+        // table rows of sel: same updates + pack column + score recompute
+        for (int which = 0; which < 2; ++which) {
+          int k = which == 0 ? u.row_i : u.row_f;
+          if (k < 0) continue;
+          Row& r = rows[k];
+          for (int rr = 0; rr < R; ++rr) {
+            if (take_idle) r.idle[rr] += -req[rr];
+            r.fut[rr] += -req[rr];
+          }
+          r.ntasks += 1.0f;
+          r.pack += 1.0f;
+          row_score(r, req, bonus, sb, k);
+        }
+        touched++;
+        placed += 1;
+        if (take_idle) placed_alloc += 1;
+        for (int r = 0; r < R; ++r) placed_res[r] += req[r];
+        a.assign[t_idx] = sel;
+        a.out_pipelined[t_idx] = pipelined ? 1 : 0;
+        if (stats) t_apply += now_ns() - ta0;
+      } else if (!sb && valid) {
+        // the scan resets pack_nodes every step the chain breaks, even
+        // when nothing is placed (pack = where(sb, pack_nodes, 0))
+        epoch++;
+      }
+      if (valid) cur_bucket = b;
+
+      t_off += 1;
+
+      // ---- job boundary (gang commit/rollback + charges + select)
+      if (t_off >= a.job_ntasks[job]) {
+        int32_t base = a.job_base[job];
+        int32_t minav = a.job_min[job];
+        bool is_ready = base + placed_alloc >= minav;
+        bool is_kept = base + placed >= minav;
+        bool keep = is_ready || is_kept;
+        if (!keep) {
+          // rollback: restore pre-placement values (exact — the XLA
+          // kernel restores a checkpoint copy). pack chain state is NOT
+          // restored (scan semantics: pack_nodes is never checkpointed),
+          // and neither are the rows' pack columns — only their
+          // state-dependent fields; the serve caches rebuild lazily.
+          n_rollback++;
+          for (auto it = undo.rbegin(); it != undo.rend(); ++it) {
+            for (int r = 0; r < R; ++r) {
+              idleT[(size_t)r * N + it->node] = it->idle[r];
+              futT[(size_t)r * N + it->node] = it->fut[r];
+            }
+            ntasks[it->node] = it->ntasks;
+            if (it->row_i >= 0) {
+              float pk = rows[it->row_i].pack;   // pack survives rollback
+              rows[it->row_i] = it->ri;
+              rows[it->row_i].pack = pk;
+            }
+            if (it->row_f >= 0) {
+              float pk = rows[it->row_f].pack;
+              rows[it->row_f] = it->rf;
+              rows[it->row_f].pack = pk;
+            }
+          }
+          serve_valid = false;
+        }
+        if (keep) {
+          int p = cur_pool < 0 ? 0 : cur_pool;
+          int q = a.pool_queue[p];
+          int ns = a.pool_ns[p];
+          for (int r = 0; r < R; ++r) {
+            q_alloc[(size_t)q * R + r] += placed_res[r];
+            ns_alloc[(size_t)ns * R + r] += placed_res[r];
+          }
+        }
+        if (cur_pool >= 0) p_cursor[cur_pool] += 1;
+        if (is_ready) ready[job] = 1;
+        if (is_kept) kept[job] = 1;
+        undo.clear();
+        t_off = 0; placed = 0; placed_alloc = 0;
+        std::fill(placed_res.begin(), placed_res.end(), 0.0f);
+        select(&cur_pool, &cur_job);
+      }
+    }
+
+    // post-filter: placements of non-kept jobs are cleared
+    for (int32_t t = 0; t < a.T; ++t) {
+      int j = a.task_job[t];
+      bool ok = a.task_valid[t] && j >= 0 && j < a.J &&
+                (ready[j] || kept[j]);
+      if (!ok) { a.assign[t] = -1; a.out_pipelined[t] = 0; }
+    }
+    std::memcpy(a.out_ready, ready.data(), a.J);
+    std::memcpy(a.out_kept, kept.data(), a.J);
+    for (int n = 0; n < N; ++n)
+      for (int r = 0; r < R; ++r)
+        a.out_idle[(size_t)n * R + r] = idleT[(size_t)r * N + n];
+    if (stats)
+      std::fprintf(stderr,
+                   "[native] refresh %lldms x%lld (A %lld B %lld inst "
+                   "%lld) | memcmp %lldms x%lld | "
+                   "serve %lldms x%lld | apply %lldms | rollback x%lld\n",
+                   (long long)(t_refresh / 1000000), (long long)n_refresh,
+                   (long long)(t_passa / 1000000),
+                   (long long)(t_passb / 1000000),
+                   (long long)(t_install / 1000000),
+                   (long long)(t_memcmp / 1000000), (long long)n_memcmp,
+                   (long long)(t_serve / 1000000), (long long)n_serve,
+                   (long long)(t_apply / 1000000), (long long)n_rollback);
+  }
+};
+
+}  // namespace
+
+extern "C" int vc_gang_allocate(const Args* args) {
+  if (!args || args->T < 0 || args->N <= 0 || args->R <= 0 ||
+      args->R > 8 || args->C2 <= 0)
+    return 1;
+  for (int32_t t = 0; t < args->T; ++t) {
+    args->assign[t] = -1;
+    args->out_pipelined[t] = 0;
+  }
+  std::memset(args->out_ready, 0, args->J);
+  std::memset(args->out_kept, 0, args->J);
+  Solver s(*args);
+  s.run();
+  return 0;
+}
+
+extern "C" int vc_abi_version() { return 1; }

@@ -1,0 +1,197 @@
+"""ctypes binding for the native C++ gang-allocate solver.
+
+``gang_allocate_native`` is a drop-in for ops.allocate.gang_allocate (same
+positional signature, numpy/jax array inputs, numpy outputs) whose
+decisions are bit-exact vs the scan kernel (tests/test_native_kernel.py).
+It is the off-TPU production kernel at scale: XLA-on-CPU pays per-step
+scan dispatch plus a full [N,R] checkpoint copy per gang boundary, while
+the native solver runs the same decision procedure with an undo log and a
+content-keyed candidate table (volcano_tpu/native/solver.cc).
+
+Availability is soft: if the toolchain is missing the import of this
+module still succeeds and ``available()`` returns False — the solver then
+keeps using the XLA kernels.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+from typing import Optional
+
+import numpy as np
+
+_log = logging.getLogger(__name__)
+_lib = None
+_lib_err: Optional[str] = None
+
+# table size per fit class: >= the XLA chunk so the exactness budget is
+# looser, large enough that a 50k-serve burst refreshes ~T/C2 times
+_C2 = int(os.environ.get("VOLCANO_NATIVE_C2", "256"))
+
+
+class _Args(ctypes.Structure):
+    _fields_ = [
+        ("T", ctypes.c_int32), ("G", ctypes.c_int32),
+        ("J", ctypes.c_int32), ("Q", ctypes.c_int32),
+        ("P", ctypes.c_int32), ("NS", ctypes.c_int32),
+        ("N", ctypes.c_int32), ("R", ctypes.c_int32),
+        ("C2", ctypes.c_int32),
+        ("task_group", ctypes.c_void_p), ("task_job", ctypes.c_void_p),
+        ("task_valid", ctypes.c_void_p),
+        ("group_req", ctypes.c_void_p), ("group_mask", ctypes.c_void_p),
+        ("group_static", ctypes.c_void_p),
+        ("task_bucket", ctypes.c_void_p), ("pack_bonus", ctypes.c_void_p),
+        ("job_min", ctypes.c_void_p), ("job_base", ctypes.c_void_p),
+        ("job_start", ctypes.c_void_p), ("job_ntasks", ctypes.c_void_p),
+        ("pool_queue", ctypes.c_void_p), ("pool_ns", ctypes.c_void_p),
+        ("pool_job_start", ctypes.c_void_p),
+        ("pool_njobs", ctypes.c_void_p),
+        ("ns_weight", ctypes.c_void_p), ("ns_alloc0", ctypes.c_void_p),
+        ("ns_total", ctypes.c_void_p),
+        ("q_deserved", ctypes.c_void_p), ("q_alloc0", ctypes.c_void_p),
+        ("node_idle", ctypes.c_void_p), ("node_future", ctypes.c_void_p),
+        ("node_alloc", ctypes.c_void_p), ("node_ntasks", ctypes.c_void_p),
+        ("node_max", ctypes.c_void_p), ("eps", ctypes.c_void_p),
+        ("binpack_res", ctypes.c_void_p),
+        ("w_binpack", ctypes.c_float), ("w_least", ctypes.c_float),
+        ("w_most", ctypes.c_float), ("w_balanced", ctypes.c_float),
+        ("allow_pipeline", ctypes.c_int32), ("ns_live", ctypes.c_int32),
+        ("assign", ctypes.c_void_p), ("out_pipelined", ctypes.c_void_p),
+        ("out_ready", ctypes.c_void_p), ("out_kept", ctypes.c_void_p),
+        ("out_idle", ctypes.c_void_p),
+    ]
+
+
+def _load():
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    try:
+        from ..native.build import ensure_built
+        path = ensure_built()
+        lib = ctypes.CDLL(path)
+        lib.vc_gang_allocate.restype = ctypes.c_int
+        lib.vc_gang_allocate.argtypes = [ctypes.POINTER(_Args)]
+        if lib.vc_abi_version() != 1:
+            raise RuntimeError("native solver ABI mismatch")
+        _lib = lib
+    except Exception as e:   # missing toolchain, build failure
+        _lib_err = str(e)
+        _log.warning("native solver unavailable: %s", e)
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _c(a, dtype):
+    arr = np.asarray(a)
+    if dtype == np.uint8 and arr.dtype == np.bool_:
+        arr = np.ascontiguousarray(arr)
+        return arr.view(np.uint8)   # zero-copy: bool is 1 byte
+    return np.ascontiguousarray(arr, dtype=dtype)
+
+
+def _ptr(a):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def gang_allocate_native(task_group, task_job, task_valid, group_req,
+                         group_mask, group_static_score, task_bucket,
+                         group_pack_bonus, job_min_available,
+                         job_ready_base, job_task_start, job_n_tasks,
+                         job_queue, pool_queue, pool_ns, pool_job_start,
+                         pool_njobs, ns_weight, ns_alloc0, ns_total,
+                         queue_deserved, queue_alloc0, node_idle,
+                         node_future, node_alloc, node_ntasks,
+                         node_max_tasks, eps, weights,
+                         allow_pipeline: bool = True,
+                         ns_live: bool = False):
+    """Same signature/returns as ops.allocate.gang_allocate; numpy outputs.
+
+    ``job_n_tasks`` may be the TaskBatch property (end-start); ``job_queue``
+    is accepted for signature parity but unused (pool tables carry it).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native solver unavailable: {_lib_err}")
+
+    task_group = _c(task_group, np.int32)
+    task_job = _c(task_job, np.int32)
+    task_valid = _c(task_valid, np.uint8)
+    group_req = _c(group_req, np.float32)
+    group_mask = _c(group_mask, np.uint8)
+    group_static = _c(group_static_score, np.float32)
+    task_bucket = _c(task_bucket, np.int32)
+    pack_bonus = _c(group_pack_bonus, np.float32)
+    job_min = _c(job_min_available, np.int32)
+    job_base = _c(job_ready_base, np.int32)
+    job_start = _c(job_task_start, np.int32)
+    job_ntasks = _c(job_n_tasks, np.int32)
+    pool_queue = _c(pool_queue, np.int32)
+    pool_ns = _c(pool_ns, np.int32)
+    pool_job_start = _c(pool_job_start, np.int32)
+    pool_njobs = _c(pool_njobs, np.int32)
+    ns_weight = _c(ns_weight, np.float32)
+    ns_alloc0 = _c(ns_alloc0, np.float32)
+    ns_total = _c(ns_total, np.float32)
+    q_deserved = _c(queue_deserved, np.float32)
+    q_alloc0 = _c(queue_alloc0, np.float32)
+    node_idle = _c(node_idle, np.float32)
+    node_future = _c(node_future, np.float32)
+    node_alloc = _c(node_alloc, np.float32)
+    node_ntasks = _c(node_ntasks, np.int32)
+    node_max = _c(node_max_tasks, np.int32)
+    eps = _c(eps, np.float32)
+    binpack_res = _c(weights.binpack_res, np.float32)
+
+    T = task_group.shape[0]
+    G, R = group_req.shape
+    J = job_min.shape[0]
+    Q = q_deserved.shape[0]
+    P = pool_queue.shape[0]
+    NS = ns_weight.shape[0]
+    N = node_idle.shape[0]
+    assert group_mask.shape == (G, N), (group_mask.shape, (G, N))
+    assert group_static.shape == (G, N)
+
+    assign = np.full(T, -1, np.int32)
+    pipelined = np.zeros(T, np.uint8)
+    ready = np.zeros(J, np.uint8)
+    kept = np.zeros(J, np.uint8)
+    out_idle = np.zeros((N, R), np.float32)
+
+    args = _Args(
+        T=T, G=G, J=J, Q=Q, P=P, NS=NS, N=N, R=R,
+        C2=max(8, min(_C2, N)),
+        task_group=_ptr(task_group), task_job=_ptr(task_job),
+        task_valid=_ptr(task_valid),
+        group_req=_ptr(group_req), group_mask=_ptr(group_mask),
+        group_static=_ptr(group_static),
+        task_bucket=_ptr(task_bucket), pack_bonus=_ptr(pack_bonus),
+        job_min=_ptr(job_min), job_base=_ptr(job_base),
+        job_start=_ptr(job_start), job_ntasks=_ptr(job_ntasks),
+        pool_queue=_ptr(pool_queue), pool_ns=_ptr(pool_ns),
+        pool_job_start=_ptr(pool_job_start), pool_njobs=_ptr(pool_njobs),
+        ns_weight=_ptr(ns_weight), ns_alloc0=_ptr(ns_alloc0),
+        ns_total=_ptr(ns_total),
+        q_deserved=_ptr(q_deserved), q_alloc0=_ptr(q_alloc0),
+        node_idle=_ptr(node_idle), node_future=_ptr(node_future),
+        node_alloc=_ptr(node_alloc), node_ntasks=_ptr(node_ntasks),
+        node_max=_ptr(node_max), eps=_ptr(eps),
+        binpack_res=_ptr(binpack_res),
+        w_binpack=float(weights.binpack), w_least=float(weights.least),
+        w_most=float(weights.most), w_balanced=float(weights.balanced),
+        allow_pipeline=1 if allow_pipeline else 0,
+        ns_live=1 if ns_live else 0,
+        assign=_ptr(assign), out_pipelined=_ptr(pipelined),
+        out_ready=_ptr(ready), out_kept=_ptr(kept),
+        out_idle=_ptr(out_idle))
+    rc = lib.vc_gang_allocate(ctypes.byref(args))
+    if rc != 0:
+        raise RuntimeError(f"native solver failed rc={rc}")
+    return (assign, pipelined.astype(bool), ready.astype(bool),
+            kept.astype(bool), out_idle)
