@@ -188,9 +188,8 @@ def test_chunked_matches_scan_multi_namespace(seed):
 
 @pytest.mark.parametrize("seed", [0, 1])
 def test_pallas_single_namespace_pool_wrapper_matches_scan(seed):
-    """The Pallas wrapper reconstructs queue-selection arrays from the
-    degenerate single-namespace pools (pallas_allocate.py); its placements
-    must match the scan, and multi-namespace batches must be refused."""
+    """Single-namespace pools degenerate to queue-only selection; the
+    Pallas kernel's placements must match the scan exactly."""
     from volcano_tpu.ops.pallas_allocate import gang_allocate_pallas
 
     rng = np.random.default_rng(seed + 300)
@@ -210,6 +209,24 @@ def test_pallas_single_namespace_pool_wrapper_matches_scan(seed):
     np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
     np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
 
-    multi = _scenario(seed)[0]
-    with pytest.raises(ValueError, match="single-namespace"):
-        gang_allocate_pallas(*multi.args, weights, interpret=True)
+
+@pytest.mark.parametrize("seed", range(3))
+@pytest.mark.parametrize("ns_live", [False, True])
+def test_pallas_matches_scan_multi_namespace(seed, ns_live):
+    """The Pallas kernel carries the namespace-primary pool selection
+    in-kernel (pool/namespace one-hot matmuls + live weighted-share
+    re-selection at every job boundary); decisions must match the scan
+    exactly for multi-namespace batches in both namespace orders
+    (reference semantics: allocate.go:120-162)."""
+    from volcano_tpu.ops.pallas_allocate import gang_allocate_pallas
+
+    sa, weights, rng = _scenario(seed + 70)
+    args = [jnp.asarray(a) for a in sa.args] + [weights]
+    a1, p1, r1, k1, _ = gang_allocate(*args, ns_live=ns_live)
+    a2, p2, r2, k2, _ = gang_allocate_pallas(*args, ns_live=ns_live,
+                                             interpret=True)
+    ctx = f"seed={seed} ns_live={ns_live}"
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2), ctx)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2), ctx)
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2), ctx)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2), ctx)

@@ -95,6 +95,29 @@ def test_native_small_c2_budget():
         nat._C2 = old
 
 
+@pytest.mark.parametrize("seed", range(10))
+def test_native_near_tie_stress(seed):
+    """Adversarial near-tie shapes (large gangs over few tight nodes with
+    the balanced term active): many nodes score within 1-2 ulp, so any
+    float-op-order mismatch vs XLA:CPU flips argmax tie-breaks. This pins
+    the -ffp-contract=fast build matching XLA's FMA contraction
+    (native/build.py); a future XLA emission change fails here first."""
+    rng = np.random.default_rng(seed)
+    sa = synth_arrays(int(rng.integers(100, 400)),
+                      int(rng.integers(12, 40)),
+                      gang_size=int(rng.integers(12, 25)), seed=seed * 13,
+                      utilization=float(rng.uniform(0.1, 0.6)))
+    sa = _mutate(sa, rng)
+    sa.node_idle *= rng.uniform(0.15, 0.5)
+    sa.node_future[:] = np.maximum(sa.node_future, sa.node_idle)
+    weights = ScoreWeights.make(
+        sa.group_req.shape[1],
+        binpack=float(rng.uniform(0, 2)), least=float(rng.uniform(0, 2)),
+        most=float(rng.uniform(0, 1)), balanced=float(rng.uniform(0, 2)))
+    _run_pair(sa, weights, bool(rng.integers(0, 2)),
+              ctx=f"near-tie seed={seed}")
+
+
 def test_native_rollback_heavy():
     """Tight capacity: most gangs roll back; undo-log restoration must be
     exact (the XLA kernel restores a checkpoint copy)."""

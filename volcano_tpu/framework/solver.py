@@ -436,26 +436,22 @@ class BatchSolver:
         resource axis fits its sublane budget; off-TPU `auto` prefers the
         native C++ solver (ops/native.py, bit-exact vs the scan) and falls
         back to the chunked-candidate XLA scan; `chunked`/`scan`/`native`
-        force a specific kernel. Multi-namespace batches never route to
-        Pallas — the namespace-primary selection lives in the other
-        kernels."""
+        force a specific kernel. All kernels carry the namespace-primary
+        pool selection (multi-namespace batches included)."""
         from ..ops.allocate import gang_allocate_chunked
         from ..ops.pallas_allocate import R_PAD, gang_allocate_pallas
         if self.kernel == "pallas":
             import jax
-            if self.rindex.r > R_PAD or n_namespaces > 1:
-                why = ("resource dims exceed R_PAD" if self.rindex.r > R_PAD
-                       else "the batch spans multiple namespaces")
-                _log_once(f"solver kernel=pallas but {why}; "
-                          "falling back to the chunked scan")
+            if self.rindex.r > R_PAD:
+                _log_once("solver kernel=pallas but resource dims exceed "
+                          "R_PAD; falling back to the chunked scan")
                 return gang_allocate_chunked, {}
             interpret = jax.default_backend() != "tpu"
             return gang_allocate_pallas, {"interpret": interpret}
         if self.kernel in ("auto", "native"):
             import jax
             on_tpu = jax.default_backend() == "tpu"
-            if self.kernel == "auto" and on_tpu \
-                    and self.rindex.r <= R_PAD and n_namespaces <= 1:
+            if self.kernel == "auto" and on_tpu and self.rindex.r <= R_PAD:
                 return gang_allocate_pallas, {}
             # native is the off-TPU path only: on a TPU backend `auto`
             # stays on the XLA kernels when the Pallas gate fails (running

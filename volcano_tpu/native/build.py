@@ -5,9 +5,14 @@ path is native Go; ours is a C++ kernel for off-TPU deployments plus the
 Pallas kernel on TPU). The library is compiled once per source change with
 the toolchain baked into the image (g++); no network, no pip.
 
-Float parity with XLA:CPU requires IEEE semantics: no -ffast-math and
--ffp-contract=off (FMA contraction would change last-ulp results and with
-them argmax tie-breaks, breaking the bit-exact parity the fuzz tests pin).
+Float parity with XLA:CPU requires IEEE value semantics (no -ffast-math —
+no reassociation) AND matching XLA's FMA behavior: XLA:CPU's LLVM backend
+CONTRACTS mul+add chains in the score formula, so the build uses
+-ffp-contract=fast — gcc fuses the same canonical a*b+c shapes and the
+results match bitwise (with contraction off, near-tie scores differed by
+1-2 ulp and flipped argmax tie-breaks). The adversarial near-tie fuzz in
+tests/test_native_kernel.py pins this; if a future XLA changes emission,
+that fuzz fails and the solver conf falls back to `kernel: chunked`.
 """
 
 from __future__ import annotations
@@ -68,11 +73,11 @@ def ensure_built() -> str:
         if not os.path.exists(path):
             tmp = path + f".tmp{os.getpid()}"
             # -march=native vectorizes the sweep (AVX2/AVX-512 where the
-            # host has it) — still bit-exact: elementwise IEEE float ops
-            # are identical per lane, and -ffp-contract=off forbids FMA
-            # -fno-trapping-math lets the compiler speculate the masked
-            # divisions (if-conversion), enabling vectorization; computed
-            # VALUES stay IEEE-exact — only unobserved FP flags differ
+            # host has it) — elementwise IEEE float ops are identical per
+            # lane; -ffp-contract=fast matches XLA:CPU's FMA contraction
+            # (see module docstring); -fno-trapping-math lets the compiler
+            # speculate the masked divisions (if-conversion), enabling
+            # vectorization — computed VALUES stay IEEE-exact
             cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
                    "-fno-fast-math", "-ffp-contract=off", "-march=native",
                    "-fno-trapping-math", "-fno-math-errno",

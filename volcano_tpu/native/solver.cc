@@ -28,9 +28,12 @@
 // the (req row, mask row, static row, pack bonus) CONTENT to be equal,
 // which is verified by memcmp, and a bucket change forces a refresh
 // exactly like the XLA kernel's `b != prev_b` condition.  Float32
-// arithmetic follows ops/score.py's operation order; the build forbids
-// FMA contraction (-ffp-contract=off) so results match XLA:CPU bitwise.
-// Parity is pinned by tests/test_native_kernel.py fuzz vs the scan.
+// arithmetic follows ops/score.py's operation order, and the build uses
+// -ffp-contract=fast to match XLA:CPU's FMA contraction of the score
+// formula's mul+add chains (see native/build.py — with contraction OFF,
+// near-tie scores differed by 1-2 ulp and flipped argmax tie-breaks).
+// Parity is pinned by tests/test_native_kernel.py fuzz vs the scan,
+// including adversarial near-tie stress shapes.
 //
 // Reference semantics: pkg/scheduler/actions/allocate/allocate.go:120-270
 // (namespace/queue priority queues, per-task predicate+score+argmax,
@@ -92,10 +95,14 @@ static inline float node_score_base(const float* req, const float* idle,
   float least = (fl[0] * 100.0f + fl[1] * 100.0f) / 2.0f;
   float most = (fm[0] * 100.0f + fm[1] * 100.0f) / 2.0f;
   float balanced = 100.0f - std::fabs(fb[0] - fb[1]) * 100.0f;
+  // the weighted accumulation is the ONE chain XLA:CPU contracts to FMA
+  // (jnp `s = s + w * term`); explicit fmaf matches it bitwise while the
+  // build keeps -ffp-contract=off everywhere else (blanket contraction
+  // over-fused other sites and broke parity the other way)
   float s = w.binpack * bp;
-  s = s + w.least * least;
-  s = s + w.most * most;
-  s = s + w.balanced * balanced;
+  s = std::fmaf(w.least, least, s);
+  s = std::fmaf(w.most, most, s);
+  s = std::fmaf(w.balanced, balanced, s);
   return s;
 }
 
@@ -439,10 +446,11 @@ struct Solver {
         float least = (fl0[n] * 100.0f + fl1[n] * 100.0f) / 2.0f;
         float most = (fm0[n] * 100.0f + fm1[n] * 100.0f) / 2.0f;
         float balanced = 100.0f - std::fabs(fb0[n] - fb1[n]) * 100.0f;
+        // fmaf chain matches XLA's contraction (see node_score_base)
         float s = wb * bp;
-        s = s + wl * least;
-        s = s + wm * most;
-        s = s + wba * balanced;
+        s = std::fmaf(wl, least, s);
+        s = std::fmaf(wm, most, s);
+        s = std::fmaf(wba, balanced, s);
         // rank = (s + static) + pack_eff*bonus   (XLA refresh order)
         // serve = s + (static + pack_eff*bonus)  (XLA serve/scan order)
         float pe = chain && pack_epoch[n] == epoch ? pack_val[n] : 0.0f;

@@ -58,16 +58,22 @@ def _kernel(# scalar prefetch (SMEM)
             s_job_ntasks,     # [J] i32
             s_job_minavail,   # [J] i32
             s_job_base,       # [J] i32
-            s_job_queue,      # [J] i32
-            s_queue_jstart,   # [Q] i32
-            s_queue_njobs,    # [Q] i32
+            s_pool_jstart,    # [P8] i32
+            s_pool_njobs,     # [P8] i32
+            s_pool_queue,     # [P8] i32
+            s_pool_ns,        # [P8] i32
             s_group_bucket,   # [G] i32
             s_pack_milli,     # [G] i32 pack bonus * 1024
             # VMEM inputs
             group_req_ref,    # [G8, R_PAD] f32
             qdes_ref,         # [Q8, LANE] f32 (+inf for ungated dims)
             qalloc0_ref,      # [Q8, LANE] f32
-            qnjobs_ref,       # [Q8, LANE] i32 (lane-broadcast)
+            pnjobs_ref,       # [P8, LANE] i32 (lane-broadcast)
+            pq_onehot_ref,    # [P8, Q8] f32 pool -> queue one-hot
+            pn_onehot_ref,    # [NS8, P8] f32 namespace -> pools incidence
+            nsalloc0_ref,     # [NS8, LANE] f32
+            nstotal_ref,      # [1, LANE] f32 (first R lanes; 0 elsewhere)
+            nsweight_ref,     # [NS8, LANE] f32 (lane-broadcast)
             idle0_ref,        # [R_PAD, Np] f32
             future0_ref,      # [R_PAD, Np] f32
             alloc_ref,        # [R_PAD, Np] f32
@@ -84,23 +90,25 @@ def _kernel(# scalar prefetch (SMEM)
             v_pack,                                      # [1, Np] f32
             v_grow,                                      # [1, Np] f32 group row
             v_qalloc,                                    # [Q8, LANE] f32
-            v_qcursor,                                   # [Q8, LANE] i32
+            v_nsalloc,                                   # [NS8, LANE] f32
+            v_pcursor,                                   # [P8, LANE] i32
             v_placedres,                                 # [1, LANE] f32
             sc,                                          # SMEM (16,) i32
-            sc_cursor,                                   # SMEM (Q8,) i32
+            sc_cursor,                                   # SMEM (P8,) i32
             sem,                                         # DMA semaphore
-            *, n_res: int, allow_pipeline: bool):
+            *, n_res: int, allow_pipeline: bool, ns_live: bool):
     t = pl.program_id(0)
     T = pl.num_programs(0)
 
     # SMEM scalar slots
-    CUR_Q, CUR_JOB, T_OFF, PLACED, PLACED_ALLOC, CUR_BUCKET, PREV_G = range(7)
+    CUR_P, CUR_JOB, T_OFF, PLACED, PLACED_ALLOC, CUR_BUCKET, PREV_G = range(7)
 
-    n_queues = s_queue_njobs.shape[0]
-
-    def queue_select():
-        """min dominant share among eligible queues (share/overuse from the
-        live v_qalloc); returns (q, job) scalars, -1 when none eligible."""
+    def pool_select():
+        """The two-level (namespace, queue) job selection
+        (ops/allocate.make_pool_select): namespace first — live weighted
+        dominant share (drf's NamespaceOrderFn) when ``ns_live``, else the
+        static encode rank — then the best non-overused pool within it by
+        live queue share. Returns the pool scalar, -1 when none eligible."""
         alloc = v_qalloc[:, :]
         des = qdes_ref[:, :]
         eps = eps_ref[0:1, :]
@@ -112,12 +120,34 @@ def _kernel(# scalar prefetch (SMEM)
                       alloc / jnp.where(zero_des, 1.0, des)))
         share = jnp.max(frac, axis=1)                       # [Q8]
         over = jnp.any(~((alloc <= des + eps) | inf_des), axis=1)
-        cursor = v_qcursor[:, 0]
-        njobs = qnjobs_ref[:, 0]
-        eligible = (cursor < njobs) & ~over
-        q = jnp.argmin(jnp.where(eligible, share, BIG)).astype(jnp.int32)
+        # map per-queue share/over onto pools via the one-hot matmul
+        pool_share = jnp.dot(pq_onehot_ref[:, :], share[:, None],
+                             preferred_element_type=jnp.float32)[:, 0]
+        pool_over = jnp.dot(pq_onehot_ref[:, :],
+                            over.astype(jnp.float32)[:, None],
+                            preferred_element_type=jnp.float32)[:, 0] > 0.0
+        cursor = v_pcursor[:, 0]
+        njobs = pnjobs_ref[:, 0]
+        pool_ok = (cursor < njobs) & ~pool_over             # [P8]
+        ns_has = jnp.dot(pn_onehot_ref[:, :],
+                         pool_ok.astype(jnp.float32)[:, None],
+                         preferred_element_type=jnp.float32)[:, 0] > 0.0
+        if ns_live:
+            ns_alloc = v_nsalloc[:, :]
+            total = nstotal_ref[0:1, :]
+            nfrac = jnp.where(total > 0.0,
+                              ns_alloc / jnp.where(total > 0.0, total, 1.0),
+                              jnp.where(ns_alloc == 0.0, 0.0, 1.0))
+            ns_key = jnp.max(nfrac, axis=1) / nsweight_ref[:, 0]
+        else:
+            ns_key = jax.lax.broadcasted_iota(
+                jnp.float32, (ns_has.shape[0], 1), 0)[:, 0]
+        ns_sel = jnp.argmin(jnp.where(ns_has, ns_key, BIG)).astype(jnp.int32)
+        ns_row = pn_onehot_ref[pl.ds(ns_sel, 1), :]         # [1, P8]
+        eligible = pool_ok & (ns_row[0, :] > 0.0)
+        p = jnp.argmin(jnp.where(eligible, pool_share, BIG)).astype(jnp.int32)
         ok = jnp.any(eligible)
-        return jnp.where(ok, q, -1)
+        return jnp.where(ok, p, -1)
 
     @pl.when(t == 0)
     def _init():
@@ -129,18 +159,19 @@ def _kernel(# scalar prefetch (SMEM)
         v_ck_ntasks[:, :] = ntasks0_ref[:, :]
         v_pack[:, :] = jnp.zeros_like(v_pack)
         v_qalloc[:, :] = qalloc0_ref[:, :]
-        v_qcursor[:, :] = jnp.zeros_like(v_qcursor)
+        v_nsalloc[:, :] = nsalloc0_ref[:, :]
+        v_pcursor[:, :] = jnp.zeros_like(v_pcursor)
         v_placedres[:, :] = jnp.zeros_like(v_placedres)
-        for qi in range(sc_cursor.shape[0]):
-            sc_cursor[qi] = 0
+        for pi in range(sc_cursor.shape[0]):
+            sc_cursor[pi] = 0
         sc[CUR_BUCKET] = -1
         sc[PREV_G] = -1
         sc[T_OFF] = 0
         sc[PLACED] = 0
         sc[PLACED_ALLOC] = 0
-        q0 = queue_select()
-        sc[CUR_Q] = q0
-        sc[CUR_JOB] = jnp.where(q0 >= 0, s_queue_jstart[jnp.maximum(q0, 0)], -1)
+        p0 = pool_select()
+        sc[CUR_P] = p0
+        sc[CUR_JOB] = jnp.where(p0 >= 0, s_pool_jstart[jnp.maximum(p0, 0)], -1)
 
     active = sc[CUR_JOB] >= 0
     job = jnp.maximum(sc[CUR_JOB], 0)
@@ -279,20 +310,26 @@ def _kernel(# scalar prefetch (SMEM)
     v_ck_future[:, :] = jnp.where(complete, v_future[:, :], v_ck_future[:, :])
     v_ck_ntasks[:, :] = jnp.where(complete, v_ntasks[:, :], v_ck_ntasks[:, :])
 
-    q = jnp.maximum(sc[CUR_Q], 0)
+    p = jnp.maximum(sc[CUR_P], 0)
+    q = s_pool_queue[p]
+    ns = s_pool_ns[p]
     qrow_ids = jax.lax.broadcasted_iota(jnp.int32, v_qalloc.shape, 0)
     charge = jnp.where((qrow_ids == q) & keep, v_placedres[0:1, :], 0.0)
     v_qalloc[:, :] = v_qalloc[:, :] + charge
-    v_qcursor[:, :] = v_qcursor[:, :] + jnp.where(
-        (qrow_ids == q) & complete, 1, 0)
-    sc_cursor[q] = sc_cursor[q] + jnp.where(complete, 1, 0)
+    nsrow_ids = jax.lax.broadcasted_iota(jnp.int32, v_nsalloc.shape, 0)
+    v_nsalloc[:, :] = v_nsalloc[:, :] + jnp.where(
+        (nsrow_ids == ns) & keep, v_placedres[0:1, :], 0.0)
+    prow_ids = jax.lax.broadcasted_iota(jnp.int32, v_pcursor.shape, 0)
+    v_pcursor[:, :] = v_pcursor[:, :] + jnp.where(
+        (prow_ids == p) & complete, 1, 0)
+    sc_cursor[p] = sc_cursor[p] + jnp.where(complete, 1, 0)
 
-    # next (queue, job)
-    nq = queue_select()
-    nq_safe = jnp.maximum(nq, 0)
-    njob = jnp.where(nq >= 0,
-                     s_queue_jstart[nq_safe] + sc_cursor[nq_safe], -1)
-    sc[CUR_Q] = jnp.where(complete, nq, sc[CUR_Q])
+    # next (pool, job)
+    np_ = pool_select()
+    np_safe = jnp.maximum(np_, 0)
+    njob = jnp.where(np_ >= 0,
+                     s_pool_jstart[np_safe] + sc_cursor[np_safe], -1)
+    sc[CUR_P] = jnp.where(complete, np_, sc[CUR_P])
     sc[CUR_JOB] = jnp.where(complete, njob, sc[CUR_JOB])
     sc[T_OFF] = jnp.where(complete, 0, new_t_off)
     sc[PLACED] = jnp.where(complete, 0, placed)
@@ -312,31 +349,41 @@ def _kernel(# scalar prefetch (SMEM)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("allow_pipeline", "n_res", "interpret"))
+                   static_argnames=("allow_pipeline", "n_res", "ns_live",
+                                    "interpret"))
 def _pallas_gang_allocate(s_task_group, s_job_start, s_job_ntasks,
-                          s_job_minavail, s_job_base, s_job_queue,
-                          s_queue_jstart, s_queue_njobs, s_group_bucket,
-                          s_pack_milli,
-                          group_req, qdes, qalloc0, qnjobs,
+                          s_job_minavail, s_job_base, s_pool_jstart,
+                          s_pool_njobs, s_pool_queue, s_pool_ns,
+                          s_group_bucket, s_pack_milli,
+                          group_req, qdes, qalloc0, pnjobs,
+                          pq_onehot, pn_onehot, nsalloc0, nstotal, nsweight,
                           idle0, future0, alloc, ntasks0, maxtasks,
                           eps_row, w_row, gscore,
                           *, n_res: int, allow_pipeline: bool,
-                          interpret: bool = False):
+                          ns_live: bool, interpret: bool = False):
     T = int(s_task_group.shape[0])
     kernel = functools.partial(_kernel, n_res=n_res,
-                               allow_pipeline=allow_pipeline)
+                               allow_pipeline=allow_pipeline,
+                               ns_live=ns_live)
     Np = idle0.shape[1]
     Q8 = qdes.shape[0]
+    P8 = pnjobs.shape[0]
+    NS8 = nsalloc0.shape[0]
     emits = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=10,
+            num_scalar_prefetch=11,
             grid=(T,),
             in_specs=[
                 pl.BlockSpec(memory_space=pltpu.VMEM),   # group_req
                 pl.BlockSpec(memory_space=pltpu.VMEM),   # qdes
                 pl.BlockSpec(memory_space=pltpu.VMEM),   # qalloc0
-                pl.BlockSpec(memory_space=pltpu.VMEM),   # qnjobs
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # pnjobs
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # pq_onehot
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # pn_onehot
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # nsalloc0
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # nstotal
+                pl.BlockSpec(memory_space=pltpu.VMEM),   # nsweight
                 pl.BlockSpec(memory_space=pltpu.VMEM),   # idle0
                 pl.BlockSpec(memory_space=pltpu.VMEM),   # future0
                 pl.BlockSpec(memory_space=pltpu.VMEM),   # alloc
@@ -358,19 +405,21 @@ def _pallas_gang_allocate(s_task_group, s_job_start, s_job_ntasks,
                 pltpu.VMEM((1, Np), jnp.float32),        # v_pack
                 pltpu.VMEM((1, Np), jnp.float32),        # v_grow
                 pltpu.VMEM((Q8, LANE), jnp.float32),     # v_qalloc
-                pltpu.VMEM((Q8, LANE), jnp.int32),       # v_qcursor
+                pltpu.VMEM((NS8, LANE), jnp.float32),    # v_nsalloc
+                pltpu.VMEM((P8, LANE), jnp.int32),       # v_pcursor
                 pltpu.VMEM((1, LANE), jnp.float32),      # v_placedres
                 pltpu.SMEM((16,), jnp.int32),            # sc
-                pltpu.SMEM((Q8,), jnp.int32),            # sc_cursor
+                pltpu.SMEM((P8,), jnp.int32),            # sc_cursor
                 pltpu.SemaphoreType.DMA(()),             # sem
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((((T + 7) // 8) * 8, 8), jnp.int32),
         interpret=interpret,
     )(s_task_group, s_job_start, s_job_ntasks, s_job_minavail, s_job_base,
-      s_job_queue, s_queue_jstart, s_queue_njobs, s_group_bucket,
+      s_pool_jstart, s_pool_njobs, s_pool_queue, s_pool_ns, s_group_bucket,
       s_pack_milli,
-      group_req, qdes, qalloc0, qnjobs, idle0, future0, alloc, ntasks0,
+      group_req, qdes, qalloc0, pnjobs, pq_onehot, pn_onehot, nsalloc0,
+      nstotal, nsweight, idle0, future0, alloc, ntasks0,
       maxtasks, eps_row, w_row, gscore)
     return emits
 
@@ -388,34 +437,17 @@ def gang_allocate_pallas(task_group, task_job, task_valid, group_req,
     """Drop-in for ops.allocate.gang_allocate, returning
     (assign, pipelined, ready, kept, None).
 
-    Single-namespace only: with one namespace the (ns, queue) pools
-    degenerate to queues and this kernel's live queue selection is exactly
-    the two-level rule; for multi-namespace batches the solver routes to
-    the chunked XLA kernel instead (BatchSolver._select_kernel), which
-    carries the namespace-primary selection in full.
+    Namespace fairness is first-class: jobs are encoded in (namespace,
+    queue) POOLS and every job boundary re-selects the namespace — by live
+    weighted dominant share over the in-kernel ns allocations when
+    ``ns_live`` (drf's NamespaceOrderFn, allocate.go:120-139), else by the
+    encode's static namespace rank — then the best non-overused queue
+    within it (single-namespace batches degenerate to the previous
+    queue-only selection exactly).
 
     The group-bucket reduction needs host numpy (scatter by group), so it
     runs here; everything else is one jitted program — the wrapper's ~30
     individual op dispatches cost real latency on a tunneled TPU."""
-    n_ns = int(np.asarray(ns_weight).shape[0])
-    if n_ns > 1 and len(np.unique(np.asarray(pool_ns)[
-            np.asarray(pool_njobs) > 0])) > 1:
-        raise ValueError(
-            "gang_allocate_pallas handles single-namespace batches only; "
-            "route multi-namespace batches to gang_allocate_chunked")
-    # pools -> queue-indexed selection arrays (exact for one namespace:
-    # pool order is queue first-appearance order)
-    pq = np.asarray(pool_queue)
-    Qn = int(np.asarray(queue_deserved).shape[0])
-    queue_job_start = np.zeros(Qn, np.int32)
-    queue_njobs = np.zeros(Qn, np.int32)
-    pjs = np.asarray(pool_job_start)
-    pnj = np.asarray(pool_njobs)
-    for i in range(min(len(pq), Qn)):
-        q = int(pq[i])
-        if q < Qn and pnj[i] > 0:
-            queue_job_start[q] = pjs[i]
-            queue_njobs[q] = pnj[i]
     G = int(group_req.shape[0])
     # group_bucket from per-task buckets (uniform within a group by
     # construction; see solver.place bucket_fn keyed on job+task annotations)
@@ -435,9 +467,13 @@ def gang_allocate_pallas(task_group, task_job, task_valid, group_req,
         jnp.asarray(job_ready_base, jnp.int32),
         jnp.asarray(job_task_start, jnp.int32),
         jnp.asarray(job_n_tasks, jnp.int32),
-        jnp.asarray(job_queue, jnp.int32),
-        jnp.asarray(queue_job_start, jnp.int32),
-        jnp.asarray(queue_njobs, jnp.int32),
+        jnp.asarray(pool_queue, jnp.int32),
+        jnp.asarray(pool_ns, jnp.int32),
+        jnp.asarray(pool_job_start, jnp.int32),
+        jnp.asarray(pool_njobs, jnp.int32),
+        jnp.asarray(ns_weight, jnp.float32),
+        jnp.asarray(ns_alloc0, jnp.float32),
+        jnp.asarray(ns_total, jnp.float32),
         jnp.asarray(queue_deserved, jnp.float32),
         jnp.asarray(queue_alloc0, jnp.float32),
         jnp.asarray(node_idle, jnp.float32),
@@ -446,19 +482,22 @@ def gang_allocate_pallas(task_group, task_job, task_valid, group_req,
         jnp.asarray(node_ntasks, jnp.int32),
         jnp.asarray(node_max_tasks, jnp.int32),
         jnp.asarray(eps, jnp.float32), weights,
-        allow_pipeline=allow_pipeline, interpret=interpret)
+        allow_pipeline=allow_pipeline, ns_live=bool(ns_live),
+        interpret=interpret)
 
 
-@partial(jax.jit, static_argnames=("allow_pipeline", "interpret"))
+@partial(jax.jit, static_argnames=("allow_pipeline", "ns_live", "interpret"))
 def _gang_allocate_pallas_jit(task_group, task_job, task_valid, group_req,
                               group_mask, group_static_score, gb,
                               group_pack_bonus, job_min_available,
                               job_ready_base, job_task_start, job_n_tasks,
-                              job_queue, queue_job_start, queue_njobs,
+                              pool_queue, pool_ns, pool_job_start,
+                              pool_njobs, ns_weight, ns_alloc0, ns_total,
                               queue_deserved, queue_alloc0, node_idle,
                               node_future, node_alloc, node_ntasks,
                               node_max_tasks, eps, weights: ScoreWeights,
                               allow_pipeline: bool = True,
+                              ns_live: bool = False,
                               interpret: bool = False):
     T = int(task_group.shape[0])
     J = int(job_min_available.shape[0])
@@ -467,8 +506,12 @@ def _gang_allocate_pallas_jit(task_group, task_job, task_valid, group_req,
     R = int(group_req.shape[1])
     assert R <= R_PAD, f"resource axis {R} exceeds R_PAD={R_PAD}"
     Np = ((N + LANE - 1) // LANE) * LANE
-    Q = int(queue_njobs.shape[0])
+    Q = int(queue_deserved.shape[0])
     Q8 = max(8, ((Q + 7) // 8) * 8)
+    P = int(pool_queue.shape[0])
+    P8 = max(8, ((P + 7) // 8) * 8)
+    NS = int(ns_weight.shape[0])
+    NS8 = max(8, ((NS + 7) // 8) * 8)
     G8 = ((G + 7) // 8) * 8
 
     s_task_group = jnp.where(jnp.asarray(task_valid, bool),
@@ -498,9 +541,29 @@ def _gang_allocate_pallas_jit(task_group, task_job, task_valid, group_req,
     qdes = jnp.where(jnp.isinf(qdes), BIG * 2.0, qdes)
     qalloc0_p = _pad_to(_pad_to(jnp.asarray(queue_alloc0, jnp.float32),
                                 LANE, 1), Q8, 0)
-    qnjobs = jnp.broadcast_to(
-        _pad_to(jnp.asarray(queue_njobs, jnp.int32), Q8, 0)[:, None],
-        (Q8, LANE))
+    pnjobs = jnp.broadcast_to(
+        _pad_to(jnp.asarray(pool_njobs, jnp.int32), P8, 0)[:, None],
+        (P8, LANE))
+    pq_p = _pad_to(jnp.asarray(pool_queue, jnp.int32), P8, 0)
+    pns_p = _pad_to(jnp.asarray(pool_ns, jnp.int32), P8, 0)
+    pjs_p = _pad_to(jnp.asarray(pool_job_start, jnp.int32), P8, 0)
+    pnj_p = _pad_to(jnp.asarray(pool_njobs, jnp.int32), P8, 0)
+    # one-hot maps for the in-kernel share/eligibility matmuls; padding
+    # pools keep all-zero rows (their njobs is 0 -> never eligible)
+    live_pool = (jnp.arange(P8) < P)[:, None]
+    pq_onehot = jnp.where(
+        live_pool & (jnp.arange(Q8)[None, :] == pq_p[:, None]),
+        1.0, 0.0).astype(jnp.float32)                        # [P8, Q8]
+    pn_onehot = jnp.where(
+        (jnp.arange(NS8)[:, None] == pns_p[None, :]) & live_pool.T,
+        1.0, 0.0).astype(jnp.float32)                        # [NS8, P8]
+    nsalloc0_p = _pad_to(_pad_to(jnp.asarray(ns_alloc0, jnp.float32),
+                                 LANE, 1), NS8, 0)
+    nstotal_row = _pad_to(jnp.asarray(ns_total, jnp.float32)[None, :],
+                          LANE, 1)
+    nsweight_p = jnp.broadcast_to(
+        _pad_to(jnp.maximum(jnp.asarray(ns_weight, jnp.float32), 1e-9),
+                NS8, 0, value=1.0)[:, None], (NS8, LANE))
 
     eps_row = _pad_to(jnp.asarray(eps, jnp.float32)[None, :], LANE, 1)
     w_row = jnp.zeros((1, LANE), jnp.float32)
@@ -517,15 +580,15 @@ def _gang_allocate_pallas_jit(task_group, task_job, task_valid, group_req,
         jnp.asarray(job_n_tasks, jnp.int32),
         jnp.asarray(job_min_available, jnp.int32),
         jnp.asarray(job_ready_base, jnp.int32),
-        jnp.asarray(job_queue, jnp.int32),
-        jnp.asarray(queue_job_start, jnp.int32),
-        jnp.asarray(queue_njobs, jnp.int32),
+        pjs_p, pnj_p, pq_p, pns_p,
         jnp.asarray(gb), pack_milli,
-        group_req_p, qdes, qalloc0_p, qnjobs,
+        group_req_p, qdes, qalloc0_p, pnjobs,
+        pq_onehot, pn_onehot, nsalloc0_p, nstotal_row, nsweight_p,
         tr_nodes(node_idle), tr_nodes(node_future), tr_nodes(node_alloc),
         row_nodes(node_ntasks), row_nodes(node_max_tasks),
         eps_row, w_row, gscore,
-        n_res=R, allow_pipeline=allow_pipeline, interpret=interpret)
+        n_res=R, allow_pipeline=allow_pipeline, ns_live=ns_live,
+        interpret=interpret)
 
     # reconstruct task-order outputs from the per-step emission stream
     emits = emits[:T]   # drop the padded tail rows (never written)
