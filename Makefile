@@ -2,7 +2,17 @@
 
 PYTHON ?= python
 
-.PHONY: test unit-test e2e bench bench-all multichip-dryrun
+.PHONY: test unit-test e2e bench bench-all multichip-dryrun deploy deploy-up
+
+# one-command deployment (the reference's installer/volcano-development.yaml
+# analogue): bring up apiserver + webhook-manager (TLS admission) +
+# controller-manager + scheduler, run a smoke job through the full path,
+# tear down. `make deploy-up` leaves the control plane running.
+deploy:
+	$(PYTHON) -m volcano_tpu.cmd.deploy
+
+deploy-up:
+	$(PYTHON) -m volcano_tpu.cmd.deploy --keep
 
 # the standard unit gate (reference: make unit-test, go test -p 8 -race ...)
 # tests force the virtual 8-device CPU mesh (tests/conftest.py); the

@@ -1,0 +1,73 @@
+"""Fake MPI workload run as REAL processes by the ProcessKubelet e2e.
+
+Mirrors the reference MPI e2e's contract (test/e2e/jobseq/mpi.go:30-81):
+the master reads the worker hostfile the svc plugin rendered at
+/etc/volcano and drives every listed worker; passwordless auth is
+simulated with the ssh plugin's REAL RSA keypair — the master SIGNS each
+worker's name with id_rsa and workers VERIFY the signature against
+authorized_keys before exiting 0. Completion therefore depends on the
+hostfile contents (an unlisted worker never gets a launch file) AND on
+the keypair being a matching pair (a bad signature exits nonzero).
+
+Roles (argv[1]):
+  master: read hostfile + VC_WORKER_NUM, sign one launch file per worker
+          into RENDEZVOUS_DIR, exit 0.
+  worker: wait for launch file + the test's release gate, verify the
+          signature with authorized_keys, exit 0 (4 on bad signature,
+          3 on timeout).
+"""
+
+import os
+import pathlib
+import sys
+import time
+
+
+def main() -> int:
+    role = sys.argv[1]
+    rendezvous = pathlib.Path(os.environ["RENDEZVOUS_DIR"])
+    mount_root = pathlib.Path(os.environ["VOLCANO_MOUNT_ROOT"])
+    etc = mount_root / "etc/volcano"
+    ssh_dir = mount_root / "root/.ssh"
+    pod_name = os.environ["POD_NAME"]
+
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding
+
+    if role == "master":
+        hosts = (etc / "worker.host").read_text().split()
+        if len(hosts) != int(os.environ["VC_WORKER_NUM"]):
+            return 2
+        key = serialization.load_pem_private_key(
+            (ssh_dir / "id_rsa").read_bytes(), password=None)
+        for fqdn in hosts:
+            worker = fqdn.split(".")[0]
+            sig = key.sign(worker.encode(), padding.PKCS1v15(),
+                           hashes.SHA256())
+            tmp = rendezvous / f".tmp-{worker}-{os.getpid()}"
+            tmp.write_bytes(sig)
+            tmp.rename(rendezvous / f"go-{worker}")
+        return 0
+
+    # worker
+    pub = serialization.load_ssh_public_key(
+        (ssh_dir / "authorized_keys").read_bytes())
+    launch = rendezvous / f"go-{pod_name}"
+    release = rendezvous / "release"
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if launch.exists() and release.exists():
+            break
+        time.sleep(0.05)
+    else:
+        return 3
+    try:
+        pub.verify(launch.read_bytes(), pod_name.encode(),
+                   padding.PKCS1v15(), hashes.SHA256())
+    except Exception:
+        return 4
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,197 @@
+"""Workload-consuming e2e: a fake MPI job whose tasks run as REAL
+processes and whose completion DEPENDS on what the svc/ssh job plugins
+produced — the master reads the rendered worker hostfile, signs each
+listed worker's launch token with the ssh Secret's private key, and
+workers verify it against authorized_keys before exiting 0
+(tests/fake_mpi_workload.py). Mirrors the reference's MPI e2e
+(test/e2e/jobseq/mpi.go:30-81) and its failure-policy suite
+(job_error_handling.go): a SIGKILLed worker process drives the
+PodFailed -> RestartTask / RestartJob policies through the real job
+lifecycle.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+from tests.test_controllers import CONF, make_job
+from volcano_tpu.apiserver import ObjectStore
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.controllers import ControllerManager, make_pod_name
+from volcano_tpu.framework import (close_session, get_action, open_session,
+                                   parse_scheduler_conf)
+from volcano_tpu.models.objects import (Container, JobAction, JobEvent,
+                                        JobPhase, LifecyclePolicy, ObjectMeta,
+                                        PodSpec, PodTemplate, TaskSpec)
+from volcano_tpu.utils.clock import FakeClock
+from volcano_tpu.utils.process_kubelet import ProcessKubelet
+from volcano_tpu.utils.test_utils import build_node, build_queue
+from volcano_tpu.webhooks import WebhookManager
+
+WORKLOAD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fake_mpi_workload.py")
+
+
+class ProcCluster:
+    """Control plane + process kubelet (real workload subprocesses)."""
+
+    def __init__(self, tmp_path):
+        self.clock = FakeClock(start=100.0)
+        self.store = ObjectStore(clock=self.clock)
+        WebhookManager(self.store)
+        self.store.create("queues", build_queue("default", weight=1))
+        self.manager = ControllerManager(self.store)
+        self.kubelet = ProcessKubelet(self.store, workdir=str(tmp_path))
+        self.cache = SchedulerCache(self.store)
+        self.cache.run()
+        self.conf = parse_scheduler_conf(CONF)
+
+    def schedule_once(self):
+        ssn = open_session(self.cache, self.conf.tiers,
+                           self.conf.configurations)
+        try:
+            for name in self.conf.actions:
+                get_action(name).execute(ssn)
+        finally:
+            close_session(ssn)
+        self.cache.flush_executors()
+
+    def pump(self, until, timeout=90.0, tick=0.1):
+        """Run control loops + reap processes until ``until()`` or
+        timeout; advances the fake clock alongside wall time."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            self.manager.sync()
+            self.schedule_once()
+            self.kubelet.poll()
+            self.clock.advance(1.0)
+            if until():
+                return True
+            time.sleep(tick)
+        return False
+
+    def stop(self):
+        self.kubelet.stop()
+        self.cache.stop()
+
+    def phase(self, name="mpi"):
+        return self.store.get("jobs", name).status.state.phase
+
+
+def mpi_job(rendezvous, worker_policy=None, job_policies=None,
+            n_workers=2):
+    def container(role):
+        return Container(
+            requests={"cpu": "1", "memory": "1Gi"},
+            command=["python", WORKLOAD, role],
+            env={"RENDEZVOUS_DIR": str(rendezvous)})
+    tasks = [
+        TaskSpec(name="master", replicas=1,
+                 template=PodTemplate(spec=PodSpec(
+                     containers=[container("master")]))),
+        TaskSpec(name="worker", replicas=n_workers,
+                 policies=worker_policy or [],
+                 template=PodTemplate(spec=PodSpec(
+                     containers=[container("worker")]))),
+    ]
+    return make_job(name="mpi", tasks=tasks, min_available=1 + n_workers,
+                    plugins={"svc": [], "ssh": [], "env": []},
+                    policies=job_policies or [])
+
+
+@pytest.fixture
+def cl(tmp_path):
+    c = ProcCluster(tmp_path / "kubelet")
+    yield c
+    c.stop()
+
+
+def test_mpi_job_completes_through_hostfile_and_keypair(cl, tmp_path):
+    """The happy path of mpi.go:30-81: master + 2 workers; the job
+    completes ONLY because the hostfile listed both workers and the
+    signature verified against the ssh Secret's authorized_keys."""
+    rdv = tmp_path / "rdv"
+    rdv.mkdir()
+    cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+    cl.store.create("jobs", mpi_job(rdv))
+    (rdv / "release").write_text("go")   # no failure injection: open gate
+
+    assert cl.pump(lambda: cl.phase() == JobPhase.COMPLETED), \
+        f"job stuck in {cl.phase()}"
+    job = cl.store.get("jobs", "mpi")
+    assert job.status.succeeded == 3
+    # the launch tokens exist for exactly the hostfile's workers
+    for i in range(2):
+        assert (rdv / f"go-{make_pod_name('mpi', 'worker', i)}").exists()
+
+
+def test_killed_worker_restart_task_policy(cl, tmp_path):
+    """job_error_handling-style: SIGKILL one worker process mid-run; the
+    task-level PodFailed -> RestartTask policy restarts ONLY the worker
+    task's pods (master's Succeeded pod is retained), and the rerun
+    workers complete off the persisted launch tokens."""
+    rdv = tmp_path / "rdv"
+    rdv.mkdir()
+    cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+    policy = [LifecyclePolicy(event=JobEvent.POD_FAILED,
+                              action=JobAction.RESTART_TASK)]
+    cl.store.create("jobs", mpi_job(rdv, worker_policy=policy))
+
+    victim = make_pod_name("mpi", "worker", 0)
+    # wait until the master signed the launch tokens and workers run
+    assert cl.pump(lambda: (rdv / f"go-{victim}").exists()
+                   and f"default/{victim}" in cl.kubelet.procs), \
+        "workers never started"
+    assert cl.kubelet.kill("default", victim)
+    # the failure propagates: pod Failed -> RestartTask recreates workers
+    assert cl.pump(lambda: cl.store.get("jobs", "mpi").status.version >= 1), \
+        "RestartTask never fired"
+    (rdv / "release").write_text("go")
+    assert cl.pump(lambda: cl.phase() == JobPhase.COMPLETED), \
+        f"job stuck in {cl.phase()} after task restart"
+    assert cl.store.get("jobs", "mpi").status.succeeded == 3
+
+
+def test_killed_worker_restart_job_policy(cl, tmp_path):
+    """The reference's job-level variant (job_error_handling.go:37-47):
+    PodFailed -> RestartJob kills and reruns the whole job, retry count
+    bumped, and the rerun completes."""
+    rdv = tmp_path / "rdv"
+    rdv.mkdir()
+    cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+    cl.store.create("jobs", mpi_job(
+        rdv, job_policies=[LifecyclePolicy(event=JobEvent.POD_FAILED,
+                                           action=JobAction.RESTART_JOB)]))
+
+    victim = make_pod_name("mpi", "worker", 1)
+    assert cl.pump(lambda: f"default/{victim}" in cl.kubelet.procs), \
+        "workers never started"
+    assert cl.kubelet.kill("default", victim)
+    assert cl.pump(lambda: cl.store.get("jobs", "mpi").status.retry_count
+                   >= 1), "RestartJob never fired"
+    (rdv / "release").write_text("go")
+    assert cl.pump(lambda: cl.phase() == JobPhase.COMPLETED), \
+        f"job stuck in {cl.phase()} after restart"
+
+
+def test_tampered_keypair_fails_job(cl, tmp_path):
+    """Negative control proving completion really consumes the keypair:
+    replace authorized_keys with a DIFFERENT public key after creation —
+    workers' signature verification fails and the job cannot complete."""
+    rdv = tmp_path / "rdv"
+    rdv.mkdir()
+    (rdv / "release").write_text("go")
+    cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+    cl.store.create("jobs", mpi_job(rdv))
+    # tamper before pods are created: PodGroup is still Pending
+    from volcano_tpu.controllers.job.plugins.ssh import generate_rsa_key
+    cl.manager.sync()
+    secret = cl.store.get("secrets", "mpi-ssh")
+    assert secret is not None
+    secret.data["authorized_keys"] = generate_rsa_key()["authorized_keys"]
+    cl.store.update("secrets", secret, skip_admission=True)
+
+    assert cl.pump(lambda: cl.phase() in (JobPhase.FAILED,),
+                   timeout=60), f"job should fail, is {cl.phase()}"

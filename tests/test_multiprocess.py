@@ -41,6 +41,22 @@ def _wait_ready(client, timeout=60.0):
     return False
 
 
+def test_deploy_command(tmp_path):
+    """`make deploy` (volcano_tpu.cmd.deploy): one command brings up the
+    four-process control plane with TLS admission, proves admission is
+    live, runs a smoke gang job to full binding, and tears down clean."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "volcano_tpu.cmd.deploy",
+         "--timeout", "150"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=200)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-500:]
+    assert "admission live" in r.stdout
+    assert "smoke job bound: 4/4" in r.stdout
+    assert "deployment verified and torn down" in r.stdout
+
+
 def test_four_process_control_plane(tmp_path):
     import socket
     with socket.socket() as s:

@@ -235,10 +235,11 @@ class JobController(Controller):
         job_info = self.cache.get(req.key())
         if job_info is None or job_info.job is None:
             return
-        state = new_state(job_info, self.sync_job, self.kill_job)
+        state = new_state(job_info, self.sync_job, self.kill_job,
+                          self.kill_target)
         action = apply_policies(job_info.job, req)
         try:
-            state.execute(action)
+            state.execute(action, target=req.task_name)
             self.requeue_count.pop(self._req_key(req), None)
         except Exception as e:  # requeue with backoff cap (job_controller.go:336-352)
             k = self._req_key(req)
@@ -349,6 +350,36 @@ class JobController(Controller):
             controlled_resources=job.status.controlled_resources,
             retry_count=job.status.retry_count)
         self._write_status(job, update_status)
+
+    def kill_target(self, job_info: JobInfo, task_name: str,
+                    update_status=None) -> None:
+        """RestartTask: delete ONLY the named task's pods (all phases) and
+        bump the job version so their in-flight requests are discarded;
+        the next sync recreates them. The job phase is untouched — the
+        action's contract is a task-scoped restart
+        (bus/v1alpha1/actions.go:31-33)."""
+        job = self._get_live_job(job_info)
+        if job is None:
+            return
+        for pod in list(job_info.pods.get(task_name, {}).values()):
+            try:
+                self.store.delete("pods", pod.metadata.name,
+                                  pod.metadata.namespace,
+                                  skip_admission=True)
+            except KeyError:
+                pass
+        job = self._get_live_job(job_info) or job
+        job.status.version += 1
+        self.store.record_event(
+            "jobs", job, "Normal", "RestartTask",
+            f"Restarting task {task_name} pods")
+        # like kill_job: the write must land (a ConflictError propagates so
+        # the request requeues — a silently lost version bump would let
+        # stale POD_FAILED events at the old version re-trigger the
+        # restart) and the controller cache must see the bump immediately
+        # (the async mirror can lag a queued same-version request)
+        self.store.update("jobs", job, skip_admission=True)
+        self.cache.update(job)
 
     def kill_job(self, job_info: JobInfo, pod_retain_phases: Set[str],
                  update_status) -> None:

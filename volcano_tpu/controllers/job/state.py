@@ -19,15 +19,19 @@ POD_RETAIN_PHASE_SOFT: Set[str] = {"Succeeded", "Failed"}
 
 SyncFn = Callable[[JobInfo, Optional[Callable[[JobStatus], bool]]], None]
 KillFn = Callable[[JobInfo, Set[str], Optional[Callable[[JobStatus], bool]]], None]
+# RestartTask: second argument is the TASK NAME, not a retain-phase set
+TargetKillFn = Callable[[JobInfo, str, Optional[Callable[[JobStatus], bool]]], None]
 
 
 class State:
-    def __init__(self, job: JobInfo, sync_job: SyncFn, kill_job: KillFn):
+    def __init__(self, job: JobInfo, sync_job: SyncFn, kill_job: KillFn,
+                 kill_target: Optional[TargetKillFn] = None):
         self.job = job
         self.sync_job = sync_job
         self.kill_job = kill_job
+        self.kill_target = kill_target
 
-    def execute(self, action: str) -> None:
+    def execute(self, action: str, target: str = "") -> None:
         raise NotImplementedError
 
     # common transitions -----------------------------------------------------
@@ -44,7 +48,7 @@ class State:
 class PendingState(State):
     """state/pending.go"""
 
-    def execute(self, action: str) -> None:
+    def execute(self, action: str, target: str = "") -> None:
         if action == JobAction.RESTART_JOB:
             self._kill_to(JobPhase.RESTARTING, POD_RETAIN_PHASE_NONE, bump_retry=True)
         elif action == JobAction.ABORT_JOB:
@@ -67,9 +71,17 @@ class RunningState(State):
     """state/running.go — including minSuccess / per-task minAvailable
     completion semantics."""
 
-    def execute(self, action: str) -> None:
+    def execute(self, action: str, target: str = "") -> None:
         if action == JobAction.RESTART_JOB:
             self._kill_to(JobPhase.RESTARTING, POD_RETAIN_PHASE_NONE, bump_retry=True)
+        elif action == JobAction.RESTART_TASK and target \
+                and self.kill_target is not None:
+            # restart ONLY the named task's pods; the job stays Running and
+            # sync recreates them under the bumped version. The reference
+            # declares the action (bus/v1alpha1/actions.go:31-33) as the
+            # per-task default but its controller at this version has no
+            # handler; this implements the documented contract.
+            self.kill_target(self.job, target, None)
         elif action == JobAction.ABORT_JOB:
             self._kill_to(JobPhase.ABORTING, POD_RETAIN_PHASE_SOFT)
         elif action == JobAction.TERMINATE_JOB:
@@ -112,7 +124,7 @@ class RestartingState(State):
     """state/restarting.go — back to Pending once enough pods are gone,
     Failed once maxRetry exhausted."""
 
-    def execute(self, action: str) -> None:
+    def execute(self, action: str, target: str = "") -> None:
         job = self.job.job
 
         def update(status: JobStatus) -> bool:
@@ -129,7 +141,7 @@ class RestartingState(State):
 class AbortingState(State):
     """state/aborting.go"""
 
-    def execute(self, action: str) -> None:
+    def execute(self, action: str, target: str = "") -> None:
         if action == JobAction.RESUME_JOB:
             self._kill_to(JobPhase.RESTARTING, POD_RETAIN_PHASE_SOFT, bump_retry=True)
         else:
@@ -144,7 +156,7 @@ class AbortingState(State):
 class AbortedState(State):
     """state/aborted.go"""
 
-    def execute(self, action: str) -> None:
+    def execute(self, action: str, target: str = "") -> None:
         if action == JobAction.RESUME_JOB:
             self._kill_to(JobPhase.RESTARTING, POD_RETAIN_PHASE_SOFT, bump_retry=True)
         else:
@@ -154,7 +166,7 @@ class AbortedState(State):
 class CompletingState(State):
     """state/completing.go"""
 
-    def execute(self, action: str) -> None:
+    def execute(self, action: str, target: str = "") -> None:
         def update(status: JobStatus) -> bool:
             if status.terminating or status.pending or status.running:
                 return False
@@ -166,7 +178,7 @@ class CompletingState(State):
 class TerminatingState(State):
     """state/terminating.go"""
 
-    def execute(self, action: str) -> None:
+    def execute(self, action: str, target: str = "") -> None:
         def update(status: JobStatus) -> bool:
             if status.terminating or status.pending or status.running:
                 return False
@@ -178,7 +190,7 @@ class TerminatingState(State):
 class FinishedState(State):
     """state/finished.go — always release non-retained pods."""
 
-    def execute(self, action: str) -> None:
+    def execute(self, action: str, target: str = "") -> None:
         self.kill_job(self.job, POD_RETAIN_PHASE_SOFT, None)
 
 
@@ -196,8 +208,9 @@ _STATES = {
 }
 
 
-def new_state(job_info: JobInfo, sync_job: SyncFn, kill_job: KillFn) -> State:
+def new_state(job_info: JobInfo, sync_job: SyncFn, kill_job: KillFn,
+              kill_target: Optional[TargetKillFn] = None) -> State:
     """state/factory.go:62-85 — Pending by default."""
     phase = job_info.job.status.state.phase if job_info.job else JobPhase.PENDING
     cls = _STATES.get(phase, PendingState)
-    return cls(job_info, sync_job, kill_job)
+    return cls(job_info, sync_job, kill_job, kill_target)
