@@ -235,10 +235,12 @@ class ObjectStore:
 
         Watch delivery: watchers exposing ``on_bulk_update`` get one call
         with their [(old, new)] list, where ``new`` is the STORE'S OWN
-        object delivered transiently — the handler must neither mutate nor
-        retain it (clone first to keep anything); this saves one deep pod
-        copy per patch on the 50k-bind flush. Watchers without a bulk
-        handler get per-pair on_update with the usual per-watcher copy."""
+        object — the handler must never MUTATE it, but retaining it is
+        allowed (stored objects are immutable in place: every update
+        replaces them wholesale, a contract any future optimization here
+        must preserve); this saves one deep pod copy per patch on the
+        50k-bind flush. Watchers without a bulk handler get per-pair
+        on_update with the usual per-watcher copy."""
         pairs: list = []
         missing: list = []
         watches: list = []

@@ -80,12 +80,11 @@ def _run_cycle(cache, conf) -> float:
     import gc
 
     from volcano_tpu.framework import close_session, get_action, open_session
+    from volcano_tpu.utils import gcguard
 
     gc.collect()
     gc.freeze()
-    was_enabled = gc.isenabled()
-    if was_enabled:
-        gc.disable()
+    gcguard.pause()   # nest-safe vs the cache executor's own GC pause
     try:
         t0 = time.perf_counter()
         cache.begin_cycle()
@@ -102,8 +101,7 @@ def _run_cycle(cache, conf) -> float:
             cache.end_cycle()
         return (time.perf_counter() - t0) * 1000.0
     finally:
-        if was_enabled:
-            gc.enable()
+        gcguard.resume()
         gc.unfreeze()
 
 

@@ -145,7 +145,9 @@ class SchedulerCache(EventHandlersMixin):
         w.append(s.watch("nodes", locked(self.add_node), locked(self.update_node),
                          locked(self.delete_node)))
         w.append(s.watch("podgroups", locked(self.add_pod_group),
-                         locked(self.update_pod_group), locked(self.delete_pod_group)))
+                         locked(self.update_pod_group),
+                         locked(self.delete_pod_group),
+                         on_bulk_update=self.update_pod_groups_bulk))
         w.append(s.watch("queues", locked(self.add_queue), locked(self.update_queue),
                          locked(self.delete_queue)))
         w.append(s.watch("pods", locked(self.add_pod), locked(self.update_pod),
@@ -195,7 +197,9 @@ class SchedulerCache(EventHandlersMixin):
     RESYNC_RETRY_SECONDS = 1.0
 
     def _exec_loop(self) -> None:
+        from ..utils import gcguard
         last_yield_gen = -1
+        gc_paused = False
         while True:
             # while reconciliations are pending, wake periodically even
             # with no new submissions (a stuck err_task must not wait for
@@ -204,41 +208,57 @@ class SchedulerCache(EventHandlersMixin):
             self._exec_event.wait(
                 timeout=self.RESYNC_RETRY_SECONDS if self.err_tasks
                 else None)
-            while True:
-                with self._exec_lock:
-                    fn = self._exec_queue.popleft() if self._exec_queue \
-                        else None
-                if fn is None:
-                    # queue drained: reconcile failed binds/evicts before
-                    # going idle; keep going while passes make progress
-                    before = len(self.err_tasks)
-                    if before:
-                        self.process_resync_tasks()
-                    if self.err_tasks and len(self.err_tasks) < before:
-                        continue   # progressed: keep reconciling
+            try:
+                while True:
                     with self._exec_lock:
-                        if not self._exec_queue:
-                            self._exec_event.clear()
-                            # idle = submitted writes executed; pending
-                            # reconciliations retry on the timed wakeup
-                            self._exec_idle.set()
-                            break
-                    continue
-                # yield to a live cycle — once per cycle generation, so
-                # long or back-to-back cycles delay the backlog by at most
-                # 2 s each rather than 2 s per queued item
-                if not self._cycle_idle.is_set():
-                    gen = self._cycle_gen
-                    if gen != last_yield_gen:
-                        self._cycle_idle.wait(timeout=2.0)
-                        last_yield_gen = gen
-                try:
-                    fn()   # submitted fns resync their own expected errors
-                except Exception:
-                    # an escaped error must not kill the worker: every later
-                    # bind/evict would silently queue forever
-                    logging.getLogger(__name__).exception(
-                        "cache executor task failed")
+                        fn = self._exec_queue.popleft() if self._exec_queue \
+                            else None
+                    if fn is None:
+                        # queue drained: reconcile failed binds/evicts
+                        # before going idle; keep going while passes make
+                        # progress
+                        before = len(self.err_tasks)
+                        if before:
+                            self.process_resync_tasks()
+                        if self.err_tasks and len(self.err_tasks) < before:
+                            continue   # progressed: keep reconciling
+                        with self._exec_lock:
+                            if not self._exec_queue:
+                                self._exec_event.clear()
+                                # idle = submitted writes executed; pending
+                                # reconciliations retry on the timed wakeup
+                                self._exec_idle.set()
+                                break
+                        continue
+                    if not gc_paused:
+                        # pause cyclic GC for the drain burst (same policy
+                        # as run_once: a gen2 scan over the 50k-task graph
+                        # mid-flush costs seconds; burst garbage is
+                        # refcounted)
+                        gc_paused = True
+                        gcguard.pause()
+                    # yield to a live cycle — once per cycle generation, so
+                    # long or back-to-back cycles delay the backlog by at
+                    # most 2 s each rather than 2 s per queued item
+                    if not self._cycle_idle.is_set():
+                        gen = self._cycle_gen
+                        if gen != last_yield_gen:
+                            self._cycle_idle.wait(timeout=2.0)
+                            last_yield_gen = gen
+                    try:
+                        fn()   # submitted fns resync their own errors
+                    except Exception:
+                        # an escaped error must not kill the worker: every
+                        # later bind/evict would silently queue forever
+                        logging.getLogger(__name__).exception(
+                            "cache executor task failed")
+            finally:
+                # ANY exit from the drain (idle, worker death, an escaped
+                # resync error) must release the GC pause — leaking it
+                # would leave cyclic collection disabled process-wide
+                if gc_paused:
+                    gc_paused = False
+                    gcguard.resume()
             if self._exec_stop:
                 return
 
@@ -418,24 +438,72 @@ class SchedulerCache(EventHandlersMixin):
         accepted: list = []
         bound: list = []
 
+        def apply_one(task_info, hostname):
+            try:
+                job, task = self._find_job_and_task(task_info)
+            except KeyError:
+                return
+            node = self.nodes.get(hostname)
+            if node is None:
+                return
+            original = task.status
+            job.move_task_status(task, TaskStatus.Binding)
+            try:
+                node.add_task(task)
+            except RuntimeError:
+                job.move_task_status(task, original)
+                return
+            accepted.append(task_info)
+            bound.append((task, task.pod, hostname))
+
         def apply():
+            # bulk fast path: a gang's pairs share one job and land on few
+            # nodes; one status-move pass + one accounting pass per node
+            # replaces per-task move/add overhead (50k binds per burst).
+            # Any lookup miss or accounting refusal falls back to the
+            # per-task path for exactly the affected items (identical
+            # semantics: the per-task path skips/rolls back per task).
+            by_job: Dict[str, list] = {}
             for task_info, hostname in pairs:
-                try:
-                    job, task = self._find_job_and_task(task_info)
-                except KeyError:
+                by_job.setdefault(task_info.job, []).append(
+                    (task_info, hostname))
+            for jid, items in by_job.items():
+                job = self.jobs.get(jid)
+                stored = None
+                if job is not None:
+                    stored = [job.tasks.get(t.uid) for t, _ in items]
+                if job is None or any(s is None for s in stored) or \
+                        any(self.nodes.get(h) is None for _, h in items):
+                    for task_info, hostname in items:
+                        apply_one(task_info, hostname)
                     continue
-                node = self.nodes.get(hostname)
-                if node is None:
-                    continue
-                original = task.status
-                job.move_task_status(task, TaskStatus.Binding)
-                try:
-                    node.add_task(task)
-                except RuntimeError:
-                    job.move_task_status(task, original)
-                    continue
-                accepted.append(task_info)
-                bound.append((task, task.pod, hostname))
+                originals = [s.status for s in stored]
+                job.move_tasks_status_bulk(stored, TaskStatus.Binding)
+                by_node: Dict[str, list] = {}
+                for (task_info, hostname), s, orig in zip(items, stored,
+                                                          originals):
+                    by_node.setdefault(hostname, []).append(
+                        (task_info, s, orig))
+                for hostname, node_items in by_node.items():
+                    node = self.nodes[hostname]
+                    tasks = [s for _, s, _ in node_items]
+                    try:
+                        node.add_tasks_bulk(tasks, pipelined=False)
+                    except RuntimeError:
+                        # combined fit refused (drifted accounting): replay
+                        # per task so fitting prefixes still land
+                        for (task_info, s, orig) in node_items:
+                            try:
+                                node.add_task(s)
+                            except RuntimeError:
+                                job.move_task_status(s, orig)
+                                continue
+                            accepted.append(task_info)
+                            bound.append((s, s.pod, hostname))
+                        continue
+                    for task_info, s, _ in node_items:
+                        accepted.append(task_info)
+                        bound.append((s, s.pod, hostname))
 
         with self._exec_lock:
             worker_live = self._exec_thread is not None
@@ -642,6 +710,32 @@ class SchedulerCache(EventHandlersMixin):
                 job.pod_group = pg
                 job.pod_group_owned = True
         return job
+
+    def update_job_statuses(self, updates) -> None:
+        """Bulk form of :meth:`update_job_status` for the session's close
+        writeback (``[(job, update_pg)]``): events first, then ONE bulk
+        PodGroup status push (StoreStatusUpdater.update_pod_groups) —
+        the per-group get+update round trips dominated the post-burst
+        flush at 6k jobs."""
+        push = []
+        for job, update_pg in updates:
+            self.record_job_status_event(job)
+            if update_pg and job.pod_group is not None:
+                push.append(job)
+        if not push:
+            return
+        bulk = getattr(self.status_updater, "update_pod_groups", None)
+        if bulk is None:
+            for job in push:
+                pg = self.status_updater.update_pod_group(job.pod_group)
+                if pg is not None:
+                    job.pod_group = pg
+                    job.pod_group_owned = True
+            return
+        for job, pg in zip(push, bulk([j.pod_group for j in push])):
+            if pg is not None:
+                job.pod_group = pg
+                job.pod_group_owned = True
 
     def record_job_status_event(self, job: JobInfo) -> None:
         """Pending-not-ready jobs get FailedScheduling events on their

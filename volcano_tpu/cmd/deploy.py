@@ -130,10 +130,12 @@ def main(argv=None) -> int:
             try:
                 client.create("jobs", make_job("deploy-bad", 2, 5))
                 client.delete("jobs", "deploy-bad", "default")
-                time.sleep(0.4)
             except ApiError as e:
                 if e.code == 422:
                     rejected = True
+                    break
+            time.sleep(0.4)   # outside the try: non-422 errors (webhook
+            #                   still booting) must not busy-spin
         if not rejected:
             log("FAIL: admission never became live")
             return 1
@@ -170,7 +172,9 @@ def main(argv=None) -> int:
                 while all(p.poll() is None for p in procs):
                     time.sleep(1.0)
             except KeyboardInterrupt:
-                pass
+                return 0
+            log("FAIL: a control-plane component exited; tearing down")
+            return 1
         return 0
     finally:
         if not args.keep or not ok:

@@ -162,9 +162,13 @@ class JobUpdater:
         if cache is None:
             return
         if updates:
-            cache.submit_background(
-                lambda: [cache.update_job_status(job, update_pg)
-                         for job, update_pg in updates])
+            bulk = getattr(cache, "update_job_statuses", None)
+            if bulk is not None:
+                cache.submit_background(lambda: bulk(updates))
+            else:
+                cache.submit_background(
+                    lambda: [cache.update_job_status(job, update_pg)
+                             for job, update_pg in updates])
 
     def prepare_job(self, job: JobInfo) -> bool:
         """Roll up the job's status; True if the PodGroup must be pushed.

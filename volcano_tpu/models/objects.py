@@ -701,6 +701,24 @@ def clone_pod_for_bind(p: "Pod") -> "Pod":
     return new
 
 
+def clone_pod_group_for_status(pg: "PodGroup") -> "PodGroup":
+    """Minimal podgroup clone for the store's bulk STATUS push: a fresh
+    metadata shell (resource_version bump) with the spec SHARED — stored
+    objects are never mutated in place, and sharing lets watchers detect
+    the status-only echo by spec identity (cache.update_pod_groups_bulk).
+    The status is installed by the patch fn, so the clone's own status is
+    irrelevant (shared here)."""
+    new = object.__new__(PodGroup)
+    d = new.__dict__
+    s = pg.__dict__
+    m = object.__new__(ObjectMeta)
+    m.__dict__.update(s["metadata"].__dict__)
+    d["metadata"] = m
+    d["spec"] = s["spec"]
+    d["status"] = s["status"]
+    return new
+
+
 def _clone_pod_group_status(st: "PodGroupStatus") -> "PodGroupStatus":
     new = object.__new__(PodGroupStatus)
     d = new.__dict__

@@ -84,13 +84,11 @@ class Scheduler:
         reclaimed) objects and a mid-cycle gen2 scan costs over a second.
         Cycle-created garbage with actual reference cycles is collected
         between cycles in :meth:`run`."""
-        import gc
+        from .utils import gcguard
         start = time.perf_counter()
         with self._mutex:
             conf = self.conf
-        gc_was_enabled = gc.isenabled()
-        if gc_was_enabled:
-            gc.disable()
+        gcguard.pause()
         begin = getattr(self.cache, "begin_cycle", None)
         if begin is not None:
             begin()
@@ -109,8 +107,7 @@ class Scheduler:
             end = getattr(self.cache, "end_cycle", None)
             if end is not None:
                 end()
-            if gc_was_enabled:
-                gc.enable()
+            gcguard.resume()
         m.update_e2e_duration(time.perf_counter() - start)
 
     def run(self) -> None:

@@ -1,0 +1,44 @@
+"""Refcounted pause/resume of the cyclic garbage collector.
+
+Two code paths disable the collector around object-churn bursts — the
+scheduling cycle (a mid-cycle gen2 scan over a 50k-task graph costs over
+a second; scheduler.run_once) and the cache executor's drain bursts
+(bind flush churns millions of acyclic objects). They overlap on
+different threads, so raw gc.disable()/gc.enable() pairs would race and
+re-enable collection mid-burst; this guard nests.
+
+The collector is re-enabled only when the LAST pause releases and only
+if it was enabled at the first pause (a process that globally disabled
+GC stays that way). Garbage from the bursts is overwhelmingly acyclic
+(refcount-reclaimed); true cycles are reaped by the scheduler loop's
+inter-cycle collect (scheduler.run) or the next natural threshold.
+"""
+
+from __future__ import annotations
+
+import gc
+import threading
+
+_lock = threading.Lock()
+_depth = 0
+_was_enabled = False
+
+
+def pause() -> None:
+    global _depth, _was_enabled
+    with _lock:
+        _depth += 1
+        if _depth == 1:
+            _was_enabled = gc.isenabled()
+            if _was_enabled:
+                gc.disable()
+
+
+def resume() -> None:
+    global _depth
+    with _lock:
+        if _depth == 0:
+            return   # unbalanced release: never force-enable
+        _depth -= 1
+        if _depth == 0 and _was_enabled:
+            gc.enable()

@@ -126,18 +126,21 @@ class ProcessKubelet:
             rc = proc.poll()
             if rc is None:
                 continue
-            del self.procs[key]
             ns, name = key.split("/", 1)
             pod = self.store.get("pods", name, ns)
             if pod is None or pod.status.phase != "Running":
+                del self.procs[key]   # pod gone/rewritten: nothing to record
                 continue
             pod.status.exit_code = rc
             pod.status.phase = "Succeeded" if rc == 0 else "Failed"
             try:
                 self.store.update("pods", pod, skip_admission=True)
-                finished += 1
             except (ConflictError, KeyError):
-                pass
+                continue   # raced a concurrent writer: retry next poll —
+                #            dropping the entry here would lose the pod's
+                #            terminal phase forever
+            del self.procs[key]
+            finished += 1
         return finished
 
     def kill(self, namespace: str, name: str,
