@@ -94,9 +94,18 @@ class AllocateAction(Action):
 
     def _pending_tasks(self, ssn, job: JobInfo) -> List[TaskInfo]:
         """Pending, non-best-effort, task-order sorted (allocate.go:183-196)."""
-        import functools
         tasks = [t for t in job.task_status_index.get(TaskStatus.Pending, {}).values()
                  if not t.resreq.is_empty()]
+        fns = ssn._enabled_fns("task_order_fns")
+        if all(getattr(fn, "standard_priority_order", False)
+               for _, _, fn in fns):
+            # no order fn beyond the standard priority comparator (or none
+            # at all): the dispatch result is exactly (priority desc, uid
+            # asc) — a key sort instead of a cmp_to_key dispatch per
+            # comparison (50k comparisons per burst cycle)
+            tasks.sort(key=lambda t: (-t.priority, t.uid))
+            return tasks
+        import functools
         tasks.sort(key=functools.cmp_to_key(
             lambda a, b: -1 if ssn.task_order_fn(a, b) else 1))
         return tasks

@@ -28,6 +28,26 @@ class VolumeBinder(Protocol):
     def bind_volumes(self, task, pod_volumes) -> None: ...
 
 
+_clone_fn_support: dict = {}
+
+
+def _accepts_clone_fn(patch_fn) -> bool:
+    """Whether this store's patch_batch takes the clone_fn kwarg — probed
+    once per underlying function (older remote stores lack it; catching
+    TypeError around the executing call instead would re-run a partially
+    committed batch)."""
+    key = getattr(patch_fn, "__func__", patch_fn)
+    cached = _clone_fn_support.get(key)
+    if cached is None:
+        try:
+            import inspect
+            cached = "clone_fn" in inspect.signature(patch_fn).parameters
+        except (TypeError, ValueError):   # builtins/remote proxies
+            cached = False
+        _clone_fn_support[key] = cached
+    return cached
+
+
 def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool) -> tuple:
     """Shared engine behind StoreBinder/FakeBinder ``bind_batch``: one
     ``patch_batch`` store pass (one lock acquisition, one bulk watch
@@ -63,17 +83,8 @@ def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool) -> tuple:
         return fn
 
     from ..models.objects import clone_pod_for_bind
-    # feature-detect clone_fn support up front: catching TypeError around
-    # the executing call would re-run a partially committed batch when a
-    # patch fn itself raised TypeError (double rv bumps + double watch
-    # deliveries for the committed prefix)
-    kwargs = {}
-    try:
-        import inspect
-        if "clone_fn" in inspect.signature(patch_fn).parameters:
-            kwargs["clone_fn"] = clone_pod_for_bind
-    except (TypeError, ValueError):   # builtins/remote proxies: no kwarg
-        pass
+    kwargs = {"clone_fn": clone_pod_for_bind} \
+        if _accepts_clone_fn(patch_fn) else {}
     _, missing_keys = patch_fn(
         "pods", [(pod.metadata.name, pod.metadata.namespace,
                   setter(hostname)) for pod, hostname in items], **kwargs)
@@ -156,13 +167,8 @@ class StoreStatusUpdater:
             return fn
 
         from ..models.objects import clone_pod_group_for_status
-        kwargs = {}
-        try:
-            import inspect
-            if "clone_fn" in inspect.signature(patch_fn).parameters:
-                kwargs["clone_fn"] = clone_pod_group_for_status
-        except (TypeError, ValueError):
-            pass
+        kwargs = {"clone_fn": clone_pod_group_for_status} \
+            if _accepts_clone_fn(patch_fn) else {}
         pairs, missing = patch_fn(
             "podgroups",
             [(pg.metadata.name, pg.metadata.namespace,

@@ -70,8 +70,13 @@ def observe(name: str, value: float, **labels):
 
 
 def set_gauge(name: str, value: float, **labels):
+    # single-label fast path: one-item tuples need no sort (the gauge
+    # sweeps at session close set ~3 per job)
+    items = tuple(labels.items())
+    if len(items) > 1:
+        items = tuple(sorted(items))
     with _lock:
-        _gauges[(name, tuple(sorted(labels.items())))] = value
+        _gauges[(name, items)] = value
 
 
 def inc(name: str, value: float = 1.0, **labels):

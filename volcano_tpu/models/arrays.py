@@ -299,7 +299,8 @@ class TaskBatch:
     task_group: np.ndarray           # [T] i32
     task_job: np.ndarray             # [T] i32
     group_req: np.ndarray            # [G, R] f32
-    group_members: List[List[int]]   # group -> task indices
+    group_first: np.ndarray          # [G_real] i32 first task per group
+    group_inverse: np.ndarray        # [T_real] group of each task
     job_uids: List[str]
     job_min_available: np.ndarray    # [J] i32 (padding rows incl. sentinel: 0)
     job_ready_base: np.ndarray       # [J] i32 already-occupied task count
@@ -399,16 +400,27 @@ class TaskBatch:
             uniq_keys, first_idx, inverse = np.unique(
                 packed, return_index=True, return_inverse=True)
             task_group = inverse.astype(np.int32)
-            group_reqs = [rindex.vec(tasks[i].resreq) for i in first_idx]
-            order = np.argsort(inverse, kind="stable")
-            counts = np.bincount(inverse, minlength=len(uniq_keys))
-            bounds = np.cumsum(counts)[:-1]
-            group_members = [m.tolist()
-                             for m in np.split(order, bounds)]
+            reps = [tasks[i] for i in first_idx]
+            if all(not r.resreq.scalars for r in reps):
+                # no scalar dims: column-wise fill beats one rindex.vec
+                # (6 temp arrays) per group — 6k groups per burst encode
+                n_g = len(reps)
+                group_reqs_arr = np.zeros((n_g, rindex.r), np.float32)
+                group_reqs_arr[:, 0] = np.fromiter(
+                    (r.resreq.milli_cpu for r in reps), np.float64, n_g)
+                group_reqs_arr[:, 1] = np.fromiter(
+                    (r.resreq.memory for r in reps), np.float64, n_g)
+                group_reqs_arr *= rindex.scales[None, :]
+                group_reqs = group_reqs_arr
+            else:
+                group_reqs = [rindex.vec(t.resreq) for t in reps]
+            group_first = first_idx.astype(np.int32)
+            group_inverse = inverse
         else:
             task_group = np.zeros(0, np.int32)
             group_reqs = []
-            group_members = []
+            group_first = np.zeros(0, np.int32)
+            group_inverse = np.zeros(0, np.int64)
 
         t_pad = bucket(len(tasks), task_bucket)
         g_pad = bucket(max(1, len(group_reqs)), group_bucket)
@@ -427,8 +439,11 @@ class TaskBatch:
             return out
 
         greq = np.zeros((g_pad, r), np.float32)
-        if group_reqs:
-            greq[:len(group_reqs)] = np.stack(group_reqs)
+        if len(group_reqs):
+            if isinstance(group_reqs, np.ndarray):
+                greq[:len(group_reqs)] = group_reqs
+            else:
+                greq[:len(group_reqs)] = np.stack(group_reqs)
 
         return cls(
             rindex=rindex, tasks=tasks, t_pad=t_pad, g_pad=g_pad, j_pad=j_pad,
@@ -437,7 +452,8 @@ class TaskBatch:
             task_group=pad1(task_group, t_pad, np.int32),
             task_job=pad1(task_job, t_pad, np.int32, fill=sentinel),
             group_req=greq,
-            group_members=group_members,
+            group_first=group_first,
+            group_inverse=group_inverse,
             job_uids=job_uids,
             job_min_available=pad1(job_min, j_pad, np.int32),
             job_ready_base=pad1(job_base, j_pad, np.int32),
@@ -458,7 +474,25 @@ class TaskBatch:
 
     @property
     def n_groups(self) -> int:
-        return len(self.group_members)
+        return len(self.group_first)
+
+    @property
+    def group_members(self) -> List[List[int]]:
+        """group -> member task indices, materialized on first use (most
+        cycles only ever need a group's REPRESENTATIVE, group_first; the
+        6k-list materialization cost real encode time per burst)."""
+        cached = self.__dict__.get("_group_members")
+        if cached is None:
+            if len(self.group_inverse):
+                order = np.argsort(self.group_inverse, kind="stable")
+                counts = np.bincount(self.group_inverse,
+                                     minlength=len(self.group_first))
+                bounds = np.cumsum(counts)[:-1]
+                cached = [m.tolist() for m in np.split(order, bounds)]
+            else:
+                cached = []
+            self.__dict__["_group_members"] = cached
+        return cached
 
 
 # ---------------------------------------------------------------------------
@@ -494,12 +528,48 @@ class PredicateFeatures:
               batch: TaskBatch) -> "PredicateFeatures":
         n_pad = node_arrays.n_pad
         g_pad = batch.g_pad
+        # one representative task per group (tasks group on identical
+        # constraints, so the rep carries them for the whole group)
+        reps = [batch.tasks[i] for i in batch.group_first]
+
+        # taints (NoSchedule/NoExecute block scheduling): node-side, needed
+        # regardless of task constraints — an untolerated taint must mask
+        # its node even for constraint-free pods
+        taint_ids: Dict[tuple, int] = {}
+        node_taint_list: List[List[int]] = [[] for _ in range(n_pad)]
+        for name, i in node_arrays.name_to_idx.items():
+            node = nodes[name].node
+            for taint in (node.spec.taints if node else []):
+                if taint.effect in ("NoSchedule", "NoExecute"):
+                    tid = taint_ids.setdefault(
+                        (taint.key, taint.value, taint.effect),
+                        len(taint_ids))
+                    node_taint_list[i].append(tid)
+        k_pad = bucket(max(1, len(taint_ids)), 8)
+        node_taints = np.zeros((n_pad, k_pad), np.float32)
+        for i, tids in enumerate(node_taint_list):
+            for tid in tids:
+                node_taints[i, tid] = 1.0
+
+        # fast path: no group carries any scheduling constraint — the
+        # common burst shape; skip every per-group sweep (the group-side
+        # matrices are all-zero / trivially empty)
+        if all(t.constraint_key_cache is _TRIVIAL_CONSTRAINT or (
+                not t.pod.spec.node_selector and not t.pod.spec.tolerations
+                and t.pod.spec.affinity is None) for t in reps):
+            f_pad = bucket(1, 8)
+            return cls(
+                node_pairs=np.zeros((n_pad, f_pad), np.float32),
+                group_requires=np.zeros((g_pad, f_pad), np.float32),
+                group_require_counts=np.zeros(g_pad, np.float32),
+                node_taints=node_taints,
+                group_tolerates=np.zeros((g_pad, k_pad), np.float32),
+                group_affinity_ok=None)
 
         # collect referenced selector pairs
         pair_ids: Dict[Tuple[str, str], int] = {}
         group_pairs: List[List[int]] = [[] for _ in range(g_pad)]
-        for g, members in enumerate(batch.group_members):
-            t = batch.tasks[members[0]]
+        for g, t in enumerate(reps):
             for k, v in sorted(t.pod.spec.node_selector.items()):
                 pid = pair_ids.setdefault((k, v), len(pair_ids))
                 group_pairs[g].append(pid)
@@ -520,25 +590,9 @@ class PredicateFeatures:
                 group_requires[g, pid] = 1.0
         group_require_counts = group_requires.sum(axis=1).astype(np.float32)
 
-        # taints (NoSchedule/NoExecute block scheduling)
-        taint_ids: Dict[tuple, int] = {}
-        node_taint_list: List[List[int]] = [[] for _ in range(n_pad)]
-        for name, i in node_arrays.name_to_idx.items():
-            node = nodes[name].node
-            for taint in (node.spec.taints if node else []):
-                if taint.effect in ("NoSchedule", "NoExecute"):
-                    tid = taint_ids.setdefault(
-                        (taint.key, taint.value, taint.effect), len(taint_ids))
-                    node_taint_list[i].append(tid)
-        k_pad = bucket(max(1, len(taint_ids)), 8)
-        node_taints = np.zeros((n_pad, k_pad), np.float32)
-        for i, tids in enumerate(node_taint_list):
-            for tid in tids:
-                node_taints[i, tid] = 1.0
         group_tolerates = np.zeros((g_pad, k_pad), np.float32)
         from .objects import Taint
-        for g, members in enumerate(batch.group_members):
-            t = batch.tasks[members[0]]
+        for g, t in enumerate(reps):
             for (key, value, effect), tid in taint_ids.items():
                 taint = Taint(key=key, value=value, effect=effect)
                 if any(tol.tolerates(taint) for tol in t.pod.spec.tolerations):
@@ -548,8 +602,7 @@ class PredicateFeatures:
         # per group x node; built only when some group actually carries
         # required affinity (None otherwise — see class docstring)
         group_affinity_ok = None
-        for g, members in enumerate(batch.group_members):
-            t = batch.tasks[members[0]]
+        for g, t in enumerate(reps):
             aff = t.pod.spec.affinity
             if aff is None or aff.node_affinity is None or not aff.node_affinity.required:
                 continue

@@ -326,6 +326,35 @@ class NodeInfo:
         mirror the cache's state, and replaying costs O(tasks) resource
         arithmetic plus a quantity re-parse per node, which dominated the
         per-cycle snapshot at 10k nodes."""
+        from .job_info import _fastmodel
+        fm = _fastmodel()
+        if fm is not None:
+            try:
+                tasks = fm.clone_task_dict(self.tasks)
+            except TypeError:
+                tasks = None
+            if tasks is not None:
+                # C shell copy + the fields needing fresh values — the
+                # same set the Python path below rebuilds
+                c = fm.shell_clone(self)
+                c.releasing = fm.clone_resource(self.releasing)
+                c.pipelined = fm.clone_resource(self.pipelined)
+                c.idle = fm.clone_resource(self.idle)
+                c.used = fm.clone_resource(self.used)
+                c.tasks = tasks
+                if self.numa_scheduler_info is not None:
+                    c.numa_scheduler_info = self.numa_scheduler_info.clone()
+                c.others = dict(self.others)
+                if self.gpu_devices:
+                    devices = {}
+                    for i, d in self.gpu_devices.items():
+                        nd = GPUDevice(d.id, d.memory)
+                        nd.pod_map = dict(d.pod_map)
+                        devices[i] = nd
+                    c.gpu_devices = devices
+                else:
+                    c.gpu_devices = {}
+                return c
         c = NodeInfo.__new__(NodeInfo)
         c.name = self.name
         c.node = self.node

@@ -24,9 +24,14 @@ static int n_task_slots = -1;
 static Py_ssize_t status_offset = -1;
 static Py_ssize_t uid_offset = -1;
 
+/* Walk tp's __slots__ member descriptors into offsets/count; optionally
+ * report the offsets of up to two named slots (want_a/want_b, NULL to
+ * skip). Writes ONLY into caller-provided storage so a failed
+ * registration can commit atomically. */
 static int
 collect_offsets(PyTypeObject *tp, Py_ssize_t *offsets, int *count,
-                Py_ssize_t *status_off, Py_ssize_t *uid_off)
+                const char *want_a, Py_ssize_t *off_a,
+                const char *want_b, Py_ssize_t *off_b)
 {
     PyObject *slots = PyObject_GetAttrString((PyObject *)tp, "__slots__");
     if (slots == NULL)
@@ -60,10 +65,10 @@ collect_offsets(PyTypeObject *tp, Py_ssize_t *offsets, int *count,
         offsets[(*count)++] = m->offset;
         const char *cname = PyUnicode_AsUTF8(name);
         if (cname != NULL) {
-            if (strcmp(cname, "status") == 0)
-                *status_off = m->offset;
-            else if (strcmp(cname, "uid") == 0)
-                *uid_off = m->offset;
+            if (want_a != NULL && strcmp(cname, want_a) == 0)
+                *off_a = m->offset;
+            if (want_b != NULL && strcmp(cname, want_b) == 0)
+                *off_b = m->offset;
         }
         Py_DECREF(descr);
     }
@@ -79,13 +84,21 @@ register_task_type(PyObject *self, PyObject *arg)
         return NULL;
     }
     PyTypeObject *tp = (PyTypeObject *)arg;
-    if (collect_offsets(tp, task_offsets, &n_task_slots,
-                        &status_offset, &uid_offset) < 0)
+    /* stage into locals; commit globals only on full success */
+    Py_ssize_t offsets[MAX_SLOTS];
+    int count = 0;
+    Py_ssize_t st_off = -1, u_off = -1;
+    if (collect_offsets(tp, offsets, &count, "status", &st_off,
+                        "uid", &u_off) < 0)
         return NULL;
-    if (status_offset < 0 || uid_offset < 0) {
+    if (st_off < 0 || u_off < 0) {
         PyErr_SetString(PyExc_ValueError, "type lacks status/uid slots");
         return NULL;
     }
+    memcpy(task_offsets, offsets, sizeof(offsets[0]) * count);
+    n_task_slots = count;
+    status_offset = st_off;
+    uid_offset = u_off;
     Py_XDECREF((PyObject *)task_type);
     Py_INCREF(arg);
     task_type = tp;
@@ -177,12 +190,141 @@ fail:
     return NULL;
 }
 
+/* clone_task_dict(tasks) -> dict of cloned tasks (no status index) —
+ * NodeInfo.tasks clones. */
+static PyObject *
+clone_task_dict(PyObject *self, PyObject *arg)
+{
+    if (n_task_slots < 0 || !PyDict_CheckExact(arg)) {
+        PyErr_SetString(PyExc_TypeError, "expected a dict (registered type)");
+        return NULL;
+    }
+    PyObject *out = PyDict_New();
+    if (out == NULL)
+        return NULL;
+    Py_ssize_t pos = 0;
+    PyObject *key, *value;
+    while (PyDict_Next(arg, &pos, &key, &value)) {
+        if (Py_TYPE(value) != task_type) {
+            Py_DECREF(out);
+            PyErr_SetString(PyExc_TypeError, "mixed task types");
+            return NULL;
+        }
+        PyObject *c = clone_one(value);
+        if (c == NULL || PyDict_SetItem(out, key, c) < 0) {
+            Py_XDECREF(c);
+            Py_DECREF(out);
+            return NULL;
+        }
+        Py_DECREF(c);
+    }
+    return out;
+}
+
+/* ---- Resource (slots: milli_cpu, memory, scalars, max_task_num) ---- */
+
+static PyTypeObject *res_type = NULL;
+static Py_ssize_t res_offsets[MAX_SLOTS];
+static int n_res_slots = -1;
+static Py_ssize_t res_scalars_offset = -1;
+
+static PyObject *
+register_resource_type(PyObject *self, PyObject *arg)
+{
+    if (!PyType_Check(arg)) {
+        PyErr_SetString(PyExc_TypeError, "expected a type");
+        return NULL;
+    }
+    PyTypeObject *tp = (PyTypeObject *)arg;
+    /* stage into locals; commit globals only on full success */
+    Py_ssize_t offsets[MAX_SLOTS];
+    int count = 0;
+    Py_ssize_t sc_off = -1;
+    if (collect_offsets(tp, offsets, &count, "scalars", &sc_off,
+                        NULL, NULL) < 0)
+        return NULL;
+    if (sc_off < 0) {
+        PyErr_SetString(PyExc_ValueError, "type lacks a scalars slot");
+        return NULL;
+    }
+    memcpy(res_offsets, offsets, sizeof(offsets[0]) * count);
+    n_res_slots = count;
+    res_scalars_offset = sc_off;
+    Py_XDECREF((PyObject *)res_type);
+    Py_INCREF(arg);
+    res_type = tp;
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+clone_resource(PyObject *self, PyObject *arg)
+{
+    if (n_res_slots < 0 || Py_TYPE(arg) != res_type) {
+        PyErr_SetString(PyExc_TypeError, "not a registered Resource");
+        return NULL;
+    }
+    PyObject *dst = res_type->tp_alloc(res_type, 0);
+    if (dst == NULL)
+        return NULL;
+    char *s = (char *)arg, *d = (char *)dst;
+    for (int i = 0; i < n_res_slots; i++) {
+        PyObject *v = *(PyObject **)(s + res_offsets[i]);
+        if (res_offsets[i] == res_scalars_offset && v != NULL) {
+            PyObject *copy = PyDict_Copy(v);
+            if (copy == NULL) {
+                Py_DECREF(dst);
+                return NULL;
+            }
+            *(PyObject **)(d + res_offsets[i]) = copy;
+        } else {
+            Py_XINCREF(v);
+            *(PyObject **)(d + res_offsets[i]) = v;
+        }
+    }
+    return dst;
+}
+
+/* ---- generic shell clone for plain __dict__ classes ---- */
+
+static PyObject *
+shell_clone(PyObject *self, PyObject *src)
+{
+    PyTypeObject *tp = Py_TYPE(src);
+    PyObject *d = PyObject_GetAttrString(src, "__dict__");
+    if (d == NULL)
+        return NULL;
+    PyObject *nd = PyDict_Copy(d);
+    Py_DECREF(d);
+    if (nd == NULL)
+        return NULL;
+    PyObject *dst = tp->tp_alloc(tp, 0);
+    if (dst == NULL) {
+        Py_DECREF(nd);
+        return NULL;
+    }
+    if (PyObject_SetAttrString(dst, "__dict__", nd) < 0) {
+        Py_DECREF(nd);
+        Py_DECREF(dst);
+        return NULL;
+    }
+    Py_DECREF(nd);
+    return dst;
+}
+
 static PyMethodDef methods[] = {
     {"register_task_type", register_task_type, METH_O,
      "Register the TaskInfo class (reads slot offsets)."},
     {"clone_task", clone_task, METH_O, "Verbatim slot-copy clone."},
     {"clone_task_table", clone_task_table, METH_O,
      "Clone a job's task dict and build the status index."},
+    {"clone_task_dict", clone_task_dict, METH_O,
+     "Clone a node's task dict (no index)."},
+    {"register_resource_type", register_resource_type, METH_O,
+     "Register the Resource class (reads slot offsets)."},
+    {"clone_resource", clone_resource, METH_O,
+     "Slot-copy Resource clone with a fresh scalars dict."},
+    {"shell_clone", shell_clone, METH_O,
+     "New instance of type(obj) with a shallow __dict__ copy."},
     {NULL, NULL, 0, NULL}
 };
 

@@ -126,28 +126,36 @@ class NodeOrderPlugin(Plugin):
         from . import interpod
 
         def fn(batch, narr, feats):
-            score = np.zeros((batch.g_pad, narr.n_pad), np.float32)
+            # the [G, N] score materializes ONLY on first touch: the
+            # all-pass case previously paid a ~256 MB zeros alloc per
+            # context build at 50k x 10k before returning None
+            score = None
             touched = False   # all-zero -> return None (no [G,N] transfer)
             n = len(narr.names)
+
+            def buf():
+                nonlocal score
+                if score is None:
+                    score = np.zeros((batch.g_pad, narr.n_pad), np.float32)
+                return score
             if self.pod_affinity_w:
                 # inter-pod preferred (anti-)affinity batch scorer
                 # (nodeorder.go:271-295); symmetry can score affinity-free
                 # groups, so gate on any affinity existing at all
-                own = {g for g, members in enumerate(batch.group_members)
-                       if interpod.task_has_pod_affinity(
-                           batch.tasks[members[0]])}
+                own = {g for g, i in enumerate(batch.group_first)
+                       if interpod.task_has_pod_affinity(batch.tasks[i])}
                 existing = any(interpod.task_has_pod_affinity(t)
                                for node in ssn.nodes.values()
                                for t in node.tasks.values())
                 if own or existing:
                     index = interpod.get_index(ssn, narr.names)
-                    groups = set(range(len(batch.group_members))) \
+                    groups = set(range(batch.n_groups)) \
                         if index.pref_terms else own
                     for g in groups:
-                        rep = batch.tasks[batch.group_members[g][0]]
+                        rep = batch.tasks[batch.group_first[g]]
                         raw = index.preference_score(rep)
                         if raw is not None:
-                            score[g, :n] += interpod.normalize(
+                            buf()[g, :n] += interpod.normalize(
                                 raw, float(self.pod_affinity_w))
                             touched = True
             # PreferNoSchedule taints are rare: sweep only nodes that carry
@@ -158,8 +166,8 @@ class NodeOrderPlugin(Plugin):
                 if ssn.nodes[name].node is not None
                 and any(t.effect == "PreferNoSchedule"
                         for t in ssn.nodes[name].node.spec.taints)]
-            for g, members in enumerate(batch.group_members):
-                rep = batch.tasks[members[0]]
+            for g, ti in enumerate(batch.group_first):
+                rep = batch.tasks[ti]
                 has_pref = (rep.pod.spec.affinity is not None
                             and rep.pod.spec.affinity.node_affinity is not None
                             and rep.pod.spec.affinity.node_affinity.preferred)
@@ -167,14 +175,14 @@ class NodeOrderPlugin(Plugin):
                     for name, i in narr.name_to_idx.items():
                         labels = ssn.nodes[name].node.metadata.labels \
                             if ssn.nodes[name].node else {}
-                        score[g, i] += self.node_affinity_w * \
+                        buf()[g, i] += self.node_affinity_w * \
                             _preferred_affinity_score(rep, labels)
                     touched = True
                 if self.taint_w and taint_nodes:
                     touched = True
                     for name, i in taint_nodes:
                         # relative to the taint-free constant of 100
-                        score[g, i] += self.taint_w * (
+                        buf()[g, i] += self.taint_w * (
                             _prefer_no_schedule_score(rep, ssn.nodes[name]) - 100.0)
             return score if touched else None
         return fn

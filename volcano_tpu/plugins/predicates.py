@@ -256,8 +256,8 @@ class PredicatesPlugin(Plugin):
                 cpu_reserved = idle_res * cpu_rate                 # millicores
                 mem_reserved = idle_res * mem_rate * 1e6 * \
                     rindex.scales[1]                               # scaled mem
-                for g, members in enumerate(batch.group_members):
-                    rep = batch.tasks[members[0]]
+                for g, ti in enumerate(batch.group_first):
+                    rep = batch.tasks[ti]
                     if rep.resreq.get(res) > 0:
                         continue   # requesters are exempt
                     left_cpu = narr.idle[:, 0] - batch.group_req[g, 0]
@@ -272,9 +272,8 @@ class PredicatesPlugin(Plugin):
         from . import interpod
 
         def mask_fn(batch, narr, feats):
-            needs = {g for g, members in enumerate(batch.group_members)
-                     if interpod.task_has_pod_affinity(
-                         batch.tasks[members[0]])}
+            needs = {g for g, ti in enumerate(batch.group_first)
+                     if interpod.task_has_pod_affinity(batch.tasks[ti])}
             # the symmetry rule can constrain affinity-free groups too, but
             # only when some existing pod carries required anti-affinity —
             # check cheaply before indexing everything
@@ -286,11 +285,10 @@ class PredicatesPlugin(Plugin):
             mask = np.ones((batch.g_pad, narr.n_pad), bool)
             index = interpod.get_index(ssn, narr.names)
             if index.anti_required:
-                needs = set(range(len(batch.group_members)))
+                needs = set(range(batch.n_groups))
             n = len(narr.names)
             for g in needs:
-                members = batch.group_members[g]
-                m = index.required_mask(batch.tasks[members[0]])
+                m = index.required_mask(batch.tasks[batch.group_first[g]])
                 if m is not None:
                     mask[g, :n] &= m
             return mask
@@ -300,8 +298,8 @@ class PredicatesPlugin(Plugin):
         def mask_fn(batch, narr, feats):
             mask = None   # None = pass-through (no dense [G,N] transfer)
             # only sweep groups that actually use host ports or shared GPUs
-            for g, members in enumerate(batch.group_members):
-                rep = batch.tasks[members[0]]
+            for g, ti in enumerate(batch.group_first):
+                rep = batch.tasks[ti]
                 uses_ports = bool(rep.pod.spec.host_ports)
                 uses_gpu = rep.resreq.get(GPU_MEMORY_RESOURCE) > 0
                 if not (uses_ports or uses_gpu):

@@ -26,6 +26,10 @@ class PriorityPlugin(Plugin):
                 return 0
             return -1 if l.priority > r.priority else 1
 
+        # marker: this comparator is EXACTLY the dispatch fallback's
+        # (priority desc) — hot callers key-sort instead of running a
+        # cmp dispatch per comparison (actions/allocate._pending_tasks)
+        task_order_fn.standard_priority_order = True
         ssn.add_task_order_fn(NAME, task_order_fn)
 
         def job_order_fn(l, r):
