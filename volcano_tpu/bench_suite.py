@@ -114,12 +114,13 @@ def _populate(store, n_nodes, n_jobs, gang, queues=None, cpu="2",
 
 
 
-def _warm_cycle(conf_text: str, runs: int = 2, flush_timeout: float = 120.0,
+def _warm_cycle(conf_text: str, runs: int = 3, flush_timeout: float = 120.0,
                 **populate_kwargs):
     """Cold cycle (compile) on one env, then measured warm cycles on fresh
     identical envs with the previous env's executor drained first. Takes
     the min of ``runs`` warm measurements — single-shot wall numbers on a
-    shared machine carry +-25% co-tenant noise. Returns
+    shared machine carry +-25% co-tenant noise (same protocol as
+    bench.py's cycle_worker). Returns
     (ms, flush_ms, binder, cache, conf) of the winning env."""
     store, cache, binder, conf = _cycle_env(conf_text)
     _populate(store, **populate_kwargs)
@@ -252,6 +253,47 @@ def config_5(n_tasks=50_000, n_nodes=10_000, runs=3,
                 "value_ms": round(best, 2),
                 "platform": _platform()})
 
+    # the off-TPU production kernel (solver `auto` picks it): native C++ —
+    # decisions verified against the XLA result on this exact production
+    # shape, every bench run (a divergent solver must never publish a
+    # fast number for wrong placements). Equality is up to sub-ulp score
+    # ties: XLA's fused-emission float results are context-dependent, so
+    # bit-identical argmax on EXACT ties is unattainable across backends
+    # (the Pallas kernel carries the same contract —
+    # tests/test_pallas_allocate.py); gang outcomes and placement counts
+    # must match exactly and every native placement must replay feasibly.
+    from volcano_tpu.ops.native import available, gang_allocate_native
+    if _platform() != "tpu" and available():
+        r2 = gang_allocate_native(*sa.args, weights)
+        a1, a2 = np.asarray(r[0]), r2[0]
+        assert np.array_equal(np.asarray(r[2]), r2[2]) \
+            and np.array_equal(np.asarray(r[3]), r2[3]), \
+            "native solver gang outcomes diverged at 50k x 10k"
+        assert int((a1 >= 0).sum()) == int((a2 >= 0).sum()), \
+            "native solver placement count diverged at 50k x 10k"
+        ndiff = int((a1 != a2).sum())
+        if ndiff:
+            log(f"config_5: native vs XLA differ on {ndiff} sub-ulp "
+                "score-tie placements (contract: tie-equivalent)")
+            idle_chk = np.asarray(sa.node_idle, np.float32).copy()
+            gr = np.asarray(sa.group_req, np.float32)
+            tg = np.asarray(sa.task_group)
+            for t in np.flatnonzero(a2 >= 0):
+                idle_chk[a2[t]] -= gr[tg[t]]
+            assert (idle_chk >= -np.asarray(sa.eps)[None, :] - 1e-3).all(), \
+                "native placements do not replay feasibly"
+        best = float("inf")
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            r2 = gang_allocate_native(*sa.args, weights)
+            best = min(best, (time.perf_counter() - t0) * 1000.0)
+        out.append({"config": 5,
+                    "desc": f"{n_tasks // 1000}k x {n_nodes // 1000}k "
+                            "rack-affinity kernel (native C++, the "
+                            "off-TPU production path)",
+                    "value_ms": round(best, 2),
+                    "platform": _platform()})
+
     if sharded_devices and len(jax.devices()) >= sharded_devices:
         from jax.sharding import Mesh
 
@@ -295,7 +337,7 @@ def full_cycle_50k(n_tasks=50_000, n_nodes=10_000) -> Dict:
     return {"config": "full_cycle",
             "desc": f"end-to-end runOnce {n_tasks // 1000}k tasks x "
                     f"{n_nodes // 1000}k nodes (snapshot+encode+place+"
-                    "commit; min of 2 warm runs; async bind flush "
+                    "commit; min of 3 warm runs; async bind flush "
                     "reported separately)",
             "value_ms": round(warm, 2),
             "steady_state_ms": round(steady, 2),
@@ -471,41 +513,66 @@ def capture_traces() -> None:
             log(f"trace capture for {name} failed ({e})")
 
 
+def machine_calibration() -> Dict:
+    """Co-tenant load fingerprint: wall time of a fixed single-core numpy
+    workload, recorded alongside the suite so readers can compare two
+    captures' machine conditions. This box is SHARED: same-day A/B ran
+    identical round-4 code at 655 ms (round-4 capture) vs 1528 ms
+    (round-5 re-run) on the preempt config — up to ~2.3x wall drift.
+    Round-5 observed range for this fingerprint: ~32-40 ms."""
+    import numpy as np
+    rng = np.random.default_rng(0)
+    a = rng.random(2_000_000)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.sort(a.copy())
+        best = min(best, (time.perf_counter() - t0) * 1000.0)
+    return {"config": "machine_calibration",
+            "desc": "fixed numpy sort (2M f64), min of 3 — compare across "
+                    "captures; round-5 observed ~32-40 ms",
+            "value_ms": round(best, 2)}
+
+
 def run_all(full_scale: bool = True) -> List[Dict]:
     import jax
 
     results: List[Dict] = []
-    for fn in (config_1, config_2, config_3):
-        log(f"running {fn.__name__}")
-        results.append(fn())
-        log(f"{fn.__name__}: {results[-1]}")
-    log("running config_4")
-    results.append(config_4() if full_scale else
-                   config_4(n_nodes=2000, n_low=250, n_high=125))
-    log(f"config_4: {results[-1]}")
-    log("running config_reclaim")
-    results.append(config_reclaim() if full_scale else
-                   config_reclaim(n_nodes=2000, n_running=250,
-                                  n_pending=125))
-    log(f"config_reclaim: {results[-1]}")
-    log("running config_5")
+
+    def run(name, fn):
+        """Per-config isolation: one failing config must not abort the
+        suite (the artifact write happens only after run_all returns)."""
+        log(f"running {name}")
+        try:
+            r = fn()
+        except Exception as e:
+            log(f"{name} FAILED: {e!r}")
+            results.append({"config": name, "error": repr(e)[:300]})
+            return
+        results.extend(r if isinstance(r, list) else [r])
+        log(f"{name}: {results[-1]}")
+
+    results.append(machine_calibration())
+    log(f"calibration: {results[-1]}")
+    run("config_1", config_1)
+    run("config_2", config_2)
+    run("config_3", config_3)
+    run("config_4", config_4 if full_scale else
+        lambda: config_4(n_nodes=2000, n_low=250, n_high=125))
+    run("config_reclaim", config_reclaim if full_scale else
+        lambda: config_reclaim(n_nodes=2000, n_running=250, n_pending=125))
     n_dev = len(jax.devices())
-    results.extend(config_5(sharded_devices=n_dev if n_dev >= 2 else None)
-                   if full_scale else
-                   config_5(5_000, 1_000,
-                            sharded_devices=n_dev if n_dev >= 2 else None))
-    log(f"config_5: {results[-1]}")
+    run("config_5", (lambda: config_5(
+        sharded_devices=n_dev if n_dev >= 2 else None)) if full_scale else
+        (lambda: config_5(5_000, 1_000,
+                          sharded_devices=n_dev if n_dev >= 2 else None)))
     if full_scale:
-        log("running full_cycle_50k")
-        results.append(full_cycle_50k())
-        log(f"full_cycle: {results[-1]}")
-        log("running churn_load")
-        results.append(churn_load())
-        log(f"churn_load: {results[-1]}")
+        run("full_cycle_50k", full_cycle_50k)
+        run("churn_load", churn_load)
     else:
-        log("running churn_load (reduced)")
-        results.append(churn_load(n_nodes=1000, resident_jobs=625,
-                                  arrival_jobs=25, cycles=10))
-        log(f"churn_load: {results[-1]}")
+        run("churn_load", lambda: churn_load(
+            n_nodes=1000, resident_jobs=625, arrival_jobs=25, cycles=10))
+    results.append(machine_calibration())   # load may drift over the run
+    log(f"calibration (end): {results[-1]}")
     capture_traces()
     return results
