@@ -1,0 +1,154 @@
+"""Tripwires for the native snapshot engine (volcano_tpu/native/
+fastmodel.c) and the GC guard: the C clone paths must stay field-for-field
+equivalent to the Python clones they accelerate — a model-field addition
+that only updates one side fails here first."""
+
+import gc
+
+import pytest
+
+from volcano_tpu.models.job_info import JobInfo, TaskInfo, TaskStatus, \
+    _fastmodel
+from volcano_tpu.models.node_info import NodeInfo
+from volcano_tpu.models.objects import (clone_pod_for_bind,
+                                        clone_pod_group_for_status)
+from volcano_tpu.utils.fastclone import fast_clone
+from volcano_tpu.utils.test_utils import build_node, build_pod, \
+    build_pod_group
+
+
+def _mk_job():
+    job = JobInfo("ns1/pg-x")
+    for i in range(4):
+        pod = build_pod("ns1", f"p{i}", "node-0" if i % 2 else "",
+                        "Running" if i % 2 else "Pending",
+                        {"cpu": "2", "memory": "4Gi"}, "pg-x")
+        job.add_task_info(TaskInfo(pod))
+    job.set_pod_group(build_pod_group("pg-x", "ns1", "default", 4))
+    return job
+
+
+def _assert_equiv(a, b, path=""):
+    """Structural equivalence for the clone comparisons: every leaf is
+    genuinely value-compared (objects without __eq__ compare via vars)."""
+    from volcano_tpu.models.resource import Resource
+    assert type(a) is type(b), (path, type(a), type(b))
+    if isinstance(a, Resource):
+        assert a.milli_cpu == b.milli_cpu and a.memory == b.memory \
+            and a.scalars == b.scalars \
+            and a.max_task_num == b.max_task_num, path
+        return
+    if isinstance(a, dict):
+        assert set(a) == set(b), (path, set(a) ^ set(b))
+        for k in a:
+            _assert_equiv(a[k], b[k], f"{path}.{k}")
+        return
+    if isinstance(a, TaskInfo):
+        for slot in TaskInfo.__slots__:
+            if slot == "pod":
+                assert getattr(a, slot) is getattr(b, slot), (path, slot)
+            else:
+                assert getattr(a, slot, None) == getattr(b, slot, None), \
+                    (path, slot)
+        return
+    if isinstance(a, (str, int, float, bool, tuple, list, type(None))):
+        assert a == b, (path, a, b)
+        return
+    if a is b:
+        return
+    # object without a useful __eq__ (e.g. DisruptionBudget, PodGroup):
+    # compare the attribute dicts recursively
+    _assert_equiv(vars(a), vars(b), f"{path}<{type(a).__name__}>")
+
+
+def test_job_clone_native_matches_python():
+    fm = _fastmodel()
+    if fm is None:
+        pytest.skip("fastmodel unavailable")
+    job = _mk_job()
+    n = job._clone_native(fm)
+    p = job._clone_python()
+    assert n is not None
+    # identical attribute sets and equivalent values — a JobInfo field
+    # added to __init__/clone without updating _clone_native fails here
+    assert set(vars(n)) == set(vars(p)), set(vars(n)) ^ set(vars(p))
+    for key in vars(p):
+        _assert_equiv(getattr(n, key), getattr(p, key), key)
+    # fresh (not shared) mutable state: mutating a cloned task must not
+    # touch the source job's task
+    assert n.tasks is not job.tasks
+    assert n.allocated is not job.allocated
+    uid = next(iter(n.tasks))
+    assert n.tasks[uid] is not job.tasks[uid]
+    before = job.tasks[uid].status
+    n.tasks[uid].status = TaskStatus.Binding
+    assert job.tasks[uid].status == before
+
+
+def test_node_clone_native_and_python_equivalent():
+    node = NodeInfo(build_node("n1", {"cpu": "8", "memory": "16Gi"}))
+    t = TaskInfo(build_pod("ns1", "p0", "n1", "Running",
+                           {"cpu": "1", "memory": "1Gi"}, "pg"))
+    node.add_task(t)
+    c = node.clone()   # takes the native path when available
+    assert set(vars(c)) == set(vars(node))
+    _assert_equiv(c.idle, node.idle, "idle")
+    _assert_equiv(c.used, node.used, "used")
+    assert c.tasks is not node.tasks and set(c.tasks) == set(node.tasks)
+    assert c.allocatable is node.allocatable   # shared by contract
+    # clone independence: accounting on the clone leaves the source alone
+    t2 = TaskInfo(build_pod("ns1", "p1", "n1", "Running",
+                            {"cpu": "1", "memory": "1Gi"}, "pg"))
+    c.add_task(t2)
+    assert "ns1/p1" in c.tasks and "ns1/p1" not in node.tasks
+    assert node.idle.milli_cpu - c.idle.milli_cpu == 1000
+
+
+def test_bind_clone_attribute_parity():
+    """clone_pod_for_bind must expose the same attribute surface as the
+    structured fast_clone (shared substructure, fresh shells), including
+    the parse-cache/intern carry-over keys on a pod that has them."""
+    pod = build_pod("ns1", "p0", "", "Pending",
+                    {"cpu": "1", "memory": "1Gi"}, "pg")
+    pod.resource_request()            # seeds _rr
+    pod._sched_group_sig = 1234       # encode-group intern id
+    a, b = clone_pod_for_bind(pod), fast_clone(pod)
+    assert set(vars(a)) == set(vars(b)), set(vars(a)) ^ set(vars(b))
+    assert a.__dict__["_rr"] is pod.__dict__["_rr"]
+    assert a.__dict__["_sched_group_sig"] == 1234
+    assert set(vars(a.metadata)) == set(vars(b.metadata))
+    assert set(vars(a.spec)) == set(vars(b.spec))
+    assert set(vars(a.status)) == set(vars(b.status))
+    a.spec.node_name = "nX"
+    a.metadata.resource_version = 99
+    assert pod.spec.node_name == "" and pod.metadata.resource_version != 99
+
+
+def test_status_clone_attribute_parity():
+    pg = build_pod_group("pg-x", "ns1", "default", 4)
+    a, b = clone_pod_group_for_status(pg), fast_clone(pg)
+    assert set(vars(a)) == set(vars(b))
+    assert a.spec is pg.spec          # shared by contract (status-only)
+    assert a.metadata is not pg.metadata
+
+
+def test_gcguard_nesting_and_foreign_disable():
+    from volcano_tpu.utils import gcguard
+    assert gc.isenabled()
+    gcguard.pause()
+    assert not gc.isenabled()
+    gcguard.pause()                       # nested
+    gcguard.resume()
+    assert not gc.isenabled()             # still held by outer
+    gcguard.resume()
+    assert gc.isenabled()                 # last release re-enables
+    gcguard.resume()                      # unbalanced: must not force-enable
+    assert gc.isenabled()
+    # a process that globally disabled GC stays disabled through the guard
+    gc.disable()
+    try:
+        gcguard.pause()
+        gcguard.resume()
+        assert not gc.isenabled()
+    finally:
+        gc.enable()
