@@ -2,7 +2,8 @@
 
 PYTHON ?= python
 
-.PHONY: test unit-test e2e bench bench-all multichip-dryrun deploy deploy-up
+.PHONY: test unit-test e2e bench bench-all multichip-dryrun deploy deploy-up \
+	trace-smoke
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -33,6 +34,14 @@ bench:
 # the five BASELINE.md configs + full-cycle runOnce -> BENCH_DETAILS.json
 bench-all:
 	$(PYTHON) bench.py --all
+
+# flight-recorder smoke gate: one small traced cycle, /debug/trace +
+# /debug/pending fetched over HTTP and validated against the span schema,
+# plus the <2% tracer-overhead regression check. The same tests run in
+# tier-1 (tests/test_trace.py); this target is the fast standalone gate.
+trace-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_trace.py -q \
+		-k "smoke or overhead"
 
 # multi-chip sharding dryrun on the virtual CPU mesh
 multichip-dryrun:

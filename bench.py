@@ -115,6 +115,11 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
     from volcano_tpu.bench_suite import (CONF_FULL, _cycle_env, _populate,
                                          _run_cycle)
     from volcano_tpu.metrics import metrics as m
+    from volcano_tpu.trace import tracer
+
+    # flight recorder on: the headline number carries per-phase
+    # attribution from now on (<2% overhead, tests/test_trace.py)
+    tracer.enable()
 
     devs = jax.devices()
     log(f"cycle worker backend: {devs[0].platform} x{len(devs)}")
@@ -135,6 +140,7 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
     del store, cache, binder
 
     best = None
+    best_rec = None
     runs = 3   # min-of-3: single wall numbers on this shared machine
     #            carry ±15-25% co-tenant noise
     for i in range(runs):
@@ -142,6 +148,7 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
         _populate(s2, **pop)
         k0 = kernel_total()
         ms = _run_cycle(c2, cf2)
+        rec = tracer.last_record()
         kernel_ms = kernel_total() - k0
         t0 = time.perf_counter()
         c2.flush_executors(timeout=900)
@@ -155,7 +162,17 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
                     "bind_flush_ms": flush_ms, "steady_state_ms": steady,
                     "binds": len(b2.binds),
                     "platform": devs[0].platform}
+            best_rec = rec
         del s2, c2, b2
+    if best_rec is not None:
+        best["phases"] = tracer.flat_phases(best_rec)
+        best["trace_coverage"] = tracer.summary(best_rec)["coverage"]
+        if os.environ.get("VOLCANO_BENCH_DUMP_TRACE"):
+            path = os.path.join(os.getcwd(),
+                                f"trace_cycle_{n_tasks}x{n_nodes}.json")
+            with open(path, "w") as f:
+                json.dump(tracer.chrome_trace(best_rec), f)
+            log(f"chrome trace of winning cycle: {path}")
     print(json.dumps(best))
 
 
@@ -317,6 +334,12 @@ def main() -> None:
         log("bench --all failed on every platform")
         sys.exit(1)
 
+    # --trace: the cycle workers additionally dump the winning cycle's
+    # Chrome trace-event JSON (trace_cycle_<T>x<N>.json, Perfetto-loadable);
+    # the per-phase breakdown is in the output JSON either way
+    if "--trace" in sys.argv:
+        os.environ["VOLCANO_BENCH_DUMP_TRACE"] = "1"
+
     # HEADLINE ladder: the full runOnce (scope=full_cycle) — TPU first,
     # CPU fallback; shrink the shape only after every platform failed on
     # the larger one. A global deadline and the pre-probe keep the ladder
@@ -359,6 +382,10 @@ def main() -> None:
                 "bind_flush_ms": round(
                     float(res.get("bind_flush_ms", 0.0)), 2),
                 "binds": res.get("binds"),
+                # per-phase attribution from the flight recorder
+                # (volcano_tpu/trace): '/'-joined span paths -> {ms, count}
+                "phases": res.get("phases"),
+                "trace_coverage": res.get("trace_coverage"),
             }))
             return
 

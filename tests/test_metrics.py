@@ -1,0 +1,152 @@
+"""Metrics module coverage: full histogram exposition (cumulative
+``_bucket{le=...}`` lines), label escaping, snapshot/reset thread-safety
+under concurrent writers, and an end-to-end scrape of the metrics server
+returning parseable exposition text."""
+
+import re
+import threading
+import urllib.request
+
+import pytest
+
+from volcano_tpu.metrics import metrics as m
+from volcano_tpu.metrics.server import MetricsServer
+
+# one exposition line: name{labels} value  (labels optional)
+LINE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'               # metric name
+    r'(\{([a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\\n])*",?)*\})?'  # labels
+    r' -?[0-9.e+\-]+(\n|$)')
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    m.reset()
+    yield
+    m.reset()
+
+
+def _lines(body=None):
+    body = body if body is not None else m.render_prometheus()
+    return [ln for ln in body.splitlines() if ln]
+
+
+class TestHistogramExposition:
+    def test_bucket_lines_cumulative_with_inf(self):
+        name = "volcano_test_latency"
+        # one observation per bucket boundary (v <= bound lands in it),
+        # plus one past the last bound (the overflow bucket)
+        for v in m._Hist.BOUNDS:
+            m.observe(name, v)
+        m.observe(name, m._Hist.BOUNDS[-1] * 10)
+        out = {}
+        for ln in _lines():
+            if ln.startswith(f"{name}_bucket"):
+                le = re.search(r'le="([^"]+)"', ln).group(1)
+                out[le] = float(ln.rsplit(" ", 1)[1])
+        # cumulative: bucket i holds i+1 observations
+        for i, bound in enumerate(m._Hist.BOUNDS):
+            assert out[f"{bound:g}"] == i + 1, (bound, out)
+        assert out["+Inf"] == len(m._Hist.BOUNDS) + 1
+        body = m.render_prometheus()
+        assert f"{name}_count 13" in body
+
+    def test_bucket_boundary_is_inclusive(self):
+        m.observe("volcano_edge", 0.001)   # exactly on a bound -> le bucket
+        body = m.render_prometheus()
+        assert 'volcano_edge_bucket{le="0.001"} 1' in body
+        assert 'volcano_edge_bucket{le="0.0001"} 0' in body
+
+    def test_buckets_carry_existing_labels(self):
+        m.observe("volcano_lbl", 5.0, queue="q1")
+        body = m.render_prometheus()
+        assert 'volcano_lbl_bucket{queue="q1",le="10"} 1' in body
+        assert 'volcano_lbl_count{queue="q1"} 1' in body
+
+    def test_every_line_parses(self):
+        m.observe("volcano_h", 0.5, queue="a")
+        m.set_gauge("volcano_g", 1.25, node="n1")
+        m.inc("volcano_c", 2.0)
+        for ln in _lines():
+            assert LINE_RE.match(ln), ln
+
+
+class TestLabelEscaping:
+    def test_quote_backslash_newline(self):
+        m.set_gauge("volcano_esc", 1.0, queue='he said "hi"\\\n')
+        (ln,) = _lines()
+        assert ln == 'volcano_esc{queue="he said \\"hi\\"\\\\\\n"} 1.0'
+        # exposition stays one line per sample
+        assert len(m.render_prometheus().strip().splitlines()) == 1
+        assert LINE_RE.match(ln), ln
+
+    def test_escaping_applies_to_histogram_and_counter_labels(self):
+        m.observe("volcano_esc_h", 1.0, job='a"b')
+        m.inc("volcano_esc_c", job="x\ny")
+        body = m.render_prometheus()
+        assert '\\"' in body and "\\n" in body
+        assert "\n".join(_lines(body)) == body.strip()
+
+
+class TestThreadSafety:
+    def test_concurrent_observe_inc_vs_snapshot_reset(self):
+        stop = threading.Event()
+        errors = []
+
+        def writer(i):
+            try:
+                while not stop.is_set():
+                    m.observe("volcano_ts_h", 0.5 * i, worker=str(i % 3))
+                    m.inc("volcano_ts_c", worker=str(i % 3))
+                    m.set_gauge("volcano_ts_g", i)
+            except Exception as e:   # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(200):
+                snap = m.snapshot()
+                assert isinstance(snap["histograms"], dict)
+                m.render_prometheus()
+                m.reset()
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert not errors
+        # post-reset state still consistent: counts match bucket sums
+        m.reset()
+        m.observe("volcano_ts_h", 1.0)
+        with m._lock:
+            (h,) = [h for (n, _), h in m._histograms.items()
+                    if n == "volcano_ts_h"]
+            assert sum(h.buckets) == h.count == 1
+
+
+class TestEndToEndScrape:
+    def test_server_returns_parseable_exposition(self):
+        m.update_e2e_duration(0.5)
+        m.observe(m.PLUGIN_LATENCY, 120.0, plugin="gang",
+                  OnSession="OnSessionOpen")
+        m.inc(m.UNSCHEDULABLE_REASON, 3.0,
+              reason='node(s) had taints that the pod didn\'t tolerate')
+        server = MetricsServer(port=0)
+        server.start()
+        try:
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=5)
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        finally:
+            server.stop()
+        lines = _lines(body)
+        assert lines and body.endswith("\n")
+        for ln in lines:
+            assert LINE_RE.match(ln), ln
+        assert any(ln.startswith(
+            "volcano_e2e_scheduling_latency_milliseconds_bucket{")
+            for ln in lines)
+        assert "volcano_unschedulable_reason_total" in body

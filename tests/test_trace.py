@@ -1,0 +1,322 @@
+"""Flight-recorder tests: span trees, ring buffer, Chrome export schema,
+/debug/* endpoints over HTTP, the "why pending" diagnosis, and the
+tracer-overhead regression gate (`make trace-smoke` runs the smoke +
+overhead subset)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from volcano_tpu.apiserver import ObjectStore
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.metrics import metrics as m
+from volcano_tpu.metrics.server import MetricsServer
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.trace import pending, tracer
+from volcano_tpu.utils.test_utils import (FakeBinder, FakeEvictor, build_node,
+                                          build_pod, build_pod_group,
+                                          build_queue)
+
+CONF = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    tracer.reset()
+    tracer.set_budgets({})
+    yield
+    tracer.disable()
+    tracer.reset()
+    tracer.set_budgets({})
+
+
+def _env(n_nodes=4, n_gangs=2, gang=3):
+    store = ObjectStore()
+    binder = FakeBinder(store)
+    cache = SchedulerCache(store, binder=binder, evictor=FakeEvictor(store))
+    cache.run()
+    sched = Scheduler(store, scheduler_conf=CONF, cache=cache)
+    store.create("queues", build_queue("default", weight=1))
+    for i in range(n_nodes):
+        store.create("nodes", build_node(f"n{i}", {"cpu": "8",
+                                                   "memory": "16Gi"}))
+    for j in range(n_gangs):
+        store.create("podgroups", build_pod_group(
+            f"pg-{j}", "default", "default", gang, phase="Inqueue"))
+        for t in range(gang):
+            store.create("pods", build_pod(
+                "default", f"pg-{j}-{t}", "", "Pending",
+                {"cpu": "1", "memory": "1Gi"}, groupname=f"pg-{j}"))
+    return store, cache, binder, sched
+
+
+# -- tracer core -------------------------------------------------------------
+
+
+def test_span_tree_and_ring():
+    tracer.enable(capacity=4)
+    with tracer.cycle(source="test"):
+        with tracer.span("open_session"):
+            with tracer.span("snapshot"):
+                pass
+        with tracer.span("action:allocate"):
+            tracer.add_tags(placed=7)
+            tracer.tag_cycle(binds=7)
+    rec = tracer.last_record()
+    assert rec is not None
+    root = rec.root
+    assert root.name == "cycle" and root.dur > 0
+    assert [c.name for c in root.children] == ["open_session",
+                                               "action:allocate"]
+    assert root.children[0].children[0].name == "snapshot"
+    assert root.children[1].tags == {"placed": 7}
+    assert root.tags == {"source": "test", "binds": 7}
+    # nested spans never outlive their parent
+    assert root.children[0].dur <= root.dur
+
+
+def test_ring_buffer_capacity_and_seq():
+    tracer.enable(capacity=2)
+    for _ in range(3):
+        with tracer.cycle():
+            pass
+    recs = tracer.records()
+    assert len(recs) == 2
+    assert recs[0].seq + 1 == recs[1].seq
+    assert tracer.get_record(recs[0].seq) is recs[0]
+    assert tracer.get_record(recs[1].seq - 10) is None
+
+
+def test_disabled_tracer_records_nothing():
+    with tracer.cycle():
+        with tracer.span("x"):
+            pass
+    assert tracer.last_record() is None
+    # span outside any cycle is a no-op even when enabled
+    tracer.enable()
+    with tracer.span("orphan"):
+        pass
+    assert tracer.last_record() is None
+
+
+def test_chrome_trace_schema_and_validator():
+    tracer.enable()
+    with tracer.cycle():
+        with tracer.span("open_session", plugin="gang"):
+            pass
+    ct = tracer.chrome_trace(tracer.last_record())
+    tracer.validate_chrome_trace(ct)   # must not raise
+    names = [e["name"] for e in ct["traceEvents"]]
+    assert names[0] == "cycle" and "open_session" in names
+    # events are complete-events with µs timestamps relative to the root
+    assert all(e["ph"] == "X" and e["ts"] >= 0 for e in ct["traceEvents"])
+    with pytest.raises(ValueError):
+        tracer.validate_chrome_trace({"traceEvents": []})
+    with pytest.raises(ValueError):
+        tracer.validate_chrome_trace(
+            {"traceEvents": [{"name": "x", "ph": "B", "ts": 0, "dur": 0,
+                              "pid": 1, "tid": 1}]})
+
+
+def test_budget_flags_and_metric():
+    m.reset()
+    tracer.enable()
+    tracer.set_budgets({"cycle": 0.0, "nap": 0.0})
+    with tracer.cycle():
+        with tracer.span("nap"):
+            pass
+    s = tracer.summary(tracer.last_record())
+    assert "cycle" in s["over_budget"] and "nap" in s["over_budget"]
+    counters = m.snapshot()["counters"]
+    assert any(name == f"{m.NS}_trace_phase_over_budget_total"
+               for (name, _), _ in counters.items())
+
+
+# -- real cycles -------------------------------------------------------------
+
+
+def test_smoke_traced_cycle_and_debug_endpoints():
+    """`make trace-smoke`: one small traced cycle through the REAL
+    scheduler, /debug/trace + /debug/cycles + /debug/pending fetched over
+    HTTP, the trace validated against the span schema, and the pending
+    surface reporting correct per-reason counts for a synthetically
+    unschedulable job."""
+    m.reset()
+    tracer.enable()
+    store, cache, binder, sched = _env()
+    # synthetically unschedulable: no node has 64 cpus
+    store.create("podgroups", build_pod_group(
+        "stuck", "default", "default", 2, phase="Inqueue"))
+    for t in range(2):
+        store.create("pods", build_pod(
+            "default", f"stuck-{t}", "", "Pending",
+            {"cpu": "64", "memory": "1Gi"}, groupname="stuck"))
+    sched.run_once()
+    cache.flush_executors()
+    assert len(binder.binds) == 6   # both real gangs bound
+
+    server = MetricsServer(port=0)
+    server.start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+
+        def get(path):
+            return json.loads(urllib.request.urlopen(
+                base + path, timeout=5).read().decode())
+
+        trace_json = get("/debug/trace")
+        tracer.validate_chrome_trace(trace_json)
+        names = {e["name"] for e in trace_json["traceEvents"]}
+        assert {"cycle", "open_session", "snapshot", "plugin_open",
+                "action:enqueue", "action:allocate", "solver.place",
+                "build_context", "kernel", "close_session",
+                "job_updater"} <= names
+
+        cycles = get("/debug/cycles")
+        assert cycles["enabled"] and len(cycles["cycles"]) == 1
+        summary = cycles["cycles"][0]
+        assert summary["cycle_ms"] > 0
+        assert summary["tags"]["committed_tasks"] == 6
+        assert get(f"/debug/trace?seq={summary['seq']}")["otherData"][
+            "cycle_seq"] == summary["seq"]
+
+        pend = get("/debug/pending")
+        # joinable against /debug/trace?seq= on the same field
+        assert pend["cycle_seq"] == summary["seq"]
+        assert pend["pending_jobs"] == 1
+        job = pend["jobs"]["default/stuck"]
+        assert job["pending_tasks"] == 2
+        assert job["reasons"] == {pending.REASON_SOLVER_MASKED: 2}
+        assert pend["reasons"][pending.REASON_SOLVER_MASKED] == 2
+
+        # prometheus export of the same counts
+        body = urllib.request.urlopen(
+            base + "/metrics", timeout=5).read().decode()
+        assert 'volcano_unschedulable_reason_total{reason=' \
+            '"predicates failed or insufficient resources"} 2.0' in body
+    finally:
+        server.stop()
+
+
+def test_trace_coverage_of_cycle_wall_time():
+    """Spans must attribute (nearly) all of the measured cycle: no large
+    unattributed gaps (the acceptance bar is >=95% at bench scale; small
+    cycles amortize fixed gaps less, so gate at 90% here)."""
+    tracer.enable()
+    _, cache, _, sched = _env()
+    sched.run_once()      # compile cycle
+    # best-of-3: a co-tenant stall inside a ~10 ms cycle but outside any
+    # span (e.g. a lock wait) can dent one record's coverage
+    best = {"coverage": 0.0}
+    for _ in range(3):
+        sched.run_once()
+        s = tracer.summary(tracer.last_record())
+        if s["coverage"] > best["coverage"]:
+            best = s
+        if best["coverage"] >= 0.90:
+            break
+    assert best["coverage"] >= 0.90, best
+    assert best["spans"] >= 15
+
+
+def test_pending_report_empty_when_all_ready():
+    tracer.enable()
+    _, cache, _, sched = _env()
+    sched.run_once()
+    cache.flush_executors()
+    sched.run_once()
+    rep = tracer.pending_report()
+    assert rep["pending_jobs"] == 0 and rep["reasons"] == {}
+
+
+def test_awaiting_enqueue_counts_unready_not_zero():
+    """A Pending-phase PodGroup has no pods yet (pod creation is gated on
+    enqueue), so its diagnosis must count the min_available shortfall,
+    not the zero Pending-status tasks."""
+    tracer.enable()
+    store = ObjectStore()
+    binder = FakeBinder(store)
+    cache = SchedulerCache(store, binder=binder, evictor=FakeEvictor(store))
+    cache.run()
+    sched = Scheduler(store, scheduler_conf=CONF.replace(
+        "enqueue, allocate", "allocate"), cache=cache)
+    store.create("queues", build_queue("default", weight=1))
+    store.create("nodes", build_node("n0", {"cpu": "8", "memory": "16Gi"}))
+    store.create("podgroups", build_pod_group(
+        "waiting", "default", "default", 4))   # Pending phase, no pods
+    sched.run_once()
+    rep = tracer.pending_report()
+    assert rep["jobs"]["default/waiting"]["reasons"] == \
+        {pending.REASON_AWAITING_ENQUEUE: 4}
+    assert rep["reasons"][pending.REASON_AWAITING_ENQUEUE] == 4
+
+
+def test_bind_flush_async_spans_recorded():
+    tracer.enable()
+    _, cache, _, sched = _env()
+    sched.run_once()
+    cache.flush_executors()
+    rec = tracer.last_record()
+    flushes = tracer._async_spans_for(rec.seq)
+    assert any(s.name == "bind_flush.store" for s in flushes)
+    n_binds = sum((s.tags or {}).get("binds", 0) for s in flushes
+                  if s.name == "bind_flush.store")
+    assert n_binds == 6
+    # and they ride tid 2 of the chrome export
+    ct = tracer.chrome_trace(rec)
+    assert any(e["tid"] == 2 and e["name"] == "bind_flush.store"
+               for e in ct["traceEvents"])
+
+
+def test_tracer_overhead_under_two_percent():
+    """The flight recorder must be cheap enough to leave on: steady-state
+    cycles with tracing on vs off, interleaved min-of-N (min cancels
+    co-tenant noise; the 0.3 ms epsilon is the timer floor at this tiny
+    scale — at the bench scale's ~170 ms steady cycle the same span count
+    is far below 2%)."""
+    import time
+
+    _, cache, _, sched = _env(n_nodes=16, n_gangs=8)
+    sched.run_once()            # compile + place
+    cache.flush_executors()
+    for _ in range(3):          # settle: binds echoed, nothing pending
+        sched.run_once()
+
+    def steady(n=12):
+        best = float("inf")
+        for _ in range(n):
+            t0 = time.perf_counter()
+            sched.run_once()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    steady(3)                   # warm both code paths
+
+    def measure():
+        base = traced = float("inf")
+        for _ in range(4):      # interleave to cancel machine drift
+            tracer.disable()
+            base = min(base, steady())
+            tracer.enable()
+            traced = min(traced, steady())
+        return base, traced
+
+    for _ in range(3):          # flake shield vs co-tenant bursts
+        base, traced = measure()
+        if traced <= base * 1.02 + 3e-4:
+            break
+    assert traced <= base * 1.02 + 3e-4, \
+        f"tracing on {traced * 1e3:.2f} ms vs off {base * 1e3:.2f} ms"

@@ -1,9 +1,11 @@
 """Phase-level timing of one full runOnce at bench scale (CPU by default).
 
-Instruments the production cycle path with perf_counter wrappers (snapshot,
-plugin opens, solver context build, kernel, staging, finalize, close, bind
-flush) and prints a phase table — the measurement harness behind
-docs/design/perf.md's budget rows.
+Historical note: this tool used to monkeypatch the live code paths with
+perf_counter wrappers from the outside. The production cycle now records
+itself through the flight recorder (volcano_tpu/trace): every phase below
+comes from the REAL spans the scheduler emits — the same data `/debug/trace`
+serves in production — so the table here is exactly what a Perfetto load of
+the trace shows.
 
 Usage:  JAX_PLATFORMS=cpu python tools/phase_timer.py [n_tasks] [n_nodes]
 """
@@ -20,37 +22,18 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
     import jax
     jax.config.update("jax_platforms", "cpu")   # beat sitecustomize pin
 
-TIMES: dict = {}
-COUNTS: dict = {}
-
-
-def wrap(obj, name: str, label: str) -> None:
-    orig = getattr(obj, name)
-
-    def timed(*a, **k):
-        t0 = time.perf_counter()
-        try:
-            return orig(*a, **k)
-        finally:
-            dt = time.perf_counter() - t0
-            TIMES[label] = TIMES.get(label, 0.0) + dt
-            COUNTS[label] = COUNTS.get(label, 0) + 1
-    setattr(obj, name, timed)
-
 
 def main() -> None:
     n_tasks = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
     n_nodes = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000
 
-    import volcano_tpu.framework as fw   # bench resolves these lazily
     from volcano_tpu import bench_suite as bs
-    from volcano_tpu.actions.allocate import AllocateAction
-    from volcano_tpu.actions.enqueue import EnqueueAction
-    from volcano_tpu.cache.cache import SchedulerCache
-    from volcano_tpu.framework.solver import BatchSolver
+    from volcano_tpu.trace import tracer
 
     def log(msg):
         print(f"[phase] {msg}", file=sys.stderr, flush=True)
+
+    tracer.enable()
 
     # cold env: compile
     log(f"building cold env {n_tasks}x{n_nodes}")
@@ -61,50 +44,34 @@ def main() -> None:
     cache.flush_executors(timeout=600.0)
     del store, cache, binder
 
-    # instrument
-    wrap(SchedulerCache, "snapshot", "snapshot")
-    wrap(BatchSolver, "_build_context", "build_context")
-    wrap(BatchSolver, "place", "place_total")
-    wrap(AllocateAction, "_ordered_jobs", "ordered_jobs")
-    wrap(AllocateAction, "_stage", "stage")
-    wrap(AllocateAction, "_finalize", "finalize")
-    wrap(fw, "open_session", "open_session")
-    wrap(fw, "close_session", "close_session")
-    wrap(EnqueueAction, "execute", "enqueue_action")
-
     log(f"building measured env {n_tasks}x{n_nodes}")
     store, cache, binder, conf = bs._cycle_env(bs.CONF_FULL)
     bs._populate(store, n_nodes=n_nodes, n_jobs=n_tasks // 8, gang=8)
     log("measured cycle")
     ms = bs._run_cycle(cache, conf)
+    rec = tracer.last_record()
     t0 = time.perf_counter()
     cache.flush_executors(timeout=600.0)
     flush_ms = (time.perf_counter() - t0) * 1000.0
 
-    kernel = TIMES.get("place_total", 0.0) - TIMES.get("build_context", 0.0)
-    opens = TIMES.get("open_session", 0.0) - TIMES.get("snapshot", 0.0)
+    phases = tracer.flat_phases(rec)
+    summary = tracer.summary(rec)
     print(f"\n=== phase table ({n_tasks}x{n_nodes}, "
           f"binds={len(binder.binds)}) ===")
-    rows = [
-        ("full runOnce", ms),
-        ("  open_session", TIMES.get("open_session", 0.0) * 1000),
-        ("    snapshot", TIMES.get("snapshot", 0.0) * 1000),
-        ("    plugin opens + valid", opens * 1000),
-        ("  enqueue action", TIMES.get("enqueue_action", 0.0) * 1000),
-        ("  ordered_jobs", TIMES.get("ordered_jobs", 0.0) * 1000),
-        ("  place (kernel+context)", TIMES.get("place_total", 0.0) * 1000),
-        ("    build_context (encode)", TIMES.get("build_context", 0.0) * 1000),
-        ("    kernel+decode", kernel * 1000),
-        ("  stage", TIMES.get("stage", 0.0) * 1000),
-        ("  finalize", TIMES.get("finalize", 0.0) * 1000),
-        ("  close_session", TIMES.get("close_session", 0.0) * 1000),
-        ("bind flush (background)", flush_ms),
-    ]
-    for label, v in rows:
-        print(f"{label:<30} {v:>10.1f} ms")
+    print(f"{'full runOnce':<46} {ms:>10.1f} ms")
+    for path in sorted(phases):
+        depth = path.count("/")
+        label = "  " * (depth + 1) + path.rsplit("/", 1)[-1]
+        e = phases[path]
+        count = f" x{e['count']}" if e["count"] > 1 else ""
+        print(f"{label + count:<46} {e['ms']:>10.1f} ms")
+    print(f"{'bind flush (background)':<46} {flush_ms:>10.1f} ms")
+    print(f"span coverage of cycle wall time: "
+          f"{summary['coverage'] * 100:.1f}%  "
+          f"(tags: {summary['tags']})")
     # steady-state cycle after flush
     steady = min(bs._run_cycle(cache, conf) for _ in range(2))
-    print(f"{'steady-state runOnce':<30} {steady:>10.1f} ms")
+    print(f"{'steady-state runOnce':<46} {steady:>10.1f} ms")
 
 
 if __name__ == "__main__":

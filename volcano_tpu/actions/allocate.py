@@ -28,6 +28,7 @@ from ..framework.statement import Statement
 from ..metrics import metrics as m
 from ..models.job_info import JobInfo, TaskInfo, TaskStatus
 from ..models.objects import PodGroupPhase
+from ..trace import tracer as trace
 
 
 class AllocateAction(Action):
@@ -142,7 +143,8 @@ class AllocateAction(Action):
             self._execute_inner(ssn)
 
     def _execute_inner(self, ssn) -> None:
-        ordered_jobs = self._ordered_jobs(ssn)
+        with trace.span("ordered_jobs"):
+            ordered_jobs = self._ordered_jobs(ssn)
         if not ordered_jobs:
             return
 
@@ -158,6 +160,7 @@ class AllocateAction(Action):
 
         if not phase_a:
             return
+        trace.tag_cycle(tasks_considered=sum(len(t) for t in pending.values()))
 
         result_a = ssn.solver.place([(j, t) for j, t in phase_a],
                                     allow_pipeline=True)
@@ -174,13 +177,16 @@ class AllocateAction(Action):
 
         # phase A's claims must be visible to phase B's solver run;
         # stage them in session state first, then place surplus
-        staged = self._stage(ssn, phase_a, result_a)
+        with trace.span("stage", jobs=len(phase_a)):
+            staged = self._stage(ssn, phase_a, result_a)
         if phase_b:
             result_b = ssn.solver.place(
                 [(shadow, ts) for _, shadow, ts in phase_b],
                 allow_pipeline=True)
-            self._apply_extra(ssn, staged, result_b, phase_b)
-        self._finalize(ssn, phase_a, result_a, staged)
+            with trace.span("apply_extra", jobs=len(phase_b)):
+                self._apply_extra(ssn, staged, result_b, phase_b)
+        with trace.span("finalize", jobs=len(staged)):
+            self._finalize(ssn, phase_a, result_a, staged)
 
     # -- session application ----------------------------------------------
 
@@ -422,18 +428,26 @@ class AllocateAction(Action):
 
     def _finalize(self, ssn, phase_a, result_a, staged) -> None:
         """JobReady -> Commit; JobPipelined -> keep; else Discard."""
+        committed = pipelined = discarded = binds = 0
         for job, _ in phase_a:
             stmt = staged.get(job.uid)
             if stmt is None:
                 continue
             if ssn.job_ready(job):
+                binds += sum(len(getattr(op, "items", ())) or 1
+                             for op in stmt.operations)
                 stmt.commit()
+                committed += 1
                 m.register_schedule_attempt("scheduled")
             elif ssn.job_pipelined(job):
-                pass  # keep claims in session state
+                pipelined += 1  # keep claims in session state
             else:
                 stmt.discard()
+                discarded += 1
                 m.register_schedule_attempt("unschedulable")
+        trace.add_tags(committed=committed, pipelined=pipelined,
+                       discarded=discarded)
+        trace.tag_cycle(committed_tasks=binds)
 
 
 class _ZeroMinJob:

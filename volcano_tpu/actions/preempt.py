@@ -27,6 +27,7 @@ from ..framework.victims import INTER_JOB, INTRA_JOB, PreemptContext
 from ..metrics import metrics as m
 from ..models.job_info import JobInfo, TaskInfo, TaskStatus
 from ..models.objects import PodGroupPhase
+from ..trace import tracer as trace
 
 
 class PreemptAction(Action):
@@ -74,9 +75,10 @@ class PreemptAction(Action):
             return
 
         # one batched encode for ALL preemptor tasks of the action
-        ctx = PreemptContext(
-            ssn, [(job, list(preemptor_tasks[job.uid]))
-                  for job in under_request if preemptor_tasks.get(job.uid)])
+        with trace.span("preempt.encode", preemptors=len(under_request)):
+            ctx = PreemptContext(
+                ssn, [(job, list(preemptor_tasks[job.uid]))
+                      for job in under_request if preemptor_tasks.get(job.uid)])
 
         job_key = functools.cmp_to_key(
             lambda a, b: -1 if ssn.job_order_fn(a, b) else 1)
@@ -129,6 +131,8 @@ class PreemptAction(Action):
                     break
 
         self._victim_tasks(ssn)
+        trace.add_tags(attempts=stats["attempts"],
+                       victims=max(0, stats["last_victims"]))
 
     # ------------------------------------------------------------------
 

@@ -80,6 +80,7 @@ def _run_cycle(cache, conf) -> float:
     import gc
 
     from volcano_tpu.framework import close_session, get_action, open_session
+    from volcano_tpu.trace import tracer as tr
     from volcano_tpu.utils import gcguard
 
     gc.collect()
@@ -87,18 +88,20 @@ def _run_cycle(cache, conf) -> float:
     gcguard.pause()   # nest-safe vs the cache executor's own GC pause
     try:
         t0 = time.perf_counter()
-        cache.begin_cycle()
-        try:
-            ssn = open_session(cache, conf.tiers, conf.configurations)
+        with tr.cycle():   # flight recorder (no-op unless tracer.enable())
+            cache.begin_cycle()
             try:
-                for name in conf.actions:
-                    action = get_action(name)
-                    if action is not None:
-                        action.execute(ssn)
+                ssn = open_session(cache, conf.tiers, conf.configurations)
+                try:
+                    for name in conf.actions:
+                        action = get_action(name)
+                        if action is not None:
+                            with tr.span(f"action:{name}", action=name):
+                                action.execute(ssn)
+                finally:
+                    close_session(ssn)
             finally:
-                close_session(ssn)
-        finally:
-            cache.end_cycle()
+                cache.end_cycle()
         return (time.perf_counter() - t0) * 1000.0
     finally:
         gcguard.resume()
@@ -121,7 +124,11 @@ def _warm_cycle(conf_text: str, runs: int = 3, flush_timeout: float = 120.0,
     the min of ``runs`` warm measurements — single-shot wall numbers on a
     shared machine carry +-25% co-tenant noise (same protocol as
     bench.py's cycle_worker). Returns
-    (ms, flush_ms, binder, cache, conf) of the winning env."""
+    (ms, flush_ms, binder, cache, conf, trace_record) of the winning env
+    (trace_record is the flight-recorder CycleRecord of the winning cycle,
+    None unless tracing is enabled)."""
+    from volcano_tpu.trace import tracer as tr
+
     store, cache, binder, conf = _cycle_env(conf_text)
     _populate(store, **populate_kwargs)
     _run_cycle(cache, conf)                # includes compile
@@ -130,24 +137,25 @@ def _warm_cycle(conf_text: str, runs: int = 3, flush_timeout: float = 120.0,
     #                                        measured runs (3 concurrent
     #                                        50k-task envs swap-pressure
     #                                        the very cycle being timed)
-    best = (float("inf"), 0.0, None, None, None)
+    best = (float("inf"), 0.0, None, None, None, None)
     for _ in range(runs):
         store2, cache2, binder2, conf2 = _cycle_env(conf_text)
         _populate(store2, **populate_kwargs)
         ms = _run_cycle(cache2, conf2)
+        rec = tr.last_record() if tr.is_enabled() else None
         t0 = time.perf_counter()
         cache2.flush_executors(timeout=flush_timeout)
         flush_ms = (time.perf_counter() - t0) * 1000.0
         if ms < best[0]:
-            best = (ms, flush_ms, binder2, cache2, conf2)
+            best = (ms, flush_ms, binder2, cache2, conf2, rec)
     return best
 
 
 def config_1() -> Dict:
     """Single gang-of-3 PodGroup (example/job.yaml shape), full cycle."""
-    ms, _, binder, _, _ = _warm_cycle(CONF_FULL, n_nodes=4, n_jobs=1,
-                                      gang=3, node_cpu="8",
-                                      node_mem="16Gi")
+    ms, _, binder, _, _, _ = _warm_cycle(CONF_FULL, n_nodes=4,
+                                         n_jobs=1, gang=3, node_cpu="8",
+                                         node_mem="16Gi")
     assert len(binder.binds) == 3, binder.binds
     return {"config": 1, "desc": "single gang-of-3 PodGroup, full cycle",
             "value_ms": round(ms, 2), "binds": len(binder.binds),
@@ -156,8 +164,8 @@ def config_1() -> Dict:
 
 def config_2() -> Dict:
     """1k tasks x 100 nodes, predicates + binpack, full cycle."""
-    ms, _, binder, _, _ = _warm_cycle(CONF_FULL, n_nodes=100,
-                                      n_jobs=125, gang=8)
+    ms, _, binder, _, _, _ = _warm_cycle(CONF_FULL, n_nodes=100,
+                                         n_jobs=125, gang=8)
     return {"config": 2, "desc": "1k tasks x 100 nodes full cycle",
             "value_ms": round(ms, 2), "binds": len(binder.binds),
             "platform": _platform()}
@@ -166,8 +174,8 @@ def config_2() -> Dict:
 def config_3() -> Dict:
     """DRF multi-queue fair share: 4 queues, 5k tasks, full cycle."""
     queues = [(f"q{i}", w) for i, w in enumerate([1, 2, 3, 4])]
-    ms, _, binder, _, _ = _warm_cycle(CONF_FULL, n_nodes=1000, n_jobs=625,
-                                      gang=8, queues=queues)
+    ms, _, binder, _, _, _ = _warm_cycle(CONF_FULL, n_nodes=1000,
+                                         n_jobs=625, gang=8, queues=queues)
     return {"config": 3,
             "desc": "drf 4-queue fair share, 5k tasks x 1k nodes full cycle",
             "value_ms": round(ms, 2), "binds": len(binder.binds),
@@ -325,25 +333,32 @@ def config_5(n_tasks=50_000, n_nodes=10_000, runs=3,
 
 def full_cycle_50k(n_tasks=50_000, n_nodes=10_000) -> Dict:
     """End-to-end runOnce at 50k x 10k through the store-backed cache."""
+    from volcano_tpu.trace import tracer as tr
+
+    tr.enable()   # BENCH rows carry per-phase attribution from now on
     log(f"building {n_tasks}x{n_nodes} cluster through the store "
         "(this takes a while)")
-    warm, flush_ms, binder2, cache2, conf2 = _warm_cycle(
+    warm, flush_ms, binder2, cache2, conf2, rec = _warm_cycle(
         CONF_FULL, flush_timeout=600.0,
         n_nodes=n_nodes, n_jobs=n_tasks // 8, gang=8)
     # the steady-state duty cycle: everything bound, nothing pending —
     # what the scheduler runs every period between arrivals (on the
     # winning env, whose flush completed)
     steady = min(_run_cycle(cache2, conf2) for _ in range(2))
-    return {"config": "full_cycle",
-            "desc": f"end-to-end runOnce {n_tasks // 1000}k tasks x "
-                    f"{n_nodes // 1000}k nodes (snapshot+encode+place+"
-                    "commit; min of 3 warm runs; async bind flush "
-                    "reported separately)",
-            "value_ms": round(warm, 2),
-            "steady_state_ms": round(steady, 2),
-            "bind_flush_ms": round(flush_ms, 2),
-            "binds": len(binder2.binds),
-            "platform": _platform()}
+    out = {"config": "full_cycle",
+           "desc": f"end-to-end runOnce {n_tasks // 1000}k tasks x "
+                   f"{n_nodes // 1000}k nodes (snapshot+encode+place+"
+                   "commit; min of 3 warm runs; async bind flush "
+                   "reported separately)",
+           "value_ms": round(warm, 2),
+           "steady_state_ms": round(steady, 2),
+           "bind_flush_ms": round(flush_ms, 2),
+           "binds": len(binder2.binds),
+           "platform": _platform()}
+    if rec is not None:
+        out["phases"] = tr.flat_phases(rec)
+        out["trace_coverage"] = tr.summary(rec)["coverage"]
+    return out
 
 
 def churn_load(n_nodes=10_000, resident_jobs=6_250, gang=8,

@@ -534,11 +534,14 @@ class SchedulerCache(EventHandlersMixin):
         with self._apply_lock:
             batches, self._pending_binds = self._pending_binds, []
             self._bind_drain_queued = False
-        with self.mutex:
-            self._drain_applies_locked()
+        from ..trace import tracer
+        with tracer.async_span("bind_flush.apply"):
+            with self.mutex:
+                self._drain_applies_locked()
         bound = [x for b in batches for x in b]
         if bound:
-            self._bind_store_writes(bound)
+            with tracer.async_span("bind_flush.store", binds=len(bound)):
+                self._bind_store_writes(bound)
 
     def _bind_store_writes(self, bound) -> None:
         """One binder pass + Scheduled events for [(task, pod, hostname)];

@@ -34,6 +34,15 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--percentage-nodes-to-find", type=int, default=0,
                         help="accepted for flag parity; the TPU solver "
                              "evaluates all nodes exhaustively")
+    parser.add_argument("--enable-tracing", action="store_true",
+                        help="turn on the cycle flight recorder "
+                             "(/debug/trace, /debug/cycles, /debug/pending "
+                             "on --listen-address; <2%% cycle overhead); "
+                             "also enabled by VOLCANO_TRACE=1")
+    parser.add_argument("--trace-cycles", type=int, default=None,
+                        help="flight-recorder ring buffer: how many recent "
+                             "cycles to keep (default 64, or "
+                             "VOLCANO_TRACE_CAPACITY when set)")
     parser.add_argument("--version", action="store_true")
 
 
@@ -65,6 +74,16 @@ def main(argv=None) -> int:
     if args.version:
         from ..version import print_version_and_exit
         print_version_and_exit()
+    from ..trace import tracer
+    if args.enable_tracing:
+        # an explicit --trace-cycles wins; else VOLCANO_TRACE_CAPACITY;
+        # else the tracer's default (64)
+        cap = args.trace_cycles
+        if cap is None:
+            cap = tracer.env_capacity()
+        tracer.enable(capacity=cap)
+    elif tracer.enable_from_env() and args.trace_cycles is not None:
+        tracer.configure(args.trace_cycles)
     if args.server:
         from ..apiserver.remote import RemoteStore
         store = RemoteStore(args.server)

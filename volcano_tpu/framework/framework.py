@@ -23,55 +23,72 @@ from .solver import BatchSolver
 
 
 def open_session(cache, tiers, configurations=None) -> Session:
-    snapshot = cache.snapshot()
-    ssn = Session(cache, snapshot, tiers, configurations)
-    ssn.solver = BatchSolver(ssn)
-    # pre-session PodGroup statuses for jitter-deduped writeback
-    ssn.pod_group_status: Dict[str, object] = {}
-    for job in ssn.jobs.values():
-        if job.pod_group is not None:
-            ssn.pod_group_status[job.uid] = _status_snapshot(
-                job.pod_group.status)
-    ssn.total_resource = Resource()
-    for n in ssn.nodes.values():
-        ssn.total_resource.add(n.allocatable)
+    from ..trace import tracer as tr
+    with tr.span("open_session"):
+        with tr.span("snapshot"):
+            snapshot = cache.snapshot()
+        ssn = Session(cache, snapshot, tiers, configurations)
+        ssn.solver = BatchSolver(ssn)
+        # pre-session PodGroup statuses for jitter-deduped writeback
+        ssn.pod_group_status: Dict[str, object] = {}
+        for job in ssn.jobs.values():
+            if job.pod_group is not None:
+                ssn.pod_group_status[job.uid] = _status_snapshot(
+                    job.pod_group.status)
+        ssn.total_resource = Resource()
+        for n in ssn.nodes.values():
+            ssn.total_resource.add(n.allocatable)
 
-    from ..metrics import metrics as m
-    for tier in tiers:
-        for opt in tier.plugins:
-            builder = get_plugin_builder(opt.name)
-            if builder is None:
-                continue
-            plugin = builder(opt.arguments)
-            ssn.plugins[plugin.name()] = plugin
-            with m.plugin_timer(plugin.name(), "OnSessionOpen"):
-                plugin.on_session_open(ssn)
+        from ..metrics import metrics as m
+        for tier in tiers:
+            for opt in tier.plugins:
+                builder = get_plugin_builder(opt.name)
+                if builder is None:
+                    continue
+                plugin = builder(opt.arguments)
+                ssn.plugins[plugin.name()] = plugin
+                with m.plugin_timer(plugin.name(), "OnSessionOpen"), \
+                        tr.span("plugin_open", plugin=plugin.name()):
+                    plugin.on_session_open(ssn)
 
-    # drop invalid gangs (JobValid), writing the Unschedulable condition.
-    # Pending PodGroups are exempt: their pods don't exist yet (the job
-    # controller gates pod creation on the enqueue action moving the group
-    # to Inqueue), so gang's valid-task-count check cannot apply to them.
-    for job in list(ssn.jobs.values()):
-        if job.pod_group is not None and \
-                job.pod_group.status.phase == PodGroupPhase.PENDING:
-            continue
-        vr = ssn.job_valid(job)
-        if vr is not None and not vr.passed:
-            update_pod_group_condition(ssn, job, PodGroupCondition(
-                type=PodGroupConditionType.UNSCHEDULABLE, status="True",
-                transition_id=ssn.uid, reason=vr.reason, message=vr.message))
-            del ssn.jobs[job.uid]
-    return ssn
+        # drop invalid gangs (JobValid), writing the Unschedulable
+        # condition. Pending PodGroups are exempt: their pods don't exist
+        # yet (the job controller gates pod creation on the enqueue action
+        # moving the group to Inqueue), so gang's valid-task-count check
+        # cannot apply to them.
+        with tr.span("job_valid"):
+            for job in list(ssn.jobs.values()):
+                if job.pod_group is not None and \
+                        job.pod_group.status.phase == PodGroupPhase.PENDING:
+                    continue
+                vr = ssn.job_valid(job)
+                if vr is not None and not vr.passed:
+                    update_pod_group_condition(ssn, job, PodGroupCondition(
+                        type=PodGroupConditionType.UNSCHEDULABLE,
+                        status="True", transition_id=ssn.uid,
+                        reason=vr.reason, message=vr.message))
+                    del ssn.jobs[job.uid]
+        return ssn
 
 
 def close_session(ssn: Session) -> None:
     from ..metrics import metrics as m
-    for plugin in ssn.plugins.values():
-        with m.plugin_timer(plugin.name(), "OnSessionClose"):
-            plugin.on_session_close(ssn)
-    JobUpdater(ssn).update_all()
-    ssn.plugins = {}
-    ssn.event_handlers = []
+    from ..trace import tracer as tr
+    with tr.span("close_session"):
+        for plugin in ssn.plugins.values():
+            with m.plugin_timer(plugin.name(), "OnSessionClose"), \
+                    tr.span("plugin_close", plugin=plugin.name()):
+                plugin.on_session_close(ssn)
+        if tr.is_enabled():
+            # "why pending" diagnosis for /debug/pending — after the
+            # plugin closes (gang just wrote fit errors + conditions)
+            from ..trace import pending as _pending
+            with tr.span("pending_diagnosis"):
+                _pending.publish(ssn)
+        with tr.span("job_updater"):
+            JobUpdater(ssn).update_all()
+        ssn.plugins = {}
+        ssn.event_handlers = []
 
 
 def update_pod_group_condition(ssn: Session, job: JobInfo,

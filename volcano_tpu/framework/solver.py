@@ -32,6 +32,7 @@ from ..models.unschedule_info import FitError, FitErrors
 from ..ops.allocate import gang_allocate
 from ..ops.fit import group_fit_mask, selector_mask, taint_mask
 from ..ops.score import ScoreWeights
+from ..trace import tracer as trace
 
 import logging
 import time
@@ -365,6 +366,10 @@ class BatchSolver:
         predicate mask + static score for the batch: (narr, batch, gmask,
         static_score) — the DEVICE formulation (the [G, N] arrays stay on
         the accelerator; only the small inputs cross the link)."""
+        with trace.span("build_context"):
+            return self._build_context_inner(ordered_jobs)
+
+    def _build_context_inner(self, ordered_jobs):
         narr, batch, feats = self._context_arrays(ordered_jobs)
         eps = jnp.asarray(self.rindex.eps)
         # capability fit through unique capability rows: clusters have a
@@ -398,6 +403,10 @@ class BatchSolver:
         fit differs — column-wise numpy without [G, N, R] temporaries —
         and tests/test_solver_kernel.py's
         test_host_context_matches_device_context pins that equivalence."""
+        with trace.span("build_context", host=True):
+            return self._build_host_context_inner(ordered_jobs)
+
+    def _build_host_context_inner(self, ordered_jobs):
         narr, batch, feats = self._context_arrays(ordered_jobs)
         eps = self.rindex.eps
         gmask = np.ones((batch.g_pad, narr.n_pad), bool)
@@ -479,6 +488,15 @@ class BatchSolver:
               allow_pipeline: bool = True) -> PlacementResult:
         """Run the gang-allocate kernel for the ordered job/task batch against
         the session's *current* node state."""
+        with trace.span("solver.place", jobs=len(ordered_jobs)):
+            result = self._place(ordered_jobs, allow_pipeline)
+            trace.add_tags(
+                placed=sum(len(p) for p in result.placements.values()),
+                committed=sum(1 for ok in result.committed.values() if ok))
+            return result
+
+    def _place(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]],
+               allow_pipeline: bool = True) -> PlacementResult:
         narr, batch, gmask, static_score = self._build_context(ordered_jobs)
         eps = jnp.asarray(self.rindex.eps)
 
@@ -531,39 +549,49 @@ class BatchSolver:
                 pack_bonus[batch.task_group[t_idx]] = bonus
 
         from ..metrics import metrics as m
-        t_kernel = time.perf_counter()
+        from ..ops import kernel_span
         if self.mesh is not None:
-            assign, pipelined, ready, kept = self._run_sharded(
-                batch, narr, gmask, static_score, task_bucket, pack_bonus,
-                q_deserved, q_alloc0, ns_weight, ns_alloc0, ns_total,
-                ns_live, eps, allow_pipeline)
+            kernel_fn, kernel_kwargs, kernel_name = None, {}, "sharded"
         else:
             kernel_fn, kernel_kwargs = self._select_kernel(
                 len(batch.ns_names))
-            assign, pipelined, ready, kept, _ = kernel_fn(
-                jnp.asarray(batch.task_group), jnp.asarray(batch.task_job),
-                jnp.asarray(batch.task_valid), jnp.asarray(batch.group_req),
-                gmask, static_score,
-                jnp.asarray(task_bucket), jnp.asarray(pack_bonus),
-                jnp.asarray(batch.job_min_available),
-                jnp.asarray(batch.job_ready_base),
-                jnp.asarray(batch.job_task_start),
-                jnp.asarray(batch.job_n_tasks),
-                jnp.asarray(batch.job_queue),
-                jnp.asarray(batch.pool_queue),
-                jnp.asarray(batch.pool_ns),
-                jnp.asarray(batch.pool_job_start),
-                jnp.asarray(batch.pool_njobs),
-                jnp.asarray(ns_weight), jnp.asarray(ns_alloc0),
-                jnp.asarray(ns_total),
-                jnp.asarray(q_deserved), jnp.asarray(q_alloc0),
-                jnp.asarray(narr.idle), jnp.asarray(narr.future_idle),
-                jnp.asarray(narr.allocatable), jnp.asarray(narr.n_tasks),
-                jnp.asarray(narr.max_tasks), eps, self.score_weights(),
-                allow_pipeline=allow_pipeline, ns_live=ns_live,
-                **kernel_kwargs)
+            kernel_name = kernel_fn.__name__
+        t_kernel = time.perf_counter()
+        with kernel_span(kernel_name, g_pad=int(batch.g_pad),
+                         n_pad=int(narr.idle.shape[0]),
+                         t_pad=int(batch.task_group.shape[0])):
+            if self.mesh is not None:
+                assign, pipelined, ready, kept = self._run_sharded(
+                    batch, narr, gmask, static_score, task_bucket,
+                    pack_bonus, q_deserved, q_alloc0, ns_weight, ns_alloc0,
+                    ns_total, ns_live, eps, allow_pipeline)
+            else:
+                assign, pipelined, ready, kept, _ = kernel_fn(
+                    jnp.asarray(batch.task_group),
+                    jnp.asarray(batch.task_job),
+                    jnp.asarray(batch.task_valid),
+                    jnp.asarray(batch.group_req),
+                    gmask, static_score,
+                    jnp.asarray(task_bucket), jnp.asarray(pack_bonus),
+                    jnp.asarray(batch.job_min_available),
+                    jnp.asarray(batch.job_ready_base),
+                    jnp.asarray(batch.job_task_start),
+                    jnp.asarray(batch.job_n_tasks),
+                    jnp.asarray(batch.job_queue),
+                    jnp.asarray(batch.pool_queue),
+                    jnp.asarray(batch.pool_ns),
+                    jnp.asarray(batch.pool_job_start),
+                    jnp.asarray(batch.pool_njobs),
+                    jnp.asarray(ns_weight), jnp.asarray(ns_alloc0),
+                    jnp.asarray(ns_total),
+                    jnp.asarray(q_deserved), jnp.asarray(q_alloc0),
+                    jnp.asarray(narr.idle), jnp.asarray(narr.future_idle),
+                    jnp.asarray(narr.allocatable), jnp.asarray(narr.n_tasks),
+                    jnp.asarray(narr.max_tasks), eps, self.score_weights(),
+                    allow_pipeline=allow_pipeline, ns_live=ns_live,
+                    **kernel_kwargs)
 
-        assign = np.asarray(assign)   # blocks until the device finishes
+            assign = np.asarray(assign)  # blocks until the device finishes
         m.observe(m.SOLVER_KERNEL_LATENCY,
                   (time.perf_counter() - t_kernel) * 1000.0)
         pipelined_np = np.asarray(pipelined)
@@ -651,11 +679,12 @@ class BatchSolver:
             # fit errors need the predicate mask rows of only the unplaced
             # groups — a full [G, N] device->host pull costs seconds over a
             # tunneled TPU, so gather just those rows in one transfer
-            gs = sorted({g for _, _, g in unplaced_records})
-            rows = np.asarray(gmask[jnp.asarray(np.array(gs, np.int32))])
-            row_of = {g: rows[i] for i, g in enumerate(gs)}
-            for job, task, g in unplaced_records:
-                self._record_fit_errors(job, task, narr, row_of[g])
+            with trace.span("fit_errors", tasks=len(unplaced_records)):
+                gs = sorted({g for _, _, g in unplaced_records})
+                rows = np.asarray(gmask[jnp.asarray(np.array(gs, np.int32))])
+                row_of = {g: rows[i] for i, g in enumerate(gs)}
+                for job, task, g in unplaced_records:
+                    self._record_fit_errors(job, task, narr, row_of[g])
         return result
 
     def _run_sharded(self, batch, narr, gmask, static_score, task_bucket,

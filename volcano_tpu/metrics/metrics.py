@@ -62,6 +62,7 @@ QUEUE_WEIGHT = f"{NS}_queue_weight"
 NAMESPACE_SHARE = f"{NS}_namespace_share"
 NAMESPACE_WEIGHT = f"{NS}_namespace_weight"
 SOLVER_KERNEL_LATENCY = f"{NS}_tpu_solver_kernel_latency_milliseconds"
+UNSCHEDULABLE_REASON = f"{NS}_unschedulable_reason_total"
 
 
 def observe(name: str, value: float, **labels):
@@ -185,18 +186,35 @@ def snapshot() -> dict:
         }
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus text format: backslash, double-quote and newline must
+    be escaped inside label values (exposition_formats.md)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
 def render_prometheus() -> str:
-    """Text exposition format."""
+    """Text exposition format, with full histogram exposition:
+    cumulative ``_bucket{le="..."}`` lines per _Hist.BOUNDS bound plus
+    ``le="+Inf"``, then ``_count``/``_sum``."""
     lines: List[str] = []
 
     def fmt_labels(labels: Tuple) -> str:
         if not labels:
             return ""
-        inner = ",".join(f'{k}="{v}"' for k, v in labels)
+        inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                         for k, v in labels)
         return "{" + inner + "}"
 
     with _lock:
         for (name, labels), h in _histograms.items():
+            cum = 0
+            for bound, n in zip(h.BOUNDS, h.buckets):
+                cum += n
+                le = fmt_labels(labels + (("le", f"{bound:g}"),))
+                lines.append(f"{name}_bucket{le} {cum}")
+            le = fmt_labels(labels + (("le", "+Inf"),))
+            lines.append(f"{name}_bucket{le} {h.count}")
             lines.append(f"{name}_count{fmt_labels(labels)} {h.count}")
             lines.append(f"{name}_sum{fmt_labels(labels)} {h.total}")
         for (name, labels), v in _gauges.items():

@@ -1,13 +1,52 @@
-"""Prometheus exposition endpoint (reference: the scheduler's /metrics on
---listen-address, cmd/scheduler/app/server.go:85)."""
+"""Prometheus exposition + flight-recorder debug endpoints (reference:
+the scheduler's /metrics on --listen-address, cmd/scheduler/app/
+server.go:85).
+
+Routes:
+  /metrics        Prometheus text exposition
+  /debug/cycles   ring-buffer summaries of the last N traced cycles
+  /debug/trace    Chrome trace-event JSON for one cycle (?seq=N, default
+                  the newest; load in chrome://tracing or Perfetto)
+  /debug/pending  "why pending": per-job / per-reason unschedulable counts
+"""
 
 from __future__ import annotations
 
+import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from . import metrics as m
+
+
+def _debug_response(path: str, query: dict):
+    """(status, payload dict) for a /debug/* path, None for unknown."""
+    from ..trace import tracer
+    if path == "/debug/cycles":
+        return 200, {"enabled": tracer.is_enabled(),
+                     "cycles": [tracer.summary(r) for r in tracer.records()]}
+    if path == "/debug/trace":
+        seq = query.get("seq")
+        if seq is not None:
+            try:
+                rec = tracer.get_record(int(seq[0]))
+            except ValueError:
+                return 400, {"error": f"bad seq {seq[0]!r}"}
+        else:
+            rec = tracer.last_record()
+        if rec is None:
+            return 404, {"error": "no traced cycle in the ring buffer",
+                         "enabled": tracer.is_enabled()}
+        return 200, tracer.chrome_trace(rec)
+    if path == "/debug/pending":
+        report = tracer.pending_report()
+        if report is None:
+            return 200, {"enabled": tracer.is_enabled(), "pending_jobs": 0,
+                         "reasons": {}, "jobs": {}}
+        return 200, report
+    return None
 
 
 class MetricsServer:
@@ -16,18 +55,30 @@ class MetricsServer:
             def log_message(self, fmt, *args):
                 pass
 
-            def do_GET(self):
-                if self.path.rstrip("/") not in ("", "/metrics"):
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                body = m.render_prometheus().encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
+            def _send(self, status: int, body: bytes, ctype: str) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):
+                parsed = urllib.parse.urlsplit(self.path)
+                path = parsed.path.rstrip("/")
+                if path.startswith("/debug/"):
+                    res = _debug_response(
+                        path, urllib.parse.parse_qs(parsed.query))
+                    if res is not None:
+                        status, payload = res
+                        self._send(status, json.dumps(payload).encode(),
+                                   "application/json")
+                        return
+                if path not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self._send(200, m.render_prometheus().encode(),
+                           "text/plain; version=0.0.4")
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_port

@@ -6,3 +6,22 @@ from .score import (ScoreWeights, balanced_allocation_score,  # noqa: F401
                     binpack_score, least_requested_score,
                     most_requested_score, node_score)
 from .allocate import gang_allocate  # noqa: F401
+
+# padded-shape buckets already served per kernel: the first invocation at
+# a bucket is the one that pays the jit compile (or, for the native
+# solver, its candidate-table build), so its kernel span is tagged
+# compiled=True — the compile-vs-execute attribution for /debug/trace
+_seen_shape_buckets: set = set()
+
+
+def kernel_span(kernel: str, **shape_tags):
+    """Flight-recorder span for one placement-kernel invocation, tagging
+    the kernel name, the padded-shape bucket and whether this call is the
+    bucket's first (compile) run."""
+    from ..trace import tracer
+    key = (kernel, tuple(sorted(shape_tags.items())))
+    compiled = key not in _seen_shape_buckets
+    if compiled:
+        _seen_shape_buckets.add(key)
+    return tracer.span("kernel", kernel=kernel, compiled=compiled,
+                       **shape_tags)

@@ -190,7 +190,9 @@ def gang_allocate_native(task_group, task_job, task_valid, group_req,
         assign=_ptr(assign), out_pipelined=_ptr(pipelined),
         out_ready=_ptr(ready), out_kept=_ptr(kept),
         out_idle=_ptr(out_idle))
-    rc = lib.vc_gang_allocate(ctypes.byref(args))
+    from ..trace import tracer
+    with tracer.span("native_solve", tasks=T, nodes=N):
+        rc = lib.vc_gang_allocate(ctypes.byref(args))
     if rc != 0:
         raise RuntimeError(f"native solver failed rc={rc}")
     return (assign, pipelined.astype(bool), ready.astype(bool),

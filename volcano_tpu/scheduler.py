@@ -84,30 +84,36 @@ class Scheduler:
         reclaimed) objects and a mid-cycle gen2 scan costs over a second.
         Cycle-created garbage with actual reference cycles is collected
         between cycles in :meth:`run`."""
+        from .trace import tracer as tr
         from .utils import gcguard
         start = time.perf_counter()
         with self._mutex:
             conf = self.conf
-        gcguard.pause()
-        begin = getattr(self.cache, "begin_cycle", None)
-        if begin is not None:
-            begin()
-        try:
-            ssn = open_session(self.cache, conf.tiers, conf.configurations)
+        with tr.cycle():
+            gcguard.pause()
+            begin = getattr(self.cache, "begin_cycle", None)
+            if begin is not None:
+                begin()
             try:
-                for name in conf.actions:
-                    action = get_action(name)
-                    if action is None:
-                        continue
-                    with m.action_timer(name):
-                        action.execute(ssn)
+                ssn = open_session(self.cache, conf.tiers,
+                                   conf.configurations)
+                tr.tag_cycle(jobs=len(ssn.jobs), nodes=len(ssn.nodes),
+                             queues=len(ssn.queues))
+                try:
+                    for name in conf.actions:
+                        action = get_action(name)
+                        if action is None:
+                            continue
+                        with m.action_timer(name), \
+                                tr.span(f"action:{name}", action=name):
+                            action.execute(ssn)
+                finally:
+                    close_session(ssn)
             finally:
-                close_session(ssn)
-        finally:
-            end = getattr(self.cache, "end_cycle", None)
-            if end is not None:
-                end()
-            gcguard.resume()
+                end = getattr(self.cache, "end_cycle", None)
+                if end is not None:
+                    end()
+                gcguard.resume()
         m.update_e2e_duration(time.perf_counter() - start)
 
     def run(self) -> None:

@@ -1,0 +1,3 @@
+"""Cycle flight recorder (tracer) + "why pending" diagnosis (pending)."""
+
+from . import tracer  # noqa: F401

@@ -1,0 +1,112 @@
+""""Why pending" diagnosis: aggregate the cycle's unschedulable reasons.
+
+The scheduler already *collects* per-task failure detail — FitErrors from
+the solver's mask summaries (framework/solver.py _record_fit_errors) and
+the host predicate path (plugins/predicates.py FitException reasons), plus
+the gang plugin's Unschedulable PodGroup conditions — but nothing
+aggregated it into an answerable "why is this task still pending".
+``collect(ssn)`` rolls those sources into per-job and per-reason counts;
+``publish(ssn)`` (called at session close while tracing is on) stores the
+report for the ``/debug/pending`` endpoint and bumps the
+``volcano_unschedulable_reason_total`` counters.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict
+
+from ..models.job_info import TaskStatus
+from ..models.objects import PodGroupConditionType, PodGroupPhase
+from . import tracer
+
+# canonical reasons for the solver's summarized (mask-level) fit errors,
+# matched against FitErrors.err strings set by _record_fit_errors
+REASON_SOLVER_MASKED = "predicates failed or insufficient resources"
+REASON_GANG_ROLLBACK = "gang rollback or all feasible nodes already full"
+REASON_NOT_CONSIDERED = "not considered this cycle"
+REASON_AWAITING_ENQUEUE = "PodGroup awaiting enqueue (Pending phase)"
+
+
+def _task_reasons(fe) -> Counter:
+    """Distinct reasons of one task's FitErrors: per-node predicate
+    reasons when present, else the classified summary error."""
+    reasons: Counter = Counter()
+    if fe.nodes:
+        seen = set()
+        for node_fe in fe.nodes.values():
+            seen.update(node_fe.reasons)
+        for r in seen:
+            reasons[r] += 1
+        if seen:
+            return reasons
+    err = fe.err or ""
+    if REASON_SOLVER_MASKED in err:
+        reasons[REASON_SOLVER_MASKED] += 1
+    elif "gang rollback" in err:
+        reasons[REASON_GANG_ROLLBACK] += 1
+    elif err:
+        reasons[err] += 1
+    return reasons
+
+
+def collect(ssn) -> dict:
+    """Per-job and per-reason pending counts for one session. A reason
+    counts once per task (a task blocked on 9k nodes by the same
+    predicate is one pending task, not 9k)."""
+    jobs: Dict[str, dict] = {}
+    totals: Counter = Counter()
+    for job in ssn.jobs.values():
+        if job.pod_group is None or job.ready():
+            continue
+        pending = len(job.task_status_index.get(TaskStatus.Pending, {}))
+        unready = max(0, job.min_available - job.ready_task_num())
+        if not pending and not unready:
+            continue
+        per_reason: Counter = Counter()
+        for fe in job.nodes_fit_errors.values():
+            per_reason.update(_task_reasons(fe))
+        cond_reason = ""
+        cond_message = ""
+        for c in job.pod_group.status.conditions:
+            if c.type == PodGroupConditionType.UNSCHEDULABLE \
+                    and c.status == "True":
+                cond_reason, cond_message = c.reason, c.message
+        if not per_reason:
+            # no fit errors recorded: the job never reached the solver
+            # this cycle (still Pending-phase, dropped by JobValid, or
+            # starved by ordering)
+            # count by max(pending, unready): a Pending-phase group's
+            # pods don't exist yet, so its Pending-status task count is 0
+            # while min_available-unready is the real shortfall
+            if job.pod_group.status.phase == PodGroupPhase.PENDING:
+                per_reason[REASON_AWAITING_ENQUEUE] = \
+                    max(pending, unready) or 1
+            else:
+                per_reason[cond_reason or REASON_NOT_CONSIDERED] = \
+                    max(pending, unready) or 1
+        totals.update(per_reason)
+        jobs[f"{job.namespace}/{job.name}"] = {
+            "queue": job.queue,
+            "pending_tasks": pending,
+            "unready": unready,
+            "min_available": job.min_available,
+            "condition_reason": cond_reason,
+            "message": cond_message or job.job_fit_errors,
+            "reasons": dict(per_reason),
+        }
+    return {"pending_jobs": len(jobs), "reasons": dict(totals),
+            "jobs": jobs}
+
+
+def publish(ssn) -> dict:
+    """Collect + store for /debug/pending + export the per-reason
+    counters (``volcano_unschedulable_reason_total``)."""
+    from ..metrics import metrics as m
+    report = collect(ssn)
+    report["cycle_seq"] = tracer.current_seq()
+    report["session_uid"] = getattr(ssn, "uid", "")
+    for reason, count in report["reasons"].items():
+        m.inc(m.UNSCHEDULABLE_REASON, float(count), reason=reason)
+    tracer.set_pending_report(report)
+    return report
