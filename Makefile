@@ -3,7 +3,7 @@
 PYTHON ?= python
 
 .PHONY: test unit-test e2e bench bench-all multichip-dryrun deploy deploy-up \
-	trace-smoke
+	trace-smoke sim-smoke
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -42,6 +42,15 @@ bench-all:
 trace-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_trace.py -q \
 		-k "smoke or overhead"
+
+# churn-simulator smoke gate: 200 virtual-time ticks of seeded churn
+# (>=2k tasks through 512 nodes, node flaps + bind-failure + evict-storm
+# injection) with the invariant catalog on, run TWICE — the second run
+# must reproduce the first's bind sequence bit-identically. Exit 1 on
+# any invariant violation (a repro bundle lands in CWD) or determinism
+# break. ~55 s on an idle machine.
+sim-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli smoke
 
 # multi-chip sharding dryrun on the virtual CPU mesh
 multichip-dryrun:

@@ -100,8 +100,9 @@ def test_native_near_tie_stress(seed):
     """Adversarial near-tie shapes (large gangs over few tight nodes with
     the balanced term active): many nodes score within 1-2 ulp, so any
     float-op-order mismatch vs XLA:CPU flips argmax tie-breaks. This pins
-    the -ffp-contract=fast build matching XLA's FMA contraction
-    (native/build.py); a future XLA emission change fails here first."""
+    the explicit-fmaf score chain (built with -ffp-contract=off) matching
+    XLA's FMA contraction site-for-site (native/build.py); a future XLA
+    emission change fails here first."""
     rng = np.random.default_rng(seed)
     sa = synth_arrays(int(rng.integers(100, 400)),
                       int(rng.integers(12, 40)),
@@ -157,3 +158,66 @@ def test_native_rollback_heavy():
                                 balanced=1.0)
     _run_pair(sa, weights, True, ctx="rollback-heavy")
     _run_pair(sa, weights, False, ctx="rollback-heavy nopipe")
+
+
+def _stale_gen_shape(seed, scale, gang=12, njobs=8, n_nodes=24,
+                     bucket_period=0):
+    """A shape that makes a stale-generation rollback OBSERVABLE: all
+    jobs share ONE group (identical pod templates — the production norm),
+    so after a gang rolls back the next gang passes the content check and
+    serves from whatever the rollback left in the table instead of
+    refreshing. Tight capacity makes mid-life gangs place a prefix and
+    then fail minAvailable."""
+    sa = synth_arrays(gang * njobs, n_nodes, gang_size=gang, seed=seed,
+                      utilization=0.2)
+    sa.task_group[:] = 0
+    sa.node_idle = sa.node_idle * scale
+    sa.node_future = np.maximum(sa.node_future * 0.2, sa.node_idle)
+    if bucket_period:
+        sa.task_bucket = ((np.arange(len(sa.task_bucket)) // bucket_period)
+                          % 2).astype(np.int32)
+    return sa
+
+
+_STALE_GEN_WEIGHTS = dict(binpack=1.3, least=0.7, balanced=0.9)
+
+
+@pytest.mark.parametrize("seed,scale", [(3, 0.6), (4, 0.4), (7, 0.6),
+                                        (13, 0.6), (14, 0.6)])
+def test_native_rollback_gang_over_c2(seed, scale):
+    """gang_size > C2: the touch budget (touched >= C2) forces a
+    mid-gang refresh(), which bumps rowmap_gen and reinstalls the row
+    table. Undo entries recorded before the refresh then point at row
+    slots owned by OTHER nodes; a rollback that restored those snapshots
+    corrupted the table (wrong gidx/idle/fits under live scores) and —
+    because every job here shares one group, so no refresh intervenes —
+    the next gang served from the corrupted table, diverging assignments
+    AND ready/kept gang outcomes from the scan. The fix tags each undo
+    entry with its rowmap generation and drops the table on a
+    cross-generation rollback."""
+    import volcano_tpu.ops.native as nat
+    old = nat._C2
+    try:
+        nat._C2 = 8
+        sa = _stale_gen_shape(seed, scale)
+        weights = ScoreWeights.make(sa.group_req.shape[1],
+                                    **_STALE_GEN_WEIGHTS)
+        _run_pair(sa, weights, True, ctx=f"gang>C2 seed={seed}")
+    finally:
+        nat._C2 = old
+
+
+@pytest.mark.parametrize("seed,scale,period", [
+    (3, 0.6, 10), (4, 0.4, 7), (6, 0.4, 10), (7, 0.6, 9), (13, 0.6, 10)])
+def test_native_rollback_alternating_buckets(seed, scale, period):
+    """Same stale-generation corruption reached through the bucket-chain
+    trigger instead of the touch budget: task-topology buckets alternate
+    INSIDE each gang (period < gang size), so a bucket flip mid-gang
+    refreshes the table and the gang's earlier undo entries go stale.
+    The period is chosen so the post-rollback serve lands in the same
+    bucket as the last refresh — the one case where the corrupted table
+    is reused rather than immediately rebuilt."""
+    sa = _stale_gen_shape(seed, scale, bucket_period=period)
+    weights = ScoreWeights.make(sa.group_req.shape[1],
+                                **_STALE_GEN_WEIGHTS)
+    _run_pair(sa, weights, True, ctx=f"alt-bucket seed={seed}")

@@ -132,6 +132,66 @@ def test_status_clone_attribute_parity():
     assert a.metadata is not pg.metadata
 
 
+def test_register_subclass_clones_inherited_slots():
+    """collect_offsets walks the MRO: a TaskInfo subclass adding its own
+    __slots__ must clone the BASE slots too — before the MRO walk, a
+    subclass registration silently produced clones with every inherited
+    field left NULL."""
+    fm = _fastmodel()
+    if fm is None:
+        pytest.skip("fastmodel unavailable")
+
+    class SubTask(TaskInfo):
+        __slots__ = ("extra_tag",)
+
+        def __init__(self, pod):
+            super().__init__(pod)
+            self.extra_tag = "sub"
+
+    try:
+        fm.register_task_type(SubTask)
+        t = SubTask(build_pod("ns1", "p0", "n1", "Running",
+                              {"cpu": "1", "memory": "1Gi"}, "pg"))
+        c = fm.clone_task(t)
+        # inherited slots carried over, not just the subclass's own
+        for slot in TaskInfo.__slots__:
+            assert getattr(c, slot, None) == getattr(t, slot, None), slot
+        assert c.extra_tag == "sub"
+    finally:
+        fm.register_task_type(TaskInfo)   # restore for other tests
+
+
+def test_register_rejects_dict_bearing_base():
+    """A subclass whose MRO contains a slotless (dict-bearing) base must
+    be rejected at registration — its __dict__ state would silently not
+    be cloned."""
+    fm = _fastmodel()
+    if fm is None:
+        pytest.skip("fastmodel unavailable")
+
+    class DictBase:
+        pass
+
+    class BadTask(DictBase):
+        __slots__ = ("status", "uid")
+
+    with pytest.raises(TypeError):
+        fm.register_task_type(BadTask)
+
+    # the subtle variant: no own __slots__ at all — __slots__ resolves
+    # to the base's tuple by inheritance, but instances still get a
+    # __dict__, which the slot copier would silently drop
+    class NoSlotsSub(TaskInfo):
+        pass
+
+    with pytest.raises(TypeError):
+        fm.register_task_type(NoSlotsSub)
+    # the previous registration must still be intact
+    t = TaskInfo(build_pod("ns1", "p0", "n1", "Running",
+                           {"cpu": "1", "memory": "1Gi"}, "pg"))
+    assert fm.clone_task(t).uid == t.uid
+
+
 def test_gcguard_nesting_and_foreign_disable():
     from volcano_tpu.utils import gcguard
     assert gc.isenabled()

@@ -28,10 +28,9 @@ class EnqueueAction(Action):
         queue_seen = set()
         jobs_map: Dict[str, List[JobInfo]] = {}
 
-        import time
         for job in ssn.jobs.values():
             if not job.scheduling_start_time:
-                job.scheduling_start_time = time.time()
+                job.scheduling_start_time = ssn.clock.now()
             queue = ssn.queues.get(job.queue)
             if queue is None:
                 continue

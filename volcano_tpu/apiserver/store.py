@@ -331,6 +331,19 @@ class ObjectStore:
             items = [o for o in items if o.metadata.namespace == namespace]
         return [fast_clone(o) for o in items]
 
+    def list_refs(self, kind: str, namespace: Optional[str] = None) -> list:
+        """Live object references — no clone. Stored objects are replaced,
+        never mutated in place (the same property the journal relies on),
+        so each ref is a consistent view; callers MUST NOT mutate. This is
+        the read-only audit path: the churn simulator's invariant checker
+        walks every pod after every tick, and cloning 50k pods per audit
+        would cost more than the scheduling cycle it checks."""
+        with self._lock:
+            items = list(self._objects[kind].values())
+        if namespace is not None and kind in NAMESPACED:
+            items = [o for o in items if o.metadata.namespace == namespace]
+        return items
+
     # -- watch -------------------------------------------------------------
 
     def watch(self, kind: str, on_add=None, on_update=None, on_delete=None,

@@ -31,7 +31,7 @@ class EventHandlersMixin:
         if not ti.job:
             return None
         if ti.job not in self.jobs:
-            self.jobs[ti.job] = JobInfo(ti.job)
+            self.jobs[ti.job] = JobInfo(ti.job, clock=self.store.clock)
         return self.jobs[ti.job]
 
     def _add_task(self, ti: TaskInfo) -> None:
@@ -178,6 +178,14 @@ class EventHandlersMixin:
                             new.metadata.resource_version
                         if stored is not None and stored is not cached:
                             stored.status = new_status
+                            if stored.pod is not cached.pod:
+                                # distinct TaskInfo wrapping a distinct pod
+                                # object: give the node-side view the echo's
+                                # resource_version too, or optimistic-
+                                # concurrency writers reading it conflict
+                                # against the store forever
+                                stored.pod.metadata.resource_version = \
+                                    new.metadata.resource_version
                         continue
                 flush_run()
                 try:
@@ -224,7 +232,7 @@ class EventHandlersMixin:
     def add_pod_group(self, pg: obj.PodGroup) -> None:
         key = pg.metadata.key()
         if key not in self.jobs:
-            self.jobs[key] = JobInfo(key)
+            self.jobs[key] = JobInfo(key, clock=self.store.clock)
         self.jobs[key].set_pod_group(pg)
 
     def update_pod_group(self, old: obj.PodGroup, new: obj.PodGroup) -> None:

@@ -2,9 +2,12 @@
 
     vcctl job   {run,list,view,suspend,resume,delete}
     vcctl queue {create,list,get,delete,operate}
+    vcctl sim   {run,smoke,replay}
 
-Talks HTTP to a running control plane (python -m volcano_tpu.cmd.cluster);
---server or $VOLCANO_SERVER selects the endpoint.
+job/queue talk HTTP to a running control plane (python -m
+volcano_tpu.cmd.cluster); --server or $VOLCANO_SERVER selects the
+endpoint. sim needs no server: the churn simulator owns its whole
+control plane in-process.
 """
 
 from __future__ import annotations
@@ -72,6 +75,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="open | close | update")
     qo.add_argument("--weight", "-w", type=int, default=0)
 
+    from ..sim.cli import add_sim_parser
+    add_sim_parser(sub)
+
     return parser
 
 
@@ -116,6 +122,15 @@ def dispatch(args, client=None) -> str:
 
 def main(argv: Optional[List[str]] = None, client=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.group == "sim":
+        # serverless: the simulator prints its own summary and returns an
+        # exit code (nonzero on invariant violations / smoke failure)
+        from ..sim.cli import dispatch_sim
+        try:
+            return dispatch_sim(args)
+        except Exception as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
     try:
         print(dispatch(args, client))
         return 0

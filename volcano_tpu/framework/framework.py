@@ -22,12 +22,12 @@ from .session import Session
 from .solver import BatchSolver
 
 
-def open_session(cache, tiers, configurations=None) -> Session:
+def open_session(cache, tiers, configurations=None, clock=None) -> Session:
     from ..trace import tracer as tr
     with tr.span("open_session"):
         with tr.span("snapshot"):
             snapshot = cache.snapshot()
-        ssn = Session(cache, snapshot, tiers, configurations)
+        ssn = Session(cache, snapshot, tiers, configurations, clock=clock)
         ssn.solver = BatchSolver(ssn)
         # pre-session PodGroup statuses for jitter-deduped writeback
         ssn.pod_group_status: Dict[str, object] = {}

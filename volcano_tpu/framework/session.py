@@ -23,6 +23,7 @@ from ..models.job_info import JobInfo, TaskInfo, TaskStatus
 from ..models.node_info import NodeInfo
 from ..models.queue_info import NamespaceInfo, QueueInfo
 from ..models.resource import Resource
+from ..utils.clock import GLOBAL_CLOCK
 
 # plugin voting values (reference: plugins/util/util.go:31-36)
 PERMIT = 1
@@ -104,10 +105,18 @@ _session_log = logging.getLogger(__name__)
 class Session:
     """One scheduling cycle's context."""
 
-    def __init__(self, cache, snapshot: ClusterInfo, tiers, configurations=None):
+    def __init__(self, cache, snapshot: ClusterInfo, tiers,
+                 configurations=None, clock=None):
         self.uid = str(uuid.uuid4())
         self.cache = cache
         self.kube_client = cache.client() if cache is not None else None
+        # time-dependent plugins (sla, ...) must read this, never
+        # time.time(): wall time in production, virtual under the churn
+        # simulator, so decisions compare against the same timebase that
+        # stamped creation_timestamp. An explicit clock (Scheduler's)
+        # wins; otherwise the store's clock is the source of truth.
+        self.clock = clock if clock is not None else \
+            (getattr(self.kube_client, "clock", None) or GLOBAL_CLOCK)
         self.jobs: Dict[str, JobInfo] = snapshot.jobs
         self.nodes: Dict[str, NodeInfo] = snapshot.nodes
         self.queues: Dict[str, QueueInfo] = snapshot.queues

@@ -196,7 +196,7 @@ class JobInfo:
     """Scheduler view of one PodGroup and its tasks
     (reference: job_info.go:187-591)."""
 
-    def __init__(self, uid: str, *tasks: TaskInfo):
+    def __init__(self, uid: str, *tasks: TaskInfo, clock=None):
         self.uid: str = uid
         self.name: str = ""
         self.namespace: str = ""
@@ -221,9 +221,12 @@ class JobInfo:
         self.pod_group_owned: bool = True
         # stamped when the cache first sees the job, so the reservation
         # election's "longest waiting" survives per-cycle snapshot clones
-        # (clone() copies it; the reference's ScheduleStartTimestamp analogue)
+        # (clone() copies it; the reference's ScheduleStartTimestamp
+        # analogue). The cache passes its store's clock so the stamp
+        # shares the session timebase — virtual under the churn simulator
         import time as _t
-        self.scheduling_start_time: float = _t.time()
+        self.scheduling_start_time: float = \
+            clock.now() if clock is not None else _t.time()
         self.preemptable: bool = False
         self.revocable_zone: str = ""
         self.budget: DisruptionBudget = DisruptionBudget()

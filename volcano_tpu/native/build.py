@@ -7,10 +7,13 @@ the toolchain baked into the image (g++); no network, no pip.
 
 Float parity with XLA:CPU requires IEEE value semantics (no -ffast-math —
 no reassociation) AND matching XLA's FMA behavior: XLA:CPU's LLVM backend
-CONTRACTS mul+add chains in the score formula, so the build uses
--ffp-contract=fast — gcc fuses the same canonical a*b+c shapes and the
-results match bitwise (with contraction off, near-tie scores differed by
-1-2 ulp and flipped argmax tie-breaks). The adversarial near-tie fuzz in
+CONTRACTS the score formula's mul+add accumulation chain. The build
+compiles with -ffp-contract=off and solver.cc spells that one chain as
+explicit std::fmaf calls (node_score_base / row_score) — fusing exactly
+the sites XLA fuses and nothing else. Blanket -ffp-contract=fast was
+tried first and broke parity the other way (gcc over-fused sites XLA
+leaves unfused); with no fusing at all, near-tie scores differed by 1-2
+ulp and flipped argmax tie-breaks. The adversarial near-tie fuzz in
 tests/test_native_kernel.py pins this; if a future XLA changes emission,
 that fuzz fails and the solver conf falls back to `kernel: chunked`.
 """
@@ -74,8 +77,10 @@ def ensure_built() -> str:
             tmp = path + f".tmp{os.getpid()}"
             # -march=native vectorizes the sweep (AVX2/AVX-512 where the
             # host has it) — elementwise IEEE float ops are identical per
-            # lane; -ffp-contract=fast matches XLA:CPU's FMA contraction
-            # (see module docstring); -fno-trapping-math lets the compiler
+            # lane; -ffp-contract=off keeps gcc from fusing anything on
+            # its own — XLA:CPU's FMA contraction is reproduced by the
+            # explicit fmaf chain in solver.cc (see module docstring);
+            # -fno-trapping-math lets the compiler
             # speculate the masked divisions (if-conversion), enabling
             # vectorization — computed VALUES stay IEEE-exact
             cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",

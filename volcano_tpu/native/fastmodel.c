@@ -24,31 +24,47 @@ static int n_task_slots = -1;
 static Py_ssize_t status_offset = -1;
 static Py_ssize_t uid_offset = -1;
 
-/* Walk tp's __slots__ member descriptors into offsets/count; optionally
- * report the offsets of up to two named slots (want_a/want_b, NULL to
- * skip). Writes ONLY into caller-provided storage so a failed
- * registration can commit atomically. */
+/* Collect the member-descriptor offsets of every slot an instance of tp
+ * carries — walking the whole MRO, not just tp's own __slots__, so a
+ * subclass of a slotted model registers ALL storage (its own slots plus
+ * the inherited ones). A clone that copied only the leaf class's slots
+ * would silently leave the base's fields NULL. Any MRO entry (other
+ * than object) WITHOUT __slots__ rejects the registration: it gives
+ * instances a __dict__ this copier would not clone. Optionally reports
+ * the offsets of up to two named slots (want_a/want_b, NULL to skip).
+ * Writes ONLY into caller-provided storage so a failed registration can
+ * commit atomically. */
 static int
-collect_offsets(PyTypeObject *tp, Py_ssize_t *offsets, int *count,
-                const char *want_a, Py_ssize_t *off_a,
-                const char *want_b, Py_ssize_t *off_b)
+collect_one_class(PyTypeObject *tp, PyObject *klass, Py_ssize_t *offsets,
+                  int *count, const char *want_a, Py_ssize_t *off_a,
+                  const char *want_b, Py_ssize_t *off_b)
 {
-    PyObject *slots = PyObject_GetAttrString((PyObject *)tp, "__slots__");
-    if (slots == NULL)
+    PyObject *slots = PyObject_GetAttrString(klass, "__slots__");
+    if (slots == NULL) {
+        PyErr_Format(PyExc_TypeError,
+                     "%s in the MRO of %s has no __slots__ (instances "
+                     "would carry a __dict__ the slot copier cannot "
+                     "clone)",
+                     ((PyTypeObject *)klass)->tp_name, tp->tp_name);
         return -1;
+    }
+    /* a bare-string __slots__ declares ONE slot, not len(str) of them */
+    if (PyUnicode_Check(slots)) {
+        PyObject *tup = PyTuple_Pack(1, slots);
+        Py_DECREF(slots);
+        if (tup == NULL)
+            return -1;
+        slots = tup;
+    }
     PyObject *seq = PySequence_Fast(slots, "__slots__ not a sequence");
     Py_DECREF(slots);
     if (seq == NULL)
         return -1;
     Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
-    if (n > MAX_SLOTS) {
-        Py_DECREF(seq);
-        PyErr_SetString(PyExc_ValueError, "too many slots");
-        return -1;
-    }
-    *count = 0;
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *name = PySequence_Fast_GET_ITEM(seq, i);
+        /* resolve through tp, not klass: a shadowed name must land on
+         * the storage the instance actually uses */
         PyObject *descr = PyObject_GetAttr((PyObject *)tp, name);
         if (descr == NULL) {
             Py_DECREF(seq);
@@ -62,7 +78,18 @@ collect_offsets(PyTypeObject *tp, Py_ssize_t *offsets, int *count,
             return -1;
         }
         PyMemberDef *m = ((PyMemberDescrObject *)descr)->d_member;
-        offsets[(*count)++] = m->offset;
+        Py_DECREF(descr);
+        int dup = 0;
+        for (int j = 0; j < *count; j++)
+            if (offsets[j] == m->offset) { dup = 1; break; }
+        if (!dup) {
+            if (*count >= MAX_SLOTS) {
+                Py_DECREF(seq);
+                PyErr_SetString(PyExc_ValueError, "too many slots");
+                return -1;
+            }
+            offsets[(*count)++] = m->offset;
+        }
         const char *cname = PyUnicode_AsUTF8(name);
         if (cname != NULL) {
             if (want_a != NULL && strcmp(cname, want_a) == 0)
@@ -70,9 +97,42 @@ collect_offsets(PyTypeObject *tp, Py_ssize_t *offsets, int *count,
             if (want_b != NULL && strcmp(cname, want_b) == 0)
                 *off_b = m->offset;
         }
-        Py_DECREF(descr);
     }
     Py_DECREF(seq);
+    return 0;
+}
+
+static int
+collect_offsets(PyTypeObject *tp, Py_ssize_t *offsets, int *count,
+                const char *want_a, Py_ssize_t *off_a,
+                const char *want_b, Py_ssize_t *off_b)
+{
+    /* the authoritative __dict__ check: ANY slotless class in the
+     * hierarchy (including a subclass that merely inherits __slots__
+     * without declaring its own) gives instances a dict, and dict state
+     * is invisible to the slot copier. tp_dictoffset is how the
+     * interpreter itself records that. */
+    if (tp->tp_dictoffset != 0) {
+        PyErr_Format(PyExc_TypeError,
+                     "%s instances carry a __dict__ (some class in the "
+                     "hierarchy lacks __slots__); the slot copier would "
+                     "clone it partially", tp->tp_name);
+        return -1;
+    }
+    PyObject *mro = tp->tp_mro;
+    if (mro == NULL || !PyTuple_Check(mro)) {
+        PyErr_SetString(PyExc_TypeError, "type has no MRO");
+        return -1;
+    }
+    *count = 0;
+    for (Py_ssize_t k = 0; k < PyTuple_GET_SIZE(mro); k++) {
+        PyObject *klass = PyTuple_GET_ITEM(mro, k);
+        if (klass == (PyObject *)&PyBaseObject_Type)
+            continue;
+        if (collect_one_class(tp, klass, offsets, count,
+                              want_a, off_a, want_b, off_b) < 0)
+            return -1;
+    }
     return 0;
 }
 

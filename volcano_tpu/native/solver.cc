@@ -35,10 +35,12 @@
 // the (req row, mask row, static row, pack bonus) CONTENT to be equal,
 // which is verified by memcmp, and a bucket change forces a refresh
 // exactly like the XLA kernel's `b != prev_b` condition.  Float32
-// arithmetic follows ops/score.py's operation order, and the build uses
-// -ffp-contract=fast to match XLA:CPU's FMA contraction of the score
-// formula's mul+add chains (see native/build.py — with contraction OFF,
-// near-tie scores differed by 1-2 ulp and flipped argmax tie-breaks).
+// arithmetic follows ops/score.py's operation order; the build compiles
+// with -ffp-contract=off and the score formula's one contracted mul+add
+// chain is written as explicit std::fmaf (node_score_base / row_score),
+// matching XLA:CPU's FMA emission site-for-site (see native/build.py —
+// with no fusing at all, near-tie scores differed by 1-2 ulp and flipped
+// argmax tie-breaks; blanket contraction over-fused other sites).
 // Parity is pinned by tests/test_native_kernel.py fuzz vs the scan,
 // including adversarial near-tie stress shapes.
 //
@@ -250,12 +252,17 @@ struct Solver {
         std::chrono::steady_clock::now().time_since_epoch()).count();
   }
 
-  // undo log for the current gang (pre-placement values)
+  // undo log for the current gang (pre-placement values). Row indices
+  // are only meaningful for the rowmap generation they were recorded
+  // under: a mid-gang refresh() reinstalls the table and reassigns the
+  // slots, so each entry carries its generation and rollback discards
+  // the table instead of restoring rows across generations.
   struct Undo {
     int32_t node;
     float idle[8], fut[8];
     int32_t ntasks;
     int32_t row_i, row_f;
+    int32_t gen;         // rowmap_gen at record time
     Row ri, rf;          // full row copies (small)
   };
   std::vector<Undo> undo;
@@ -649,6 +656,7 @@ struct Solver {
         bool mapped = rowmap_ep[sel] == rowmap_gen;
         u.row_i = mapped ? rowmap_i[sel] : -1;
         u.row_f = mapped ? rowmap_f[sel] : -1;
+        u.gen = rowmap_gen;
         if (u.row_i >= 0) u.ri = rows[u.row_i];
         if (u.row_f >= 0) u.rf = rows[u.row_f];
         undo.push_back(u);
@@ -715,6 +723,16 @@ struct Solver {
               futT[(size_t)r * N + it->node] = it->fut[r];
             }
             ntasks[it->node] = it->ntasks;
+            if (it->gen != rowmap_gen) {
+              // recorded before a mid-gang refresh (touch budget hit, or
+              // the gang's tasks alternate buckets): the row slots were
+              // reassigned, so restoring the snapshots would write one
+              // node's pre-placement state into another node's row.
+              // Globals above are generation-independent and exact; drop
+              // the table and let the next serve refresh from them.
+              have_table = false;
+              continue;
+            }
             if (it->row_i >= 0) {
               float pk = rows[it->row_i].pack;   // pack survives rollback
               rows[it->row_i] = it->ri;

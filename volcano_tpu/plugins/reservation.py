@@ -9,8 +9,6 @@ each cycle lock the unlocked node with the most idle resources
 
 from __future__ import annotations
 
-import time
-
 from ..framework.plugin import Plugin
 from ..framework.registry import register_plugin_builder
 from ..models.resource import ZERO
@@ -32,7 +30,7 @@ class ReservationPlugin(Plugin):
                 return None
             highest = max(job.priority for job in jobs)
             candidates = [job for job in jobs if job.priority == highest]
-            now = time.time()
+            now = ssn.clock.now()
 
             def waited(job):
                 start = job.scheduling_start_time or now

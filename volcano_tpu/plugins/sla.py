@@ -8,7 +8,6 @@ JobEnqueueable and JobPipelined voters (sla.go:103-149).
 
 from __future__ import annotations
 
-import time
 from typing import Optional
 
 from ..framework.plugin import Plugin
@@ -63,7 +62,7 @@ class SlaPlugin(Plugin):
             jwt = self._waiting_time(job)
             if jwt is None:
                 return ABSTAIN
-            if time.time() - job.creation_timestamp < jwt:
+            if ssn.clock.now() - job.creation_timestamp < jwt:
                 return ABSTAIN
             return PERMIT
 

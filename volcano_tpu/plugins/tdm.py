@@ -149,6 +149,11 @@ class TdmPlugin(Plugin):
         def victims_fn():
             """Outside the window, drain preemptable pods from the zone's
             nodes once per evict period (tdm.go:232-260)."""
+            # wall time on purpose (not ssn.clock): tdm is time-of-day
+            # multiplexing — the zone windows above parse against
+            # time.localtime() — and _last_evict_at is a module global
+            # shared across schedulers in-process, so mixing timebases
+            # here would leak virtual stamps into production pacing
             global _last_evict_at
             if _last_evict_at + self.evict_period > time.time():
                 return []

@@ -18,6 +18,7 @@ from .framework import (close_session, default_scheduler_conf, get_action,
                         open_session, parse_scheduler_conf)
 from .metrics import metrics as m
 from .models.objects import DEFAULT_SCHEDULER_NAME
+from .utils.clock import Clock
 from .utils.filewatcher import FileWatcher
 
 
@@ -27,8 +28,15 @@ class Scheduler:
                  scheduler_conf: Optional[str] = None,
                  scheduler_conf_path: Optional[str] = None,
                  schedule_period: float = 1.0,
-                 cache: Optional[SchedulerCache] = None):
+                 cache: Optional[SchedulerCache] = None,
+                 clock: Optional[Clock] = None):
         self.store = store
+        # time-dependent scheduling decisions (sla waiting windows, ...)
+        # read this clock via the session (run_once passes it into
+        # open_session), so a simulator driving the scheduler on a
+        # virtual clock stays coherent with the store's creation
+        # timestamps
+        self.clock = clock if clock is not None else store.clock
         self.cache = cache if cache is not None else SchedulerCache(
             store, scheduler_name)
         self.schedule_period = schedule_period
@@ -96,7 +104,7 @@ class Scheduler:
                 begin()
             try:
                 ssn = open_session(self.cache, conf.tiers,
-                                   conf.configurations)
+                                   conf.configurations, clock=self.clock)
                 tr.tag_cycle(jobs=len(ssn.jobs), nodes=len(ssn.nodes),
                              queues=len(ssn.queues))
                 try:

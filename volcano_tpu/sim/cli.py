@@ -1,0 +1,238 @@
+"""`vcctl sim` / `python -m volcano_tpu.sim.cli`: the simulator CLI.
+
+    vcctl sim run     --seed 7 --ticks 200 --nodes 512 ...   # one churn run
+    vcctl sim smoke                                          # the CI gate
+    vcctl sim replay  --bundle sim_repro_seed7_tick42/       # re-run a repro
+
+Unlike the other vcctl groups this one talks to no server: the simulator
+owns its whole control plane in-process (that is the point — a violation
+shrinks to `{seed, tick}` with no cluster state to capture).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+# engine/faults/workload (and through them jax + the scheduler stack)
+# are imported inside the dispatch helpers: vcctl calls add_sim_parser
+# on EVERY invocation, and `vcctl job list` must stay a light HTTP
+# client that works even where jax is absent
+
+
+def add_sim_parser(sub) -> None:
+    """Attach the `sim` group to vcctl's subparser set."""
+    sim = sub.add_parser(
+        "sim", help="cluster churn simulator").add_subparsers(
+        dest="verb", required=True)
+
+    run = sim.add_parser("run", help="one seeded churn run")
+    run.add_argument("--seed", type=int, default=0)
+    run.add_argument("--ticks", type=int, default=100)
+    run.add_argument("--tick-seconds", type=float, default=1.0)
+    run.add_argument("--nodes", type=int, default=64)
+    run.add_argument("--node-cpu", default="64")
+    run.add_argument("--node-mem", default="256Gi")
+    run.add_argument("--resident-jobs", type=int, default=0)
+    run.add_argument("--resident-gang", type=int, default=8)
+    run.add_argument("--arrival-rate", type=float, default=1.0,
+                     help="jobs per virtual second (Poisson)")
+    run.add_argument("--bind-fail-rate", type=float, default=0.0)
+    run.add_argument("--api-latency", type=float, default=0.0,
+                     help="virtual seconds charged per store bind")
+    run.add_argument("--flap-rate", type=float, default=0.0,
+                     help="node drain+undrain pairs per virtual second")
+    run.add_argument("--kill-rate", type=float, default=0.0)
+    run.add_argument("--storm-rate", type=float, default=0.0)
+    run.add_argument("--fail-rate", type=float, default=0.0,
+                     help="fraction of gangs losing a pod mid-run")
+    run.add_argument("--trace", default=None, metavar="EVENTS_JSONL",
+                     help="replay this event trace instead of synthesizing "
+                          "(live injection — --bind-fail-rate/--api-latency "
+                          "— is config, not events: pass the original "
+                          "flags too, or use `sim replay --bundle` which "
+                          "carries the full config)")
+    run.add_argument("--trace-out", default=None, metavar="PATH",
+                     help="dump the applied event stream after the run")
+    run.add_argument("--repro-dir", default=".",
+                     help="where violation repro bundles are written")
+    run.add_argument("--no-invariants", action="store_true")
+    run.add_argument("--keep-going", action="store_true",
+                     help="do not stop at the first violating tick")
+    run.add_argument("--json", action="store_true",
+                     help="print the full summary as one JSON object")
+
+    smoke = sim.add_parser(
+        "smoke", help="CI gate: seeded churn twice, invariants on, "
+                      "bit-identical bind sequences required")
+    smoke.add_argument("--seed", type=int, default=7)
+    smoke.add_argument("--ticks", type=int, default=200)
+    smoke.add_argument("--nodes", type=int, default=512)
+    smoke.add_argument("--json", action="store_true")
+
+    rep = sim.add_parser("replay", help="re-run a violation repro bundle")
+    rep.add_argument("--bundle", required=True)
+    rep.add_argument("--use-trace", action="store_true",
+                     help="replay the recorded event stream verbatim "
+                          "instead of re-generating from the seed")
+    rep.add_argument("--ticks", type=int, default=None)
+    rep.add_argument("--json", action="store_true")
+
+
+def _config_from_args(args):
+    from .engine import SimConfig
+    from .faults import FaultConfig
+    from .workload import WorkloadConfig
+    horizon = args.ticks * args.tick_seconds
+    return SimConfig(
+        seed=args.seed,
+        ticks=args.ticks,
+        tick_s=args.tick_seconds,
+        n_nodes=args.nodes,
+        node_cpu=args.node_cpu,
+        node_mem=args.node_mem,
+        resident_jobs=args.resident_jobs,
+        resident_gang=args.resident_gang,
+        workload=WorkloadConfig(seed=args.seed, horizon_s=horizon,
+                                arrival_rate=args.arrival_rate),
+        faults=FaultConfig(seed=args.seed,
+                           bind_fail_rate=args.bind_fail_rate,
+                           api_latency_s=args.api_latency,
+                           flap_rate=args.flap_rate,
+                           kill_rate=args.kill_rate,
+                           storm_rate=args.storm_rate),
+        fail_rate=args.fail_rate,
+        trace_path=args.trace,
+        check_invariants=not args.no_invariants,
+        stop_on_violation=not args.keep_going,
+        repro_dir=args.repro_dir)
+
+
+def smoke_config(seed: int = 7, ticks: int = 200, nodes: int = 512):
+    """The `make sim-smoke` shape: >= 2k tasks through >= 512 nodes over
+    >= 200 virtual-time ticks with node flaps and bind-failure injection
+    on. A resident backlog of 216 gangs-of-8 (1728 tasks) plus a Poisson
+    arrival stream (~0.5 jobs/s x 200 s x ~4.2 avg gang ≈ 400 tasks)
+    clears 2k comfortably while keeping each cycle fast enough that the
+    double run (determinism half) fits the 60 s budget."""
+    from .engine import SimConfig
+    from .faults import FaultConfig
+    from .workload import WorkloadConfig
+    horizon = float(ticks)
+    return SimConfig(
+        seed=seed, ticks=ticks, tick_s=1.0, n_nodes=nodes,
+        node_cpu="16", node_mem="32Gi",
+        queues=[("default", 2, None),
+                ("capped", 1, {"cpu": str(nodes * 8), "memory": "99999Gi"})],
+        resident_jobs=216, resident_gang=8,
+        workload=WorkloadConfig(
+            seed=seed, horizon_s=horizon, arrival_rate=0.5,
+            duration_min_s=20.0, duration_max_s=150.0,
+            queues=["default", "capped"]),
+        faults=FaultConfig(
+            seed=seed, bind_fail_rate=0.02, api_latency_s=0.001,
+            flap_rate=0.05, flap_down_s=6.0,
+            kill_rate=0.01, kill_down_s=12.0,
+            storm_rate=0.01, storm_fraction=0.05),
+        fail_rate=0.05,
+        repro_dir=".")
+
+
+def _print_summary(summary: dict, as_json: bool) -> None:
+    if as_json:
+        print(json.dumps(summary, indent=1))
+        return
+    c = summary["cycle_ms"]
+    print(f"ticks={summary['ticks']} vtime={summary['vtime_s']}s "
+          f"jobs arrived={summary['arrived_jobs']} "
+          f"completed={summary['completed_jobs']} "
+          f"binds={summary['binds']}")
+    print(f"cycle latency ms: p50={c['p50']} p95={c['p95']} max={c['max']}")
+    print(f"bind fingerprint: {summary['bind_fingerprint'][:16]}…")
+    if summary["violations"]:
+        print(f"INVARIANT VIOLATIONS: {len(summary['violations'])}")
+        for v in summary["violations"][:10]:
+            print(f"  tick {v['tick']}: [{v['invariant']}] {v['detail']}")
+        for p in summary["repro_bundles"]:
+            print(f"  repro bundle: {p}")
+    else:
+        print("invariants: clean")
+
+
+def dispatch_sim(args) -> int:
+    from .engine import run_sim
+    if args.verb == "run":
+        result = run_sim(_config_from_args(args))
+        if args.trace_out:
+            from .workload import dump_trace
+            dump_trace(args.trace_out, result.events_applied)
+        _print_summary(result.summary(), args.json)
+        return 1 if result.violations else 0
+
+    if args.verb == "smoke":
+        cfg = smoke_config(seed=args.seed, ticks=args.ticks,
+                           nodes=args.nodes)
+        r1 = run_sim(cfg)
+        s1 = r1.summary()
+        tasks_through = sum(
+            int(e["size"]) for e in r1.events_applied
+            if e.get("kind") == "job_arrival")
+        ok = not r1.violations and s1["ticks"] >= args.ticks \
+            and tasks_through >= 2000
+        # determinism half: same seed, same config, fresh engine — the
+        # bind sequences must be bit-identical. Skipped when the first
+        # run already failed: re-running a red gate doubles time-to-red
+        # for no extra signal.
+        deterministic = False
+        if ok:
+            r2 = run_sim(smoke_config(seed=args.seed, ticks=args.ticks,
+                                      nodes=args.nodes))
+            deterministic = r1.bind_fingerprint() == r2.bind_fingerprint()
+        verdict = {
+            "smoke": s1,
+            "tasks_through": tasks_through,
+            "deterministic_replay": deterministic,
+            "pass": bool(ok and deterministic),
+        }
+        if args.json:
+            print(json.dumps(verdict, indent=1))
+        else:
+            _print_summary(s1, False)
+            print(f"tasks through the sim: {tasks_through}")
+            print(f"same-seed bind sequence identical: {deterministic}")
+            print(f"sim-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
+        return 0 if verdict["pass"] else 1
+
+    if args.verb == "replay":
+        from .replay import load_bundle, replay_bundle
+        bundle = load_bundle(args.bundle)
+        result = replay_bundle(args.bundle, use_trace=args.use_trace,
+                               ticks=args.ticks)
+        summary = result.summary()
+        summary["original_violations"] = bundle["violations"]
+        summary["reproduced"] = bool(result.violations)
+        _print_summary(summary, args.json)
+        if not args.json:
+            print(f"violation reproduced: {summary['reproduced']}")
+        # same convention as `run`: nonzero when the replay violates —
+        # so `vcctl sim replay --bundle d && echo fixed` means what it
+        # says in a bisect script
+        return 1 if result.violations else 0
+
+    raise ValueError(f"unknown sim verb {args.verb!r}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="volcano-sim", description="cluster churn simulator")
+    sub = parser.add_subparsers(dest="group", required=True)
+    add_sim_parser(sub)
+    args = parser.parse_args(argv if argv is not None
+                             else ["sim"] + sys.argv[1:])
+    return dispatch_sim(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,465 @@
+"""Discrete-event cluster churn simulator driving the REAL scheduler.
+
+No mocks anywhere on the decision path: the engine builds an
+``ObjectStore`` on a virtual clock, a production ``SchedulerCache`` (live
+executors, write-behind applies, snapshot prebuild) and a ``Scheduler``
+over the real conf/plugins/actions, then interleaves event application
+(job arrivals, pod lifecycle, node churn, fault injection) with
+``scheduler.run_once()`` ticks. The only fakes are at the cluster edge —
+the recording (optionally flaky) binder/evictor that production tests
+already use — which is exactly where the reference's kubelet would sit.
+
+Determinism contract: all randomness lives in the seeded event
+generators (workload/faults) and the seeded :class:`FlakyBinder`; the
+engine itself never consults an RNG, the cache executor is one FIFO
+worker flushed every tick, and the event queue breaks timestamp ties by
+insertion order. Two runs with the same config in one process produce
+bit-identical bind sequences (:meth:`SimResult.bind_fingerprint`); across
+processes additionally pin ``PYTHONHASHSEED`` (set-iteration order is
+the one hash-dependent surface).
+
+On an invariant violation the engine dumps a replayable repro bundle —
+``{seed, tick}``, the full applied-event stream as JSONL, and the
+offending cycle's flight-recorder trace (PR 1's ``trace/``) — via
+:mod:`volcano_tpu.sim.replay`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import time
+import zlib
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..apiserver.store import ObjectStore
+from ..cache import SchedulerCache
+from ..scheduler import Scheduler
+from ..utils.clock import FakeClock
+from ..utils.test_utils import (FakeEvictor, build_node, build_pod,
+                                build_pod_group, build_queue)
+from .events import Event, EventQueue, make_event
+from .faults import (FaultConfig, FlakyBinder, apply_evict_storm,
+                     synthesize_evict_storms, synthesize_node_churn)
+from .invariants import (CycleContext, Violation, allocated_task_count,
+                         check_all, queues_over_capability)
+from .workload import (WorkloadConfig, load_trace, resident_backlog,
+                       synthesize_arrivals)
+
+log = logging.getLogger(__name__)
+
+DEFAULT_CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+@dataclass
+class SimConfig:
+    seed: int = 0
+    ticks: int = 100
+    tick_s: float = 1.0                   # virtual seconds per tick
+    n_nodes: int = 64
+    node_cpu: str = "64"
+    node_mem: str = "256Gi"
+    node_pods: str = "110"
+    # (name, weight, capability resource-list or None)
+    queues: List[tuple] = field(
+        default_factory=lambda: [("default", 1, None)])
+    conf_text: str = DEFAULT_CONF
+    resident_jobs: int = 0                # t=0 backlog gangs
+    resident_gang: int = 8
+    workload: WorkloadConfig = field(default_factory=WorkloadConfig)
+    faults: FaultConfig = field(default_factory=FaultConfig)
+    # fraction of jobs whose gang loses a pod mid-run (lifecycle "fail")
+    fail_rate: float = 0.0
+    trace_path: Optional[str] = None      # replay this JSONL instead of
+    #                                       synthesizing workload/faults
+    check_invariants: bool = True
+    stop_on_violation: bool = True
+    repro_dir: Optional[str] = None       # where violation bundles land
+    flush_timeout_s: float = 120.0
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SimConfig":
+        d = dict(d)
+        d["workload"] = WorkloadConfig(**d.get("workload", {}))
+        d["faults"] = FaultConfig(**d.get("faults", {}))
+        d["queues"] = [tuple(q) for q in d.get("queues", [])]
+        return cls(**d)
+
+
+@dataclass
+class TickStats:
+    tick: int
+    vtime: float
+    cycle_ms: float
+    events: int
+    new_binds: int
+    pods: int
+    nodes: int
+    violations: int
+
+
+class SimResult:
+    def __init__(self):
+        self.bind_sequence: List[Tuple[str, str]] = []   # (pod key, node)
+        self.violations: List[Tuple[int, Violation]] = []  # (tick, v)
+        self.ticks: List[TickStats] = []
+        self.events_applied: List[Event] = []
+        self.repro_paths: List[str] = []
+        self.completed_jobs = 0
+        self.arrived_jobs = 0
+
+    def bind_fingerprint(self) -> str:
+        h = hashlib.sha256()
+        for key, host in self.bind_sequence:
+            h.update(f"{key}->{host}\n".encode())
+        return h.hexdigest()
+
+    def cycle_ms_percentiles(self, skip: int = 0) -> Dict[str, float]:
+        """Nearest-rank percentiles over the tick cycle latencies;
+        ``skip`` drops leading ticks (bench's steady-state view excludes
+        the cold backlog-populate tick)."""
+        lat = sorted(t.cycle_ms for t in self.ticks[skip:])
+        if not lat:
+            return {"p50": 0.0, "p95": 0.0, "max": 0.0}
+        at = lambda q: lat[min(len(lat) - 1, int(q * len(lat)))]
+        return {"p50": round(at(0.50), 3), "p95": round(at(0.95), 3),
+                "max": round(lat[-1], 3)}
+
+    def summary(self) -> dict:
+        return {
+            "ticks": len(self.ticks),
+            "vtime_s": round(self.ticks[-1].vtime, 3) if self.ticks else 0.0,
+            "arrived_jobs": self.arrived_jobs,
+            "completed_jobs": self.completed_jobs,
+            "binds": len(self.bind_sequence),
+            "bind_fingerprint": self.bind_fingerprint(),
+            "cycle_ms": self.cycle_ms_percentiles(),
+            "violations": [
+                {"tick": t, "invariant": v.invariant, "detail": v.detail}
+                for t, v in self.violations],
+            "repro_bundles": list(self.repro_paths),
+        }
+
+
+class SimEngine:
+    """One simulator run. Build, call :meth:`run`, read :attr:`result`."""
+
+    def __init__(self, cfg: SimConfig):
+        self.cfg = cfg
+        self.clock = FakeClock(start=1.0)   # nonzero: creation_timestamp
+        #                                     falsiness means "unset"
+        self.store = ObjectStore(clock=self.clock)
+        # seeded from faults.seed like every other injector (churn
+        # schedules, storms) — varying the fault seed must vary the
+        # bind-failure coin sequence too
+        self.binder = FlakyBinder(self.store, self.clock,
+                                  fail_rate=cfg.faults.bind_fail_rate,
+                                  latency_s=cfg.faults.api_latency_s,
+                                  seed=cfg.faults.seed)
+        self.evictor = FakeEvictor(self.store)
+        self.cache = SchedulerCache(self.store, binder=self.binder,
+                                    evictor=self.evictor)
+        self.scheduler = Scheduler(self.store, scheduler_conf=cfg.conf_text,
+                                   cache=self.cache, clock=self.clock)
+        self.queue = EventQueue()
+        self.result = SimResult()
+        # job key -> its arrival event (duration/outcome live there)
+        self._job_specs: Dict[str, Event] = {}
+        self._dirty_jobs: set = set()
+        self._ever_ready: set = set()
+        self._completed_scheduled: set = set()
+        # node name -> (cpu, mem, pods) for kill/re-add cycles
+        self._node_catalog: Dict[str, tuple] = {}
+        self._bind_cursor = 0
+        self._failed_bind_cursor = 0
+
+    # -- setup -------------------------------------------------------------
+
+    def _seed_events(self) -> None:
+        cfg = self.cfg
+        if cfg.trace_path:
+            events = load_trace(cfg.trace_path)
+        else:
+            horizon = cfg.ticks * cfg.tick_s
+            events = []
+            events += resident_backlog(cfg.resident_jobs, cfg.resident_gang,
+                                       queue=cfg.queues[0][0])
+            events += synthesize_arrivals(cfg.workload)
+            node_names = [f"node-{i}" for i in range(cfg.n_nodes)]
+            events += synthesize_node_churn(cfg.faults, node_names, horizon)
+            events += synthesize_evict_storms(cfg.faults, horizon)
+        for e in events:
+            self.queue.push(e)
+
+    def _create_base(self) -> None:
+        cfg = self.cfg
+        for name, weight, capability in cfg.queues:
+            self.store.create("queues", build_queue(
+                name, weight=weight, capability=capability))
+        for i in range(cfg.n_nodes):
+            self._add_node(f"node-{i}", cfg.node_cpu, cfg.node_mem,
+                           cfg.node_pods)
+        self.cache.run()
+
+    def _add_node(self, name: str, cpu: str, mem: str, pods: str) -> None:
+        self._node_catalog[name] = (cpu, mem, pods)
+        self.store.create("nodes", build_node(
+            name, {"cpu": cpu, "memory": mem, "pods": pods}))
+
+    # -- event application -------------------------------------------------
+
+    def _apply(self, e: Event) -> None:
+        self.result.events_applied.append(e)
+        kind = e.kind
+        fn = getattr(self, f"_ev_{kind}", None)
+        if fn is None:
+            raise ValueError(f"unknown sim event kind {kind!r}")
+        fn(e)
+
+    def _ev_job_arrival(self, e: Event) -> None:
+        ns, name = e["namespace"], e["name"]
+        self.result.arrived_jobs += 1
+        self._job_specs[f"{ns}/{name}"] = e
+        self.store.create("podgroups", build_pod_group(
+            name, ns, e["queue"], int(e["min_available"]), phase="Inqueue",
+            priority_class=e.get("priority_class", "")))
+        for t in range(int(e["size"])):
+            self.store.create("pods", build_pod(
+                ns, f"{name}-{t}", "", "Pending",
+                {"cpu": e["cpu"], "memory": e["mem"]}, groupname=name))
+
+    def _ev_job_complete(self, e: Event) -> None:
+        ns, name = e["namespace"], e["name"]
+        spec = self._job_specs.get(f"{ns}/{name}")
+        size = int(spec["size"]) if spec is not None else 0
+        for t in range(size):
+            try:
+                self.store.delete("pods", f"{name}-{t}", ns,
+                                  skip_admission=True)
+            except KeyError:
+                pass
+        try:
+            self.store.delete("podgroups", name, ns, skip_admission=True)
+            self.result.completed_jobs += 1
+        except KeyError:
+            pass
+
+    def _ev_pod_fail(self, e: Event) -> None:
+        ns, name, task = e["namespace"], e["name"], int(e["task"])
+        self._dirty_jobs.add(f"{ns}/{name}")
+        try:
+            self.store.delete("pods", f"{name}-{task}", ns,
+                              skip_admission=True)
+        except KeyError:
+            pass
+
+    def _ev_node_add(self, e: Event) -> None:
+        name = e["name"]
+        if self.store.get("nodes", name) is not None:
+            return
+        cpu, mem, pods = self._node_catalog.get(
+            name, (self.cfg.node_cpu, self.cfg.node_mem, self.cfg.node_pods))
+        cpu = e.get("cpu", cpu)
+        mem = e.get("mem", mem)
+        pods = e.get("pods", pods)
+        self._add_node(name, cpu, mem, pods)
+
+    def _ev_node_drain(self, e: Event) -> None:
+        node = self.store.get("nodes", e["name"])
+        if node is None:
+            return
+        node.spec.unschedulable = True
+        self.store.update("nodes", node, skip_admission=True)
+
+    def _ev_node_undrain(self, e: Event) -> None:
+        node = self.store.get("nodes", e["name"])
+        if node is None:
+            return
+        node.spec.unschedulable = False
+        self.store.update("nodes", node, skip_admission=True)
+
+    def _ev_node_kill(self, e: Event) -> None:
+        name = e["name"]
+        if self.store.get("nodes", name) is None:
+            return
+        # resident pods die with the node (lost VM) — keeping them would
+        # manufacture orphaned bindings the checker rightly flags
+        for p in self.store.list_refs("pods"):
+            if p.spec.node_name == name:
+                self._dirty_jobs.add(
+                    f"{p.metadata.namespace}/"
+                    f"{self._job_of_pod(p.metadata.name)}")
+                try:
+                    self.store.delete("pods", p.metadata.name,
+                                      p.metadata.namespace,
+                                      skip_admission=True)
+                except KeyError:
+                    pass
+        self.store.delete("nodes", name, skip_admission=True)
+
+    def _ev_evict_storm(self, e: Event) -> None:
+        for key in apply_evict_storm(self.store, e):
+            ns, pod_name = key.split("/", 1)
+            self._dirty_jobs.add(f"{ns}/{self._job_of_pod(pod_name)}")
+
+    def _ev_fault_set(self, e: Event) -> None:
+        if "bind_fail_rate" in e:
+            self.binder.fail_rate = float(e["bind_fail_rate"])
+        if "api_latency_s" in e:
+            self.binder.latency_s = float(e["api_latency_s"])
+
+    @staticmethod
+    def _job_of_pod(pod_name: str) -> str:
+        # pod names are "<job>-<index>" by construction
+        return pod_name.rsplit("-", 1)[0]
+
+    # -- kubelet + lifecycle -----------------------------------------------
+
+    def _kubelet_step(self) -> None:
+        """Bound Pending pods become Running; a fully-bound gang gets its
+        completion (and optional mid-run pod failure) scheduled once, at
+        bind time + its arrival-drawn duration."""
+        now = self.clock.now()
+        # scan live refs (no clone), re-fetch only the few pods actually
+        # transitioning — newly-bound pods per tick, not the whole cluster
+        for ref in self.store.list_refs("pods"):
+            if ref.spec.node_name and ref.status.phase == "Pending":
+                p = self.store.get("pods", ref.metadata.name,
+                                   ref.metadata.namespace)
+                if p is None or not p.spec.node_name:
+                    continue
+                p.status.phase = "Running"
+                self.store.update("pods", p, skip_admission=True)
+        for jkey, job in list(self.cache.jobs.items()):
+            if job.pod_group is None or jkey in self._completed_scheduled:
+                continue
+            spec = self._job_specs.get(jkey)
+            if spec is None:
+                continue
+            if allocated_task_count(job) < int(spec["min_available"]):
+                continue
+            self._ever_ready.add(jkey)
+            self._completed_scheduled.add(jkey)
+            duration = float(spec.get("duration", 60.0))
+            ns, name = jkey.split("/", 1)
+            # deterministic per-job outcome: crc32 keeps it independent of
+            # PYTHONHASHSEED (hash() of str is per-process randomized)
+            fails = self.cfg.fail_rate > 0 and (
+                (zlib.crc32(jkey.encode()) ^ self.cfg.seed) % 10_000
+                < self.cfg.fail_rate * 10_000)
+            if fails:
+                self.queue.push(make_event(
+                    now + duration * 0.3, "pod_fail", namespace=ns,
+                    name=name, task=0))
+            self.queue.push(make_event(
+                now + duration, "job_complete", namespace=ns, name=name))
+
+    def _absorb_bind_failures(self) -> None:
+        failed = self.binder.failed_keys
+        while self._failed_bind_cursor < len(failed):
+            key = failed[self._failed_bind_cursor]
+            self._failed_bind_cursor += 1
+            ns, pod_name = key.split("/", 1)
+            self._dirty_jobs.add(f"{ns}/{self._job_of_pod(pod_name)}")
+
+    def _collect_binds(self) -> int:
+        chan = self.binder.channel
+        new = 0
+        while self._bind_cursor < len(chan):
+            key = chan[self._bind_cursor]
+            self._bind_cursor += 1
+            self.result.bind_sequence.append((key, self.binder.binds[key]))
+            new += 1
+        return new
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self) -> SimResult:
+        from ..trace import tracer
+        cfg = self.cfg
+        trace_was_on = tracer.is_enabled()
+        tracer.enable()
+        try:
+            self._create_base()
+            self._seed_events()
+            for tick in range(cfg.ticks):
+                self.clock.advance(cfg.tick_s)
+                events = self.queue.pop_until(self.clock.now())
+                for e in events:
+                    self._apply(e)
+                queues_over = queues_over_capability(self.cache) \
+                    if cfg.check_invariants else set()
+                t0 = time.perf_counter()
+                self.scheduler.run_once()
+                cycle_ms = (time.perf_counter() - t0) * 1000.0
+                if not self.cache.flush_executors(
+                        timeout=cfg.flush_timeout_s):
+                    raise RuntimeError(
+                        f"tick {tick}: executor flush timed out")
+                # charge the tick's accumulated virtual API latency here,
+                # on the engine thread, after the flush barrier — see
+                # FlakyBinder.take_pending_latency
+                self.clock.advance(self.binder.take_pending_latency())
+                self._absorb_bind_failures()
+                new_binds = self._collect_binds()
+                violations: List[Violation] = []
+                if cfg.check_invariants:
+                    ctx = CycleContext(
+                        store=self.store, cache=self.cache, tick=tick,
+                        dirty_jobs=self._dirty_jobs,
+                        ever_ready=self._ever_ready,
+                        queues_over_before=queues_over)
+                    violations = check_all(ctx)
+                    # ever_ready updates AFTER the check: a gang must be
+                    # complete the first tick it shows up allocated
+                    for jkey, job in self.cache.jobs.items():
+                        if job.pod_group is not None and \
+                                allocated_task_count(job) >= \
+                                max(1, job.min_available):
+                            self._ever_ready.add(jkey)
+                # simulated kubelet runs after the audit: the checkers see
+                # the scheduler's output state, not the lifecycle echo
+                self._kubelet_step()
+                self.result.ticks.append(TickStats(
+                    tick=tick, vtime=self.clock.now(), cycle_ms=cycle_ms,
+                    events=len(events), new_binds=new_binds,
+                    pods=len(self.store.list_refs("pods")),
+                    nodes=len(self.store.list_refs("nodes")),
+                    violations=len(violations)))
+                if violations:
+                    for v in violations:
+                        self.result.violations.append((tick, v))
+                        log.error("sim tick %d invariant violation: %s",
+                                  tick, v)
+                    if cfg.repro_dir:
+                        from .replay import write_repro_bundle
+                        self.result.repro_paths.append(write_repro_bundle(
+                            cfg.repro_dir, self, tick, violations))
+                    if cfg.stop_on_violation:
+                        break
+            return self.result
+        finally:
+            if not trace_was_on:
+                tracer.disable()
+            self.scheduler.stop()
+            self.cache.stop()
+
+
+def run_sim(cfg: SimConfig) -> SimResult:
+    return SimEngine(cfg).run()

@@ -1,0 +1,150 @@
+"""Fault injection for the simulator: flaky binds, API latency, node
+churn schedules, evict storms.
+
+Two layers:
+
+* **Live injectors** — :class:`FlakyBinder` wraps the recording binder
+  with a seeded per-bind failure coin and a virtual-clock latency charge;
+  failures take the production resync path (cache.resync_task →
+  process_resync_tasks), which is exactly the machinery the simulator
+  exists to stress.
+* **Scheduled faults** — :func:`synthesize_node_churn` /
+  :func:`synthesize_evict_storms` emit plain events (drain/undrain,
+  kill/re-add, storms) from a seeded RNG so they ride the same replayable
+  stream as arrivals.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import List
+
+from ..utils.clock import Clock
+from ..utils.test_utils import FakeBinder
+from .events import Event, make_event
+
+
+@dataclass
+class FaultConfig:
+    seed: int = 0
+    bind_fail_rate: float = 0.0      # per-pod store-bind failure probability
+    api_latency_s: float = 0.0       # virtual seconds charged per store bind
+    # node churn (over the workload horizon)
+    flap_rate: float = 0.0           # drain+undrain pairs per virtual second
+    flap_down_s: float = 5.0         # how long a flapped node stays drained
+    kill_rate: float = 0.0           # node kill + re-add pairs per second
+    kill_down_s: float = 10.0
+    # evict storms
+    storm_rate: float = 0.0          # storms per virtual second
+    storm_fraction: float = 0.1      # fraction of bound pods deleted
+
+
+class FlakyBinder(FakeBinder):
+    """Recording binder with deterministic failure + latency injection.
+
+    Failure decisions come from one seeded RNG consumed in bind order;
+    the cache executor is a single FIFO worker and the engine flushes it
+    every tick, so the coin-flip sequence — and therefore the whole run —
+    is reproducible from the seed. Failed binds raise (landing the task
+    in the resync queue) and are recorded in ``failed_keys`` so the
+    invariant checker can exempt their gangs from the atomicity rule.
+    """
+
+    def __init__(self, store, clock: Clock, fail_rate: float = 0.0,
+                 latency_s: float = 0.0, seed: int = 0):
+        super().__init__(store)
+        self.clock = clock
+        self.fail_rate = fail_rate
+        self.latency_s = latency_s
+        self._rng = random.Random(seed ^ 0x5EED)
+        self.failed_keys: List[str] = []
+        self.attempts = 0
+        # latency is ACCUMULATED here and charged to the clock by the
+        # engine at the tick boundary (after the executor flush), never
+        # from the executor thread: a mid-cycle clock mutation would
+        # race concurrent ssn.clock.now() reads by time-dependent
+        # plugins and break the bit-identical-replay contract
+        self.pending_latency_s = 0.0
+
+    def take_pending_latency(self) -> float:
+        """Drain the accumulated virtual API latency. Called by the
+        engine after flush_executors() — the flush barrier is the
+        synchronization point, so no lock is needed."""
+        charged, self.pending_latency_s = self.pending_latency_s, 0.0
+        return charged
+
+    def bind(self, pod, hostname: str) -> None:
+        self.attempts += 1
+        if self.latency_s:
+            self.pending_latency_s += self.latency_s  # virtual round-trip
+        if self.fail_rate and self._rng.random() < self.fail_rate:
+            key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+            self.failed_keys.append(key)
+            raise RuntimeError(f"injected bind failure for {key}")
+        super().bind(pod, hostname)
+
+
+def synthesize_node_churn(cfg: FaultConfig, node_names: List[str],
+                          horizon_s: float,
+                          start_at: float = 0.0) -> List[Event]:
+    """Drain/undrain flaps and kill/re-add cycles over ``horizon_s``.
+
+    Every down event is paired with its recovery up front, so a dumped
+    trace carries the full schedule (no RNG at apply time). Node specs
+    for re-adds are resolved by the engine from its node catalog.
+    """
+    rng = random.Random(cfg.seed ^ 0xF1A9)
+    events: List[Event] = []
+    for rate, down_s, down_kind, up_kind in (
+            (cfg.flap_rate, cfg.flap_down_s, "node_drain", "node_undrain"),
+            (cfg.kill_rate, cfg.kill_down_s, "node_kill", "node_add")):
+        if rate <= 0 or not node_names:
+            continue
+        t = start_at
+        while True:
+            t += rng.expovariate(rate)
+            if t > start_at + horizon_s:
+                break
+            name = rng.choice(node_names)
+            events.append(make_event(t, down_kind, name=name))
+            events.append(make_event(t + down_s, up_kind, name=name))
+    return events
+
+
+def synthesize_evict_storms(cfg: FaultConfig, horizon_s: float,
+                            start_at: float = 0.0) -> List[Event]:
+    """Periodic storms deleting a seeded fraction of bound pods (the
+    kubelet-pressure / node-OOM analogue)."""
+    if cfg.storm_rate <= 0:
+        return []
+    rng = random.Random(cfg.seed ^ 0x5702)
+    events: List[Event] = []
+    t = start_at
+    while True:
+        t += rng.expovariate(cfg.storm_rate)
+        if t > start_at + horizon_s:
+            break
+        events.append(make_event(t, "evict_storm",
+                                 fraction=cfg.storm_fraction,
+                                 seed=rng.randrange(1 << 30)))
+    return events
+
+
+def apply_evict_storm(store, event: Event) -> List[str]:
+    """Delete ``fraction`` of currently bound pods, chosen by the event's
+    own seed over the key-sorted pod list (order-independent of store
+    internals). Returns the deleted keys."""
+    bound = sorted((p.metadata.namespace, p.metadata.name)
+                   for p in store.list_refs("pods") if p.spec.node_name)
+    rng = random.Random(int(event.get("seed", 0)))
+    k = int(len(bound) * float(event.get("fraction", 0.0)))
+    victims = rng.sample(bound, k) if k else []
+    deleted: List[str] = []
+    for ns, name in victims:
+        try:
+            store.delete("pods", name, ns, skip_admission=True)
+            deleted.append(f"{ns}/{name}")
+        except KeyError:
+            pass
+    return deleted

@@ -1,0 +1,292 @@
+"""Invariant catalog audited after every simulator tick.
+
+Each checker is a standalone function taking a :class:`CycleContext` and
+returning :class:`Violation` records (empty = clean), so the test suite
+can aim a deliberately-broken fixture at each one individually. The
+engine runs the full catalog after flushing the executors, when cache,
+store and snapshot are supposed to agree.
+
+Catalog (docs/design/simulation.md carries the prose version):
+
+* ``node_accounting`` — no node overcommit: per-node ``idle >= 0`` on
+  every dimension, ``used`` equals the sum of resident non-pipelined task
+  requests, ``idle + used == allocatable``, and no task resident on two
+  nodes.
+* ``gang_atomicity`` — a job never sits partially bound below its
+  ``minAvailable``: excluding gangs hit by churn/faults this run, the
+  allocated-status task count is either 0 or >= minAvailable.
+* ``queue_quota`` — a queue with a capability never crosses it through
+  *scheduler* action: if it was within capability before the cycle, new
+  binds must not push its allocated total beyond capability.
+* ``no_orphans`` — every bound store pod's node exists (store + cache)
+  and accounts for it; every allocated cache task's pod still exists in
+  the store.
+* ``snapshot_coherence`` — the per-cycle snapshot agrees with the live
+  cache and the store: task keysets match, snapshot nodes are exactly
+  the ready cache nodes, and cloned idle equals live idle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from ..models.job_info import TaskStatus, allocated_status
+from ..models.resource import Resource
+
+EPS = 0.5
+
+
+@dataclass
+class Violation:
+    invariant: str
+    detail: str
+
+    def __str__(self) -> str:
+        return f"[{self.invariant}] {self.detail}"
+
+
+@dataclass
+class CycleContext:
+    """Everything the checkers need about one audited tick."""
+    store: object
+    cache: object
+    tick: int = 0
+    # job keys ("ns/pg-name") whose gangs were hit by churn or injected
+    # faults at any point — exempt from the gang-atomicity rule (a pod
+    # delete or failed bind legitimately leaves a partial gang)
+    dirty_jobs: Set[str] = field(default_factory=set)
+    # jobs that reached >= minAvailable in an earlier tick (a completing
+    # gang draining down is not an atomicity violation)
+    ever_ready: Set[str] = field(default_factory=set)
+    # queue names already beyond capability before the cycle ran
+    # (grandfathered: node churn can strand a queue over its cap; only
+    # the scheduler *pushing* it over is a violation)
+    queues_over_before: Set[str] = field(default_factory=set)
+    snapshot: Optional[object] = None
+
+
+def _dims(r: Resource) -> Dict[str, float]:
+    d = {"cpu": r.milli_cpu, "memory": r.memory}
+    d.update(r.scalars)
+    return d
+
+
+def _res_delta(a: Resource, b: Resource) -> Dict[str, float]:
+    da, db = _dims(a), _dims(b)
+    return {k: da.get(k, 0.0) - db.get(k, 0.0)
+            for k in set(da) | set(db)}
+
+
+def allocated_task_count(job) -> int:
+    return sum(len(tasks) for status, tasks in job.task_status_index.items()
+               if allocated_status(status))
+
+
+def queue_allocated(cache) -> Dict[str, Resource]:
+    """Per-queue total of allocated-status task requests (cache view)."""
+    totals: Dict[str, Resource] = {}
+    for job in cache.jobs.values():
+        if job.pod_group is None:
+            continue
+        for task in job.tasks.values():
+            if not allocated_status(task.status):
+                continue
+            totals.setdefault(job.queue, Resource()).add(task.resreq)
+    return totals
+
+
+def queues_over_capability(cache, eps: float = EPS) -> Set[str]:
+    over: Set[str] = set()
+    totals = queue_allocated(cache)
+    for name, q in cache.queues.items():
+        cap = q.queue.spec.capability
+        if not cap:
+            continue
+        cap_r = Resource.from_resource_list(cap)
+        total = totals.get(name, Resource())
+        # constrain only dims the capability NAMES (raw resource-list
+        # keys, which match _dims' naming): Resource zero-fills missing
+        # dims, and a cpu-only capability read as memory=0 would mark
+        # the queue over-capability from tick 0 — grandfathering it out
+        # of the check forever
+        named = set(cap)
+        if any(v > eps for k, v in _res_delta(total, cap_r).items()
+               if k in named):
+            over.add(name)
+    return over
+
+
+# -- checkers ---------------------------------------------------------------
+
+
+def check_node_accounting(ctx: CycleContext,
+                          eps: float = EPS) -> List[Violation]:
+    out: List[Violation] = []
+    cache = ctx.cache
+    seen: Dict[str, str] = {}
+    for node in cache.nodes.values():
+        used = Resource()
+        for key, task in node.tasks.items():
+            if key in seen:
+                out.append(Violation(
+                    "node_accounting",
+                    f"task {key} resident on both {seen[key]} and "
+                    f"{node.name}"))
+            seen[key] = node.name
+            if task.status != TaskStatus.Pipelined:
+                used.add(task.resreq)
+        for dim, dv in _res_delta(node.used, used).items():
+            if abs(dv) > eps:
+                out.append(Violation(
+                    "node_accounting",
+                    f"node {node.name} used[{dim}] drifted {dv:+.3f} from "
+                    f"its resident tasks"))
+        for dim, v in _dims(node.idle).items():
+            if v < -eps:
+                out.append(Violation(
+                    "node_accounting",
+                    f"node {node.name} overcommitted: idle[{dim}]={v:.3f}"))
+        total = node.idle.clone().add(node.used)
+        for dim, dv in _res_delta(total, node.allocatable).items():
+            if abs(dv) > eps:
+                out.append(Violation(
+                    "node_accounting",
+                    f"node {node.name} idle+used != allocatable on {dim} "
+                    f"(delta {dv:+.3f})"))
+    return out
+
+
+def check_gang_atomicity(ctx: CycleContext) -> List[Violation]:
+    out: List[Violation] = []
+    for key, job in ctx.cache.jobs.items():
+        if job.pod_group is None or job.min_available <= 0:
+            continue
+        if key in ctx.dirty_jobs or key in ctx.ever_ready:
+            continue
+        allocated = allocated_task_count(job)
+        if 0 < allocated < job.min_available:
+            out.append(Violation(
+                "gang_atomicity",
+                f"job {key} partially bound: {allocated}/"
+                f"{job.min_available} allocated (gang of "
+                f"{len(job.tasks)})"))
+    return out
+
+
+def check_queue_quota(ctx: CycleContext, eps: float = EPS) -> List[Violation]:
+    out: List[Violation] = []
+    over_now = queues_over_capability(ctx.cache, eps)
+    for name in over_now - ctx.queues_over_before:
+        q = ctx.cache.queues.get(name)
+        cap = q.queue.spec.capability if q is not None else None
+        out.append(Violation(
+            "queue_quota",
+            f"queue {name} pushed beyond capability {cap} by this cycle's "
+            "binds"))
+    return out
+
+
+def check_no_orphans(ctx: CycleContext) -> List[Violation]:
+    out: List[Violation] = []
+    store, cache = ctx.store, ctx.cache
+    # list_refs: read-only audit over live store objects (no clones —
+    # cloning the whole cluster per tick would dwarf the audited cycle)
+    store_pods = {p.metadata.key(): p for p in store.list_refs("pods")}
+    store_nodes = {n.metadata.name for n in store.list_refs("nodes")}
+    for key, pod in store_pods.items():
+        if not pod.spec.node_name or is_terminated_phase(pod):
+            continue
+        if pod.spec.node_name not in store_nodes:
+            out.append(Violation(
+                "no_orphans",
+                f"pod {key} bound to node {pod.spec.node_name} which is "
+                "gone from the store"))
+            continue
+        node = cache.nodes.get(pod.spec.node_name)
+        if node is None:
+            out.append(Violation(
+                "no_orphans",
+                f"pod {key} bound to node {pod.spec.node_name} unknown to "
+                "the cache"))
+        elif key not in node.tasks:
+            out.append(Violation(
+                "no_orphans",
+                f"pod {key} bound to {pod.spec.node_name} but not "
+                "accounted on it"))
+    for job in cache.jobs.values():
+        for task in job.tasks.values():
+            if allocated_status(task.status) and task.key() not in store_pods:
+                out.append(Violation(
+                    "no_orphans",
+                    f"cache task {task.key()} ({task.status.name}) has no "
+                    "store pod"))
+    return out
+
+
+def is_terminated_phase(pod) -> bool:
+    return pod.status.phase in ("Succeeded", "Failed")
+
+
+def check_snapshot_coherence(ctx: CycleContext,
+                             eps: float = EPS) -> List[Violation]:
+    out: List[Violation] = []
+    store, cache = ctx.store, ctx.cache
+    snap = ctx.snapshot if ctx.snapshot is not None else cache.snapshot()
+    # cache tasks mirror store pods exactly (this scheduler's pods)
+    cache_keys = {t.key() for j in cache.jobs.values()
+                  for t in j.tasks.values()}
+    store_keys = {p.metadata.key() for p in store.list_refs("pods")
+                  if p.spec.scheduler_name == cache.scheduler_name}
+    for key in cache_keys - store_keys:
+        out.append(Violation("snapshot_coherence",
+                             f"cache task {key} has no store pod"))
+    for key in store_keys - cache_keys:
+        out.append(Violation("snapshot_coherence",
+                             f"store pod {key} missing from the cache"))
+    # snapshot nodes are exactly the ready cache nodes, with equal idle
+    ready = {n.name for n in cache.nodes.values() if n.ready()}
+    snap_nodes = set(snap.nodes)
+    for name in ready ^ snap_nodes:
+        out.append(Violation(
+            "snapshot_coherence",
+            f"node {name} {'missing from' if name in ready else 'extra in'}"
+            " the snapshot"))
+    for name in ready & snap_nodes:
+        delta = _res_delta(snap.nodes[name].idle, cache.nodes[name].idle)
+        for dim, dv in delta.items():
+            if abs(dv) > eps:
+                out.append(Violation(
+                    "snapshot_coherence",
+                    f"snapshot idle[{dim}] of {name} drifted {dv:+.3f} "
+                    "from the live cache"))
+    # snapshot jobs carry the same task sets as the live cache
+    for uid, sjob in snap.jobs.items():
+        cjob = cache.jobs.get(uid)
+        if cjob is None:
+            out.append(Violation("snapshot_coherence",
+                                 f"snapshot job {uid} not in the cache"))
+            continue
+        if set(sjob.tasks) != set(cjob.tasks):
+            out.append(Violation(
+                "snapshot_coherence",
+                f"snapshot job {uid} task set drifted "
+                f"({len(sjob.tasks)} vs {len(cjob.tasks)})"))
+    return out
+
+
+CHECKERS = (check_node_accounting, check_gang_atomicity, check_queue_quota,
+            check_no_orphans, check_snapshot_coherence)
+
+
+def check_all(ctx: CycleContext) -> List[Violation]:
+    """Run the whole catalog under the cache lock (the engine calls this
+    between cycles, when the executors are flushed; the RLock makes the
+    nested ``snapshot()`` reentrant)."""
+    out: List[Violation] = []
+    with ctx.cache.mutex:
+        if ctx.snapshot is None:
+            ctx.snapshot = ctx.cache.snapshot()
+        for checker in CHECKERS:
+            out.extend(checker(ctx))
+    return out

@@ -1,0 +1,79 @@
+"""Repro bundles + deterministic replay.
+
+A bundle is a directory holding everything needed to re-run a failing
+simulation up to the violating tick:
+
+* ``bundle.json`` — ``{seed, tick, config, violations, bind_fingerprint,
+  events_applied}`` (the one-line repro is the ``{seed, tick}`` pair:
+  same config + same seed reproduces the identical bind sequence)
+* ``events.jsonl`` — the applied event stream, verbatim, in application
+  order (replayable standalone via ``SimConfig(trace_path=...)``)
+* ``trace.json`` — the offending cycle's flight-recorder export
+  (Chrome trace-event JSON, Perfetto-loadable), when the tracer has a
+  record
+
+``replay_bundle()`` reconstructs the config and re-runs it; because the
+generators are seeded the re-run needs nothing but ``bundle.json``, and
+the event stream is carried anyway so a bundle stays replayable even if
+generator code drifts (``use_trace=True``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from .workload import dump_trace
+
+
+def write_repro_bundle(base_dir: str, engine, tick: int,
+                       violations) -> str:
+    """Dump a replayable bundle for a violation at ``tick``; returns the
+    bundle directory path."""
+    from ..trace import tracer
+    cfg = engine.cfg
+    path = os.path.join(base_dir,
+                        f"sim_repro_seed{cfg.seed}_tick{tick}")
+    os.makedirs(path, exist_ok=True)
+    dump_trace(os.path.join(path, "events.jsonl"),
+               engine.result.events_applied)
+    bundle = {
+        "seed": cfg.seed,
+        "tick": tick,
+        "repro": f"vcctl sim replay --bundle {path}",
+        "config": cfg.to_dict(),
+        "violations": [{"invariant": v.invariant, "detail": v.detail}
+                       for v in violations],
+        "bind_fingerprint": engine.result.bind_fingerprint(),
+        "binds": len(engine.result.bind_sequence),
+        "events_applied": len(engine.result.events_applied),
+    }
+    with open(os.path.join(path, "bundle.json"), "w") as f:
+        json.dump(bundle, f, indent=1, sort_keys=True)
+    rec = tracer.last_record()
+    if rec is not None:
+        with open(os.path.join(path, "trace.json"), "w") as f:
+            json.dump(tracer.chrome_trace(rec), f)
+    return path
+
+
+def load_bundle(path: str) -> dict:
+    with open(os.path.join(path, "bundle.json")) as f:
+        return json.load(f)
+
+
+def replay_bundle(path: str, use_trace: bool = False,
+                  ticks: Optional[int] = None):
+    """Re-run a bundle's simulation: seeded re-generation by default, or
+    the recorded event stream verbatim (``use_trace=True``). Runs up to
+    (and including) the violating tick unless ``ticks`` overrides.
+    Returns the new :class:`volcano_tpu.sim.engine.SimResult`."""
+    from .engine import SimConfig, run_sim
+    bundle = load_bundle(path)
+    cfg = SimConfig.from_dict(bundle["config"])
+    cfg.ticks = ticks if ticks is not None else int(bundle["tick"]) + 1
+    if use_trace:
+        cfg.trace_path = os.path.join(path, "events.jsonl")
+    cfg.repro_dir = None   # a replay must not recursively dump bundles
+    return run_sim(cfg)
