@@ -3,7 +3,7 @@
 PYTHON ?= python
 
 .PHONY: test unit-test e2e bench bench-all multichip-dryrun deploy deploy-up \
-	trace-smoke sim-smoke
+	trace-smoke sim-smoke flush-bench
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -43,13 +43,23 @@ trace-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_trace.py -q \
 		-k "smoke or overhead"
 
+# bind-flush micro-gate: a 5k-bind coalesced flush through the
+# production cache + store (sharded two-phase patch_batch, bulk echo
+# ingest), run TWICE on fresh envs — exit 1 unless the journal / rv /
+# bind fingerprints are bit-identical (the sharded pipeline's
+# determinism contract, docs/design/bind_pipeline.md). Seconds.
+flush-bench:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/flush_bench.py
+
 # churn-simulator smoke gate: 200 virtual-time ticks of seeded churn
 # (>=2k tasks through 512 nodes, node flaps + bind-failure + evict-storm
 # injection) with the invariant catalog on, run TWICE — the second run
 # must reproduce the first's bind sequence bit-identically. Exit 1 on
 # any invariant violation (a repro bundle lands in CWD) or determinism
-# break. ~55 s on an idle machine.
-sim-smoke:
+# break. ~55 s on an idle machine. Runs the flush-bench double-run
+# first: the sharded bind flush must prove its determinism before the
+# sim's own double-run relies on it.
+sim-smoke: flush-bench
 	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli smoke
 
 # multi-chip sharding dryrun on the virtual CPU mesh

@@ -136,7 +136,11 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
     t0 = time.perf_counter()
     _run_cycle(cache, conf)
     log(f"cold cycle (incl compile): {time.perf_counter() - t0:.1f}s")
-    cache.flush_executors(timeout=900)
+    flush_timeout = not cache.flush_executors(timeout=900)
+    cache.stop()   # the executor thread pins the whole env alive; a bare
+    #                del leaks every 50k-object env for the process
+    #                lifetime and the leak's heap pressure is what the
+    #                measured runs were supposed to be isolated from
     del store, cache, binder
 
     best = None
@@ -151,8 +155,13 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
         rec = tracer.last_record()
         kernel_ms = kernel_total() - k0
         t0 = time.perf_counter()
-        c2.flush_executors(timeout=900)
+        flushed = c2.flush_executors(timeout=900)
         flush_ms = (time.perf_counter() - t0) * 1000.0
+        if not flushed:
+            # a truncated flush_ms would quietly flatter the number — a
+            # timed-out flush must fail the bench, not shade it
+            log(f"warm {i + 1}/{runs}: executor flush TIMED OUT")
+            flush_timeout = True
         steady = min(_run_cycle(c2, cf2) for _ in range(2))
         log(f"warm {i + 1}/{runs}: cycle={ms:.1f} ms kernel={kernel_ms:.1f} "
             f"ms flush={flush_ms:.1f} ms steady={steady:.1f} ms "
@@ -163,9 +172,15 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
                     "binds": len(b2.binds),
                     "platform": devs[0].platform}
             best_rec = rec
+        c2.stop()   # see the cold-env note: a leaked executor thread
+        #             keeps the env resident and run i+1 pays run i's heap
         del s2, c2, b2
     if best_rec is not None:
         best["phases"] = tracer.flat_phases(best_rec)
+        # where the flush time goes: the executor-side span tree of the
+        # winning cycle (bind_flush.apply / bind_flush.store / nested
+        # echo-ingest + store publish sub-phases)
+        best["flush_phases"] = tracer.async_phases(best_rec)
         best["trace_coverage"] = tracer.summary(best_rec)["coverage"]
         if os.environ.get("VOLCANO_BENCH_DUMP_TRACE"):
             path = os.path.join(os.getcwd(),
@@ -173,6 +188,11 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
             with open(path, "w") as f:
                 json.dump(tracer.chrome_trace(best_rec), f)
             log(f"chrome trace of winning cycle: {path}")
+    if flush_timeout:
+        best = best or {}
+        best["flush_timeout"] = True
+        print(json.dumps(best))
+        sys.exit(1)
     print(json.dumps(best))
 
 
@@ -257,15 +277,23 @@ def try_cycle_worker(platform: str, n_tasks: int, n_nodes: int):
         return None
     for line in (r.stderr or "").splitlines():
         print(line, file=sys.stderr)
+    parsed = None
+    try:
+        parsed = json.loads((r.stdout or "").strip().splitlines()[-1])
+    except Exception:
+        pass
     if r.returncode != 0:
+        # a worker that failed LOUDLY with a structured verdict (executor
+        # flush timeout) must propagate it, not fall down the ladder to a
+        # reduced shape that would mask the hang
+        if isinstance(parsed, dict) and parsed.get("flush_timeout"):
+            return parsed
         log(f"cycle worker rc={r.returncode}; "
             f"stdout tail: {(r.stdout or '')[-200:]!r}")
         return None
-    try:
-        return json.loads((r.stdout or "").strip().splitlines()[-1])
-    except Exception:
+    if parsed is None:
         log(f"cycle worker output unparseable: {(r.stdout or '')[-200:]!r}")
-        return None
+    return parsed
 
 
 def sim_worker(seed: int, ticks: int, n_nodes: int) -> None:
@@ -469,11 +497,18 @@ def main() -> None:
                 if platform == "tpu":
                     tpu_failures += 1
                 continue
-            cycle_ms = float(res["cycle_ms"])
             full = (n_tasks, n_nodes) == (N_TASKS, N_NODES)
             name = "schedule_cycle_latency_50k_tasks_x_10k_nodes" if full \
                 else (f"schedule_cycle_latency_{n_tasks}_tasks_x_"
                       f"{n_nodes}_nodes_REDUCED")
+            if res.get("flush_timeout"):
+                # label the timeout with the shape that actually ran —
+                # the ladder may have shrunk below the headline config
+                res["metric"] = name
+                res.setdefault("unit", "ms")
+                print(json.dumps(res))
+                sys.exit(1)
+            cycle_ms = float(res["cycle_ms"])
             print(json.dumps({
                 "metric": name,
                 "value": round(cycle_ms, 2),
@@ -494,6 +529,10 @@ def main() -> None:
                 # per-phase attribution from the flight recorder
                 # (volcano_tpu/trace): '/'-joined span paths -> {ms, count}
                 "phases": res.get("phases"),
+                # executor-side flush attribution (bind_flush.apply /
+                # bind_flush.store with nested publish + echo-ingest
+                # sub-phases) so BENCH_r* tracks WHERE flush time goes
+                "flush_phases": res.get("flush_phases"),
                 "trace_coverage": res.get("trace_coverage"),
             }))
             return

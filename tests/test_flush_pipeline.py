@@ -1,0 +1,441 @@
+"""The sharded, pipelined bind-flush (docs/design/bind_pipeline.md).
+
+Covers the store's two-phase patch engine — serial vs sharded
+equivalence, rv reservation + journal ordering under interleaved
+writers, the write barrier on in-flight keys, filter-flip watch
+semantics on every delivery path — the native bind-clone parity, and a
+concurrency stress (`-m flushstress`) asserting rv monotonicity,
+journal order and the sim's node-accounting invariants under the
+parallel flush.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from volcano_tpu.apiserver import ObjectStore
+from volcano_tpu.utils.test_utils import (FakeBinder, FakeEvictor,
+                                          build_node, build_pod,
+                                          build_pod_group, build_queue)
+
+FLIP_KEY = "volcano.sh/test-filter-flip"
+
+
+def sharded(store: ObjectStore, target: int = 2, cap: int = 4) -> ObjectStore:
+    """Force the sharded path for tiny bursts (instance attrs shadow the
+    class tuning)."""
+    store.SHARD_SERIAL_MAX = 0
+    store.SHARD_TARGET = target
+    store.SHARD_MAX = cap
+    return store
+
+
+def store_with_pods(n: int) -> ObjectStore:
+    store = ObjectStore()
+    for i in range(n):
+        store.create("pods", build_pod("ns1", f"p{i:03d}", "", "Pending",
+                                       {"cpu": "1", "memory": "1Gi"}))
+    return store
+
+
+def journal_rvs(store: ObjectStore) -> list:
+    with store._lock:
+        return [e[0] for e in store._journal]
+
+
+def assert_journal_clean(store: ObjectStore) -> None:
+    rvs = journal_rvs(store)
+    assert all(b - a == 1 for a, b in zip(rvs, rvs[1:])), rvs
+    with store._lock:
+        assert store._journal_tail == store._rv
+        assert not store._journal_parked
+        assert not any(store._inflight.values())
+
+
+def setter(host):
+    def fn(p):
+        p.spec.node_name = host
+    return fn
+
+
+class TestShardedEngine:
+    def test_sharded_matches_serial(self):
+        """Same burst through the serial and the sharded path: identical
+        stored objects, rvs, journal and delivery pairs."""
+        n = 12
+        results = []
+        for force in (False, True):
+            store = store_with_pods(n)
+            if force:
+                sharded(store, target=3)
+            bulk = []
+            store.watch("pods", on_bulk_update=lambda ps: bulk.extend(ps),
+                        sync=False)
+            pairs, missing = store.patch_batch(
+                "pods", [(f"p{i:03d}", "ns1", setter(f"n{i % 4}"))
+                         for i in range(n)] + [("ghost", "ns1", setter("x"))])
+            assert missing == [("ghost", "ns1")]
+            assert_journal_clean(store)
+            results.append((
+                [(o.metadata.name, new.spec.node_name,
+                  new.metadata.resource_version) for o, new in pairs],
+                [(o.metadata.name, new.metadata.resource_version)
+                 for o, new in bulk],
+                [(p.metadata.name, p.spec.node_name,
+                  p.metadata.resource_version)
+                 for p in sorted(store.list_refs("pods"),
+                                 key=lambda p: p.metadata.name)],
+            ))
+        assert results[0] == results[1]
+
+    def test_bind_pods_matches_patch_batch(self):
+        """bind_pods (native batch clone) and patch_batch (python clone)
+        produce identical stored state."""
+        outs = []
+        for use_bind in (False, True):
+            store = sharded(store_with_pods(10), target=3)
+            if use_bind:
+                pairs, missing = store.bind_pods(
+                    [(f"p{i:03d}", "ns1", f"n{i % 3}") for i in range(10)]
+                    + [("ghost", "ns1", "nx")])
+            else:
+                pairs, missing = store.patch_batch(
+                    "pods", [(f"p{i:03d}", "ns1", setter(f"n{i % 3}"))
+                             for i in range(10)]
+                    + [("ghost", "ns1", setter("nx"))])
+            assert missing == [("ghost", "ns1")]
+            assert_journal_clean(store)
+            outs.append([(p.metadata.name, p.spec.node_name,
+                          p.metadata.resource_version)
+                        for p in sorted(store.list_refs("pods"),
+                                        key=lambda p: p.metadata.name)])
+        assert outs[0] == outs[1]
+
+    def test_bind_pods_clone_shares_immutable_subtrees(self):
+        """The bind clone (native or python) must share everything but
+        the metadata/spec shells with the stored object — the
+        immutable-stored-object contract the pipeline relies on."""
+        store = store_with_pods(3)
+        with store._lock:
+            olds = {k: v for k, v in store._objects["pods"].items()}
+        store.bind_pods([(f"p{i:03d}", "ns1", "n0") for i in range(3)])
+        for key, old in olds.items():
+            with store._lock:
+                new = store._objects["pods"][key]
+            assert new is not old
+            assert new.spec is not old.spec
+            assert new.metadata is not old.metadata
+            assert new.spec.containers is old.spec.containers
+            assert new.metadata.annotations is old.metadata.annotations
+            assert new.status is old.status
+            assert new.__dict__.get("_rr") is old.__dict__.get("_rr")
+            assert old.spec.node_name == ""      # stored old untouched
+            assert new.spec.node_name == "n0"
+
+    def test_repeated_key_chains_even_on_forced_shard_tuning(self):
+        """Two patches to one key in a burst must chain (the second sees
+        the first's result) — duplicates force the serial engine even
+        when the burst would otherwise shard."""
+        store = sharded(store_with_pods(6), target=2)
+
+        def label(k, v):
+            def fn(p):
+                p.metadata.labels[k] = v
+            return fn
+
+        patches = [(f"p{i:03d}", "ns1", setter(f"n{i}")) for i in range(6)]
+        patches.insert(3, ("p000", "ns1", label("second", "yes")))
+        store.patch_batch("pods", patches)
+        p0 = store.get("pods", "p000", "ns1")
+        assert p0.spec.node_name == "n0"          # first patch kept
+        assert p0.metadata.labels.get("second") == "yes"
+        assert_journal_clean(store)
+
+    def test_sharded_raising_fn_commits_noop_and_reraises(self):
+        """Sharded path: a raising patch fn cannot abort reserved rvs —
+        its item commits a no-op version, every other item commits, the
+        journal stays gap-free and the error re-raises after delivery."""
+        store = sharded(store_with_pods(6), target=2)
+
+        def boom(p):
+            raise RuntimeError("bad patch")
+
+        patches = [(f"p{i:03d}", "ns1",
+                    boom if i == 2 else setter(f"n{i}")) for i in range(6)]
+        with pytest.raises(RuntimeError, match="bad patch"):
+            store.patch_batch("pods", patches)
+        assert_journal_clean(store)
+        for i in range(6):
+            p = store.get("pods", f"p{i:03d}", "ns1")
+            assert p.spec.node_name == ("" if i == 2 else f"n{i}")
+            assert p.metadata.resource_version > 6   # every rv consumed
+
+    def test_interleaved_writer_parks_until_publish(self):
+        """A single update racing a sharded patch takes an rv ABOVE the
+        reservation; its journal entry parks until the whole reservation
+        publishes, keeping the journal rv-sorted and gap-free."""
+        store = sharded(store_with_pods(8), target=2)
+        store.create("nodes", build_node("n-aux", {"cpu": "1",
+                                                   "memory": "1Gi"}))
+        release = threading.Event()
+        entered = threading.Event()
+
+        def slow_setter(host):
+            def fn(p):
+                entered.set()
+                release.wait(timeout=5.0)
+                p.spec.node_name = host
+            return fn
+
+        rv_before = store.current_rv()
+        t = threading.Thread(target=store.patch_batch, args=(
+            "pods", [(f"p{i:03d}", "ns1", slow_setter(f"n{i}"))
+                     for i in range(8)]))
+        t.start()
+        assert entered.wait(timeout=5.0)
+        # the patch holds its reservation; write an UNRELATED kind's key
+        aux = store.get("nodes", "n-aux")
+        aux.metadata.labels["touched"] = "yes"
+        store.update("nodes", aux, skip_admission=True)
+        # its entry must not be visible before the reservation publishes
+        events, _, _ = store.events_since(rv_before, timeout=0.05)
+        assert not any(k == "nodes" for _, _, k, _ in events)
+        release.set()
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        assert_journal_clean(store)
+        events, _, resync = store.events_since(rv_before, timeout=0.1)
+        assert not resync
+        assert [k for _, _, k, _ in events] == ["pods"] * 8 + ["nodes"]
+
+    def test_update_on_inflight_key_waits_for_publish(self):
+        """update() on a key inside an open reservation blocks until the
+        owning shard publishes — then lands ON TOP of the patched
+        version (no lost update, monotonic rvs)."""
+        store = sharded(store_with_pods(8), target=2)
+        release = threading.Event()
+        entered = threading.Event()
+
+        def slow_setter(host):
+            def fn(p):
+                entered.set()
+                release.wait(timeout=5.0)
+                p.spec.node_name = host
+            return fn
+
+        t = threading.Thread(target=store.patch_batch, args=(
+            "pods", [(f"p{i:03d}", "ns1", slow_setter(f"n{i}"))
+                     for i in range(8)]))
+        t.start()
+        assert entered.wait(timeout=5.0)
+        done = threading.Event()
+
+        def racing_update():
+            from volcano_tpu.apiserver.store import ConflictError
+            live = store.get("pods", "p000", "ns1")   # pre-patch copy
+            live.metadata.labels["raced"] = "yes"
+            try:
+                store.update("pods", live, skip_admission=True)
+                done.set()   # must NOT happen: stale rv
+            except ConflictError:
+                # the barrier held the write until the shard published,
+                # so optimistic concurrency SEES the patch and rejects
+                # the stale copy — re-get and retry, as the contract says
+                fresh = store.get("pods", "p000", "ns1")
+                fresh.metadata.labels["raced"] = "yes"
+                store.update("pods", fresh, skip_admission=True)
+                done.set()
+
+        u = threading.Thread(target=racing_update)
+        u.start()
+        time.sleep(0.05)
+        assert not done.is_set()      # barriered behind the reservation
+        release.set()
+        t.join(timeout=10.0)
+        u.join(timeout=10.0)
+        assert done.is_set()
+        final = store.get("pods", "p000", "ns1")
+        assert final.metadata.labels.get("raced") == "yes"
+        assert final.spec.node_name == "n0"   # patch not lost
+        assert_journal_clean(store)
+
+
+class TestFilterFlipWatchers:
+    """A watcher whose filter flips pass->fail / fail->pass mid-burst
+    must see on_delete/on_add (not on_update) — on the bulk and the
+    per-pair delivery paths, on the serial and the sharded engine."""
+
+    def _flip_store(self, force_sharded: bool):
+        store = store_with_pods(6)
+        if force_sharded:
+            sharded(store, target=2)
+        # pods 0/1 start passing the filter; the patch flips 1 out and
+        # flips 4 in, leaves 0 passing and 5 failing
+        for name, val in (("p000", "true"), ("p001", "true")):
+            live = store.get("pods", name, "ns1")
+            live.metadata.annotations[FLIP_KEY] = "true"
+            store.update("pods", live, skip_admission=True)
+        return store
+
+    @staticmethod
+    def _passes(p) -> bool:
+        return p.metadata.annotations.get(FLIP_KEY) == "true"
+
+    @staticmethod
+    def _flip(value):
+        def fn(p):
+            # metadata shells share annotation dicts with the stored
+            # object; a patch that EDITS them must copy first (the same
+            # rule any annotation-patching caller already follows)
+            p.metadata.annotations = dict(p.metadata.annotations)
+            p.metadata.annotations[FLIP_KEY] = value
+        return fn
+
+    @pytest.mark.parametrize("force_sharded", [False, True])
+    @pytest.mark.parametrize("bulk_handler", [False, True])
+    def test_filter_flips(self, force_sharded, bulk_handler):
+        store = self._flip_store(force_sharded)
+        got = {"add": [], "delete": [], "update": [], "bulk": []}
+        kwargs = dict(
+            on_add=lambda o: got["add"].append(o.metadata.name),
+            on_delete=lambda o: got["delete"].append(o.metadata.name),
+            filter_fn=self._passes, sync=False)
+        if bulk_handler:
+            kwargs["on_bulk_update"] = lambda pairs: got["bulk"].extend(
+                (o.metadata.name, n.metadata.name) for o, n in pairs)
+        else:
+            kwargs["on_update"] = lambda o, n: got["update"].append(
+                o.metadata.name)
+        store.watch("pods", **kwargs)
+
+        store.patch_batch("pods", [
+            ("p000", "ns1", self._flip("true")),    # pass -> pass
+            ("p001", "ns1", self._flip("false")),   # pass -> fail
+            ("p004", "ns1", self._flip("true")),    # fail -> pass
+            ("p005", "ns1", self._flip("false")),   # fail -> fail
+        ])
+        assert got["add"] == ["p004"]
+        assert got["delete"] == ["p001"]
+        if bulk_handler:
+            assert got["bulk"] == [("p000", "p000")]
+            assert got["update"] == []
+        else:
+            assert got["update"] == ["p000"]
+            assert got["bulk"] == []
+        assert_journal_clean(store)
+
+
+def _stress_env(n_nodes=32, n_jobs=64, gang=8):
+    from volcano_tpu.cache import SchedulerCache
+
+    store = ObjectStore()
+    binder = FakeBinder(store)
+    cache = SchedulerCache(store, binder=binder, evictor=FakeEvictor(store))
+    cache.run()
+    store.create("queues", build_queue("default", weight=1))
+    for i in range(n_nodes):
+        store.create("nodes", build_node(
+            f"node-{i}", {"cpu": "640", "memory": "2560Gi", "pods": "1100"}))
+    for j in range(n_jobs):
+        store.create("podgroups", build_pod_group(
+            f"pg-{j}", "default", "default", gang, phase="Inqueue"))
+        for t in range(gang):
+            store.create("pods", build_pod(
+                "default", f"job{j}-task{t}", "", "Pending",
+                {"cpu": "2", "memory": "4Gi"}, groupname=f"pg-{j}"))
+    return store, cache, binder
+
+
+@pytest.mark.flushstress
+class TestFlushStress:
+    def test_parallel_flush_invariants(self):
+        """Bind bursts through the sharded flush while other threads
+        churn unrelated objects: rv monotonicity, journal order and the
+        sim catalog's node-accounting/no-orphans invariants must hold."""
+        from volcano_tpu.sim.invariants import (CycleContext,
+                                                check_journal_order,
+                                                check_no_orphans,
+                                                check_node_accounting)
+
+        store, cache, binder = _stress_env()
+        sharded(store, target=64, cap=8)   # 512 binds -> 8 shards
+        stop = threading.Event()
+        errors = []
+
+        def churn():
+            """Unrelated-kind writers racing the reservation windows."""
+            i = 0
+            try:
+                while not stop.is_set():
+                    store.create("nodes", build_node(
+                        f"churn-{i}", {"cpu": "1", "memory": "1Gi"}))
+                    live = store.get("nodes", f"churn-{i}")
+                    live.metadata.labels["i"] = str(i)
+                    store.update("nodes", live, skip_admission=True)
+                    store.delete("nodes", f"churn-{i}",
+                                 skip_admission=True)
+                    i += 1
+            except Exception as e:        # pragma: no cover
+                errors.append(e)
+
+        def poll_events():
+            """A journal reader must only ever see sorted, gap-free rv
+            sequences."""
+            cursor = 0
+            try:
+                while not stop.is_set():
+                    events, rv, resync = store.events_since(
+                        cursor, timeout=0.05)
+                    if resync:
+                        cursor = rv
+                        continue
+                    rvs = [e[0] for e in events]
+                    assert rvs == sorted(rvs)
+                    assert all(b - a == 1
+                               for a, b in zip(rvs, rvs[1:])), rvs
+                    if rvs:
+                        assert rvs[0] == cursor + 1
+                    cursor = max(cursor, rv)
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=churn),
+                   threading.Thread(target=poll_events)]
+        for t in threads:
+            t.start()
+        try:
+            with cache.mutex:
+                jobs = sorted(cache.jobs.values(), key=lambda j: j.uid)
+                gangs = []
+                i = 0
+                for job in jobs:
+                    pairs = []
+                    for task in sorted(job.tasks.values(),
+                                       key=lambda t: t.uid):
+                        pairs.append((task, f"node-{i % 32}"))
+                        i += 1
+                    gangs.append(pairs)
+            for pairs in gangs:
+                cache.bind_batch(pairs)
+            assert cache.flush_executors(timeout=60.0)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10.0)
+        assert not errors, errors
+        assert len(binder.binds) == 64 * 8
+        # unbound pods would mean a shard never published
+        assert all(p.spec.node_name for p in store.list_refs("pods"))
+        ctx = CycleContext(store=store, cache=cache)
+        with cache.mutex:
+            violations = (check_node_accounting(ctx)
+                          + check_no_orphans(ctx)
+                          + check_journal_order(ctx))
+        assert not violations, [str(v) for v in violations]
+        rvs = journal_rvs(store)
+        assert rvs == sorted(rvs)
+        assert all(b - a == 1 for a, b in zip(rvs, rvs[1:]))
+        cache.stop()

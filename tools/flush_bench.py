@@ -1,0 +1,133 @@
+"""Flush-only micro-benchmark: time a 5k-bind coalesced flush through the
+production cache + store (write-behind applies, sharded two-phase
+patch_batch, bulk echo ingest) WITHOUT a scheduling cycle — seconds, not
+minutes, so it can gate every CI run (`make flush-bench`, wired into
+`make sim-smoke`).
+
+Runs the identical burst TWICE on fresh envs and fails (exit 1) unless
+the two runs are bit-identical — same journal (rv, action, key,
+node_name) sequence, same per-pod resource_versions, same bind set —
+which is exactly the determinism contract the sharded pipeline promises
+the churn simulator (docs/design/bind_pipeline.md): shard assignment, rv
+reservation and publish order are pure functions of the input burst.
+
+Prints one JSON line: {"metric": "bind_flush_5k_ms", "value": <best ms>,
+"runs": [...], "binds": n, "deterministic": true}.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_NODES = 1_000
+N_JOBS = 625          # x gang of 8 = 5k binds
+GANG = 8
+FLUSH_TIMEOUT_S = 120.0
+
+
+def build_env():
+    from volcano_tpu.apiserver import ObjectStore
+    from volcano_tpu.cache import SchedulerCache
+    from volcano_tpu.utils.test_utils import (FakeBinder, FakeEvictor,
+                                              build_node, build_pod,
+                                              build_pod_group, build_queue)
+
+    store = ObjectStore()
+    binder = FakeBinder(store)
+    cache = SchedulerCache(store, binder=binder, evictor=FakeEvictor(store))
+    cache.run()
+    store.create("queues", build_queue("default", weight=1))
+    for i in range(N_NODES):
+        store.create("nodes", build_node(
+            f"node-{i}", {"cpu": "64", "memory": "256Gi", "pods": "110"}))
+    for j in range(N_JOBS):
+        store.create("podgroups", build_pod_group(
+            f"pg-{j}", "default", "default", GANG, phase="Inqueue"))
+        for t in range(GANG):
+            store.create("pods", build_pod(
+                "default", f"job{j}-task{t}", "", "Pending",
+                {"cpu": "2", "memory": "4Gi"}, groupname=f"pg-{j}"))
+    return store, cache, binder
+
+
+def run_once() -> dict:
+    """One populated env -> one coalesced bind burst -> full flush."""
+    store, cache, binder = build_env()
+    # stage the bind pairs exactly as the allocate action's commit does:
+    # per-gang bind_batch calls against the live cache tasks, nodes
+    # assigned round-robin (5 pods per node at 5k x 1k)
+    with cache.mutex:
+        jobs = sorted(cache.jobs.values(), key=lambda j: j.uid)
+        gangs = []
+        i = 0
+        for job in jobs:
+            tasks = sorted(job.tasks.values(), key=lambda t: t.uid)
+            pairs = []
+            for t in tasks:
+                pairs.append((t, f"node-{i % N_NODES}"))
+                i += 1
+            gangs.append(pairs)
+    t0 = time.perf_counter()
+    for pairs in gangs:
+        cache.bind_batch(pairs)
+    if not cache.flush_executors(timeout=FLUSH_TIMEOUT_S):
+        print(json.dumps({"metric": "bind_flush_5k_ms", "value": None,
+                          "flush_timeout": True}))
+        sys.exit(1)
+    ms = (time.perf_counter() - t0) * 1000.0
+
+    h = hashlib.sha256()
+    with store._lock:
+        for rv, action, kind, o in store._journal:
+            h.update(f"{rv}|{action}|{kind}|{store.key_of(kind, o)}|"
+                     f"{getattr(o.spec, 'node_name', '')}\n".encode())
+        tail_ok = store._journal_tail == store._rv \
+            and not store._journal_parked \
+            and not any(store._inflight.values())
+    for p in sorted(store.list_refs("pods"),
+                    key=lambda p: p.metadata.key()):
+        h.update(f"{p.metadata.key()}|{p.metadata.resource_version}|"
+                 f"{p.spec.node_name}\n".encode())
+    unbound = sum(1 for p in store.list_refs("pods")
+                  if not p.spec.node_name)
+    cache.stop()
+    return {"ms": ms, "binds": len(binder.binds),
+            "fingerprint": h.hexdigest(), "unbound": unbound,
+            "journal_ok": tail_ok}
+
+
+def main() -> None:
+    runs = [run_once(), run_once()]
+    deterministic = runs[0]["fingerprint"] == runs[1]["fingerprint"]
+    ok = deterministic \
+        and all(r["binds"] == N_JOBS * GANG for r in runs) \
+        and all(r["unbound"] == 0 for r in runs) \
+        and all(r["journal_ok"] for r in runs)
+    print(json.dumps({
+        "metric": "bind_flush_5k_ms",
+        "value": round(min(r["ms"] for r in runs), 2),
+        "unit": "ms",
+        "runs": [round(r["ms"], 2) for r in runs],
+        "binds": runs[0]["binds"],
+        "deterministic": deterministic,
+        "journal_ok": all(r["journal_ok"] for r in runs),
+        "fingerprint": runs[0]["fingerprint"][:16],
+    }))
+    if not ok:
+        for i, r in enumerate(runs):
+            print(f"[flush-bench] run {i}: binds={r['binds']} "
+                  f"unbound={r['unbound']} journal_ok={r['journal_ok']} "
+                  f"fingerprint={r['fingerprint'][:16]}", file=sys.stderr)
+        print("[flush-bench] FAILED: non-deterministic or incomplete flush",
+              file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -75,6 +75,11 @@ def load_store(path: str, store: Optional[ObjectStore] = None,
     with store._lock:
         store._rv = max(store._rv, int(payload.get("resource_version", 0)))
         store._journal.clear()
+        # re-anchor the journal sequencer at the restored rv: the cleared
+        # journal window starts fresh (clients resync on the gap) and no
+        # parked entries can refer to pre-restore reservations
+        store._journal_tail = store._rv
+        store._journal_parked.clear()
     return store, count
 
 

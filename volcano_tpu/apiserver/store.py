@@ -13,6 +13,7 @@ slice; namespaced kinds key by "namespace/name", cluster-scoped by "name".
 
 from __future__ import annotations
 
+import os
 import threading
 from collections import defaultdict
 from typing import Callable, Dict, List, Optional
@@ -20,6 +21,33 @@ from typing import Callable, Dict, List, Optional
 from ..models import objects as obj
 from ..utils.clock import GLOBAL_CLOCK, Clock
 from ..utils.fastclone import fast_clone
+
+# shared worker pool for the sharded bulk-patch clone phase (phase 2 of
+# the two-phase commit in ObjectStore._bulk_patch). Module-level so every
+# store (tests build hundreds) shares a handful of threads; the pool only
+# ever runs pure clone+patch closures over immutable inputs, so sharing
+# is safe. Pool SIZE never affects results — shard content and publish
+# order are fixed before any worker runs.
+_FLUSH_POOL = None
+_FLUSH_POOL_LOCK = threading.Lock()
+
+
+def _flush_pool():
+    global _FLUSH_POOL
+    if _FLUSH_POOL is None:
+        with _FLUSH_POOL_LOCK:
+            if _FLUSH_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
+                try:
+                    workers = int(os.environ.get(
+                        "VOLCANO_FLUSH_WORKERS", "0")) or 0
+                except ValueError:
+                    workers = 0
+                if workers <= 0:
+                    workers = min(4, os.cpu_count() or 1)
+                _FLUSH_POOL = ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="flush-shard")
+    return _FLUSH_POOL
 
 NAMESPACED = {"pods", "podgroups", "jobs", "commands", "resourcequotas", "services",
               "configmaps", "secrets", "networkpolicies", "persistentvolumeclaims"}
@@ -93,6 +121,17 @@ class ObjectStore:
     JOURNAL_CAPACITY = 65536
     EVENTS_CAPACITY = 16384
 
+    # sharded bulk-patch tuning (class attrs so tests can tune per store):
+    # bursts at or below SHARD_SERIAL_MAX commit under one lock pass (the
+    # classic serial path, exact legacy semantics); larger bursts split
+    # into ceil(n / SHARD_TARGET) shards capped at SHARD_MAX. Shard count
+    # is a pure function of the burst size — never of cpu count or pool
+    # state — so double runs stay bit-identical (the sim determinism
+    # contract, docs/design/bind_pipeline.md).
+    SHARD_SERIAL_MAX = 512
+    SHARD_TARGET = 2048
+    SHARD_MAX = 8
+
     def __init__(self, clock: Clock = GLOBAL_CLOCK):
         self._objects: Dict[str, Dict[str, object]] = {k: {} for k in KINDS}
         self._watches: Dict[str, List[Watch]] = defaultdict(list)
@@ -110,6 +149,21 @@ class ObjectStore:
         # object ref — safe to hold, internals are replaced never mutated)
         self._journal = _deque(maxlen=self.JOURNAL_CAPACITY)
         self._journal_cond = threading.Condition(self._lock)
+        # journal sequencer: _rv is the ALLOCATION counter (bulk patches
+        # reserve whole contiguous ranges up front); _journal_tail is the
+        # highest rv whose journal entry has been appended. The journal
+        # stays rv-sorted and gap-free: an entry whose rv is ahead of the
+        # tail (a single write that interleaved with an outstanding
+        # reservation) parks in _journal_parked until the range below it
+        # publishes. Readers (events_since, current_rv) see the tail.
+        self._journal_tail = 0
+        self._journal_parked: Dict[int, tuple] = {}
+        # keys with a reserved-but-unpublished patch in flight, per kind;
+        # update/delete on such a key waits on _flush_cond until its shard
+        # publishes (a write racing the reservation window would otherwise
+        # be silently overwritten by the shard's stale clone)
+        self._inflight: Dict[str, set] = defaultdict(set)
+        self._flush_cond = threading.Condition(self._lock)
 
     # -- keys --------------------------------------------------------------
 
@@ -117,6 +171,37 @@ class ObjectStore:
     def key_of(kind: str, o) -> str:
         meta = o.metadata
         return meta.name if kind in CLUSTER_SCOPED else f"{meta.namespace}/{meta.name}"
+
+    # -- journal sequencer (caller holds self._lock) -----------------------
+
+    def _journal_append_locked(self, rv: int, action: str, kind: str,
+                               o) -> None:
+        """Append one journal entry keeping the journal rv-sorted and
+        gap-free. Entries ahead of the contiguous tail (a writer that
+        interleaved with an outstanding bulk reservation) park until the
+        range below them publishes; watchers are only notified when the
+        tail actually advances (parked entries are not yet visible)."""
+        if rv == self._journal_tail + 1:
+            self._journal.append((rv, action, kind, o))
+            self._journal_tail = rv
+            parked = self._journal_parked
+            while parked:
+                nxt = parked.pop(self._journal_tail + 1, None)
+                if nxt is None:
+                    break
+                self._journal.append(nxt)
+                self._journal_tail += 1
+            self._journal_cond.notify_all()
+        else:
+            self._journal_parked[rv] = (rv, action, kind, o)
+
+    def _wait_key_writable_locked(self, kind: str, key: str) -> None:
+        """Block (releasing the lock) while ``key`` has a reserved bulk
+        patch in flight — the write must order after the shard publish."""
+        infl = self._inflight.get(kind)
+        if infl and key in infl:
+            self._flush_cond.wait_for(
+                lambda: key not in self._inflight.get(kind, ()))
 
     # -- admission ---------------------------------------------------------
 
@@ -164,8 +249,7 @@ class ObjectStore:
             self._rv += 1
             o.metadata.resource_version = self._rv
             self._objects[kind][key] = o
-            self._journal.append((self._rv, "ADDED", kind, o))
-            self._journal_cond.notify_all()
+            self._journal_append_locked(self._rv, "ADDED", kind, o)
             watches = list(self._watches[kind])
         for w in watches:
             if w.on_add and w._passes(o):
@@ -194,6 +278,7 @@ class ObjectStore:
         if derive is not None:
             derive(o)
         with self._lock:
+            self._wait_key_writable_locked(kind, key)
             old = self._objects[kind].get(key)
             if old is None:
                 raise KeyError(f"{kind} {key!r} not found")
@@ -205,8 +290,7 @@ class ObjectStore:
             self._rv += 1
             o.metadata.resource_version = self._rv
             self._objects[kind][key] = o
-            self._journal.append((self._rv, "MODIFIED", kind, o))
-            self._journal_cond.notify_all()
+            self._journal_append_locked(self._rv, "MODIFIED", kind, o)
             watches = list(self._watches[kind])
         for w in watches:
             old_p, new_p = w._passes(old), w._passes(o)
@@ -222,75 +306,328 @@ class ObjectStore:
         return o
 
     def patch_batch(self, kind: str, patches, clone_fn=None) -> tuple:
-        """Apply ``[(name, namespace, fn)]`` under ONE lock pass: each fn
+        """Apply ``[(name, namespace, fn)]`` as one bulk commit: each fn
         mutates a fresh clone of the stored object, which becomes the new
         stored version (rv bump + journal entry each). ``clone_fn``
         overrides the clone used to derive the new version (the bind path
-        passes a shell-only pod cloner). Admission is skipped
-        by design — the only caller is the bind path, and the reference's
-        POST .../binding does not re-run pod admission either.
+        passes a shell-only pod cloner). Admission is skipped by design —
+        the callers are the bind/status-writeback paths, and the
+        reference's POST .../binding does not re-run pod admission either.
+
+        Bursts up to ``SHARD_SERIAL_MAX`` commit under one lock pass (the
+        classic serial path: a raising fn aborts its own item and every
+        later one, with the committed prefix still announced). Larger
+        bursts take the sharded two-phase pipeline — see :meth:`_bulk_patch`
+        for the shard/reserve/publish protocol and its (slightly different)
+        failure semantics.
 
         Returns ``(pairs, missing)`` where pairs is [(old, new)] of applied
         patches and missing the [(name, namespace)] whose object was gone.
 
-        Watch delivery: watchers exposing ``on_bulk_update`` get one call
-        with their [(old, new)] list, where ``new`` is the STORE'S OWN
-        object — the handler must never MUTATE it, but retaining it is
-        allowed (stored objects are immutable in place: every update
-        replaces them wholesale, a contract any future optimization here
-        must preserve); this saves one deep pod copy per patch on the
-        50k-bind flush. Watchers without a bulk handler get per-pair
-        on_update with the usual per-watcher copy."""
+        Watch delivery semantics (both paths, and both the bulk and
+        per-pair forms): ``_passes(old)``/``_passes(new)`` are evaluated
+        once per pair, and a filter FLIP mid-burst is delivered as a
+        lifecycle transition, not an update — pass→fail fires ``on_delete``
+        with the old object, fail→pass fires ``on_add`` with a fresh copy
+        of the new one; only pass→pass pairs reach ``on_update`` /
+        ``on_bulk_update``. Watchers exposing ``on_bulk_update`` get one
+        call per commit unit (the whole burst on the serial path, one call
+        PER SHARD on the sharded path) with their [(old, new)] list, where
+        ``new`` is the STORE'S OWN object — the handler must never MUTATE
+        it, but retaining it is allowed (stored objects are immutable in
+        place: every update replaces them wholesale, a contract any future
+        optimization here must preserve); this saves one deep pod copy per
+        patch on the 50k-bind flush. Watchers without a bulk handler get
+        per-pair on_update with the usual per-watcher copy."""
+        def apply_fn(new, fn):
+            fn(new)
+
+        return self._bulk_patch(kind, patches, clone_fn or fast_clone,
+                                apply_fn, None)
+
+    def bind_pods(self, bindings) -> tuple:
+        """The bind-flush fast path: ``[(name, namespace, hostname)]`` →
+        pod.spec.node_name patches through the same bulk engine as
+        :meth:`patch_batch`, with the per-item closure replaced by a plain
+        hostname payload so large bursts can promote the whole
+        clone+patch+rv step of a shard into ONE ``fastmodel.c``
+        ``bind_clone_pods`` call. Returns ``(pairs, missing)``."""
+        from ..models.objects import clone_pod_for_bind
+
+        def apply_fn(new, hostname):
+            new.spec.node_name = hostname
+            new.resource_request()   # seed the parse cache: the stored
+            #                          version and every watcher echo copy
+            #                          share it (TaskInfo rebuilds skip the
+            #                          quantity parse)
+
+        batch_shard = None
+        try:
+            from ..native.build import fastmodel
+            fm = fastmodel()
+        except Exception:
+            fm = None
+        if fm is not None and hasattr(fm, "bind_clone_pods"):
+            def batch_shard(shard, rv_base):
+                return fm.bind_clone_pods([old for _, old, _ in shard],
+                                          [h for _, _, h in shard],
+                                          rv_base + 1)
+
+        return self._bulk_patch("pods", bindings, clone_pod_for_bind,
+                                apply_fn, batch_shard)
+
+    def _shard_count(self, n: int) -> int:
+        return min(self.SHARD_MAX, -(-n // self.SHARD_TARGET))
+
+    def _bulk_patch(self, kind: str, items, clone_fn, apply_fn,
+                    batch_shard) -> tuple:
+        """Bulk-commit engine behind patch_batch/bind_pods.
+
+        ``items`` is [(name, namespace, payload)]; each applied item
+        becomes ``new = clone_fn(old); apply_fn(new, payload)`` with a
+        fresh rv. Two commit strategies:
+
+        * serial (n <= SHARD_SERIAL_MAX): resolve, clone, patch, install
+          and journal under ONE lock pass, exactly the legacy path.
+        * sharded two-phase (docs/design/bind_pipeline.md): a SHORT lock
+          reserves a contiguous rv range, snapshots the old objects and
+          splits them into K stable shards (contiguous ranges of the
+          input burst — gang locality preserved, see the phase-1 comment);
+          the clone+patch of each shard then runs
+          LOCK-FREE on a small worker pool (``batch_shard(shard, rv_base)``
+          may replace a whole shard's clone+patch+rv loop with one native
+          call); finally shards PUBLISH strictly in shard order — install
+          + journal append (rv order == publish order) + one bulk watch
+          delivery per shard — so a watcher's echo ingest of shard i
+          overlaps shard i+1's clone work. While a reservation is
+          outstanding its keys are write-barriered: update/delete on them
+          block until the owning shard publishes, and interleaved writes
+          on OTHER keys park their journal entries until the reserved
+          range below them lands (see _journal_append_locked).
+
+        Failure semantics differ on the sharded path: rvs are already
+        reserved when apply_fn runs, so a raising apply_fn cannot abort
+        the remaining items the way the serial path does — the failed
+        item commits a NO-OP version (clone of the old object, rv bumped,
+        journal entry, delivered as an old→unchanged update) to keep the
+        journal gap-free, every other item commits normally, and the
+        first error re-raises after delivery. Patch fns are not expected
+        to raise; this is containment, not API.
+
+        Determinism contract: shard assignment (contiguous ranges),
+        per-shard rv ranges (shard order == input order) and publish
+        order are all pure functions of the input burst — pool size and
+        thread timing never change any observable ordering."""
         pairs: list = []
         missing: list = []
         watches: list = []
+        resolved: list = []
+        shards = bases = None
+        cluster = kind in CLUSTER_SCOPED
         try:
             with self._lock:
-                try:
-                    for name, namespace, fn in patches:
-                        key = name if kind in CLUSTER_SCOPED \
-                            else f"{namespace}/{name}"
-                        old = self._objects[kind].get(key)
-                        if old is None:
-                            missing.append((name, namespace))
-                            continue
-                        new = (clone_fn or fast_clone)(old)
-                        fn(new)   # a raising fn aborts THIS item pre-commit;
-                        #           already-committed items still notify and
-                        #           deliver below (finally) before re-raise
-                        self._rv += 1
-                        new.metadata.resource_version = self._rv
-                        self._objects[kind][key] = new
-                        self._journal.append((self._rv, "MODIFIED", kind, new))
-                        pairs.append((old, new))
-                finally:
-                    if pairs:
-                        self._journal_cond.notify_all()
-                        watches = list(self._watches[kind])
+                # phase 1: resolve + (for big bursts) reserve. Waits out
+                # any other in-flight bulk patch on this kind first: two
+                # overlapping reservations on one kind could deadlock on
+                # each other's keys.
+                if self._inflight.get(kind):
+                    self._flush_cond.wait_for(
+                        lambda: not self._inflight.get(kind))
+                objs = self._objects[kind]
+                seen: set = set()
+                for name, namespace, payload in items:
+                    key = name if cluster else f"{namespace}/{name}"
+                    old = objs.get(key)
+                    if old is None:
+                        missing.append((name, namespace))
+                    else:
+                        seen.add(key)
+                        resolved.append((key, old, payload))
+                n = len(resolved)
+                if n == 0:
+                    return [], missing
+                # a repeated key must see the FIRST patch's result as its
+                # old version — only the serial path chains patches that
+                # way (phase 2 clones every item from the phase-1
+                # snapshot), so duplicates force serial. No real caller
+                # repeats keys (one bind / one status push per object).
+                if n <= self.SHARD_SERIAL_MAX or self._shard_count(n) < 2 \
+                        or len(seen) != n:
+                    # serial path: commit everything under this lock pass.
+                    # A raising apply_fn aborts THIS item pre-commit and
+                    # every later one; already-committed items still
+                    # notify and deliver below (finally) before re-raise.
+                    try:
+                        for key, _, payload in resolved:
+                            # re-read under the held lock: a repeated key
+                            # chains off the previous patch's result
+                            old = objs[key]
+                            new = clone_fn(old)
+                            apply_fn(new, payload)
+                            self._rv += 1
+                            new.metadata.resource_version = self._rv
+                            objs[key] = new
+                            self._journal_append_locked(
+                                self._rv, "MODIFIED", kind, new)
+                            pairs.append((old, new))
+                    finally:
+                        if pairs:
+                            watches = list(self._watches[kind])
+                    return pairs, missing
+                # sharded: reserve rvs + split; keys barriered until their
+                # shard publishes. Shards are CONTIGUOUS RANGES of the
+                # input burst, not a key hash: the burst arrives in gang
+                # order, and range splitting preserves it — the cache's
+                # echo ingest coalesces consecutive same-job pods into one
+                # status-index pass, which a hash split (each gang's pods
+                # scattered over every shard) measurably destroys. Ranges
+                # are just as stable a function of the input burst, and rv
+                # assignment stays exactly the legacy serial order.
+                k = self._shard_count(n)
+                step = -(-n // k)
+                shards = [resolved[i:i + step]
+                          for i in range(0, n, step)]
+                bases = []
+                rv = self._rv
+                for s in shards:
+                    bases.append(rv)
+                    rv += len(s)
+                self._rv = rv
+                infl = self._inflight[kind]
+                for key, _, _ in resolved:
+                    infl.add(key)
+                watches = list(self._watches[kind])
         finally:
-            for w in watches:
-                if w.on_bulk_update is not None:
-                    delivery = []
-                    for old, new in pairs:
-                        old_p, new_p = w._passes(old), w._passes(new)
-                        if old_p and new_p:
-                            delivery.append((old, new))
-                        elif not old_p and new_p and w.on_add:
-                            w.on_add(fast_clone(new))
-                        elif old_p and not new_p and w.on_delete:
-                            w.on_delete(old)
-                    if delivery:
-                        w.on_bulk_update(delivery)
-                    continue
+            if shards is None:
+                self._deliver_patch_pairs(watches, pairs)
+        try:
+            from ..metrics import metrics as _m
+            _m.observe(_m.STORE_PATCH_SHARDS, len(shards), kind=kind)
+        except Exception:
+            pass
+        return self._publish_shards(kind, shards, bases, watches, clone_fn,
+                                    apply_fn, batch_shard, missing)
+
+    def _publish_shards(self, kind, shards, bases, watches, clone_fn,
+                        apply_fn, batch_shard, missing) -> tuple:
+        """Phases 2+3 of :meth:`_bulk_patch`: fan the shards out to the
+        clone pool, then publish + deliver them strictly in shard order."""
+        first_err: list = [None]
+
+        def run_shard(shard, rv_base):
+            if batch_shard is not None:
+                try:
+                    return batch_shard(shard, rv_base)
+                except Exception:
+                    pass   # fall through to the per-item loop
+            news = []
+            rv = rv_base
+            for key, old, payload in shard:
+                rv += 1
+                try:
+                    new = clone_fn(old)
+                    apply_fn(new, payload)
+                except BaseException as e:
+                    if first_err[0] is None:
+                        first_err[0] = e
+                    new = clone_fn(old)   # no-op version keeps the
+                    #                       reserved rv/journal gap-free
+                new.metadata.resource_version = rv
+                news.append(new)
+            return news
+
+        from ..trace import tracer
+        pairs_all: list = []
+        published = 0
+        try:
+            # everything from here until the last shard publishes sits
+            # inside the recovery scope: a failure anywhere (pool
+            # creation, submit, a worker, a watch handler) MUST still
+            # land the reserved rvs and release the key barriers, or the
+            # journal tail stalls and every later write blocks forever
+            pool = _flush_pool()
+            futures = [pool.submit(run_shard, s, b)
+                       for s, b in zip(shards, bases)]
+            for shard, base, fut in zip(shards, bases, futures):
+                with tracer.async_span("store.patch.clone_wait"):
+                    news = fut.result()
+                with tracer.async_span("store.patch.publish"):
+                    spairs = self._install_shard_locked(kind, shard, news)
+                published += 1
+                pairs_all.extend(spairs)
+                with tracer.async_span("store.patch.deliver"):
+                    self._deliver_patch_pairs(watches, spairs)
+        finally:
+            if published < len(shards):
+                # fill the unpublished remainder with no-op versions
+                for shard, base in list(zip(shards, bases))[published:]:
+                    news = [clone_fn(old) for _, old, _ in shard]
+                    for i, new in enumerate(news):
+                        new.metadata.resource_version = base + i + 1
+                    self._install_shard_locked(kind, shard, news)
+        if first_err[0] is not None:
+            raise first_err[0]
+        return pairs_all, missing
+
+    def _install_shard_locked(self, kind, shard, news) -> list:
+        """Ordered-publish step: install a shard's new versions, append
+        their journal entries (contiguous reserved rvs) and release the
+        shard's write barrier. Returns the shard's [(old, new)] pairs."""
+        with self._lock:
+            objs = self._objects[kind]
+            infl = self._inflight[kind]
+            first = news[0].metadata.resource_version
+            fast = self._journal_tail + 1 == first \
+                and not self._journal_parked
+            for (key, _, _), new in zip(shard, news):
+                objs[key] = new
+                infl.discard(key)
+                if fast:
+                    self._journal.append(
+                        (new.metadata.resource_version, "MODIFIED", kind,
+                         new))
+                else:
+                    self._journal_append_locked(
+                        new.metadata.resource_version, "MODIFIED", kind,
+                        new)
+            if fast:
+                self._journal_tail = news[-1].metadata.resource_version
+                self._journal_cond.notify_all()
+            self._flush_cond.notify_all()
+        return [(old, new) for (_, old, _), new in zip(shard, news)]
+
+    def _deliver_patch_pairs(self, watches, pairs) -> None:
+        """Watch delivery for one commit unit (whole serial burst or one
+        shard): _passes evaluated once per pair, filter flips delivered
+        as add/delete lifecycle transitions (see patch_batch docstring)."""
+        if not pairs:
+            return
+        for w in watches:
+            bulk = w.on_bulk_update
+            if bulk is not None and w.filter_fn is None:
+                bulk(pairs)
+                continue
+            if bulk is not None:
+                delivery = []
                 for old, new in pairs:
                     old_p, new_p = w._passes(old), w._passes(new)
-                    if old_p and new_p and w.on_update:
-                        w.on_update(old, fast_clone(new))
+                    if old_p and new_p:
+                        delivery.append((old, new))
                     elif not old_p and new_p and w.on_add:
                         w.on_add(fast_clone(new))
                     elif old_p and not new_p and w.on_delete:
                         w.on_delete(old)
-        return pairs, missing
+                if delivery:
+                    bulk(delivery)
+                continue
+            for old, new in pairs:
+                old_p, new_p = w._passes(old), w._passes(new)
+                if old_p and new_p and w.on_update:
+                    w.on_update(old, fast_clone(new))
+                elif not old_p and new_p and w.on_add:
+                    w.on_add(fast_clone(new))
+                elif old_p and not new_p and w.on_delete:
+                    w.on_delete(old)
 
     def delete(self, kind: str, name: str, namespace: str = "default",
                skip_admission: bool = False) -> int:
@@ -304,13 +641,13 @@ class ObjectStore:
                 raise KeyError(f"{kind} {key!r} not found")
             self._admit(kind, "DELETE", None, old_pre)   # outside the lock
         with self._lock:
+            self._wait_key_writable_locked(kind, key)
             old = self._objects[kind].get(key)
             if old is None:
                 raise KeyError(f"{kind} {key!r} not found")
             self._rv += 1
             deleted_rv = self._rv
-            self._journal.append((self._rv, "DELETED", kind, old))
-            self._journal_cond.notify_all()
+            self._journal_append_locked(self._rv, "DELETED", kind, old)
             del self._objects[kind][key]
             watches = list(self._watches[kind])
         for w in watches:
@@ -354,6 +691,14 @@ class ObjectStore:
         w = Watch(kind, on_add, on_update, on_delete, filter_fn,
                   on_bulk_update=on_bulk_update)
         with self._lock:
+            # wait out an in-flight sharded patch on this kind: its
+            # delivery list was snapshotted at reservation time, so a
+            # watch registered mid-flight would neither appear in that
+            # snapshot nor see the unpublished shards in its sync replay
+            # — it would silently miss part of the burst forever
+            if self._inflight.get(kind):
+                self._flush_cond.wait_for(
+                    lambda: not self._inflight.get(kind))
             self._watches[kind].append(w)
             existing = list(self._objects[kind].values()) if sync else []
         for o in existing:
@@ -362,31 +707,39 @@ class ObjectStore:
         return w
 
     def current_rv(self) -> int:
+        """The watch-visible resource version: the journal's contiguous
+        tail. During a bulk-patch reservation window this can trail the
+        allocation counter ``_rv`` — cursors anchored here never skip the
+        reserved-but-unpublished entries."""
         with self._lock:
-            return self._rv
+            return self._journal_tail
 
     def events_since(self, rv: int, timeout: float = 25.0):
         """Long-poll the change journal: block until an event with
         resource_version > rv exists (or timeout), then return
         (events, current_rv, resync) where events is [(rv, action, kind,
         object)] and resync=True means rv predates the journal window —
-        the caller must re-list everything and restart from current_rv."""
+        the caller must re-list everything and restart from current_rv.
+        Visibility is bounded by the journal's contiguous tail (entries
+        parked behind an in-flight bulk reservation are not yet events)."""
         import itertools
         with self._journal_cond:
             if not self._journal_cond.wait_for(
-                    lambda: self._rv > rv, timeout=timeout):
-                return [], self._rv, False
+                    lambda: self._journal_tail > rv, timeout=timeout):
+                return [], self._journal_tail, False
             if not self._journal or self._journal[0][0] > rv + 1:
                 # gap: the journal cannot prove coverage of rv+1 (rolled
                 # past it, or cleared by a snapshot restore) — the caller
                 # must re-list
-                return [], self._rv, True
-            # journal rvs are contiguous (every rv bump appends exactly one
-            # entry), so the slice start is an O(1) offset, not a scan
+                return [], self._journal_tail, True
+            # journal rvs are contiguous up to the tail (reserved ranges
+            # publish in rv order; interleaved writers park until the
+            # range below them lands), so the slice start is an O(1)
+            # offset, not a scan
             start = max(0, rv + 1 - self._journal[0][0]) if self._journal \
                 else 0
             events = list(itertools.islice(self._journal, start, None))
-            return events, self._rv, False
+            return events, self._journal_tail, False
 
     def unwatch(self, w: Watch) -> None:
         with self._lock:

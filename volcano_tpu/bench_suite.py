@@ -133,10 +133,13 @@ def _warm_cycle(conf_text: str, runs: int = 3, flush_timeout: float = 120.0,
     _populate(store, **populate_kwargs)
     _run_cycle(cache, conf)                # includes compile
     cache.flush_executors(timeout=flush_timeout)
-    del store, cache, binder               # free the cold env before the
-    #                                        measured runs (3 concurrent
-    #                                        50k-task envs swap-pressure
-    #                                        the very cycle being timed)
+    cache.stop()                           # free the cold env before the
+    #                                        measured runs — the executor
+    #                                        thread pins the env alive, so
+    #                                        without stop() every env
+    #                                        leaks and later runs pay the
+    #                                        accumulated heap pressure
+    del store, cache, binder
     best = (float("inf"), 0.0, None, None, None, None)
     for _ in range(runs):
         store2, cache2, binder2, conf2 = _cycle_env(conf_text)
@@ -147,7 +150,11 @@ def _warm_cycle(conf_text: str, runs: int = 3, flush_timeout: float = 120.0,
         cache2.flush_executors(timeout=flush_timeout)
         flush_ms = (time.perf_counter() - t0) * 1000.0
         if ms < best[0]:
+            if best[3] is not None:
+                best[3].stop()             # non-winning env: release it
             best = (ms, flush_ms, binder2, cache2, conf2, rec)
+        else:
+            cache2.stop()
     return best
 
 
@@ -357,6 +364,7 @@ def full_cycle_50k(n_tasks=50_000, n_nodes=10_000) -> Dict:
            "platform": _platform()}
     if rec is not None:
         out["phases"] = tr.flat_phases(rec)
+        out["flush_phases"] = tr.async_phases(rec)
         out["trace_coverage"] = tr.summary(rec)["coverage"]
     return out
 

@@ -36,6 +36,28 @@ from .interface import (StoreBinder, StoreEvictor, StoreStatusUpdater,
                         StoreVolumeBinder)
 
 
+class _BindBurst:
+    """One gang's recorded bind commit: the write-behind apply payload
+    (``pairs`` of (task_info, hostname)) plus the accept/bound results
+    populated at apply time. Callable, so the generic apply-drain path
+    and inline mode treat it like any queued mutation; the drain
+    additionally COALESCES consecutive bursts in the apply queue into one
+    cross-gang pass (``_apply_bind_bursts``) — a 50k-bind flush arrives
+    as 6.25k gangs whose tasks land ~5 per node, and per-gang node
+    accounting degenerates to 1-task calls without the merge."""
+
+    __slots__ = ("cache", "pairs", "accepted", "bound")
+
+    def __init__(self, cache, pairs):
+        self.cache = cache
+        self.pairs = pairs
+        self.accepted: list = []
+        self.bound: list = []
+
+    def __call__(self):
+        self.cache._apply_bind_bursts([self])
+
+
 class SchedulerCache(EventHandlersMixin):
     """The scheduler's view of the cluster, fed by store watches."""
 
@@ -101,9 +123,9 @@ class SchedulerCache(EventHandlersMixin):
         # cleared while a scheduling cycle is in flight: the executor backs
         # off so its (GIL-bound) store writes don't contend with the
         # cycle's host path — submitted work flushes in the schedule-period
-        # gap instead. The yield is bounded (2 s) and taken at most once
-        # per cycle generation, so back-to-back cycles can't starve the
-        # bind/evict backlog.
+        # gap instead. The yield is bounded (CYCLE_YIELD_SECONDS) and taken
+        # at most once per cycle generation, so back-to-back cycles can't
+        # starve the bind/evict backlog.
         self._cycle_idle = threading.Event()
         self._cycle_idle.set()
         self._cycle_gen = 0
@@ -113,6 +135,17 @@ class SchedulerCache(EventHandlersMixin):
         # mutation bumps _state_version and invalidates the prebuilt.
         self._state_version = 0
         self._prebuilt: Optional[tuple] = None
+        # expected bind-echo hint: while _bind_store_writes is on the
+        # store, (thread_ident, {pod uid: (cache task, hostname)}) of the
+        # binds being written, so update_pods_bulk can ingest our own
+        # echoes without re-deriving what this thread just wrote. The
+        # hint is THREAD-SCOPED: the store delivers synchronously from
+        # the patching thread, so a delivery arriving on the hint's own
+        # thread is by construction our patch; a delivery on any other
+        # thread (another writer's patch racing a small serial-path
+        # burst, which takes no in-flight barrier) ignores the hint and
+        # takes the full change-detection guards
+        self._expected_bind_echo: Optional[tuple] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -196,6 +229,15 @@ class SchedulerCache(EventHandlersMixin):
     # otherwise idle (the reference's processResyncTask wait.Until period)
     RESYNC_RETRY_SECONDS = 1.0
 
+    # how long the executor defers a drain for a live scheduling cycle
+    # (once per cycle generation). Under the GIL a mid-cycle drain doesn't
+    # overlap the cycle, it time-slices it — stretching BOTH the cycle and
+    # the flush — so the bound comfortably covers a slow cycle (the 1 s
+    # budget plus heavy co-tenancy headroom) while still guaranteeing
+    # backlog progress if a cycle wedges. The wait also ends the instant
+    # the cycle ends.
+    CYCLE_YIELD_SECONDS = 5.0
+
     def _exec_loop(self) -> None:
         from ..utils import gcguard
         last_yield_gen = -1
@@ -239,11 +281,12 @@ class SchedulerCache(EventHandlersMixin):
                         gcguard.pause()
                     # yield to a live cycle — once per cycle generation, so
                     # long or back-to-back cycles delay the backlog by at
-                    # most 2 s each rather than 2 s per queued item
+                    # most CYCLE_YIELD_SECONDS each rather than per item
                     if not self._cycle_idle.is_set():
                         gen = self._cycle_gen
                         if gen != last_yield_gen:
-                            self._cycle_idle.wait(timeout=2.0)
+                            self._cycle_idle.wait(
+                                timeout=self.CYCLE_YIELD_SECONDS)
                             last_yield_gen = gen
                     try:
                         fn()   # submitted fns resync their own errors
@@ -319,14 +362,28 @@ class SchedulerCache(EventHandlersMixin):
     def _drain_applies_locked(self) -> None:
         """Run all pending write-behind mutations. Caller must hold
         ``self.mutex``; pop+execute is atomic under it, so a drain that
-        finds the deque empty knows every prior apply has completed."""
+        finds the deque empty knows every prior apply has completed.
+
+        Runs of CONSECUTIVE bind bursts execute as one cross-gang pass
+        (see :class:`_BindBurst`); any non-burst entry (an evict apply)
+        closes the run, so the queue's FIFO contract — a bind apply never
+        reorders across an evict that was submitted after it — holds."""
         while True:
+            bursts = None
             with self._apply_lock:
                 if not self._pending_apply:
                     return
                 fn = self._pending_apply.popleft()
+                if isinstance(fn, _BindBurst):
+                    bursts = [fn]
+                    while self._pending_apply and isinstance(
+                            self._pending_apply[0], _BindBurst):
+                        bursts.append(self._pending_apply.popleft())
             self._state_version += 1
-            fn()
+            if bursts is not None:
+                self._apply_bind_bursts(bursts)
+            else:
+                fn()
 
     def client(self) -> ObjectStore:
         """The plugins'/actions' handle to the API (Cache.Client analogue)."""
@@ -435,76 +492,7 @@ class SchedulerCache(EventHandlersMixin):
         pairs = list(pairs)
         if not pairs:
             return []
-        accepted: list = []
-        bound: list = []
-
-        def apply_one(task_info, hostname):
-            try:
-                job, task = self._find_job_and_task(task_info)
-            except KeyError:
-                return
-            node = self.nodes.get(hostname)
-            if node is None:
-                return
-            original = task.status
-            job.move_task_status(task, TaskStatus.Binding)
-            try:
-                node.add_task(task)
-            except RuntimeError:
-                job.move_task_status(task, original)
-                return
-            accepted.append(task_info)
-            bound.append((task, task.pod, hostname))
-
-        def apply():
-            # bulk fast path: a gang's pairs share one job and land on few
-            # nodes; one status-move pass + one accounting pass per node
-            # replaces per-task move/add overhead (50k binds per burst).
-            # Any lookup miss or accounting refusal falls back to the
-            # per-task path for exactly the affected items (identical
-            # semantics: the per-task path skips/rolls back per task).
-            by_job: Dict[str, list] = {}
-            for task_info, hostname in pairs:
-                by_job.setdefault(task_info.job, []).append(
-                    (task_info, hostname))
-            for jid, items in by_job.items():
-                job = self.jobs.get(jid)
-                stored = None
-                if job is not None:
-                    stored = [job.tasks.get(t.uid) for t, _ in items]
-                if job is None or any(s is None for s in stored) or \
-                        any(self.nodes.get(h) is None for _, h in items):
-                    for task_info, hostname in items:
-                        apply_one(task_info, hostname)
-                    continue
-                originals = [s.status for s in stored]
-                job.move_tasks_status_bulk(stored, TaskStatus.Binding)
-                by_node: Dict[str, list] = {}
-                for (task_info, hostname), s, orig in zip(items, stored,
-                                                          originals):
-                    by_node.setdefault(hostname, []).append(
-                        (task_info, s, orig))
-                for hostname, node_items in by_node.items():
-                    node = self.nodes[hostname]
-                    tasks = [s for _, s, _ in node_items]
-                    try:
-                        node.add_tasks_bulk(tasks, pipelined=False)
-                    except RuntimeError:
-                        # combined fit refused (drifted accounting): replay
-                        # per task so fitting prefixes still land
-                        for (task_info, s, orig) in node_items:
-                            try:
-                                node.add_task(s)
-                            except RuntimeError:
-                                job.move_task_status(s, orig)
-                                continue
-                            accepted.append(task_info)
-                            bound.append((s, s.pod, hostname))
-                        continue
-                    for task_info, s, _ in node_items:
-                        accepted.append(task_info)
-                        bound.append((s, s.pod, hostname))
-
+        burst = _BindBurst(self, pairs)
         with self._exec_lock:
             worker_live = self._exec_thread is not None
         if worker_live:
@@ -513,8 +501,8 @@ class SchedulerCache(EventHandlersMixin):
             # apply in _pending_apply, so the drainer's apply drain
             # covers every gang it pops
             with self._apply_lock:
-                self._pending_apply.append(apply)
-                self._pending_binds.append(bound)
+                self._pending_apply.append(burst)
+                self._pending_binds.append(burst.bound)
                 need_drain = not self._bind_drain_queued
                 self._bind_drain_queued = True
             if need_drain:
@@ -522,19 +510,102 @@ class SchedulerCache(EventHandlersMixin):
             return [t for t, _ in pairs]
         with self.mutex:
             self._state_version += 1
-            apply()
-        self._bind_store_writes(bound)
-        return accepted
+            burst()
+        self._bind_store_writes(burst.bound)
+        return list(burst.accepted)
+
+    def _apply_bind_one(self, burst: _BindBurst, task_info, hostname) -> None:
+        """Per-task bind apply (the fallback when a burst item's
+        job/task/node lookup fails): skips/rolls back exactly the
+        affected task, matching the per-task commit path's semantics."""
+        try:
+            job, task = self._find_job_and_task(task_info)
+        except KeyError:
+            return
+        node = self.nodes.get(hostname)
+        if node is None:
+            return
+        original = task.status
+        job.move_task_status(task, TaskStatus.Binding)
+        try:
+            node.add_task(task)
+        except RuntimeError:
+            job.move_task_status(task, original)
+            return
+        burst.accepted.append(task_info)
+        burst.bound.append((task, task.pod, hostname))
+
+    def _apply_bind_bursts(self, bursts) -> None:
+        """Cross-gang bind apply: one status-move pass per job and ONE
+        accounting pass per node for a whole run of coalesced bursts
+        (caller holds ``self.mutex``). A 50k-bind flush carries 6.25k
+        gangs of 8 whose tasks land ~5 per node — grouped per gang, the
+        node passes degenerate to 1-task calls; grouped across the run
+        they stay genuinely bulk. Any lookup miss or accounting refusal
+        falls back to the per-task path for exactly the affected items
+        (identical semantics: the per-task path skips/rolls back per
+        task). Each burst's accepted/bound lists are populated in
+        (job-group, node-group) order — deterministic, since both
+        groupings are insertion-ordered by the input pairs."""
+        by_job: Dict[str, list] = {}
+        for burst in bursts:
+            for task_info, hostname in burst.pairs:
+                by_job.setdefault(task_info.job, []).append(
+                    (burst, task_info, hostname))
+        by_node: Dict[str, list] = {}
+        for jid, items in by_job.items():
+            job = self.jobs.get(jid)
+            stored = None
+            if job is not None:
+                stored = [job.tasks.get(t.uid) for _, t, _ in items]
+            if job is None or any(s is None for s in stored) or \
+                    any(self.nodes.get(h) is None for _, _, h in items):
+                for burst, task_info, hostname in items:
+                    self._apply_bind_one(burst, task_info, hostname)
+                continue
+            originals = [s.status for s in stored]
+            job.move_tasks_status_bulk(stored, TaskStatus.Binding)
+            for (burst, task_info, hostname), s, orig in zip(items, stored,
+                                                             originals):
+                by_node.setdefault(hostname, []).append(
+                    (burst, task_info, s, orig, job))
+        for hostname, node_items in by_node.items():
+            node = self.nodes[hostname]
+            try:
+                node.add_tasks_bulk([s for _, _, s, _, _ in node_items],
+                                    pipelined=False)
+            except RuntimeError:
+                # combined fit refused (drifted accounting): replay per
+                # task so fitting prefixes still land
+                for burst, task_info, s, orig, job in node_items:
+                    try:
+                        node.add_task(s)
+                    except RuntimeError:
+                        job.move_task_status(s, orig)
+                        continue
+                    burst.accepted.append(task_info)
+                    burst.bound.append((s, s.pod, hostname))
+                continue
+            for burst, task_info, s, orig, job in node_items:
+                burst.accepted.append(task_info)
+                burst.bound.append((s, s.pod, hostname))
 
     def _drain_binds(self) -> None:
         """Executor half of the coalesced bind flush: pop the recorded
         gangs, drain the pending cache applies (they order BEFORE the
         store writes — popping first guarantees every popped gang's apply
-        is covered), then execute one store bind pass for the burst."""
+        is covered), then execute one store bind pass for the burst (the
+        sharded reserve/clone/publish pipeline when the store supports
+        it; its per-shard bulk deliveries land back here through
+        ``update_pods_bulk`` while later shards are still cloning)."""
+        import time as _time
+
+        from ..metrics import metrics as m
+        from ..trace import tracer
         with self._apply_lock:
             batches, self._pending_binds = self._pending_binds, []
             self._bind_drain_queued = False
-        from ..trace import tracer
+        t0 = _time.perf_counter()
         with tracer.async_span("bind_flush.apply"):
             with self.mutex:
                 self._drain_applies_locked()
@@ -542,12 +613,21 @@ class SchedulerCache(EventHandlersMixin):
         if bound:
             with tracer.async_span("bind_flush.store", binds=len(bound)):
                 self._bind_store_writes(bound)
+            m.observe(m.BIND_FLUSH_LATENCY,
+                      (_time.perf_counter() - t0) * 1000.0)
+            m.inc(m.BIND_FLUSH_BINDS, len(bound))
 
     def _bind_store_writes(self, bound) -> None:
         """One binder pass + Scheduled events for [(task, pod, hostname)];
         failures land in the resync queue (cache.go:605-655)."""
         bind_all = getattr(self.binder, "bind_batch", None)
         if bind_all is not None:
+            # hint the echo ingest: bulk deliveries arriving ON THIS
+            # THREAD while we're inside bind_all are OUR writes (the
+            # store delivers synchronously from the patching thread), so
+            # update_pods_bulk can skip the change-detection guards
+            self._expected_bind_echo = (threading.get_ident(), {
+                task.uid: (task, hostname) for task, _, hostname in bound})
             try:
                 missing = bind_all([(pod, hostname)
                                     for _, pod, hostname in bound])
@@ -555,15 +635,27 @@ class SchedulerCache(EventHandlersMixin):
                 for task, _, _ in bound:
                     self.resync_task(task)
                 return
+            finally:
+                self._expected_bind_echo = None
             gone = {id(pod) for pod, _ in missing}
-            for task, pod, hostname in bound:
-                if id(pod) in gone:
-                    self.resync_task(task)
-                else:
-                    self.store.record_event(
-                        "pods", pod, "Normal", "Scheduled",
-                        f"Successfully assigned {task.namespace}/"
-                        f"{task.name} to {hostname}")
+            ok = bound
+            if gone:
+                for task, pod, hostname in bound:
+                    if id(pod) in gone:
+                        self.resync_task(task)
+                ok = [b for b in bound if id(b[1]) not in gone]
+            # Scheduled events: the store's event deque is bounded, so a
+            # burst longer than its capacity would format messages for
+            # entries the append itself immediately evicts — skip the
+            # doomed prefix (the surviving deque contents are identical;
+            # gone pods are filtered BEFORE slicing so the window holds
+            # exactly the newest `cap` events that would have survived)
+            cap = getattr(self.store, "EVENTS_CAPACITY", 0) or len(ok)
+            for task, pod, hostname in ok[-cap:]:
+                self.store.record_event(
+                    "pods", pod, "Normal", "Scheduled",
+                    f"Successfully assigned {task.namespace}/"
+                    f"{task.name} to {hostname}")
             return
         for task, pod, hostname in bound:
             try:

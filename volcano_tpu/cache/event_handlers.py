@@ -9,6 +9,7 @@ is synchronous).
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 from ..models import objects as obj
@@ -114,8 +115,11 @@ class EventHandlersMixin:
         self.add_pod(new)
 
     def update_pods_bulk(self, pairs) -> None:
-        """Batched echo ingest for patch_batch bursts (bind writes): one
-        mutex pass and one state-version bump for the whole delivery.
+        """Batched echo ingest for bulk store patches (bind writes): one
+        mutex pass and one state-version bump per delivery. The sharded
+        bind flush delivers one such call PER SHARD, from the store's
+        publish loop, so this ingest overlaps the clone work of the
+        shards behind it (docs/design/bind_pipeline.md).
 
         The delivered ``new`` objects are the store's own (transient,
         read-only — see ObjectStore.patch_batch). A pure bind echo — same
@@ -124,6 +128,8 @@ class EventHandlersMixin:
         cache already holds, with the transient object dropped: zero
         clones, no TaskInfo rebuild. Anything else falls back to
         :meth:`update_pod` on a private copy."""
+        from ..trace import tracer
+
         # per-(job, status) run accumulator: the echo moves flush through
         # move_tasks_status_bulk (one index pass per run instead of one
         # per pod — a 50k-bind burst delivers in gang order)
@@ -138,22 +144,65 @@ class EventHandlersMixin:
             run_job = None
             run_tasks = []
 
-        with self.mutex:
+        # the bind-echo hint is thread-scoped: only deliveries on the
+        # hinting thread are provably its own store write (the store
+        # delivers synchronously from the patching thread)
+        hint_state = getattr(self, "_expected_bind_echo", None)
+        exp = hint_state[1] if hint_state is not None \
+            and hint_state[0] == threading.get_ident() else None
+        with tracer.async_span("bind_flush.echo", pairs=len(pairs)), \
+                self.mutex:
             self._state_version += 1
             for old, new in pairs:
+                if exp is not None:
+                    # our own bind write echoing back (delivered on the
+                    # hinting thread): the patch changed node_name + rv
+                    # and nothing else BY CONSTRUCTION, so the per-pod
+                    # change-detection guards below are redundant — move
+                    # the status index and refresh the rv, done
+                    hint = exp.get(new.metadata.uid)
+                    if hint is not None:
+                        task, host = hint
+                        new_status = get_task_status(new)
+                        if new.spec.node_name == host \
+                                and task.node_name == host \
+                                and allocated_status(task.status) \
+                                and allocated_status(new_status):
+                            job = self.jobs.get(task.job)
+                            if job is not None:
+                                if job is not run_job \
+                                        or new_status != run_status:
+                                    flush_run()
+                                    run_job, run_status = job, new_status
+                                run_tasks.append(task)
+                                rv = new.metadata.resource_version
+                                task.pod.metadata.resource_version = rv
+                                node = self.nodes.get(host)
+                                stored = node.tasks.get(task.key()) \
+                                    if node is not None else None
+                                if stored is not None and stored is not task:
+                                    stored.status = new_status
+                                    if stored.pod is not task.pod:
+                                        stored.pod.metadata \
+                                            .resource_version = rv
+                                continue
                 jid = get_job_id(new)
                 job = self.jobs.get(jid) if jid else None
                 cached = None
                 if job is not None:
                     uid = new.metadata.uid or new.metadata.key()
                     cached = job.tasks.get(uid)
+                om, nm = old.metadata, new.metadata
                 if cached is not None and cached.node_name \
                         and cached.node_name == new.spec.node_name \
                         and allocated_status(cached.status) \
-                        and old.metadata.annotations == new.metadata.annotations \
+                        and (om.annotations is nm.annotations
+                             or om.annotations == nm.annotations) \
                         and old.spec.priority == new.spec.priority \
-                        and (old.metadata.deletion_timestamp
-                             == new.metadata.deletion_timestamp):
+                        and (om.deletion_timestamp
+                             is nm.deletion_timestamp
+                             or om.deletion_timestamp
+                             == nm.deletion_timestamp):
                     # the three guards above prove the patch changed nothing
                     # the per-event fast path would re-derive (priority,
                     # preemptable, revocable zone, topology policy, releasing

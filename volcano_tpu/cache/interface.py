@@ -50,11 +50,12 @@ def _accepts_clone_fn(patch_fn) -> bool:
 
 def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool) -> tuple:
     """Shared engine behind StoreBinder/FakeBinder ``bind_batch``: one
-    ``patch_batch`` store pass (one lock acquisition, one bulk watch
-    delivery) instead of a get+update round trip per pod.
+    bulk store pass (``bind_pods`` when the store has it — the sharded,
+    natively-cloned pipeline — else ``patch_batch`` with per-host patch
+    closures) instead of a get+update round trip per pod.
 
     Falls back to per-pod ``per_pod_bind`` calls when the store has no
-    ``patch_batch`` (remote stores) or ``batch_ok`` is False (a binder
+    bulk patch API (remote stores) or ``batch_ok`` is False (a binder
     subclass overrode ``bind`` — failure injection and custom transports
     keep their semantics).
 
@@ -62,9 +63,11 @@ def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool) -> tuple:
     bind (pod gone, or bind raised) for the caller to resync, and whether
     the batch path ran (per-pod fallback already went through the
     caller's own bind)."""
+    bind_fn = getattr(store, "bind_pods", None) if store is not None \
+        else None
     patch_fn = getattr(store, "patch_batch", None) if store is not None \
         else None
-    if patch_fn is None or not batch_ok:
+    if (bind_fn is None and patch_fn is None) or not batch_ok:
         failed = []
         for pod, hostname in items:
             try:
@@ -73,21 +76,33 @@ def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool) -> tuple:
                 failed.append((pod, hostname))
         return failed, False
 
-    def setter(host):
-        def fn(p):
-            p.spec.node_name = host
-            p.resource_request()   # seed the parse cache: the new stored
-            #                        version and every watcher echo copy
-            #                        share it (TaskInfo rebuilds skip the
-            #                        quantity parse)
-        return fn
+    if bind_fn is not None:
+        # payload-based fast path: no per-pod closures to build, and the
+        # store can promote whole shards into fastmodel.bind_clone_pods
+        _, missing_keys = bind_fn(
+            [(pod.metadata.name, pod.metadata.namespace, hostname)
+             for pod, hostname in items])
+    else:
+        def setter(host):
+            def fn(p):
+                p.spec.node_name = host
+                p.resource_request()   # seed the parse cache: the new
+                #                        stored version and every watcher
+                #                        echo copy share it (TaskInfo
+                #                        rebuilds skip the quantity parse)
+            return fn
 
-    from ..models.objects import clone_pod_for_bind
-    kwargs = {"clone_fn": clone_pod_for_bind} \
-        if _accepts_clone_fn(patch_fn) else {}
-    _, missing_keys = patch_fn(
-        "pods", [(pod.metadata.name, pod.metadata.namespace,
-                  setter(hostname)) for pod, hostname in items], **kwargs)
+        from ..models.objects import clone_pod_for_bind
+        kwargs = {"clone_fn": clone_pod_for_bind} \
+            if _accepts_clone_fn(patch_fn) else {}
+        # hosts repeat heavily (a 10k-node burst carries ~5 pods per
+        # node): one closure per distinct host, not per pod
+        setters: dict = {}
+        _, missing_keys = patch_fn(
+            "pods", [(pod.metadata.name, pod.metadata.namespace,
+                      setters.get(hostname) or
+                      setters.setdefault(hostname, setter(hostname)))
+                     for pod, hostname in items], **kwargs)
     if not missing_keys:
         return [], True
     gone = set(missing_keys)

@@ -63,6 +63,12 @@ NAMESPACE_SHARE = f"{NS}_namespace_share"
 NAMESPACE_WEIGHT = f"{NS}_namespace_weight"
 SOLVER_KERNEL_LATENCY = f"{NS}_tpu_solver_kernel_latency_milliseconds"
 UNSCHEDULABLE_REASON = f"{NS}_unschedulable_reason_total"
+# bind-flush pipeline (docs/design/bind_pipeline.md): wall latency of one
+# coalesced drain (apply + store write + echo ingest), binds it carried,
+# and the shard fan-out of each sharded store commit
+BIND_FLUSH_LATENCY = f"{NS}_bind_flush_latency_milliseconds"
+BIND_FLUSH_BINDS = f"{NS}_bind_flush_binds_total"
+STORE_PATCH_SHARDS = f"{NS}_store_patch_shards"
 
 
 def observe(name: str, value: float, **labels):

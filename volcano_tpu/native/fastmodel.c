@@ -346,6 +346,137 @@ clone_resource(PyObject *self, PyObject *arg)
 
 /* ---- generic shell clone for plain __dict__ classes ---- */
 
+/* interned attribute keys for the bind-clone hot loop (module init) */
+static PyObject *s_metadata, *s_spec, *s_node_name, *s_resource_version;
+
+/* instance __dict__ slot of o, or NULL (with TypeError set) when the
+ * class keeps no dict — the bind-clone loop works on the dict storage
+ * directly, skipping the attribute-descriptor machinery entirely */
+static PyObject **
+dict_slot(PyObject *o)
+{
+    PyObject **dp = _PyObject_GetDictPtr(o);
+    if (dp == NULL)
+        PyErr_Format(PyExc_TypeError, "%s instance carries no __dict__",
+                     Py_TYPE(o)->tp_name);
+    return dp;
+}
+
+/* new instance of tp adopting nd as its __dict__ (steals no refs;
+ * the instance takes its own). NULL on failure. */
+static PyObject *
+adopt_dict(PyTypeObject *tp, PyObject *nd)
+{
+    PyObject *dst = tp->tp_alloc(tp, 0);
+    if (dst == NULL)
+        return NULL;
+    PyObject **dp = _PyObject_GetDictPtr(dst);
+    if (dp == NULL) {
+        Py_DECREF(dst);
+        PyErr_Format(PyExc_TypeError, "%s instances carry no __dict__",
+                     tp->tp_name);
+        return NULL;
+    }
+    Py_INCREF(nd);
+    *dp = nd;
+    return dst;
+}
+
+/* bind_clone_pods(pods, hostnames, rv_start) -> list[Pod]
+ *
+ * The whole clone+patch+rv step of one bind-flush shard in a single
+ * call: for each stored pod, build the minimal bind clone (the C twin of
+ * models/objects.py clone_pod_for_bind — fresh pod/metadata/spec shells,
+ * every subtree SHARED with the immutable stored object, the _rr parse
+ * cache riding along in the dict copy), set spec.node_name to
+ * hostnames[i] and metadata.resource_version to rv_start + i.  The
+ * Python loop pays ~6 dict builds + 3 object constructions + 2 attribute
+ * stores per pod in interpreted code; here it is a fixed sequence of
+ * C-API calls, which is what turns the 50k-pod store pass from the
+ * flush's dominant cost into a minor one (docs/design/bind_pipeline.md).
+ */
+static PyObject *
+bind_clone_pods(PyObject *self, PyObject *args)
+{
+    PyObject *pods, *hosts;
+    long long rv_start;
+    if (!PyArg_ParseTuple(args, "O!O!L", &PyList_Type, &pods,
+                          &PyList_Type, &hosts, &rv_start))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(pods);
+    if (PyList_GET_SIZE(hosts) != n) {
+        PyErr_SetString(PyExc_ValueError, "pods/hostnames length mismatch");
+        return NULL;
+    }
+    PyObject *out = PyList_New(n);
+    if (out == NULL)
+        return NULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *src = PyList_GET_ITEM(pods, i);
+        PyObject **sdp = dict_slot(src);
+        if (sdp == NULL || *sdp == NULL) {
+            if (sdp != NULL)
+                PyErr_SetString(PyExc_TypeError, "pod has no __dict__");
+            goto fail;
+        }
+        PyObject *nd = PyDict_Copy(*sdp);
+        if (nd == NULL)
+            goto fail;
+        /* metadata shell with the fresh resource_version */
+        PyObject *meta = PyDict_GetItem(nd, s_metadata); /* borrowed */
+        PyObject *spec = PyDict_GetItem(nd, s_spec);     /* borrowed */
+        PyObject **mdp, **spp;
+        if (meta == NULL || spec == NULL ||
+            (mdp = dict_slot(meta)) == NULL || *mdp == NULL ||
+            (spp = dict_slot(spec)) == NULL || *spp == NULL) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_TypeError,
+                                "pod lacks metadata/spec dicts");
+            Py_DECREF(nd);
+            goto fail;
+        }
+        PyObject *md = PyDict_Copy(*mdp);
+        PyObject *rv = PyLong_FromLongLong(rv_start + (long long)i);
+        PyObject *nmeta = NULL;
+        if (md == NULL || rv == NULL ||
+            PyDict_SetItem(md, s_resource_version, rv) < 0 ||
+            (nmeta = adopt_dict(Py_TYPE(meta), md)) == NULL ||
+            PyDict_SetItem(nd, s_metadata, nmeta) < 0) {
+            Py_XDECREF(nmeta);
+            Py_XDECREF(rv);
+            Py_XDECREF(md);
+            Py_DECREF(nd);
+            goto fail;
+        }
+        Py_DECREF(nmeta);
+        Py_DECREF(rv);
+        Py_DECREF(md);
+        /* spec shell with the bind target */
+        PyObject *sd = PyDict_Copy(*spp);
+        PyObject *nspec = NULL;
+        if (sd == NULL ||
+            PyDict_SetItem(sd, s_node_name, PyList_GET_ITEM(hosts, i)) < 0 ||
+            (nspec = adopt_dict(Py_TYPE(spec), sd)) == NULL ||
+            PyDict_SetItem(nd, s_spec, nspec) < 0) {
+            Py_XDECREF(nspec);
+            Py_XDECREF(sd);
+            Py_DECREF(nd);
+            goto fail;
+        }
+        Py_DECREF(nspec);
+        Py_DECREF(sd);
+        PyObject *dst = adopt_dict(Py_TYPE(src), nd);
+        Py_DECREF(nd);
+        if (dst == NULL)
+            goto fail;
+        PyList_SET_ITEM(out, i, dst);
+    }
+    return out;
+fail:
+    Py_DECREF(out);
+    return NULL;
+}
+
 static PyObject *
 shell_clone(PyObject *self, PyObject *src)
 {
@@ -385,6 +516,8 @@ static PyMethodDef methods[] = {
      "Slot-copy Resource clone with a fresh scalars dict."},
     {"shell_clone", shell_clone, METH_O,
      "New instance of type(obj) with a shallow __dict__ copy."},
+    {"bind_clone_pods", bind_clone_pods, METH_VARARGS,
+     "Batch bind clone: minimal pod shells with node_name + rv set."},
     {NULL, NULL, 0, NULL}
 };
 
@@ -396,5 +529,12 @@ static struct PyModuleDef moduledef = {
 PyMODINIT_FUNC
 PyInit_fastmodel(void)
 {
+    s_metadata = PyUnicode_InternFromString("metadata");
+    s_spec = PyUnicode_InternFromString("spec");
+    s_node_name = PyUnicode_InternFromString("node_name");
+    s_resource_version = PyUnicode_InternFromString("resource_version");
+    if (s_metadata == NULL || s_spec == NULL || s_node_name == NULL ||
+        s_resource_version == NULL)
+        return NULL;
     return PyModule_Create(&moduledef);
 }

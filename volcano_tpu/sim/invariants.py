@@ -24,6 +24,10 @@ Catalog (docs/design/simulation.md carries the prose version):
 * ``snapshot_coherence`` — the per-cycle snapshot agrees with the live
   cache and the store: task keysets match, snapshot nodes are exactly
   the ready cache nodes, and cloned idle equals live idle.
+* ``journal_order`` — the store's change journal is rv-sorted and
+  gap-free, its tail matches the watch-visible resource version, and no
+  reservation (sharded bind flush, docs/design/bind_pipeline.md) is
+  left open at the tick boundary: no parked entries, no in-flight keys.
 """
 
 from __future__ import annotations
@@ -275,8 +279,51 @@ def check_snapshot_coherence(ctx: CycleContext,
     return out
 
 
+def check_journal_order(ctx: CycleContext) -> List[Violation]:
+    """The store journal under the parallel bind flush: rvs strictly
+    contiguous ascending, tail == watch-visible rv, and every
+    reservation fully published by the tick's flush barrier."""
+    out: List[Violation] = []
+    store = ctx.store
+    if not hasattr(store, "_journal"):
+        return out   # remote mirror: no local journal to audit
+    with store._lock:
+        entries = list(store._journal)
+        tail = store._journal_tail
+        parked = dict(store._journal_parked)
+        inflight = {k: set(v) for k, v in store._inflight.items() if v}
+        alloc = store._rv
+    prev = None
+    for rv, _action, _kind, _obj in entries:
+        if prev is not None and rv != prev + 1:
+            out.append(Violation(
+                "journal_order",
+                f"journal gap: rv {prev} followed by {rv}"))
+            break
+        prev = rv
+    if entries and entries[-1][0] != tail:
+        out.append(Violation(
+            "journal_order",
+            f"journal tail {tail} != last entry rv {entries[-1][0]}"))
+    if parked:
+        out.append(Violation(
+            "journal_order",
+            f"{len(parked)} journal entries still parked at the flush "
+            f"barrier (tail {tail}, reserved through {alloc})"))
+    if inflight:
+        out.append(Violation(
+            "journal_order",
+            f"in-flight patch keys left open at the flush barrier: "
+            f"{ {k: len(v) for k, v in inflight.items()} }"))
+    if tail != alloc and not parked:
+        out.append(Violation(
+            "journal_order",
+            f"allocated rv {alloc} never published (tail {tail})"))
+    return out
+
+
 CHECKERS = (check_node_accounting, check_gang_atomicity, check_queue_quota,
-            check_no_orphans, check_snapshot_coherence)
+            check_no_orphans, check_snapshot_coherence, check_journal_order)
 
 
 def check_all(ctx: CycleContext) -> List[Violation]:

@@ -139,12 +139,36 @@ class _AsyncCtx:
     def __exit__(self, *exc):
         s = self._span
         s.dur = _perf() - s.t0
+        _tls.astack = None
         global _async_count
         with _lock:
             _async.setdefault(self._seq, []).append(s)
             _async_count += 1
             while _async_count > _ASYNC_SPAN_CAP and len(_async) > 1:
                 _async_count -= len(_async.pop(next(iter(_async))))
+        return False
+
+
+class _AsyncChildCtx:
+    """A nested async span: child of the thread's innermost open async
+    span (NOT a new _async root — flush-wide aggregates like summary()'s
+    bind_flush_ms sum roots only, so sub-phases never double-count)."""
+
+    __slots__ = ("_span", "_stack")
+
+    def __init__(self, span: Span, stack: list):
+        self._span = span
+        self._stack = stack
+
+    def __enter__(self):
+        return self._span
+
+    def __exit__(self, *exc):
+        s = self._span
+        s.dur = _perf() - s.t0
+        st = self._stack
+        if st and st[-1] is s:
+            st.pop()
         return False
 
 
@@ -163,6 +187,7 @@ def disable() -> None:
     global _enabled
     _enabled = False
     _tls.stack = None
+    _tls.astack = None
 
 
 def is_enabled() -> bool:
@@ -187,6 +212,7 @@ def reset() -> None:
         _async_count = 0
     _pending_report = None
     _tls.stack = None
+    _tls.astack = None
 
 
 def set_budgets(budgets: Dict[str, float]) -> None:
@@ -291,12 +317,24 @@ def tag_cycle(**tags) -> None:
 
 def async_span(name: str, **tags):
     """A span recorded from a non-cycle thread (the bind-flush executor),
-    attached to the newest cycle's sequence number."""
+    attached to the newest cycle's sequence number. Nests per-thread: an
+    async_span opened inside another (the flush's store pass opening its
+    echo-ingest sub-phase) becomes a CHILD of the open one rather than a
+    second root, so per-cycle flush totals never double-count."""
     if not _enabled:
         return _NULL
     s = Span(name, _perf())
     if tags:
         s.tags = tags
+    stack = getattr(_tls, "astack", None)
+    if stack:
+        parent = stack[-1]
+        if parent.children is None:
+            parent.children = []
+        parent.children.append(s)
+        stack.append(s)
+        return _AsyncChildCtx(s, stack)
+    _tls.astack = [s]
     return _AsyncCtx(s, _seq)
 
 
@@ -400,6 +438,30 @@ def flat_phases(rec: CycleRecord) -> Dict[str, dict]:
 
     for c in rec.root.children or ():
         walk(c, "")
+    for e in out.values():
+        e["ms"] = round(e["ms"], 3)
+    return out
+
+
+def async_phases(rec: CycleRecord) -> Dict[str, dict]:
+    """'/'-joined span paths -> {ms, count} over the cycle's ASYNC spans
+    (the bind flush that follows it): the flat_phases twin for the
+    executor side, behind bench.py's flush sub-phase attribution
+    (bind_flush.apply / bind_flush.store / bind_flush.store/bind_flush.echo)."""
+    out: Dict[str, dict] = {}
+
+    def walk(s: Span, prefix: str) -> None:
+        path = f"{prefix}/{s.name}" if prefix else s.name
+        e = out.get(path)
+        if e is None:
+            out[path] = e = {"ms": 0.0, "count": 0}
+        e["ms"] += s.dur * 1000.0
+        e["count"] += 1
+        for c in s.children or ():
+            walk(c, path)
+
+    for s in _async_spans_for(rec.seq):
+        walk(s, "")
     for e in out.values():
         e["ms"] = round(e["ms"], 3)
     return out
