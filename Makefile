@@ -3,7 +3,7 @@
 PYTHON ?= python
 
 .PHONY: test unit-test e2e bench bench-all multichip-dryrun deploy deploy-up \
-	trace-smoke sim-smoke flush-bench
+	trace-smoke sim-smoke flush-bench chaos-smoke
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -61,6 +61,15 @@ flush-bench:
 # sim's own double-run relies on it.
 sim-smoke: flush-bench
 	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli smoke
+
+# commit-path resilience gate (docs/design/resilience.md), after
+# sim-smoke: a churn run with 2% injected bind failures PLUS a targeted
+# poison pod. Exit 1 unless gang atomicity held with NO bind-failure
+# waiver (partial gangs healed by the commit path), the poison pod
+# landed in quarantine with a why-pending reason, and a double run from
+# the same seed was bit-identical.
+chaos-smoke: sim-smoke
+	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli chaos
 
 # multi-chip sharding dryrun on the virtual CPU mesh
 multichip-dryrun:

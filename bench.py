@@ -328,6 +328,8 @@ def sim_worker(seed: int, ticks: int, n_nodes: int) -> None:
         "arrived_jobs": result.arrived_jobs,
         "completed_jobs": result.completed_jobs,
         "violations": len(result.violations),
+        "resync_retries": getattr(result, "resync_retries", 0),
+        "quarantined": len(getattr(result, "quarantined", ())),
         "bind_fingerprint": result.bind_fingerprint(),
     }))
 
@@ -401,6 +403,8 @@ def main() -> None:
             "arrived_jobs": res["arrived_jobs"],
             "completed_jobs": res["completed_jobs"],
             "invariant_violations": res["violations"],
+            "resync_retries": res.get("resync_retries", 0),
+            "quarantined": res.get("quarantined", 0),
             "bind_fingerprint": res["bind_fingerprint"],
             "seed": seed,
         }))

@@ -87,6 +87,10 @@ def test_resync_reconverges_after_bind_failures():
     reconcile the cache with the store (pods back to Pending), and the
     next cycle must bind them all for real."""
     store, cache, binder, conf = _env(FlakyBinder)
+    # this test drives back-to-back cycles on the wall clock; zero the
+    # re-placement backoff (docs/design/resilience.md) so the second
+    # cycle retries immediately like the pre-resilience commit path
+    cache.RESYNC_BACKOFF_BASE_SECONDS = 0.0
     sched = Scheduler(store, scheduler_conf=CONF, cache=cache)
     store.create("queues", build_queue("default", weight=1))
     for i in range(8):

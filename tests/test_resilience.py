@@ -1,0 +1,471 @@
+"""Commit-path resilience (docs/design/resilience.md): Resync v2's
+backoff/budget/quarantine machinery, gang-atomic bind healing, the cycle
+watchdog, and the solver kernel circuit breaker.
+
+Everything time-dependent runs on a FakeClock threaded through the store,
+so backoff schedules are asserted exactly — the same virtual-clock
+plumbing the churn simulator relies on for bit-identical replays.
+"""
+
+import time
+
+import pytest
+
+import volcano_tpu.framework.solver as solver_mod
+import volcano_tpu.ops.allocate as alloc_mod
+from volcano_tpu.apiserver import ObjectStore
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.framework import close_session, open_session
+from volcano_tpu.metrics import metrics as m
+from volcano_tpu.models.job_info import TaskStatus
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.sim.faults import FlakyBinder
+from volcano_tpu.trace import pending, tracer
+from volcano_tpu.trace.pending import REASON_BIND_BACKOFF, REASON_QUARANTINED
+from volcano_tpu.utils.clock import FakeClock
+from volcano_tpu.utils.test_utils import (FakeBinder, FakeEvictor, build_node,
+                                          build_pod, build_pod_group,
+                                          build_queue, build_resource_list)
+
+CONF = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+RL = build_resource_list("1", "1Gi")
+
+
+def _env(fail_pods=(), nodes=4, node_cpu="8"):
+    """Virtual-clock store + cache + scheduler with a targeted-failure
+    binder (the sim's FlakyBinder in fail_pods mode)."""
+    clock = FakeClock(start=1.0)
+    store = ObjectStore(clock=clock)
+    binder = FlakyBinder(store, clock, fail_pods=set(fail_pods))
+    cache = SchedulerCache(store, binder=binder, evictor=FakeEvictor(store))
+    cache.run()
+    sched = Scheduler(store, scheduler_conf=CONF, cache=cache, clock=clock)
+    store.create("queues", build_queue("default", weight=1))
+    for i in range(nodes):
+        store.create("nodes", build_node(f"n{i}", {"cpu": node_cpu,
+                                                   "memory": "64Gi"}))
+    return clock, store, binder, cache, sched
+
+
+def _gang(store, name, size, min_available=None):
+    store.create("podgroups", build_pod_group(
+        name, "ns1", "default", min_available or size, phase="Inqueue"))
+    for t in range(size):
+        store.create("pods", build_pod("ns1", f"{name}-{t}", "", "Pending",
+                                       RL, name))
+
+
+def _statuses(cache):
+    with cache.mutex:
+        return {f"{t.namespace}/{t.name}": t.status
+                for j in cache.jobs.values() for t in j.tasks.values()}
+
+
+def _cycle(sched, cache, clock, n=1, advance=1.0):
+    for _ in range(n):
+        sched.run_once()
+        assert cache.flush_executors(timeout=30)
+        clock.advance(advance)
+
+
+# -- resync v2: backoff schedule --------------------------------------------
+
+
+def test_backoff_schedule_deterministic_under_virtual_clock():
+    """The retry schedule of a failing pod is exponential with seeded
+    jitter, computed off the store's (virtual) clock — two identical
+    environments produce the exact same not_before sequence."""
+    schedules = []
+    for _ in range(2):
+        clock, store, binder, cache, sched = _env(fail_pods={"ns1/pg0-0"})
+        _gang(store, "pg0", 1, min_available=1)
+        seen = []
+        for _ in range(30):
+            before = cache.retry_records.get("ns1/pg0-0")
+            attempts_before = before.attempts if before else 0
+            _cycle(sched, cache, clock)
+            rec = cache.retry_records.get("ns1/pg0-0")
+            if rec is not None and rec.attempts != attempts_before:
+                seen.append((rec.attempts, rec.not_before))
+            if cache.quarantined:
+                break
+        cache.stop()
+        schedules.append(seen)
+    assert schedules[0] == schedules[1]
+    assert len(schedules[0]) >= 3
+    assert [a for a, _ in schedules[0]] == list(
+        range(1, len(schedules[0]) + 1))
+    # jittered-exponential shape: each backoff delay stays inside
+    # [0.5, 1.0) * base * 2^(attempt-1) (cap permitting)
+    cache_cls = SchedulerCache
+    base = cache_cls.RESYNC_BACKOFF_BASE_SECONDS
+    cap = cache_cls.RESYNC_BACKOFF_CAP_SECONDS
+    probe = cache_cls(ObjectStore())
+    for attempt, _ in schedules[0]:
+        delay = probe._backoff_seconds("ns1/pg0-0", attempt)
+        nominal = min(cap, base * 2.0 ** (attempt - 1))
+        assert 0.5 * nominal <= delay < nominal
+
+
+def test_backoff_gates_replacement_not_reconcile():
+    """After a bind failure the cache reconciles IMMEDIATELY (task back
+    to Pending, store agrees), while re-placement waits for the backoff
+    window: the pod is ineligible at session open until not_before."""
+    clock, store, binder, cache, sched = _env(fail_pods={"ns1/solo-0"})
+    _gang(store, "solo", 1, min_available=1)
+    _cycle(sched, cache, clock, advance=0.0)   # bind fails, no time passes
+    # reconciled: Pending on both sides, no node accounting left
+    assert _statuses(cache)["ns1/solo-0"] == TaskStatus.Pending
+    assert store.get("pods", "solo-0", "ns1").spec.node_name == ""
+    with cache.mutex:
+        assert all(not n.tasks for n in cache.nodes.values())
+    # but ineligible for re-placement while the backoff window is open
+    rec = cache.retry_records["ns1/solo-0"]
+    assert rec.attempts == 1 and rec.not_before > clock.now()
+    assert "ns1/solo-0" in cache.bind_ineligible()
+    attempts_before = binder.attempts
+    _cycle(sched, cache, clock, advance=0.0)
+    assert binder.attempts == attempts_before   # no bind attempted
+    # window over: eligible again, and the bind is retried
+    clock.advance(rec.not_before - clock.now() + 0.001)
+    assert "ns1/solo-0" not in cache.bind_ineligible()
+    _cycle(sched, cache, clock, advance=0.0)
+    assert binder.attempts == attempts_before + 1
+    cache.stop()
+
+
+# -- resync v2: quarantine lifecycle ----------------------------------------
+
+
+def test_budget_exhaustion_quarantines_then_pod_delete_clears():
+    """A poison pod burns its retry budget into quarantine (gauge +
+    store event + why-pending reason, no further bind attempts); deleting
+    the pod un-quarantines it."""
+    clock, store, binder, cache, sched = _env(fail_pods={"ns1/poison-0"})
+    _gang(store, "poison", 2, min_available=2)
+    budget = cache.RESYNC_RETRY_BUDGET
+    for _ in range(60):
+        _cycle(sched, cache, clock)
+        if cache.quarantined:
+            break
+    assert cache.quarantined.keys() == {"ns1/poison-0"}
+    assert "ns1/poison-0" not in cache.retry_records
+    assert len(binder.failed_keys) == budget
+    assert cache.resync_retry_total == budget
+    # gauge + store event (events are (kind, key, type, reason, message))
+    assert m.snapshot()["gauges"].get((m.QUARANTINED_TASKS, ())) == 1.0
+    assert any(e[3] == "BindQuarantined" for e in store.events)
+    # quarantined: the scheduler stops trying entirely
+    attempts = binder.attempts
+    _cycle(sched, cache, clock, n=3)
+    assert binder.attempts == attempts
+    # why-pending surfaces the reason
+    ssn = open_session(cache, sched.conf.tiers, sched.conf.configurations,
+                       clock=clock)
+    report = pending.collect(ssn)
+    close_session(ssn)
+    assert report["reasons"].get(REASON_QUARANTINED) == 1
+    job = report["jobs"]["ns1/poison"]
+    assert REASON_QUARANTINED in job["reasons"]
+    # un-quarantine on pod delete echo (recreate = fresh budget)
+    store.delete("pods", "poison-0", "ns1", skip_admission=True)
+    assert not cache.quarantined
+    assert m.snapshot()["gauges"].get((m.QUARANTINED_TASKS, ())) == 0.0
+    store.create("pods", build_pod("ns1", "poison-0", "", "Pending", RL,
+                                   "poison"))
+    binder.fail_pods.clear()            # the "fixed" recreated pod
+    _cycle(sched, cache, clock, n=2)
+    assert store.get("pods", "poison-0", "ns1").spec.node_name
+    cache.stop()
+
+
+def test_backoff_reason_in_why_pending():
+    clock, store, binder, cache, sched = _env(fail_pods={"ns1/pg0-0"})
+    _gang(store, "pg0", 1, min_available=1)
+    _cycle(sched, cache, clock, advance=0.0)
+    ssn = open_session(cache, sched.conf.tiers, sched.conf.configurations,
+                       clock=clock)
+    report = pending.collect(ssn)
+    close_session(ssn)
+    assert any(r.startswith(REASON_BIND_BACKOFF)
+               for r in report["reasons"]), report["reasons"]
+    cache.stop()
+
+
+# -- gang-atomic bind healing -----------------------------------------------
+
+
+def test_partial_gang_bind_heals_and_replaces():
+    """One member of a gang-of-4 fails to bind: the three bound siblings
+    are unbound (store node_name reverted, node accounting rolled back)
+    in the same flush, and once the failure clears the gang binds whole
+    next cycle."""
+    clock, store, binder, cache, sched = _env(fail_pods={"ns1/gang-2"})
+    _gang(store, "gang", 4, min_available=4)
+    _cycle(sched, cache, clock, advance=0.0)
+    # healed: the whole gang is Pending again, nowhere bound
+    assert set(_statuses(cache).values()) == {TaskStatus.Pending}
+    for t in range(4):
+        assert store.get("pods", f"gang-{t}", "ns1").spec.node_name == ""
+    with cache.mutex:
+        assert all(not n.tasks for n in cache.nodes.values())
+        assert all(n.used.is_empty() for n in cache.nodes.values())
+    assert any(e[3] == "GangUnbound" for e in store.events)
+    counters = m.snapshot()["counters"]
+    assert counters.get((m.GANG_HEALS, ()), 0) >= 1
+    assert counters.get((m.BIND_ERRORS, (("reason", "rejected"),)), 0) >= 1
+    # the poison member heals; siblings carry no failure record
+    assert set(cache.retry_records) == {"ns1/gang-2"}
+    # failure clears -> whole gang placed and bound atomically
+    binder.fail_pods.clear()
+    rec = cache.retry_records["ns1/gang-2"]
+    clock.advance(rec.not_before - clock.now() + 0.001)
+    _cycle(sched, cache, clock)
+    assert all(store.get("pods", f"gang-{t}", "ns1").spec.node_name
+               for t in range(4))
+    assert not cache.retry_records     # success cleared the record
+    cache.stop()
+
+
+def test_partial_gang_heals_on_per_task_bind_path():
+    """The session dispatches a ready gang as one cache.bind() per task
+    (backfill / ssn.allocate): a failure there must heal the gang too —
+    the deferred heal runs behind the sibling do_binds on the FIFO
+    executor."""
+    clock, store, binder, cache, sched = _env(fail_pods={"ns1/ptg-1"})
+    _gang(store, "ptg", 3, min_available=3)
+    with cache.mutex:
+        job = next(iter(cache.jobs.values()))
+        tasks = sorted(job.tasks.values(), key=lambda t: t.name)
+    for i, t in enumerate(tasks):
+        cache.bind(t, f"n{i}")
+    assert cache.flush_executors(timeout=30)
+    assert set(_statuses(cache).values()) == {TaskStatus.Pending}
+    for t in range(3):
+        assert store.get("pods", f"ptg-{t}", "ns1").spec.node_name == ""
+    with cache.mutex:
+        assert all(not n.tasks for n in cache.nodes.values())
+    assert set(cache.retry_records) == {"ns1/ptg-1"}
+    cache.stop()
+
+
+def test_partial_gang_heals_inline_mode_at_flush_barrier():
+    """Pre-run() inline executor mode (unit-test semantics): a mid-gang
+    bind failure must NOT heal mid-dispatch — later siblings haven't even
+    staged — but at the flush_executors() barrier the partial gang is
+    healed."""
+    clock = FakeClock(start=1.0)
+    store = ObjectStore(clock=clock)
+    binder = FlakyBinder(store, clock, fail_pods={"ns1/ig-1"})
+    cache = SchedulerCache(store, binder=binder, evictor=FakeEvictor(store))
+    # deliberately NO cache.run(): no watches, no executor worker
+    for i in range(4):
+        store.create("nodes", build_node(f"n{i}", {"cpu": "8",
+                                                   "memory": "64Gi"}))
+        cache.add_node(store.get("nodes", f"n{i}"))
+    store.create("queues", build_queue("default", weight=1))
+    # feed the cache by hand (no watches)
+    pg = build_pod_group("ig", "ns1", "default", 4, phase="Inqueue")
+    cache.add_pod_group(pg)
+    pods = [build_pod("ns1", f"ig-{t}", "", "Pending", RL, "ig")
+            for t in range(4)]
+    for p in pods:
+        store.create("pods", p)
+        cache.add_pod(store.get("pods", p.metadata.name, "ns1"))
+    with cache.mutex:
+        job = next(iter(cache.jobs.values()))
+        tasks = sorted(job.tasks.values(), key=lambda t: t.name)
+    for i, t in enumerate(tasks):
+        cache.bind(t, f"n{i}")
+    # mid-dispatch nothing healed yet: siblings 0, 2, 3 bound in store
+    assert store.get("pods", "ig-0", "ns1").spec.node_name
+    assert cache.flush_executors(timeout=5)
+    # barrier heal: every sibling unbound in the store, gang retries whole
+    for t in range(4):
+        assert store.get("pods", f"ig-{t}", "ns1").spec.node_name == ""
+    assert "ns1/ig-1" in cache.retry_records
+    # inline mode parks resyncs; reconcile them and converge
+    cache.process_resync_tasks()
+    assert set(_statuses(cache).values()) == {TaskStatus.Pending}
+    with cache.mutex:
+        assert all(not n.tasks for n in cache.nodes.values())
+
+
+def test_elastic_job_above_min_available_not_healed():
+    """A job still at/above min_available without the failed pod keeps
+    its bound tasks — healing only fires on broken atomicity."""
+    clock, store, binder, cache, sched = _env(fail_pods={"ns1/ela-3"})
+    _gang(store, "ela", 4, min_available=2)
+    _cycle(sched, cache, clock, advance=0.0)
+    statuses = _statuses(cache)
+    bound = [k for k, s in statuses.items() if s != TaskStatus.Pending]
+    assert len(bound) == 3 and "ns1/ela-3" not in bound
+    assert store.get("pods", "ela-0", "ns1").spec.node_name
+    cache.stop()
+
+
+# -- cycle watchdog ----------------------------------------------------------
+
+
+class _SlowSnapshotCache(SchedulerCache):
+    """Injected slow phase: every snapshot (open_session's first span)
+    sleeps past the watchdog deadline."""
+
+    SLEEP_S = 0.25
+
+    def snapshot(self):
+        time.sleep(self.SLEEP_S)
+        return super().snapshot()
+
+
+def test_watchdog_fires_on_slow_cycle_and_recovers():
+    store = ObjectStore()
+    cache = _SlowSnapshotCache(store, binder=FakeBinder(store),
+                               evictor=FakeEvictor(store))
+    cache.run()
+    store.create("queues", build_queue("default", weight=1))
+    sched = Scheduler(store, scheduler_conf=CONF, cache=cache,
+                      schedule_period=0.05, watchdog_multiple=2.0)
+    was_on = tracer.is_enabled()
+    tracer.enable()
+    try:
+        before = m.snapshot()["counters"].get(
+            (m.CYCLE_DEADLINE_EXCEEDED, ()), 0)
+        sched.run_once()
+        time.sleep(0.05)       # let the (already fired) timer thread land
+        assert sched.degraded
+        assert sched.cycle_deadline_exceeded == 1
+        after = m.snapshot()["counters"].get(
+            (m.CYCLE_DEADLINE_EXCEEDED, ()), 0)
+        assert after == before + 1
+        report = m.health_report()
+        assert not report["healthy"] and "scheduler" in report["degraded"]
+        assert "watchdog deadline" in \
+            report["components"]["scheduler"]["detail"]
+        # recovery: an in-deadline cycle clears the degraded mark
+        sched.watchdog_multiple = 1000.0
+        sched.run_once()
+        assert not sched.degraded
+        assert m.health_report()["healthy"]
+    finally:
+        if not was_on:
+            tracer.disable()
+        cache.stop()
+
+
+def test_watchdog_live_phase_breakdown():
+    """While a cycle is stuck, live_phases() exposes the in-flight span
+    tree — the watchdog's log payload names the guilty phase."""
+    was_on = tracer.is_enabled()
+    tracer.enable()
+    try:
+        captured = {}
+        with tracer.cycle():
+            with tracer.span("open_session"):
+                captured.update(tracer.live_phases())
+        assert captured.get("open_session", {}).get("open") is True
+        assert captured.get("cycle", {}).get("open") is True
+        assert tracer.live_phases() == {}    # cleared at cycle exit
+    finally:
+        if not was_on:
+            tracer.disable()
+
+
+# -- solver circuit breaker --------------------------------------------------
+
+
+@pytest.fixture
+def crashing_chunked(monkeypatch):
+    """Replace the chunked kernel with a counting crasher; restores (and
+    resets breaker state) afterwards."""
+    solver_mod.reset_breaker()
+    calls = {"n": 0, "crash": True}
+    real = alloc_mod.gang_allocate_chunked
+
+    def maybe_crash(*args, **kwargs):
+        calls["n"] += 1
+        if calls["crash"]:
+            raise RuntimeError("injected kernel crash")
+        return real(*args, **kwargs)
+
+    maybe_crash.__name__ = "gang_allocate_chunked"
+    monkeypatch.setattr(alloc_mod, "gang_allocate_chunked", maybe_crash)
+    yield calls
+    solver_mod.reset_breaker()
+
+
+BREAKER_CONF = CONF + """
+configurations:
+- name: solver
+  arguments: {kernel: chunked, breaker.window: 3}
+"""
+
+
+def test_breaker_opens_half_opens_and_closes(crashing_chunked):
+    calls = crashing_chunked
+    clock, store, binder, cache, sched = _env(nodes=4, node_cpu="64")
+    sched2 = Scheduler(store, scheduler_conf=BREAKER_CONF, cache=cache,
+                      clock=clock)
+    n_pg = [0]
+
+    def place_once():
+        j = n_pg[0]
+        n_pg[0] += 1
+        _gang(store, f"pg{j}", 2, min_available=2)
+        _cycle(sched2, cache, clock)
+
+    # crash -> same-cycle fallback to the scan (the gang still binds),
+    # breaker opens over the chunked tier
+    place_once()
+    assert calls["n"] == 1
+    assert solver_mod.breaker_state() == {"chunked": 4}
+    assert len(binder.binds) == 2
+    counters = m.snapshot()["counters"]
+    assert counters.get((m.SOLVER_FALLBACK,
+                         (("from", "chunked"), ("to", "scan")))) == 1.0
+    # open: the crashed tier is skipped entirely for the window
+    place_once()
+    place_once()
+    assert calls["n"] == 1
+    # half-open probe still crashing -> re-opens
+    place_once()
+    assert calls["n"] == 2
+    assert solver_mod.breaker_state() == {"chunked": 7}
+    # kernel "fixed": the next probe closes the breaker and stays closed
+    calls["crash"] = False
+    place_once()
+    place_once()
+    place_once()
+    assert solver_mod.breaker_state() == {}
+    assert calls["n"] >= 3
+    # every gang bound despite the crashes (resilience, not correctness
+    # loss: the scan fallback is exact)
+    assert len(binder.binds) == 2 * n_pg[0]
+    cache.stop()
+
+
+def test_breaker_window_configurable(crashing_chunked):
+    clock, store, binder, cache, sched = _env(nodes=2, node_cpu="64")
+    conf = CONF + """
+configurations:
+- name: solver
+  arguments: {kernel: chunked, breaker.window: 50}
+"""
+    sched2 = Scheduler(store, scheduler_conf=conf, cache=cache, clock=clock)
+    _gang(store, "pg0", 2)
+    _cycle(sched2, cache, clock)
+    assert solver_mod.breaker_state() == {"chunked": 51}
+    cache.stop()

@@ -94,9 +94,13 @@ class AllocateAction(Action):
         return ordered
 
     def _pending_tasks(self, ssn, job: JobInfo) -> List[TaskInfo]:
-        """Pending, non-best-effort, task-order sorted (allocate.go:183-196)."""
+        """Pending, non-best-effort, task-order sorted (allocate.go:183-196).
+        Pods the cache marked bind-ineligible (quarantine / bind-failure
+        backoff, docs/design/resilience.md) are skipped this cycle."""
+        ineligible = getattr(ssn, "ineligible_binds", None)
         tasks = [t for t in job.task_status_index.get(TaskStatus.Pending, {}).values()
-                 if not t.resreq.is_empty()]
+                 if not t.resreq.is_empty()
+                 and not (ineligible and t.key() in ineligible)]
         fns = ssn._enabled_fns("task_order_fns")
         if all(getattr(fn, "standard_priority_order", False)
                for _, _, fn in fns):

@@ -23,6 +23,7 @@ class BackfillAction(Action):
 
     def execute(self, ssn) -> None:
         ssn.materialize()   # Pending scans must not see deferred placements
+        ineligible = getattr(ssn, "ineligible_binds", None)
         jobs_tasks = []
         for job in list(ssn.jobs.values()):
             if job.pod_group.status.phase == PodGroupPhase.PENDING:
@@ -32,7 +33,8 @@ class BackfillAction(Action):
                 continue
             tasks = [t for t in job.task_status_index.get(
                          TaskStatus.Pending, {}).values()
-                     if t.init_resreq.is_empty()]
+                     if t.init_resreq.is_empty()
+                     and not (ineligible and t.key() in ineligible)]
             if tasks:
                 jobs_tasks.append((job, tasks))
         if not jobs_tasks:

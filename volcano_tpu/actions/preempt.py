@@ -137,7 +137,13 @@ class PreemptAction(Action):
     # ------------------------------------------------------------------
 
     def _pending_tasks(self, ssn, job: JobInfo) -> List[TaskInfo]:
-        tasks = list(job.task_status_index.get(TaskStatus.Pending, {}).values())
+        # bind-ineligible pods (quarantine/backoff) must not trigger
+        # preemption either — evicting victims for a pod whose bind
+        # keeps failing would churn the cluster for nothing
+        ineligible = getattr(ssn, "ineligible_binds", None)
+        tasks = [t for t in
+                 job.task_status_index.get(TaskStatus.Pending, {}).values()
+                 if not (ineligible and t.key() in ineligible)]
         tasks.sort(key=functools.cmp_to_key(
             lambda a, b: -1 if ssn.task_order_fn(a, b) else 1))
         return tasks

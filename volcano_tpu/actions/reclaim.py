@@ -59,8 +59,10 @@ class ReclaimAction(Action):
             if queue.uid not in queue_seen:
                 queue_seen.add(queue.uid)
                 queue_list.append(queue)
-            pending = list(job.task_status_index.get(
-                TaskStatus.Pending, {}).values())
+            ineligible = getattr(ssn, "ineligible_binds", None)
+            pending = [t for t in job.task_status_index.get(
+                           TaskStatus.Pending, {}).values()
+                       if not (ineligible and t.key() in ineligible)]
             if pending:
                 preemptors_map.setdefault(job.queue, []).append(job)
                 pending.sort(key=task_key)

@@ -20,13 +20,16 @@ from __future__ import annotations
 
 import logging
 import threading
+import zlib
 from collections import deque
 from typing import Dict, List, Optional
 
 from ..apiserver.store import ObjectStore
+from ..metrics import metrics as m
 from ..models import objects as obj
 from ..models.cluster_info import ClusterInfo
-from ..models.job_info import JobInfo, TaskInfo, TaskStatus
+from ..models.job_info import (JobInfo, TaskInfo, TaskStatus,
+                               allocated_status)
 from ..models.node_info import NodeInfo
 from ..models.objects import (DEFAULT_QUEUE, DEFAULT_SCHEDULER_NAME,
                               PodGroupPhase)
@@ -34,6 +37,22 @@ from ..models.queue_info import NamespaceCollection, QueueInfo
 from .event_handlers import EventHandlersMixin
 from .interface import (StoreBinder, StoreEvictor, StoreStatusUpdater,
                         StoreVolumeBinder)
+
+
+class _RetryRecord:
+    """Resync v2 (docs/design/resilience.md): one pod's bind-failure
+    history — attempt count and the virtual-clock instant before which
+    the pod is ineligible for re-placement (seeded-jitter exponential
+    backoff). The record outlives individual reconciles: attempts only
+    reset on bind success or a pod update/delete that could change the
+    outcome."""
+
+    __slots__ = ("key", "attempts", "not_before")
+
+    def __init__(self, key: str):
+        self.key = key
+        self.attempts = 0
+        self.not_before = 0.0
 
 
 class _BindBurst:
@@ -89,6 +108,34 @@ class SchedulerCache(EventHandlersMixin):
 
         self.mutex = threading.RLock()
         self.err_tasks: deque = deque()      # resync queue (cache.go:116)
+        # Resync v2 (docs/design/resilience.md): bind failures reconcile
+        # IMMEDIATELY through err_tasks (the cache always converges with
+        # the store by the flush barrier), while these records gate when
+        # the pod becomes eligible for RE-PLACEMENT — exponential backoff
+        # with seeded jitter on the store's clock (virtual-clock aware:
+        # the sim stays deterministic), a retry budget, and a quarantine
+        # set for budget-exhausted poison pods. Both are keyed by pod key
+        # ("ns/name") and read at session open via bind_ineligible().
+        self.retry_records: Dict[str, _RetryRecord] = {}
+        self.quarantined: Dict[str, str] = {}    # key -> reason
+        self.resync_retry_total = 0              # lifetime bind-failure count
+        # per-task bind commits in flight, by job uid — the per-task
+        # path's analogue of the batch path's `ok`/`failed` split:
+        # {"gen": cycle gen, "ok": [(task, pod, hostname)] store-commit
+        # successes of the current gang dispatch, "failed": count}.
+        # Consumed by _heal_gang_of on a partial failure, dropped once
+        # enough commits landed (the gang committed atomically), and
+        # generation-fenced: state older than one cycle generation is
+        # discarded, so a later failure can never unbind pods committed
+        # by earlier dispatches, and stale records don't accumulate. The
+        # job's status index can't stand in for this bookkeeping:
+        # staged-but-uncommitted tasks sit in Binding just like
+        # committed ones (and echo to Bound just as fast).
+        self._single_bind_state: Dict[str, dict] = {}
+        # per-task heals deferred to the flush barrier in INLINE mode
+        # (no executor worker): healing mid-dispatch would unbind
+        # siblings whose gang mates haven't even staged yet
+        self._deferred_heals: list = []
         self._watches: list = []
         self._running = False
         # async executor for bind/evict store writes (the reference runs
@@ -229,6 +276,18 @@ class SchedulerCache(EventHandlersMixin):
     # otherwise idle (the reference's processResyncTask wait.Until period)
     RESYNC_RETRY_SECONDS = 1.0
 
+    # Resync v2 knobs (docs/design/resilience.md): re-placement backoff
+    # after a bind failure is base * 2^(attempt-1) seconds, jittered into
+    # [0.5, 1.0) of itself by a seeded per-(pod, attempt) hash, capped;
+    # a pod whose bind fails RESYNC_RETRY_BUDGET times is quarantined
+    # until its pod object changes or is deleted. All times are read off
+    # the store's clock, so a simulator on a virtual clock is
+    # bit-reproducible.
+    RESYNC_BACKOFF_BASE_SECONDS = 0.5
+    RESYNC_BACKOFF_CAP_SECONDS = 30.0
+    RESYNC_RETRY_BUDGET = 5
+    RESYNC_JITTER_SEED = 0
+
     # how long the executor defers a drain for a live scheduling cycle
     # (once per cycle generation). Under the GIL a mid-cycle drain doesn't
     # overlap the cycle, it time-slices it — stretching BOTH the cycle and
@@ -243,22 +302,30 @@ class SchedulerCache(EventHandlersMixin):
         last_yield_gen = -1
         gc_paused = False
         while True:
-            # while reconciliations are pending, wake periodically even
-            # with no new submissions (a stuck err_task must not wait for
-            # the next bind to be retried — cache.go:772-791 runs resync
-            # on its own loop)
+            # while reconciliations (or cycle-parked gang heals) are
+            # pending, wake periodically even with no new submissions (a
+            # stuck err_task must not wait for the next bind to be
+            # retried — cache.go:772-791 runs resync on its own loop)
             self._exec_event.wait(
-                timeout=self.RESYNC_RETRY_SECONDS if self.err_tasks
-                else None)
+                timeout=self.RESYNC_RETRY_SECONDS
+                if (self.err_tasks or self._deferred_heals) else None)
             try:
                 while True:
                     with self._exec_lock:
                         fn = self._exec_queue.popleft() if self._exec_queue \
                             else None
                     if fn is None:
-                        # queue drained: reconcile failed binds/evicts
-                        # before going idle; keep going while passes make
-                        # progress
+                        # queue drained: run gang heals parked by
+                        # per-task bind failures — but only with no
+                        # cycle in flight (a dispatch can't straddle the
+                        # cycle boundary, so this barrier is the first
+                        # point the gang's commit outcome is complete;
+                        # mid-cycle the timed wakeup retries them)
+                        if self._deferred_heals and \
+                                self._cycle_idle.is_set():
+                            self._run_deferred_heals()
+                        # then reconcile failed binds/evicts before going
+                        # idle; keep going while passes make progress
                         before = len(self.err_tasks)
                         if before:
                             self.process_resync_tasks()
@@ -320,6 +387,15 @@ class SchedulerCache(EventHandlersMixin):
         background store writes don't contend with the cycle's host path."""
         self._cycle_gen += 1
         self._cycle_idle.clear()
+        if self._single_bind_state:
+            # retire per-task dispatch records no heal will ever consume
+            # (their dispatch ended >1 generation ago) — they pin task
+            # and pod references otherwise
+            with self.mutex:
+                stale = [k for k, st in self._single_bind_state.items()
+                         if st["gen"] < self._cycle_gen - 1]
+                for k in stale:
+                    del self._single_bind_state[k]
 
     def end_cycle(self) -> None:
         self._cycle_idle.set()
@@ -339,8 +415,24 @@ class SchedulerCache(EventHandlersMixin):
             self._prebuilt = (self._state_version, self._snapshot_locked())
 
     def flush_executors(self, timeout: float = 30.0) -> bool:
-        """Block until all submitted bind/evict writes have executed."""
+        """Block until all submitted bind/evict writes have executed. In
+        inline mode (no worker) this is also the barrier where per-task
+        gang heals parked by bind failures run — mid-dispatch the gang's
+        commit outcome isn't known yet."""
+        with self._exec_lock:
+            worker_live = self._exec_thread is not None
+        if not worker_live:
+            self._run_deferred_heals()
+            return True
         return self._exec_idle.wait(timeout)
+
+    def _run_deferred_heals(self) -> None:
+        while True:
+            with self.mutex:
+                if not self._deferred_heals:
+                    return
+                task = self._deferred_heals.pop(0)
+            self._heal_gang_of(task)
 
     def wait_for_cache_sync(self) -> bool:
         return self._running  # synchronous watches: always synced once run
@@ -472,9 +564,51 @@ class SchedulerCache(EventHandlersMixin):
                     "pods", pod, "Normal", "Scheduled",
                     f"Successfully assigned {task.namespace}/{task.name} "
                     f"to {hostname}")
-            except Exception:
+            except Exception as e:
+                logging.getLogger(__name__).warning(
+                    "bind of pod %s to %s failed: %s; scheduling resync",
+                    pod.metadata.key(), hostname, e)
+                m.inc(m.BIND_ERRORS, reason=type(e).__name__)
+                self._record_bind_failure(task, str(e))
                 self.resync_task(task)
+                # gang healing for the per-task commit path: the session
+                # dispatches a ready gang as one bind() per task, all
+                # within one run_once — so the heal is parked and only
+                # runs at a barrier where the dispatch provably ended
+                # (executor queue drained with NO cycle in flight; the
+                # flush_executors() call in inline mode). Healing
+                # mid-dispatch would unbind siblings whose gang mates
+                # haven't even staged yet.
+                with self.mutex:
+                    st = self._single_bind_record(task.job)
+                    st["failed"] += 1
+                    self._deferred_heals.append(task)
+                return
+            self._clear_bind_successes([(task, pod, hostname)])
+            with self.mutex:
+                st = self._single_bind_record(task.job)
+                st["ok"].append((task, pod, hostname))
+                j = self.jobs.get(task.job)
+                if j is not None and \
+                        len(st["ok"]) >= max(1, j.min_available):
+                    # enough commits landed for the gang on their own:
+                    # committed atomically, nothing left to heal
+                    self._single_bind_state.pop(task.job, None)
         self._submit(do_bind)
+
+    def _single_bind_record(self, job_uid: str) -> dict:
+        """The job's current per-task dispatch record, generation-fenced
+        (caller holds ``self.mutex``): a record older than one cycle
+        generation belongs to a different commit — discard it rather
+        than let a later heal unbind long-committed pods. The birth gen
+        is deliberately NOT refreshed on touch: without that, a
+        below-min job topped up every cycle would accumulate commits
+        forever and one eventual failure would unbind all of them."""
+        st = self._single_bind_state.get(job_uid)
+        if st is None or st["gen"] < self._cycle_gen - 1:
+            st = self._single_bind_state[job_uid] = {
+                "gen": self._cycle_gen, "ok": [], "failed": 0}
+        return st
 
     def bind_batch(self, pairs) -> list:
         """Bind a whole gang: ``[(task_info, hostname)]`` with a single
@@ -619,7 +753,11 @@ class SchedulerCache(EventHandlersMixin):
 
     def _bind_store_writes(self, bound) -> None:
         """One binder pass + Scheduled events for [(task, pod, hostname)];
-        failures land in the resync queue (cache.go:605-655)."""
+        failures land in the resync queue with retry accounting, and a
+        gang left partially bound by them is healed — its already-bound
+        siblings unbound — before anything else observes the commit
+        (cache.go:605-655 + docs/design/resilience.md)."""
+        log = logging.getLogger(__name__)
         bind_all = getattr(self.binder, "bind_batch", None)
         if bind_all is not None:
             # hint the echo ingest: bulk deliveries arriving ON THIS
@@ -631,8 +769,13 @@ class SchedulerCache(EventHandlersMixin):
             try:
                 missing = bind_all([(pod, hostname)
                                     for _, pod, hostname in bound])
-            except Exception:
+            except Exception as e:
+                log.warning("batch bind of %d pods failed: %s; "
+                            "scheduling resync", len(bound), e)
+                m.inc(m.BIND_ERRORS, float(len(bound)),
+                      reason=type(e).__name__)
                 for task, _, _ in bound:
+                    self._record_bind_failure(task, str(e))
                     self.resync_task(task)
                 return
             finally:
@@ -640,10 +783,17 @@ class SchedulerCache(EventHandlersMixin):
             gone = {id(pod) for pod, _ in missing}
             ok = bound
             if gone:
-                for task, pod, hostname in bound:
-                    if id(pod) in gone:
-                        self.resync_task(task)
+                failed = [b for b in bound if id(b[1]) in gone]
                 ok = [b for b in bound if id(b[1]) not in gone]
+                m.inc(m.BIND_ERRORS, float(len(failed)), reason="rejected")
+                for task, pod, hostname in failed:
+                    log.warning("bind of pod %s to %s failed (binder "
+                                "rejected or pod gone); scheduling resync",
+                                pod.metadata.key(), hostname)
+                    self._record_bind_failure(task, "bind rejected")
+                    self.resync_task(task)
+                ok = self._heal_partial_gangs(ok, failed)
+            self._clear_bind_successes(ok)
             # Scheduled events: the store's event deque is bounded, so a
             # burst longer than its capacity would format messages for
             # entries the append itself immediately evicts — skip the
@@ -657,15 +807,127 @@ class SchedulerCache(EventHandlersMixin):
                     f"Successfully assigned {task.namespace}/"
                     f"{task.name} to {hostname}")
             return
+        ok, failed = [], []
         for task, pod, hostname in bound:
             try:
                 self.binder.bind(pod, hostname)
-                self.store.record_event(
-                    "pods", pod, "Normal", "Scheduled",
-                    f"Successfully assigned {task.namespace}/"
-                    f"{task.name} to {hostname}")
-            except Exception:
+            except Exception as e:
+                log.warning("bind of pod %s to %s failed: %s; scheduling "
+                            "resync", pod.metadata.key(), hostname, e)
+                m.inc(m.BIND_ERRORS, reason=type(e).__name__)
+                self._record_bind_failure(task, str(e))
                 self.resync_task(task)
+                failed.append((task, pod, hostname))
+                continue
+            ok.append((task, pod, hostname))
+        if failed:
+            ok = self._heal_partial_gangs(ok, failed)
+        self._clear_bind_successes(ok)
+        for task, pod, hostname in ok:
+            self.store.record_event(
+                "pods", pod, "Normal", "Scheduled",
+                f"Successfully assigned {task.namespace}/"
+                f"{task.name} to {hostname}")
+
+    def _heal_partial_gangs(self, bound_ok, failed) -> list:
+        """Gang-atomic bind healing: when this flush's failures would
+        leave a gang partially bound below ``min_available``, unbind the
+        gang's already-bound siblings — a store patch reverting
+        ``node_name`` whose synchronous watch echo rolls back the node
+        accounting — and resync the gang as a unit, so the atomicity
+        invariant holds instead of leaking a partial placement. Returns
+        the bound entries that survive healing (elastic jobs that stay at
+        or above ``min_available`` without the failed pod are left
+        alone). ``bound_ok``/``failed`` are [(task, pod, hostname)]."""
+        fail_count: Dict[str, int] = {}
+        for task, _, _ in failed:
+            fail_count[task.job] = fail_count.get(task.job, 0) + 1
+        heal_jobs = set()
+        with self.mutex:
+            for jid, f in fail_count.items():
+                job = self.jobs.get(jid)
+                if job is None or job.min_available <= 0:
+                    continue
+                alloc = sum(
+                    len(tasks) for st, tasks
+                    in job.task_status_index.items()
+                    if allocated_status(st))
+                # the failed tasks still sit in Binding here (their
+                # reconcile is queued behind this call): without the
+                # failures the job keeps alloc - f allocated tasks
+                if 0 < alloc - f < job.min_available:
+                    heal_jobs.add(jid)
+        if not heal_jobs:
+            return bound_ok
+        unbind = [b for b in bound_ok if b[0].job in heal_jobs]
+        if not unbind:
+            return bound_ok
+        survivors = [b for b in bound_ok if b[0].job not in heal_jobs]
+        logging.getLogger(__name__).warning(
+            "gang-atomic heal: unbinding %d bound sibling(s) of %d "
+            "partially bound gang(s)", len(unbind), len(heal_jobs))
+        m.inc(m.GANG_HEALS, float(len(heal_jobs)))
+        self._unbind_bound(unbind)
+        return survivors
+
+    def _unbind_bound(self, unbind) -> None:
+        """The heal's unbind mechanics for [(task, pod, hostname)]: one
+        store patch reverting ``node_name`` (its synchronous watch echo
+        rolls back the cache's node accounting), a GangUnbound event per
+        pod, and a resync so the gang reconciles as a unit — no retry
+        attempt is charged to these pods (their binds succeeded)."""
+
+        def clear_node(p):
+            p.spec.node_name = ""
+
+        patch_fn = getattr(self.store, "patch_batch", None)
+        if patch_fn is not None:
+            patch_fn("pods", [(pod.metadata.name, pod.metadata.namespace,
+                               clear_node) for _, pod, _ in unbind])
+        else:
+            for _, pod, _ in unbind:
+                live = self.store.get("pods", pod.metadata.name,
+                                      pod.metadata.namespace)
+                if live is not None:
+                    live.spec.node_name = ""
+                    self.store.update("pods", live, skip_admission=True)
+        for task, pod, hostname in unbind:
+            self.store.record_event(
+                "pods", pod, "Warning", "GangUnbound",
+                f"unbound from {hostname}: a sibling bind failure broke "
+                f"gang atomicity; the gang will be re-placed as a unit")
+            self.resync_task(task)
+
+    def _heal_gang_of(self, task_info: TaskInfo) -> None:
+        """Gang-atomic healing for the PER-TASK bind path (``bind()``'s
+        do_bind): submitted behind the gang's sibling do_binds, so it
+        runs once the whole gang's commit outcome is known. Unbinds the
+        dispatch's recorded sibling successes when the job is left
+        partially bound below ``min_available``; elastic jobs still at
+        or above it keep their binds."""
+        with self.mutex:
+            st = self._single_bind_state.pop(task_info.job, None)
+            if st is None or st["gen"] < self._cycle_gen - 1:
+                return   # a different (long-gone) dispatch's state
+            unbind, f = st["ok"], st["failed"]
+            job = self.jobs.get(task_info.job)
+            if job is None or job.min_available <= 0:
+                return
+            alloc = sum(len(tasks) for s, tasks
+                        in job.task_status_index.items()
+                        if allocated_status(s))
+            # the failed tasks still sit staged in Binding (their
+            # reconcile is queued behind this call): without them the
+            # job keeps alloc - f allocated tasks
+            if not (0 < alloc - f < job.min_available):
+                return
+        if not unbind:
+            return
+        logging.getLogger(__name__).warning(
+            "gang-atomic heal: unbinding %d bound sibling(s) of "
+            "partially bound gang %s", len(unbind), task_info.job)
+        m.inc(m.GANG_HEALS)
+        self._unbind_bound(unbind)
 
     def evict(self, task_info: TaskInfo, reason: str) -> None:
         """Mark Releasing, update node accounting, then delete the pod
@@ -763,6 +1025,91 @@ class SchedulerCache(EventHandlersMixin):
     def resync_task(self, task: TaskInfo) -> None:
         self.err_tasks.append(task)
 
+    def _backoff_seconds(self, key: str, attempts: int) -> float:
+        """Seeded-jitter exponential backoff for the Nth bind failure of
+        one pod: deterministic for a fixed (key, attempt, seed) so two
+        sim runs from the same seed schedule identical retries."""
+        base = self.RESYNC_BACKOFF_BASE_SECONDS
+        if base <= 0.0:
+            return 0.0
+        delay = min(self.RESYNC_BACKOFF_CAP_SECONDS,
+                    base * (2.0 ** (attempts - 1)))
+        h = zlib.crc32(f"{key}:{attempts}:{self.RESYNC_JITTER_SEED}"
+                       .encode())
+        return delay * (0.5 + (h % 4096) / 8192.0)   # [0.5, 1.0) * delay
+
+    def _record_bind_failure(self, task: TaskInfo, reason: str) -> None:
+        """Bump the pod's retry record: schedule its re-placement backoff
+        or, past the retry budget, move it to quarantine (store event +
+        ``volcano_quarantined_tasks``). The caller still enqueues the
+        immediate reconcile via :meth:`resync_task` — backoff gates
+        eligibility, never cache/store convergence."""
+        key = task.key()
+        quarantine_msg = None
+        with self.mutex:
+            self.resync_retry_total += 1
+            if key in self.quarantined:
+                return
+            rec = self.retry_records.get(key)
+            if rec is None:
+                rec = self.retry_records[key] = _RetryRecord(key)
+            rec.attempts += 1
+            if rec.attempts >= self.RESYNC_RETRY_BUDGET:
+                del self.retry_records[key]
+                quarantine_msg = (
+                    f"bind retry budget ({self.RESYNC_RETRY_BUDGET}) "
+                    f"exhausted after {rec.attempts} attempts; last "
+                    f"failure: {reason}")
+                self.quarantined[key] = quarantine_msg
+                n_quarantined = len(self.quarantined)
+            else:
+                rec.not_before = self.store.clock.now() + \
+                    self._backoff_seconds(key, rec.attempts)
+        m.inc(m.RESYNC_RETRIES)
+        if quarantine_msg is not None:
+            m.set_gauge(m.QUARANTINED_TASKS, float(n_quarantined))
+            self.store.record_event("pods", task.pod, "Warning",
+                                    "BindQuarantined", quarantine_msg)
+            logging.getLogger(__name__).warning(
+                "quarantining pod %s: %s", key, quarantine_msg)
+
+    def _clear_bind_retry_state(self, key: str) -> None:
+        """Forget a pod's failure history (bind success, or a pod
+        update/delete that could change the outcome — the un-quarantine
+        path). Caller holds ``self.mutex``."""
+        self.retry_records.pop(key, None)
+        if self.quarantined.pop(key, None) is not None:
+            m.set_gauge(m.QUARANTINED_TASKS, float(len(self.quarantined)))
+
+    def _clear_bind_successes(self, bound_ok) -> None:
+        """Successful binds reset their pods' retry records."""
+        if not self.retry_records:
+            return
+        with self.mutex:
+            for task, _, _ in bound_ok:
+                self.retry_records.pop(task.key(), None)
+
+    def bind_ineligible(self) -> Dict[str, str]:
+        """Pod keys currently ineligible for (re-)placement, with a
+        human-readable reason each: quarantined pods, and pods inside
+        their bind-failure backoff window. Snapshotted into the session
+        at open (``ssn.ineligible_binds``); the placing actions skip
+        these tasks and the why-pending report surfaces the reasons."""
+        if not self.retry_records and not self.quarantined:
+            return {}
+        from ..trace.pending import REASON_BIND_BACKOFF, REASON_QUARANTINED
+        now = self.store.clock.now()
+        out: Dict[str, str] = {}
+        with self.mutex:
+            for key in self.quarantined:
+                out[key] = REASON_QUARANTINED
+            for key, rec in self.retry_records.items():
+                if rec.not_before > now:
+                    out.setdefault(
+                        key, f"{REASON_BIND_BACKOFF} (attempt "
+                             f"{rec.attempts})")
+        return out
+
     def process_resync_tasks(self) -> None:
         """Refetch each errored pod from the store and reconcile the cache.
         A task whose reconciliation itself fails goes back on the queue
@@ -783,6 +1130,9 @@ class SchedulerCache(EventHandlersMixin):
         with self.mutex:
             self._state_version += 1
             if pod is None:
+                # a bind failure recorded AFTER the pod's delete echo must
+                # not leak its retry record (the pod can never come back)
+                self._clear_bind_retry_state(old_task.key())
                 self._delete_task(old_task)
                 return
             new_task = TaskInfo(pod)

@@ -111,7 +111,20 @@ class EventHandlersMixin:
                 view.constraint_key_cache = nt.constraint_key_cache
                 view.group_sig_cache = nt.group_sig_cache
             return
-        self._delete_task(TaskInfo(old))
+        # un-quarantine on a MATERIAL pod update (docs/design/
+        # resilience.md): a changed spec — bound elsewhere, or new
+        # requests — may fix what poisoned the bind, so the pod earns a
+        # fresh retry budget. A pure status writeback (the Unschedulable
+        # condition this very pod receives each cycle) must NOT reset it,
+        # hence the spec compare.
+        ot = TaskInfo(old)
+        if self.retry_records or self.quarantined:
+            key = new.metadata.key()
+            if key in self.retry_records or key in self.quarantined:
+                if old.spec.node_name != new.spec.node_name or \
+                        not ot.resreq.equal(nt.resreq):
+                    self._clear_bind_retry_state(key)
+        self._delete_task(ot)
         self.add_pod(new)
 
     def update_pods_bulk(self, pairs) -> None:
@@ -244,6 +257,10 @@ class EventHandlersMixin:
             flush_run()
 
     def delete_pod(self, pod: obj.Pod) -> None:
+        # a deleted pod drops its bind-failure history — the
+        # un-quarantine path: a recreated pod starts a fresh retry budget
+        if self.retry_records or self.quarantined:
+            self._clear_bind_retry_state(pod.metadata.key())
         self._delete_task(TaskInfo(pod))
         # drop empty shell jobs with no podgroup (processCleanupJob analogue)
         jid = get_job_id(pod)

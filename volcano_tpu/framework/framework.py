@@ -39,6 +39,15 @@ def open_session(cache, tiers, configurations=None, clock=None) -> Session:
         for n in ssn.nodes.values():
             ssn.total_resource.add(n.allocatable)
 
+        # commit-path resilience (docs/design/resilience.md): pod keys
+        # the cache has made ineligible for (re-)placement this cycle —
+        # quarantined poison pods and bind-failure backoff windows. The
+        # placing actions skip these tasks; why-pending reports the
+        # reasons.
+        ineligible = getattr(cache, "bind_ineligible", None)
+        ssn.ineligible_binds = ineligible() if ineligible is not None \
+            else {}
+
         from ..metrics import metrics as m
         for tier in tiers:
             for opt in tier.plugins:

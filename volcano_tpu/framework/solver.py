@@ -44,6 +44,35 @@ _logged_once: set = set()
 # level node cursor, scheduler_helper.go:95); advances per sampled session
 _node_cursor = 0
 
+# -- solver circuit breaker (docs/design/resilience.md) ----------------------
+# A kernel tier that CRASHES mid-place (the known native-kernel divergence
+# class) is retried with the next tier of the degradation ladder
+# (pallas/native/sharded -> chunked -> scan) within the same cycle, and a
+# breaker opens over the crashed tier: it is skipped for `breaker.window`
+# subsequent placement calls, then half-open — one probe; success closes
+# the breaker, another crash re-opens it. State is module-level because
+# BatchSolver instances are per-session; the counter advances once per
+# place() call (>= once per cycle).
+_place_counter = 0
+_breaker_open_until: Dict[str, int] = {}
+
+_TIER_OF_KERNEL = {"gang_allocate_pallas": "pallas",
+                   "gang_allocate_native": "native",
+                   "gang_allocate_chunked": "chunked",
+                   "gang_allocate": "scan"}
+
+
+def reset_breaker() -> None:
+    """Drop all circuit-breaker state (tests / process reinit)."""
+    global _place_counter
+    _place_counter = 0
+    _breaker_open_until.clear()
+
+
+def breaker_state() -> Dict[str, int]:
+    """{tier: open-until placement-counter} of currently open breakers."""
+    return dict(_breaker_open_until)
+
 # shared all-zeros [G, N] device buffers by shape (read-only: the kernels
 # never write their static-score input); one slot — shapes are bucketed so
 # consecutive cycles at a stable scale reuse the same buffer
@@ -149,8 +178,17 @@ class BatchSolver:
         self.sampling = False
         self.sampling_pct = 0.0
         self.sampling_min = 100
+        # circuit-breaker window: placements a crashed kernel tier is
+        # skipped for before its half-open probe (resilience.md);
+        #   configurations:
+        #   - name: solver
+        #     arguments: {breaker.window: 20}
+        self.breaker_window = 20
         solver_args = (ssn.configurations or {}).get("solver")
         if solver_args is not None:
+            if hasattr(solver_args, "get_int"):
+                self.breaker_window = solver_args.get_int(
+                    "breaker.window", 20)
             if getattr(solver_args, "get_bool",
                        lambda *_: False)("mesh.enable", False):
                 import jax
@@ -550,48 +588,109 @@ class BatchSolver:
 
         from ..metrics import metrics as m
         from ..ops import kernel_span
+        from ..ops.allocate import gang_allocate_chunked
+
+        # tier ladder + circuit breaker (resilience.md): the selected
+        # kernel first, then chunked, then the plain scan as last resort;
+        # breaker-open tiers are skipped until their half-open window
+        global _place_counter
+        _place_counter += 1
         if self.mesh is not None:
-            kernel_fn, kernel_kwargs, kernel_name = None, {}, "sharded"
+            ladder = [("sharded", None, {})]
         else:
             kernel_fn, kernel_kwargs = self._select_kernel(
                 len(batch.ns_names))
-            kernel_name = kernel_fn.__name__
-        t_kernel = time.perf_counter()
-        with kernel_span(kernel_name, g_pad=int(batch.g_pad),
-                         n_pad=int(narr.idle.shape[0]),
-                         t_pad=int(batch.task_group.shape[0])):
-            if self.mesh is not None:
-                assign, pipelined, ready, kept = self._run_sharded(
-                    batch, narr, gmask, static_score, task_bucket,
-                    pack_bonus, q_deserved, q_alloc0, ns_weight, ns_alloc0,
-                    ns_total, ns_live, eps, allow_pipeline)
-            else:
-                assign, pipelined, ready, kept, _ = kernel_fn(
-                    jnp.asarray(batch.task_group),
-                    jnp.asarray(batch.task_job),
-                    jnp.asarray(batch.task_valid),
-                    jnp.asarray(batch.group_req),
-                    gmask, static_score,
-                    jnp.asarray(task_bucket), jnp.asarray(pack_bonus),
-                    jnp.asarray(batch.job_min_available),
-                    jnp.asarray(batch.job_ready_base),
-                    jnp.asarray(batch.job_task_start),
-                    jnp.asarray(batch.job_n_tasks),
-                    jnp.asarray(batch.job_queue),
-                    jnp.asarray(batch.pool_queue),
-                    jnp.asarray(batch.pool_ns),
-                    jnp.asarray(batch.pool_job_start),
-                    jnp.asarray(batch.pool_njobs),
-                    jnp.asarray(ns_weight), jnp.asarray(ns_alloc0),
-                    jnp.asarray(ns_total),
-                    jnp.asarray(q_deserved), jnp.asarray(q_alloc0),
-                    jnp.asarray(narr.idle), jnp.asarray(narr.future_idle),
-                    jnp.asarray(narr.allocatable), jnp.asarray(narr.n_tasks),
-                    jnp.asarray(narr.max_tasks), eps, self.score_weights(),
-                    allow_pipeline=allow_pipeline, ns_live=ns_live,
-                    **kernel_kwargs)
+            ladder = [(_TIER_OF_KERNEL.get(kernel_fn.__name__, "scan"),
+                       kernel_fn, kernel_kwargs)]
+        if ladder[0][0] != "scan":
+            if ladder[0][0] != "chunked":
+                ladder.append(("chunked", gang_allocate_chunked, {}))
+            ladder.append(("scan", gang_allocate, {}))
+        ladder_names = {t[0] for t in ladder}
+        # a breaker whose window expired but whose tier is no longer
+        # selected at all (kernel selection moved on) will never get a
+        # half-open probe: retire it so the open-gauge doesn't stick
+        for tname in [k for k, until in _breaker_open_until.items()
+                      if _place_counter >= until
+                      and k not in ladder_names]:
+            del _breaker_open_until[tname]
+            m.set_gauge(m.SOLVER_BREAKER_OPEN, 0.0, kernel=tname)
+        eligible = [t for t in ladder
+                    if _place_counter >= _breaker_open_until.get(t[0], 0)]
+        if not eligible:
+            eligible = ladder[-1:]   # every tier open: still try the last
 
-            assign = np.asarray(assign)  # blocks until the device finishes
+        kernel_inputs = None
+        t_kernel = time.perf_counter()
+        for i, (tier, kfn, kkwargs) in enumerate(eligible):
+            span_name = "sharded" if tier == "sharded" else kfn.__name__
+            try:
+                with kernel_span(span_name, g_pad=int(batch.g_pad),
+                                 n_pad=int(narr.idle.shape[0]),
+                                 t_pad=int(batch.task_group.shape[0])):
+                    if tier == "sharded":
+                        assign, pipelined, ready, kept = self._run_sharded(
+                            batch, narr, gmask, static_score, task_bucket,
+                            pack_bonus, q_deserved, q_alloc0, ns_weight,
+                            ns_alloc0, ns_total, ns_live, eps,
+                            allow_pipeline)
+                    else:
+                        if kernel_inputs is None:
+                            kernel_inputs = (
+                                jnp.asarray(batch.task_group),
+                                jnp.asarray(batch.task_job),
+                                jnp.asarray(batch.task_valid),
+                                jnp.asarray(batch.group_req),
+                                gmask, static_score,
+                                jnp.asarray(task_bucket),
+                                jnp.asarray(pack_bonus),
+                                jnp.asarray(batch.job_min_available),
+                                jnp.asarray(batch.job_ready_base),
+                                jnp.asarray(batch.job_task_start),
+                                jnp.asarray(batch.job_n_tasks),
+                                jnp.asarray(batch.job_queue),
+                                jnp.asarray(batch.pool_queue),
+                                jnp.asarray(batch.pool_ns),
+                                jnp.asarray(batch.pool_job_start),
+                                jnp.asarray(batch.pool_njobs),
+                                jnp.asarray(ns_weight),
+                                jnp.asarray(ns_alloc0),
+                                jnp.asarray(ns_total),
+                                jnp.asarray(q_deserved),
+                                jnp.asarray(q_alloc0),
+                                jnp.asarray(narr.idle),
+                                jnp.asarray(narr.future_idle),
+                                jnp.asarray(narr.allocatable),
+                                jnp.asarray(narr.n_tasks),
+                                jnp.asarray(narr.max_tasks), eps,
+                                self.score_weights())
+                        assign, pipelined, ready, kept, _ = kfn(
+                            *kernel_inputs, allow_pipeline=allow_pipeline,
+                            ns_live=ns_live, **kkwargs)
+
+                    # blocks until the device finishes (a deferred kernel
+                    # crash surfaces here, inside the tier's try)
+                    assign = np.asarray(assign)
+            except Exception:
+                if i + 1 >= len(eligible):
+                    raise   # last resort crashed too: fail the cycle
+                nxt = eligible[i + 1][0]
+                _breaker_open_until[tier] = \
+                    _place_counter + self.breaker_window
+                m.inc(m.SOLVER_FALLBACK, **{"from": tier, "to": nxt})
+                m.set_gauge(m.SOLVER_BREAKER_OPEN, 1.0, kernel=tier)
+                _logger.exception(
+                    "solver kernel %r crashed; falling back to %r for "
+                    "this cycle (breaker open for the next %d placements)",
+                    tier, nxt, self.breaker_window)
+                continue
+            if tier in _breaker_open_until:
+                # half-open probe succeeded: close the breaker
+                del _breaker_open_until[tier]
+                m.set_gauge(m.SOLVER_BREAKER_OPEN, 0.0, kernel=tier)
+                _logger.warning(
+                    "solver kernel %r recovered; breaker closed", tier)
+            break
         m.observe(m.SOLVER_KERNEL_LATENCY,
                   (time.perf_counter() - t_kernel) * 1000.0)
         pipelined_np = np.asarray(pipelined)

@@ -69,6 +69,40 @@ UNSCHEDULABLE_REASON = f"{NS}_unschedulable_reason_total"
 BIND_FLUSH_LATENCY = f"{NS}_bind_flush_latency_milliseconds"
 BIND_FLUSH_BINDS = f"{NS}_bind_flush_binds_total"
 STORE_PATCH_SHARDS = f"{NS}_store_patch_shards"
+# commit-path resilience (docs/design/resilience.md): bind failures by
+# reason, resync retry volume, pods quarantined after budget exhaustion,
+# gang-atomic heal events, the cycle watchdog, and the solver kernel
+# circuit breaker's fallback transitions / open state
+BIND_ERRORS = f"{NS}_bind_errors_total"
+RESYNC_RETRIES = f"{NS}_resync_retries_total"
+QUARANTINED_TASKS = f"{NS}_quarantined_tasks"
+GANG_HEALS = f"{NS}_gang_heal_total"
+CYCLE_DEADLINE_EXCEEDED = f"{NS}_cycle_deadline_exceeded_total"
+SOLVER_FALLBACK = f"{NS}_solver_fallback_total"
+SOLVER_BREAKER_OPEN = f"{NS}_solver_breaker_open"
+
+# component health registry behind /debug/health: a component absent from
+# the registry is healthy by default; the watchdog (scheduler.py) flips
+# "scheduler" on a cycle-deadline breach and back on recovery
+_health: Dict[str, Tuple[bool, str]] = {}
+
+
+def set_health(component: str, healthy: bool, detail: str = ""):
+    with _lock:
+        _health[component] = (bool(healthy), detail)
+
+
+def health_report() -> dict:
+    """{"healthy": bool, "degraded": [component], "components": {...}} —
+    the /debug/health payload (non-healthy renders as HTTP 503)."""
+    with _lock:
+        comps = {name: {"healthy": ok, "detail": detail}
+                 for name, (ok, detail) in _health.items()}
+    return {
+        "healthy": all(c["healthy"] for c in comps.values()),
+        "degraded": sorted(n for n, c in comps.items() if not c["healthy"]),
+        "components": comps,
+    }
 
 
 def observe(name: str, value: float, **labels):
@@ -180,6 +214,7 @@ def reset():
         _histograms.clear()
         _gauges.clear()
         _counters.clear()
+        _health.clear()
 
 
 def snapshot() -> dict:

@@ -8,6 +8,8 @@ Routes:
   /debug/trace    Chrome trace-event JSON for one cycle (?seq=N, default
                   the newest; load in chrome://tracing or Perfetto)
   /debug/pending  "why pending": per-job / per-reason unschedulable counts
+  /debug/health   component health (cycle watchdog et al.); HTTP 503 when
+                  any component reports degraded
 """
 
 from __future__ import annotations
@@ -40,6 +42,9 @@ def _debug_response(path: str, query: dict):
             return 404, {"error": "no traced cycle in the ring buffer",
                          "enabled": tracer.is_enabled()}
         return 200, tracer.chrome_trace(rec)
+    if path == "/debug/health":
+        report = m.health_report()
+        return (200 if report["healthy"] else 503), report
     if path == "/debug/pending":
         report = tracer.pending_report()
         if report is None:

@@ -23,13 +23,23 @@ from .utils.filewatcher import FileWatcher
 
 
 class Scheduler:
+    # cycle watchdog (docs/design/resilience.md): a run_once exceeding
+    # watchdog_multiple x schedule_period wall seconds logs the in-flight
+    # flight-recorder phase breakdown, bumps
+    # volcano_cycle_deadline_exceeded_total, and marks the scheduler
+    # degraded on /debug/health (cleared by the next in-deadline cycle).
+    # The watchdog only observes — it never interrupts the cycle — so
+    # scheduling decisions stay bit-reproducible.
+    WATCHDOG_MULTIPLE = 4.0
+
     def __init__(self, store: ObjectStore,
                  scheduler_name: str = DEFAULT_SCHEDULER_NAME,
                  scheduler_conf: Optional[str] = None,
                  scheduler_conf_path: Optional[str] = None,
                  schedule_period: float = 1.0,
                  cache: Optional[SchedulerCache] = None,
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None,
+                 watchdog_multiple: Optional[float] = None):
         self.store = store
         # time-dependent scheduling decisions (sla waiting windows, ...)
         # read this clock via the session (run_once passes it into
@@ -40,6 +50,11 @@ class Scheduler:
         self.cache = cache if cache is not None else SchedulerCache(
             store, scheduler_name)
         self.schedule_period = schedule_period
+        self.watchdog_multiple = (watchdog_multiple
+                                  if watchdog_multiple is not None
+                                  else self.WATCHDOG_MULTIPLE)
+        self.degraded = False
+        self.cycle_deadline_exceeded = 0
         self._conf_path = scheduler_conf_path
         self._mutex = threading.Lock()
         self._stop = threading.Event()
@@ -97,32 +112,67 @@ class Scheduler:
         start = time.perf_counter()
         with self._mutex:
             conf = self.conf
-        with tr.cycle():
-            gcguard.pause()
-            begin = getattr(self.cache, "begin_cycle", None)
-            if begin is not None:
-                begin()
-            try:
-                ssn = open_session(self.cache, conf.tiers,
-                                   conf.configurations, clock=self.clock)
-                tr.tag_cycle(jobs=len(ssn.jobs), nodes=len(ssn.nodes),
-                             queues=len(ssn.queues))
+        deadline = self.schedule_period * self.watchdog_multiple
+        timer: Optional[threading.Timer] = None
+        if deadline > 0:
+            timer = threading.Timer(deadline, self._watchdog_fire,
+                                    args=(deadline,))
+            timer.daemon = True
+            timer.start()
+        try:
+            with tr.cycle():
+                gcguard.pause()
+                begin = getattr(self.cache, "begin_cycle", None)
+                if begin is not None:
+                    begin()
                 try:
-                    for name in conf.actions:
-                        action = get_action(name)
-                        if action is None:
-                            continue
-                        with m.action_timer(name), \
-                                tr.span(f"action:{name}", action=name):
-                            action.execute(ssn)
+                    ssn = open_session(self.cache, conf.tiers,
+                                       conf.configurations, clock=self.clock)
+                    tr.tag_cycle(jobs=len(ssn.jobs), nodes=len(ssn.nodes),
+                                 queues=len(ssn.queues))
+                    try:
+                        for name in conf.actions:
+                            action = get_action(name)
+                            if action is None:
+                                continue
+                            with m.action_timer(name), \
+                                    tr.span(f"action:{name}", action=name):
+                                action.execute(ssn)
+                    finally:
+                        close_session(ssn)
                 finally:
-                    close_session(ssn)
-            finally:
-                end = getattr(self.cache, "end_cycle", None)
-                if end is not None:
-                    end()
-                gcguard.resume()
-        m.update_e2e_duration(time.perf_counter() - start)
+                    end = getattr(self.cache, "end_cycle", None)
+                    if end is not None:
+                        end()
+                    gcguard.resume()
+        finally:
+            elapsed = time.perf_counter() - start
+            if timer is not None:
+                timer.cancel()
+                if self.degraded and elapsed <= deadline:
+                    # recovered: this cycle came in under the deadline
+                    self.degraded = False
+                    m.set_health("scheduler", True,
+                                 "cycle time back under the watchdog "
+                                 "deadline")
+        m.update_e2e_duration(elapsed)
+
+    def _watchdog_fire(self, deadline: float) -> None:
+        """The cycle blew its watchdog deadline: record the breach and
+        the stuck cycle's flight-recorder phase breakdown. Observation
+        only — the cycle keeps running and will complete (or fail) on
+        its own; the next in-deadline cycle clears the degraded mark."""
+        from .trace import tracer as tr
+        self.degraded = True
+        self.cycle_deadline_exceeded += 1
+        m.inc(m.CYCLE_DEADLINE_EXCEEDED)
+        detail = (f"scheduling cycle exceeded its {deadline:.2f}s watchdog "
+                  f"deadline ({self.watchdog_multiple:g}x the "
+                  f"{self.schedule_period:g}s period)")
+        m.set_health("scheduler", False, detail)
+        phases = tr.live_phases()
+        log.error("cycle watchdog: %s; in-flight phases: %s", detail,
+                  phases if phases else "(tracing disabled)")
 
     def run(self) -> None:
         """Start cache ingestion + periodic cycles until stop()."""

@@ -72,6 +72,17 @@ def add_sim_parser(sub) -> None:
     smoke.add_argument("--nodes", type=int, default=512)
     smoke.add_argument("--json", action="store_true")
 
+    chaos = sim.add_parser(
+        "chaos", help="CI gate: 2%% bind-failure injection plus a poison "
+                      "pod — gang atomicity must be HEALED (no waiver), "
+                      "the poison pod must reach quarantine with a "
+                      "why-pending reason, and a double run must be "
+                      "bit-identical")
+    chaos.add_argument("--seed", type=int, default=13)
+    chaos.add_argument("--ticks", type=int, default=120)
+    chaos.add_argument("--nodes", type=int, default=128)
+    chaos.add_argument("--json", action="store_true")
+
     rep = sim.add_parser("replay", help="re-run a violation repro bundle")
     rep.add_argument("--bundle", required=True)
     rep.add_argument("--use-trace", action="store_true",
@@ -140,6 +151,34 @@ def smoke_config(seed: int = 7, ticks: int = 200, nodes: int = 512):
         repro_dir=".")
 
 
+POISON_POD = "default/rj-0-0"
+
+
+def chaos_config(seed: int = 13, ticks: int = 120, nodes: int = 128):
+    """The `make chaos-smoke` shape (docs/design/resilience.md): a
+    resident gang backlog plus a Poisson stream under 2% injected bind
+    failures AND one targeted poison pod (task 0 of resident gang rj-0,
+    whose binds always fail). Node churn and evict storms stay off so
+    every partial gang the audit sees comes from the bind-failure path —
+    the gang-atomic healing must hold with NO waiver, and the poison pod
+    must exhaust its retry budget into quarantine."""
+    from .engine import SimConfig
+    from .faults import FaultConfig
+    from .workload import WorkloadConfig
+    return SimConfig(
+        seed=seed, ticks=ticks, tick_s=1.0, n_nodes=nodes,
+        node_cpu="16", node_mem="32Gi",
+        resident_jobs=80, resident_gang=8,
+        workload=WorkloadConfig(
+            seed=seed, horizon_s=float(ticks), arrival_rate=0.3,
+            duration_min_s=20.0, duration_max_s=120.0),
+        faults=FaultConfig(
+            seed=seed, bind_fail_rate=0.02, api_latency_s=0.001,
+            fail_pods=[POISON_POD]),
+        fail_rate=0.0,
+        repro_dir=".")
+
+
 def _print_summary(summary: dict, as_json: bool) -> None:
     if as_json:
         print(json.dumps(summary, indent=1))
@@ -172,8 +211,10 @@ def dispatch_sim(args) -> int:
         return 1 if result.violations else 0
 
     if args.verb == "smoke":
+        from ..framework.solver import reset_breaker
         cfg = smoke_config(seed=args.seed, ticks=args.ticks,
                            nodes=args.nodes)
+        reset_breaker()
         r1 = run_sim(cfg)
         s1 = r1.summary()
         tasks_through = sum(
@@ -187,6 +228,7 @@ def dispatch_sim(args) -> int:
         # for no extra signal.
         deterministic = False
         if ok:
+            reset_breaker()   # module-global solver state must not leak
             r2 = run_sim(smoke_config(seed=args.seed, ticks=args.ticks,
                                       nodes=args.nodes))
             deterministic = r1.bind_fingerprint() == r2.bind_fingerprint()
@@ -203,6 +245,50 @@ def dispatch_sim(args) -> int:
             print(f"tasks through the sim: {tasks_through}")
             print(f"same-seed bind sequence identical: {deterministic}")
             print(f"sim-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
+        return 0 if verdict["pass"] else 1
+
+    if args.verb == "chaos":
+        from ..framework.solver import reset_breaker
+        from ..trace import tracer
+        from ..trace.pending import REASON_QUARANTINED
+        # the solver breaker is module-global: a tier crash in run 1
+        # must not leak an open breaker (and thus a different kernel
+        # tier) into run 2's determinism half
+        reset_breaker()
+        r1 = run_sim(chaos_config(seed=args.seed, ticks=args.ticks,
+                                  nodes=args.nodes))
+        rep1 = tracer.pending_report() or {}
+        reset_breaker()
+        r2 = run_sim(chaos_config(seed=args.seed, ticks=args.ticks,
+                                  nodes=args.nodes))
+        checks = {
+            # atomicity healed, not waived: the checker ran with no
+            # bind-failure exemption and stayed clean
+            "no_violations": not r1.violations and not r2.violations,
+            "bind_failures_fired": r1.resync_retries > 0
+                                   and bool(r1.bind_sequence),
+            "quarantine_reached": POISON_POD in r1.quarantined,
+            "why_pending_quarantine":
+                REASON_QUARANTINED in (rep1.get("reasons") or {}),
+            "deterministic_replay":
+                r1.bind_fingerprint() == r2.bind_fingerprint()
+                and r1.quarantined == r2.quarantined
+                and r1.resync_retries == r2.resync_retries,
+        }
+        verdict = {
+            "chaos": r1.summary(),
+            "checks": checks,
+            "pass": all(checks.values()),
+        }
+        if args.json:
+            print(json.dumps(verdict, indent=1))
+        else:
+            _print_summary(r1.summary(), False)
+            print(f"resync retries: {r1.resync_retries}  "
+                  f"quarantined: {r1.quarantined}")
+            for name, ok in checks.items():
+                print(f"  {name}: {'ok' if ok else 'FAIL'}")
+            print(f"chaos-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
         return 0 if verdict["pass"] else 1
 
     if args.verb == "replay":

@@ -83,6 +83,11 @@ class SimConfig:
     faults: FaultConfig = field(default_factory=FaultConfig)
     # fraction of jobs whose gang loses a pod mid-run (lifecycle "fail")
     fail_rate: float = 0.0
+    # gang-atomicity convergence window (invariants.py): audited ticks a
+    # gang may sit partially bound before violating. Bind failures heal
+    # within their own flush, so this is slack for multi-tick cascades
+    # (a heal racing a storm), not a waiver.
+    gang_converge_ticks: int = 2
     trace_path: Optional[str] = None      # replay this JSONL instead of
     #                                       synthesizing workload/faults
     check_invariants: bool = True
@@ -123,6 +128,10 @@ class SimResult:
         self.repro_paths: List[str] = []
         self.completed_jobs = 0
         self.arrived_jobs = 0
+        # resilience counters (read off the cache at end of run):
+        # lifetime bind-failure resyncs, and the quarantined pod keys
+        self.resync_retries = 0
+        self.quarantined: List[str] = []
 
     def bind_fingerprint(self) -> str:
         h = hashlib.sha256()
@@ -149,6 +158,8 @@ class SimResult:
             "completed_jobs": self.completed_jobs,
             "binds": len(self.bind_sequence),
             "bind_fingerprint": self.bind_fingerprint(),
+            "resync_retries": self.resync_retries,
+            "quarantined": list(self.quarantined),
             "cycle_ms": self.cycle_ms_percentiles(),
             "violations": [
                 {"tick": t, "invariant": v.invariant, "detail": v.detail}
@@ -171,7 +182,8 @@ class SimEngine:
         self.binder = FlakyBinder(self.store, self.clock,
                                   fail_rate=cfg.faults.bind_fail_rate,
                                   latency_s=cfg.faults.api_latency_s,
-                                  seed=cfg.faults.seed)
+                                  seed=cfg.faults.seed,
+                                  fail_pods=cfg.faults.fail_pods)
         self.evictor = FakeEvictor(self.store)
         self.cache = SchedulerCache(self.store, binder=self.binder,
                                     evictor=self.evictor)
@@ -187,7 +199,9 @@ class SimEngine:
         # node name -> (cpu, mem, pods) for kill/re-add cycles
         self._node_catalog: Dict[str, tuple] = {}
         self._bind_cursor = 0
-        self._failed_bind_cursor = 0
+        # gang-atomicity convergence streaks (invariants.py): persists
+        # across per-tick CycleContexts
+        self._partial_streaks: Dict[str, int] = {}
 
     # -- setup -------------------------------------------------------------
 
@@ -323,6 +337,8 @@ class SimEngine:
             self.binder.fail_rate = float(e["bind_fail_rate"])
         if "api_latency_s" in e:
             self.binder.latency_s = float(e["api_latency_s"])
+        if "fail_pods" in e:
+            self.binder.fail_pods = set(e["fail_pods"])
 
     @staticmethod
     def _job_of_pod(pod_name: str) -> str:
@@ -370,14 +386,6 @@ class SimEngine:
             self.queue.push(make_event(
                 now + duration, "job_complete", namespace=ns, name=name))
 
-    def _absorb_bind_failures(self) -> None:
-        failed = self.binder.failed_keys
-        while self._failed_bind_cursor < len(failed):
-            key = failed[self._failed_bind_cursor]
-            self._failed_bind_cursor += 1
-            ns, pod_name = key.split("/", 1)
-            self._dirty_jobs.add(f"{ns}/{self._job_of_pod(pod_name)}")
-
     def _collect_binds(self) -> int:
         chan = self.binder.channel
         new = 0
@@ -416,7 +424,11 @@ class SimEngine:
                 # on the engine thread, after the flush barrier — see
                 # FlakyBinder.take_pending_latency
                 self.clock.advance(self.binder.take_pending_latency())
-                self._absorb_bind_failures()
+                # NOTE: injected bind failures are deliberately NOT added
+                # to dirty_jobs — the commit path heals partial gangs
+                # (resilience.md) and the atomicity checker holds it to
+                # that, with a small convergence window instead of a
+                # waiver
                 new_binds = self._collect_binds()
                 violations: List[Violation] = []
                 if cfg.check_invariants:
@@ -424,7 +436,9 @@ class SimEngine:
                         store=self.store, cache=self.cache, tick=tick,
                         dirty_jobs=self._dirty_jobs,
                         ever_ready=self._ever_ready,
-                        queues_over_before=queues_over)
+                        queues_over_before=queues_over,
+                        gang_converge_ticks=cfg.gang_converge_ticks,
+                        partial_streaks=self._partial_streaks)
                     violations = check_all(ctx)
                     # ever_ready updates AFTER the check: a gang must be
                     # complete the first tick it shows up allocated
@@ -453,6 +467,8 @@ class SimEngine:
                             cfg.repro_dir, self, tick, violations))
                     if cfg.stop_on_violation:
                         break
+            self.result.resync_retries = self.cache.resync_retry_total
+            self.result.quarantined = sorted(self.cache.quarantined)
             return self.result
         finally:
             if not trace_was_on:

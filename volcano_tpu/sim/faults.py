@@ -17,7 +17,7 @@ Two layers:
 from __future__ import annotations
 
 import random
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import List
 
 from ..utils.clock import Clock
@@ -30,6 +30,10 @@ class FaultConfig:
     seed: int = 0
     bind_fail_rate: float = 0.0      # per-pod store-bind failure probability
     api_latency_s: float = 0.0       # virtual seconds charged per store bind
+    # targeted failure: pod keys ("ns/name") whose binds ALWAYS fail —
+    # the deterministic poison-pod mode for quarantine testing (no
+    # coin flips involved; the fail-rate RNG sequence is untouched)
+    fail_pods: List[str] = field(default_factory=list)
     # node churn (over the workload horizon)
     flap_rate: float = 0.0           # drain+undrain pairs per virtual second
     flap_down_s: float = 5.0         # how long a flapped node stays drained
@@ -46,17 +50,22 @@ class FlakyBinder(FakeBinder):
     Failure decisions come from one seeded RNG consumed in bind order;
     the cache executor is a single FIFO worker and the engine flushes it
     every tick, so the coin-flip sequence — and therefore the whole run —
-    is reproducible from the seed. Failed binds raise (landing the task
-    in the resync queue) and are recorded in ``failed_keys`` so the
-    invariant checker can exempt their gangs from the atomicity rule.
+    is reproducible from the seed. Failed binds raise, taking the
+    production resilience path: resync with retry accounting, gang-atomic
+    healing of the bound siblings, and quarantine past the retry budget
+    (docs/design/resilience.md). ``failed_keys`` records every injected
+    failure for test assertions. ``fail_pods`` is the targeted mode: the
+    named pods ALWAYS fail (without consuming the fail-rate coin), so
+    poison-pod quarantine is testable deterministically.
     """
 
     def __init__(self, store, clock: Clock, fail_rate: float = 0.0,
-                 latency_s: float = 0.0, seed: int = 0):
+                 latency_s: float = 0.0, seed: int = 0, fail_pods=None):
         super().__init__(store)
         self.clock = clock
         self.fail_rate = fail_rate
         self.latency_s = latency_s
+        self.fail_pods = set(fail_pods or ())
         self._rng = random.Random(seed ^ 0x5EED)
         self.failed_keys: List[str] = []
         self.attempts = 0
@@ -78,8 +87,11 @@ class FlakyBinder(FakeBinder):
         self.attempts += 1
         if self.latency_s:
             self.pending_latency_s += self.latency_s  # virtual round-trip
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        if key in self.fail_pods:
+            self.failed_keys.append(key)
+            raise RuntimeError(f"injected targeted bind failure for {key}")
         if self.fail_rate and self._rng.random() < self.fail_rate:
-            key = f"{pod.metadata.namespace}/{pod.metadata.name}"
             self.failed_keys.append(key)
             raise RuntimeError(f"injected bind failure for {key}")
         super().bind(pod, hostname)

@@ -13,8 +13,12 @@ Catalog (docs/design/simulation.md carries the prose version):
   requests, ``idle + used == allocatable``, and no task resident on two
   nodes.
 * ``gang_atomicity`` — a job never sits partially bound below its
-  ``minAvailable``: excluding gangs hit by churn/faults this run, the
-  allocated-status task count is either 0 or >= minAvailable.
+  ``minAvailable``: excluding gangs hit by CHURN (node kills, evict
+  storms, pod failures) this run, the allocated-status task count is
+  either 0 or >= minAvailable. Injected BIND failures are NOT exempt —
+  the commit path heals them (gang-atomic unbind of the bound siblings,
+  docs/design/resilience.md), and this checker asserts the heal
+  converges within ``gang_converge_ticks`` consecutive ticks.
 * ``queue_quota`` — a queue with a capability never crosses it through
   *scheduler* action: if it was within capability before the cycle, new
   binds must not push its allocated total beyond capability.
@@ -56,10 +60,18 @@ class CycleContext:
     store: object
     cache: object
     tick: int = 0
-    # job keys ("ns/pg-name") whose gangs were hit by churn or injected
-    # faults at any point — exempt from the gang-atomicity rule (a pod
-    # delete or failed bind legitimately leaves a partial gang)
+    # job keys ("ns/pg-name") whose gangs were hit by CHURN (node kill,
+    # evict storm, mid-run pod failure) at any point — exempt from the
+    # gang-atomicity rule (a pod delete legitimately leaves a partial
+    # gang). Bind failures are NOT collected here: the commit path must
+    # heal them (resilience.md), not get them waived.
     dirty_jobs: Set[str] = field(default_factory=set)
+    # gang-atomicity convergence window: consecutive audited ticks a job
+    # may sit partially bound before it violates (0 = flag immediately).
+    # The engine passes a persistent ``partial_streaks`` dict so streaks
+    # survive across per-tick contexts.
+    gang_converge_ticks: int = 0
+    partial_streaks: Dict[str, int] = field(default_factory=dict)
     # jobs that reached >= minAvailable in an earlier tick (a completing
     # gang draining down is not an atomicity violation)
     ever_ready: Set[str] = field(default_factory=set)
@@ -163,6 +175,7 @@ def check_node_accounting(ctx: CycleContext,
 
 def check_gang_atomicity(ctx: CycleContext) -> List[Violation]:
     out: List[Violation] = []
+    partial_now: Set[str] = set()
     for key, job in ctx.cache.jobs.items():
         if job.pod_group is None or job.min_available <= 0:
             continue
@@ -170,11 +183,18 @@ def check_gang_atomicity(ctx: CycleContext) -> List[Violation]:
             continue
         allocated = allocated_task_count(job)
         if 0 < allocated < job.min_available:
-            out.append(Violation(
-                "gang_atomicity",
-                f"job {key} partially bound: {allocated}/"
-                f"{job.min_available} allocated (gang of "
-                f"{len(job.tasks)})"))
+            partial_now.add(key)
+            streak = ctx.partial_streaks.get(key, 0) + 1
+            ctx.partial_streaks[key] = streak
+            if streak > ctx.gang_converge_ticks:
+                out.append(Violation(
+                    "gang_atomicity",
+                    f"job {key} partially bound: {allocated}/"
+                    f"{job.min_available} allocated (gang of "
+                    f"{len(job.tasks)}) for {streak} consecutive tick(s) "
+                    f"(convergence window {ctx.gang_converge_ticks})"))
+    for key in [k for k in ctx.partial_streaks if k not in partial_now]:
+        del ctx.partial_streaks[key]   # converged (or job gone)
     return out
 
 

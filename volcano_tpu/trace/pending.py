@@ -26,6 +26,12 @@ REASON_SOLVER_MASKED = "predicates failed or insufficient resources"
 REASON_GANG_ROLLBACK = "gang rollback or all feasible nodes already full"
 REASON_NOT_CONSIDERED = "not considered this cycle"
 REASON_AWAITING_ENQUEUE = "PodGroup awaiting enqueue (Pending phase)"
+# commit-path resilience (docs/design/resilience.md): pods the cache has
+# made ineligible for re-placement — quarantined after exhausting their
+# bind retry budget, or inside a bind-failure backoff window (the latter
+# is suffixed "(attempt N)", bounded by the retry budget)
+REASON_QUARANTINED = "bind quarantined: retry budget exhausted"
+REASON_BIND_BACKOFF = "bind failed: in retry backoff"
 
 
 def _task_reasons(fe) -> Counter:
@@ -56,6 +62,7 @@ def collect(ssn) -> dict:
     predicate is one pending task, not 9k)."""
     jobs: Dict[str, dict] = {}
     totals: Counter = Counter()
+    ineligible = getattr(ssn, "ineligible_binds", None) or {}
     for job in ssn.jobs.values():
         if job.pod_group is None or job.ready():
             continue
@@ -66,25 +73,41 @@ def collect(ssn) -> dict:
         per_reason: Counter = Counter()
         for fe in job.nodes_fit_errors.values():
             per_reason.update(_task_reasons(fe))
+        had_fit_errors = bool(per_reason)
+        gated = 0
+        if ineligible:
+            # quarantined / backoff-gated pods were skipped by the
+            # placing actions, so they carry no fit errors — surface the
+            # cache's ineligibility reason instead
+            for task in job.task_status_index.get(
+                    TaskStatus.Pending, {}).values():
+                reason = ineligible.get(task.key())
+                if reason:
+                    per_reason[reason] += 1
+                    gated += 1
         cond_reason = ""
         cond_message = ""
         for c in job.pod_group.status.conditions:
             if c.type == PodGroupConditionType.UNSCHEDULABLE \
                     and c.status == "True":
                 cond_reason, cond_message = c.reason, c.message
-        if not per_reason:
+        if not had_fit_errors:
             # no fit errors recorded: the job never reached the solver
-            # this cycle (still Pending-phase, dropped by JobValid, or
-            # starved by ordering)
-            # count by max(pending, unready): a Pending-phase group's
-            # pods don't exist yet, so its Pending-status task count is 0
-            # while min_available-unready is the real shortfall
-            if job.pod_group.status.phase == PodGroupPhase.PENDING:
-                per_reason[REASON_AWAITING_ENQUEUE] = \
-                    max(pending, unready) or 1
-            else:
-                per_reason[cond_reason or REASON_NOT_CONSIDERED] = \
-                    max(pending, unready) or 1
+            # this cycle (still Pending-phase, dropped by JobValid,
+            # starved by ordering, or its eligible tasks parked behind a
+            # gated gang mate). Count by max(pending, unready): a
+            # Pending-phase group's pods don't exist yet, so its
+            # Pending-status task count is 0 while min_available-unready
+            # is the real shortfall. Gated tasks already carry their own
+            # reason above — count only the remainder here, so a gang
+            # with one quarantined pod still reports its other stuck
+            # tasks instead of vanishing from the backlog.
+            rest = (max(pending, unready) or 1) - gated
+            if rest > 0:
+                if job.pod_group.status.phase == PodGroupPhase.PENDING:
+                    per_reason[REASON_AWAITING_ENQUEUE] = rest
+                else:
+                    per_reason[cond_reason or REASON_NOT_CONSIDERED] = rest
         totals.update(per_reason)
         jobs[f"{job.namespace}/{job.name}"] = {
             "queue": job.queue,

@@ -55,6 +55,10 @@ DEFAULT_BUDGETS = {"cycle": 1000.0}
 # cycle at session close while tracing is enabled
 _pending_report: Optional[dict] = None
 
+# root span of the cycle currently in flight (None between cycles) —
+# read cross-thread by the cycle watchdog via live_phases()
+_live_cycle: Optional["Span"] = None
+
 
 class Span:
     __slots__ = ("name", "t0", "dur", "tags", "children")
@@ -119,9 +123,12 @@ class _CycleCtx:
         return self._root
 
     def __exit__(self, *exc):
+        global _live_cycle
         root = self._root
         root.dur = _perf() - root.t0
         _tls.stack = None
+        if _live_cycle is root:
+            _live_cycle = None
         _finish_cycle(root, self._seq)
         return False
 
@@ -254,7 +261,7 @@ def enable_from_env() -> bool:
 
 def cycle(**tags):
     """Open the root span of one scheduling cycle on this thread."""
-    global _seq
+    global _seq, _live_cycle
     if not _enabled:
         return _NULL
     root = Span("cycle", _perf())
@@ -264,6 +271,7 @@ def cycle(**tags):
         _seq += 1
         seq = _seq
     _tls.stack = [root]
+    _live_cycle = root
     return _CycleCtx(root, seq)
 
 
@@ -355,6 +363,33 @@ def current_seq() -> int:
     """Sequence number of the cycle currently (or last) recording —
     joinable against /debug/trace?seq= and /debug/cycles entries."""
     return _seq
+
+
+def live_phases() -> Dict[str, dict]:
+    """Phase breakdown of the cycle currently IN FLIGHT — the cycle
+    watchdog's view of a stuck ``run_once`` (a completed cycle's record
+    comes from the ring buffer instead). Top-level child spans of the
+    live root, name -> {ms, count, open}; an open span (dur not yet
+    written) reports its elapsed wall time so far. Reads deliberately
+    race the recording thread: children lists are append-only and spans
+    are never removed, so a snapshot is always structurally sound —
+    durations of spans closing mid-read may be a frame stale."""
+    root = _live_cycle
+    if root is None:
+        return {}
+    now = _perf()
+    out: Dict[str, dict] = {}
+    total = now - root.t0
+    for s in list(root.children or ()):
+        is_open = s.dur == 0.0
+        ms = ((now - s.t0) if is_open else s.dur) * 1000.0
+        ent = out.setdefault(s.name, {"ms": 0.0, "count": 0, "open": False})
+        ent["ms"] = round(ent["ms"] + ms, 3)
+        ent["count"] += 1
+        ent["open"] = ent["open"] or is_open
+    out["cycle"] = {"ms": round(total * 1000.0, 3), "count": 1,
+                    "open": True}
+    return out
 
 
 def set_pending_report(report: Optional[dict]) -> None:
