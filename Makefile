@@ -3,7 +3,7 @@
 PYTHON ?= python
 
 .PHONY: test unit-test e2e bench bench-all multichip-dryrun deploy deploy-up \
-	trace-smoke sim-smoke flush-bench chaos-smoke
+	trace-smoke sim-smoke flush-bench chaos-smoke failover-smoke
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -70,6 +70,19 @@ sim-smoke: flush-bench
 # the same seed was bit-identical.
 chaos-smoke: sim-smoke
 	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli chaos
+
+# control-plane failover gate (docs/design/failover.md), after
+# chaos-smoke: leader-lease lapse with a mid-flush crash, stateless and
+# snapshot-restore scheduler kills, watch-delivery drops and bind
+# failures together under leader election on the virtual clock. Exit 1
+# unless every audited tick stayed invariant-clean (crash-left partial
+# gangs reconverged, no silent rebinds, journal gap-free), the deposed
+# incarnation's stale-token write was rejected by the fence, at least
+# one watch-fault divergence was detected AND repaired by anti-entropy,
+# the standby window surfaced its why-pending reason, and a double run
+# from the same seed was bit-identical.
+failover-smoke: chaos-smoke
+	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli failover
 
 # multi-chip sharding dryrun on the virtual CPU mesh
 multichip-dryrun:

@@ -1,0 +1,813 @@
+"""Control-plane failover (docs/design/failover.md): lease fencing on
+both store flavors, elector fencing tokens + callback ordering on the
+injected clock, crash/restart recovery (stateless and snapshot modes),
+the anti-entropy cache reconciler, FlakyWatch-forced divergence/relists,
+and the remote write-retry path.
+
+Everything time-dependent runs on a FakeClock threaded through the
+store, matching the simulator's virtual-clock determinism contract.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from volcano_tpu.apiserver import ObjectStore
+from volcano_tpu.apiserver.persistence import load_store, save_store
+from volcano_tpu.apiserver.store import FencedError
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.metrics import metrics as m
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.sim.faults import FlakyBinder, FlakyWatch
+from volcano_tpu.trace import tracer
+from volcano_tpu.trace.pending import REASON_NOT_LEADER
+from volcano_tpu.utils.clock import FakeClock
+from volcano_tpu.utils.leaderelection import FENCE_KEY, LeaderElector
+from volcano_tpu.utils.test_utils import (FakeEvictor, build_node,
+                                          build_pod, build_pod_group,
+                                          build_queue,
+                                          build_resource_list)
+
+CONF = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+RL = build_resource_list("1", "1Gi")
+
+
+def _store_with_pods(n=3, clock=None):
+    store = ObjectStore(clock=clock) if clock is not None else ObjectStore()
+    store.create("queues", build_queue("default", weight=1))
+    store.create("nodes", build_node("n0", {"cpu": "32", "memory": "64Gi"}))
+    store.create("podgroups", build_pod_group("pg0", "ns1", "default", n,
+                                              phase="Inqueue"))
+    for t in range(n):
+        store.create("pods", build_pod("ns1", f"pg0-{t}", "", "Pending",
+                                       RL, "pg0"))
+    return store
+
+
+# -- lease fencing on the in-process store ----------------------------------
+
+
+class TestStoreFencing:
+    def test_stale_token_rejected_every_write_form(self):
+        store = _store_with_pods()
+        assert store.advance_fence(5) == 5
+        pod = store.get("pods", "pg0-0", "ns1")
+        with pytest.raises(FencedError):
+            store.update("pods", pod, skip_admission=True, fence=4)
+        with pytest.raises(FencedError):
+            store.create("pods", build_pod("ns1", "late", "", "Pending",
+                                           RL, "pg0"), fence=3)
+        with pytest.raises(FencedError):
+            store.delete("pods", "pg0-0", "ns1", skip_admission=True,
+                         fence=1)
+        with pytest.raises(FencedError):
+            store.patch_batch(
+                "pods", [("pg0-0", "ns1", lambda p: None)], fence=4)
+        with pytest.raises(FencedError):
+            store.bind_pods([("pg0-0", "ns1", "n0")], fence=4)
+        assert store.fenced_writes == 5
+        # nothing landed: the pod is untouched at its original rv
+        after = store.get("pods", "pg0-0", "ns1")
+        assert after.metadata.resource_version == \
+            pod.metadata.resource_version
+        assert after.spec.node_name == ""
+
+    def test_current_and_future_tokens_pass_and_unstamped_pass(self):
+        store = _store_with_pods()
+        store.advance_fence(2)
+        pod = store.get("pods", "pg0-0", "ns1")
+        store.update("pods", pod, skip_admission=True, fence=2)   # floor ok
+        pod = store.get("pods", "pg0-0", "ns1")
+        store.update("pods", pod, skip_admission=True, fence=7)   # newer ok
+        pod = store.get("pods", "pg0-0", "ns1")
+        store.update("pods", pod, skip_admission=True)   # unstamped: free
+        # advance is monotonic: an old token cannot LOWER the floor
+        assert store.advance_fence(1) == 2
+        assert store.fence_floor() == 2
+
+    def test_takeover_during_write_barrier_wait_still_fences(self):
+        """A single-pod update that queues behind an in-flight bulk
+        reservation (the write barrier releases the store lock while
+        waiting) must re-check the fence AFTER the wait: a takeover that
+        happens while the writer is parked must still reject it."""
+        store = _store_with_pods()
+        store.advance_fence(1)
+        with store._lock:
+            # freeze phase 1 of a sharded flush: the pod key is
+            # write-barriered until "its shard publishes"
+            store._inflight["pods"].add("ns1/pg0-0")
+        outcome = {}
+        pod = store.get("pods", "pg0-0", "ns1")
+
+        def deposed_writer():
+            try:
+                store.update("pods", pod, skip_admission=True, fence=1)
+                outcome["fenced"] = False
+            except FencedError:
+                outcome["fenced"] = True
+
+        t = threading.Thread(target=deposed_writer)
+        t.start()
+        time.sleep(0.2)            # writer is parked in the barrier wait
+        assert t.is_alive()
+        store.advance_fence(2)     # standby takes over mid-wait
+        with store._lock:
+            store._inflight["pods"].clear()
+            store._flush_cond.notify_all()
+        t.join(timeout=5)
+        assert outcome == {"fenced": True}
+        assert store.get("pods", "pg0-0", "ns1").spec.node_name == ""
+
+    def test_fenced_bind_pods_leaves_no_reservation(self):
+        """A fenced bulk write must reject BEFORE reserving rvs: the
+        journal sequencer stays clean and later writers don't block on
+        orphaned in-flight keys."""
+        store = _store_with_pods()
+        store.advance_fence(9)
+        rv_before = store.current_rv()
+        with pytest.raises(FencedError):
+            store.bind_pods([("pg0-0", "ns1", "n0")], fence=1)
+        assert store.current_rv() == rv_before == store._rv
+        assert not store._inflight["pods"] and not store._journal_parked
+        # the store still accepts ordinary writes afterwards
+        pod = store.get("pods", "pg0-1", "ns1")
+        store.update("pods", pod, skip_admission=True)
+
+
+class TestRemoteFencing:
+    def test_remote_store_fenced_write_maps_to_fenced_error(self):
+        from volcano_tpu.apiserver.http import StoreHTTPServer
+        from volcano_tpu.apiserver.remote import RemoteStore
+        server_store = _store_with_pods()
+        server = StoreHTTPServer(server_store, port=0)
+        server.start()
+        try:
+            remote = RemoteStore(f"http://127.0.0.1:{server.port}")
+            assert remote.advance_fence(4) == 4
+            assert server_store.fence_floor() == 4
+            q = remote.get("queues", "default")
+            q.spec.weight = 3
+            with pytest.raises(FencedError):
+                remote.update("queues", q, fence=2)
+            # the serving store counted the rejection
+            assert server_store.fenced_writes == 1
+            # a current token passes end to end
+            remote.update("queues", q, fence=4)
+            assert server_store.get("queues", "default").spec.weight == 3
+        finally:
+            server.stop()
+
+    def test_malformed_fence_param_is_rejected_not_unfenced(self):
+        """A garbled ?fence= must answer 400 — never fall through to an
+        UNfenced (and thus always-admitted) write."""
+        import urllib.error
+        import urllib.request
+
+        from volcano_tpu.apiserver.codec import encode_object
+        from volcano_tpu.apiserver.http import StoreHTTPServer
+        server_store = _store_with_pods()
+        server_store.advance_fence(5)
+        server = StoreHTTPServer(server_store, port=0)
+        server.start()
+        try:
+            q = server_store.get("queues", "default")
+            q.spec.weight = 9
+            body = json.dumps(encode_object("queues", q)).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/apis/queues/default"
+                f"?fence=abc", data=body, method="PUT",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 400
+            assert server_store.get("queues", "default").spec.weight != 9
+        finally:
+            server.stop()
+
+    def test_remote_backed_cache_anti_entropy_audits_the_mirror(self):
+        """A cache over a RemoteStore has no list_refs on its store; the
+        reconciler must audit against the remote's local mirror instead
+        of crashing every pass."""
+        from volcano_tpu.apiserver.http import StoreHTTPServer
+        from volcano_tpu.apiserver.remote import RemoteStore
+        server_store = _store_with_pods()
+        server = StoreHTTPServer(server_store, port=0)
+        server.start()
+        try:
+            remote = RemoteStore(f"http://127.0.0.1:{server.port}")
+            cache = SchedulerCache(remote)
+            cache.run()
+            rep = cache.anti_entropy()
+            assert "skipped" not in rep
+            assert rep["divergent"] == []
+            cache.stop()
+        finally:
+            server.stop()
+
+
+# -- elector: tokens, ordering, clock jumps ---------------------------------
+
+
+def _elector(store, ident, events, clock=None, lease=15.0):
+    return LeaderElector(
+        store, ident, lease_name="vc-test", lease_duration=lease,
+        clock=clock,
+        on_started_leading=lambda: events.append(f"{ident}:start"),
+        on_stopped_leading=lambda: events.append(f"{ident}:stop"),
+        on_new_leader=lambda who: events.append(f"{ident}:sees:{who}"))
+
+
+class TestElectorFencing:
+    def test_token_bumps_per_acquisition_and_advances_store(self):
+        clock = FakeClock(0.0)
+        store = ObjectStore(clock=clock)
+        events = []
+        a = _elector(store, "a", events, clock)
+        b = _elector(store, "b", events, clock)
+        assert a.step() is True
+        assert a.fencing_token == 1
+        assert store.fence_floor() == 1
+        a.release()
+        assert b.step() is True
+        assert b.fencing_token == 2
+        assert store.fence_floor() == 2
+        # renewals keep the incarnation's token (and the floor)
+        clock.advance(5)
+        assert b.step() is True
+        assert b.fencing_token == 2
+        # the token survives in the lease data across holders
+        lease = store.get("configmaps", "vc-test", "volcano-system")
+        assert lease.data[FENCE_KEY] == "2"
+
+    def test_restarted_incarnation_same_identity_bumps_token(self):
+        """A restarted process re-acquiring its OWN unexpired lease is a
+        new incarnation: it must take a fresh token so its previous
+        self's in-flight writes are fenced."""
+        clock = FakeClock(0.0)
+        store = ObjectStore(clock=clock)
+        a1 = _elector(store, "a", [], clock)
+        assert a1.step() is True and a1.fencing_token == 1
+        # process dies and restarts; lease still valid, same identity
+        a2 = _elector(store, "a", [], clock)
+        clock.advance(1)
+        assert a2.step() is True
+        assert a2.fencing_token == 2
+        assert store.fence_floor() == 2
+        # the old incarnation's write is now rejected
+        pod = store.create("pods", build_pod("ns1", "p", "", "Pending",
+                                             RL, "pg"))
+        with pytest.raises(FencedError):
+            store.update("pods", pod, skip_admission=True,
+                         fence=a1.fencing_token)
+
+    def test_lapse_takeover_fences_old_leader_and_orders_callbacks(self):
+        clock = FakeClock(0.0)
+        store = ObjectStore(clock=clock)
+        events = []
+        a = _elector(store, "a", events, clock, lease=15.0)
+        b = _elector(store, "b", events, clock, lease=15.0)
+        a.step()
+        b.step()
+        assert events == ["a:start", "a:sees:a", "b:sees:a"] or \
+            "a:start" in events
+        clock.advance(20)          # a went silent past the lease
+        assert b.step() is True    # takeover bumps the token + the floor
+        assert b.fencing_token == 2 and store.fence_floor() == 2
+        # at most one candidate ever believes it leads after a steps
+        assert a.step() is False
+        assert events.index("b:start") < events.index("a:stop")
+        assert not (a.is_leader and b.is_leader)
+        # a's in-flight write (stale token) is fenced even though its
+        # on_stopped_leading only fired after the takeover
+        pod = store.create("pods", build_pod("ns1", "p", "", "Pending",
+                                             RL, "pg"))
+        with pytest.raises(FencedError):
+            store.update("pods", pod, skip_admission=True,
+                         fence=a.fencing_token)
+
+    def test_release_fires_stop_before_lease_clears(self):
+        """Voluntary handover ordering: on_stopped_leading fires (and
+        is_leader drops) BEFORE the lease write that lets a standby's
+        on_started_leading observe the freed lease."""
+        clock = FakeClock(0.0)
+        store = ObjectStore(clock=clock)
+        events = []
+        b = _elector(store, "b", events, clock)
+
+        def stopped():
+            events.append("a:stop")
+            # at the instant a's stop callback runs, the lease is still
+            # held — the standby cannot acquire yet
+            assert b.step() is False
+
+        a = LeaderElector(store, "a", lease_name="vc-test", clock=clock,
+                          on_stopped_leading=stopped)
+        a.step()
+        a.release()
+        assert events and events[0] == "a:stop"
+        assert not a.is_leader
+        assert b.step() is True   # after release completes, b takes over
+        assert events.index("a:stop") < events.index("b:start")
+
+    def test_renew_after_clock_jump(self):
+        """A forward clock jump past the lease duration: unchallenged,
+        the leader re-establishes its own lease (same incarnation, same
+        token); challenged first, the standby wins and the old leader
+        steps down on its next round."""
+        clock = FakeClock(0.0)
+        store = ObjectStore(clock=clock)
+        events = []
+        a = _elector(store, "a", events, clock, lease=15.0)
+        b = _elector(store, "b", events, clock, lease=15.0)
+        a.step()
+        clock.advance(100)         # expired from everyone's view
+        assert a.step() is True    # unchallenged renew keeps leadership
+        assert a.fencing_token == 1
+        lease = store.get("configmaps", "vc-test", "volcano-system")
+        assert float(lease.data["renewTime"]) == 100.0
+        # second jump, but the standby races first this time
+        clock.advance(100)
+        assert b.step() is True
+        assert b.fencing_token == 2
+        assert a.step() is False
+        assert "a:stop" in events
+
+
+# -- deposed leader's in-flight flush ---------------------------------------
+
+
+class TestDeposedFlush:
+    def test_stale_fenced_flush_fails_safe_and_resyncs(self):
+        """The organic double-bind scenario: a leader's bind flush is in
+        flight when a standby takes over (fence floor rises). Every
+        store write of the flush must be rejected; the dying cache's
+        resync path absorbs the failures; the store keeps zero of the
+        deposed binds."""
+        clock = FakeClock(start=1.0)
+        store = _store_with_pods(n=3, clock=clock)
+        binder = FlakyBinder(store, clock)
+        cache = SchedulerCache(store, binder=binder,
+                               evictor=FakeEvictor(store),
+                               fence_source=lambda: 1)   # stale forever
+        cache.run()
+        sched = Scheduler(store, scheduler_conf=CONF, cache=cache,
+                          clock=clock)
+        store.advance_fence(2)    # the standby's incarnation took over
+        sched.run_once()
+        assert cache.flush_executors(timeout=30)
+        # no bind landed, every pod is still unbound at the store
+        for t in range(3):
+            assert store.get("pods", f"pg0-{t}", "ns1").spec.node_name == ""
+        assert store.fenced_writes >= 3
+        assert cache.resync_retry_total >= 3
+        # the binder recorded no effective writes either
+        assert binder.binds == {}
+        sched.stop()
+        cache.stop()
+
+
+# -- standby window ----------------------------------------------------------
+
+
+class TestStandby:
+    def test_run_once_skips_and_surfaces_reason(self):
+        clock = FakeClock(0.0)
+        store = _store_with_pods(clock=clock)
+        elector = LeaderElector(store, "standby", lease_name="vc-test",
+                                clock=clock)
+        # someone else holds the lease
+        other = LeaderElector(store, "leader", lease_name="vc-test",
+                              clock=clock)
+        other.step()
+        elector.step()
+        cache = SchedulerCache(store)
+        cache.run()
+        sched = Scheduler(store, scheduler_conf=CONF, cache=cache,
+                          clock=clock, elector=elector)
+        was_enabled = tracer.is_enabled()
+        tracer.enable()
+        try:
+            tracer.set_pending_report(None)
+            sched.run_once()
+            report = tracer.pending_report()
+            assert report is not None
+            assert report["idle_reason"] == REASON_NOT_LEADER
+            assert REASON_NOT_LEADER in report["reasons"]
+            # nothing was scheduled
+            assert store.get("pods", "pg0-0", "ns1").spec.node_name == ""
+        finally:
+            if not was_enabled:
+                tracer.disable()
+            sched.stop()
+            cache.stop()
+
+
+# -- crash/restart recovery through the simulator ---------------------------
+
+
+def _failover_sim(ticks, control_events, **overrides):
+    from volcano_tpu.sim.cli import failover_config
+    cfg = failover_config(seed=11, ticks=ticks, nodes=16)
+    cfg.resident_jobs = 8
+    cfg.faults.watch_drop_rate = 0.0
+    cfg.control_events = control_events
+    for k, v in overrides.items():
+        setattr(cfg, k, v)
+    from volcano_tpu.sim.engine import run_sim
+    return run_sim(cfg)
+
+
+class TestCrashRestart:
+    def test_stateless_restart_mid_flush_reconverges(self):
+        """Scheduler killed 2 binds into a flush: the store keeps the
+        partial gangs, the restarted (stateless) scheduler rebuilds from
+        watches and reconverges with zero invariant violations — no
+        orphaned or duplicated binds, journal gap-free, gang atomicity
+        within the convergence window."""
+        r = _failover_sim(20, [{"at": 6.0, "kind": "scheduler_kill",
+                                "mode": "stateless",
+                                "mid_flush_binds": 2}])
+        assert r.restarts == 1
+        assert not r.violations
+        assert r.bind_sequence                   # scheduling resumed
+        assert r.fenced_writes >= 1              # deposed probe rejected
+
+    def test_snapshot_restart_reanchors_journal(self):
+        """Snapshot-mode restart: store checkpointed and restored into a
+        fresh one (journal cleared + sequencer re-anchored); the rebuilt
+        scheduler keeps placing work on the restored store with every
+        invariant clean."""
+        r = _failover_sim(20, [{"at": 8.0, "kind": "scheduler_kill",
+                                "mode": "snapshot"}])
+        assert r.restarts == 1
+        assert not r.violations
+        # binds happened both before AND after the restore
+        assert len({k for k, _ in r.bind_sequence}) > 8
+
+    def test_leader_lapse_standby_window_and_fence(self):
+        """The full handover: leader dies mid-flush holding its lease; a
+        fresh candidate waits it out (why-pending says standby), takes
+        over with a bumped token, and the deposed write is fenced."""
+        r = _failover_sim(
+            22, [{"at": 6.0, "kind": "leader_lapse", "mid_flush_binds": 2}],
+            gang_converge_ticks=10)
+        assert r.restarts == 1
+        assert not r.violations
+        assert r.fenced_writes >= 1
+        assert REASON_NOT_LEADER in r.pending_reasons_seen
+
+
+# -- anti-entropy -----------------------------------------------------------
+
+
+class TestAntiEntropy:
+    def _cache_env(self):
+        clock = FakeClock(start=1.0)
+        store = _store_with_pods(n=3, clock=clock)
+        cache = SchedulerCache(store)
+        cache.run()
+        return clock, store, cache
+
+    def test_clean_pass_reports_no_divergence(self):
+        _, store, cache = self._cache_env()
+        rep = cache.anti_entropy()
+        assert rep["divergent"] == [] and rep["repaired"] == 0
+        assert cache.anti_entropy_state["checks"] == 1
+        assert cache.anti_entropy_state["last_repair"] is None
+        cache.stop()
+
+    def test_detects_and_repairs_lost_task(self):
+        """A dropped delete/update leaves the cache stale; the pass must
+        flag the kind, repair via relist, and converge to matching
+        fingerprints."""
+        _, store, cache = self._cache_env()
+        # simulate a missed ADD delivery: a pod the cache never saw
+        w = [x for x in cache._watches if x.kind == "pods"][0]
+        orig = w.on_add
+        w.on_add = lambda o: None
+        store.create("pods", build_pod("ns1", "ghost", "", "Pending",
+                                       RL, "pg0"))
+        w.on_add = orig
+
+        def task_keys():
+            with cache.mutex:
+                return {t.key() for t in
+                        cache.jobs["ns1/pg0"].tasks.values()}
+
+        assert "ns1/ghost" not in task_keys()
+        rep = cache.anti_entropy()
+        assert "pods" in rep["divergent"] and rep["repaired"] >= 1
+        assert "ns1/ghost" in task_keys()
+        # second pass is clean — the repair actually converged
+        rep2 = cache.anti_entropy()
+        assert rep2["divergent"] == []
+        assert cache.anti_entropy_state["repairs"] == 1
+        assert cache.anti_entropy_state["last_repair"] is not None
+        cache.stop()
+
+    def test_repairs_stale_version_and_stray_task(self):
+        _, store, cache = self._cache_env()
+        # stale version: a store update whose echo the cache "missed"
+        w = [x for x in cache._watches if x.kind == "pods"][0]
+        orig_update, orig_delete = w.on_update, w.on_delete
+        w.on_update = lambda old, new: None
+        w.on_delete = lambda o: None
+        pod = store.get("pods", "pg0-0", "ns1")
+        pod.status.phase = "Running"
+        store.update("pods", pod, skip_admission=True)
+        # stray: a store delete the cache missed
+        store.delete("pods", "pg0-2", "ns1", skip_admission=True)
+        w.on_update, w.on_delete = orig_update, orig_delete
+        rep = cache.anti_entropy()
+        assert "pods" in rep["divergent"] and rep["repaired"] >= 2
+        with cache.mutex:
+            job = cache.jobs["ns1/pg0"]
+            by_key = {t.key(): t for t in job.tasks.values()}
+            assert "ns1/pg0-2" not in by_key
+            t0 = by_key["ns1/pg0-0"]
+            assert t0.pod.metadata.resource_version == \
+                store.get("pods", "pg0-0", "ns1").metadata.resource_version
+        cache.stop()
+
+    def test_flaky_watch_drop_forces_divergence_then_repair(self):
+        _, store, cache = self._cache_env()
+        flaky = FlakyWatch(seed=0, drop_rate=1.0)
+        flaky.wrap([x for x in cache._watches if x.kind == "pods"][0])
+        pod = store.get("pods", "pg0-1", "ns1")
+        pod.status.phase = "Running"
+        store.update("pods", pod, skip_admission=True)
+        assert flaky.dropped == 1
+        rep = cache.anti_entropy()
+        assert "pods" in rep["divergent"]
+        flaky.unwrap()
+        rep2 = cache.anti_entropy()
+        assert rep2["divergent"] == []
+        cache.stop()
+
+    def test_flaky_watch_delay_redelivers_next_release(self):
+        _, store, cache = self._cache_env()
+        flaky = FlakyWatch(seed=0, delay_rate=1.0)
+        flaky.wrap([x for x in cache._watches if x.kind == "pods"][0])
+        pod = store.get("pods", "pg0-1", "ns1")
+        pod.status.phase = "Running"
+        store.update("pods", pod, skip_admission=True)
+        assert flaky.delayed == 1
+
+        def phase_of(key):
+            with cache.mutex:
+                return {t.key(): t.pod.status.phase for t in
+                        cache.jobs["ns1/pg0"].tasks.values()}[key]
+
+        assert phase_of("ns1/pg0-1") == "Pending"
+        assert flaky.release_delayed() == 1
+        assert phase_of("ns1/pg0-1") == "Running"
+        flaky.unwrap()
+        cache.stop()
+
+    def test_unwrap_drops_pending_delayed_deliveries(self):
+        """A restart unwraps the FlakyWatch; deliveries still delayed at
+        that point hold closures over the DISCARDED cache's handlers and
+        must be dropped, not replayed into dead state."""
+        _, store, cache = self._cache_env()
+        flaky = FlakyWatch(seed=0, delay_rate=1.0)
+        flaky.wrap([x for x in cache._watches if x.kind == "pods"][0])
+        pod = store.get("pods", "pg0-1", "ns1")
+        pod.status.phase = "Running"
+        store.update("pods", pod, skip_admission=True)
+        assert flaky.delayed == 1
+        flaky.unwrap()
+        assert flaky.dropped == 1
+        assert flaky.release_delayed() == 0
+        cache.stop()
+
+
+# -- FlakyWatch-forced journal gap -> remote relist --------------------------
+
+
+class TestWatchGapRelist:
+    def test_forced_gap_triggers_resync_relist(self):
+        from volcano_tpu.apiserver.http import StoreHTTPServer
+        from volcano_tpu.apiserver.remote import RemoteStore
+        server_store = ObjectStore()
+        server_store.create("queues", build_queue("q0", weight=1))
+        server = StoreHTTPServer(server_store, port=0)
+        server.start()
+        remote = RemoteStore(f"http://127.0.0.1:{server.port}",
+                             poll_timeout=1.0)
+        remote.run()
+        try:
+            for i in range(1, 6):
+                server_store.create("queues", build_queue(f"q{i}",
+                                                          weight=1))
+            # roll the journal window past every subscriber: the next
+            # poll must see resync=True and relist
+            FlakyWatch.force_gap(server_store)
+            server_store.create("queues", build_queue("q-after", weight=1))
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if remote.mirror.get("queues", "q-after") is not None \
+                        and remote.mirror.get("queues", "q5") is not None:
+                    break
+                time.sleep(0.05)
+            assert remote.mirror.get("queues", "q-after") is not None
+            assert remote.mirror.get("queues", "q5") is not None
+        finally:
+            remote.stop()
+            server.stop()
+
+    def test_dead_server_backs_off_and_counts_restarts(self):
+        """The watch thread must never die silently: with the server
+        gone it restarts the stream with backoff and counts each
+        restart."""
+        from volcano_tpu.apiserver.http import StoreHTTPServer
+        from volcano_tpu.apiserver.remote import RemoteStore
+        server_store = ObjectStore()
+        server = StoreHTTPServer(server_store, port=0)
+        server.start()
+        remote = RemoteStore(f"http://127.0.0.1:{server.port}",
+                             poll_timeout=0.5)
+        remote.run()
+        server.stop()   # the apiserver goes away mid-watch
+        deadline = time.time() + 10
+        while time.time() < deadline and remote.watch_restarts < 2:
+            time.sleep(0.05)
+        assert remote.watch_restarts >= 2
+        assert remote._thread.is_alive()
+        remote.stop()
+
+
+# -- remote write retry ------------------------------------------------------
+
+
+class TestWriteRetry:
+    def test_transient_errors_retry_then_succeed(self):
+        from volcano_tpu.apiserver.http import ApiError
+        from volcano_tpu.apiserver.remote import retry_transient
+        m.reset()
+        calls = []
+        slept = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ApiError(503, "unavailable")
+            return "ok"
+
+        out = retry_transient("update", "pods/ns1/p0", flaky,
+                              sleep=slept.append)
+        assert out == "ok" and len(calls) == 3
+        assert len(slept) == 2
+        # capped exponential with deterministic jitter: second delay in
+        # [0.5, 1.0) * (2 * base)
+        assert 0.05 <= slept[0] < 0.1 and 0.1 <= slept[1] < 0.2
+        counters = m.snapshot()["counters"]
+        assert counters[(m.STORE_WRITE_RETRIES, ())] == 2.0
+
+    def test_permanent_errors_raise_immediately(self):
+        from volcano_tpu.apiserver.http import ApiError
+        from volcano_tpu.apiserver.remote import retry_transient
+        calls = []
+
+        def conflict():
+            calls.append(1)
+            raise ApiError(409, "stale resource_version")
+
+        with pytest.raises(ApiError):
+            retry_transient("update", "pods/ns1/p0", conflict,
+                            sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_budget_exhaustion_raises_the_transient_error(self):
+        from volcano_tpu.apiserver.http import ApiError
+        from volcano_tpu.apiserver.remote import retry_transient
+        calls = []
+
+        def always_503():
+            calls.append(1)
+            raise ApiError(503, "unavailable")
+
+        with pytest.raises(ApiError):
+            retry_transient("create", "pods/ns1/p0", always_503,
+                            attempts=3, sleep=lambda s: None)
+        assert len(calls) == 3
+
+
+# -- persistence: parked-journal snapshot (satellite) ------------------------
+
+
+class TestParkedJournalRestore:
+    def test_snapshot_during_inflight_reservation_restores_consistent(
+            self, tmp_path):
+        """Snapshot taken while a sharded bind_pods has rvs reserved but
+        unpublished (non-contiguous tail + a parked interleaved write):
+        the restore must re-anchor the sequencer so events_since /
+        current_rv are consistent and new writes journal contiguously."""
+        store = _store_with_pods(n=2)
+        pre_tail = store.current_rv()
+        with store._lock:
+            # phase 1 of a sharded flush, frozen mid-flight: a reserved
+            # contiguous rv range with its keys write-barriered
+            store._rv += 4
+            store._inflight["pods"].update({"ns1/pg0-0", "ns1/pg0-1"})
+        # an interleaved writer on another kind: its journal entry must
+        # PARK (its rv is beyond the reserved range's unpublished tail)
+        q = store.get("queues", "default")
+        q.spec.weight = 7
+        store.update("queues", q, skip_admission=True)
+        assert store._journal_parked            # genuinely non-contiguous
+        assert store.current_rv() == pre_tail   # tail never advanced
+        alloc = store._rv
+
+        path = str(tmp_path / "mid-flight.json")
+        save_store(store, path)
+        restored, count = load_store(path)
+        # sequencer re-anchored: tail == allocation counter, nothing
+        # parked, no in-flight keys
+        assert restored.current_rv() == restored._rv >= alloc
+        assert not restored._journal_parked
+        assert not restored._inflight["pods"]
+        # the interleaved write's DATA survived even though its journal
+        # entry was still parked at snapshot time
+        assert restored.get("queues", "default").spec.weight == 7
+        # a pre-restore cursor sees a gap -> resync, never silence
+        events, rv, resync = restored.events_since(pre_tail - 1,
+                                                   timeout=0.05)
+        assert resync and not events
+        # and new writes journal contiguously from the re-anchor
+        anchor = restored.current_rv()
+        q2 = restored.get("queues", "default")
+        q2.spec.weight = 9
+        restored.update("queues", q2, skip_admission=True)
+        events, rv, resync = restored.events_since(anchor, timeout=1.0)
+        assert not resync and len(events) == 1
+        assert events[0][0] == anchor + 1
+
+
+# -- no_silent_rebind invariant ---------------------------------------------
+
+
+class TestNoSilentRebind:
+    def _ctx(self, store, ledger):
+        from volcano_tpu.sim.invariants import CycleContext
+        cache = SchedulerCache(store)
+        cache.run()
+        return CycleContext(store=store, cache=cache, bind_ledger=ledger)
+
+    def test_flags_rebind_without_unbind(self):
+        from volcano_tpu.sim.invariants import check_no_silent_rebind
+        store = _store_with_pods(n=1)
+        store.create("nodes", build_node("n1", {"cpu": "32",
+                                                "memory": "64Gi"}))
+        pod = store.get("pods", "pg0-0", "ns1")
+        pod.spec.node_name = "n0"
+        store.update("pods", pod, skip_admission=True)
+        ledger = {}
+        ctx = self._ctx(store, ledger)
+        assert check_no_silent_rebind(ctx) == []
+        assert ledger == {"ns1/pg0-0": "n0"}
+        # a second writer lands a different node with no unbind between
+        pod = store.get("pods", "pg0-0", "ns1")
+        pod.spec.node_name = "n1"
+        store.update("pods", pod, skip_admission=True)
+        out = check_no_silent_rebind(ctx)
+        assert len(out) == 1 and "double-bind" in out[0].detail
+        ctx.cache.stop()
+
+    def test_unbind_then_rebind_is_legitimate(self):
+        from volcano_tpu.sim.invariants import check_no_silent_rebind
+        store = _store_with_pods(n=1)
+        store.create("nodes", build_node("n1", {"cpu": "32",
+                                                "memory": "64Gi"}))
+        pod = store.get("pods", "pg0-0", "ns1")
+        pod.spec.node_name = "n0"
+        store.update("pods", pod, skip_admission=True)
+        ledger = {}
+        ctx = self._ctx(store, ledger)
+        assert check_no_silent_rebind(ctx) == []
+        # gang heal unbinds... (audited tick)
+        pod = store.get("pods", "pg0-0", "ns1")
+        pod.spec.node_name = ""
+        store.update("pods", pod, skip_admission=True)
+        assert check_no_silent_rebind(ctx) == []
+        assert "ns1/pg0-0" not in ledger
+        # ...then a later cycle re-places it elsewhere: clean
+        pod = store.get("pods", "pg0-0", "ns1")
+        pod.spec.node_name = "n1"
+        store.update("pods", pod, skip_admission=True)
+        assert check_no_silent_rebind(ctx) == []
+        ctx.cache.stop()

@@ -28,7 +28,17 @@ from typing import Optional
 
 from .codec import decode_object, encode_object
 from .store import (CLUSTER_SCOPED, KINDS, AdmissionError, ConflictError,
-                    ObjectStore)
+                    FencedError, ObjectStore)
+
+
+def _fence_of(query: dict):
+    """Optional fencing token from a write request's query string
+    (?fence=N). Fenced rejections map to HTTP 412 Precondition Failed —
+    distinct from the 409 conflict, which is retryable by re-reading.
+    Raises ValueError on a malformed token (handlers answer 400: a
+    garbled fence must never silently degrade to an UNfenced write)."""
+    raw = query.get("fence", [None])[0]
+    return int(raw) if raw is not None else None
 
 
 class StoreHTTPServer:
@@ -80,6 +90,8 @@ class StoreHTTPServer:
                 parsed = urllib.parse.urlparse(self.path)
                 if parsed.path == "/rv":
                     return self._send(200, {"rv": store.current_rv()})
+                if parsed.path == "/fence":
+                    return self._send(200, {"floor": store.fence_floor()})
                 if parsed.path == "/watch":
                     q = urllib.parse.parse_qs(parsed.query)
                     since = int(q.get("since", ["0"])[0])
@@ -107,6 +119,12 @@ class StoreHTTPServer:
 
             def do_POST(self):
                 parsed = urllib.parse.urlparse(self.path)
+                if parsed.path == "/fence":
+                    # the LeaderElector of a remote process announcing its
+                    # freshly-acquired token; floor advance is monotonic
+                    body = self._body() or {}
+                    floor = store.advance_fence(int(body.get("token", 0)))
+                    return self._send(200, {"floor": floor})
                 if parsed.path == "/events":
                     body = self._body()
                     o = decode_object(body["kind"], body["object"]) \
@@ -135,11 +153,17 @@ class StoreHTTPServer:
                 route = self._parse()
                 if route is None:
                     return self._send(404, {"error": "not found"})
-                kind, _ns, _name, _q = route
+                kind, _ns, _name, query = route
+                try:
+                    fence = _fence_of(query)
+                except ValueError:
+                    return self._send(400, {"error": "malformed fence token"})
                 try:
                     o = decode_object(kind, self._body())
-                    created = store.create(kind, o)
+                    created = store.create(kind, o, fence=fence)
                     return self._send(201, encode_object(kind, created))
+                except FencedError as e:
+                    return self._send(412, {"error": str(e)})
                 except AdmissionError as e:
                     return self._send(422, {"error": str(e)})
                 except KeyError as e:
@@ -149,11 +173,17 @@ class StoreHTTPServer:
                 route = self._parse()
                 if route is None:
                     return self._send(404, {"error": "not found"})
-                kind, _ns, _name, _q = route
+                kind, _ns, _name, query = route
+                try:
+                    fence = _fence_of(query)
+                except ValueError:
+                    return self._send(400, {"error": "malformed fence token"})
                 try:
                     o = decode_object(kind, self._body())
-                    updated = store.update(kind, o)
+                    updated = store.update(kind, o, fence=fence)
                     return self._send(200, encode_object(kind, updated))
+                except FencedError as e:
+                    return self._send(412, {"error": str(e)})
                 except ConflictError as e:
                     return self._send(409, {"error": str(e)})
                 except AdmissionError as e:
@@ -165,10 +195,16 @@ class StoreHTTPServer:
                 route = self._parse()
                 if route is None or route[2] is None:
                     return self._send(404, {"error": "not found"})
-                kind, ns, name, _q = route
+                kind, ns, name, query = route
                 try:
-                    rv = store.delete(kind, name, ns)
+                    fence = _fence_of(query)
+                except ValueError:
+                    return self._send(400, {"error": "malformed fence token"})
+                try:
+                    rv = store.delete(kind, name, ns, fence=fence)
                     return self._send(200, {"status": "deleted", "rv": rv})
+                except FencedError as e:
+                    return self._send(412, {"error": str(e)})
                 except AdmissionError as e:
                     return self._send(422, {"error": str(e)})
                 except KeyError as e:
@@ -239,15 +275,28 @@ class StoreClient:
         data = self._request("GET", path)
         return [decode_object(kind, item) for item in data["items"]]
 
-    def create(self, kind: str, o):
-        data = self._request("POST", self._path(kind), encode_object(kind, o))
+    @staticmethod
+    def _with_fence(path: str, fence) -> str:
+        return path if fence is None else f"{path}?fence={int(fence)}"
+
+    def create(self, kind: str, o, fence=None):
+        data = self._request("POST",
+                             self._with_fence(self._path(kind), fence),
+                             encode_object(kind, o))
         return decode_object(kind, data)
 
-    def update(self, kind: str, o):
-        data = self._request(
-            "PUT", self._path(kind, o.metadata.name, o.metadata.namespace),
-            encode_object(kind, o))
+    def update(self, kind: str, o, fence=None):
+        path = self._path(kind, o.metadata.name, o.metadata.namespace)
+        data = self._request("PUT", self._with_fence(path, fence),
+                             encode_object(kind, o))
         return decode_object(kind, data)
 
-    def delete(self, kind: str, name: str, namespace: str = "default"):
-        return self._request("DELETE", self._path(kind, name, namespace))
+    def delete(self, kind: str, name: str, namespace: str = "default",
+               fence=None):
+        return self._request(
+            "DELETE", self._with_fence(self._path(kind, name, namespace),
+                                       fence))
+
+    def advance_fence(self, token: int) -> int:
+        return int(self._request("POST", "/fence",
+                                 {"token": int(token)}).get("floor", 0))

@@ -24,7 +24,17 @@ SNAPSHOT_VERSION = 1
 
 
 def save_store(store: ObjectStore, path: str) -> int:
-    """Write an atomic snapshot; returns the number of objects saved."""
+    """Write an atomic snapshot; returns the number of objects saved.
+
+    Safe to call while a sharded bulk patch has rvs reserved but
+    unpublished (parked journal entries, non-contiguous tail): the
+    snapshot is taken under the store lock, records the ALLOCATION
+    counter ``_rv`` (not the journal tail), and object data committed by
+    interleaved writers — even writers whose journal entry is still
+    parked behind the reservation — is captured. Restore re-anchors the
+    sequencer at that counter, so a snapshot mid-flight never loses
+    writes or replays a torn journal (tests/test_failover.py,
+    TestParkedJournalRestore)."""
     payload = {"version": SNAPSHOT_VERSION, "resource_version": store._rv,
                "objects": {}}
     count = 0
@@ -56,7 +66,14 @@ def load_store(path: str, store: Optional[ObjectStore] = None,
     Returns (store, object_count). The change journal is cleared after the
     replay: the replayed creates carry restart-local rvs that misrepresent
     history, and remote watchers from before the restart must see a
-    journal gap (resync) rather than silently missing events."""
+    journal gap (resync) rather than silently missing events.
+
+    The write-fence floor (docs/design/failover.md) is deliberately NOT
+    part of a snapshot — it is incarnation-local state that re-derives
+    from the lease object's persisted ``fencingToken`` at the next
+    acquisition (the lease ConfigMap itself IS snapshotted). A restorer
+    that must close the window before that acquisition carries the old
+    floor over explicitly (sim/engine.py _swap_store_from_snapshot)."""
     with open(path) as f:
         payload = json.load(f)
     if payload.get("version") != SNAPSHOT_VERSION:

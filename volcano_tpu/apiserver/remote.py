@@ -23,16 +23,32 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import urllib.error
 import urllib.request
 from typing import Optional
 
+from ..utils.backoff import seeded_backoff
 from ..utils.fastclone import fast_clone
 from .codec import decode_object, encode_object
-from .http import StoreClient
+from .http import ApiError, StoreClient
 from .store import CLUSTER_SCOPED as _CLUSTER_SCOPED
 from .store import KINDS, AdmissionError, ObjectStore
 
 log = logging.getLogger(__name__)
+
+# HTTP statuses worth retrying: the server hiccuped, not the request.
+# Everything else (404/409/412/422) is a semantic verdict that a replay
+# would only repeat.
+_TRANSIENT_CODES = frozenset({500, 502, 503, 504})
+
+
+def _is_transient(e: Exception) -> bool:
+    if isinstance(e, ApiError):
+        return e.code in _TRANSIENT_CODES
+    # connection refused/reset, DNS blips, timeouts — urllib wraps them
+    # all in URLError (HTTPError is an ApiError by the time it's here)
+    return isinstance(e, (urllib.error.URLError, TimeoutError,
+                          ConnectionError))
 
 
 class RemoteAdmissionHook:
@@ -92,8 +108,60 @@ class RemoteAdmissionHook:
             new_obj.__dict__.update(patched.__dict__)
 
 
+def retry_transient(op: str, key: str, fn, *, attempts: int = 4,
+                    base: float = 0.1, cap: float = 2.0, seed: int = 0,
+                    sleep=None):
+    """Run ``fn`` retrying transient HTTP failures with the shared
+    seeded-jitter backoff (``volcano_store_write_retries_total`` per
+    retry). Non-transient errors raise immediately; exhausting the
+    budget logs loudly WITH the object key — a write the caller thought
+    landed silently vanishing is the failure mode this exists to kill.
+
+    At-least-once caveat: a write that COMMITTED server-side but whose
+    response was lost (connection reset after commit) is replayed, and
+    the replay surfaces as the semantic verdict of a duplicate — 409
+    (create: already exists / update: stale resource_version). That is
+    the conflict path every caller already handles with a re-get+retry
+    round trip (the store's normal optimistic-concurrency contract), so
+    the lost-response success degrades to one extra conflict loop, never
+    to a silent loss or a silent double-apply."""
+    import time as _time
+    sleep = sleep if sleep is not None else _time.sleep
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as e:
+            attempt += 1
+            if not _is_transient(e) or attempt >= attempts:
+                if _is_transient(e):
+                    log.error("store write %s %s failed after %d "
+                              "attempt(s): %s", op, key, attempt, e)
+                raise
+            try:
+                from ..metrics import metrics as _m
+                _m.inc(_m.STORE_WRITE_RETRIES)
+            except Exception:
+                pass
+            delay = seeded_backoff(f"{op}:{key}", attempt, base, cap,
+                                   seed=seed)
+            log.warning("store write %s %s failed (%s); retry %d/%d in "
+                        "%.3fs", op, key, e, attempt, attempts - 1, delay)
+            sleep(delay)
+
+
 class RemoteStore:
     """ObjectStore-compatible facade over a remote apiserver process."""
+
+    # write-path retry budget for transient HTTP errors (a blip used to
+    # raise straight through to the caller)
+    WRITE_ATTEMPTS = 4
+    WRITE_BACKOFF_BASE_S = 0.1
+    WRITE_BACKOFF_CAP_S = 2.0
+    # watch reconnect backoff: consecutive poll failures back off
+    # exponentially instead of hammering a down server at 1 Hz forever
+    WATCH_BACKOFF_BASE_S = 0.5
+    WATCH_BACKOFF_CAP_S = 15.0
 
     def __init__(self, base_url: str, poll_timeout: float = 25.0):
         self.client = StoreClient(base_url)
@@ -111,6 +179,7 @@ class RemoteStore:
         self._seen_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self.watch_restarts = 0
         self._resync()
         self.events = self.mirror.events   # local event record view
 
@@ -181,6 +250,13 @@ class RemoteStore:
             log.exception("mirror apply %s %s failed", action, kind)
 
     def _poll_loop(self) -> None:
+        """Long-poll the journal forever. EVERY failure mode — a dead
+        server, a poisoned event payload, a resync that itself fails —
+        restarts the stream with capped exponential backoff
+        (``volcano_watch_restarts_total``) instead of killing the thread:
+        a watch thread dying silently leaves the mirror frozen at a stale
+        rv with nothing ever noticing (the pre-failover behavior)."""
+        failures = 0
         while not self._stop.is_set():
             url = (f"{self.base_url}/watch?since={self._rv}"
                    f"&timeout={self.poll_timeout}")
@@ -188,19 +264,34 @@ class RemoteStore:
                 with urllib.request.urlopen(
                         url, timeout=self.poll_timeout + 10.0) as resp:
                     data = json.loads(resp.read().decode())
+                if data.get("resync"):
+                    self._resync()
+                    self._rv = max(self._rv, int(data.get("rv", self._rv)))
+                else:
+                    for ev in data.get("events", []):
+                        o = decode_object(ev["kind"], ev["object"])
+                        self._apply(ev["action"], ev["kind"], o,
+                                    int(ev["rv"]))
+                        self._rv = max(self._rv, int(ev["rv"]))
             except Exception:
-                if not self._stop.is_set():
-                    log.warning("watch poll failed; retrying", exc_info=True)
-                    self._stop.wait(1.0)
+                if self._stop.is_set():
+                    return
+                failures += 1
+                self.watch_restarts += 1
+                try:
+                    from ..metrics import metrics as _m
+                    _m.inc(_m.WATCH_RESTARTS)
+                except Exception:
+                    pass
+                delay = seeded_backoff(self.base_url, failures,
+                                       self.WATCH_BACKOFF_BASE_S,
+                                       self.WATCH_BACKOFF_CAP_S)
+                log.warning("watch poll failed (failure %d); restarting "
+                            "the stream in %.2fs", failures, delay,
+                            exc_info=True)
+                self._stop.wait(delay)
                 continue
-            if data.get("resync"):
-                self._resync()
-                self._rv = max(self._rv, int(data.get("rv", self._rv)))
-                continue
-            for ev in data.get("events", []):
-                o = decode_object(ev["kind"], ev["object"])
-                self._apply(ev["action"], ev["kind"], o, int(ev["rv"]))
-                self._rv = max(self._rv, int(ev["rv"]))
+            failures = 0   # a clean poll closes the backoff window
 
     def run(self) -> None:
         if self._thread is not None:
@@ -225,19 +316,35 @@ class RemoteStore:
         """HTTP status -> the in-process store's exception types, so
         controllers' retry/conflict handling works unchanged."""
         from .http import ApiError
-        from .store import ConflictError
+        from .store import ConflictError, FencedError
         if isinstance(e, ApiError):
             if e.code == 409 and "resource_version" in e.message:
                 return ConflictError(e.message)
             if e.code in (404, 409):
                 return KeyError(e.message)
+            if e.code == 412:
+                return FencedError(e.message)
             if e.code == 422:
                 return AdmissionError(e.message)
         return e
 
-    def create(self, kind: str, o, skip_admission: bool = False):
+    def _retrying(self, op: str, key: str, fn):
+        return retry_transient(op, key, fn, attempts=self.WRITE_ATTEMPTS,
+                               base=self.WRITE_BACKOFF_BASE_S,
+                               cap=self.WRITE_BACKOFF_CAP_S)
+
+    def advance_fence(self, token: int) -> int:
+        """Announce a freshly-acquired fencing token to the serving
+        process (LeaderElector duck-types this against both stores)."""
+        return self._retrying("advance_fence", str(token),
+                              lambda: self.client.advance_fence(token))
+
+    def create(self, kind: str, o, skip_admission: bool = False,
+               fence: Optional[int] = None):
         try:
-            created = self.client.create(kind, o)
+            created = self._retrying(
+                "create", f"{kind}/{self.key_of(kind, o)}",
+                lambda: self.client.create(kind, o, fence=fence))
         except Exception as e:
             raise self._map_error(e) from None
         # the in-process store stamps uid/rv on the caller's object in
@@ -253,9 +360,12 @@ class RemoteStore:
                     created.metadata.resource_version)
         return created
 
-    def update(self, kind: str, o, skip_admission: bool = False):
+    def update(self, kind: str, o, skip_admission: bool = False,
+               fence: Optional[int] = None):
         try:
-            updated = self.client.update(kind, o)
+            updated = self._retrying(
+                "update", f"{kind}/{self.key_of(kind, o)}",
+                lambda: self.client.update(kind, o, fence=fence))
         except Exception as e:
             raise self._map_error(e) from None
         o.metadata.resource_version = updated.metadata.resource_version
@@ -264,9 +374,12 @@ class RemoteStore:
         return updated
 
     def delete(self, kind: str, name: str, namespace: str = "default",
-               skip_admission: bool = False):
+               skip_admission: bool = False, fence: Optional[int] = None):
         try:
-            resp = self.client.delete(kind, name, namespace)
+            resp = self._retrying(
+                "delete", f"{kind}/{namespace}/{name}",
+                lambda: self.client.delete(kind, name, namespace,
+                                           fence=fence))
         except Exception as e:
             raise self._map_error(e) from None
         rv = int((resp or {}).get("rv", 0)) if isinstance(resp, dict) else 0

@@ -65,6 +65,15 @@ class ConflictError(Exception):
     concurrency, the apiserver 409). Re-get and retry."""
 
 
+class FencedError(Exception):
+    """Raised when a write carries a fencing token below the store's
+    floor (docs/design/failover.md): the writer's lease incarnation has
+    been superseded — a deposed leader with binds still in flight must
+    NOT be able to land them after the standby took over. Unlike
+    ConflictError this is not retryable by re-reading: the writer must
+    stop writing until it re-acquires leadership (and a fresh token)."""
+
+
 class AdmissionHook:
     """One admission service (reference: pkg/webhooks/router/interface.go:38-48).
 
@@ -164,6 +173,44 @@ class ObjectStore:
         # be silently overwritten by the shard's stale clone)
         self._inflight: Dict[str, set] = defaultdict(set)
         self._flush_cond = threading.Condition(self._lock)
+        # lease fencing (docs/design/failover.md): the highest fencing
+        # token this store has been told about (LeaderElector bumps it on
+        # every lease acquisition). Writes stamped with a LOWER token are
+        # rejected with FencedError; unstamped writes (fence=None — every
+        # non-leader-scoped writer: controllers, tests, admission) pass
+        # unchecked. Not persisted by snapshots: the floor re-derives
+        # from the lease object on the next acquisition (the token itself
+        # lives in the lease ConfigMap and IS snapshotted).
+        self._fence_floor = 0
+        self.fenced_writes = 0
+
+    # -- lease fencing -----------------------------------------------------
+
+    def advance_fence(self, token: int) -> int:
+        """Raise the write-fence floor to ``token`` (monotonic — a late
+        call with an older token is a no-op). Returns the floor."""
+        with self._lock:
+            if token > self._fence_floor:
+                self._fence_floor = token
+            return self._fence_floor
+
+    def fence_floor(self) -> int:
+        with self._lock:
+            return self._fence_floor
+
+    def _check_fence_locked(self, fence: Optional[int]) -> None:
+        """Reject a write stamped with a superseded fencing token.
+        Caller holds ``self._lock``; raised before any state mutates."""
+        if fence is not None and fence < self._fence_floor:
+            self.fenced_writes += 1
+            try:
+                from ..metrics import metrics as _m
+                _m.inc(_m.FENCED_WRITES)
+            except Exception:
+                pass
+            raise FencedError(
+                f"write fenced: token {fence} is behind the floor "
+                f"{self._fence_floor} (lease superseded)")
 
     # -- keys --------------------------------------------------------------
 
@@ -230,7 +277,8 @@ class ObjectStore:
 
     # -- CRUD --------------------------------------------------------------
 
-    def create(self, kind: str, o, skip_admission: bool = False):
+    def create(self, kind: str, o, skip_admission: bool = False,
+               fence: Optional[int] = None):
         # admission runs outside the store lock: remote admission hooks
         # (webhook-manager callbacks) must not stall every other writer
         if not skip_admission:
@@ -239,6 +287,7 @@ class ObjectStore:
         if derive is not None:
             derive(o)   # after admission: mutating hooks may change the spec
         with self._lock:
+            self._check_fence_locked(fence)
             key = self.key_of(kind, o)
             if key in self._objects[kind]:
                 raise KeyError(f"{kind} {key!r} already exists")
@@ -266,7 +315,8 @@ class ObjectStore:
     # true old/new pair to watchers (the aliasing alternative silently breaks
     # phase-transition detection in controllers).
 
-    def update(self, kind: str, o, skip_admission: bool = False):
+    def update(self, kind: str, o, skip_admission: bool = False,
+               fence: Optional[int] = None):
         key = self.key_of(kind, o)
         if not skip_admission:
             with self._lock:
@@ -279,6 +329,10 @@ class ObjectStore:
             derive(o)
         with self._lock:
             self._wait_key_writable_locked(kind, key)
+            # fence AFTER the barrier wait (which releases the lock): a
+            # takeover can happen while this writer queues behind an
+            # in-flight flush, and the stale write must not land then
+            self._check_fence_locked(fence)
             old = self._objects[kind].get(key)
             if old is None:
                 raise KeyError(f"{kind} {key!r} not found")
@@ -305,7 +359,8 @@ class ObjectStore:
                 w.on_delete(old)
         return o
 
-    def patch_batch(self, kind: str, patches, clone_fn=None) -> tuple:
+    def patch_batch(self, kind: str, patches, clone_fn=None,
+                    fence: Optional[int] = None) -> tuple:
         """Apply ``[(name, namespace, fn)]`` as one bulk commit: each fn
         mutates a fresh clone of the stored object, which becomes the new
         stored version (rv bump + journal entry each). ``clone_fn``
@@ -343,9 +398,9 @@ class ObjectStore:
             fn(new)
 
         return self._bulk_patch(kind, patches, clone_fn or fast_clone,
-                                apply_fn, None)
+                                apply_fn, None, fence=fence)
 
-    def bind_pods(self, bindings) -> tuple:
+    def bind_pods(self, bindings, fence: Optional[int] = None) -> tuple:
         """The bind-flush fast path: ``[(name, namespace, hostname)]`` →
         pod.spec.node_name patches through the same bulk engine as
         :meth:`patch_batch`, with the per-item closure replaced by a plain
@@ -374,13 +429,13 @@ class ObjectStore:
                                           rv_base + 1)
 
         return self._bulk_patch("pods", bindings, clone_pod_for_bind,
-                                apply_fn, batch_shard)
+                                apply_fn, batch_shard, fence=fence)
 
     def _shard_count(self, n: int) -> int:
         return min(self.SHARD_MAX, -(-n // self.SHARD_TARGET))
 
     def _bulk_patch(self, kind: str, items, clone_fn, apply_fn,
-                    batch_shard) -> tuple:
+                    batch_shard, fence: Optional[int] = None) -> tuple:
         """Bulk-commit engine behind patch_batch/bind_pods.
 
         ``items`` is [(name, namespace, payload)]; each applied item
@@ -433,6 +488,10 @@ class ObjectStore:
                 if self._inflight.get(kind):
                     self._flush_cond.wait_for(
                         lambda: not self._inflight.get(kind))
+                # after the wait: a takeover may have happened while this
+                # writer queued behind another flush — check at the last
+                # possible instant before anything is resolved/reserved
+                self._check_fence_locked(fence)
                 objs = self._objects[kind]
                 seen: set = set()
                 for name, namespace, payload in items:
@@ -630,7 +689,8 @@ class ObjectStore:
                     w.on_delete(old)
 
     def delete(self, kind: str, name: str, namespace: str = "default",
-               skip_admission: bool = False) -> int:
+               skip_admission: bool = False,
+               fence: Optional[int] = None) -> int:
         """Returns the deletion's resource version (remote mirrors dedup
         journal replays against it)."""
         key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
@@ -642,6 +702,8 @@ class ObjectStore:
             self._admit(kind, "DELETE", None, old_pre)   # outside the lock
         with self._lock:
             self._wait_key_writable_locked(kind, key)
+            # fence after the barrier wait — see update()
+            self._check_fence_locked(fence)
             old = self._objects[kind].get(key)
             if old is None:
                 raise KeyError(f"{kind} {key!r} not found")

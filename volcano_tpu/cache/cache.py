@@ -84,10 +84,18 @@ class SchedulerCache(EventHandlersMixin):
                  scheduler_name: str = DEFAULT_SCHEDULER_NAME,
                  default_queue: str = DEFAULT_QUEUE,
                  binder=None, evictor=None, status_updater=None,
-                 volume_binder=None):
+                 volume_binder=None, fence_source=None):
         self.store = store
         self.scheduler_name = scheduler_name
         self.default_queue = default_queue
+        # lease fencing (docs/design/failover.md): a zero-arg callable
+        # returning the leader's current fencing token (or None). When
+        # set, bind writes and gang-heal unbind patches are stamped with
+        # it, so a deposed incarnation's in-flight commits are rejected
+        # by the store (FencedError) instead of double-binding after a
+        # standby takes over. None (the default) leaves every write
+        # unstamped — the pre-failover behavior.
+        self.fence_source = fence_source
 
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
@@ -525,6 +533,17 @@ class SchedulerCache(EventHandlersMixin):
             snap.jobs[job.uid] = job.clone()
         return snap
 
+    def _current_fence(self):
+        """The fencing token to stamp on leader-scoped store writes (None
+        when fencing is not configured). Read per write batch: a token
+        that went stale mid-flight is exactly what the store must see."""
+        if self.fence_source is None:
+            return None
+        try:
+            return self.fence_source()
+        except Exception:
+            return None
+
     # -- find helpers ------------------------------------------------------
 
     def _find_job_and_task(self, task_info: TaskInfo):
@@ -559,6 +578,9 @@ class SchedulerCache(EventHandlersMixin):
 
         def do_bind():
             try:
+                fence = self._current_fence()
+                if fence is not None:
+                    self.binder.fence = fence
                 self.binder.bind(pod, hostname)
                 self.store.record_event(
                     "pods", pod, "Normal", "Scheduled",
@@ -758,6 +780,12 @@ class SchedulerCache(EventHandlersMixin):
         siblings unbound — before anything else observes the commit
         (cache.go:605-655 + docs/design/resilience.md)."""
         log = logging.getLogger(__name__)
+        fence = self._current_fence()
+        if fence is not None:
+            # stamp the binder for this batch: binders pass the token on
+            # their store writes (attribute-based so binder subclasses
+            # with legacy signatures keep working unstamped)
+            self.binder.fence = fence
         bind_all = getattr(self.binder, "bind_batch", None)
         if bind_all is not None:
             # hint the echo ingest: bulk deliveries arriving ON THIS
@@ -880,17 +908,24 @@ class SchedulerCache(EventHandlersMixin):
         def clear_node(p):
             p.spec.node_name = ""
 
+        fence = self._current_fence()
         patch_fn = getattr(self.store, "patch_batch", None)
         if patch_fn is not None:
+            kwargs = {"fence": fence} if fence is not None else {}
             patch_fn("pods", [(pod.metadata.name, pod.metadata.namespace,
-                               clear_node) for _, pod, _ in unbind])
+                               clear_node) for _, pod, _ in unbind],
+                     **kwargs)
         else:
             for _, pod, _ in unbind:
                 live = self.store.get("pods", pod.metadata.name,
                                       pod.metadata.namespace)
                 if live is not None:
                     live.spec.node_name = ""
-                    self.store.update("pods", live, skip_admission=True)
+                    if fence is not None:
+                        self.store.update("pods", live,
+                                          skip_admission=True, fence=fence)
+                    else:
+                        self.store.update("pods", live, skip_admission=True)
         for task, pod, hostname in unbind:
             self.store.record_event(
                 "pods", pod, "Warning", "GangUnbound",
@@ -1028,15 +1063,13 @@ class SchedulerCache(EventHandlersMixin):
     def _backoff_seconds(self, key: str, attempts: int) -> float:
         """Seeded-jitter exponential backoff for the Nth bind failure of
         one pod: deterministic for a fixed (key, attempt, seed) so two
-        sim runs from the same seed schedule identical retries."""
-        base = self.RESYNC_BACKOFF_BASE_SECONDS
-        if base <= 0.0:
-            return 0.0
-        delay = min(self.RESYNC_BACKOFF_CAP_SECONDS,
-                    base * (2.0 ** (attempts - 1)))
-        h = zlib.crc32(f"{key}:{attempts}:{self.RESYNC_JITTER_SEED}"
-                       .encode())
-        return delay * (0.5 + (h % 4096) / 8192.0)   # [0.5, 1.0) * delay
+        sim runs from the same seed schedule identical retries (the
+        shared formula in :mod:`volcano_tpu.utils.backoff`)."""
+        from ..utils.backoff import seeded_backoff
+        return seeded_backoff(key, attempts,
+                              self.RESYNC_BACKOFF_BASE_SECONDS,
+                              self.RESYNC_BACKOFF_CAP_SECONDS,
+                              seed=self.RESYNC_JITTER_SEED)
 
     def _record_bind_failure(self, task: TaskInfo, reason: str) -> None:
         """Bump the pod's retry record: schedule its re-placement backoff
@@ -1142,6 +1175,191 @@ class SchedulerCache(EventHandlersMixin):
                 self._add_task(new_task)
             except KeyError:
                 self.err_tasks.append(new_task)
+
+    # -- anti-entropy (docs/design/failover.md) ----------------------------
+
+    # kinds fingerprinted by the reconciler, in repair dependency order
+    # (pods reference nodes, so nodes repair first)
+    ANTI_ENTROPY_KINDS = ("nodes", "queues", "podgroups", "pods")
+
+    def _audit_store(self):
+        """The store the reconciler audits against: the in-process store
+        itself, or a RemoteStore's local mirror (its watch/resync loop
+        owns server truth; the cache's contract is to match the mirror
+        its watches are fed from). None disables the pass — no audit
+        surface at all."""
+        if hasattr(self.store, "list_refs"):
+            return self.store
+        return getattr(self.store, "mirror", None)
+
+    def _anti_entropy_views(self, kind: str, audit):
+        """(store_view, cache_view) as {key: (rv, obj)} for one kind.
+        Store side reads live refs (no clones — this is the audit path);
+        cache side walks the informer-fed maps. Pods are restricted to
+        this scheduler's schedulable pods (``_responsible_for`` + a
+        PodGroup link), matching exactly what the watch ingests into
+        ``jobs``. Caller holds ``self.mutex`` with applies drained."""
+        from ..models.job_info import get_job_id
+        store_view: Dict[str, tuple] = {}
+        cache_view: Dict[str, tuple] = {}
+        if kind == "pods":
+            for p in audit.list_refs("pods"):
+                if self._responsible_for(p) and get_job_id(p):
+                    store_view[p.metadata.key()] = (
+                        p.metadata.resource_version, p)
+            for job in self.jobs.values():
+                for t in job.tasks.values():
+                    cache_view[t.key()] = (
+                        t.pod.metadata.resource_version, t.pod)
+        elif kind == "nodes":
+            for n in audit.list_refs("nodes"):
+                store_view[n.metadata.name] = (n.metadata.resource_version,
+                                               n)
+            for name, node in self.nodes.items():
+                cache_view[name] = (node.node.metadata.resource_version,
+                                    node.node)
+        elif kind == "queues":
+            for q in audit.list_refs("queues"):
+                store_view[q.metadata.name] = (q.metadata.resource_version,
+                                               q)
+            for name, qi in self.queues.items():
+                cache_view[name] = (qi.queue.metadata.resource_version,
+                                    qi.queue)
+        elif kind == "podgroups":
+            for pg in audit.list_refs("podgroups"):
+                store_view[pg.metadata.key()] = (
+                    pg.metadata.resource_version, pg)
+            for job in self.jobs.values():
+                if job.pod_group is not None:
+                    cache_view[job.pod_group.metadata.key()] = (
+                        job.pod_group.metadata.resource_version,
+                        job.pod_group)
+        else:
+            raise ValueError(f"anti-entropy does not cover kind {kind!r}")
+        return store_view, cache_view
+
+    @staticmethod
+    def _fingerprint(view: Dict[str, tuple]) -> tuple:
+        """(count, max rv, crc32 of the sorted key@rv lines) — cheap to
+        compare, and any missed/extra/stale object perturbs it."""
+        crc = 0
+        max_rv = 0
+        for key in sorted(view):
+            rv = view[key][0]
+            crc = zlib.crc32(f"{key}@{rv}\n".encode(), crc)
+            if rv > max_rv:
+                max_rv = rv
+        return (len(view), max_rv, crc)
+
+    def _repair_kind(self, kind: str, store_view, cache_view) -> int:
+        """Relist repair for one diverged kind: feed the store's truth
+        back through the SAME handlers a live watch would have called
+        (informer full-relist semantics) — adds for misses, deletes for
+        strays, delete+add re-ingest for stale versions. Deterministic:
+        keys repair in sorted order. Caller holds ``self.mutex``."""
+        from ..utils.fastclone import fast_clone
+        handlers = {
+            "pods": (self.add_pod, self.update_pod,
+                     lambda obj: self.delete_pod(obj)),
+            "nodes": (self.add_node, self.update_node, self.delete_node),
+            "queues": (self.add_queue, self.update_queue,
+                       self.delete_queue),
+            "podgroups": (self.add_pod_group, self.update_pod_group,
+                          self.delete_pod_group),
+        }[kind]
+        add_fn, update_fn, delete_fn = handlers
+        repaired = 0
+        for key in sorted(set(cache_view) - set(store_view)):
+            try:
+                delete_fn(cache_view[key][1])
+                repaired += 1
+            except KeyError:
+                pass
+        for key in sorted(store_view):
+            rv, ref = store_view[key]
+            cached = cache_view.get(key)
+            try:
+                if cached is None:
+                    add_fn(fast_clone(ref))
+                    repaired += 1
+                elif cached[0] != rv:
+                    update_fn(cached[1], fast_clone(ref))
+                    repaired += 1
+            except KeyError:
+                # e.g. a pod bound to a node the cache hasn't ingested
+                # yet — the next pass (nodes repair first) converges it
+                continue
+        return repaired
+
+    def anti_entropy(self, repair: bool = True) -> dict:
+        """One cache<->store reconciliation pass: fingerprint every
+        covered kind, bump ``volcano_cache_divergence_total{kind}`` on
+        mismatch, and (with ``repair``) relist the diverged kinds in
+        place — the in-process form of the informer resync the remote
+        mirror runs on journal gaps. Returns a report dict and surfaces
+        last-check/last-repair on ``/debug/health`` (component
+        ``anti_entropy``).
+
+        Call between cycles with the executors flushed (the engine's
+        tick barrier, or the scheduler run loop's inter-cycle gap):
+        in-flight write-behind state is drained first, and a bind staged
+        but not yet committed does not perturb the fingerprints (the
+        cache-side pod keeps the store's resource_version until the
+        commit echoes back)."""
+        audit = self._audit_store()
+        if audit is None:
+            m.set_health("anti_entropy", True,
+                         "disabled: store exposes no audit surface")
+            return {"divergent": [], "repaired": 0, "checked": [],
+                    "skipped": True}
+        now = self.store.clock.now()
+        divergent: List[str] = []
+        repaired_total = 0
+        with self.mutex:
+            self._drain_applies_locked()
+            for kind in self.ANTI_ENTROPY_KINDS:
+                store_view, cache_view = self._anti_entropy_views(kind,
+                                                                  audit)
+                if self._fingerprint(store_view) == \
+                        self._fingerprint(cache_view):
+                    continue
+                divergent.append(kind)
+                m.inc(m.CACHE_DIVERGENCE, kind=kind)
+                if repair:
+                    self._state_version += 1
+                    if repaired_total == 0:
+                        # surface the failover window on /debug/pending
+                        # instead of a silently stale report
+                        from ..trace import pending as _pending
+                        _pending.publish_idle(
+                            _pending.REASON_CACHE_RESYNC,
+                            detail=f"anti-entropy repairing {kind}")
+                    repaired_total += self._repair_kind(
+                        kind, store_view, cache_view)
+        state = getattr(self, "anti_entropy_state", None) or {
+            "checks": 0, "repairs": 0, "objects_repaired": 0,
+            "last_check": None, "last_repair": None,
+            "last_divergent": []}
+        state["checks"] += 1
+        state["last_check"] = now
+        state["last_divergent"] = list(divergent)
+        if divergent and repair:
+            state["repairs"] += 1
+            state["objects_repaired"] += repaired_total
+            state["last_repair"] = now
+            logging.getLogger(__name__).warning(
+                "anti-entropy: cache diverged from the store on %s; "
+                "repaired %d object(s) via relist", divergent,
+                repaired_total)
+        self.anti_entropy_state = state
+        m.set_health(
+            "anti_entropy", True,
+            f"last-check @{state['last_check']}, last-repair "
+            f"@{state['last_repair']}, {state['repairs']} repair pass(es) "
+            f"/ {state['objects_repaired']} object(s) over "
+            f"{state['checks']} check(s)")
+        return {"divergent": divergent, "repaired": repaired_total,
+                "checked": list(self.ANTI_ENTROPY_KINDS)}
 
     # -- status writeback --------------------------------------------------
 

@@ -48,7 +48,8 @@ def _accepts_clone_fn(patch_fn) -> bool:
     return cached
 
 
-def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool) -> tuple:
+def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool,
+                    fence=None) -> tuple:
     """Shared engine behind StoreBinder/FakeBinder ``bind_batch``: one
     bulk store pass (``bind_pods`` when the store has it — the sharded,
     natively-cloned pipeline — else ``patch_batch`` with per-host patch
@@ -76,12 +77,15 @@ def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool) -> tuple:
                 failed.append((pod, hostname))
         return failed, False
 
+    # the leader's fencing token rides every store write form (kwarg
+    # passed only when set, so stores without fencing keep working)
+    fence_kw = {"fence": fence} if fence is not None else {}
     if bind_fn is not None:
         # payload-based fast path: no per-pod closures to build, and the
         # store can promote whole shards into fastmodel.bind_clone_pods
         _, missing_keys = bind_fn(
             [(pod.metadata.name, pod.metadata.namespace, hostname)
-             for pod, hostname in items])
+             for pod, hostname in items], **fence_kw)
     else:
         def setter(host):
             def fn(p):
@@ -95,6 +99,7 @@ def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool) -> tuple:
         from ..models.objects import clone_pod_for_bind
         kwargs = {"clone_fn": clone_pod_for_bind} \
             if _accepts_clone_fn(patch_fn) else {}
+        kwargs.update(fence_kw)
         # hosts repeat heavily (a 10k-node burst carries ~5 pods per
         # node): one closure per distinct host, not per pod
         setters: dict = {}
@@ -112,23 +117,35 @@ def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool) -> tuple:
 
 class StoreBinder:
     """Default binder: writes pod.spec.node_name through the object store
-    (the standalone equivalent of POST .../binding, cache.go:214-230)."""
+    (the standalone equivalent of POST .../binding, cache.go:214-230).
+
+    ``fence`` (attribute, set by the cache per write batch when lease
+    fencing is configured) stamps the store writes with the leader's
+    fencing token — a deposed incarnation's binds are rejected with
+    ``FencedError`` instead of landing after a takeover."""
 
     def __init__(self, store):
         self.store = store
+        self.fence = None
 
     def bind(self, pod: Pod, hostname: str) -> None:
         live = self.store.get("pods", pod.metadata.name, pod.metadata.namespace)
         if live is None:
             raise KeyError(f"pod {pod.metadata.key()} not found")
         live.spec.node_name = hostname
-        self.store.update("pods", live, skip_admission=True)
+        fence = getattr(self, "fence", None)
+        if fence is not None:
+            self.store.update("pods", live, skip_admission=True,
+                              fence=fence)
+        else:
+            self.store.update("pods", live, skip_admission=True)
 
     def bind_batch(self, items) -> list:
         """Batched bind; see :func:`bind_pods_batch`. Returns the failed
         [(pod, hostname)] for the caller to resync."""
         failed, _ = bind_pods_batch(self.store, items, self.bind,
-                                    type(self).bind is StoreBinder.bind)
+                                    type(self).bind is StoreBinder.bind,
+                                    fence=getattr(self, "fence", None))
         return failed
 
 

@@ -59,6 +59,11 @@ def run_scheduler(store: ObjectStore, args) -> Scheduler:
             store, identity, lease_name="vc-scheduler",
             on_started_leading=scheduler.start,
             on_stopped_leading=scheduler.stop)
+        # lease fencing (docs/design/failover.md): run_once no-ops while
+        # standby, and bind/patch writes carry the elector's token so a
+        # deposed incarnation can't commit after a takeover
+        scheduler.elector = elector
+        scheduler.cache.fence_source = lambda: elector.fencing_token
         elector.start()
     else:
         scheduler.start()

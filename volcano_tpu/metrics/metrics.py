@@ -80,6 +80,13 @@ GANG_HEALS = f"{NS}_gang_heal_total"
 CYCLE_DEADLINE_EXCEEDED = f"{NS}_cycle_deadline_exceeded_total"
 SOLVER_FALLBACK = f"{NS}_solver_fallback_total"
 SOLVER_BREAKER_OPEN = f"{NS}_solver_breaker_open"
+# control-plane failover (docs/design/failover.md): writes rejected for a
+# superseded fencing token, cache-vs-store anti-entropy divergences by
+# kind, remote-store transient write retries, and watch-stream restarts
+FENCED_WRITES = f"{NS}_fenced_writes_total"
+CACHE_DIVERGENCE = f"{NS}_cache_divergence_total"
+STORE_WRITE_RETRIES = f"{NS}_store_write_retries_total"
+WATCH_RESTARTS = f"{NS}_watch_restarts_total"
 
 # component health registry behind /debug/health: a component absent from
 # the registry is healthy by default; the watchdog (scheduler.py) flips

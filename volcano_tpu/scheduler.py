@@ -32,6 +32,12 @@ class Scheduler:
     # scheduling decisions stay bit-reproducible.
     WATCHDOG_MULTIPLE = 4.0
 
+    # anti-entropy cadence in the threaded run() loop: one cache<->store
+    # fingerprint pass (docs/design/failover.md) every N cycles, in the
+    # inter-cycle gap. 0 disables. The simulator paces its own passes at
+    # the tick barrier instead.
+    ANTI_ENTROPY_EVERY_CYCLES = 60
+
     def __init__(self, store: ObjectStore,
                  scheduler_name: str = DEFAULT_SCHEDULER_NAME,
                  scheduler_conf: Optional[str] = None,
@@ -39,7 +45,9 @@ class Scheduler:
                  schedule_period: float = 1.0,
                  cache: Optional[SchedulerCache] = None,
                  clock: Optional[Clock] = None,
-                 watchdog_multiple: Optional[float] = None):
+                 watchdog_multiple: Optional[float] = None,
+                 elector=None,
+                 anti_entropy_every: Optional[int] = None):
         self.store = store
         # time-dependent scheduling decisions (sla waiting windows, ...)
         # read this clock via the session (run_once passes it into
@@ -53,6 +61,18 @@ class Scheduler:
         self.watchdog_multiple = (watchdog_multiple
                                   if watchdog_multiple is not None
                                   else self.WATCHDOG_MULTIPLE)
+        # leader election + fencing (docs/design/failover.md): with an
+        # elector attached, run_once is a no-op while standby (the
+        # /debug/pending report says so explicitly), and the cache stamps
+        # its bind/patch writes with the elector's fencing token so a
+        # deposed incarnation can't write after a takeover.
+        self.elector = elector
+        if elector is not None and \
+                getattr(self.cache, "fence_source", None) is None:
+            self.cache.fence_source = lambda: elector.fencing_token
+        self.anti_entropy_every = (anti_entropy_every
+                                   if anti_entropy_every is not None
+                                   else self.ANTI_ENTROPY_EVERY_CYCLES)
         self.degraded = False
         self.cycle_deadline_exceeded = 0
         self._conf_path = scheduler_conf_path
@@ -109,6 +129,16 @@ class Scheduler:
         between cycles in :meth:`run`."""
         from .trace import tracer as tr
         from .utils import gcguard
+        if self.elector is not None and not self.elector.is_leader:
+            # standby: scheduling is the leader's job. Surface the reason
+            # on /debug/pending instead of silently doing nothing — the
+            # exact failover window operators page on.
+            from .trace import pending
+            pending.publish_idle(
+                pending.REASON_NOT_LEADER,
+                detail=f"candidate {self.elector.identity!r} is waiting "
+                       f"on the lease")
+            return
         start = time.perf_counter()
         with self._mutex:
             conf = self.conf
@@ -184,6 +214,7 @@ class Scheduler:
         # garbage, not to cluster size
         gc.collect()
         gc.freeze()
+        cycles = 0
         while not self._stop.is_set():
             cycle_start = time.monotonic()
             try:
@@ -192,6 +223,17 @@ class Scheduler:
                 # a transient failure (e.g. a status-writeback conflict) must
                 # not kill the scheduling thread; next cycle resyncs
                 log.exception("scheduling cycle failed; retrying next period")
+            cycles += 1
+            if self.anti_entropy_every and \
+                    cycles % self.anti_entropy_every == 0:
+                try:
+                    # inter-cycle gap: executors may still be draining a
+                    # flush; the pass tolerates staged-but-uncommitted
+                    # binds (rv-based fingerprints, see cache.anti_entropy)
+                    self.cache.anti_entropy()
+                except Exception:
+                    log.exception("anti-entropy pass failed; next "
+                                  "interval retries")
             gc.collect(0)   # reap cycle-garbage with true ref cycles
             elapsed = time.monotonic() - cycle_start
             self._stop.wait(max(0.0, self.schedule_period - elapsed))

@@ -83,6 +83,20 @@ def add_sim_parser(sub) -> None:
     chaos.add_argument("--nodes", type=int, default=128)
     chaos.add_argument("--json", action="store_true")
 
+    failover = sim.add_parser(
+        "failover", help="CI gate: control-plane chaos — a leader-lease "
+                         "lapse with a mid-flush crash, scheduler kills "
+                         "(stateless + snapshot restart), watch-delivery "
+                         "drops and 2%% bind failures together; asserts "
+                         "zero invariant violations, >=1 fenced write "
+                         "rejection, >=1 anti-entropy repair, the "
+                         "standby why-pending reason, and a bit-"
+                         "identical double run")
+    failover.add_argument("--seed", type=int, default=29)
+    failover.add_argument("--ticks", type=int, default=120)
+    failover.add_argument("--nodes", type=int, default=128)
+    failover.add_argument("--json", action="store_true")
+
     rep = sim.add_parser("replay", help="re-run a violation repro bundle")
     rep.add_argument("--bundle", required=True)
     rep.add_argument("--use-trace", action="store_true",
@@ -176,6 +190,60 @@ def chaos_config(seed: int = 13, ticks: int = 120, nodes: int = 128):
             seed=seed, bind_fail_rate=0.02, api_latency_s=0.001,
             fail_pods=[POISON_POD]),
         fail_rate=0.0,
+        repro_dir=".")
+
+
+def failover_config(seed: int = 29, ticks: int = 120, nodes: int = 128):
+    """The `make failover-smoke` shape (docs/design/failover.md): a
+    resident gang backlog plus a Poisson stream under leader election on
+    the virtual clock, with ALL the control-plane failure modes scripted
+    into one run:
+
+    * tick 30 — ``leader_lapse`` with a mid-flush crash: the leader dies
+      5 binds into its flush without releasing the lease; a fresh
+      candidate waits out the 5s lease (why-pending says "standby"),
+      takes over with a bumped fencing token, and the deposed
+      incarnation's leftover write MUST be rejected (``FencedError``);
+    * tick 60 — ``scheduler_kill`` (stateless) mid-flush: same-identity
+      restart rebuilds the cache from the surviving store;
+    * tick 85 — ``scheduler_kill`` (snapshot): the whole store is
+      checkpointed via persistence.save_store and restored into a fresh
+      one (journal cleared + sequencer re-anchored), the etcd-restore
+      drill;
+    * throughout — 2% bind-failure injection AND 2% watch-delivery drops
+      (FlakyWatch), with anti-entropy every tick so each dropped
+      delivery is detected and repaired before that tick's audit.
+
+    ``gang_converge_ticks`` widens to lease+5: a gang left partial by
+    the mid-flush crash cannot converge before the standby wins the
+    lease — the checker still requires convergence, just within the
+    whole failover window instead of the usual 2 ticks. Node churn and
+    storms stay off so every partial gang the audit sees comes from the
+    crash/fencing path."""
+    from .engine import SimConfig
+    from .faults import FaultConfig
+    from .workload import WorkloadConfig
+    lease_s = 5.0
+    return SimConfig(
+        seed=seed, ticks=ticks, tick_s=1.0, n_nodes=nodes,
+        node_cpu="16", node_mem="32Gi",
+        resident_jobs=64, resident_gang=8,
+        workload=WorkloadConfig(
+            seed=seed, horizon_s=float(ticks), arrival_rate=0.3,
+            duration_min_s=20.0, duration_max_s=120.0),
+        faults=FaultConfig(
+            seed=seed, bind_fail_rate=0.02, api_latency_s=0.001,
+            watch_drop_rate=0.02),
+        fail_rate=0.0,
+        elections=True, lease_s=lease_s,
+        gang_converge_ticks=int(lease_s) + 5,
+        anti_entropy_every_ticks=1,
+        control_events=[
+            {"at": 30.0, "kind": "leader_lapse", "mid_flush_binds": 5},
+            {"at": 60.0, "kind": "scheduler_kill", "mode": "stateless",
+             "mid_flush_binds": 3},
+            {"at": 85.0, "kind": "scheduler_kill", "mode": "snapshot"},
+        ],
         repro_dir=".")
 
 
@@ -289,6 +357,56 @@ def dispatch_sim(args) -> int:
             for name, ok in checks.items():
                 print(f"  {name}: {'ok' if ok else 'FAIL'}")
             print(f"chaos-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
+        return 0 if verdict["pass"] else 1
+
+    if args.verb == "failover":
+        from ..framework.solver import reset_breaker
+        from ..trace.pending import REASON_NOT_LEADER
+        reset_breaker()
+        r1 = run_sim(failover_config(seed=args.seed, ticks=args.ticks,
+                                     nodes=args.nodes))
+        reset_breaker()
+        r2 = run_sim(failover_config(seed=args.seed, ticks=args.ticks,
+                                     nodes=args.nodes))
+        checks = {
+            # the rebuilt/restored control planes satisfied the whole
+            # catalog every audited tick — crash-left partial gangs
+            # reconverged, journal stayed gap-free, no silent rebinds
+            "no_violations": not r1.violations and not r2.violations,
+            "restarts_ran": r1.restarts == 3,
+            # the deposed incarnation's stale-token write was rejected
+            "fenced_write_rejected": r1.fenced_writes >= 1,
+            # FlakyWatch diverged the cache and anti-entropy repaired it
+            "divergence_repaired": r1.divergence_repairs >= 1
+                                   and r1.watch_drops >= 1,
+            # the standby window said WHY nothing was being scheduled
+            "standby_reason_surfaced":
+                REASON_NOT_LEADER in r1.pending_reasons_seen,
+            "bind_failures_fired": r1.resync_retries > 0
+                                   and bool(r1.bind_sequence),
+            "deterministic_replay":
+                r1.bind_fingerprint() == r2.bind_fingerprint()
+                and r1.fenced_writes == r2.fenced_writes
+                and r1.divergence_repairs == r2.divergence_repairs
+                and r1.restarts == r2.restarts
+                and r1.resync_retries == r2.resync_retries,
+        }
+        verdict = {
+            "failover": r1.summary(),
+            "checks": checks,
+            "pass": all(checks.values()),
+        }
+        if args.json:
+            print(json.dumps(verdict, indent=1))
+        else:
+            _print_summary(r1.summary(), False)
+            print(f"restarts: {r1.restarts}  fenced writes: "
+                  f"{r1.fenced_writes}  divergence repairs: "
+                  f"{r1.divergence_repairs}  watch drops: "
+                  f"{r1.watch_drops}")
+            for name, ok in checks.items():
+                print(f"  {name}: {'ok' if ok else 'FAIL'}")
+            print(f"failover-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
         return 0 if verdict["pass"] else 1
 
     if args.verb == "replay":

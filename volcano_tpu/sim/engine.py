@@ -86,7 +86,10 @@ class SimConfig:
     # gang-atomicity convergence window (invariants.py): audited ticks a
     # gang may sit partially bound before violating. Bind failures heal
     # within their own flush, so this is slack for multi-tick cascades
-    # (a heal racing a storm), not a waiver.
+    # (a heal racing a storm), not a waiver. Failover scenarios widen it
+    # to cover the leaderless window of a lease handover: a gang left
+    # partial by a mid-flush crash cannot converge before a standby wins
+    # the lease and schedules again.
     gang_converge_ticks: int = 2
     trace_path: Optional[str] = None      # replay this JSONL instead of
     #                                       synthesizing workload/faults
@@ -94,6 +97,20 @@ class SimConfig:
     stop_on_violation: bool = True
     repro_dir: Optional[str] = None       # where violation bundles land
     flush_timeout_s: float = 120.0
+    # control-plane failover (docs/design/failover.md): run the scheduler
+    # under leader election on the virtual clock (lease fencing on every
+    # bind/patch write), with scheduler_kill / leader_lapse control
+    # events driving crash/restart and handover
+    elections: bool = False
+    lease_s: float = 5.0
+    # cache<->store anti-entropy cadence in ticks (0 = off). The default
+    # rides along every run so bench --sim measures steady state WITH
+    # the reconciler on; failover scenarios drop it to 1 so a dropped
+    # watch delivery is repaired before the same tick's invariant audit.
+    anti_entropy_every_ticks: int = 10
+    # extra scheduled events injected verbatim (the failover scenario's
+    # scripted kills/lapses ride the same replayable stream as arrivals)
+    control_events: List[dict] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -132,6 +149,18 @@ class SimResult:
         # lifetime bind-failure resyncs, and the quarantined pod keys
         self.resync_retries = 0
         self.quarantined: List[str] = []
+        # failover counters (docs/design/failover.md): scheduler
+        # crash/restarts performed, writes the store rejected for a
+        # stale fencing token, objects the anti-entropy pass repaired,
+        # watch deliveries dropped/delayed by FlakyWatch, and every
+        # why-pending reason observed during the run (the standby window
+        # must surface "scheduler not leader", not silence)
+        self.restarts = 0
+        self.fenced_writes = 0
+        self.divergence_repairs = 0
+        self.watch_drops = 0
+        self.watch_delays = 0
+        self.pending_reasons_seen: set = set()
 
     def bind_fingerprint(self) -> str:
         h = hashlib.sha256()
@@ -160,6 +189,11 @@ class SimResult:
             "bind_fingerprint": self.bind_fingerprint(),
             "resync_retries": self.resync_retries,
             "quarantined": list(self.quarantined),
+            "restarts": self.restarts,
+            "fenced_writes": self.fenced_writes,
+            "divergence_repairs": self.divergence_repairs,
+            "watch_drops": self.watch_drops,
+            "pending_reasons_seen": sorted(self.pending_reasons_seen),
             "cycle_ms": self.cycle_ms_percentiles(),
             "violations": [
                 {"tick": t, "invariant": v.invariant, "detail": v.detail}
@@ -185,10 +219,24 @@ class SimEngine:
                                   seed=cfg.faults.seed,
                                   fail_pods=cfg.faults.fail_pods)
         self.evictor = FakeEvictor(self.store)
-        self.cache = SchedulerCache(self.store, binder=self.binder,
-                                    evictor=self.evictor)
-        self.scheduler = Scheduler(self.store, scheduler_conf=cfg.conf_text,
-                                   cache=self.cache, clock=self.clock)
+        # failover state (docs/design/failover.md): the scheduler's
+        # current elector incarnation, the deposed incarnation's token
+        # awaiting its fence probe, a pending restart request from a
+        # kill/lapse event, and accumulators that survive cache/store
+        # swaps
+        self.elector = None
+        self._elector_seq = 0
+        self._probe_token: Optional[int] = None
+        self._pending_restart: Optional[dict] = None
+        self._resync_base = 0
+        self._fenced_base = 0
+        self._flaky_watch = None
+        self._bind_ledger: Dict[str, str] = {}
+        if cfg.elections:
+            self.elector = self._make_elector()
+        self.cache: SchedulerCache = None
+        self.scheduler: Scheduler = None
+        self._build_scheduler()
         self.queue = EventQueue()
         self.result = SimResult()
         # job key -> its arrival event (duration/outcome live there)
@@ -202,6 +250,146 @@ class SimEngine:
         # gang-atomicity convergence streaks (invariants.py): persists
         # across per-tick CycleContexts
         self._partial_streaks: Dict[str, int] = {}
+
+    # -- control-plane lifecycle (docs/design/failover.md) -----------------
+
+    def _make_elector(self, identity: Optional[str] = None):
+        """A fresh elector INCARNATION (its first acquisition always
+        bumps the fencing token, even when re-taking its own lease —
+        restarted processes must fence their previous selves).
+        Deterministic identities: sched-<seq>."""
+        from ..utils.leaderelection import LeaderElector
+        if identity is None:
+            identity = f"sched-{self._elector_seq}"
+            self._elector_seq += 1
+        return LeaderElector(self.store, identity, lease_name="vc-sim",
+                             lease_duration=self.cfg.lease_s,
+                             clock=self.clock)
+
+    def _build_scheduler(self) -> None:
+        """(Re)build the scheduler half of the control plane against the
+        current store — the stateless-restart shape: a brand-new cache
+        rebuilds from watches, retry/quarantine state is deliberately
+        NOT carried over (docs/design/resilience.md), and bind writes
+        are fenced with the current elector incarnation's token."""
+        elector = self.elector
+        fence_source = (lambda: elector.fencing_token) \
+            if elector is not None else None
+        self.cache = SchedulerCache(self.store, binder=self.binder,
+                                    evictor=self.evictor,
+                                    fence_source=fence_source)
+        self.scheduler = Scheduler(self.store,
+                                   scheduler_conf=self.cfg.conf_text,
+                                   cache=self.cache, clock=self.clock,
+                                   elector=elector, anti_entropy_every=0)
+
+    def _install_watch_faults(self) -> None:
+        f = self.cfg.faults
+        if f.watch_drop_rate <= 0 and f.watch_delay_rate <= 0:
+            return
+        if self._flaky_watch is None:
+            from .faults import FlakyWatch
+            self._flaky_watch = FlakyWatch(seed=f.seed,
+                                           drop_rate=f.watch_drop_rate,
+                                           delay_rate=f.watch_delay_rate)
+        for w in self.cache._watches:
+            if w.kind == "pods":
+                self._flaky_watch.wrap(w)
+                return
+
+    def _election_step(self) -> None:
+        if self.elector is None:
+            return
+        was_leader = self.elector.is_leader
+        self.elector.step()
+        if self.elector.is_leader and not was_leader and \
+                self._probe_token is not None:
+            self._probe_deposed_write(self._probe_token)
+            self._probe_token = None
+
+    def _probe_deposed_write(self, token: int) -> None:
+        """Replay the deposed incarnation's leftover in-flight write the
+        instant a new incarnation takes over: a no-op pod patch stamped
+        with the OLD token. The store must reject it (FencedError — the
+        whole point of lease fencing); if it ever lands, the fenced-write
+        counter stays flat and the failover gate fails loudly."""
+        from ..apiserver.store import FencedError
+        keys = sorted(p.metadata.key() for p in self.store.list_refs("pods"))
+        if not keys:
+            return
+        ns, name = keys[0].split("/", 1)
+
+        def noop(p):
+            pass
+
+        try:
+            self.store.patch_batch("pods", [(name, ns, noop)], fence=token)
+            log.error("deposed-leader probe write with stale token %d was "
+                      "NOT fenced", token)
+        except FencedError:
+            pass   # store.fenced_writes counted it
+
+    def _restart_scheduler(self) -> None:
+        """Kill + restart the scheduler at the tick barrier: the old
+        cache (with whatever it believed about in-flight binds) is
+        discarded exactly as a process death would, and a fresh one
+        rebuilds from the surviving store — or, in snapshot mode, from a
+        persistence.save_store checkpoint restored into a fresh store
+        (the etcd-restore drill). The restarted incarnation's first
+        acquisition bumps the fencing token, shutting the old
+        incarnation out of the store."""
+        info, self._pending_restart = self._pending_restart, None
+        self.binder.crashed = False
+        self.binder.crash_after_binds = None
+        self.result.restarts += 1
+        self._resync_base += self.cache.resync_retry_total
+        self.scheduler.stop()
+        if self._flaky_watch is not None:
+            self._flaky_watch.unwrap()
+        self.cache.stop()
+        old_token = self.elector.fencing_token \
+            if self.elector is not None else None
+        if info.get("mode") == "snapshot":
+            self._swap_store_from_snapshot()
+        if self.elector is not None:
+            self._probe_token = old_token
+            if info.get("handover"):
+                # the lease was never released: a NEW candidate identity
+                # must wait out the old lease before leading (the
+                # standby window run_once reports on /debug/pending)
+                self.elector = self._make_elector()
+            else:
+                # same identity, new incarnation: re-acquires its own
+                # lease immediately, with a bumped token
+                self.elector = self._make_elector(self.elector.identity)
+        self._build_scheduler()
+        self.cache.run()
+        self._install_watch_faults()
+        log.warning("scheduler restarted (mode=%s, handover=%s)",
+                    info.get("mode", "stateless"),
+                    bool(info.get("handover")))
+
+    def _swap_store_from_snapshot(self) -> None:
+        import os
+        import tempfile
+
+        from ..apiserver.persistence import load_store, save_store
+        fd, path = tempfile.mkstemp(prefix="sim-failover-", suffix=".json")
+        os.close(fd)
+        try:
+            save_store(self.store, path)
+            new_store = ObjectStore(clock=self.clock)
+            load_store(path, store=new_store)
+        finally:
+            os.unlink(path)
+        # the fence floor is in-memory state: it re-derives from the
+        # lease's persisted token at the next acquisition, but carrying
+        # it across the swap closes the window in between
+        new_store.advance_fence(self.store.fence_floor())
+        self._fenced_base += self.store.fenced_writes
+        self.store = new_store
+        self.binder.store = new_store
+        self.evictor.store = new_store
 
     # -- setup -------------------------------------------------------------
 
@@ -218,6 +406,8 @@ class SimEngine:
             node_names = [f"node-{i}" for i in range(cfg.n_nodes)]
             events += synthesize_node_churn(cfg.faults, node_names, horizon)
             events += synthesize_evict_storms(cfg.faults, horizon)
+        for spec in cfg.control_events:
+            events.append(Event(spec))
         for e in events:
             self.queue.push(e)
 
@@ -340,6 +530,32 @@ class SimEngine:
         if "fail_pods" in e:
             self.binder.fail_pods = set(e["fail_pods"])
 
+    def _ev_scheduler_kill(self, e: Event) -> None:
+        """Crash the scheduler this tick: with ``mid_flush_binds`` the
+        binder dies partway through the tick's bind flush (the store
+        keeps the committed prefix — partial gangs included); the
+        restart itself runs at the tick barrier, ``mode`` choosing
+        stateless (rebuild from the surviving store) or snapshot
+        (save_store -> fresh store -> restore). Same identity re-leads
+        immediately with a bumped fencing token."""
+        if "mid_flush_binds" in e:
+            self.binder.crash_after_binds = int(e["mid_flush_binds"])
+        self._pending_restart = {"mode": e.get("mode", "stateless"),
+                                 "handover": False}
+
+    def _ev_leader_lapse(self, e: Event) -> None:
+        """The leader process dies WITHOUT releasing its lease (crash,
+        zombie GC pause): its final flush can die midway like a kill,
+        but the replacement runs as a fresh candidate identity that must
+        wait out the lease — the standby window — and the deposed
+        incarnation's leftover write is probed against the fence at
+        takeover. Requires elections; degrades to a plain kill without
+        them."""
+        if "mid_flush_binds" in e:
+            self.binder.crash_after_binds = int(e["mid_flush_binds"])
+        self._pending_restart = {"mode": e.get("mode", "stateless"),
+                                 "handover": self.elector is not None}
+
     @staticmethod
     def _job_of_pod(pod_name: str) -> str:
         # pod names are "<job>-<index>" by construction
@@ -403,11 +619,19 @@ class SimEngine:
         cfg = self.cfg
         trace_was_on = tracer.is_enabled()
         tracer.enable()
+        tracer.set_pending_report(None)   # a previous run's report must
+        #                                   not leak into reasons_seen
         try:
             self._create_base()
+            self._install_watch_faults()
             self._seed_events()
+            if self.elector is not None:
+                self._election_step()   # first incarnation takes the lease
             for tick in range(cfg.ticks):
                 self.clock.advance(cfg.tick_s)
+                if self._flaky_watch is not None:
+                    self._flaky_watch.release_delayed()
+                self._election_step()
                 events = self.queue.pop_until(self.clock.now())
                 for e in events:
                     self._apply(e)
@@ -430,6 +654,24 @@ class SimEngine:
                 # that, with a small convergence window instead of a
                 # waiver
                 new_binds = self._collect_binds()
+                rep = tracer.pending_report()
+                if rep:
+                    self.result.pending_reasons_seen.update(
+                        (rep.get("reasons") or {}).keys())
+                # restart BEFORE the audit: the rebuilt (or restored)
+                # control plane is what must satisfy the invariants —
+                # including any partial gangs its predecessor's crashed
+                # flush left in the store
+                if self._pending_restart is not None or \
+                        self.binder.crashed:
+                    if self._pending_restart is None:
+                        self._pending_restart = {"mode": "stateless",
+                                                 "handover": False}
+                    self._restart_scheduler()
+                if cfg.anti_entropy_every_ticks > 0 and \
+                        tick % cfg.anti_entropy_every_ticks == 0:
+                    ae = self.cache.anti_entropy()
+                    self.result.divergence_repairs += ae["repaired"]
                 violations: List[Violation] = []
                 if cfg.check_invariants:
                     ctx = CycleContext(
@@ -438,7 +680,8 @@ class SimEngine:
                         ever_ready=self._ever_ready,
                         queues_over_before=queues_over,
                         gang_converge_ticks=cfg.gang_converge_ticks,
-                        partial_streaks=self._partial_streaks)
+                        partial_streaks=self._partial_streaks,
+                        bind_ledger=self._bind_ledger)
                     violations = check_all(ctx)
                     # ever_ready updates AFTER the check: a gang must be
                     # complete the first tick it shows up allocated
@@ -467,8 +710,17 @@ class SimEngine:
                             cfg.repro_dir, self, tick, violations))
                     if cfg.stop_on_violation:
                         break
-            self.result.resync_retries = self.cache.resync_retry_total
+            self.result.resync_retries = self._resync_base + \
+                self.cache.resync_retry_total
+            # quarantine/backoff state is stateless-rebuild scoped by
+            # design (docs/design/resilience.md): only the CURRENT
+            # incarnation's quarantine set is reported
             self.result.quarantined = sorted(self.cache.quarantined)
+            self.result.fenced_writes = self._fenced_base + \
+                self.store.fenced_writes
+            if self._flaky_watch is not None:
+                self.result.watch_drops = self._flaky_watch.dropped
+                self.result.watch_delays = self._flaky_watch.delayed
             return self.result
         finally:
             if not trace_was_on:

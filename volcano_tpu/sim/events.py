@@ -25,6 +25,14 @@ Kinds interpreted by the engine:
                    it (lost VM)
 ``evict_storm``    fraction, seed — delete that fraction of bound pods
 ``fault_set``      bind_fail_rate, api_latency_s — retune live injection
+``scheduler_kill`` mode ("stateless"|"snapshot"), mid_flush_binds —
+                   crash the scheduler (optionally partway through its
+                   bind flush) and restart it at the tick barrier
+                   (docs/design/failover.md)
+``leader_lapse``   mode, mid_flush_binds — the leader dies WITHOUT
+                   releasing its lease; a fresh candidate identity waits
+                   out the lease before leading, and the deposed
+                   incarnation's leftover write is fenced at takeover
 """
 
 from __future__ import annotations

@@ -1,13 +1,24 @@
 """Fault injection for the simulator: flaky binds, API latency, node
-churn schedules, evict storms.
+churn schedules, evict storms, watch-delivery faults, and mid-flush
+scheduler crashes.
 
-Two layers:
+Three layers:
 
 * **Live injectors** — :class:`FlakyBinder` wraps the recording binder
   with a seeded per-bind failure coin and a virtual-clock latency charge;
   failures take the production resync path (cache.resync_task →
   process_resync_tasks), which is exactly the machinery the simulator
-  exists to stress.
+  exists to stress. Its crash mode (:attr:`FlakyBinder.crash_after_binds`)
+  commits a PREFIX of a flush and then dies, modeling a scheduler killed
+  mid bind-flush — the store is left with partially bound gangs for the
+  restarted scheduler to reconverge (docs/design/failover.md).
+* **Watch faults** — :class:`FlakyWatch` wraps a subscriber's registered
+  store watch and silently drops (or delays by one tick) a content-keyed
+  fraction of deliveries, diverging the cache from the store exactly the
+  way a lossy informer stream would; the anti-entropy reconciler
+  (cache.anti_entropy) must detect and repair it. ``force_gap`` clears
+  the store journal — the remote-watch "window rolled past" failure that
+  forces a relist.
 * **Scheduled faults** — :func:`synthesize_node_churn` /
   :func:`synthesize_evict_storms` emit plain events (drain/undrain,
   kill/re-add, storms) from a seeded RNG so they ride the same replayable
@@ -17,12 +28,21 @@ Two layers:
 from __future__ import annotations
 
 import random
+import zlib
 from dataclasses import dataclass, field
-from typing import List
+from typing import List, Optional
 
 from ..utils.clock import Clock
 from ..utils.test_utils import FakeBinder
 from .events import Event, make_event
+
+
+class SimulatedCrash(RuntimeError):
+    """The injected scheduler death: raised by FlakyBinder's crash mode
+    after a flush's prefix committed. Deliberately a batch-LEVEL error
+    (raised from bind_batch, not per pod) so the dying cache takes the
+    batch-failure path — resync-and-return, NO gang healing: a crashed
+    process doesn't get to run compensation writes."""
 
 
 @dataclass
@@ -42,6 +62,11 @@ class FaultConfig:
     # evict storms
     storm_rate: float = 0.0          # storms per virtual second
     storm_fraction: float = 0.1      # fraction of bound pods deleted
+    # watch-delivery faults (FlakyWatch over the cache's pod watch):
+    # content-keyed per-delivery probabilities of a silent drop / a
+    # one-tick delay — divergence for the anti-entropy pass to repair
+    watch_drop_rate: float = 0.0
+    watch_delay_rate: float = 0.0
 
 
 class FlakyBinder(FakeBinder):
@@ -69,6 +94,13 @@ class FlakyBinder(FakeBinder):
         self._rng = random.Random(seed ^ 0x5EED)
         self.failed_keys: List[str] = []
         self.attempts = 0
+        # crash mode (docs/design/failover.md): when armed, the NEXT
+        # bind_batch commits only its first `crash_after_binds` pods and
+        # then raises SimulatedCrash — the scheduler died mid-flush,
+        # leaving partial gangs in the store. `crashed` tells the engine
+        # to perform the restart at its tick barrier.
+        self.crash_after_binds: Optional[int] = None
+        self.crashed = False
         # latency is ACCUMULATED here and charged to the clock by the
         # engine at the tick boundary (after the executor flush), never
         # from the executor thread: a mid-cycle clock mutation would
@@ -95,6 +127,153 @@ class FlakyBinder(FakeBinder):
             self.failed_keys.append(key)
             raise RuntimeError(f"injected bind failure for {key}")
         super().bind(pod, hostname)
+
+    def bind_batch(self, items) -> list:
+        """Per-pod delegation through :meth:`bind` (failure injection
+        keeps its coin order), with the crash mode layered on top: an
+        armed crash commits only the burst's prefix, then raises —
+        batch-level, so the dying cache resyncs WITHOUT healing (a dead
+        process runs no compensation writes; the store keeps the partial
+        gangs the restarted scheduler must reconverge)."""
+        items = list(items)
+        if self.crash_after_binds is not None:
+            n = max(0, int(self.crash_after_binds))
+            self.crash_after_binds = None
+            self.crashed = True
+            prefix = items[:n]
+            if prefix:
+                super().bind_batch(prefix)
+            raise SimulatedCrash(
+                f"scheduler killed mid-flush: {len(prefix)} of "
+                f"{len(items)} binds committed")
+        return super().bind_batch(items)
+
+
+class FlakyWatch:
+    """Seeded watch-delivery fault injector (docs/design/failover.md).
+
+    Wraps ONE registered store :class:`~volcano_tpu.apiserver.store.Watch`
+    (typically the cache's pod watch) so a deterministic fraction of
+    deliveries is silently dropped, or delayed until the engine's next
+    tick — the informer-stream loss/reorder failure modes. The wrapped
+    subscriber's view diverges from the store; nothing else in the system
+    is told, which is the point: the anti-entropy reconciler has to FIND
+    it.
+
+    Determinism: each delivery's fate comes from a crc32 coin over
+    ``(action, object key, resource_version, seed)`` — content-keyed, so
+    it is independent of thread timing and identical across double runs
+    (the same property the resync backoff jitter relies on). Bulk
+    deliveries are coined per pair. Delayed deliveries are re-played in
+    recorded order by :meth:`release_delayed` (the engine calls it at
+    the top of each tick); the production handlers treat them like any
+    stale event.
+    """
+
+    def __init__(self, seed: int = 0, drop_rate: float = 0.0,
+                 delay_rate: float = 0.0):
+        self.seed = seed
+        self.drop_rate = drop_rate
+        self.delay_rate = delay_rate
+        self.dropped = 0
+        self.delayed = 0
+        self._watch = None
+        self._orig: dict = {}
+        self._pending: List[tuple] = []
+
+    # coin outcomes
+    _DELIVER, _DROP, _DELAY = 0, 1, 2
+
+    def _coin(self, action: str, o) -> int:
+        h = zlib.crc32(
+            f"{action}:{o.metadata.key()}:"
+            f"{o.metadata.resource_version}:{self.seed}".encode())
+        u = (h % 10_000) / 10_000.0
+        if u < self.drop_rate:
+            return self._DROP
+        if u < self.drop_rate + self.delay_rate:
+            return self._DELAY
+        return self._DELIVER
+
+    def wrap(self, watch) -> None:
+        """Interpose on a Watch's handlers in place (install AFTER the
+        subscriber's initial sync replay — the list half of list+watch is
+        not a stream and is not faulted)."""
+        self.unwrap()
+        self._watch = watch
+        self._orig = {"on_add": watch.on_add, "on_update": watch.on_update,
+                      "on_delete": watch.on_delete,
+                      "on_bulk_update": watch.on_bulk_update}
+        if watch.on_add is not None:
+            watch.on_add = lambda o: self._deliver("ADDED", o,
+                                                   self._orig["on_add"],
+                                                   (o,))
+        if watch.on_update is not None:
+            watch.on_update = lambda old, new: self._deliver(
+                "MODIFIED", new, self._orig["on_update"], (old, new))
+        if watch.on_delete is not None:
+            watch.on_delete = lambda o: self._deliver(
+                "DELETED", o, self._orig["on_delete"], (o,))
+        if watch.on_bulk_update is not None:
+            watch.on_bulk_update = self._bulk
+
+    def unwrap(self) -> None:
+        """Restore the watch's original handlers AND drop any still-
+        delayed deliveries: they hold closures over the unwrapped
+        subscriber's handlers, and after a scheduler restart that
+        subscriber is a discarded cache — replaying into it would mutate
+        dead state (the restarted cache rebuilt from a full list, so the
+        information is not lost, merely no longer an event)."""
+        if self._watch is not None:
+            for name, fn in self._orig.items():
+                setattr(self._watch, name, fn)
+        self._watch = None
+        self._orig = {}
+        self.dropped += len(self._pending)
+        self._pending = []
+
+    def _deliver(self, action: str, o, handler, args) -> None:
+        fate = self._coin(action, o)
+        if fate == self._DROP:
+            self.dropped += 1
+            return
+        if fate == self._DELAY:
+            self.delayed += 1
+            self._pending.append((handler, args))
+            return
+        handler(*args)
+
+    def _bulk(self, pairs) -> None:
+        handler = self._orig["on_bulk_update"]
+        keep = []
+        for old, new in pairs:
+            fate = self._coin("MODIFIED", new)
+            if fate == self._DROP:
+                self.dropped += 1
+            elif fate == self._DELAY:
+                self.delayed += 1
+                self._pending.append((handler, ([(old, new)],)))
+            else:
+                keep.append((old, new))
+        if keep:
+            handler(keep)
+
+    def release_delayed(self) -> int:
+        """Deliver everything held back, in arrival order. Returns how
+        many deliveries were released."""
+        pending, self._pending = self._pending, []
+        for handler, args in pending:
+            handler(*args)
+        return len(pending)
+
+    @staticmethod
+    def force_gap(store) -> None:
+        """Roll the store's journal window past every subscriber: clears
+        the journal so the next ``events_since`` from any older rv
+        returns ``resync=True`` — the forced-relist path remote mirrors
+        take when they fall behind the window."""
+        with store._lock:
+            store._journal.clear()
 
 
 def synthesize_node_churn(cfg: FaultConfig, node_names: List[str],

@@ -32,6 +32,23 @@ Catalog (docs/design/simulation.md carries the prose version):
   gap-free, its tail matches the watch-visible resource version, and no
   reservation (sharded bind flush, docs/design/bind_pipeline.md) is
   left open at the tick boundary: no parked entries, no in-flight keys.
+* ``no_silent_rebind`` — a bound pod's node never changes without an
+  observed unbind (node_name cleared by a gang heal) or delete between
+  the two placements. The signature of a DEPOSED leader double-binding
+  across a failover; lease fencing (docs/design/failover.md) exists to
+  make this impossible, and this checker holds it to that. Active only
+  when the engine threads its persistent ``bind_ledger`` through the
+  context — the post-restart catalog re-audits the whole store against
+  the ledger, so binds surviving a crash/restart (or a snapshot-mode
+  store swap) are also covered.
+
+The restart story (docs/design/failover.md) deliberately reuses this
+catalog: after a scheduler crash/restart the engine keeps auditing every
+tick, so "no orphaned or duplicated binds, journal gap-free, gangs
+reconverge within ``gang_converge_ticks``" are enforced by
+``no_orphans`` + ``no_silent_rebind`` + ``journal_order`` +
+``gang_atomicity`` over the rebuilt control plane, not by a separate
+weaker post-restart mode.
 """
 
 from __future__ import annotations
@@ -79,6 +96,10 @@ class CycleContext:
     # (grandfathered: node churn can strand a queue over its cap; only
     # the scheduler *pushing* it over is a violation)
     queues_over_before: Set[str] = field(default_factory=set)
+    # engine-persistent {pod key: node} of the last audited bind per
+    # still-bound pod; None disables the no_silent_rebind checker (unit
+    # fixtures aiming at individual checkers don't carry a ledger)
+    bind_ledger: Optional[Dict[str, str]] = None
     snapshot: Optional[object] = None
 
 
@@ -342,8 +363,41 @@ def check_journal_order(ctx: CycleContext) -> List[Violation]:
     return out
 
 
+def check_no_silent_rebind(ctx: CycleContext) -> List[Violation]:
+    """Reconcile the persistent bind ledger against the store: every
+    currently bound pod either matches its last audited node, or is a
+    NEW binding (key absent — first bind, or re-bind after an observed
+    unbind/delete dropped it from the ledger). A bound pod whose node
+    CHANGED with no unbind in between means two writers each believed
+    they placed it — the deposed-leader double-bind that lease fencing
+    must prevent. Unbound/deleted pods fall out of the ledger here, so a
+    legitimate heal-then-replace (always >= one audited tick apart,
+    docs/design/resilience.md) never trips it."""
+    out: List[Violation] = []
+    ledger = ctx.bind_ledger
+    if ledger is None:
+        return out
+    bound_now: Dict[str, str] = {}
+    for p in ctx.store.list_refs("pods"):
+        if p.spec.node_name and not is_terminated_phase(p):
+            bound_now[p.metadata.key()] = p.spec.node_name
+    for key, node in bound_now.items():
+        last = ledger.get(key)
+        if last is not None and last != node:
+            out.append(Violation(
+                "no_silent_rebind",
+                f"pod {key} moved {last} -> {node} with no observed "
+                "unbind/delete between the placements (double-bind "
+                "signature: a second writer landed a bind over a live "
+                "one)"))
+    ledger.clear()
+    ledger.update(bound_now)
+    return out
+
+
 CHECKERS = (check_node_accounting, check_gang_atomicity, check_queue_quota,
-            check_no_orphans, check_snapshot_coherence, check_journal_order)
+            check_no_orphans, check_snapshot_coherence, check_journal_order,
+            check_no_silent_rebind)
 
 
 def check_all(ctx: CycleContext) -> List[Violation]:

@@ -32,6 +32,12 @@ REASON_AWAITING_ENQUEUE = "PodGroup awaiting enqueue (Pending phase)"
 # is suffixed "(attempt N)", bounded by the retry budget)
 REASON_QUARANTINED = "bind quarantined: retry budget exhausted"
 REASON_BIND_BACKOFF = "bind failed: in retry backoff"
+# control-plane failover (docs/design/failover.md): windows where the
+# scheduler is deliberately NOT scheduling — a standby waiting out the
+# leader lease, or the cache mid-relist after an anti-entropy divergence
+# — surface as explicit reasons instead of a silently stale report
+REASON_NOT_LEADER = "scheduler not leader (standby)"
+REASON_CACHE_RESYNC = "cache resync in progress"
 
 
 def _task_reasons(fe) -> Counter:
@@ -131,5 +137,20 @@ def publish(ssn) -> dict:
     report["session_uid"] = getattr(ssn, "uid", "")
     for reason, count in report["reasons"].items():
         m.inc(m.UNSCHEDULABLE_REASON, float(count), reason=reason)
+    tracer.set_pending_report(report)
+    return report
+
+
+def publish_idle(reason: str, detail: str = "") -> dict:
+    """Publish a whole-scheduler idle reason to ``/debug/pending`` — no
+    session ran, so there are no per-job rows, but during a failover
+    window ("scheduler not leader (standby)", "cache resync in
+    progress") the endpoint must say WHY nothing is being scheduled
+    rather than serving the last leader's stale report."""
+    from ..metrics import metrics as m
+    report = {"pending_jobs": 0, "reasons": {reason: 1}, "jobs": {},
+              "idle_reason": reason, "detail": detail,
+              "cycle_seq": tracer.current_seq()}
+    m.inc(m.UNSCHEDULABLE_REASON, 1.0, reason=reason)
     tracer.set_pending_report(report)
     return report

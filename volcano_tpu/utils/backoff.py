@@ -1,0 +1,27 @@
+"""Seeded-jitter exponential backoff (docs/design/resilience.md).
+
+One formula shared by every retry surface — the cache's bind-failure
+re-placement schedule (PR 4's Resync v2), the remote store's transient
+write retries, and its watch reconnect loop — so all of them are
+deterministic for a fixed (key, attempt, seed): delay is
+``base * 2^(attempt-1)`` capped at ``cap``, jittered into [0.5, 1.0) of
+itself by a crc32 hash (never ``random``: two sim runs from the same
+seed must schedule identical retries, and crc32 is immune to
+PYTHONHASHSEED).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+
+def seeded_backoff(key: str, attempt: int, base: float, cap: float,
+                   seed: int = 0) -> float:
+    """Delay in seconds before the ``attempt``-th retry of ``key``
+    (attempts count from 1). ``base <= 0`` disables backoff entirely —
+    the knob tests use to run retries back-to-back on a wall clock."""
+    if base <= 0.0:
+        return 0.0
+    delay = min(cap, base * (2.0 ** (attempt - 1)))
+    h = zlib.crc32(f"{key}:{attempt}:{seed}".encode())
+    return delay * (0.5 + (h % 4096) / 8192.0)   # [0.5, 1.0) * delay

@@ -6,6 +6,22 @@ Multiple candidate processes/threads race on a lease held in a ConfigMap
 (the reference's configmap resource lock); the holder renews before
 ``lease_duration`` expires, standbys take over when it lapses. Callbacks
 mirror client-go: on_started_leading / on_stopped_leading / on_new_leader.
+
+Fencing (docs/design/failover.md): the lease carries a monotonic
+**fencing token**, bumped on every acquisition by a fresh elector
+incarnation — a takeover by a standby, AND a restarted process
+re-acquiring its own still-valid lease (the old incarnation may have
+writes in flight that must not land). On acquisition the elector
+announces its token to the store (``advance_fence``); leader-scoped
+writes stamped with an older token are rejected with ``FencedError``, so
+a deposed leader mid-bind-flush cannot double-bind after the standby
+takes over. Renewals keep the incarnation's token.
+
+All lease arithmetic reads the injected :class:`~volcano_tpu.utils.clock.
+Clock` (defaulting to the store's), so the churn simulator can drive
+elections — lapses, takeovers, clock jumps — deterministically on its
+virtual clock via :meth:`LeaderElector.step`; the threaded :meth:`run`
+loop is the wall-clock deployment shape.
 """
 
 from __future__ import annotations
@@ -15,11 +31,13 @@ from typing import Callable, Optional
 
 from ..apiserver.store import ConflictError
 from ..models.objects import ConfigMap, ObjectMeta
+from .clock import Clock
 
 LOCK_NAMESPACE = "volcano-system"
 
 HOLDER_KEY = "holderIdentity"
 RENEW_KEY = "renewTime"
+FENCE_KEY = "fencingToken"
 
 
 class LeaderElector:
@@ -29,7 +47,8 @@ class LeaderElector:
                  retry_period: float = 5.0,
                  on_started_leading: Optional[Callable] = None,
                  on_stopped_leading: Optional[Callable] = None,
-                 on_new_leader: Optional[Callable[[str], None]] = None):
+                 on_new_leader: Optional[Callable[[str], None]] = None,
+                 clock: Optional[Clock] = None):
         self.store = store
         self.identity = identity
         self.lease_name = lease_name
@@ -39,25 +58,44 @@ class LeaderElector:
         self.on_stopped_leading = on_stopped_leading
         self.on_new_leader = on_new_leader
         self.is_leader = False
+        # this incarnation's fencing token; None until the first
+        # acquisition. Deliberately NOT inherited from the lease on
+        # restart — a new process incarnation always bumps.
+        self.fencing_token: Optional[int] = None
+        self.clock = clock if clock is not None \
+            else getattr(store, "clock", None) or Clock()
         self._observed_leader = ""
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     # -- lock handling -----------------------------------------------------
 
+    def _next_token(self, lease) -> int:
+        """The token this write should carry: a NEW acquisition (first
+        ever, after losing the lease, or this incarnation's first) bumps
+        the lease's stored token; a renewal keeps the incarnation's."""
+        stored = int(lease.data.get(FENCE_KEY, "0")) if lease is not None \
+            else 0
+        if self.fencing_token is None or not self.is_leader:
+            return max(stored, self.fencing_token or 0) + 1
+        return self.fencing_token
+
     def _try_acquire_or_renew(self) -> bool:
-        now = self.store.clock.now()
+        now = self.clock.now()
         lease = self.store.get("configmaps", self.lease_name, LOCK_NAMESPACE)
         if lease is None:
+            token = self._next_token(None)
             try:
                 self.store.create("configmaps", ConfigMap(
                     metadata=ObjectMeta(name=self.lease_name,
                                         namespace=LOCK_NAMESPACE),
-                    data={HOLDER_KEY: self.identity, RENEW_KEY: str(now)}),
+                    data={HOLDER_KEY: self.identity, RENEW_KEY: str(now),
+                          FENCE_KEY: str(token)}),
                     skip_admission=True)
-                return True
             except KeyError:
                 return False
+            self.fencing_token = token
+            return True
         holder = lease.data.get(HOLDER_KEY, "")
         renew = float(lease.data.get(RENEW_KEY, "0"))
         if holder and holder != self.identity and \
@@ -66,12 +104,15 @@ class LeaderElector:
             return False
         # our lease, or an expired one: take/renew it (optimistic write —
         # a concurrent standby loses on the resource-version conflict)
+        token = self._next_token(lease)
         lease.data[HOLDER_KEY] = self.identity
         lease.data[RENEW_KEY] = str(now)
+        lease.data[FENCE_KEY] = str(token)
         try:
             self.store.update("configmaps", lease, skip_admission=True)
         except (ConflictError, KeyError):
             return False
+        self.fencing_token = token
         return True
 
     def _observe(self, holder: str) -> None:
@@ -80,14 +121,26 @@ class LeaderElector:
             if self.on_new_leader is not None:
                 self.on_new_leader(holder)
 
+    def _announce_fence(self) -> None:
+        """Push this incarnation's token to the store's write fence —
+        from this instant, writes stamped by any earlier incarnation
+        (a deposed leader's in-flight bind flush) are rejected."""
+        advance = getattr(self.store, "advance_fence", None)
+        if advance is not None and self.fencing_token is not None:
+            advance(self.fencing_token)
+
     # -- loop ---------------------------------------------------------------
 
     def step(self) -> bool:
         """One election round; returns current leadership. Deterministic
-        entry point for tests and for external pacing."""
+        entry point for tests and for external pacing (the simulator
+        steps candidates on its virtual clock)."""
         acquired = self._try_acquire_or_renew()
         if acquired and not self.is_leader:
             self.is_leader = True
+            # fence BEFORE the leading callback: by the time user code
+            # starts scheduling, the old incarnation is already shut out
+            self._announce_fence()
             self._observe(self.identity)
             if self.on_started_leading is not None:
                 self.on_started_leading()
@@ -113,9 +166,19 @@ class LeaderElector:
 
     def release(self) -> None:
         """Voluntarily give up the lease on shutdown (leader transition is
-        immediate instead of waiting out the lease)."""
+        immediate instead of waiting out the lease).
+
+        Ordering contract: ``on_stopped_leading`` fires — and
+        ``is_leader`` drops — BEFORE the lease is cleared in the store,
+        so a standby whose ``on_started_leading`` observes the freed
+        lease can never run concurrently with this candidate still
+        believing (or acting as if) it leads. The fencing token survives
+        in the lease data: tokens are monotonic across holders."""
         if not self.is_leader:
             return
+        self.is_leader = False
+        if self.on_stopped_leading is not None:
+            self.on_stopped_leading()
         lease = self.store.get("configmaps", self.lease_name, LOCK_NAMESPACE)
         if lease is not None and lease.data.get(HOLDER_KEY) == self.identity:
             lease.data[HOLDER_KEY] = ""
@@ -124,6 +187,3 @@ class LeaderElector:
                 self.store.update("configmaps", lease, skip_admission=True)
             except (ConflictError, KeyError):
                 pass
-        self.is_leader = False
-        if self.on_stopped_leading is not None:
-            self.on_stopped_leading()

@@ -23,16 +23,28 @@ class FakeBinder:
         self.binds: Dict[str, str] = {}
         self.channel: List[str] = []
         self.store = store
+        # leader fencing token to stamp on store writes (set by the
+        # cache per write batch when fencing is configured; see
+        # cache.interface.StoreBinder)
+        self.fence = None
 
     def bind(self, pod: Pod, hostname: str) -> None:
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
-        self.binds[key] = hostname
-        self.channel.append(key)
         if self.store is not None:
             live = self.store.get("pods", pod.metadata.name, pod.metadata.namespace)
             if live is not None:
                 live.spec.node_name = hostname
-                self.store.update("pods", live, skip_admission=True)
+                fence = getattr(self, "fence", None)
+                if fence is not None:
+                    self.store.update("pods", live, skip_admission=True,
+                                      fence=fence)
+                else:
+                    self.store.update("pods", live, skip_admission=True)
+        # record AFTER the store write: a fenced/failed write must not
+        # appear in the bind channel (the sim's bind sequence is the
+        # record of effective writers)
+        self.binds[key] = hostname
+        self.channel.append(key)
 
     def bind_batch(self, items) -> list:
         """Batched form sharing StoreBinder's engine
@@ -43,7 +55,8 @@ class FakeBinder:
         from ..cache.interface import bind_pods_batch
         failed, used_batch = bind_pods_batch(
             self.store, items, self.bind,
-            type(self).bind is FakeBinder.bind)
+            type(self).bind is FakeBinder.bind,
+            fence=getattr(self, "fence", None))
         if used_batch:
             gone = set(map(id, (pod for pod, _ in failed)))
             for pod, hostname in items:
