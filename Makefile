@@ -2,8 +2,9 @@
 
 PYTHON ?= python
 
-.PHONY: test unit-test e2e bench bench-all multichip-dryrun deploy deploy-up \
-	trace-smoke sim-smoke flush-bench chaos-smoke failover-smoke
+.PHONY: test unit-test e2e bench bench-all bench-check multichip-dryrun \
+	deploy deploy-up trace-smoke sim-smoke flush-bench chaos-smoke \
+	failover-smoke obs-smoke
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -83,6 +84,23 @@ chaos-smoke: sim-smoke
 # from the same seed was bit-identical.
 failover-smoke: chaos-smoke
 	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli failover
+
+# observability gate (docs/design/observability.md), after
+# failover-smoke: a short churn run asserting the pod lifecycle ledger
+# fills (nonzero e2e + per-hop histograms), leaves ZERO orphaned
+# entries, stamps traceable bind correlation IDs (scheduler -> store
+# journal join), and double-runs bit-identically on both the bind
+# sequence AND the ledger aggregate fingerprint.
+obs-smoke: failover-smoke
+	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli obs
+
+# bench regression gate: compare the fresh BENCH_r06.json row (written
+# by `make bench`) against the BENCH_r05 baseline with machine-
+# calibration scaling (this box drifts up to ~2.3x vs the r05 capture).
+# Exit 1 on a scaled regression or a row missing the r06 latency
+# percentiles.
+bench-check:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_check.py
 
 # multi-chip sharding dryrun on the virtual CPU mesh
 multichip-dryrun:

@@ -188,6 +188,39 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
             with open(path, "w") as f:
                 json.dump(tracer.chrome_trace(best_rec), f)
             log(f"chrome trace of winning cycle: {path}")
+    # pod lifecycle latency percentiles (trace/ledger.py), aggregated
+    # over every cold+warm run of this worker — BENCH_r06 onward carries
+    # them so the regression gate can watch per-hop latency, not just
+    # cycle wall time
+    from volcano_tpu.metrics import timeseries
+    from volcano_tpu.trace import ledger
+    lat = ledger.report()
+    if best is not None and lat["hops"]:
+        best["pod_latency"] = {
+            "completed": lat["completed"],
+            "e2e": lat["hops"].get("e2e", {}),
+            "hops": {h: a for h, a in lat["hops"].items() if h != "e2e"},
+        }
+        best["timeseries"] = timeseries.series(limit=16)
+    if os.environ.get("VOLCANO_BENCH_PROFILE") and best is not None:
+        # --profile: one EXTRA instrumented cycle under jax.profiler —
+        # after the measured runs (host-side tracing inflates full-cycle
+        # latency up to 5x, so the recorded numbers never run under it)
+        prof_dir = os.path.join(os.getcwd(),
+                                f"profile_cycle_{n_tasks}x{n_nodes}")
+        try:
+            os.makedirs(prof_dir, exist_ok=True)
+            s3, c3, b3, cf3 = _cycle_env(CONF_FULL)
+            _populate(s3, **pop)
+            with jax.profiler.trace(prof_dir):
+                _run_cycle(c3, cf3)
+            c3.flush_executors(timeout=900)
+            c3.stop()
+            del s3, c3, b3
+            best["profile_dir"] = prof_dir
+            log(f"jax.profiler trace: {prof_dir}")
+        except Exception as e:   # profiling must never fail the bench
+            log(f"profile capture failed ({e})")
     if flush_timeout:
         best = best or {}
         best["flush_timeout"] = True
@@ -196,41 +229,76 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
     print(json.dumps(best))
 
 
+def write_bench_row(row: dict) -> None:
+    """Persist the headline row (BENCH_r06.json by default; override or
+    disable with VOLCANO_BENCH_ROW_OUT) with a machine-calibration
+    fingerprint so tools/bench_check.py can scale cross-box compares."""
+    out = os.environ.get("VOLCANO_BENCH_ROW_OUT", "BENCH_r06.json")
+    if not out:
+        return
+    try:
+        from volcano_tpu.bench_suite import machine_calibration
+        row = dict(row)
+        row["calibration_ms"] = machine_calibration()["value_ms"]
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            out)
+        with open(path, "w") as f:
+            json.dump(row, f, indent=1)
+        log(f"bench row written to {path}")
+    except Exception as e:   # the artifact write must never fail the bench
+        log(f"bench row write failed ({e})")
+
+
 # ---------------------------------------------------------------------------
 # parent: fallback ladder over (platform, kernel, shape)
 # ---------------------------------------------------------------------------
 
+_probe_verdict = None
+
+
 def tpu_alive(timeout_s: float = None) -> bool:
-    """Cheap pre-probe: TPU backend bring-up over the tunnel can HANG for a
-    whole session, and each hung worker burns its full WORKER_TIMEOUT (a
-    dead tunnel used to cost 14 min of timeouts before the ladder reached
-    the CPU fallback). Probe `jax.devices()` in a killable child first so a
-    hung tunnel costs seconds."""
+    """Instrumented pre-probe (volcano_tpu/ops/backend_probe.py): TPU
+    backend bring-up over the tunnel can HANG for a whole session, and
+    each hung worker burns its full WORKER_TIMEOUT (a dead tunnel used to
+    cost 14 min of timeouts before the ladder reached the CPU fallback).
+    The probe runs each init phase (import_jax -> backend_init ->
+    device_op) in a killable child emitting structured phase telemetry,
+    so a hang names the wedged phase instead of vanishing into a silent
+    CPU fallback; the verdict rides the bench JSON row as
+    ``backend_probe``."""
+    global _probe_verdict
     if timeout_s is None:
-        # generous enough for a slow-but-alive cold bring-up (healthy
-        # tunnels answer in seconds; the failure mode being guarded is an
-        # indefinite hang), small enough that a dead tunnel costs ~2 min
-        # instead of two 420 s worker timeouts
         timeout_s = float(os.environ.get("VOLCANO_BENCH_TPU_PROBE_TIMEOUT",
                                          120))
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
-    code = "import jax; print(jax.devices()[0].platform)"
-    log(f"pre-probing TPU backend (timeout {timeout_s:.0f}s)")
-    t0 = time.monotonic()
+    # subprocess the probe module rather than importing it: pulling
+    # volcano_tpu.ops into THIS process would import jax here, and the
+    # whole point of the parent/worker split is that the parent never
+    # touches the (hangable) backend stack
+    cmd = [sys.executable, "-m", "volcano_tpu.ops.backend_probe",
+           "--timeout", str(timeout_s)]
+    log(f"pre-probing TPU backend (instrumented, timeout {timeout_s:.0f}s)")
     try:
-        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                           text=True, timeout=timeout_s, env=env)
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout_s + 120, env=env,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
-        log(f"TPU pre-probe HUNG ({timeout_s:.0f}s); skipping all TPU workers")
+        log("backend probe runner itself timed out (killed)")
+        _probe_verdict = {"alive": False, "timed_out": True, "rc": None,
+                          "last_phase": None, "platform": None,
+                          "phases": []}
         return False
-    # last line only: sitecustomize / runtime banners may precede the print
-    lines = (r.stdout or "").strip().splitlines()
-    plat = lines[-1].strip() if lines else ""
-    alive = r.returncode == 0 and plat == "tpu"
-    log(f"TPU pre-probe: rc={r.returncode} platform={plat!r} "
-        f"({time.monotonic() - t0:.1f}s) -> {'alive' if alive else 'dead'}")
-    return alive
+    for line in (r.stderr or "").splitlines():
+        log(line)
+    try:
+        _probe_verdict = json.loads(
+            (r.stdout or "").strip().splitlines()[-1])
+    except Exception:
+        log(f"probe output unparseable: {(r.stdout or '')[-200:]!r}")
+        _probe_verdict = {"alive": False, "error": "unparseable probe "
+                                                   "output"}
+    return bool(_probe_verdict.get("alive"))
 
 
 def try_worker(platform: str, n_tasks: int, n_nodes: int, kernel: str):
@@ -480,6 +548,11 @@ def main() -> None:
     # the per-phase breakdown is in the output JSON either way
     if "--trace" in sys.argv:
         os.environ["VOLCANO_BENCH_DUMP_TRACE"] = "1"
+    # --profile: the cycle worker additionally runs ONE extra cycle under
+    # jax.profiler.trace (profile_cycle_<T>x<N>/, TensorBoard-loadable),
+    # after the measured runs so the numbers stay clean
+    if "--profile" in sys.argv:
+        os.environ["VOLCANO_BENCH_PROFILE"] = "1"
 
     # HEADLINE ladder: the full runOnce (scope=full_cycle) — TPU first,
     # CPU fallback; shrink the shape only after every platform failed on
@@ -513,7 +586,7 @@ def main() -> None:
                 print(json.dumps(res))
                 sys.exit(1)
             cycle_ms = float(res["cycle_ms"])
-            print(json.dumps({
+            row = {
                 "metric": name,
                 "value": round(cycle_ms, 2),
                 "unit": "ms",
@@ -538,7 +611,17 @@ def main() -> None:
                 # sub-phases) so BENCH_r* tracks WHERE flush time goes
                 "flush_phases": res.get("flush_phases"),
                 "trace_coverage": res.get("trace_coverage"),
-            }))
+                # pod lifecycle latency percentiles (e2e + per hop) and
+                # the /debug/timeseries ring tail — BENCH_r06 onward
+                "pod_latency": res.get("pod_latency"),
+                "timeseries": res.get("timeseries"),
+                # structured backend-init probe telemetry (which phase a
+                # hung TPU bring-up wedged in, instead of a silent
+                # CPU fallback)
+                "backend_probe": _probe_verdict,
+            }
+            print(json.dumps(row))
+            write_bench_row(row)
             return
 
     print(json.dumps({

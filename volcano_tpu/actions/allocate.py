@@ -28,6 +28,7 @@ from ..framework.statement import Statement
 from ..metrics import metrics as m
 from ..models.job_info import JobInfo, TaskInfo, TaskStatus
 from ..models.objects import PodGroupPhase
+from ..trace import ledger
 from ..trace import tracer as trace
 
 
@@ -165,9 +166,22 @@ class AllocateAction(Action):
         if not phase_a:
             return
         trace.tag_cycle(tasks_considered=sum(len(t) for t in pending.values()))
+        if ledger.is_enabled():
+            # lifecycle ledger: every task entering this cycle's allocate
+            # batch is session-eligible (set-once — only a pod's FIRST
+            # eligible cycle stamps, so steady-state cycles with a parked
+            # backlog cost one dict probe per pending task, and cycles
+            # with no pending tasks never reach here)
+            ledger.stamp_bulk(
+                [t.key() for tasks in pending.values() for t in tasks],
+                "session_eligible", ssn.clock.now())
 
         result_a = ssn.solver.place([(j, t) for j, t in phase_a],
                                     allow_pipeline=True)
+        if ledger.is_enabled():
+            ledger.stamp_bulk(
+                [p.task.key() for pls in result_a.placements.values()
+                 for p in pls], "kernel_placed", ssn.clock.now())
 
         # phase B: surplus tasks of jobs that survived phase A
         phase_b = []
@@ -187,6 +201,10 @@ class AllocateAction(Action):
             result_b = ssn.solver.place(
                 [(shadow, ts) for _, shadow, ts in phase_b],
                 allow_pipeline=True)
+            if ledger.is_enabled():
+                ledger.stamp_bulk(
+                    [p.task.key() for pls in result_b.placements.values()
+                     for p in pls], "kernel_placed", ssn.clock.now())
             with trace.span("apply_extra", jobs=len(phase_b)):
                 self._apply_extra(ssn, staged, result_b, phase_b)
         with trace.span("finalize", jobs=len(staged)):

@@ -16,6 +16,7 @@ from ..framework.plugin import Action
 from ..framework.registry import register_action
 from ..models.job_info import JobInfo
 from ..models.objects import PodGroupPhase
+from ..trace import ledger
 from ..trace import tracer as trace
 
 
@@ -61,6 +62,15 @@ class EnqueueAction(Action):
                     ssn.job_enqueued(job)
                     job.own_pod_group().status.phase = PodGroupPhase.INQUEUE
                     inqueued += 1
+                    if ledger.is_enabled() and job.tasks:
+                        # lifecycle ledger: pods whose group gated
+                        # Pending -> Inqueue this cycle (groups that pre-
+                        # date pod creation stamp nothing — the pods will
+                        # enter the ledger at submission, skipping this
+                        # hop)
+                        ledger.stamp_bulk(
+                            [t.key() for t in job.tasks.values()],
+                            "enqueued", ssn.clock.now())
 
                 queue_list.append(queue)
             trace.add_tags(inqueued=inqueued)

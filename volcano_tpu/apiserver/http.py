@@ -41,6 +41,19 @@ def _fence_of(query: dict):
     return int(raw) if raw is not None else None
 
 
+# correlation-ID wire format (docs/design/observability.md): writes carry
+# ``?trace=<id>`` and journal deliveries echo it back as the event's
+# ``trace`` field, so one bind stays traceable scheduler -> store journal
+# -> remote mirror. IDs are opaque strings, length-capped so a hostile
+# query string can't bloat the store's trace ranges.
+TRACE_MAX_LEN = 128
+
+
+def _trace_of(query: dict):
+    raw = query.get("trace", [None])[0]
+    return raw[:TRACE_MAX_LEN] if raw else None
+
+
 class StoreHTTPServer:
     def __init__(self, store: ObjectStore, host: str = "127.0.0.1",
                  port: int = 8181):
@@ -97,12 +110,21 @@ class StoreHTTPServer:
                     since = int(q.get("since", ["0"])[0])
                     timeout = min(60.0, float(q.get("timeout", ["25"])[0]))
                     events, rv, resync = store.events_since(since, timeout)
-                    return self._send(200, {
-                        "rv": rv, "resync": resync,
-                        "events": [{"rv": erv, "action": action,
-                                    "kind": kind,
-                                    "object": encode_object(kind, o)}
-                                   for erv, action, kind, o in events]})
+                    # ONE trace-map snapshot for the whole response (a
+                    # 50k-event long poll must not copy the map per
+                    # event); each rv resolves by bisect
+                    from .store import trace_in_ranges
+                    ranges = store.trace_ranges() if events else []
+                    payload = []
+                    for erv, action, kind, o in events:
+                        ev = {"rv": erv, "action": action, "kind": kind,
+                              "object": encode_object(kind, o)}
+                        trace = trace_in_ranges(ranges, erv)
+                        if trace is not None:
+                            ev["trace"] = trace
+                        payload.append(ev)
+                    return self._send(200, {"rv": rv, "resync": resync,
+                                            "events": payload})
                 route = self._parse()
                 if route is None:
                     return self._send(404, {"error": "not found"})
@@ -160,7 +182,8 @@ class StoreHTTPServer:
                     return self._send(400, {"error": "malformed fence token"})
                 try:
                     o = decode_object(kind, self._body())
-                    created = store.create(kind, o, fence=fence)
+                    created = store.create(kind, o, fence=fence,
+                                           trace=_trace_of(query))
                     return self._send(201, encode_object(kind, created))
                 except FencedError as e:
                     return self._send(412, {"error": str(e)})
@@ -180,7 +203,8 @@ class StoreHTTPServer:
                     return self._send(400, {"error": "malformed fence token"})
                 try:
                     o = decode_object(kind, self._body())
-                    updated = store.update(kind, o, fence=fence)
+                    updated = store.update(kind, o, fence=fence,
+                                           trace=_trace_of(query))
                     return self._send(200, encode_object(kind, updated))
                 except FencedError as e:
                     return self._send(412, {"error": str(e)})
@@ -201,7 +225,8 @@ class StoreHTTPServer:
                 except ValueError:
                     return self._send(400, {"error": "malformed fence token"})
                 try:
-                    rv = store.delete(kind, name, ns, fence=fence)
+                    rv = store.delete(kind, name, ns, fence=fence,
+                                      trace=_trace_of(query))
                     return self._send(200, {"status": "deleted", "rv": rv})
                 except FencedError as e:
                     return self._send(412, {"error": str(e)})
@@ -276,26 +301,32 @@ class StoreClient:
         return [decode_object(kind, item) for item in data["items"]]
 
     @staticmethod
-    def _with_fence(path: str, fence) -> str:
-        return path if fence is None else f"{path}?fence={int(fence)}"
+    def _with_params(path: str, fence, trace=None) -> str:
+        params = []
+        if fence is not None:
+            params.append(f"fence={int(fence)}")
+        if trace is not None:
+            params.append(f"trace={urllib.parse.quote(str(trace))}")
+        return f"{path}?{'&'.join(params)}" if params else path
 
-    def create(self, kind: str, o, fence=None):
+    def create(self, kind: str, o, fence=None, trace=None):
         data = self._request("POST",
-                             self._with_fence(self._path(kind), fence),
+                             self._with_params(self._path(kind), fence,
+                                               trace),
                              encode_object(kind, o))
         return decode_object(kind, data)
 
-    def update(self, kind: str, o, fence=None):
+    def update(self, kind: str, o, fence=None, trace=None):
         path = self._path(kind, o.metadata.name, o.metadata.namespace)
-        data = self._request("PUT", self._with_fence(path, fence),
+        data = self._request("PUT", self._with_params(path, fence, trace),
                              encode_object(kind, o))
         return decode_object(kind, data)
 
     def delete(self, kind: str, name: str, namespace: str = "default",
-               fence=None):
+               fence=None, trace=None):
         return self._request(
-            "DELETE", self._with_fence(self._path(kind, name, namespace),
-                                       fence))
+            "DELETE", self._with_params(self._path(kind, name, namespace),
+                                        fence, trace))
 
     def advance_fence(self, token: int) -> int:
         return int(self._request("POST", "/fence",

@@ -177,6 +177,12 @@ class RemoteStore:
         # stream's redeliveries are deduped by server resource_version.
         self._seen: dict = {}
         self._seen_lock = threading.Lock()
+        # correlation IDs observed on the watch stream (the server echoes
+        # a write's ?trace= back as the journal event's "trace" field),
+        # keyed by SERVER rv — the same join key trace_of uses on the
+        # in-process store. Bounded: old entries age out with the deque.
+        from collections import deque as _deque
+        self._trace_events: _deque = _deque(maxlen=4096)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.watch_restarts = 0
@@ -270,6 +276,10 @@ class RemoteStore:
                 else:
                     for ev in data.get("events", []):
                         o = decode_object(ev["kind"], ev["object"])
+                        if ev.get("trace") is not None:
+                            with self._seen_lock:
+                                self._trace_events.append(
+                                    (int(ev["rv"]), ev["trace"]))
                         self._apply(ev["action"], ev["kind"], o,
                                     int(ev["rv"]))
                         self._rv = max(self._rv, int(ev["rv"]))
@@ -339,12 +349,24 @@ class RemoteStore:
         return self._retrying("advance_fence", str(token),
                               lambda: self.client.advance_fence(token))
 
+    def trace_of(self, server_rv: int):
+        """Correlation ID the watch stream delivered for ``server_rv``
+        (the remote twin of ``ObjectStore.trace_of``; None when the event
+        was unstamped, aged out, or not yet polled)."""
+        with self._seen_lock:
+            events = list(self._trace_events)
+        for rv, trace in reversed(events):
+            if rv == server_rv:
+                return trace
+        return None
+
     def create(self, kind: str, o, skip_admission: bool = False,
-               fence: Optional[int] = None):
+               fence: Optional[int] = None, trace: Optional[str] = None):
         try:
             created = self._retrying(
                 "create", f"{kind}/{self.key_of(kind, o)}",
-                lambda: self.client.create(kind, o, fence=fence))
+                lambda: self.client.create(kind, o, fence=fence,
+                                           trace=trace))
         except Exception as e:
             raise self._map_error(e) from None
         # the in-process store stamps uid/rv on the caller's object in
@@ -361,11 +383,12 @@ class RemoteStore:
         return created
 
     def update(self, kind: str, o, skip_admission: bool = False,
-               fence: Optional[int] = None):
+               fence: Optional[int] = None, trace: Optional[str] = None):
         try:
             updated = self._retrying(
                 "update", f"{kind}/{self.key_of(kind, o)}",
-                lambda: self.client.update(kind, o, fence=fence))
+                lambda: self.client.update(kind, o, fence=fence,
+                                           trace=trace))
         except Exception as e:
             raise self._map_error(e) from None
         o.metadata.resource_version = updated.metadata.resource_version
@@ -374,12 +397,13 @@ class RemoteStore:
         return updated
 
     def delete(self, kind: str, name: str, namespace: str = "default",
-               skip_admission: bool = False, fence: Optional[int] = None):
+               skip_admission: bool = False, fence: Optional[int] = None,
+               trace: Optional[str] = None):
         try:
             resp = self._retrying(
                 "delete", f"{kind}/{namespace}/{name}",
                 lambda: self.client.delete(kind, name, namespace,
-                                           fence=fence))
+                                           fence=fence, trace=trace))
         except Exception as e:
             raise self._map_error(e) from None
         rv = int((resp or {}).get("rv", 0)) if isinstance(resp, dict) else 0

@@ -49,6 +49,19 @@ def _flush_pool():
                     max_workers=workers, thread_name_prefix="flush-shard")
     return _FLUSH_POOL
 
+def trace_in_ranges(ranges: list, rv: int):
+    """Resolve ``rv`` against a ``trace_ranges()`` snapshot: ranges are
+    non-overlapping and ascending by ``lo``, so a bisect finds the only
+    candidate in O(log n) — the /watch handler resolves one rv per
+    journal event against a single snapshot instead of re-copying the
+    map per event."""
+    import bisect
+    i = bisect.bisect_right(ranges, rv, key=lambda r: r[0]) - 1
+    if i >= 0 and ranges[i][1] >= rv:
+        return ranges[i][2]
+    return None
+
+
 NAMESPACED = {"pods", "podgroups", "jobs", "commands", "resourcequotas", "services",
               "configmaps", "secrets", "networkpolicies", "persistentvolumeclaims"}
 CLUSTER_SCOPED = {"nodes", "queues", "priorityclasses", "numatopologies",
@@ -183,6 +196,35 @@ class ObjectStore:
         # lives in the lease ConfigMap and IS snapshotted).
         self._fence_floor = 0
         self.fenced_writes = 0
+        # trace-context propagation (docs/design/observability.md): every
+        # write form accepts a ``trace=`` correlation ID; committed rvs
+        # are recorded here as (lo, hi, trace) ranges so a journal entry
+        # (or a watch delivery carrying its rv) joins back to the write
+        # that produced it via trace_of(rv). A side map, NOT a journal
+        # tuple field: journal consumers keep their 4-tuple shape, and a
+        # 50k-bind flush records ONE range instead of 50k entries.
+        # Bounded like the journal; snapshot restores clear it (the
+        # journal is cleared too — same lifetime).
+        self._trace_ranges = _deque(maxlen=4096)
+
+    # -- trace correlation -------------------------------------------------
+
+    def _record_trace_locked(self, lo: int, hi: int, trace) -> None:
+        if trace is not None and hi >= lo:
+            self._trace_ranges.append((lo, hi, str(trace)))
+
+    def trace_ranges(self) -> list:
+        """Snapshot of the recorded (lo, hi, trace) ranges, ascending by
+        rv (appends follow rv allocation order) — one lock pass for bulk
+        consumers like the /watch handler; join single rvs with
+        :func:`trace_in_ranges`."""
+        with self._lock:
+            return list(self._trace_ranges)
+
+    def trace_of(self, rv: int):
+        """Correlation ID of the write that produced ``rv`` (None when
+        the write was unstamped or the record aged out)."""
+        return trace_in_ranges(self.trace_ranges(), rv)
 
     # -- lease fencing -----------------------------------------------------
 
@@ -278,7 +320,7 @@ class ObjectStore:
     # -- CRUD --------------------------------------------------------------
 
     def create(self, kind: str, o, skip_admission: bool = False,
-               fence: Optional[int] = None):
+               fence: Optional[int] = None, trace: Optional[str] = None):
         # admission runs outside the store lock: remote admission hooks
         # (webhook-manager callbacks) must not stall every other writer
         if not skip_admission:
@@ -299,6 +341,7 @@ class ObjectStore:
             o.metadata.resource_version = self._rv
             self._objects[kind][key] = o
             self._journal_append_locked(self._rv, "ADDED", kind, o)
+            self._record_trace_locked(self._rv, self._rv, trace)
             watches = list(self._watches[kind])
         for w in watches:
             if w.on_add and w._passes(o):
@@ -316,7 +359,7 @@ class ObjectStore:
     # phase-transition detection in controllers).
 
     def update(self, kind: str, o, skip_admission: bool = False,
-               fence: Optional[int] = None):
+               fence: Optional[int] = None, trace: Optional[str] = None):
         key = self.key_of(kind, o)
         if not skip_admission:
             with self._lock:
@@ -345,6 +388,7 @@ class ObjectStore:
             o.metadata.resource_version = self._rv
             self._objects[kind][key] = o
             self._journal_append_locked(self._rv, "MODIFIED", kind, o)
+            self._record_trace_locked(self._rv, self._rv, trace)
             watches = list(self._watches[kind])
         for w in watches:
             old_p, new_p = w._passes(old), w._passes(o)
@@ -360,7 +404,8 @@ class ObjectStore:
         return o
 
     def patch_batch(self, kind: str, patches, clone_fn=None,
-                    fence: Optional[int] = None) -> tuple:
+                    fence: Optional[int] = None,
+                    trace: Optional[str] = None) -> tuple:
         """Apply ``[(name, namespace, fn)]`` as one bulk commit: each fn
         mutates a fresh clone of the stored object, which becomes the new
         stored version (rv bump + journal entry each). ``clone_fn``
@@ -398,9 +443,10 @@ class ObjectStore:
             fn(new)
 
         return self._bulk_patch(kind, patches, clone_fn or fast_clone,
-                                apply_fn, None, fence=fence)
+                                apply_fn, None, fence=fence, trace=trace)
 
-    def bind_pods(self, bindings, fence: Optional[int] = None) -> tuple:
+    def bind_pods(self, bindings, fence: Optional[int] = None,
+                  trace: Optional[str] = None) -> tuple:
         """The bind-flush fast path: ``[(name, namespace, hostname)]`` →
         pod.spec.node_name patches through the same bulk engine as
         :meth:`patch_batch`, with the per-item closure replaced by a plain
@@ -429,13 +475,15 @@ class ObjectStore:
                                           rv_base + 1)
 
         return self._bulk_patch("pods", bindings, clone_pod_for_bind,
-                                apply_fn, batch_shard, fence=fence)
+                                apply_fn, batch_shard, fence=fence,
+                                trace=trace)
 
     def _shard_count(self, n: int) -> int:
         return min(self.SHARD_MAX, -(-n // self.SHARD_TARGET))
 
     def _bulk_patch(self, kind: str, items, clone_fn, apply_fn,
-                    batch_shard, fence: Optional[int] = None) -> tuple:
+                    batch_shard, fence: Optional[int] = None,
+                    trace: Optional[str] = None) -> tuple:
         """Bulk-commit engine behind patch_batch/bind_pods.
 
         ``items`` is [(name, namespace, payload)]; each applied item
@@ -531,6 +579,10 @@ class ObjectStore:
                             pairs.append((old, new))
                     finally:
                         if pairs:
+                            self._record_trace_locked(
+                                pairs[0][1].metadata.resource_version,
+                                pairs[-1][1].metadata.resource_version,
+                                trace)
                             watches = list(self._watches[kind])
                     return pairs, missing
                 # sharded: reserve rvs + split; keys barriered until their
@@ -551,6 +603,9 @@ class ObjectStore:
                 for s in shards:
                     bases.append(rv)
                     rv += len(s)
+                # the whole reserved range commits (failures install
+                # no-op versions), so one range record covers the burst
+                self._record_trace_locked(self._rv + 1, rv, trace)
                 self._rv = rv
                 infl = self._inflight[kind]
                 for key, _, _ in resolved:
@@ -690,7 +745,8 @@ class ObjectStore:
 
     def delete(self, kind: str, name: str, namespace: str = "default",
                skip_admission: bool = False,
-               fence: Optional[int] = None) -> int:
+               fence: Optional[int] = None,
+               trace: Optional[str] = None) -> int:
         """Returns the deletion's resource version (remote mirrors dedup
         journal replays against it)."""
         key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
@@ -710,6 +766,7 @@ class ObjectStore:
             self._rv += 1
             deleted_rv = self._rv
             self._journal_append_locked(self._rv, "DELETED", kind, old)
+            self._record_trace_locked(self._rv, self._rv, trace)
             del self._objects[kind][key]
             watches = list(self._watches[kind])
         for w in watches:

@@ -102,7 +102,15 @@ def _run_cycle(cache, conf) -> float:
                     close_session(ssn)
             finally:
                 cache.end_cycle()
-        return (time.perf_counter() - t0) * 1000.0
+        ms = (time.perf_counter() - t0) * 1000.0
+        if tr.is_enabled():
+            # /debug/timeseries sample per cycle — the bench drives
+            # cycles directly (no Scheduler.run_once), so it samples
+            # here; the ring tail rides the bench JSON row
+            from volcano_tpu.metrics import timeseries
+            timeseries.sample(time.time(), extra={
+                "cycle_ms": round(ms, 3), "seq": tr.current_seq()})
+        return ms
     finally:
         gcguard.resume()
         gc.unfreeze()

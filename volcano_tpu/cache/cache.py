@@ -27,6 +27,7 @@ from typing import Dict, List, Optional
 from ..apiserver.store import ObjectStore
 from ..metrics import metrics as m
 from ..models import objects as obj
+from ..trace import ledger
 from ..models.cluster_info import ClusterInfo
 from ..models.job_info import (JobInfo, TaskInfo, TaskStatus,
                                allocated_status)
@@ -65,13 +66,17 @@ class _BindBurst:
     as 6.25k gangs whose tasks land ~5 per node, and per-gang node
     accounting degenerates to 1-task calls without the merge."""
 
-    __slots__ = ("cache", "pairs", "accepted", "bound")
+    __slots__ = ("cache", "pairs", "accepted", "bound", "t_staged")
 
     def __init__(self, cache, pairs):
         self.cache = cache
         self.pairs = pairs
         self.accepted: list = []
         self.bound: list = []
+        # lifecycle ledger: the foreground staging instant (store clock),
+        # read by the drain's bind_staged stamps so the staged->committed
+        # hop includes the executor queue wait
+        self.t_staged = 0.0
 
     def __call__(self):
         self.cache._apply_bind_bursts([self])
@@ -175,6 +180,12 @@ class SchedulerCache(EventHandlersMixin):
         # task re-binds only via a new pod object after delete+recreate).
         self._pending_binds: list = []
         self._bind_drain_queued = False
+        # flush correlation sequence (docs/design/observability.md): each
+        # bind flush (and each per-task dispatch) gets a deterministic
+        # "bind-N" correlation ID stamped on its store writes and on its
+        # pods' ledger entries — the scheduler -> store journal -> watch
+        # echo join key. Per-cache: a restarted incarnation restarts at 1.
+        self._flush_seq = 0
         # cleared while a scheduling cycle is in flight: the executor backs
         # off so its (GIL-bound) store writes don't contend with the
         # cycle's host path — submitted work flushes in the schedule-period
@@ -544,6 +555,13 @@ class SchedulerCache(EventHandlersMixin):
         except Exception:
             return None
 
+    def _next_trace(self) -> str:
+        """The next flush correlation ID (deterministic: a plain per-cache
+        counter, so sim double runs stamp identical IDs)."""
+        with self._apply_lock:
+            self._flush_seq += 1
+            return f"bind-{self._flush_seq}"
+
     # -- find helpers ------------------------------------------------------
 
     def _find_job_and_task(self, task_info: TaskInfo):
@@ -575,12 +593,18 @@ class SchedulerCache(EventHandlersMixin):
                 job.update_task_status(task, original)
                 raise
             pod = task.pod
+        corr = None
+        if ledger.is_enabled():
+            corr = self._next_trace()
+            ledger.stamp(task.key(), "bind_staged",
+                         self.store.clock.now(), job=task.job, trace=corr)
 
         def do_bind():
             try:
                 fence = self._current_fence()
                 if fence is not None:
                     self.binder.fence = fence
+                self.binder.trace = corr
                 self.binder.bind(pod, hostname)
                 self.store.record_event(
                     "pods", pod, "Normal", "Scheduled",
@@ -606,6 +630,12 @@ class SchedulerCache(EventHandlersMixin):
                     st["failed"] += 1
                     self._deferred_heals.append(task)
                 return
+            if corr is not None:
+                # no-op when the store's synchronous echo already
+                # confirmed (and absorbed) the entry; a remote store's
+                # delayed echo sees this as the real commit instant
+                ledger.stamp(task.key(), "store_committed",
+                             self.store.clock.now(), trace=corr)
             self._clear_bind_successes([(task, pod, hostname)])
             with self.mutex:
                 st = self._single_bind_record(task.job)
@@ -649,6 +679,8 @@ class SchedulerCache(EventHandlersMixin):
         if not pairs:
             return []
         burst = _BindBurst(self, pairs)
+        if ledger.is_enabled():
+            burst.t_staged = self.store.clock.now()
         with self._exec_lock:
             worker_live = self._exec_thread is not None
         if worker_live:
@@ -658,7 +690,7 @@ class SchedulerCache(EventHandlersMixin):
             # covers every gang it pops
             with self._apply_lock:
                 self._pending_apply.append(burst)
-                self._pending_binds.append(burst.bound)
+                self._pending_binds.append(burst)
                 need_drain = not self._bind_drain_queued
                 self._bind_drain_queued = True
             if need_drain:
@@ -667,7 +699,12 @@ class SchedulerCache(EventHandlersMixin):
         with self.mutex:
             self._state_version += 1
             burst()
-        self._bind_store_writes(burst.bound)
+        corr = None
+        if ledger.is_enabled():
+            corr = self._next_trace()
+            ledger.stamp_bulk([t.key() for t, _, _ in burst.bound],
+                              "bind_staged", burst.t_staged, trace=corr)
+        self._bind_store_writes(burst.bound, trace=corr)
         return list(burst.accepted)
 
     def _apply_bind_one(self, burst: _BindBurst, task_info, hostname) -> None:
@@ -759,26 +796,39 @@ class SchedulerCache(EventHandlersMixin):
         from ..metrics import metrics as m
         from ..trace import tracer
         with self._apply_lock:
-            batches, self._pending_binds = self._pending_binds, []
+            bursts, self._pending_binds = self._pending_binds, []
             self._bind_drain_queued = False
         t0 = _time.perf_counter()
         with tracer.async_span("bind_flush.apply"):
             with self.mutex:
                 self._drain_applies_locked()
-        bound = [x for b in batches for x in b]
+        bound = [x for b in bursts for x in b.bound]
         if bound:
+            corr = None
+            if ledger.is_enabled():
+                # one correlation ID per coalesced flush; bind_staged is
+                # stamped with each burst's FOREGROUND staging instant so
+                # the staged->committed hop includes the executor queue
+                # wait this drain just paid
+                corr = self._next_trace()
+                for b in bursts:
+                    ledger.stamp_bulk([t.key() for t, _, _ in b.bound],
+                                      "bind_staged", b.t_staged,
+                                      trace=corr)
             with tracer.async_span("bind_flush.store", binds=len(bound)):
-                self._bind_store_writes(bound)
+                self._bind_store_writes(bound, trace=corr)
             m.observe(m.BIND_FLUSH_LATENCY,
                       (_time.perf_counter() - t0) * 1000.0)
             m.inc(m.BIND_FLUSH_BINDS, len(bound))
 
-    def _bind_store_writes(self, bound) -> None:
+    def _bind_store_writes(self, bound, trace=None) -> None:
         """One binder pass + Scheduled events for [(task, pod, hostname)];
         failures land in the resync queue with retry accounting, and a
         gang left partially bound by them is healed — its already-bound
         siblings unbound — before anything else observes the commit
-        (cache.go:605-655 + docs/design/resilience.md)."""
+        (cache.go:605-655 + docs/design/resilience.md). ``trace`` is the
+        flush's correlation ID, stamped on the store writes (joinable via
+        ``store.trace_of``) and on the pods' ledger entries."""
         log = logging.getLogger(__name__)
         fence = self._current_fence()
         if fence is not None:
@@ -786,6 +836,7 @@ class SchedulerCache(EventHandlersMixin):
             # their store writes (attribute-based so binder subclasses
             # with legacy signatures keep working unstamped)
             self.binder.fence = fence
+        self.binder.trace = trace
         bind_all = getattr(self.binder, "bind_batch", None)
         if bind_all is not None:
             # hint the echo ingest: bulk deliveries arriving ON THIS
@@ -821,6 +872,10 @@ class SchedulerCache(EventHandlersMixin):
                     self._record_bind_failure(task, "bind rejected")
                     self.resync_task(task)
                 ok = self._heal_partial_gangs(ok, failed)
+            if trace is not None and ok:
+                ledger.stamp_bulk([t.key() for t, _, _ in ok],
+                                  "store_committed",
+                                  self.store.clock.now(), trace=trace)
             self._clear_bind_successes(ok)
             # Scheduled events: the store's event deque is bounded, so a
             # burst longer than its capacity would format messages for
@@ -850,6 +905,10 @@ class SchedulerCache(EventHandlersMixin):
             ok.append((task, pod, hostname))
         if failed:
             ok = self._heal_partial_gangs(ok, failed)
+        if trace is not None and ok:
+            ledger.stamp_bulk([t.key() for t, _, _ in ok],
+                              "store_committed", self.store.clock.now(),
+                              trace=trace)
         self._clear_bind_successes(ok)
         for task, pod, hostname in ok:
             self.store.record_event(
@@ -931,6 +990,11 @@ class SchedulerCache(EventHandlersMixin):
                 "pods", pod, "Warning", "GangUnbound",
                 f"unbound from {hostname}: a sibling bind failure broke "
                 f"gang atomicity; the gang will be re-placed as a unit")
+            # reopen, not detour: with the in-process store the bind's
+            # synchronous echo already CONFIRMED (and absorbed) the
+            # pod's ledger entry before this heal could run — the pod's
+            # lifecycle restarts here so the re-placement is tracked
+            ledger.reopen(task.key(), "healed", self.store.clock.now())
             self.resync_task(task)
 
     def _heal_gang_of(self, task_info: TaskInfo) -> None:
@@ -1099,6 +1163,8 @@ class SchedulerCache(EventHandlersMixin):
                 rec.not_before = self.store.clock.now() + \
                     self._backoff_seconds(key, rec.attempts)
         m.inc(m.RESYNC_RETRIES)
+        ledger.detour(key, "quarantined" if quarantine_msg is not None
+                      else "retry")
         if quarantine_msg is not None:
             m.set_gauge(m.QUARANTINED_TASKS, float(n_quarantined))
             self.store.record_event("pods", task.pod, "Warning",
@@ -1166,6 +1232,7 @@ class SchedulerCache(EventHandlersMixin):
                 # a bind failure recorded AFTER the pod's delete echo must
                 # not leak its retry record (the pod can never come back)
                 self._clear_bind_retry_state(old_task.key())
+                ledger.drop(old_task.key())
                 self._delete_task(old_task)
                 return
             new_task = TaskInfo(pod)

@@ -16,6 +16,7 @@ from ..models import objects as obj
 from ..models.arrays import _group_sig
 from ..models.job_info import (JobInfo, TaskInfo, allocated_status,
                                get_job_id, get_task_status, is_terminated)
+from ..trace import ledger
 from ..utils.fastclone import fast_clone
 from ..models.node_info import NodeInfo
 from ..models.queue_info import NamespaceCollection, QueueInfo
@@ -40,6 +41,12 @@ class EventHandlersMixin:
         # so scheduling cycles inherit it through snapshot clones and the
         # 50k-task encode loop is pure attribute reads
         _group_sig(ti)
+        if ti.job and not ti.node_name and ledger.is_enabled():
+            # lifecycle ledger: a schedulable pod enters the pipeline here
+            # (set-once — a restart's relist replay keeps the original
+            # submission stamp on the module-global ledger)
+            ledger.stamp(ti.key(), "submitted", self.store.clock.now(),
+                         job=ti.job)
         if ti.node_name:
             if ti.node_name not in self.nodes:
                 # pods bound to unknown nodes create a placeholder so their
@@ -92,6 +99,11 @@ class EventHandlersMixin:
                 and allocated_status(cached.status)
                 and allocated_status(nt.status)
                 and cached.resreq.equal(nt.resreq)):
+            if ledger.is_enabled():
+                # a bound pod's echo re-ingested: terminal ledger stamp
+                # (set-once, so the later Running-phase echo is a no-op)
+                ledger.confirm(cached.key(), self.store.clock.now(),
+                               queue=job.queue)
             _group_sig(nt)   # re-derive eagerly (watch thread), off-cycle
             job.move_task_status(cached, nt.status)
             node = self.nodes.get(cached.node_name)
@@ -163,6 +175,11 @@ class EventHandlersMixin:
         hint_state = getattr(self, "_expected_bind_echo", None)
         exp = hint_state[1] if hint_state is not None \
             and hint_state[0] == threading.get_ident() else None
+        # lifecycle ledger: one clock read and one bulk confirm per
+        # delivery (per shard on the sharded flush, so shard i's pods
+        # confirm while shard i+1 is still cloning)
+        now = self.store.clock.now() if ledger.is_enabled() else None
+        confirms: list = []
         with tracer.async_span("bind_flush.echo", pairs=len(pairs)), \
                 self.mutex:
             self._state_version += 1
@@ -188,6 +205,9 @@ class EventHandlersMixin:
                                     flush_run()
                                     run_job, run_status = job, new_status
                                 run_tasks.append(task)
+                                if now is not None:
+                                    confirms.append((task.key(),
+                                                     job.queue))
                                 rv = new.metadata.resource_version
                                 task.pod.metadata.resource_version = rv
                                 node = self.nodes.get(host)
@@ -233,6 +253,8 @@ class EventHandlersMixin:
                             flush_run()
                             run_job, run_status = job, new_status
                         run_tasks.append(cached)
+                        if now is not None:
+                            confirms.append((cached.key(), job.queue))
                         node = self.nodes.get(cached.node_name)
                         stored = node.tasks.get(cached.key()) \
                             if node is not None else None
@@ -255,10 +277,13 @@ class EventHandlersMixin:
                 except KeyError:
                     pass   # e.g. pod bound to a node we haven't seen yet
             flush_run()
+            if confirms:
+                ledger.confirm_bulk(confirms, now)
 
     def delete_pod(self, pod: obj.Pod) -> None:
         # a deleted pod drops its bind-failure history — the
         # un-quarantine path: a recreated pod starts a fresh retry budget
+        ledger.drop(pod.metadata.key())
         if self.retry_records or self.quarantined:
             self._clear_bind_retry_state(pod.metadata.key())
         self._delete_task(TaskInfo(pod))

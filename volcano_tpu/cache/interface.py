@@ -49,7 +49,7 @@ def _accepts_clone_fn(patch_fn) -> bool:
 
 
 def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool,
-                    fence=None) -> tuple:
+                    fence=None, trace=None) -> tuple:
     """Shared engine behind StoreBinder/FakeBinder ``bind_batch``: one
     bulk store pass (``bind_pods`` when the store has it — the sharded,
     natively-cloned pipeline — else ``patch_batch`` with per-host patch
@@ -77,9 +77,12 @@ def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool,
                 failed.append((pod, hostname))
         return failed, False
 
-    # the leader's fencing token rides every store write form (kwarg
-    # passed only when set, so stores without fencing keep working)
+    # the leader's fencing token and the flush's correlation ID ride
+    # every store write form (kwargs passed only when set, so stores
+    # without fencing/tracing keep working)
     fence_kw = {"fence": fence} if fence is not None else {}
+    if trace is not None:
+        fence_kw["trace"] = trace
     if bind_fn is not None:
         # payload-based fast path: no per-pod closures to build, and the
         # store can promote whole shards into fastmodel.bind_clone_pods
@@ -122,30 +125,37 @@ class StoreBinder:
     ``fence`` (attribute, set by the cache per write batch when lease
     fencing is configured) stamps the store writes with the leader's
     fencing token — a deposed incarnation's binds are rejected with
-    ``FencedError`` instead of landing after a takeover."""
+    ``FencedError`` instead of landing after a takeover. ``trace`` (same
+    attribute pattern) stamps them with the flush's correlation ID, so
+    the write is joinable scheduler -> store journal -> watch echo
+    (docs/design/observability.md)."""
 
     def __init__(self, store):
         self.store = store
         self.fence = None
+        self.trace = None
 
     def bind(self, pod: Pod, hostname: str) -> None:
         live = self.store.get("pods", pod.metadata.name, pod.metadata.namespace)
         if live is None:
             raise KeyError(f"pod {pod.metadata.key()} not found")
         live.spec.node_name = hostname
+        kwargs = {}
         fence = getattr(self, "fence", None)
         if fence is not None:
-            self.store.update("pods", live, skip_admission=True,
-                              fence=fence)
-        else:
-            self.store.update("pods", live, skip_admission=True)
+            kwargs["fence"] = fence
+        trace = getattr(self, "trace", None)
+        if trace is not None:
+            kwargs["trace"] = trace
+        self.store.update("pods", live, skip_admission=True, **kwargs)
 
     def bind_batch(self, items) -> list:
         """Batched bind; see :func:`bind_pods_batch`. Returns the failed
         [(pod, hostname)] for the caller to resync."""
         failed, _ = bind_pods_batch(self.store, items, self.bind,
                                     type(self).bind is StoreBinder.bind,
-                                    fence=getattr(self, "fence", None))
+                                    fence=getattr(self, "fence", None),
+                                    trace=getattr(self, "trace", None))
         return failed
 
 

@@ -1,0 +1,168 @@
+"""`vcctl debug`: fetch and pretty-print a running scheduler's /debug/*.
+
+    vcctl debug cycles      last N traced cycles (seq, wall, phases)
+    vcctl debug pending     why-pending per job / per reason
+    vcctl debug health      component health (exit 1 while degraded)
+    vcctl debug latency     pod lifecycle ledger percentiles
+    vcctl debug timeseries  last N cycles of key gauges/counters
+
+Talks to the metrics server (`--metrics` / $VOLCANO_METRICS, default
+http://127.0.0.1:8080), not the apiserver; `--json` prints the raw
+payload for piping into jq.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+from typing import List
+
+DEFAULT_METRICS = os.environ.get("VOLCANO_METRICS",
+                                 "http://127.0.0.1:8080")
+VERBS = ("cycles", "pending", "health", "latency", "timeseries")
+
+
+def fetch(server: str, path: str, timeout: float = 10.0):
+    """(status, payload) for one /debug GET; non-2xx still parses the
+    JSON error body (health serves 503 while degraded by design)."""
+    url = server.rstrip("/") + path
+    if not url.startswith("http"):
+        url = "http://" + url
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except Exception:
+            return e.code, {"error": str(e)}
+
+
+def _table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*header), fmt.format(*("-" * w for w in widths))]
+    lines += [fmt.format(*(str(c) for c in r)) for r in rows]
+    return "\n".join(lines)
+
+
+def _render_cycles(payload: dict) -> str:
+    cycles = payload.get("cycles", [])
+    if not cycles:
+        return ("no traced cycles (tracer "
+                f"{'enabled' if payload.get('enabled') else 'DISABLED'})")
+    rows = []
+    for c in cycles[-20:]:
+        top = sorted(c.get("phases", {}).items(),
+                     key=lambda kv: -kv[1]["ms"])[:3]
+        rows.append([c["seq"], c["cycle_ms"],
+                     f"{c.get('coverage', 0):.2f}",
+                     c.get("bind_flush_ms", ""),
+                     ",".join(c.get("over_budget", [])) or "-",
+                     " ".join(f"{n}={e['ms']}" for n, e in top)])
+    return _table(rows, ["seq", "cycle_ms", "cover", "flush_ms",
+                         "over_budget", "top phases (ms)"])
+
+
+def _render_pending(payload: dict) -> str:
+    lines = [f"pending jobs: {payload.get('pending_jobs', 0)}"]
+    if payload.get("idle_reason"):
+        lines.append(f"idle: {payload['idle_reason']} "
+                     f"({payload.get('detail', '')})")
+    reasons = payload.get("reasons") or {}
+    if reasons:
+        lines.append(_table(
+            [[r, n] for r, n in sorted(reasons.items(),
+                                       key=lambda kv: -kv[1])],
+            ["reason", "tasks"]))
+    jobs = payload.get("jobs") or {}
+    if jobs:
+        rows = [[k, j["queue"], j["pending_tasks"], j["unready"],
+                 j["min_available"],
+                 "; ".join(f"{r} x{n}" for r, n in j["reasons"].items())]
+                for k, j in sorted(jobs.items())]
+        lines.append(_table(rows, ["job", "queue", "pending", "unready",
+                                   "min", "reasons"]))
+    return "\n".join(lines)
+
+
+def _render_health(payload: dict) -> str:
+    lines = [f"healthy: {payload.get('healthy')}"]
+    comps = payload.get("components") or {}
+    if comps:
+        rows = [[name, c["healthy"], c.get("detail", "")]
+                for name, c in sorted(comps.items())]
+        lines.append(_table(rows, ["component", "healthy", "detail"]))
+    return "\n".join(lines)
+
+
+def _render_latency(payload: dict) -> str:
+    lines = [f"ledger: enabled={payload.get('enabled')} "
+             f"open={payload.get('open')} "
+             f"completed={payload.get('completed')} "
+             f"dropped={payload.get('dropped')} "
+             f"detours={payload.get('detours')}"]
+    hops = payload.get("hops") or {}
+    if hops:
+        rows = [[h, a["count"], a["mean_ms"], a["p50"], a["p95"], a["p99"]]
+                for h, a in hops.items()]
+        lines.append(_table(rows, ["hop", "count", "mean_ms", "p50",
+                                   "p95", "p99"]))
+    per_q = payload.get("per_queue_e2e") or {}
+    if per_q:
+        rows = [[q or "(unknown)", a["count"], a["p50"], a["p95"],
+                 a["p99"]] for q, a in per_q.items()]
+        lines.append("per-queue e2e:")
+        lines.append(_table(rows, ["queue", "count", "p50", "p95", "p99"]))
+    recent = payload.get("recent") or []
+    if recent:
+        rows = [[r["pod"], r.get("trace") or "-", r["e2e_ms"]]
+                for r in recent[-10:]]
+        lines.append("recent completions:")
+        lines.append(_table(rows, ["pod", "trace", "e2e_ms"]))
+    return "\n".join(lines)
+
+
+def _render_timeseries(payload: dict) -> str:
+    samples = payload.get("samples") or []
+    if not samples:
+        return "no samples (tracer off, or no cycle has run)"
+    cols: List[str] = []
+    for s in samples:
+        for k in s:
+            if k not in cols:
+                cols.append(k)
+    short = {c: c.replace("volcano_", "") for c in cols}
+    rows = [[s.get(c, "") for c in cols] for s in samples[-15:]]
+    return _table(rows, [short[c] for c in cols])
+
+
+_RENDER = {"cycles": _render_cycles, "pending": _render_pending,
+           "health": _render_health, "latency": _render_latency,
+           "timeseries": _render_timeseries}
+
+
+def dispatch_debug(args) -> int:
+    status, payload = fetch(args.metrics, f"/debug/{args.verb}")
+    if args.json:
+        print(json.dumps(payload, indent=1))
+    else:
+        print(_RENDER[args.verb](payload))
+    # /debug/health 503s while degraded — the exit code should say so
+    return 0 if status < 400 else 1
+
+
+def add_debug_parser(sub) -> None:
+    dbg = sub.add_parser(
+        "debug", help="fetch and pretty-print a running scheduler's "
+                      "/debug endpoints")
+    dbg.add_argument("verb", choices=VERBS)
+    dbg.add_argument("--metrics", "-m", default=DEFAULT_METRICS,
+                     help="metrics server endpoint "
+                          "(default $VOLCANO_METRICS or "
+                          "http://127.0.0.1:8080)")
+    dbg.add_argument("--json", action="store_true",
+                     help="print the raw JSON payload")

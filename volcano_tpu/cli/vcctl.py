@@ -2,12 +2,15 @@
 
     vcctl job   {run,list,view,suspend,resume,delete}
     vcctl queue {create,list,get,delete,operate}
-    vcctl sim   {run,smoke,replay}
+    vcctl sim   {run,smoke,chaos,failover,obs,replay}
+    vcctl debug {cycles,pending,health,latency,timeseries}
 
 job/queue talk HTTP to a running control plane (python -m
 volcano_tpu.cmd.cluster); --server or $VOLCANO_SERVER selects the
 endpoint. sim needs no server: the churn simulator owns its whole
-control plane in-process.
+control plane in-process. debug talks to the scheduler's METRICS
+server (--metrics / $VOLCANO_METRICS) and pretty-prints its /debug/*
+endpoints.
 """
 
 from __future__ import annotations
@@ -78,6 +81,9 @@ def build_parser() -> argparse.ArgumentParser:
     from ..sim.cli import add_sim_parser
     add_sim_parser(sub)
 
+    from .debug import add_debug_parser
+    add_debug_parser(sub)
+
     return parser
 
 
@@ -128,6 +134,14 @@ def main(argv: Optional[List[str]] = None, client=None) -> int:
         from ..sim.cli import dispatch_sim
         try:
             return dispatch_sim(args)
+        except Exception as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    if args.group == "debug":
+        # talks to the metrics server, not the apiserver client
+        from .debug import dispatch_debug
+        try:
+            return dispatch_debug(args)
         except Exception as e:
             print(f"error: {e}", file=sys.stderr)
             return 1

@@ -35,9 +35,11 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         help="accepted for flag parity; the TPU solver "
                              "evaluates all nodes exhaustively")
     parser.add_argument("--enable-tracing", action="store_true",
-                        help="turn on the cycle flight recorder "
-                             "(/debug/trace, /debug/cycles, /debug/pending "
-                             "on --listen-address; <2%% cycle overhead); "
+                        help="turn on the cycle flight recorder + pod "
+                             "lifecycle ledger + metrics timeseries "
+                             "(/debug/trace, /debug/cycles, /debug/pending, "
+                             "/debug/latency, /debug/timeseries on "
+                             "--listen-address; <2%% cycle overhead); "
                              "also enabled by VOLCANO_TRACE=1")
     parser.add_argument("--trace-cycles", type=int, default=None,
                         help="flight-recorder ring buffer: how many recent "

@@ -621,6 +621,7 @@ class BatchSolver:
             eligible = ladder[-1:]   # every tier open: still try the last
 
         kernel_inputs = None
+        account_transfer = False
         t_kernel = time.perf_counter()
         for i, (tier, kfn, kkwargs) in enumerate(eligible):
             span_name = "sharded" if tier == "sharded" else kfn.__name__
@@ -636,6 +637,7 @@ class BatchSolver:
                             allow_pipeline)
                     else:
                         if kernel_inputs is None:
+                            account_transfer = True
                             kernel_inputs = (
                                 jnp.asarray(batch.task_group),
                                 jnp.asarray(batch.task_job),
@@ -664,6 +666,18 @@ class BatchSolver:
                                 jnp.asarray(narr.n_tasks),
                                 jnp.asarray(narr.max_tasks), eps,
                                 self.score_weights())
+                        if account_transfer:
+                            # host->device staging bytes for this place
+                            # (gmask/static_score at indices 4-5 are
+                            # device-born — products of the context
+                            # build — so they don't count as transfer)
+                            account_transfer = False
+                            xfer = sum(
+                                int(getattr(a, "nbytes", 0))
+                                for i, a in enumerate(kernel_inputs)
+                                if i not in (4, 5))
+                            m.inc(m.DEVICE_TRANSFER_BYTES, float(xfer))
+                            trace.add_tags(transfer_bytes=xfer)
                         assign, pipelined, ready, kept, _ = kfn(
                             *kernel_inputs, allow_pipeline=allow_pipeline,
                             ns_live=ns_live, **kkwargs)
@@ -819,7 +833,18 @@ class BatchSolver:
         gn = NamedSharding(mesh, P(None, "nodes"))
         rep = NamedSharding(mesh, P())
         import jax
-        put = jax.device_put
+
+        from ..metrics import metrics as m
+        xfer = [0]
+
+        def put(a, s):
+            # host->device byte accounting: numpy inputs are genuine
+            # transfers; already-device arrays (gmask/static_score) are
+            # reshards and don't count
+            if isinstance(a, np.ndarray):
+                xfer[0] += int(a.nbytes)
+            return jax.device_put(a, s)
+
         assign, pipelined, ready, kept, _idle = fn(
             put(batch.task_group, rep), put(batch.task_job, rep),
             put(batch.task_valid, rep), put(batch.group_req, rep),
@@ -840,6 +865,9 @@ class BatchSolver:
             put(pad_nodes(narr.n_tasks, 0), n),
             put(pad_nodes(narr.max_tasks, 0), n),
             put(np.asarray(eps), rep), self.score_weights())
+        if xfer[0]:
+            m.inc(m.DEVICE_TRANSFER_BYTES, float(xfer[0]))
+            trace.add_tags(transfer_bytes=xfer[0])
         return assign, pipelined, ready, kept
 
     def _record_fit_errors(self, job: JobInfo, task: TaskInfo,

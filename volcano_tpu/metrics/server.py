@@ -3,13 +3,20 @@ the scheduler's /metrics on --listen-address, cmd/scheduler/app/
 server.go:85).
 
 Routes:
-  /metrics        Prometheus text exposition
-  /debug/cycles   ring-buffer summaries of the last N traced cycles
-  /debug/trace    Chrome trace-event JSON for one cycle (?seq=N, default
-                  the newest; load in chrome://tracing or Perfetto)
-  /debug/pending  "why pending": per-job / per-reason unschedulable counts
-  /debug/health   component health (cycle watchdog et al.); HTTP 503 when
-                  any component reports degraded
+  /metrics           Prometheus text exposition
+  /debug             index of the debug endpoints below
+  /debug/cycles      ring-buffer summaries of the last N traced cycles
+  /debug/trace       Chrome trace-event JSON for one cycle (?seq=N, default
+                     the newest; load in chrome://tracing or Perfetto)
+  /debug/pending     "why pending": per-job / per-reason unschedulable counts
+  /debug/health      component health (cycle watchdog et al.); HTTP 503 when
+                     any component reports degraded
+  /debug/latency     pod lifecycle ledger: per-hop and e2e latency
+                     percentiles, per-queue e2e, recent completions
+  /debug/timeseries  last N cycles of key gauges/counters (metrics ring)
+
+Unknown paths answer 404 with a JSON error body (never a bare status
+line), like every other route.
 """
 
 from __future__ import annotations
@@ -23,9 +30,33 @@ from typing import Optional
 from . import metrics as m
 
 
+# the /debug index: route -> one-line description
+DEBUG_ENDPOINTS = {
+    "/debug/cycles": "ring-buffer summaries of the last N traced cycles",
+    "/debug/trace": "Chrome trace-event JSON for one cycle (?seq=N)",
+    "/debug/pending": "why-pending: per-job/per-reason unschedulable counts",
+    "/debug/health": "component health (503 while degraded)",
+    "/debug/latency": "pod lifecycle ledger: per-hop/e2e latency percentiles",
+    "/debug/timeseries": "last N cycles of key gauges/counters",
+}
+
+
 def _debug_response(path: str, query: dict):
     """(status, payload dict) for a /debug/* path, None for unknown."""
     from ..trace import tracer
+    if path == "/debug":
+        return 200, {"endpoints": DEBUG_ENDPOINTS}
+    if path == "/debug/latency":
+        from ..trace import ledger
+        return 200, ledger.report()
+    if path == "/debug/timeseries":
+        from . import timeseries
+        limit = query.get("limit")
+        try:
+            n = int(limit[0]) if limit else None
+        except ValueError:
+            return 400, {"error": f"bad limit {limit[0]!r}"}
+        return 200, {"samples": timeseries.series(limit=n)}
     if path == "/debug/cycles":
         return 200, {"enabled": tracer.is_enabled(),
                      "cycles": [tracer.summary(r) for r in tracer.records()]}
@@ -70,7 +101,7 @@ class MetricsServer:
             def do_GET(self):
                 parsed = urllib.parse.urlsplit(self.path)
                 path = parsed.path.rstrip("/")
-                if path.startswith("/debug/"):
+                if path == "/debug" or path.startswith("/debug/"):
                     res = _debug_response(
                         path, urllib.parse.parse_qs(parsed.query))
                     if res is not None:
@@ -79,8 +110,13 @@ class MetricsServer:
                                    "application/json")
                         return
                 if path not in ("", "/metrics"):
-                    self.send_response(404)
-                    self.end_headers()
+                    # JSON error body like every other route (a bare 404
+                    # status line broke piped `curl | jq` diagnostics)
+                    self._send(404, json.dumps(
+                        {"error": "not found", "path": path,
+                         "endpoints": ["/metrics"]
+                         + sorted(DEBUG_ENDPOINTS)}).encode(),
+                        "application/json")
                     return
                 self._send(200, m.render_prometheus().encode(),
                            "text/plain; version=0.0.4")

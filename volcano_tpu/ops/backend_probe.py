@@ -1,0 +1,151 @@
+"""Instrumented, timeout-bounded accelerator backend-init probe.
+
+Since r03 the TPU backend has hung at bring-up on this deployment's
+tunnel, silently forcing every bench onto the CPU fallback. The old
+pre-probe (`bench.py tpu_alive`) only answered alive/dead; this probe
+makes the hang a *diagnosable artifact*: the child process emits one
+JSON line per init phase —
+
+    import_jax    import jax (wheel load, plugin discovery)
+    backend_init  jax.devices() (runtime handshake — the hang site)
+    device_op     first op on the device (executable path proven)
+
+— so a timeout tells you exactly where bring-up wedged (``last_phase``
+is the last phase that COMPLETED; the one after it hung) and how long
+the completed phases took. The parent runs the
+child under a hard timeout and kill, records
+``volcano_backend_probe_total{outcome="alive"|"dead"|"hang"}``, and
+returns a structured verdict dict that bench.py logs and embeds in its
+JSON row.
+
+Run standalone:  python -m volcano_tpu.ops.backend_probe [--timeout 120]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Optional
+
+DEFAULT_TIMEOUT_S = 120.0
+
+# The child runs as `python -c` with NO volcano_tpu import: importing
+# this module's own package (volcano_tpu.ops) pulls jax at import time,
+# which would both pre-pay the import the "import_jax" phase is supposed
+# to measure and drag jax into any parent that merely wants run_probe.
+_CHILD_CODE = r"""
+import json, time
+t0 = time.monotonic()
+
+def emit(phase, **extra):
+    rec = {"phase": phase, "ms": round((time.monotonic() - t0) * 1000.0, 1)}
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+import jax
+emit("import_jax", version=getattr(jax, "__version__", "?"))
+devs = jax.devices()
+emit("backend_init", platform=devs[0].platform, devices=len(devs))
+import jax.numpy as jnp
+x = jnp.arange(8)
+jax.block_until_ready(x + 1)
+emit("device_op", platform=devs[0].platform)
+"""
+
+
+def run_probe(timeout_s: Optional[float] = None, env: Optional[dict] = None,
+              log=None) -> dict:
+    """Probe backend bring-up in a killable child. Returns::
+
+        {"alive": bool, "platform": str|None, "timed_out": bool,
+         "last_phase": str|None, "phases": [{"phase", "ms", ...}],
+         "rc": int|None}
+
+    ``alive`` means every phase completed AND the platform is "tpu".
+    Without an explicit ``env`` the child runs under the current
+    environment MINUS JAX_PLATFORMS, so the probe sees the real backend;
+    an explicit ``env`` is used verbatim (tests pin the CPU backend this
+    way). ``log`` is an optional line sink for progress telemetry.
+    """
+    from ..metrics import metrics as m
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("VOLCANO_BENCH_TPU_PROBE_TIMEOUT",
+                                         DEFAULT_TIMEOUT_S))
+    if env is not None:
+        child_env = dict(env)
+    else:
+        child_env = dict(os.environ)
+        child_env.pop("JAX_PLATFORMS", None)
+    cmd = [sys.executable, "-c", _CHILD_CODE]
+    t0 = time.monotonic()
+    timed_out = False
+    rc: Optional[int] = None
+    out = ""
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout_s, env=child_env)
+        rc = r.returncode
+        out = r.stdout or ""
+    except subprocess.TimeoutExpired as e:
+        timed_out = True
+        raw = e.stdout or b""
+        out = raw.decode(errors="replace") if isinstance(raw, bytes) \
+            else raw
+    phases = []
+    for line in out.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue   # runtime banners / sitecustomize noise
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if "phase" in rec:
+            phases.append(rec)
+    last_phase = phases[-1]["phase"] if phases else None
+    platform = next((p.get("platform") for p in reversed(phases)
+                     if p.get("platform")), None)
+    alive = (not timed_out and rc == 0 and last_phase == "device_op"
+             and platform == "tpu")
+    outcome = "alive" if alive else ("hang" if timed_out else "dead")
+    try:
+        m.inc(m.BACKEND_PROBE, outcome=outcome)
+    except Exception:
+        pass
+    verdict = {"alive": alive, "platform": platform,
+               "timed_out": timed_out, "last_phase": last_phase,
+               "phases": phases, "rc": rc,
+               "wall_s": round(time.monotonic() - t0, 1)}
+    if log is not None:
+        for p in phases:
+            log(f"backend probe phase {p['phase']}: {p['ms']} ms "
+                + " ".join(f"{k}={v}" for k, v in p.items()
+                           if k not in ("phase", "ms")))
+        if timed_out:
+            log(f"backend probe HUNG after {timeout_s:.0f}s; last "
+                f"completed phase: {last_phase or '(none — import hung)'}")
+        else:
+            log(f"backend probe: rc={rc} platform={platform!r} -> "
+                f"{outcome}")
+    return verdict
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    timeout = DEFAULT_TIMEOUT_S
+    if "--timeout" in argv:
+        timeout = float(argv[argv.index("--timeout") + 1])
+    verdict = run_probe(timeout_s=timeout,
+                        log=lambda s: print(s, file=sys.stderr))
+    # ONE compact line: callers that subprocess this module (bench.py's
+    # parent keeps jax — and therefore this package — out of its own
+    # process) parse stdout's last line
+    print(json.dumps(verdict))
+    return 0 if verdict["alive"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -186,6 +186,14 @@ class Scheduler:
                                  "cycle time back under the watchdog "
                                  "deadline")
         m.update_e2e_duration(elapsed)
+        if tr.is_enabled():
+            # /debug/timeseries: one sample of the key gauges/counters
+            # per cycle (docs/design/observability.md) — rides the same
+            # production switch as the flight recorder
+            from .metrics import timeseries
+            timeseries.sample(self.clock.now(), extra={
+                "cycle_ms": round(elapsed * 1000.0, 3),
+                "seq": tr.current_seq()})
 
     def _watchdog_fire(self, deadline: float) -> None:
         """The cycle blew its watchdog deadline: record the breach and

@@ -97,6 +97,17 @@ def add_sim_parser(sub) -> None:
     failover.add_argument("--nodes", type=int, default=128)
     failover.add_argument("--json", action="store_true")
 
+    obs = sim.add_parser(
+        "obs", help="CI gate: short churn run asserting the pod "
+                    "lifecycle ledger fills (nonzero e2e/hop "
+                    "histograms), leaves zero orphaned entries, stamps "
+                    "traceable bind correlation IDs, and double-runs "
+                    "bit-identically (bind + ledger fingerprints)")
+    obs.add_argument("--seed", type=int, default=17)
+    obs.add_argument("--ticks", type=int, default=60)
+    obs.add_argument("--nodes", type=int, default=128)
+    obs.add_argument("--json", action="store_true")
+
     rep = sim.add_parser("replay", help="re-run a violation repro bundle")
     rep.add_argument("--bundle", required=True)
     rep.add_argument("--use-trace", action="store_true",
@@ -247,6 +258,30 @@ def failover_config(seed: int = 29, ticks: int = 120, nodes: int = 128):
         repro_dir=".")
 
 
+def obs_config(seed: int = 17, ticks: int = 60, nodes: int = 128):
+    """The `make obs-smoke` shape (docs/design/observability.md): a
+    resident backlog plus a Poisson stream with 2% bind failures and
+    mid-run gang pod losses, short enough for a double run in well under
+    a minute. Every pod that completes the pipeline must land in the
+    lifecycle ledger's e2e/hop histograms; pods deleted mid-flight must
+    be dropped (zero orphans); and the virtual clock makes both runs'
+    ledger aggregates bit-identical."""
+    from .engine import SimConfig
+    from .faults import FaultConfig
+    from .workload import WorkloadConfig
+    return SimConfig(
+        seed=seed, ticks=ticks, tick_s=1.0, n_nodes=nodes,
+        node_cpu="16", node_mem="32Gi",
+        resident_jobs=40, resident_gang=8,
+        workload=WorkloadConfig(
+            seed=seed, horizon_s=float(ticks), arrival_rate=0.3,
+            duration_min_s=10.0, duration_max_s=40.0),
+        faults=FaultConfig(
+            seed=seed, bind_fail_rate=0.02, api_latency_s=0.001),
+        fail_rate=0.05,
+        repro_dir=".")
+
+
 def _print_summary(summary: dict, as_json: bool) -> None:
     if as_json:
         print(json.dumps(summary, indent=1))
@@ -361,10 +396,20 @@ def dispatch_sim(args) -> int:
 
     if args.verb == "failover":
         from ..framework.solver import reset_breaker
+        from ..trace import ledger as _ledger
         from ..trace.pending import REASON_NOT_LEADER
+        from .engine import SimEngine
         reset_breaker()
-        r1 = run_sim(failover_config(seed=args.seed, ticks=args.ticks,
-                                     nodes=args.nodes))
+        eng1 = SimEngine(failover_config(seed=args.seed, ticks=args.ticks,
+                                         nodes=args.nodes))
+        r1 = eng1.run()
+        # observability acceptance: even across kills/handover/snapshot-
+        # restore, a confirmed bind's ledger correlation ID must join
+        # back to the (current) store's journal trace map
+        led_traces = {rec["trace"] for rec in _ledger.report()["recent"]
+                      if rec.get("trace")}
+        store_traces = {t for _, _, t in eng1.store.trace_ranges()}
+        trace_joinable = bool(led_traces & store_traces)
         reset_breaker()
         r2 = run_sim(failover_config(seed=args.seed, ticks=args.ticks,
                                      nodes=args.nodes))
@@ -382,6 +427,9 @@ def dispatch_sim(args) -> int:
             # the standby window said WHY nothing was being scheduled
             "standby_reason_surfaced":
                 REASON_NOT_LEADER in r1.pending_reasons_seen,
+            # a bind stays traceable scheduler -> store journal -> watch
+            # echo across the failover scenarios (obs layer, PR 6)
+            "bind_trace_joinable": trace_joinable,
             "bind_failures_fired": r1.resync_retries > 0
                                    and bool(r1.bind_sequence),
             "deterministic_replay":
@@ -407,6 +455,63 @@ def dispatch_sim(args) -> int:
             for name, ok in checks.items():
                 print(f"  {name}: {'ok' if ok else 'FAIL'}")
             print(f"failover-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
+        return 0 if verdict["pass"] else 1
+
+    if args.verb == "obs":
+        from ..framework.solver import reset_breaker
+        from .engine import SimEngine
+        reset_breaker()
+        eng1 = SimEngine(obs_config(seed=args.seed, ticks=args.ticks,
+                                    nodes=args.nodes))
+        r1 = eng1.run()
+        led1 = r1.ledger
+        # end-to-end correlation: a confirmed bind's ledger entry and
+        # the store's journal trace map must agree on the flush's
+        # correlation ID (the pod's CURRENT rv may already belong to a
+        # later unstamped write — the kubelet's Running echo — so the
+        # join runs over the recorded IDs, not live object rvs)
+        from ..trace import ledger as _ledger
+        led_traces = {r["trace"] for r in _ledger.report()["recent"]
+                      if r.get("trace")}
+        store_traces = {t for _, _, t in eng1.store.trace_ranges()}
+        traceable = bool(led_traces & store_traces)
+        reset_breaker()
+        r2 = run_sim(obs_config(seed=args.seed, ticks=args.ticks,
+                                nodes=args.nodes))
+        led2 = r2.ledger
+        checks = {
+            "no_violations": not r1.violations and not r2.violations,
+            # the ledger filled: completions flowed into nonzero e2e and
+            # per-hop histograms
+            "ledger_nonzero": led1.get("completed", 0) > 0
+                              and led1.get("e2e", {}).get("count", 0) > 0,
+            "zero_orphans": led1.get("orphans") == []
+                            and led2.get("orphans") == [],
+            "bind_trace_joinable": traceable,
+            "detours_recorded": bool(led1.get("detours"))
+                                == (r1.resync_retries > 0),
+            "deterministic_replay":
+                r1.bind_fingerprint() == r2.bind_fingerprint()
+                and led1.get("fingerprint") == led2.get("fingerprint"),
+        }
+        verdict = {
+            "obs": r1.summary(),
+            "checks": checks,
+            "pass": all(checks.values()),
+        }
+        if args.json:
+            print(json.dumps(verdict, indent=1))
+        else:
+            _print_summary(r1.summary(), False)
+            print(f"ledger: completed={led1.get('completed')} "
+                  f"open={led1.get('open')} dropped={led1.get('dropped')} "
+                  f"detours={led1.get('detours')}")
+            e2e = led1.get("e2e", {})
+            print(f"pod e2e ms: p50={e2e.get('p50')} p95={e2e.get('p95')} "
+                  f"p99={e2e.get('p99')} (n={e2e.get('count')})")
+            for name, ok in checks.items():
+                print(f"  {name}: {'ok' if ok else 'FAIL'}")
+            print(f"obs-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
         return 0 if verdict["pass"] else 1
 
     if args.verb == "replay":

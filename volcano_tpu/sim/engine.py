@@ -161,6 +161,10 @@ class SimResult:
         self.watch_drops = 0
         self.watch_delays = 0
         self.pending_reasons_seen: set = set()
+        # pod lifecycle ledger (docs/design/observability.md): stats +
+        # orphan audit + deterministic aggregate fingerprint, read off
+        # trace/ledger.py at end of run (the obs-smoke gate's surface)
+        self.ledger: dict = {}
 
     def bind_fingerprint(self) -> str:
         h = hashlib.sha256()
@@ -194,6 +198,7 @@ class SimResult:
             "divergence_repairs": self.divergence_repairs,
             "watch_drops": self.watch_drops,
             "pending_reasons_seen": sorted(self.pending_reasons_seen),
+            "ledger": dict(self.ledger),
             "cycle_ms": self.cycle_ms_percentiles(),
             "violations": [
                 {"tick": t, "invariant": v.invariant, "detail": v.detail}
@@ -615,12 +620,17 @@ class SimEngine:
     # -- main loop ---------------------------------------------------------
 
     def run(self) -> SimResult:
-        from ..trace import tracer
+        from ..metrics import timeseries
+        from ..trace import ledger, tracer
         cfg = self.cfg
         trace_was_on = tracer.is_enabled()
         tracer.enable()
         tracer.set_pending_report(None)   # a previous run's report must
         #                                   not leak into reasons_seen
+        # the ledger and timeseries ring are module-global: a previous
+        # run's aggregates must not leak into this run's fingerprint
+        ledger.reset()
+        timeseries.reset()
         try:
             self._create_base()
             self._install_watch_faults()
@@ -721,6 +731,12 @@ class SimEngine:
             if self._flaky_watch is not None:
                 self.result.watch_drops = self._flaky_watch.dropped
                 self.result.watch_delays = self._flaky_watch.delayed
+            lstats = ledger.stats()
+            lstats["orphans"] = ledger.orphans(self.store)
+            lstats["fingerprint"] = ledger.fingerprint()
+            e2e = ledger.report()["hops"].get("e2e", {})
+            lstats["e2e"] = e2e
+            self.result.ledger = lstats
             return self.result
         finally:
             if not trace_was_on:

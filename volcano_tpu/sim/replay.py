@@ -11,6 +11,9 @@ simulation up to the violating tick:
 * ``trace.json`` — the offending cycle's flight-recorder export
   (Chrome trace-event JSON, Perfetto-loadable), when the tracer has a
   record
+* ``timeseries.json`` — the metrics time-series ring (last N cycles of
+  key gauges/counters, ``/debug/timeseries``'s payload) plus the pod
+  lifecycle ledger report at violation time
 
 ``replay_bundle()`` reconstructs the config and re-runs it; because the
 generators are seeded the re-run needs nothing but ``bundle.json``, and
@@ -55,6 +58,11 @@ def write_repro_bundle(base_dir: str, engine, tick: int,
     if rec is not None:
         with open(os.path.join(path, "trace.json"), "w") as f:
             json.dump(tracer.chrome_trace(rec), f)
+    from ..metrics import timeseries
+    from ..trace import ledger
+    with open(os.path.join(path, "timeseries.json"), "w") as f:
+        json.dump({"samples": timeseries.series(),
+                   "latency": ledger.report()}, f, indent=1)
     return path
 
 

@@ -1,3 +1,5 @@
-"""Cycle flight recorder (tracer) + "why pending" diagnosis (pending)."""
+"""Cycle flight recorder (tracer), pod lifecycle ledger (ledger) and
+"why pending" diagnosis (pending)."""
 
+from . import ledger  # noqa: F401
 from . import tracer  # noqa: F401

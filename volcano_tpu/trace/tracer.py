@@ -183,11 +183,15 @@ class _AsyncChildCtx:
 
 
 def enable(capacity: Optional[int] = None) -> None:
-    """Turn the flight recorder on (idempotent)."""
+    """Turn the flight recorder on (idempotent). The pod lifecycle
+    ledger (trace/ledger.py) rides the same switch: one production
+    toggle covers both, and the <2% overhead gate measures both."""
     global _enabled
     if capacity is not None:
         configure(capacity=capacity)
     _enabled = True
+    from . import ledger
+    ledger.enable()
 
 
 def disable() -> None:
@@ -195,6 +199,8 @@ def disable() -> None:
     _enabled = False
     _tls.stack = None
     _tls.astack = None
+    from . import ledger
+    ledger.disable()
 
 
 def is_enabled() -> bool:

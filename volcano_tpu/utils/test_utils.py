@@ -23,10 +23,11 @@ class FakeBinder:
         self.binds: Dict[str, str] = {}
         self.channel: List[str] = []
         self.store = store
-        # leader fencing token to stamp on store writes (set by the
-        # cache per write batch when fencing is configured; see
+        # leader fencing token / flush correlation ID to stamp on store
+        # writes (set by the cache per write batch when configured; see
         # cache.interface.StoreBinder)
         self.fence = None
+        self.trace = None
 
     def bind(self, pod: Pod, hostname: str) -> None:
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
@@ -34,12 +35,15 @@ class FakeBinder:
             live = self.store.get("pods", pod.metadata.name, pod.metadata.namespace)
             if live is not None:
                 live.spec.node_name = hostname
+                kwargs = {}
                 fence = getattr(self, "fence", None)
                 if fence is not None:
-                    self.store.update("pods", live, skip_admission=True,
-                                      fence=fence)
-                else:
-                    self.store.update("pods", live, skip_admission=True)
+                    kwargs["fence"] = fence
+                trace = getattr(self, "trace", None)
+                if trace is not None:
+                    kwargs["trace"] = trace
+                self.store.update("pods", live, skip_admission=True,
+                                  **kwargs)
         # record AFTER the store write: a fenced/failed write must not
         # appear in the bind channel (the sim's bind sequence is the
         # record of effective writers)
@@ -56,7 +60,8 @@ class FakeBinder:
         failed, used_batch = bind_pods_batch(
             self.store, items, self.bind,
             type(self).bind is FakeBinder.bind,
-            fence=getattr(self, "fence", None))
+            fence=getattr(self, "fence", None),
+            trace=getattr(self, "trace", None))
         if used_batch:
             gone = set(map(id, (pod for pod, _ in failed)))
             for pod, hostname in items:
