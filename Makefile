@@ -4,7 +4,7 @@ PYTHON ?= python
 
 .PHONY: test unit-test e2e bench bench-all bench-check multichip-dryrun \
 	deploy deploy-up trace-smoke sim-smoke flush-bench chaos-smoke \
-	failover-smoke obs-smoke
+	failover-smoke obs-smoke incr-smoke
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -94,11 +94,22 @@ failover-smoke: chaos-smoke
 obs-smoke: failover-smoke
 	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli obs
 
-# bench regression gate: compare the fresh BENCH_r06.json row (written
-# by `make bench`) against the BENCH_r05 baseline with machine-
-# calibration scaling (this box drifts up to ~2.3x vs the r05 capture).
-# Exit 1 on a scaled regression or a row missing the r06 latency
-# percentiles.
+# incremental-cycle gate (docs/design/incremental_cycle.md), after
+# obs-smoke: 200 ticks of seeded churn (bursty backlog, node flaps, a
+# quiet tail) executed TWICE — once on the incremental persistent
+# snapshot, once with full rebuilds forced every tick. Exit 1 unless the
+# two runs' bind sequences AND lifecycle-ledger aggregates are
+# bit-identical, both stay invariant-clean (incl. journal order), and
+# the incremental/quiet fast paths demonstrably engaged.
+incr-smoke: obs-smoke
+	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli incr
+
+# bench regression gate: compare the fresh BENCH_r07.json row (written
+# by `make bench`) against the BENCH_r06 baseline with machine-
+# calibration scaling (this box drifts up to ~2.3x across captures).
+# Exit 1 on a scaled regression, a row missing the r06 observability
+# fields, or an incremental steady-state cycle missing/over its 20 ms
+# machine-adjusted budget.
 bench-check:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_check.py
 

@@ -1,0 +1,382 @@
+"""Incremental steady-state cycle (docs/design/incremental_cycle.md).
+
+The contract under test: with ``cache.incremental`` on, snapshot() keeps
+ONE persistent ClusterInfo patched per dirty job/node and the resulting
+scheduling decisions are BIT-IDENTICAL to rebuilding the snapshot from
+scratch every cycle — across quiet, bursty and node-flap churn — while
+self-inflicted bind echoes never re-dirty, structural changes and
+anti-entropy repairs force full rebuilds, and the solver's persistent
+device buffers actually get reused.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.apiserver.store import ObjectStore
+from volcano_tpu.framework.solver import reset_breaker
+from volcano_tpu.metrics import metrics as m
+from volcano_tpu.models.job_info import TaskStatus
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.utils.test_utils import (FakeBinder, FakeEvictor,
+                                          build_node, build_pod,
+                                          build_pod_group, build_queue)
+
+CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+def _env(incremental: bool = True):
+    store = ObjectStore()
+    binder = FakeBinder(store)
+    cache = SchedulerCache(store, binder=binder,
+                           evictor=FakeEvictor(store))
+    sched = Scheduler(store, cache=cache, scheduler_conf=CONF,
+                      incremental=incremental, anti_entropy_every=0)
+    store.create("queues", build_queue("default", weight=1))
+    for i in range(6):
+        store.create("nodes", build_node(
+            f"node-{i}", {"cpu": "16", "memory": "32Gi"}))
+    cache.run()
+    return store, cache, binder, sched
+
+
+def _add_gang(store, name, size=3, cpu="2"):
+    store.create("podgroups", build_pod_group(
+        name, "default", "default", size, phase="Inqueue"))
+    for t in range(size):
+        store.create("pods", build_pod(
+            "default", f"{name}-{t}", "", "Pending",
+            {"cpu": cpu, "memory": "4Gi"}, groupname=name))
+
+
+def _cycle(sched, cache):
+    sched.run_once()
+    cache.flush_executors(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# incremental-vs-full equivalence on seeded churn
+# ---------------------------------------------------------------------------
+
+def _churn_cfg(kind, incremental):
+    from volcano_tpu.sim.engine import SimConfig
+    from volcano_tpu.sim.faults import FaultConfig
+    from volcano_tpu.sim.workload import WorkloadConfig
+    base = dict(seed=11, ticks=40, tick_s=1.0, n_nodes=32,
+                node_cpu="16", node_mem="32Gi", repro_dir=None,
+                incremental=incremental)
+    if kind == "quiet":
+        # a short burst then a long dirty-free tail: the quiet fast
+        # path must engage without perturbing a single decision
+        return SimConfig(resident_jobs=10, resident_gang=4,
+                         workload=WorkloadConfig(seed=11, horizon_s=5.0,
+                                                 arrival_rate=0.4),
+                         faults=FaultConfig(seed=11), **base)
+    if kind == "bursty":
+        return SimConfig(resident_jobs=24, resident_gang=8,
+                         workload=WorkloadConfig(seed=11, horizon_s=30.0,
+                                                 arrival_rate=1.0),
+                         faults=FaultConfig(seed=11), fail_rate=0.1,
+                         **base)
+    return SimConfig(resident_jobs=12, resident_gang=4,   # node-flap
+                     workload=WorkloadConfig(seed=11, horizon_s=30.0,
+                                             arrival_rate=0.4),
+                     faults=FaultConfig(seed=11, flap_rate=0.08,
+                                        flap_down_s=5.0),
+                     fail_rate=0.05, **base)
+
+
+@pytest.mark.parametrize("kind", ["quiet", "bursty", "flap"])
+def test_incremental_vs_full_equivalence(kind):
+    """Bind-for-bind + ledger-for-ledger equivalence of the persistent
+    patched snapshot vs a full rebuild every tick, per churn regime."""
+    from volcano_tpu.sim.engine import run_sim
+    reset_breaker()
+    r_incr = run_sim(_churn_cfg(kind, True))
+    reset_breaker()
+    r_full = run_sim(_churn_cfg(kind, False))
+    assert not r_incr.violations and not r_full.violations
+    assert r_incr.cycle_modes.get("incremental", 0) > 0
+    assert r_full.cycle_modes == {"legacy": 40}
+    assert r_incr.bind_fingerprint() == r_full.bind_fingerprint()
+    assert r_incr.ledger.get("fingerprint") == \
+        r_full.ledger.get("fingerprint")
+    if kind == "quiet":
+        assert r_incr.quiet_cycles > 0
+
+
+# ---------------------------------------------------------------------------
+# dirty-set semantics
+# ---------------------------------------------------------------------------
+
+def test_self_echo_does_not_dirty():
+    """A flush's own bind echo must leave NO dirty residue beyond the
+    bind apply itself — consumed by the next snapshot — while a foreign
+    pod patch (no expected-echo hint) dirties like any watch delta."""
+    store, cache, binder, sched = _env()
+    _add_gang(store, "gang-a")
+    _cycle(sched, cache)
+    assert len(binder.binds) == 3
+    # everything the bind touched was consumed by a snapshot by now; a
+    # further snapshot must see a clean dirty set (the echo did not
+    # re-dirty what the apply already reconciled)
+    _cycle(sched, cache)
+    snap = cache.snapshot()
+    assert snap.incr_mode == "incremental"
+    assert not snap.patched_jobs and not snap.patched_nodes
+
+    # foreign writer: same patch shape as a bind echo, but with no
+    # expected-echo hint on this thread -> it must dirty the job
+    def noop(pod):
+        pass
+
+    store.patch_batch("pods", [("gang-a-0", "default", noop)])
+    snap = cache.snapshot()
+    assert "default/gang-a" in snap.patched_jobs
+    cache.stop()
+
+
+def test_update_pods_bulk_hint_skips_dirty():
+    """The unit form: the SAME delivery dirties without the hint and
+    does not with it."""
+    import threading
+    store, cache, binder, sched = _env()
+    _add_gang(store, "gang-b")
+    _cycle(sched, cache)
+    _cycle(sched, cache)
+    cache.snapshot()            # consume
+    job = cache.jobs["default/gang-b"]
+    task = next(iter(job.tasks.values()))
+    old = store.get("pods", task.name, task.namespace)
+    new = old                    # same object: a pure rv-style echo
+    hint = {task.uid: (task, task.node_name)}
+    cache._expected_bind_echo = (threading.get_ident(), hint)
+    try:
+        cache.update_pods_bulk([(old, new)])
+    finally:
+        cache._expected_bind_echo = None
+    assert "default/gang-b" not in cache._dirty_jobs
+    cache.update_pods_bulk([(old, new)])
+    assert "default/gang-b" in cache._dirty_jobs
+    cache.stop()
+
+
+def test_structural_change_forces_full_rebuild():
+    """Queue / priority-class edits invalidate the persistent snapshot
+    wholesale."""
+    from volcano_tpu.models.objects import ObjectMeta, PriorityClass
+    store, cache, binder, sched = _env()
+    _add_gang(store, "gang-c")
+    _cycle(sched, cache)
+    _cycle(sched, cache)
+    assert cache.snapshot().incr_mode == "incremental"
+    store.create("priorityclasses",
+                 PriorityClass(metadata=ObjectMeta(name="high"), value=9))
+    assert cache.snapshot().incr_mode == "full"
+    assert cache.snapshot().incr_mode == "incremental"
+    q = store.get("queues", "default")
+    store.update("queues", q)
+    assert cache.snapshot().incr_mode == "full"
+    cache.stop()
+
+
+def test_fingerprint_repair_invalidates_snapshot():
+    """An anti-entropy pass that repaired divergence means the watch
+    stream (and therefore the dirty sets) lied: the persistent snapshot
+    must be rebuilt."""
+    store, cache, binder, sched = _env()
+    _add_gang(store, "gang-d")
+    _cycle(sched, cache)
+    _cycle(sched, cache)
+    assert cache.snapshot().incr_mode == "incremental"
+    # clean pass: no divergence, no invalidation
+    rep = cache.anti_entropy()
+    assert rep["repaired"] == 0
+    assert cache.snapshot().incr_mode == "incremental"
+    # diverge the cache behind the watch's back, then repair
+    cache.nodes.pop("node-5")
+    cache.node_list.remove("node-5")
+    rep = cache.anti_entropy()
+    assert rep["repaired"] >= 1
+    assert cache.snapshot().incr_mode == "full"
+    cache.stop()
+
+
+def test_periodic_full_recompute_cadence():
+    store, cache, binder, sched = _env()
+    cache.INCR_FULL_RECOMPUTE_EVERY_CYCLES = 3
+    _add_gang(store, "gang-e")
+    modes = []
+    for _ in range(7):
+        sched.run_once()
+        modes.append(cache.last_snapshot_stats["mode"])
+    cache.flush_executors(timeout=30)
+    assert modes[0] == "full"
+    assert modes[3] == "full" and modes[6] == "full"
+    assert modes[1] == modes[2] == modes[4] == modes[5] == "incremental"
+    cache.stop()
+
+
+def test_retry_backoff_jobs_stay_in_working_set():
+    """Bind-backoff expiry is time-based (no watch delta): jobs with
+    live retry records must re-enter the dirty set every snapshot."""
+    store, cache, binder, sched = _env()
+    _add_gang(store, "gang-f")
+    _cycle(sched, cache)
+    _cycle(sched, cache)
+    cache.snapshot()
+    from volcano_tpu.cache.cache import _RetryRecord
+    rec = _RetryRecord("default/gang-f-0", "default/gang-f")
+    rec.attempts = 1
+    rec.not_before = store.clock.now() + 60.0
+    cache.retry_records[rec.key] = rec
+    snap = cache.snapshot()
+    assert "default/gang-f" in snap.patched_jobs
+    snap = cache.snapshot()     # every cycle, not just once
+    assert "default/gang-f" in snap.patched_jobs
+    del cache.retry_records[rec.key]
+    snap = cache.snapshot()
+    assert "default/gang-f" not in snap.patched_jobs
+    cache.stop()
+
+
+# ---------------------------------------------------------------------------
+# snapshot content equivalence
+# ---------------------------------------------------------------------------
+
+def test_patched_snapshot_matches_full_rebuild():
+    """After mixed churn, the patched persistent snapshot must be
+    content- and ORDER-identical to a from-scratch rebuild of the same
+    cache (dict order feeds float-accumulation order downstream)."""
+    store, cache, binder, sched = _env()
+    for j in range(4):
+        _add_gang(store, f"gang-g{j}")
+    _cycle(sched, cache)
+    # churn: a pod fails, a node drains, a new gang arrives
+    store.delete("pods", "gang-g0-1", "default", skip_admission=True)
+    node = store.get("nodes", "node-2")
+    node.spec.unschedulable = True
+    store.update("nodes", node, skip_admission=True)
+    _add_gang(store, "gang-h")
+    _cycle(sched, cache)
+    _cycle(sched, cache)
+    snap = cache.snapshot()
+    assert snap.incr_mode == "incremental"
+    with cache.mutex:
+        full = cache._snapshot_locked()
+    assert list(snap.jobs) == list(full.jobs)
+    assert list(snap.nodes) == list(full.nodes)
+    assert snap.node_list == full.node_list
+    for uid, job in full.jobs.items():
+        pj = snap.jobs[uid]
+        assert {u: t.status for u, t in pj.tasks.items()} == \
+            {u: t.status for u, t in job.tasks.items()}
+        assert pj.priority == job.priority
+        assert pj.pod_group.status.phase == job.pod_group.status.phase
+    for name, ninfo in full.nodes.items():
+        pn = snap.nodes[name]
+        assert pn.idle.milli_cpu == ninfo.idle.milli_cpu
+        assert pn.idle.memory == ninfo.idle.memory
+        assert sorted(pn.tasks) == sorted(ninfo.tasks)
+    # the maintained total must equal the rebuild-order sum bitwise
+    total = None
+    from volcano_tpu.models.resource import Resource
+    total = Resource()
+    for n in full.nodes.values():
+        total.add(n.allocatable)
+    assert snap.total_resource.milli_cpu == total.milli_cpu
+    assert snap.total_resource.memory == total.memory
+    cache.stop()
+
+
+# ---------------------------------------------------------------------------
+# quiet fast path + device buffers
+# ---------------------------------------------------------------------------
+
+def test_quiet_cycle_skips_plugin_opens():
+    from volcano_tpu.framework import (close_session, open_session,
+                                       parse_scheduler_conf)
+    store, cache, binder, sched = _env()
+    _add_gang(store, "gang-i")
+    _cycle(sched, cache)
+    _cycle(sched, cache)
+    _cycle(sched, cache)
+    conf = parse_scheduler_conf(CONF)
+    ssn = open_session(cache, conf.tiers, conf.configurations,
+                       actions=conf.actions)
+    try:
+        assert ssn.quiet_cycle
+        assert ssn.plugins == {}          # opens skipped wholesale
+        assert ssn.total_resource is not None
+    finally:
+        close_session(ssn)
+    # without the action list the fast path must not engage (the caller
+    # might run time-based actions the skip would starve)
+    ssn = open_session(cache, conf.tiers, conf.configurations)
+    try:
+        assert not ssn.quiet_cycle
+        assert ssn.plugins
+    finally:
+        close_session(ssn)
+    cache.stop()
+
+
+def _counter(name, **labels):
+    key = (name, tuple(sorted(labels.items())))
+    with m._lock:
+        return m._counters.get(key, 0.0)
+
+
+def test_device_buffer_reuse_and_scoped_transfer():
+    """Across incremental cycles with pending work, the solver must
+    reuse its persistent device buffers (scatter-updating dirty rows)
+    instead of re-uploading the full node tensors."""
+    store, cache, binder, sched = _env()
+    _add_gang(store, "gang-j", size=2)
+    xfer0 = _counter(m.DEVICE_TRANSFER_BYTES)
+    sched.run_once()            # full snapshot; kernel runs; buffers built
+    cache.flush_executors(timeout=30)
+    full_stage = _counter(m.DEVICE_TRANSFER_BYTES) - xfer0
+    rebuilds0 = _counter(m.SOLVER_DEVICE_BUFFER, event="rebuild")
+    reuse0 = _counter(m.SOLVER_DEVICE_BUFFER, event="reuse")
+    assert rebuilds0 >= 1 and full_stage > 0
+    _add_gang(store, "gang-k", size=2)
+    xfer1 = _counter(m.DEVICE_TRANSFER_BYTES)
+    sched.run_once()            # incremental; kernel runs again
+    cache.flush_executors(timeout=30)
+    incr_stage = _counter(m.DEVICE_TRANSFER_BYTES) - xfer1
+    assert cache.last_snapshot_stats["mode"] == "incremental"
+    assert _counter(m.SOLVER_DEVICE_BUFFER, event="reuse") > reuse0
+    assert _counter(m.SOLVER_DEVICE_BUFFER, event="rebuild") == rebuilds0
+    # steady-state transfer ~= batch arrays + the dirty node rows,
+    # strictly below the full-upload cycle's staging
+    assert 0 < incr_stage < full_stage
+    cache.stop()
+
+
+def test_cycle_mode_metrics_and_stats():
+    store, cache, binder, sched = _env()
+    full0 = _counter(m.CYCLE_MODE, mode="full")
+    incr0 = _counter(m.CYCLE_MODE, mode="incremental")
+    _add_gang(store, "gang-m")
+    _cycle(sched, cache)
+    _cycle(sched, cache)
+    assert _counter(m.CYCLE_MODE, mode="full") == full0 + 1
+    assert _counter(m.CYCLE_MODE, mode="incremental") == incr0 + 1
+    stats = cache.last_snapshot_stats
+    assert set(stats) >= {"mode", "quiet", "dirty_jobs", "dirty_nodes",
+                          "patched_jobs", "patched_nodes"}
+    cache.stop()

@@ -1,8 +1,8 @@
 """Bench regression gate: compare a fresh bench row against a baseline.
 
-    python tools/bench_check.py                         # BENCH_r06 vs r05
-    python tools/bench_check.py --row BENCH_r06.json \
-        --baseline BENCH_r05.json --tolerance 0.35
+    python tools/bench_check.py                         # BENCH_r07 vs r06
+    python tools/bench_check.py --row BENCH_r07.json \
+        --baseline BENCH_r06.json --tolerance 0.35
 
 Compares the headline cycle latency and its secondary rows (kernel,
 steady-state, bind flush) against the baseline with MACHINE-CALIBRATION
@@ -45,6 +45,14 @@ GATED_KEYS = (("value", "full cycle ms", 0.0),
 # the r05 box's documented calibration fingerprint (bench_suite
 # machine_calibration docstring: round-5 observed ~32-40 ms)
 R05_CALIBRATION_MS = 36.0
+
+# incremental steady-state budget (docs/design/incremental_cycle.md):
+# the ROADMAP's <20 ms target is in r05-machine milliseconds, so the
+# gate scales it by fresh_cal / R05_CALIBRATION like every other number;
+# the row must also have measured it at a quiet (<=1%) dirty fraction —
+# a churn-heavy measurement would not be the steady-state claim.
+INCR_TARGET_MS = 20.0
+INCR_MAX_DIRTY_FRACTION = 0.01
 
 
 def load_row(path: str) -> dict:
@@ -101,6 +109,40 @@ def check(fresh: dict, baseline: dict, tolerance: float,
             failures.append(
                 f"{label}: {cur:.1f} ms > {budget:.1f} ms budget "
                 f"({base:.1f} x{scale:.2f} +{tol:.0%})")
+    # incremental steady-state (the r07 row's new headline secondary):
+    # gated against the ABSOLUTE r05-machine target, calibration-scaled —
+    # not against a baseline row, because r06 had no incremental mode
+    incr = fresh.get("steady_state_incremental_ms")
+    cal_scale = fresh_cal / R05_CALIBRATION_MS
+    incr_budget = INCR_TARGET_MS * cal_scale
+    if incr in (None, 0, 0.0):
+        failures.append("steady_state_incremental_ms missing — the row "
+                        "predates the incremental cycle (re-run `python "
+                        "bench.py`)")
+    else:
+        verdict = "ok" if float(incr) <= incr_budget else "REGRESSION"
+        print(f"  {'incremental steady ms':<24} {float(incr):9.1f} vs "
+              f"budget {incr_budget:9.1f} ({INCR_TARGET_MS:.0f} ms "
+              f"r05-machine x{cal_scale:.2f}) {verdict}")
+        if verdict != "ok":
+            failures.append(
+                f"incremental steady-state: {incr:.1f} ms > "
+                f"{incr_budget:.1f} ms machine-adjusted budget")
+        full = fresh.get("steady_state_ms")
+        if full and float(incr) >= float(full):
+            failures.append(
+                f"incremental steady-state ({incr:.1f} ms) is not faster "
+                f"than the full rebuild ({full:.1f} ms)")
+        dirty = fresh.get("dirty_fraction")
+        if dirty is None:
+            failures.append("dirty_fraction missing from the fresh row")
+        elif float(dirty) > INCR_MAX_DIRTY_FRACTION:
+            failures.append(
+                f"dirty_fraction {dirty} > {INCR_MAX_DIRTY_FRACTION} — "
+                "the incremental number was not measured at steady state")
+        else:
+            print(f"  {'dirty fraction':<24} {float(dirty):9.5f} "
+                  f"(<= {INCR_MAX_DIRTY_FRACTION}) ok")
     # observability fields the r06 row must carry
     lat = fresh.get("pod_latency") or {}
     e2e = lat.get("e2e") or {}
@@ -126,10 +168,10 @@ def check(fresh: dict, baseline: dict, tolerance: float,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--row", default=os.path.join(REPO, "BENCH_r06.json"),
+    ap.add_argument("--row", default=os.path.join(REPO, "BENCH_r07.json"),
                     help="fresh bench row (bench.py writes it)")
     ap.add_argument("--baseline",
-                    default=os.path.join(REPO, "BENCH_r05.json"))
+                    default=os.path.join(REPO, "BENCH_r06.json"))
     ap.add_argument("--tolerance", type=float, default=0.35,
                     help="allowed fractional slowdown after calibration "
                          "scaling (shared-box noise is ±15-25%%)")

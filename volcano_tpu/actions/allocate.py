@@ -52,8 +52,34 @@ class AllocateAction(Action):
                    for job in ssn.jobs.values()):
             return []
 
+        # scoped working set (docs/design/incremental_cycle.md): on an
+        # incremental cycle where NO node changed, a pending job outside
+        # the patched set is in exactly the state it was last evaluated
+        # in, against exactly the same cluster — re-running the kernel
+        # over it must repeat last cycle's no-placement (a placement
+        # would have dirtied it), so it is skipped. Any patched node (or
+        # a full rebuild) widens the set back to every pending job:
+        # freed/changed capacity can unlock any of them. Reservation
+        # locks are SESSION-GLOBAL state with no cache delta (the elect
+        # action locks/unlocks nodes on its own clock), so any active or
+        # just-changed lock state widens too — a job parked by a lock
+        # must be re-evaluated the cycle the lock lifts.
+        working = None
+        if getattr(ssn, "incr_mode", None) == "incremental" \
+                and not ssn.patched_nodes \
+                and not self._reservation_active_or_changed(ssn):
+            working = set(ssn.patched_jobs or ()) | ssn.touched_jobs
+        skipped_jobs = skipped_tasks = 0
+
         jobs_by_ns_queue: Dict[str, Dict[str, List[JobInfo]]] = {}
         for job in ssn.jobs.values():
+            if working is not None and job.uid not in working:
+                n_pending = len(job.task_status_index.get(
+                    TaskStatus.Pending, ()))
+                if n_pending:
+                    skipped_jobs += 1
+                    skipped_tasks += n_pending
+                continue
             if job.pod_group.status.phase == PodGroupPhase.PENDING:
                 continue
             vr = ssn.job_valid(job)
@@ -63,6 +89,9 @@ class AllocateAction(Action):
                 continue
             jobs_by_ns_queue.setdefault(job.namespace, {}) \
                 .setdefault(job.queue, []).append(job)
+        if skipped_jobs:
+            trace.tag_cycle(skipped_jobs=skipped_jobs,
+                            skipped_tasks=skipped_tasks)
 
         import functools
         ns_sorted = sorted(
@@ -93,6 +122,25 @@ class AllocateAction(Action):
                     jobs.sort(key=job_key)
                     ordered.extend(jobs)
         return ordered
+
+    @staticmethod
+    def _reservation_active_or_changed(ssn) -> bool:
+        """True while reservation locks are live OR the lock state
+        differs from the previous cycle's (the unlock transition itself
+        carries no cache delta, so the cycle it happens on must
+        re-evaluate every pending job)."""
+        from ..utils.reservation import RESERVATION
+        state = (RESERVATION.target_job.uid
+                 if RESERVATION.target_job is not None else None,
+                 frozenset(RESERVATION.locked_nodes))
+        cache = ssn.cache
+        prev = getattr(cache, "_incr_reservation_state", None) \
+            if cache is not None else None
+        if cache is not None:
+            cache._incr_reservation_state = state
+        if state != (None, frozenset()):
+            return True
+        return prev is not None and prev != state
 
     def _pending_tasks(self, ssn, job: JobInfo) -> List[TaskInfo]:
         """Pending, non-best-effort, task-order sorted (allocate.go:183-196).

@@ -284,6 +284,32 @@ class ObjectStore:
         else:
             self._journal_parked[rv] = (rv, action, kind, o)
 
+    def _journal_extend_locked(self, entries) -> None:
+        """Bulk sequencer append for a CONTIGUOUS ascending run of
+        entries — ONE call per published shard instead of one per entry
+        (journal write batching, the phase-3 lever from
+        docs/design/bind_pipeline.md). Semantics match replaying
+        :meth:`_journal_append_locked` over the run: either the whole run
+        lands (its head extends the tail; parked entries above it drain
+        after) or the whole run parks (nothing below it has landed —
+        contiguity means no interior entry could land either)."""
+        if not entries:
+            return
+        if entries[0][0] == self._journal_tail + 1:
+            self._journal.extend(entries)
+            self._journal_tail = entries[-1][0]
+            parked = self._journal_parked
+            while parked:
+                nxt = parked.pop(self._journal_tail + 1, None)
+                if nxt is None:
+                    break
+                self._journal.append(nxt)
+                self._journal_tail += 1
+            self._journal_cond.notify_all()
+        else:
+            for e in entries:
+                self._journal_parked[e[0]] = e
+
     def _wait_key_writable_locked(self, kind: str, key: str) -> None:
         """Block (releasing the lock) while ``key`` has a reserved bulk
         patch in flight — the write must order after the shard publish."""
@@ -690,23 +716,15 @@ class ObjectStore:
         with self._lock:
             objs = self._objects[kind]
             infl = self._inflight[kind]
-            first = news[0].metadata.resource_version
-            fast = self._journal_tail + 1 == first \
-                and not self._journal_parked
+            entries = []
             for (key, _, _), new in zip(shard, news):
                 objs[key] = new
                 infl.discard(key)
-                if fast:
-                    self._journal.append(
-                        (new.metadata.resource_version, "MODIFIED", kind,
-                         new))
-                else:
-                    self._journal_append_locked(
-                        new.metadata.resource_version, "MODIFIED", kind,
-                        new)
-            if fast:
-                self._journal_tail = news[-1].metadata.resource_version
-                self._journal_cond.notify_all()
+                entries.append((new.metadata.resource_version, "MODIFIED",
+                                kind, new))
+            # journal write batching: the shard's contiguous reserved rvs
+            # land (or park) through ONE sequencer call
+            self._journal_extend_locked(entries)
             self._flush_cond.notify_all()
         return [(old, new) for (_, old, _), new in zip(shard, news)]
 

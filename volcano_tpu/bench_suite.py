@@ -91,7 +91,8 @@ def _run_cycle(cache, conf) -> float:
         with tr.cycle():   # flight recorder (no-op unless tracer.enable())
             cache.begin_cycle()
             try:
-                ssn = open_session(cache, conf.tiers, conf.configurations)
+                ssn = open_session(cache, conf.tiers, conf.configurations,
+                                   actions=conf.actions)
                 try:
                     for name in conf.actions:
                         action = get_action(name)

@@ -48,12 +48,16 @@ class _RetryRecord:
     reset on bind success or a pod update/delete that could change the
     outcome."""
 
-    __slots__ = ("key", "attempts", "not_before")
+    __slots__ = ("key", "attempts", "not_before", "job")
 
-    def __init__(self, key: str):
+    def __init__(self, key: str, job: str = ""):
         self.key = key
         self.attempts = 0
         self.not_before = 0.0
+        # owning job uid: backoff expiry is time-based (no watch delta
+        # announces it), so the incremental snapshot re-dirties the job
+        # of every live retry record each cycle
+        self.job = job
 
 
 class _BindBurst:
@@ -201,6 +205,32 @@ class SchedulerCache(EventHandlersMixin):
         # mutation bumps _state_version and invalidates the prebuilt.
         self._state_version = 0
         self._prebuilt: Optional[tuple] = None
+        # incremental steady-state cycle (docs/design/incremental_cycle.md):
+        # with `incremental` enabled (Scheduler turns it on), snapshot()
+        # keeps ONE persistent ClusterInfo and patches it in place —
+        # clone-on-dirty per job/node — instead of re-cloning the whole
+        # cluster every cycle. The dirty sets are fed by every watch/echo
+        # delta (the event handlers), the bind/evict commit paths, and the
+        # session's own mutations (absorb_session_touches at close); the
+        # expected-bind-echo hint path deliberately does NOT re-dirty (a
+        # self-inflicted echo carries no new scheduling information). A
+        # structural change (queue/priority-class/quota/numa edits, an
+        # anti-entropy repair) forces a full rebuild, as does the periodic
+        # INCR_FULL_RECOMPUTE_EVERY_CYCLES anti-entropy cadence.
+        self.incremental = False
+        self._dirty_jobs: set = set()
+        self._dirty_nodes: set = set()
+        self._dirty_structural = True      # first snapshot is always full
+        self._incr_snap: Optional[ClusterInfo] = None
+        self._incr_seq = 0
+        self._incr_cycles_since_full = 0
+        # {("n", name) | ("j", uid): frozenset(scalar resource names)} of
+        # entities contributing scalar dims — the cheap maintenance behind
+        # snapshot.rindex (ResourceIndex.from_cluster scans everything)
+        self._incr_scalar_src: Dict[tuple, frozenset] = {}
+        # last snapshot()'s mode/dirty stats, read by the scheduler's
+        # cycle tags, /debug/cycles and the bench row
+        self.last_snapshot_stats: dict = {}
         # expected bind-echo hint: while _bind_store_writes is on the
         # store, (thread_ident, {pod uid: (cache task, hostname)}) of the
         # binds being written, so update_pods_bulk can ingest our own
@@ -306,6 +336,14 @@ class SchedulerCache(EventHandlersMixin):
     RESYNC_BACKOFF_CAP_SECONDS = 30.0
     RESYNC_RETRY_BUDGET = 5
     RESYNC_JITTER_SEED = 0
+
+    # incremental snapshot anti-entropy: every Nth snapshot is a full
+    # rebuild of the persistent ClusterInfo even with nothing dirty, so a
+    # dirty-tracking bug is bounded to this many cycles before the
+    # snapshot reconverges with the cache (0 disables the cadence; the
+    # cache<->store fingerprint pass stays the store-side safety net and
+    # its repairs force a rebuild regardless)
+    INCR_FULL_RECOMPUTE_EVERY_CYCLES = 64
 
     # how long the executor defers a drain for a live scheduling cycle
     # (once per cycle generation). Under the GIL a mid-cycle drain doesn't
@@ -419,11 +457,15 @@ class SchedulerCache(EventHandlersMixin):
     def end_cycle(self) -> None:
         self._cycle_idle.set()
         # rebuild the snapshot clone in the inter-cycle gap (after the
-        # executor drains this cycle's binds and their watch echoes)
-        if self._exec_thread is not None:
+        # executor drains this cycle's binds and their watch echoes);
+        # the incremental snapshot replaces the prebuild wholesale — its
+        # patch is O(dirty) on the cycle thread already
+        if self._exec_thread is not None and not self.incremental:
             self._submit(self._prebuild_snapshot)
 
     def _prebuild_snapshot(self) -> None:
+        if self.incremental:
+            return
         if not self._cycle_idle.is_set():
             # a new cycle is already in flight: the clone would hold the
             # mutex against the hot path and be invalidated by that same
@@ -505,9 +547,24 @@ class SchedulerCache(EventHandlersMixin):
     def snapshot(self) -> ClusterInfo:
         """Deep copy of the whole state (cache.go:793-882): only Ready nodes;
         only jobs with a PodGroup and an existing queue; job priority resolved
-        from PriorityClass here."""
+        from PriorityClass here.
+
+        With :attr:`incremental` enabled the full rebuild is replaced by
+        the persistent-snapshot patch (docs/design/incremental_cycle.md):
+        the previous cycle's ClusterInfo is patched in place, re-cloning
+        only dirty jobs/nodes, and MUST be content-identical to what this
+        full rebuild would have produced — `make incr-smoke` holds it to
+        that bind-for-bind."""
         with self.mutex:
             self._drain_applies_locked()
+            if self.incremental:
+                return self._incr_snapshot_locked()
+            # legacy full path: dirty bookkeeping is consumed (bounded)
+            # even though the rebuild doesn't read it
+            self._dirty_jobs.clear()
+            self._dirty_nodes.clear()
+            self._dirty_structural = False
+            self._incr_snap = None
             pre, self._prebuilt = self._prebuilt, None
             if pre is not None and pre[0] == self._state_version:
                 return pre[1]
@@ -543,6 +600,242 @@ class SchedulerCache(EventHandlersMixin):
                 job.priority = pc.value
             snap.jobs[job.uid] = job.clone()
         return snap
+
+    # -- incremental snapshot (docs/design/incremental_cycle.md) -----------
+
+    def mark_structural_change(self) -> None:
+        """Force the next snapshot to fully rebuild the persistent
+        ClusterInfo: a change whose blast radius is not a single job/node
+        (queue add/update/delete re-gates every job's inclusion and
+        fair share; priority-class and quota edits re-resolve every job;
+        numa topology feeds every node's scheduler view; an anti-entropy
+        repair means the dirty sets themselves cannot be trusted)."""
+        self._dirty_structural = True
+
+    def absorb_session_touches(self, jobs, nodes) -> None:
+        """Fold a closing session's own mutations (placements, pipelined
+        claims, condition/status writes) into the dirty sets: the session
+        mutates the persistent snapshot's objects IN PLACE, so every
+        touched job/node must be re-cloned from the cache next cycle or
+        the snapshot would leak session state the cache never saw."""
+        if not (jobs or nodes):
+            return
+        with self.mutex:
+            self._dirty_jobs.update(jobs)
+            self._dirty_nodes.update(nodes)
+
+    @staticmethod
+    def _scalar_names_of(res) -> Optional[frozenset]:
+        return frozenset(res.scalars) if res.scalars else None
+
+    def _incr_scalar_update(self, key: tuple, names) -> bool:
+        """Track one entity's scalar-resource contribution; True when it
+        changed (the caller then re-derives snapshot.rindex)."""
+        old = self._incr_scalar_src.pop(key, None)
+        if names:
+            self._incr_scalar_src[key] = names
+        return old != names
+
+    def _incr_refresh_rindex(self, snap: ClusterInfo) -> None:
+        """Re-derive the snapshot's ResourceIndex from the maintained
+        scalar-name sources; keeps the SAME object when the name set is
+        unchanged (the solver invalidates its device buffers on identity
+        change)."""
+        from ..models.arrays import ResourceIndex
+        from ..models.resource import CPU, MEMORY
+        names: set = set()
+        for contributed in self._incr_scalar_src.values():
+            names |= contributed
+        if snap.rindex is not None and set(snap.rindex.names) == \
+                ({CPU, MEMORY} | names):
+            return
+        snap.rindex = ResourceIndex(names)
+
+    def _init_incr_aux(self, snap: ClusterInfo) -> None:
+        """Build the per-snapshot rollup caches a full rebuild implies:
+        the resource index, the allocatable total (summed in snap.nodes
+        order — the SAME float-accumulation order open_session's legacy
+        loop uses, so reuse is bit-identical), the PodGroup-status
+        fingerprints, and the pending-work sets behind the quiet-cycle
+        fast path."""
+        from ..models.objects import status_fingerprint
+        from ..models.resource import Resource
+        self._incr_scalar_src = {}
+        for name, node in snap.nodes.items():
+            sn = self._scalar_names_of(node.allocatable)
+            if sn:
+                self._incr_scalar_src[("n", name)] = sn
+        for uid, job in snap.jobs.items():
+            sn = self._scalar_names_of(job.total_request)
+            if sn:
+                self._incr_scalar_src[("j", uid)] = sn
+        snap.rindex = None
+        self._incr_refresh_rindex(snap)
+        total = Resource()
+        for node in snap.nodes.values():
+            total.add(node.allocatable)
+        snap.total_resource = total
+        snap.pg_fprints = {
+            uid: status_fingerprint(job.pod_group.status)
+            for uid, job in snap.jobs.items() if job.pod_group is not None}
+        snap.pending_task_jobs = {
+            uid for uid, job in snap.jobs.items()
+            if job.task_status_index.get(TaskStatus.Pending)}
+        from ..models.objects import PodGroupPhase
+        snap.pending_phase_jobs = {
+            uid for uid, job in snap.jobs.items()
+            if job.pod_group is not None
+            and job.pod_group.status.phase == PodGroupPhase.PENDING}
+
+    def _incr_job_aux(self, snap: ClusterInfo, uid: str, job) -> None:
+        """Refresh one patched job's rollup-cache entries (None = gone)."""
+        from ..models.objects import PodGroupPhase, status_fingerprint
+        if job is None:
+            snap.pg_fprints.pop(uid, None)
+            snap.pending_task_jobs.discard(uid)
+            snap.pending_phase_jobs.discard(uid)
+            return
+        snap.pg_fprints[uid] = status_fingerprint(job.pod_group.status)
+        if job.task_status_index.get(TaskStatus.Pending):
+            snap.pending_task_jobs.add(uid)
+        else:
+            snap.pending_task_jobs.discard(uid)
+        if job.pod_group.status.phase == PodGroupPhase.PENDING:
+            snap.pending_phase_jobs.add(uid)
+        else:
+            snap.pending_phase_jobs.discard(uid)
+
+    def _incr_snapshot_locked(self) -> ClusterInfo:
+        """The persistent-snapshot cycle entry: full rebuild when forced
+        (first use, structural change, anti-entropy cadence), else patch
+        in place. Caller holds the mutex with applies drained."""
+        # time-gated bind-backoff state produces no watch delta when it
+        # expires: jobs with live retry records re-enter the working set
+        # every cycle so their eligibility is re-evaluated on schedule
+        for rec in self.retry_records.values():
+            if rec.job:
+                self._dirty_jobs.add(rec.job)
+        self._prebuilt = None
+        every = self.INCR_FULL_RECOMPUTE_EVERY_CYCLES
+        full_due = (self._incr_snap is None or self._dirty_structural
+                    or (every > 0
+                        and self._incr_cycles_since_full >= every))
+        n_dirty_jobs = len(self._dirty_jobs)
+        n_dirty_nodes = len(self._dirty_nodes)
+        self._incr_seq += 1
+        if full_due:
+            snap = self._snapshot_locked()
+            self._init_incr_aux(snap)
+            self._incr_snap = snap
+            self._incr_cycles_since_full = 0
+            self._dirty_structural = False
+            self._dirty_jobs = set()
+            self._dirty_nodes = set()
+            snap.incr_mode = "full"
+            snap.patched_jobs = set(snap.jobs)
+            snap.patched_nodes = set(snap.nodes)
+        else:
+            snap = self._incr_snap
+            snap.incr_mode = "incremental"
+            self._patch_snapshot_locked(snap)
+        self._incr_cycles_since_full += 1
+        snap.incr_seq = self._incr_seq
+        snap.quiet = (snap.incr_mode == "incremental"
+                      and not snap.patched_jobs and not snap.patched_nodes
+                      and not snap.pending_task_jobs
+                      and not snap.pending_phase_jobs)
+        self.last_snapshot_stats = {
+            "mode": snap.incr_mode, "quiet": snap.quiet,
+            "dirty_jobs": n_dirty_jobs, "dirty_nodes": n_dirty_nodes,
+            "patched_jobs": len(snap.patched_jobs),
+            "patched_nodes": len(snap.patched_nodes),
+            "jobs": len(snap.jobs), "nodes": len(snap.nodes)}
+        m.inc(m.CYCLE_MODE, mode=snap.incr_mode)
+        m.set_gauge(m.DIRTY_SET_SIZE, float(n_dirty_jobs), kind="jobs")
+        m.set_gauge(m.DIRTY_SET_SIZE, float(n_dirty_nodes), kind="nodes")
+        return snap
+
+    def _patch_snapshot_locked(self, snap: ClusterInfo) -> None:
+        """Patch the persistent ClusterInfo in place: re-clone exactly the
+        dirty jobs/nodes, drop the gone/filtered ones, rebuild the cheap
+        whole-cluster collections (queues/namespaces/node_list).
+
+        Order fidelity: the full rebuild iterates the CACHE's dicts, so
+        whenever membership could have changed the snapshot dict shells
+        are rebuilt in cache order — downstream float accumulations
+        (total_resource, proportion's queue sums) follow dict order and
+        bit-identical equivalence with a forced-full run depends on it."""
+        from ..models.resource import Resource
+        dirty_jobs, self._dirty_jobs = self._dirty_jobs, set()
+        dirty_nodes, self._dirty_nodes = self._dirty_nodes, set()
+        rindex_stale = False
+
+        # whole-cluster collections: tiny, rebuilt every cycle like the
+        # full path (queue/namespace churn is structural anyway)
+        snap.queues = {q.uid: q.clone() for q in self.queues.values()}
+        snap.namespaces = {}
+        for name, coll in self.namespace_collection.items():
+            info = coll.snapshot()
+            snap.namespaces[info.name] = info
+
+        patched_nodes: set = set()
+        if dirty_nodes:
+            for name in dirty_nodes:
+                node = self.nodes.get(name)
+                if node is None or not node.ready():
+                    snap.nodes.pop(name, None)
+                    snap.revocable_nodes.pop(name, None)
+                    rindex_stale |= self._incr_scalar_update(("n", name),
+                                                             None)
+                    patched_nodes.add(name)
+                    continue
+                node.refresh_numa_scheduler_info()
+                cloned = node.clone()
+                snap.nodes[name] = cloned
+                rindex_stale |= self._incr_scalar_update(
+                    ("n", name), self._scalar_names_of(node.allocatable))
+                patched_nodes.add(name)
+            # shell rebuild in cache order (an inter-cycle delete+re-add
+            # moves a key to the end of the cache dict; the snapshot must
+            # follow or the next full rebuild would disagree on order)
+            snap.nodes = {n: snap.nodes[n] for n in self.nodes
+                          if n in snap.nodes}
+            snap.revocable_nodes = {n: c for n, c in snap.nodes.items()
+                                    if c.revocable_zone}
+            snap.node_list = list(self.node_list)
+            total = Resource()
+            for node in snap.nodes.values():
+                total.add(node.allocatable)
+            snap.total_resource = total
+
+        patched_jobs: set = set()
+        if dirty_jobs:
+            for uid in dirty_jobs:
+                job = self.jobs.get(uid)
+                if job is None or job.pod_group is None \
+                        or job.queue not in snap.queues:
+                    snap.jobs.pop(uid, None)
+                    self._incr_job_aux(snap, uid, None)
+                    rindex_stale |= self._incr_scalar_update(("j", uid),
+                                                             None)
+                    patched_jobs.add(uid)
+                    continue
+                job.priority = self.default_priority
+                pc = self.priority_classes.get(
+                    job.pod_group.spec.priority_class_name)
+                if pc is not None:
+                    job.priority = pc.value
+                snap.jobs[uid] = job.clone()
+                self._incr_job_aux(snap, uid, job)
+                rindex_stale |= self._incr_scalar_update(
+                    ("j", uid), self._scalar_names_of(job.total_request))
+                patched_jobs.add(uid)
+            snap.jobs = {u: snap.jobs[u] for u in self.jobs
+                         if u in snap.jobs}
+        if rindex_stale:
+            self._incr_refresh_rindex(snap)
+        snap.patched_jobs = patched_jobs
+        snap.patched_nodes = patched_nodes
 
     def _current_fence(self):
         """The fencing token to stamp on leader-scoped store writes (None
@@ -592,6 +885,8 @@ class SchedulerCache(EventHandlersMixin):
             except RuntimeError:
                 job.update_task_status(task, original)
                 raise
+            self._dirty_jobs.add(task.job)
+            self._dirty_nodes.add(hostname)
             pod = task.pod
         corr = None
         if ledger.is_enabled():
@@ -725,6 +1020,8 @@ class SchedulerCache(EventHandlersMixin):
         except RuntimeError:
             job.move_task_status(task, original)
             return
+        self._dirty_jobs.add(task.job)
+        self._dirty_nodes.add(hostname)
         burst.accepted.append(task_info)
         burst.bound.append((task, task.pod, hostname))
 
@@ -745,6 +1042,7 @@ class SchedulerCache(EventHandlersMixin):
             for task_info, hostname in burst.pairs:
                 by_job.setdefault(task_info.job, []).append(
                     (burst, task_info, hostname))
+        self._dirty_jobs.update(by_job)
         by_node: Dict[str, list] = {}
         for jid, items in by_job.items():
             job = self.jobs.get(jid)
@@ -762,6 +1060,7 @@ class SchedulerCache(EventHandlersMixin):
                                                              originals):
                 by_node.setdefault(hostname, []).append(
                     (burst, task_info, s, orig, job))
+        self._dirty_nodes.update(by_node)
         for hostname, node_items in by_node.items():
             node = self.nodes[hostname]
             try:
@@ -1045,6 +1344,8 @@ class SchedulerCache(EventHandlersMixin):
             except RuntimeError:
                 job.update_task_status(task, original)
                 raise
+            self._dirty_jobs.add(task.job)
+            self._dirty_nodes.add(task.node_name)
             pod = task.pod
 
         def do_evict():
@@ -1097,6 +1398,8 @@ class SchedulerCache(EventHandlersMixin):
                         "scheduling resync", task.uid)
                     self.resync_task(task)
                     continue
+                self._dirty_jobs.add(task.job)
+                self._dirty_nodes.add(task.node_name)
                 staged.append((task, task.pod, job.pod_group, reason))
 
         def do_evict_all():
@@ -1149,7 +1452,8 @@ class SchedulerCache(EventHandlersMixin):
                 return
             rec = self.retry_records.get(key)
             if rec is None:
-                rec = self.retry_records[key] = _RetryRecord(key)
+                rec = self.retry_records[key] = _RetryRecord(key,
+                                                             task.job)
             rec.attempts += 1
             if rec.attempts >= self.RESYNC_RETRY_BUDGET:
                 del self.retry_records[key]
@@ -1414,6 +1718,10 @@ class SchedulerCache(EventHandlersMixin):
             state["repairs"] += 1
             state["objects_repaired"] += repaired_total
             state["last_repair"] = now
+            # a repair means the watch stream lied: the dirty sets built
+            # from it cannot be trusted either, so the persistent
+            # snapshot is invalidated wholesale (incremental_cycle.md)
+            self.mark_structural_change()
             logging.getLogger(__name__).warning(
                 "anti-entropy: cache diverged from the store on %s; "
                 "repaired %d object(s) via relist", divergent,
@@ -1494,6 +1802,7 @@ class SchedulerCache(EventHandlersMixin):
                 node = self.nodes.get(node_name)
                 if node is not None and node.numa_scheduler_info is not None:
                     node.numa_scheduler_info.allocate(res_sets)
+                    self._dirty_nodes.add(node_name)
 
     def __repr__(self):
         return (f"SchedulerCache(jobs={len(self.jobs)}, nodes={len(self.nodes)}, "

@@ -23,7 +23,14 @@ from ..models.queue_info import NamespaceCollection, QueueInfo
 
 
 class EventHandlersMixin:
-    """Mixed into SchedulerCache; operates on self.jobs/self.nodes/..."""
+    """Mixed into SchedulerCache; operates on self.jobs/self.nodes/...
+
+    Every handler records the job/node keys it mutates into the cache's
+    dirty sets (docs/design/incremental_cycle.md) — the incremental
+    snapshot re-clones exactly those. The expected-bind-echo hint path in
+    :meth:`update_pods_bulk` is the ONE deliberate exception: a
+    self-inflicted bind echo confirms state the bind apply already
+    dirtied and must not re-dirty its job."""
 
     # -- pods -------------------------------------------------------------
 
@@ -57,6 +64,9 @@ class EventHandlersMixin:
         job = self._get_or_create_job(ti)
         if job is not None:
             job.add_task_info(ti)
+            self._dirty_jobs.add(ti.job)
+        if ti.node_name:
+            self._dirty_nodes.add(ti.node_name)
 
     def add_pod(self, pod: obj.Pod) -> None:
         self._add_task(TaskInfo(pod))
@@ -78,10 +88,13 @@ class EventHandlersMixin:
         if job is not None:
             try:
                 job.delete_task_info(ti)
+                self._dirty_jobs.add(ti.job)
             except KeyError:
                 pass
-        if ti.node_name and ti.node_name in self.nodes:
-            self.nodes[ti.node_name].remove_task(ti)
+        if ti.node_name:
+            self._dirty_nodes.add(ti.node_name)
+            if ti.node_name in self.nodes:
+                self.nodes[ti.node_name].remove_task(ti)
 
     def update_pod(self, old: obj.Pod, new: obj.Pod) -> None:
         # Fast path for bind/status echoes: when the cached task and the
@@ -122,6 +135,10 @@ class EventHandlersMixin:
                 view.topology_policy = nt.topology_policy
                 view.constraint_key_cache = nt.constraint_key_cache
                 view.group_sig_cache = nt.group_sig_cache
+            # a real (non-self-echo) status/annotation change: the
+            # snapshot's job AND node task views are both stale now
+            self._dirty_jobs.add(nt.job)
+            self._dirty_nodes.add(cached.node_name)
             return
         # un-quarantine on a MATERIAL pod update (docs/design/
         # resilience.md): a changed spec — bound elsewhere, or new
@@ -248,7 +265,12 @@ class EventHandlersMixin:
                         # the job-side status flip happens INSIDE the
                         # bulk move (it reads the pre-move status);
                         # only the node-side view and the shared pod's
-                        # resource_version update inline
+                        # resource_version update inline. Unlike the
+                        # self-echo hint path above, this is ANOTHER
+                        # writer's patch — it does carry new state, so
+                        # it dirties like any watch delta.
+                        self._dirty_jobs.add(jid)
+                        self._dirty_nodes.add(cached.node_name)
                         if job is not run_job or new_status != run_status:
                             flush_run()
                             run_job, run_status = job, new_status
@@ -292,11 +314,13 @@ class EventHandlersMixin:
         job = self.jobs.get(jid)
         if job is not None and not job.tasks and job.pod_group is None:
             del self.jobs[jid]
+            self._dirty_jobs.add(jid)
 
     # -- nodes ------------------------------------------------------------
 
     def add_node(self, node: obj.Node) -> None:
         name = node.metadata.name
+        self._dirty_nodes.add(name)
         if name in self.nodes:
             self.nodes[name].set_node(node)
         else:
@@ -308,12 +332,14 @@ class EventHandlersMixin:
             self.node_list.append(name)
 
     def update_node(self, old: obj.Node, new: obj.Node) -> None:
+        self._dirty_nodes.add(new.metadata.name)
         if new.metadata.name in self.nodes:
             self.nodes[new.metadata.name].set_node(new)
         else:
             self.add_node(new)
 
     def delete_node(self, node: obj.Node) -> None:
+        self._dirty_nodes.add(node.metadata.name)
         self.nodes.pop(node.metadata.name, None)
         if node.metadata.name in self.node_list:
             self.node_list.remove(node.metadata.name)
@@ -322,6 +348,7 @@ class EventHandlersMixin:
 
     def add_pod_group(self, pg: obj.PodGroup) -> None:
         key = pg.metadata.key()
+        self._dirty_jobs.add(key)
         if key not in self.jobs:
             self.jobs[key] = JobInfo(key, clock=self.store.clock)
         self.jobs[key].set_pod_group(pg)
@@ -340,6 +367,7 @@ class EventHandlersMixin:
         with self.mutex:
             self._state_version += 1
             for old, new in pairs:
+                self._dirty_jobs.add(new.metadata.key())
                 job = self.jobs.get(new.metadata.key())
                 if job is not None and job.pod_group is not None \
                         and new.spec is old.spec:
@@ -353,6 +381,7 @@ class EventHandlersMixin:
 
     def delete_pod_group(self, pg: obj.PodGroup) -> None:
         key = pg.metadata.key()
+        self._dirty_jobs.add(key)
         job = self.jobs.get(key)
         if job is None:
             return
@@ -361,19 +390,27 @@ class EventHandlersMixin:
             del self.jobs[key]
 
     # -- queues -----------------------------------------------------------
+    # Queue/priority-class/quota/numa edits are STRUCTURAL for the
+    # incremental snapshot: their blast radius is every job (inclusion
+    # filters, fair-share budgets, priority resolution) or every node
+    # (numa views), so the cheap per-key dirty sets cannot scope them —
+    # the next snapshot rebuilds wholesale (incremental_cycle.md).
 
     def add_queue(self, queue: obj.Queue) -> None:
+        self.mark_structural_change()
         self.queues[queue.metadata.name] = QueueInfo(queue)
 
     def update_queue(self, old: obj.Queue, new: obj.Queue) -> None:
         self.add_queue(new)
 
     def delete_queue(self, queue: obj.Queue) -> None:
+        self.mark_structural_change()
         self.queues.pop(queue.metadata.name, None)
 
     # -- priority classes -------------------------------------------------
 
     def add_priority_class(self, pc: obj.PriorityClass) -> None:
+        self.mark_structural_change()
         if pc.global_default:
             self.default_priority_class = pc
             self.default_priority = pc.value
@@ -384,6 +421,7 @@ class EventHandlersMixin:
         self.add_priority_class(new)
 
     def delete_priority_class(self, pc: obj.PriorityClass) -> None:
+        self.mark_structural_change()
         if pc.global_default:
             self.default_priority_class = None
             self.default_priority = 0
@@ -392,6 +430,7 @@ class EventHandlersMixin:
     # -- resource quotas (namespace weights) ------------------------------
 
     def add_resource_quota(self, quota: obj.ResourceQuota) -> None:
+        self.mark_structural_change()
         ns = quota.metadata.namespace
         if ns not in self.namespace_collection:
             self.namespace_collection[ns] = NamespaceCollection(ns)
@@ -401,6 +440,7 @@ class EventHandlersMixin:
         self.add_resource_quota(new)
 
     def delete_resource_quota(self, quota: obj.ResourceQuota) -> None:
+        self.mark_structural_change()
         coll = self.namespace_collection.get(quota.metadata.namespace)
         if coll is not None:
             coll.delete(quota)
@@ -409,6 +449,7 @@ class EventHandlersMixin:
 
     def add_numa_info(self, nt: obj.Numatopology) -> None:
         from ..models.numa_info import NumatopoInfo
+        self.mark_structural_change()
         info = NumatopoInfo.from_crd(nt)
         old = self.numatopologies.get(nt.metadata.name)
         self.numatopologies[nt.metadata.name] = info
@@ -427,6 +468,7 @@ class EventHandlersMixin:
         self.add_numa_info(new)
 
     def delete_numa_info(self, nt: obj.Numatopology) -> None:
+        self.mark_structural_change()
         self.numatopologies.pop(nt.metadata.name, None)
         node = self.nodes.get(nt.metadata.name)
         if node is not None:

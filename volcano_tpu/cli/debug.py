@@ -58,13 +58,22 @@ def _render_cycles(payload: dict) -> str:
     for c in cycles[-20:]:
         top = sorted(c.get("phases", {}).items(),
                      key=lambda kv: -kv[1]["ms"])[:3]
+        tags = c.get("tags") or {}
+        mode = tags.get("mode", "-")
+        if tags.get("quiet"):
+            mode = f"{mode}*"      # * = quiet fast path taken
+        dirty = f"{tags.get('dirty_jobs', '-')}/" \
+                f"{tags.get('dirty_nodes', '-')}" \
+            if "dirty_jobs" in tags else "-"
         rows.append([c["seq"], c["cycle_ms"],
                      f"{c.get('coverage', 0):.2f}",
+                     mode, dirty, tags.get("skipped_tasks", "-"),
                      c.get("bind_flush_ms", ""),
                      ",".join(c.get("over_budget", [])) or "-",
                      " ".join(f"{n}={e['ms']}" for n, e in top)])
-    return _table(rows, ["seq", "cycle_ms", "cover", "flush_ms",
-                         "over_budget", "top phases (ms)"])
+    return _table(rows, ["seq", "cycle_ms", "cover", "mode", "dirty j/n",
+                         "skipped", "flush_ms", "over_budget",
+                         "top phases (ms)"])
 
 
 def _render_pending(payload: dict) -> str:

@@ -22,22 +22,60 @@ from .session import Session
 from .solver import BatchSolver
 
 
-def open_session(cache, tiers, configurations=None, clock=None) -> Session:
+# actions that are provably no-ops on a quiet cycle (no dirty state, no
+# pending work): the quiet fast path below may only skip plugin opens
+# when the conf runs nothing outside this set — elect/reserve make
+# TIME-based reservation decisions that need live plugins every cycle
+QUIET_SAFE_ACTIONS = frozenset(
+    ("enqueue", "allocate", "backfill", "preempt", "reclaim"))
+
+
+def open_session(cache, tiers, configurations=None, clock=None,
+                 actions=None) -> Session:
+    """Open one scheduling cycle's session.
+
+    ``actions`` (the conf's action-name list) gates the incremental
+    QUIET fast path: on a snapshot with nothing dirty and no pending
+    work the plugin opens/JobValid sweep are provably decision-free, so
+    they are skipped wholesale (docs/design/incremental_cycle.md) — but
+    only when every configured action is quiet-safe. Callers that do not
+    pass ``actions`` never take the fast path."""
     from ..trace import tracer as tr
     with tr.span("open_session"):
         with tr.span("snapshot"):
             snapshot = cache.snapshot()
         ssn = Session(cache, snapshot, tiers, configurations, clock=clock)
-        ssn.solver = BatchSolver(ssn)
-        # pre-session PodGroup statuses for jitter-deduped writeback
-        ssn.pod_group_status: Dict[str, object] = {}
-        for job in ssn.jobs.values():
-            if job.pod_group is not None:
-                ssn.pod_group_status[job.uid] = _status_snapshot(
-                    job.pod_group.status)
-        ssn.total_resource = Resource()
-        for n in ssn.nodes.values():
-            ssn.total_resource.add(n.allocatable)
+        ssn.solver = BatchSolver(ssn, rindex=snapshot.rindex)
+        # incremental-cycle surface (consumed by the solver's persistent
+        # device buffers, the allocate action's scoped working set and
+        # the close-time writeback scope)
+        ssn.incr_mode = snapshot.incr_mode
+        ssn.incr_seq = snapshot.incr_seq
+        ssn.patched_jobs = snapshot.patched_jobs
+        ssn.patched_nodes = snapshot.patched_nodes
+        ssn.quiet_cycle = bool(
+            snapshot.quiet and actions is not None
+            and QUIET_SAFE_ACTIONS.issuperset(actions))
+        if snapshot.incr_mode is not None:
+            from ..framework.solver import note_incremental_snapshot
+            note_incremental_snapshot(cache, snapshot)
+        # pre-session PodGroup statuses for jitter-deduped writeback:
+        # maintained per patched job by the incremental snapshot, else
+        # recomputed over every job like the reference
+        if snapshot.pg_fprints is not None:
+            ssn.pod_group_status = snapshot.pg_fprints
+        else:
+            ssn.pod_group_status: Dict[str, object] = {}
+            for job in ssn.jobs.values():
+                if job.pod_group is not None:
+                    ssn.pod_group_status[job.uid] = _status_snapshot(
+                        job.pod_group.status)
+        if snapshot.total_resource is not None:
+            ssn.total_resource = snapshot.total_resource
+        else:
+            ssn.total_resource = Resource()
+            for n in ssn.nodes.values():
+                ssn.total_resource.add(n.allocatable)
 
         # commit-path resilience (docs/design/resilience.md): pod keys
         # the cache has made ineligible for (re-)placement this cycle —
@@ -47,6 +85,9 @@ def open_session(cache, tiers, configurations=None, clock=None) -> Session:
         ineligible = getattr(cache, "bind_ineligible", None)
         ssn.ineligible_binds = ineligible() if ineligible is not None \
             else {}
+
+        if ssn.quiet_cycle:
+            return ssn
 
         from ..metrics import metrics as m
         for tier in tiers:
@@ -98,6 +139,13 @@ def close_session(ssn: Session) -> None:
             JobUpdater(ssn).update_all()
         ssn.plugins = {}
         ssn.event_handlers = []
+        # incremental cycle: everything this session mutated must be
+        # re-cloned from cache truth before the persistent snapshot is
+        # read again (docs/design/incremental_cycle.md)
+        if ssn.cache is not None and \
+                getattr(ssn.cache, "incremental", False):
+            ssn.cache.absorb_session_touches(ssn.touched_jobs,
+                                             ssn.touched_nodes)
 
 
 def update_pod_group_condition(ssn: Session, job: JobInfo,
@@ -108,6 +156,7 @@ def update_pod_group_condition(ssn: Session, job: JobInfo,
     if job.pod_group is None:
         return
     condition.last_transition_time = _time.time()
+    ssn.touched_jobs.add(job.uid)
     conditions = job.own_pod_group().status.conditions
     for i, c in enumerate(conditions):
         if c.type == condition.type:
@@ -144,6 +193,7 @@ def job_status(ssn: Session, job: JobInfo):
     succeeded = len(job.task_status_index.get(TaskStatus.Succeeded, {}))
     if (phase, running, failed, succeeded) != \
             (status.phase, status.running, status.failed, status.succeeded):
+        ssn.touched_jobs.add(job.uid)
         status = job.own_pod_group().status
         status.phase = phase
         status.running = running
@@ -154,10 +204,12 @@ def job_status(ssn: Session, job: JobInfo):
 
 def _status_snapshot(status) -> tuple:
     """Cheap immutable fingerprint of a PodGroup status for writeback
-    dedup (replaces a deep clone per job per cycle)."""
-    return (status.phase, status.running, status.succeeded, status.failed,
-            tuple((c.type, c.status, c.reason, c.message,
-                   c.last_transition_time) for c in status.conditions))
+    dedup (replaces a deep clone per job per cycle). The incremental
+    snapshot maintains the same fingerprints per patched job — one
+    shared implementation (models.objects.status_fingerprint) so the two
+    producers can never drift."""
+    from ..models.objects import status_fingerprint
+    return status_fingerprint(status)
 
 
 # condition-writeback dedup window (job_updater.go:31-37)
@@ -173,7 +225,18 @@ class JobUpdater:
 
     def __init__(self, ssn: Session):
         self.ssn = ssn
-        self.job_queue = [j for j in ssn.jobs.values() if j.pod_group is not None]
+        # incremental cycle: only patched (cache-side deltas) or touched
+        # (session-side mutations) jobs can roll up differently from last
+        # cycle's writeback — the sweep is scoped to them. Any job that
+        # would need a FailedScheduling/condition write this cycle wrote
+        # one LAST cycle too, whose echo dirtied it, so it is patched;
+        # everything outside the scope provably pushes nothing.
+        scope = None
+        if getattr(ssn, "incr_mode", None) == "incremental":
+            scope = set(ssn.patched_jobs or ()) | ssn.touched_jobs
+        self.job_queue = [j for j in ssn.jobs.values()
+                         if j.pod_group is not None
+                         and (scope is None or j.uid in scope)]
 
     def update_all(self) -> None:
         """Compute statuses foreground, push the store writes on the cache

@@ -142,6 +142,27 @@ class Session:
         # insertion-ordered uid -> [ops]: materialize() walks all values,
         # materialize_job() pops one key in O(1)
         self._deferred_ops: Dict[str, List[object]] = {}
+        # incremental steady-state cycle (docs/design/incremental_cycle.md):
+        # jobs/nodes THIS session mutated. The persistent snapshot hands
+        # the same objects to the next session, so close_session feeds
+        # these back into the cache's dirty sets — every touched entity is
+        # re-cloned from cache truth before it is read again. Populated by
+        # the session/statement primitives (the only sanctioned mutation
+        # funnels) plus the podgroup condition/status writers.
+        self.touched_jobs: set = set()
+        self.touched_nodes: set = set()
+        # incremental surface stamped by open_session (None = legacy full)
+        self.incr_mode = None
+        self.incr_seq = 0
+        self.patched_jobs = None
+        self.patched_nodes = None
+        self.quiet_cycle = False
+
+    def touch_job(self, uid: str) -> None:
+        self.touched_jobs.add(uid)
+
+    def touch_node(self, name: str) -> None:
+        self.touched_nodes.add(name)
 
     # ------------------------------------------------------------------
     # deferred apply (allocate's burst-cycle fast path)
@@ -527,6 +548,8 @@ class Session:
         job.update_task_status(task, TaskStatus.Pipelined)
         task.node_name = hostname
         node.add_task(task)
+        self.touched_jobs.add(task.job)
+        self.touched_nodes.add(hostname)
         self._fire_allocate(task)
 
     def allocate(self, task: TaskInfo, node_info: NodeInfo) -> None:
@@ -548,6 +571,8 @@ class Session:
         job.update_task_status(task, TaskStatus.Allocated)
         task.node_name = hostname
         node.add_task(task)
+        self.touched_jobs.add(task.job)
+        self.touched_nodes.add(hostname)
         self._fire_allocate(task)
         if self.job_ready(job):
             for t in list(job.task_status_index.get(TaskStatus.Allocated, {}).values()):
@@ -563,6 +588,7 @@ class Session:
         job = self.jobs.get(task.job)
         if job is not None:
             job.update_task_status(task, TaskStatus.Binding)
+            self.touched_jobs.add(task.job)
 
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         """Immediate eviction (used by reclaim): session state + cache."""
@@ -574,6 +600,8 @@ class Session:
             raise KeyError(f"failed to find node {reclaimee.node_name}")
         job.update_task_status(reclaimee, TaskStatus.Releasing)
         node.update_task(reclaimee)
+        self.touched_jobs.add(reclaimee.job)
+        self.touched_nodes.add(reclaimee.node_name)
         self._fire_deallocate(reclaimee)
         if self.cache is not None:
             self.cache.evict(reclaimee, reason)

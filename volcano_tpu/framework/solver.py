@@ -69,6 +69,49 @@ def reset_breaker() -> None:
     _breaker_open_until.clear()
 
 
+# -- incremental node tensors (docs/design/incremental_cycle.md) -------------
+
+class _IncrNodeState:
+    """Persistent host NodeArrays + device-resident kernel-input buffers
+    reused across steady-state cycles. One per SchedulerCache (the
+    BatchSolver itself is per-session): each incremental snapshot's
+    patched-node set accumulates into ``pending``; the next session's
+    FIRST context build re-encodes only those host rows and scatters only
+    those device rows, so the steady-state host→device transfer drops to
+    ~the dirty rows instead of the full [N, R] snapshot. Any shape/order/
+    rindex change — or a full snapshot rebuild — invalidates wholesale."""
+
+    __slots__ = ("seq", "narr", "rindex", "names", "pending", "dev",
+                 "dev_dirty_rows")
+
+    def __init__(self):
+        self.seq = -1
+        self.narr = None           # host NodeArrays of the last first-build
+        self.rindex = None
+        self.names = None          # node order the arrays encode
+        self.pending = set()       # node names needing host row re-encode
+        self.dev = None            # {field: device array} or None
+        self.dev_dirty_rows = set()  # row indices needing device scatter
+
+
+def note_incremental_snapshot(cache, snap) -> None:
+    """Fold one snapshot's invalidation surface into the cache's
+    persistent solver state (called once per cycle by open_session)."""
+    state = getattr(cache, "_incr_solver_state", None)
+    if state is None:
+        state = cache._incr_solver_state = _IncrNodeState()
+    if snap.incr_seq == state.seq:
+        return
+    state.seq = snap.incr_seq
+    if snap.incr_mode != "incremental":
+        state.narr = None
+        state.dev = None
+        state.pending.clear()
+        state.dev_dirty_rows.clear()
+    else:
+        state.pending |= snap.patched_nodes
+
+
 def breaker_state() -> Dict[str, int]:
     """{tier: open-until placement-counter} of currently open breakers."""
     return dict(_breaker_open_until)
@@ -126,9 +169,13 @@ class PlacementResult:
 
 
 class BatchSolver:
-    def __init__(self, ssn):
+    def __init__(self, ssn, rindex: Optional[ResourceIndex] = None):
         self.ssn = ssn
-        self.rindex = ResourceIndex.from_cluster(ssn.nodes, ssn.jobs)
+        # the incremental snapshot maintains the cycle's ResourceIndex
+        # (same scalar-name derivation, kept object-identical while the
+        # name set is stable); legacy full snapshots rescan everything
+        self.rindex = rindex if rindex is not None \
+            else ResourceIndex.from_cluster(ssn.nodes, ssn.jobs)
         self._weights: Dict[str, float] = {"binpack": 0.0, "least": 0.0,
                                            "most": 0.0, "balanced": 0.0}
         self._binpack_res: Optional[np.ndarray] = None
@@ -353,14 +400,101 @@ class BatchSolver:
 
     def _context_arrays(self, ordered_jobs):
         """Shared front half of both context builds: materialize deferred
-        placements, then the SoA encodes."""
+        placements, then the SoA encodes. The FIRST build of an
+        incremental session reuses the persistent NodeArrays with only
+        the patched rows re-encoded; later builds in the same cycle see
+        session-mutated nodes and always encode fresh."""
         ssn = self.ssn
         ssn.materialize()   # deferred placements must be visible to arrays
-        narr = NodeArrays.build(ssn.nodes, self._node_order(),
-                                self.rindex)
+        narr = None
+        if not getattr(ssn, "_narr_first_done", False):
+            ssn._narr_first_done = True
+            narr = self._incremental_node_arrays()
+        if narr is None:
+            narr = NodeArrays.build(ssn.nodes, self._node_order(),
+                                    self.rindex)
         batch = TaskBatch.build(ordered_jobs, self.rindex)
         feats = PredicateFeatures.build(ssn.nodes, narr, batch)
         return narr, batch, feats
+
+    def _incr_state(self) -> Optional[_IncrNodeState]:
+        if self.ssn.cache is None:
+            return None
+        return getattr(self.ssn.cache, "_incr_solver_state", None)
+
+    def _incremental_node_arrays(self) -> Optional[NodeArrays]:
+        """The session's first node encode, through the persistent
+        host-array cache when live; None falls back to a fresh build
+        (which is then installed as the new persistent state)."""
+        ssn = self.ssn
+        state = self._incr_state()
+        if state is None or getattr(ssn, "incr_mode", None) is None \
+                or self.sampling:
+            return None
+        order = self._node_order()
+        if ssn.incr_mode == "incremental" and state.narr is not None \
+                and state.rindex is self.rindex \
+                and state.names == order \
+                and not ssn.touched_nodes \
+                and len(state.pending) <= max(64, len(order) // 4):
+            rows = state.narr.update_rows(ssn.nodes, state.pending)
+            state.pending = set()
+            state.dev_dirty_rows.update(rows)
+            return state.narr
+        narr = NodeArrays.build(ssn.nodes, order, self.rindex)
+        state.narr = narr
+        state.rindex = self.rindex
+        state.names = list(order)
+        state.pending = set()
+        state.dev = None
+        state.dev_dirty_rows = set()
+        return narr
+
+    _DEV_NODE_FIELDS = ("idle", "future_idle", "allocatable", "n_tasks",
+                        "max_tasks")
+
+    def _device_node_inputs(self, narr: NodeArrays):
+        """The five node tensors the kernels consume, as device arrays:
+        scatter-updates only the dirty rows of the persistent buffers
+        when the host arrays are the persistent ones, else a plain full
+        upload. Returns ({field: device array}, host->device bytes)."""
+        from ..metrics import metrics as m
+
+        def full_host():
+            return {"idle": narr.idle, "future_idle": narr.future_idle,
+                    "allocatable": narr.allocatable,
+                    "n_tasks": narr.n_tasks, "max_tasks": narr.max_tasks}
+
+        state = self._incr_state()
+        if state is None or state.narr is not narr:
+            host = full_host()
+            return {f: jnp.asarray(a) for f, a in host.items()}, \
+                sum(int(a.nbytes) for a in host.values())
+        if state.dev is None:
+            host = full_host()
+            state.dev = {f: jnp.asarray(a) for f, a in host.items()}
+            state.dev_dirty_rows = set()
+            m.inc(m.SOLVER_DEVICE_BUFFER, event="rebuild")
+            return dict(state.dev), \
+                sum(int(a.nbytes) for a in host.values())
+        xfer = 0
+        rows = sorted(state.dev_dirty_rows)
+        if rows:
+            idx = jnp.asarray(np.asarray(rows, np.int32))
+            host_rows = {
+                "idle": narr.idle[rows],
+                "future_idle": narr.idle[rows] + narr.releasing[rows]
+                - narr.pipelined[rows],
+                "allocatable": narr.allocatable[rows],
+                "n_tasks": narr.n_tasks[rows],
+                "max_tasks": narr.max_tasks[rows]}
+            for f in self._DEV_NODE_FIELDS:
+                hr = host_rows[f]
+                state.dev[f] = state.dev[f].at[idx].set(jnp.asarray(hr))
+                xfer += int(hr.nbytes)
+            state.dev_dirty_rows = set()
+        m.inc(m.SOLVER_DEVICE_BUFFER, event="reuse")
+        return dict(state.dev), xfer
 
     def _apply_masks_and_scores(self, gmask, batch, narr, feats, xp):
         """Shared back half of both context builds — ONE formulation of
@@ -638,6 +772,8 @@ class BatchSolver:
                     else:
                         if kernel_inputs is None:
                             account_transfer = True
+                            dev_nodes, node_xfer = \
+                                self._device_node_inputs(narr)
                             kernel_inputs = (
                                 jnp.asarray(batch.task_group),
                                 jnp.asarray(batch.task_job),
@@ -660,22 +796,25 @@ class BatchSolver:
                                 jnp.asarray(ns_total),
                                 jnp.asarray(q_deserved),
                                 jnp.asarray(q_alloc0),
-                                jnp.asarray(narr.idle),
-                                jnp.asarray(narr.future_idle),
-                                jnp.asarray(narr.allocatable),
-                                jnp.asarray(narr.n_tasks),
-                                jnp.asarray(narr.max_tasks), eps,
+                                dev_nodes["idle"],
+                                dev_nodes["future_idle"],
+                                dev_nodes["allocatable"],
+                                dev_nodes["n_tasks"],
+                                dev_nodes["max_tasks"], eps,
                                 self.score_weights())
                         if account_transfer:
                             # host->device staging bytes for this place
                             # (gmask/static_score at indices 4-5 are
                             # device-born — products of the context
-                            # build — so they don't count as transfer)
+                            # build — and the node tensors at 22-26 may
+                            # be persistent device buffers whose real
+                            # transfer node_xfer already measured as the
+                            # scattered dirty rows)
                             account_transfer = False
-                            xfer = sum(
+                            xfer = node_xfer + sum(
                                 int(getattr(a, "nbytes", 0))
                                 for i, a in enumerate(kernel_inputs)
-                                if i not in (4, 5))
+                                if i not in (4, 5, 22, 23, 24, 25, 26))
                             m.inc(m.DEVICE_TRANSFER_BYTES, float(xfer))
                             trace.add_tags(transfer_bytes=xfer)
                         assign, pipelined, ready, kept, _ = kfn(

@@ -129,6 +129,8 @@ class Statement:
             raise KeyError(f"failed to find node {reclaimee.node_name}")
         job.move_task_status(reclaimee, TaskStatus.Releasing)
         node.transition_task(reclaimee)
+        self.ssn.touched_jobs.add(reclaimee.job)
+        self.ssn.touched_nodes.add(reclaimee.node_name)
         self.ssn._fire_deallocate(reclaimee)
         self.operations.append(_Operation("evict", reclaimee, reason))
 
@@ -153,6 +155,8 @@ class Statement:
         job.update_task_status(task, TaskStatus.Pipelined)
         task.node_name = hostname
         node.add_task(task)
+        self.ssn.touched_jobs.add(task.job)
+        self.ssn.touched_nodes.add(hostname)
         self.ssn._fire_allocate(task)
         self.operations.append(_Operation("pipeline", task))
 
@@ -185,6 +189,8 @@ class Statement:
         job.update_task_status(task, TaskStatus.Allocated)
         task.node_name = hostname
         node.add_task(task)
+        self.ssn.touched_jobs.add(task.job)
+        self.ssn.touched_nodes.add(hostname)
         self.ssn._fire_allocate(task)
         self.operations.append(_Operation("allocate", task))
 
@@ -234,8 +240,20 @@ class Statement:
 
         applied = self._stage_fast_seq(fast, keep_partial)
         if applied:
+            self._touch_items(job, applied)
             ssn._fire_allocate_batch(job, [t for t, _, _ in applied])
             self.operations.append(_BatchOperation(job, applied))
+
+    def _touch_items(self, job, items) -> None:
+        """Record a staged gang in the session's touched sets (the
+        incremental snapshot's re-clone scope): the job plus every node
+        the gang landed on. Rolled-back gangs stay marked — conservative
+        re-clones are always sound."""
+        ssn = self.ssn
+        ssn.touched_jobs.add(job.uid)
+        touched_nodes = ssn.touched_nodes
+        for _, node, _ in items:
+            touched_nodes.add(node.name)
 
     def _stage_fast_seq(self, fast, keep_partial: bool) -> list:
         """Sequential per-task staging: all-or-nothing by default, prefix
@@ -287,6 +305,7 @@ class Statement:
         plugin events and appends the operation, exactly like
         :meth:`allocate_batch` does after its own staging. ``total`` may
         carry the gang's precomputed resource sum."""
+        self._touch_items(job, items)
         self.ssn._fire_allocate_batch(job, [t for t, _, _ in items], total)
         self.operations.append(_BatchOperation(job, items))
 
@@ -296,6 +315,7 @@ class Statement:
         both already set), bumps the job's readiness deltas, and queues
         the apply for Session.materialize."""
         op = _DeferredBatch(job, items)
+        self._touch_items(job, items)
         alloc_n = sum(1 for _, _, p in items if not p)
         job.deferred_alloc += alloc_n
         job.deferred_pipe += len(items) - alloc_n

@@ -101,6 +101,13 @@ SOLVER_COMPILE_CACHE = f"{NS}_solver_compile_cache_total"
 SOLVER_SHAPE_RECOMPILES = f"{NS}_solver_padded_shape_recompile_total"
 DEVICE_TRANSFER_BYTES = f"{NS}_solver_device_transfer_bytes_total"
 BACKEND_PROBE = f"{NS}_backend_probe_total"
+# incremental steady-state cycle (docs/design/incremental_cycle.md):
+# snapshots by mode (mode="full"|"incremental"), the dirty-set sizes the
+# last snapshot consumed (kind="jobs"|"nodes"), and the solver's
+# persistent device-resident node buffers (event="reuse"|"rebuild")
+CYCLE_MODE = f"{NS}_cycle_mode_total"
+DIRTY_SET_SIZE = f"{NS}_dirty_set_size"
+SOLVER_DEVICE_BUFFER = f"{NS}_solver_device_buffer_total"
 
 # component health registry behind /debug/health: a component absent from
 # the registry is healthy by default; the watchdog (scheduler.py) flips

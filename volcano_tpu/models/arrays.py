@@ -198,6 +198,43 @@ class NodeArrays:
     def future_idle(self) -> np.ndarray:
         return self.idle + self.releasing - self.pipelined
 
+    def update_rows(self, nodes: Dict[str, NodeInfo], names) -> List[int]:
+        """Re-encode the rows of ``names`` in place from the live
+        NodeInfos — the incremental steady-state path (docs/design/
+        incremental_cycle.md) keeps ONE NodeArrays alive across cycles
+        and re-encodes only the dirty rows. Same field semantics as
+        :meth:`build`; membership/order changes are the caller's problem
+        (it must full-rebuild instead). Returns the updated row indices.
+        """
+        views = ("idle", "used", "releasing", "pipelined", "allocatable",
+                 "capability")
+        index = self.rindex.index
+        scales = self.rindex.scales
+        rows: List[int] = []
+        for name in names:
+            i = self.name_to_idx.get(name)
+            ni = nodes.get(name)
+            if i is None or ni is None:
+                continue
+            rows.append(i)
+            for attr in views:
+                res = getattr(ni, attr)
+                row = getattr(self, attr)[i]
+                row[:] = 0.0
+                row[0] = res.milli_cpu
+                row[1] = res.memory
+                if res.scalars:
+                    for sname, quant in res.scalars.items():
+                        si = index.get(sname)
+                        if si is not None:
+                            row[si] = quant
+                row *= scales
+            self.max_tasks[i] = ni.allocatable.max_task_num
+            self.n_tasks[i] = len(ni.tasks)
+            self.revocable[i] = bool(ni.revocable_zone)
+            self.oversubscription[i] = ni.oversubscription_node
+        return rows
+
 
 _SIG_INTERN: Dict[tuple, int] = {}
 _SIG_LOCK = threading.Lock()

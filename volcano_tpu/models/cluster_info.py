@@ -18,6 +18,24 @@ class ClusterInfo:
         self.namespaces: Dict[str, NamespaceInfo] = {}
         self.revocable_nodes: Dict[str, NodeInfo] = {}
         self.node_list: List[str] = []
+        # incremental steady-state cycle (docs/design/incremental_cycle.md):
+        # populated only by SchedulerCache's persistent-snapshot path.
+        # incr_mode: None (legacy full rebuild), "full" (periodic/forced
+        # rebuild of the persistent snapshot) or "incremental" (patched in
+        # place); patched_* name exactly the entities re-cloned this cycle
+        # (the session/solver's invalidation surface); the aux fields are
+        # maintained per patch so open_session's O(jobs+nodes) rollups
+        # become O(dirty).
+        self.incr_mode = None
+        self.incr_seq: int = 0
+        self.patched_jobs = None        # set[str] | None
+        self.patched_nodes = None       # set[str] | None
+        self.quiet: bool = False        # provably-no-op cycle hint
+        self.rindex = None              # models.arrays.ResourceIndex
+        self.total_resource = None      # Resource (sum of node allocatable)
+        self.pg_fprints = None          # {job uid: status_fingerprint}
+        self.pending_task_jobs = None   # {uid: job has Pending tasks}
+        self.pending_phase_jobs = None  # {uid: PodGroup phase == Pending}
 
     def __repr__(self):
         return (f"ClusterInfo(jobs={len(self.jobs)}, nodes={len(self.nodes)}, "

@@ -762,3 +762,15 @@ register_cloner(Pod, _clone_pod)
 register_cloner(PodGroupStatus, _clone_pod_group_status)
 register_cloner(PodGroupSpec, _clone_pod_group_spec)
 register_cloner(PodGroup, _clone_pod_group)
+
+
+def status_fingerprint(status: "PodGroupStatus") -> tuple:
+    """Cheap immutable fingerprint of a PodGroup status, used for the
+    session-close writeback dedup (framework.JobUpdater) and maintained
+    incrementally per patched job by the cache's persistent snapshot
+    (docs/design/incremental_cycle.md). The two producers MUST agree
+    tuple-for-tuple, which is why the helper lives here rather than in
+    either consumer."""
+    return (status.phase, status.running, status.succeeded, status.failed,
+            tuple((c.type, c.status, c.reason, c.message,
+                   c.last_transition_time) for c in status.conditions))

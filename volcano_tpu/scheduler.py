@@ -47,7 +47,8 @@ class Scheduler:
                  clock: Optional[Clock] = None,
                  watchdog_multiple: Optional[float] = None,
                  elector=None,
-                 anti_entropy_every: Optional[int] = None):
+                 anti_entropy_every: Optional[int] = None,
+                 incremental: Optional[bool] = None):
         self.store = store
         # time-dependent scheduling decisions (sla waiting windows, ...)
         # read this clock via the session (run_once passes it into
@@ -73,6 +74,15 @@ class Scheduler:
         self.anti_entropy_every = (anti_entropy_every
                                    if anti_entropy_every is not None
                                    else self.ANTI_ENTROPY_EVERY_CYCLES)
+        # incremental steady-state cycle (docs/design/
+        # incremental_cycle.md): the production default. The cache keeps
+        # a persistent snapshot patched per dirty job/node instead of
+        # re-cloning the cluster every period; periodic full recomputes
+        # and the anti-entropy pass bound any tracking bug. Pass
+        # incremental=False to force the legacy full rebuild per cycle.
+        self.incremental = incremental if incremental is not None else True
+        if hasattr(self.cache, "incremental"):
+            self.cache.incremental = self.incremental
         self.degraded = False
         self.cycle_deadline_exceeded = 0
         self._conf_path = scheduler_conf_path
@@ -157,9 +167,18 @@ class Scheduler:
                     begin()
                 try:
                     ssn = open_session(self.cache, conf.tiers,
-                                       conf.configurations, clock=self.clock)
+                                       conf.configurations, clock=self.clock,
+                                       actions=conf.actions)
                     tr.tag_cycle(jobs=len(ssn.jobs), nodes=len(ssn.nodes),
                                  queues=len(ssn.queues))
+                    stats = getattr(self.cache, "last_snapshot_stats", None)
+                    if stats:
+                        # /debug/cycles: snapshot mode + the dirty-set
+                        # sizes this cycle consumed
+                        tr.tag_cycle(mode=stats.get("mode"),
+                                     dirty_jobs=stats.get("dirty_jobs"),
+                                     dirty_nodes=stats.get("dirty_nodes"),
+                                     quiet=stats.get("quiet"))
                     try:
                         for name in conf.actions:
                             action = get_action(name)

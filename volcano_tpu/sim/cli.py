@@ -108,6 +108,18 @@ def add_sim_parser(sub) -> None:
     obs.add_argument("--nodes", type=int, default=128)
     obs.add_argument("--json", action="store_true")
 
+    incr = sim.add_parser(
+        "incr", help="CI gate: the same seeded churn (quiet tail, "
+                     "bursty backlog, node flaps) run twice — "
+                     "incremental persistent-snapshot cycles vs "
+                     "forced-full rebuilds — requiring bit-identical "
+                     "bind AND ledger fingerprints, zero violations, "
+                     "and proof the incremental/quiet paths engaged")
+    incr.add_argument("--seed", type=int, default=23)
+    incr.add_argument("--ticks", type=int, default=200)
+    incr.add_argument("--nodes", type=int, default=256)
+    incr.add_argument("--json", action="store_true")
+
     rep = sim.add_parser("replay", help="re-run a violation repro bundle")
     rep.add_argument("--bundle", required=True)
     rep.add_argument("--use-trace", action="store_true",
@@ -279,6 +291,34 @@ def obs_config(seed: int = 17, ticks: int = 60, nodes: int = 128):
         faults=FaultConfig(
             seed=seed, bind_fail_rate=0.02, api_latency_s=0.001),
         fail_rate=0.05,
+        repro_dir=".")
+
+
+def incr_config(seed: int = 23, ticks: int = 200, nodes: int = 256,
+                incremental: bool = True):
+    """The `make incr-smoke` shape (docs/design/incremental_cycle.md):
+    200 ticks covering the three churn regimes the incremental cycle
+    must survive — a BURSTY resident backlog at t=0, a Poisson arrival
+    stream with node FLAPS through the first 60% of the horizon, and a
+    QUIET tail (arrivals stop, completions drain, steady-state cycles go
+    dirty-free) where the quiet fast path must engage. Run twice —
+    ``incremental`` on vs off — the bind and ledger fingerprints must be
+    bit-identical: the persistent patched snapshot is required to be
+    indistinguishable, bind for bind, from a full rebuild every tick."""
+    from .engine import SimConfig
+    from .faults import FaultConfig
+    from .workload import WorkloadConfig
+    return SimConfig(
+        seed=seed, ticks=ticks, tick_s=1.0, n_nodes=nodes,
+        node_cpu="16", node_mem="32Gi",
+        resident_jobs=96, resident_gang=8,
+        workload=WorkloadConfig(
+            seed=seed, horizon_s=float(ticks) * 0.6, arrival_rate=0.4,
+            duration_min_s=15.0, duration_max_s=90.0),
+        faults=FaultConfig(
+            seed=seed, flap_rate=0.04, flap_down_s=6.0),
+        fail_rate=0.05,
+        incremental=incremental,
         repro_dir=".")
 
 
@@ -512,6 +552,59 @@ def dispatch_sim(args) -> int:
             for name, ok in checks.items():
                 print(f"  {name}: {'ok' if ok else 'FAIL'}")
             print(f"obs-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
+        return 0 if verdict["pass"] else 1
+
+    if args.verb == "incr":
+        from ..framework.solver import reset_breaker
+        reset_breaker()
+        r_incr = run_sim(incr_config(seed=args.seed, ticks=args.ticks,
+                                     nodes=args.nodes, incremental=True))
+        reset_breaker()
+        r_full = run_sim(incr_config(seed=args.seed, ticks=args.ticks,
+                                     nodes=args.nodes, incremental=False))
+        checks = {
+            "no_violations": not r_incr.violations
+                             and not r_full.violations,
+            # the machinery actually engaged: patched cycles ran and the
+            # quiet tail took the fast path
+            "incremental_cycles_ran":
+                r_incr.cycle_modes.get("incremental", 0) > 0,
+            "quiet_cycles_ran": r_incr.quiet_cycles > 0,
+            "full_run_forced_full":
+                r_full.cycle_modes.get("incremental", 0) == 0,
+            # the whole point: the patched persistent snapshot is
+            # bind-for-bind AND ledger-for-ledger indistinguishable
+            # from rebuilding the cluster every tick
+            "bind_fingerprints_identical":
+                r_incr.bind_fingerprint() == r_full.bind_fingerprint(),
+            "ledger_fingerprints_identical":
+                r_incr.ledger.get("fingerprint") ==
+                r_full.ledger.get("fingerprint"),
+        }
+        verdict = {
+            "incremental": r_incr.summary(),
+            "forced_full": {
+                "binds": len(r_full.bind_sequence),
+                "bind_fingerprint": r_full.bind_fingerprint(),
+                "ledger_fingerprint": r_full.ledger.get("fingerprint"),
+                "cycle_ms": r_full.cycle_ms_percentiles(skip=1),
+            },
+            "checks": checks,
+            "pass": all(checks.values()),
+        }
+        if args.json:
+            print(json.dumps(verdict, indent=1))
+        else:
+            _print_summary(r_incr.summary(), False)
+            c_full = r_full.cycle_ms_percentiles(skip=1)
+            c_incr = r_incr.cycle_ms_percentiles(skip=1)
+            print(f"cycle modes: {r_incr.cycle_modes} "
+                  f"(quiet={r_incr.quiet_cycles})")
+            print(f"steady p50 ms: incremental={c_incr['p50']} "
+                  f"forced-full={c_full['p50']}")
+            for name, ok in checks.items():
+                print(f"  {name}: {'ok' if ok else 'FAIL'}")
+            print(f"incr-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
         return 0 if verdict["pass"] else 1
 
     if args.verb == "replay":

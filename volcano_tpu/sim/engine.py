@@ -111,6 +111,13 @@ class SimConfig:
     # extra scheduled events injected verbatim (the failover scenario's
     # scripted kills/lapses ride the same replayable stream as arrivals)
     control_events: List[dict] = field(default_factory=list)
+    # incremental steady-state cycle (docs/design/incremental_cycle.md):
+    # run the scheduler on the persistent patched snapshot instead of a
+    # full rebuild per tick. Off by default so the legacy smoke gates
+    # keep their exact historical path; `vcctl sim incr` runs the same
+    # churn twice — incremental vs forced-full — and requires
+    # bit-identical bind + ledger fingerprints.
+    incremental: bool = False
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -165,6 +172,12 @@ class SimResult:
         # orphan audit + deterministic aggregate fingerprint, read off
         # trace/ledger.py at end of run (the obs-smoke gate's surface)
         self.ledger: dict = {}
+        # incremental-cycle accounting: snapshot mode per tick
+        # ("full"/"incremental"/"legacy") and how many ticks took the
+        # quiet fast path — the `vcctl sim incr` gate's evidence that the
+        # incremental machinery actually engaged
+        self.cycle_modes: Dict[str, int] = {}
+        self.quiet_cycles = 0
 
     def bind_fingerprint(self) -> str:
         h = hashlib.sha256()
@@ -194,6 +207,8 @@ class SimResult:
             "resync_retries": self.resync_retries,
             "quarantined": list(self.quarantined),
             "restarts": self.restarts,
+            "cycle_modes": dict(self.cycle_modes),
+            "quiet_cycles": self.quiet_cycles,
             "fenced_writes": self.fenced_writes,
             "divergence_repairs": self.divergence_repairs,
             "watch_drops": self.watch_drops,
@@ -286,7 +301,8 @@ class SimEngine:
         self.scheduler = Scheduler(self.store,
                                    scheduler_conf=self.cfg.conf_text,
                                    cache=self.cache, clock=self.clock,
-                                   elector=elector, anti_entropy_every=0)
+                                   elector=elector, anti_entropy_every=0,
+                                   incremental=self.cfg.incremental)
 
     def _install_watch_faults(self) -> None:
         f = self.cfg.faults
@@ -650,6 +666,13 @@ class SimEngine:
                 t0 = time.perf_counter()
                 self.scheduler.run_once()
                 cycle_ms = (time.perf_counter() - t0) * 1000.0
+                stats = self.cache.last_snapshot_stats \
+                    if self.cache.incremental else None
+                mode = stats.get("mode") if stats else "legacy"
+                self.result.cycle_modes[mode] = \
+                    self.result.cycle_modes.get(mode, 0) + 1
+                if stats and stats.get("quiet"):
+                    self.result.quiet_cycles += 1
                 if not self.cache.flush_executors(
                         timeout=cfg.flush_timeout_s):
                     raise RuntimeError(
