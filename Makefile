@@ -45,10 +45,12 @@ trace-smoke:
 		-k "smoke or overhead"
 
 # bind-flush micro-gate: a 5k-bind coalesced flush through the
-# production cache + store (sharded two-phase patch_batch, bulk echo
-# ingest), run TWICE on fresh envs — exit 1 unless the journal / rv /
-# bind fingerprints are bit-identical (the sharded pipeline's
-# determinism contract, docs/design/bind_pipeline.md). Seconds.
+# production cache + store (sharded three-stage pipeline with the
+# native publish/echo/apply passes on, bulk echo ingest), run TWICE on
+# fresh envs — exit 1 unless the journal / rv / bind / lifecycle-ledger
+# fingerprints are bit-identical (the pipeline's determinism contract,
+# docs/design/bind_pipeline.md). Seconds. `--tasks 50000 --nodes 10000`
+# measures the full paper regime standalone; `--profile` attributes it.
 flush-bench:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/flush_bench.py
 
@@ -104,12 +106,13 @@ obs-smoke: failover-smoke
 incr-smoke: obs-smoke
 	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli incr
 
-# bench regression gate: compare the fresh BENCH_r07.json row (written
-# by `make bench`) against the BENCH_r06 baseline with machine-
+# bench regression gate: compare the fresh BENCH_r08.json row (written
+# by `make bench`) against the BENCH_r07 baseline with machine-
 # calibration scaling (this box drifts up to ~2.3x across captures).
 # Exit 1 on a scaled regression, a row missing the r06 observability
-# fields, or an incremental steady-state cycle missing/over its 20 ms
-# machine-adjusted budget.
+# fields, an incremental steady-state cycle missing/over its 20 ms
+# machine-adjusted budget, or a bind flush over the <=800 ms
+# r05-machine commit-path target (docs/design/bind_pipeline.md).
 bench-check:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_check.py
 

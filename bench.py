@@ -129,6 +129,15 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
             return sum(h.total for (name, _), h in m._histograms.items()
                        if name == m.SOLVER_KERNEL_LATENCY)
 
+    def flush_total() -> float:
+        # the coalesced bind drain's own latency metric (apply + store
+        # pass + echo ingest) — the BIND FLUSH, as distinct from the
+        # whole flush_executors wait, which also drains the session's
+        # PodGroup status writeback and the snapshot prebuild
+        with m._lock:
+            return sum(h.total for (name, _), h in m._histograms.items()
+                       if name == m.BIND_FLUSH_LATENCY)
+
     pop = dict(n_nodes=n_nodes, n_jobs=n_tasks // 8, gang=8)
     log(f"cold env: populating {n_tasks}x{n_nodes} through the store")
     store, cache, binder, conf = _cycle_env(CONF_FULL)
@@ -151,12 +160,18 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
         s2, c2, b2, cf2 = _cycle_env(CONF_FULL)
         _populate(s2, **pop)
         k0 = kernel_total()
+        f0 = flush_total()
         ms = _run_cycle(c2, cf2)
         rec = tracer.last_record()
         kernel_ms = kernel_total() - k0
         t0 = time.perf_counter()
         flushed = c2.flush_executors(timeout=900)
-        flush_ms = (time.perf_counter() - t0) * 1000.0
+        # flush_wall_ms: the whole post-cycle executor drain (bind flush
+        # + status writeback + snapshot prebuild). bind_flush_ms: the
+        # bind drain alone, from its own latency histogram — the number
+        # the ROADMAP's <=800ms commit-path target is about
+        flush_wall_ms = (time.perf_counter() - t0) * 1000.0
+        flush_ms = flush_total() - f0
         if not flushed:
             # a truncated flush_ms would quietly flatter the number — a
             # timed-out flush must fail the bench, not shade it
@@ -190,19 +205,30 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
             if denom else 0.0
         c2.incremental = False
         log(f"warm {i + 1}/{runs}: cycle={ms:.1f} ms kernel={kernel_ms:.1f} "
-            f"ms flush={flush_ms:.1f} ms steady={steady:.1f} ms "
+            f"ms flush={flush_ms:.1f} ms (wall {flush_wall_ms:.1f} ms) "
+            f"steady={steady:.1f} ms "
             f"steady_incr={steady_incr:.1f} ms "
             f"(mode={snap_stats.get('mode')} quiet={snap_stats.get('quiet')} "
             f"dirty={dirty_fraction:.4f}) binds={len(b2.binds)}")
         if best is None or ms < best["cycle_ms"]:
+            prev_flush = best["bind_flush_ms"] if best else flush_ms
+            prev_wall = best["flush_wall_ms"] if best else flush_wall_ms
             best = {"cycle_ms": ms, "kernel_ms": kernel_ms,
-                    "bind_flush_ms": flush_ms, "steady_state_ms": steady,
+                    "bind_flush_ms": min(flush_ms, prev_flush),
+                    "flush_wall_ms": min(flush_wall_ms, prev_wall),
+                    "steady_state_ms": steady,
                     "steady_state_incremental_ms": steady_incr,
                     "dirty_fraction": round(dirty_fraction, 5),
                     "incr_snapshot": snap_stats,
                     "binds": len(b2.binds),
                     "platform": devs[0].platform}
             best_rec = rec
+        else:
+            # flush min-of-runs like every other noise-sensitive metric
+            # (co-tenant bursts hit the GIL-bound drain hardest)
+            best["bind_flush_ms"] = min(best["bind_flush_ms"], flush_ms)
+            best["flush_wall_ms"] = min(best["flush_wall_ms"],
+                                        flush_wall_ms)
         c2.stop()   # see the cold-env note: a leaked executor thread
         #             keeps the env resident and run i+1 pays run i's heap
         del s2, c2, b2
@@ -261,10 +287,10 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
 
 
 def write_bench_row(row: dict) -> None:
-    """Persist the headline row (BENCH_r07.json by default; override or
+    """Persist the headline row (BENCH_r08.json by default; override or
     disable with VOLCANO_BENCH_ROW_OUT) with a machine-calibration
     fingerprint so tools/bench_check.py can scale cross-box compares."""
-    out = os.environ.get("VOLCANO_BENCH_ROW_OUT", "BENCH_r07.json")
+    out = os.environ.get("VOLCANO_BENCH_ROW_OUT", "BENCH_r08.json")
     if not out:
         return
     try:
@@ -638,8 +664,15 @@ def main() -> None:
                     float(res.get("steady_state_incremental_ms", 0.0)), 2),
                 "dirty_fraction": res.get("dirty_fraction"),
                 "incr_snapshot": res.get("incr_snapshot"),
+                # the coalesced bind drain (apply + store pass + echo
+                # ingest) from its own latency histogram — BENCH_r08
+                # onward; flush_wall_ms keeps the pre-r08 semantics (the
+                # whole flush_executors wait incl. PodGroup status
+                # writeback + snapshot prebuild)
                 "bind_flush_ms": round(
                     float(res.get("bind_flush_ms", 0.0)), 2),
+                "flush_wall_ms": round(
+                    float(res.get("flush_wall_ms", 0.0)), 2),
                 "binds": res.get("binds"),
                 # per-phase attribution from the flight recorder
                 # (volcano_tpu/trace): '/'-joined span paths -> {ms, count}

@@ -154,11 +154,15 @@ class TestShardedEngine:
         assert p0.metadata.labels.get("second") == "yes"
         assert_journal_clean(store)
 
-    def test_sharded_raising_fn_commits_noop_and_reraises(self):
+    @pytest.mark.parametrize("native_publish", [False, True])
+    def test_sharded_raising_fn_commits_noop_and_reraises(
+            self, native_publish):
         """Sharded path: a raising patch fn cannot abort reserved rvs —
         its item commits a no-op version, every other item commits, the
-        journal stays gap-free and the error re-raises after delivery."""
+        journal stays gap-free and the error re-raises after delivery —
+        identically through the native and the Python publish engine."""
         store = sharded(store_with_pods(6), target=2)
+        store.NATIVE_PUBLISH = native_publish
 
         def boom(p):
             raise RuntimeError("bad patch")
@@ -296,8 +300,10 @@ class TestFilterFlipWatchers:
 
     @pytest.mark.parametrize("force_sharded", [False, True])
     @pytest.mark.parametrize("bulk_handler", [False, True])
-    def test_filter_flips(self, force_sharded, bulk_handler):
+    @pytest.mark.parametrize("native_publish", [False, True])
+    def test_filter_flips(self, force_sharded, bulk_handler, native_publish):
         store = self._flip_store(force_sharded)
+        store.NATIVE_PUBLISH = native_publish
         got = {"add": [], "delete": [], "update": [], "bulk": []}
         kwargs = dict(
             on_add=lambda o: got["add"].append(o.metadata.name),
@@ -326,6 +332,229 @@ class TestFilterFlipWatchers:
             assert got["update"] == ["p000"]
             assert got["bulk"] == []
         assert_journal_clean(store)
+
+
+class TestNativeParity:
+    """The native publish / echo / apply engines (fastmodel.c) must be
+    BIT-IDENTICAL to the pure-Python pipeline: same journal, same rvs,
+    same bind set, same cache state (status indexes, node accounting)
+    and the same lifecycle-ledger aggregate fingerprint. These are the
+    acceptance fingerprints of docs/design/bind_pipeline.md."""
+
+    @staticmethod
+    def _set_native(on: bool) -> None:
+        from volcano_tpu.apiserver.store import ObjectStore as S
+        from volcano_tpu.cache.cache import SchedulerCache as C
+        from volcano_tpu.trace import ledger as L
+        S.NATIVE_PUBLISH = on
+        C.NATIVE_ECHO = on
+        C.NATIVE_APPLY = on
+        L.NATIVE_CONFIRM = on
+
+    @pytest.fixture(autouse=True)
+    def _restore_native(self):
+        yield
+        self._set_native(True)
+
+    def _run_flush(self, native: bool, n_jobs=64, gang=8, n_nodes=16):
+        """One full coalesced cache flush (write-behind applies, sharded
+        store commit, echo ingest) on a virtual clock; returns a
+        deep fingerprint of every observable surface."""
+        import hashlib
+
+        from volcano_tpu.cache import SchedulerCache
+        from volcano_tpu.trace import ledger
+        from volcano_tpu.utils.clock import FakeClock
+
+        self._set_native(native)
+        store = ObjectStore(clock=FakeClock(start=1.0))
+        store.SHARD_SERIAL_MAX = 0
+        store.SHARD_TARGET = 128        # 512 binds -> 4 shards
+        binder = FakeBinder(store)
+        cache = SchedulerCache(store, binder=binder,
+                               evictor=FakeEvictor(store))
+        cache.run()
+        store.create("queues", build_queue("default", weight=1))
+        for i in range(n_nodes):
+            store.create("nodes", build_node(
+                f"node-{i}", {"cpu": "640", "memory": "2560Gi",
+                              "pods": "1100"}))
+        for j in range(n_jobs):
+            store.create("podgroups", build_pod_group(
+                f"pg-{j}", "default", "default", gang, phase="Inqueue"))
+            for t in range(gang):
+                store.create("pods", build_pod(
+                    "default", f"job{j}-task{t}", "", "Pending",
+                    {"cpu": "2", "memory": "4Gi"}, groupname=f"pg-{j}"))
+        ledger.reset()
+        ledger.enable()
+        try:
+            with cache.mutex:
+                for job in cache.jobs.values():
+                    for t in job.tasks.values():
+                        ledger.stamp(t.key(), "submitted",
+                                     store.clock.now(), job=t.job)
+                gangs = []
+                i = 0
+                for job in sorted(cache.jobs.values(),
+                                  key=lambda j: j.uid):
+                    pairs = []
+                    for t in sorted(job.tasks.values(),
+                                    key=lambda t: t.uid):
+                        pairs.append((t, f"node-{i % n_nodes}"))
+                        i += 1
+                    gangs.append(pairs)
+            for pairs in gangs:
+                cache.bind_batch(pairs)
+            assert cache.flush_executors(timeout=60.0)
+
+            h = hashlib.sha256()
+            with store._lock:
+                for rv, action, kind, o in store._journal:
+                    h.update(f"{rv}|{action}|{kind}|"
+                             f"{store.key_of(kind, o)}|"
+                             f"{getattr(o.spec, 'node_name', '')}\n"
+                             .encode())
+                assert store._journal_tail == store._rv
+                assert not store._journal_parked
+                assert not any(store._inflight.values())
+            for p in sorted(store.list_refs("pods"),
+                            key=lambda p: p.metadata.key()):
+                h.update(f"{p.metadata.key()}|"
+                         f"{p.metadata.resource_version}|"
+                         f"{p.spec.node_name}\n".encode())
+            with cache.mutex:
+                for uid in sorted(cache.jobs):
+                    job = cache.jobs[uid]
+                    h.update(f"job {uid} alloc={job.allocated.milli_cpu}"
+                             f" pend={job.pending_request.milli_cpu}\n"
+                             .encode())
+                    for tuid in sorted(job.tasks):
+                        t = job.tasks[tuid]
+                        h.update(
+                            f"  {tuid} {t.status.name} {t.node_name} "
+                            f"{t.pod.metadata.resource_version}\n"
+                            .encode())
+                    for st in sorted(job.task_status_index,
+                                     key=lambda s: s.name):
+                        h.update(f"  idx {st.name} "
+                                 f"{sorted(job.task_status_index[st])}\n"
+                                 .encode())
+                for name in sorted(cache.nodes):
+                    n = cache.nodes[name]
+                    h.update(f"node {name} idle={n.idle.milli_cpu}/"
+                             f"{n.idle.memory} used={n.used.milli_cpu} "
+                             f"tasks={sorted(n.tasks)}\n".encode())
+            h.update(ledger.fingerprint().encode())
+            stats = ledger.stats()
+            return {"fp": h.hexdigest(), "binds": dict(binder.binds),
+                    "completed": stats["completed"],
+                    "open": stats["open"]}
+        finally:
+            cache.stop()
+            ledger.disable()
+            ledger.reset()
+
+    def test_native_vs_python_flush_bit_identical(self):
+        a = self._run_flush(native=True)
+        b = self._run_flush(native=False)
+        assert a["completed"] == 64 * 8 and a["open"] == 0
+        assert a == b
+
+    def test_native_publish_vs_python_raising_fn_state(self):
+        """The raising-fn containment path (no-op version, gap-free
+        journal, re-raise) must leave identical stored state through
+        both publish engines."""
+        outs = []
+        for native in (False, True):
+            store = sharded(store_with_pods(6), target=2)
+            store.NATIVE_PUBLISH = native
+
+            def boom(p):
+                raise RuntimeError("bad patch")
+
+            with pytest.raises(RuntimeError, match="bad patch"):
+                store.patch_batch(
+                    "pods", [(f"p{i:03d}", "ns1",
+                              boom if i == 3 else setter(f"n{i}"))
+                             for i in range(6)])
+            assert_journal_clean(store)
+            outs.append([(p.metadata.name, p.spec.node_name,
+                          p.metadata.resource_version)
+                         for p in sorted(store.list_refs("pods"),
+                                         key=lambda p: p.metadata.name)])
+        assert outs[0] == outs[1]
+
+    def test_commit_echo_hop_split(self):
+        """The pipelined flush stamps store_committed at the shard's
+        PUBLISH instant and echo_confirmed at ingest, so the ledger
+        splits flush-internal queue wait out of staged->committed
+        (docs/design/bind_pipeline.md). On a clock that advances per
+        read, the committed->echo hop must be visibly nonzero."""
+        from volcano_tpu.cache import SchedulerCache
+        from volcano_tpu.trace import ledger
+        from volcano_tpu.utils.clock import Clock
+
+        class TickClock(Clock):
+            def __init__(self):
+                self.t = 1.0
+
+            def now(self):
+                self.t += 0.001
+                return self.t
+
+        self._set_native(True)
+        store = ObjectStore(clock=TickClock())
+        store.SHARD_SERIAL_MAX = 0
+        store.SHARD_TARGET = 128
+        binder = FakeBinder(store)
+        cache = SchedulerCache(store, binder=binder,
+                               evictor=FakeEvictor(store))
+        cache.run()
+        store.create("queues", build_queue("default", weight=1))
+        for i in range(8):
+            store.create("nodes", build_node(
+                f"node-{i}", {"cpu": "640", "memory": "2560Gi",
+                              "pods": "1100"}))
+        for j in range(80):
+            store.create("podgroups", build_pod_group(
+                f"pg-{j}", "default", "default", 8, phase="Inqueue"))
+            for t in range(8):
+                store.create("pods", build_pod(
+                    "default", f"job{j}-task{t}", "", "Pending",
+                    {"cpu": "1", "memory": "1Gi"}, groupname=f"pg-{j}"))
+        ledger.reset()
+        ledger.enable()
+        try:
+            with cache.mutex:
+                gangs = []
+                i = 0
+                for job in sorted(cache.jobs.values(),
+                                  key=lambda j: j.uid):
+                    for t in sorted(job.tasks.values(),
+                                    key=lambda t: t.uid):
+                        ledger.stamp(t.key(), "submitted",
+                                     store.clock.now(), job=t.job)
+                    gangs.append([
+                        (t, f"node-{(i := i + 1) % 8}")
+                        for t in sorted(job.tasks.values(),
+                                        key=lambda t: t.uid)])
+            for pairs in gangs:
+                cache.bind_batch(pairs)
+            assert cache.flush_executors(timeout=60.0)
+            hops = ledger.report()["hops"]
+            split = hops.get("store_committed->echo_confirmed")
+            assert split is not None and split["count"] == 80 * 8
+            # the publish instant precedes the echo ingest on a ticking
+            # clock: the hop must be nonzero, i.e. NOT folded into
+            # bind_staged->store_committed
+            assert split["mean_ms"] > 0.0
+            staged = hops.get("bind_staged->store_committed")
+            assert staged is not None and staged["count"] == 80 * 8
+        finally:
+            cache.stop()
+            ledger.disable()
+            ledger.reset()
 
 
 def _stress_env(n_nodes=32, n_jobs=64, gang=8):

@@ -49,6 +49,67 @@ def _flush_pool():
                     max_workers=workers, thread_name_prefix="flush-shard")
     return _FLUSH_POOL
 
+
+# dedicated single-thread delivery executor: stage 3 of the flush
+# pipeline (docs/design/bind_pipeline.md). ONE worker so deliveries
+# retain shard order; shared module-wide like the clone pool (delivery
+# order only matters within one store's patch, and a patch drains its
+# own deliveries before returning).
+_ECHO_POOL = None
+
+
+def _echo_pool():
+    global _ECHO_POOL
+    if _ECHO_POOL is None:
+        with _FLUSH_POOL_LOCK:
+            if _ECHO_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
+                _ECHO_POOL = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="flush-echo")
+    return _ECHO_POOL
+
+
+# per-delivery context for watch handlers: when the echo worker runs a
+# shard's delivery, ``origin`` carries the thread ident of the flush
+# that produced it (the cache's expected-bind-echo hint is scoped to
+# the writer's thread — the pipeline delivers on the writer's BEHALF)
+# and ``commit_t`` the store-clock instant the shard published (the
+# ledger's store_committed stamp, so committed->echo shows the echo
+# pipeline's internal queue wait). ``depth`` flags handlers already
+# running ON the echo worker, so a nested bulk patch inside a delivery
+# degrades to inline delivery instead of deadlocking on the one worker.
+_DELIVERY_CTX = threading.local()
+
+
+def delivery_origin():
+    """Thread ident of the flush a running watch delivery belongs to
+    (the current thread outside the echo pipeline)."""
+    return getattr(_DELIVERY_CTX, "origin", None) or threading.get_ident()
+
+
+def delivery_commit_time():
+    """Store-clock instant the delivering shard published, or None
+    outside the echo pipeline."""
+    return getattr(_DELIVERY_CTX, "commit_t", None)
+
+
+# native publish (fastmodel.publish_shard): resolved lazily; the import
+# is shared with the bind-clone fast path
+_PUBLISH_NATIVE = [None, False]   # [module, probed]
+
+
+def _publish_native():
+    if not _PUBLISH_NATIVE[1]:
+        _PUBLISH_NATIVE[1] = True
+        try:
+            from ..native.build import fastmodel
+            fm = fastmodel()
+            if fm is not None and hasattr(fm, "publish_shard"):
+                _PUBLISH_NATIVE[0] = fm
+        except Exception:
+            _PUBLISH_NATIVE[0] = None
+    return _PUBLISH_NATIVE[0]
+
 def trace_in_ranges(ranges: list, rv: int):
     """Resolve ``rv`` against a ``trace_ranges()`` snapshot: ranges are
     non-overlapping and ascending by ``lo``, so a bisect finds the only
@@ -109,7 +170,8 @@ class AdmissionHook:
 class Watch:
     def __init__(self, kind: str, on_add=None, on_update=None, on_delete=None,
                  filter_fn: Optional[Callable] = None,
-                 on_bulk_update: Optional[Callable] = None):
+                 on_bulk_update: Optional[Callable] = None,
+                 filter_attr: Optional[tuple] = None):
         self.kind = kind
         self.on_add = on_add
         self.on_update = on_update
@@ -120,6 +182,13 @@ class Watch:
         # handler dispatch + locking); watchers without it get per-pair
         # on_update calls
         self.on_bulk_update = on_bulk_update
+        # optional declaration that filter_fn is EQUIVALENT to the
+        # attribute equality obj.<a0>.<a1> == expected —
+        # ((a0, a1), expected) — letting bulk deliveries classify a
+        # whole burst natively (two Python filter calls per pod on the
+        # 50k flush otherwise). filter_fn stays authoritative: any
+        # unexpected shape falls back to it.
+        self.filter_attr = filter_attr
 
     def _passes(self, o) -> bool:
         return self.filter_fn is None or self.filter_fn(o)
@@ -153,6 +222,9 @@ class ObjectStore:
     SHARD_SERIAL_MAX = 512
     SHARD_TARGET = 2048
     SHARD_MAX = 8
+    # native publish (fastmodel.publish_shard) switch — class attr so
+    # the native-vs-Python parity tests can force either engine
+    NATIVE_PUBLISH = True
 
     def __init__(self, clock: Clock = GLOBAL_CLOCK):
         self._objects: Dict[str, Dict[str, object]] = {k: {} for k in KINDS}
@@ -650,8 +722,14 @@ class ObjectStore:
 
     def _publish_shards(self, kind, shards, bases, watches, clone_fn,
                         apply_fn, batch_shard, missing) -> tuple:
-        """Phases 2+3 of :meth:`_bulk_patch`: fan the shards out to the
-        clone pool, then publish + deliver them strictly in shard order."""
+        """Phases 2+3+4 of :meth:`_bulk_patch` — the three-stage pipeline
+        (docs/design/bind_pipeline.md): shard clones run on the worker
+        pool, this thread publishes (installs + journals) strictly in
+        shard order, and each published shard's watch delivery is handed
+        to the single-thread echo executor. Shard *i*'s echo apply,
+        shard *i+1*'s publish and shard *i+2*'s clone are therefore all
+        in flight at once; all deliveries drain before the patch
+        returns, so callers keep the synchronous contract."""
         first_err: list = [None]
 
         def run_shard(shard, rv_base):
@@ -677,8 +755,41 @@ class ObjectStore:
             return news
 
         from ..trace import tracer
+        origin = delivery_origin()   # transitive: a nested patch inside
+        #                              a delivery keeps the root writer
+        deliver_err: list = [None]
+
+        def deliver_task(spairs, commit_t):
+            # every published shard DELIVERS, even after an earlier
+            # shard's handler raised: the publish loop runs ahead of the
+            # deliveries, so skipping would leave committed state no
+            # watcher ever saw (the first handler error still re-raises
+            # after the drain). Save/restore the context rather than
+            # clearing it — a nested inline delivery must hand the outer
+            # frame its origin back.
+            prev = (getattr(_DELIVERY_CTX, "origin", None),
+                    getattr(_DELIVERY_CTX, "commit_t", None))
+            _DELIVERY_CTX.origin = origin
+            _DELIVERY_CTX.commit_t = commit_t
+            _DELIVERY_CTX.depth = getattr(_DELIVERY_CTX, "depth", 0) + 1
+            try:
+                with tracer.async_span("store.patch.deliver",
+                                       pairs=len(spairs)):
+                    self._deliver_patch_pairs(watches, spairs)
+            except BaseException as e:
+                if deliver_err[0] is None:
+                    deliver_err[0] = e
+            finally:
+                _DELIVERY_CTX.depth -= 1
+                _DELIVERY_CTX.origin, _DELIVERY_CTX.commit_t = prev
+
+        # a bulk patch issued FROM a watch delivery already runs on the
+        # echo worker: submitting its deliveries to the same one-thread
+        # pool would deadlock — deliver inline instead (no pipeline)
+        inline_echo = getattr(_DELIVERY_CTX, "depth", 0) > 0
         pairs_all: list = []
         published = 0
+        deliveries: list = []
         try:
             # everything from here until the last shard publishes sits
             # inside the recovery scope: a failure anywhere (pool
@@ -686,17 +797,23 @@ class ObjectStore:
             # land the reserved rvs and release the key barriers, or the
             # journal tail stalls and every later write blocks forever
             pool = _flush_pool()
+            epool = None if inline_echo else _echo_pool()
             futures = [pool.submit(run_shard, s, b)
                        for s, b in zip(shards, bases)]
             for shard, base, fut in zip(shards, bases, futures):
                 with tracer.async_span("store.patch.clone_wait"):
                     news = fut.result()
                 with tracer.async_span("store.patch.publish"):
-                    spairs = self._install_shard_locked(kind, shard, news)
+                    spairs = self._install_shard_locked(kind, shard, news,
+                                                        base)
                 published += 1
                 pairs_all.extend(spairs)
-                with tracer.async_span("store.patch.deliver"):
-                    self._deliver_patch_pairs(watches, spairs)
+                commit_t = self.clock.now()
+                if epool is None:
+                    deliver_task(spairs, commit_t)
+                else:
+                    deliveries.append(
+                        epool.submit(deliver_task, spairs, commit_t))
         finally:
             if published < len(shards):
                 # fill the unpublished remainder with no-op versions
@@ -704,18 +821,45 @@ class ObjectStore:
                     news = [clone_fn(old) for _, old, _ in shard]
                     for i, new in enumerate(news):
                         new.metadata.resource_version = base + i + 1
-                    self._install_shard_locked(kind, shard, news)
+                    self._install_shard_locked(kind, shard, news, base)
+            # echo drain: the patch must not return (nor the bind flush
+            # release its barrier) with deliveries still in flight
+            if deliveries:
+                with tracer.async_span("store.patch.echo_wait"):
+                    for f in deliveries:
+                        f.result()
         if first_err[0] is not None:
             raise first_err[0]
+        if deliver_err[0] is not None:
+            raise deliver_err[0]
         return pairs_all, missing
 
-    def _install_shard_locked(self, kind, shard, news) -> list:
+    def _install_shard_locked(self, kind, shard, news, rv_base) -> list:
         """Ordered-publish step: install a shard's new versions, append
-        their journal entries (contiguous reserved rvs) and release the
-        shard's write barrier. Returns the shard's [(old, new)] pairs."""
+        their journal entries (the contiguous reserved rvs from
+        ``rv_base + 1``) and release the shard's write barrier. The whole
+        per-shard loop — install + journal-entry construction + delivery
+        pair assembly — is ONE ``fastmodel.publish_shard`` call when the
+        native module is available (the Python loop was a measured slice
+        of the 50k-bind commit path); the journal batch then lands
+        through ONE sequencer call. Returns the shard's [(old, new)]."""
+        fm = _publish_native() if self.NATIVE_PUBLISH else None
         with self._lock:
             objs = self._objects[kind]
             infl = self._inflight[kind]
+            if fm is not None:
+                try:
+                    entries, pairs = fm.publish_shard(objs, infl, kind,
+                                                      shard, news, rv_base)
+                    self._journal_extend_locked(entries)
+                    self._flush_cond.notify_all()
+                    return pairs
+                except Exception:
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "native publish_shard failed; Python fallback")
+                    # fall through: the Python loop re-applies the
+                    # install idempotently
             entries = []
             for (key, _, _), new in zip(shard, news):
                 objs[key] = new
@@ -740,9 +884,37 @@ class ObjectStore:
                 bulk(pairs)
                 continue
             if bulk is not None:
+                if w.filter_attr is not None:
+                    # native classification for declared attribute-
+                    # equality filters; filter_fn stays the authority
+                    # on any failure. Flips come back as ordered
+                    # (is_add, obj) events, fired exactly like the
+                    # per-pair loop below would fire them.
+                    fm = _publish_native() if self.NATIVE_PUBLISH else None
+                    if fm is not None and hasattr(fm,
+                                                  "attr_eq_filter_pairs"):
+                        (path0, path1), expected = w.filter_attr
+                        try:
+                            delivery, flips = fm.attr_eq_filter_pairs(
+                                pairs if isinstance(pairs, list)
+                                else list(pairs),
+                                path0, path1, expected)
+                        except Exception:
+                            pass
+                        else:
+                            for is_add, o in flips:
+                                if is_add and w.on_add:
+                                    w.on_add(fast_clone(o))
+                                elif not is_add and w.on_delete:
+                                    w.on_delete(o)
+                            if delivery:
+                                bulk(delivery)
+                            continue
+                fl = w.filter_fn   # direct: the _passes wrapper is two
+                #                    extra calls per pod on a 50k burst
                 delivery = []
                 for old, new in pairs:
-                    old_p, new_p = w._passes(old), w._passes(new)
+                    old_p, new_p = fl(old), fl(new)
                     if old_p and new_p:
                         delivery.append((old, new))
                     elif not old_p and new_p and w.on_add:
@@ -822,11 +994,14 @@ class ObjectStore:
 
     def watch(self, kind: str, on_add=None, on_update=None, on_delete=None,
               filter_fn=None, sync: bool = True,
-              on_bulk_update=None) -> Watch:
+              on_bulk_update=None, filter_attr=None) -> Watch:
         """Subscribe to events for a kind; with sync=True, existing objects
-        are replayed through on_add first (informer list+watch semantics)."""
+        are replayed through on_add first (informer list+watch semantics).
+        ``filter_attr=((a0, a1), expected)`` optionally declares that
+        ``filter_fn`` is equivalent to ``obj.<a0>.<a1> == expected`` so
+        bulk deliveries can classify the burst natively."""
         w = Watch(kind, on_add, on_update, on_delete, filter_fn,
-                  on_bulk_update=on_bulk_update)
+                  on_bulk_update=on_bulk_update, filter_attr=filter_attr)
         with self._lock:
             # wait out an in-flight sharded patch on this kind: its
             # delivery list was snapshotted at reservation time, so a

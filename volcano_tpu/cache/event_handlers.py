@@ -9,13 +9,15 @@ is synchronous).
 
 from __future__ import annotations
 
-import threading
+import logging
 from typing import Optional
 
+from ..apiserver import store as store_api
 from ..models import objects as obj
 from ..models.arrays import _group_sig
-from ..models.job_info import (JobInfo, TaskInfo, allocated_status,
-                               get_job_id, get_task_status, is_terminated)
+from ..models.job_info import (JobInfo, TaskInfo, _fastmodel,
+                               allocated_status, get_job_id,
+                               get_task_status, is_terminated)
 from ..trace import ledger
 from ..utils.fastclone import fast_clone
 from ..models.node_info import NodeInfo
@@ -31,6 +33,10 @@ class EventHandlersMixin:
     :meth:`update_pods_bulk` is the ONE deliberate exception: a
     self-inflicted bind echo confirms state the bind apply already
     dirtied and must not re-dirty its job."""
+
+    # native echo apply (fastmodel.bind_echo_apply) switch — class attr
+    # so the native-vs-Python parity tests can force either engine
+    NATIVE_ECHO = True
 
     # -- pods -------------------------------------------------------------
 
@@ -186,20 +192,49 @@ class EventHandlersMixin:
             run_job = None
             run_tasks = []
 
-        # the bind-echo hint is thread-scoped: only deliveries on the
-        # hinting thread are provably its own store write (the store
-        # delivers synchronously from the patching thread)
+        # the bind-echo hint is scoped to the DELIVERY ORIGIN: only a
+        # delivery the hinting thread's own store write produced is
+        # provably its echo. The store delivers synchronously from the
+        # patching thread, or — on the pipelined flush — from the echo
+        # worker acting on the patching thread's behalf, which stamps
+        # that thread's ident into the delivery context.
         hint_state = getattr(self, "_expected_bind_echo", None)
         exp = hint_state[1] if hint_state is not None \
-            and hint_state[0] == threading.get_ident() else None
+            and hint_state[0] == store_api.delivery_origin() else None
         # lifecycle ledger: one clock read and one bulk confirm per
         # delivery (per shard on the sharded flush, so shard i's pods
-        # confirm while shard i+1 is still cloning)
+        # confirm while shard i+1 is still cloning). The shard's publish
+        # instant rides the delivery context, so the
+        # store_committed->echo_confirmed hop shows the echo pipeline's
+        # queue wait instead of folding into staged->committed.
         now = self.store.clock.now() if ledger.is_enabled() else None
+        commit_t = store_api.delivery_commit_time() \
+            if now is not None else None
         confirms: list = []
         with tracer.async_span("bind_flush.echo", pairs=len(pairs)), \
                 self.mutex:
             self._state_version += 1
+            if exp is not None and self.NATIVE_ECHO:
+                # native fast path: the whole hinted scan — guards,
+                # status-index moves, rv refresh, node-view sync, ledger
+                # run grouping — in one C pass; pairs that miss a guard
+                # come back for the Python loop below (bit-identical
+                # final state either way, tests/test_flush_pipeline.py)
+                fm = _fastmodel()
+                if fm is not None and hasattr(fm, "bind_echo_apply"):
+                    try:
+                        runs, rest = fm.bind_echo_apply(
+                            pairs if isinstance(pairs, list)
+                            else list(pairs),
+                            exp, self.jobs, self.nodes, now is not None)
+                    except Exception:
+                        logging.getLogger(__name__).exception(
+                            "native bind_echo_apply failed; Python "
+                            "fallback")
+                    else:
+                        if runs:
+                            ledger.confirm_runs(runs, now, commit_t)
+                        pairs = rest
             for old, new in pairs:
                 if exp is not None:
                     # our own bind write echoing back (delivered on the
@@ -300,7 +335,7 @@ class EventHandlersMixin:
                     pass   # e.g. pod bound to a node we haven't seen yet
             flush_run()
             if confirms:
-                ledger.confirm_bulk(confirms, now)
+                ledger.confirm_bulk(confirms, now, commit_t)
 
     def delete_pod(self, pod: obj.Pod) -> None:
         # a deleted pod drops its bind-failure history — the

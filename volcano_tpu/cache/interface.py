@@ -48,6 +48,23 @@ def _accepts_clone_fn(patch_fn) -> bool:
     return cached
 
 
+def native_bind_request_items(items, want_reqs: bool, want_keys: bool):
+    """The fastmodel binder-seam plumbing — ``[(pod, host)]`` to the
+    ``(name, ns, host)`` request list and/or the ``"ns/name"`` key list
+    — or ``(None, None)`` when the native module is unavailable or the
+    shapes surprise it (callers then build the lists in Python)."""
+    try:
+        from ..models.job_info import _fastmodel
+        fm = _fastmodel()
+        if fm is not None and hasattr(fm, "bind_request_items"):
+            return fm.bind_request_items(
+                items if isinstance(items, list) else list(items),
+                want_reqs, want_keys)
+    except Exception:
+        pass
+    return None, None
+
+
 def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool,
                     fence=None, trace=None) -> tuple:
     """Shared engine behind StoreBinder/FakeBinder ``bind_batch``: one
@@ -85,10 +102,15 @@ def bind_pods_batch(store, items, per_pod_bind, batch_ok: bool,
         fence_kw["trace"] = trace
     if bind_fn is not None:
         # payload-based fast path: no per-pod closures to build, and the
-        # store can promote whole shards into fastmodel.bind_clone_pods
-        _, missing_keys = bind_fn(
-            [(pod.metadata.name, pod.metadata.namespace, hostname)
-             for pod, hostname in items], **fence_kw)
+        # store can promote whole shards into fastmodel.bind_clone_pods;
+        # the (name, ns, host) request list itself builds natively when
+        # the module is available (two attribute loads + a tuple per pod
+        # on the 50k drain otherwise)
+        reqs, _ = native_bind_request_items(items, True, False)
+        if reqs is None:
+            reqs = [(pod.metadata.name, pod.metadata.namespace, hostname)
+                    for pod, hostname in items]
+        _, missing_keys = bind_fn(reqs, **fence_kw)
     else:
         def setter(host):
             def fn(p):

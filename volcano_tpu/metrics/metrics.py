@@ -142,12 +142,23 @@ def observe_bulk(name: str, values, **labels):
     """Observe a whole batch under ONE lock pass — the pod lifecycle
     ledger exports per-hop latencies for 50k-bind flush deliveries, and
     per-value locking would put ~300k lock acquisitions on the flush
-    executor."""
+    executor. Buckets resolve by bisect instead of the per-value bound
+    scan (same first-bound->=value semantics), and the running total
+    accumulates in the same per-value order as repeated observe()."""
+    from bisect import bisect_left
     key = (name, tuple(sorted(labels.items())))
     with _lock:
         h = _histograms[key]
+        bounds = h.BOUNDS
+        buckets = h.buckets
+        nb = len(bounds)
+        h.count += len(values)
+        total = h.total
         for v in values:
-            h.observe(v)
+            total += v
+            i = bisect_left(bounds, v)
+            buckets[i if i < nb else -1] += 1
+        h.total = total
 
 
 def set_gauge(name: str, value: float, **labels):

@@ -94,10 +94,47 @@ def ensure_built() -> str:
                 raise RuntimeError(
                     f"native solver build failed:\n{r.stderr[-2000:]}")
             os.replace(tmp, path)   # atomic: concurrent builders race safely
-            # leave older-hash libraries in place (running processes may
-            # still map them); the directory holds at most a few
+            # drop superseded hashes: every source edit used to leave its
+            # build artifact behind and the directory accumulated stale
+            # .so files. Unlinking is safe even for a library a running
+            # process still maps (the inode lives until unmapped).
+            _clean_superseded("libvcsolver-", path)
         _cached_path = path
         return path
+
+
+# a .tmp file younger than this is treated as another builder's
+# in-flight output, never cleanup fodder (the os.replace publish is
+# atomic; deleting a live tmp would break that race-safety)
+_TMP_STALE_SECONDS = 600.0
+
+
+def _clean_superseded(prefix: str, keep: str) -> None:
+    """Best-effort removal of older-hash build artifacts sharing
+    ``prefix``, plus .tmp files ORPHANED by crashed builds (age-gated:
+    a fresh tmp belongs to a concurrent builder about to os.replace)."""
+    import time
+    keep_name = os.path.basename(keep)
+    try:
+        for name in os.listdir(_DIR):
+            if not name.startswith(prefix):
+                continue
+            if name == keep_name:
+                continue
+            path = os.path.join(_DIR, name)
+            try:
+                if ".so.tmp" in name:
+                    if time.time() - os.path.getmtime(path) \
+                            < _TMP_STALE_SECONDS:
+                        continue   # in-flight concurrent build
+                elif not name.endswith(".so"):
+                    continue
+                os.unlink(path)
+                _log.info("removed superseded native artifact %s", name)
+            except OSError:
+                pass
+    except OSError:
+        pass
 
 
 _FM_SRC = os.path.join(_DIR, "fastmodel.c")
@@ -133,6 +170,7 @@ def fastmodel():
                     raise RuntimeError(
                         f"fastmodel build failed:\n{r.stderr[-1500:]}")
                 os.replace(tmp, so)
+                _clean_superseded("fastmodel-", so)
             spec = importlib.util.spec_from_file_location("fastmodel", so)
             mod = importlib.util.module_from_spec(spec)
             spec.loader.exec_module(mod)

@@ -15,6 +15,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 #include <structmember.h>
+#include <math.h>
 
 #define MAX_SLOTS 64
 
@@ -23,6 +24,30 @@ static Py_ssize_t task_offsets[MAX_SLOTS];
 static int n_task_slots = -1;
 static Py_ssize_t status_offset = -1;
 static Py_ssize_t uid_offset = -1;
+/* extra named TaskInfo slots for the bind-echo/apply passes */
+static Py_ssize_t t_node_name_off = -1, t_job_off = -1, t_pod_off = -1,
+                  t_namespace_off = -1, t_name_off = -1, t_resreq_off = -1,
+                  t_key_off = -1;
+
+/* offset of one named slot's member descriptor on tp (resolved through
+ * tp so shadowed names land on the instance's real storage); -1 with an
+ * exception set on failure */
+static Py_ssize_t
+member_offset(PyTypeObject *tp, const char *name)
+{
+    PyObject *descr = PyObject_GetAttrString((PyObject *)tp, name);
+    if (descr == NULL)
+        return -1;
+    if (Py_TYPE(descr) != &PyMemberDescr_Type) {
+        Py_DECREF(descr);
+        PyErr_Format(PyExc_TypeError, "%s.%s is not a slot descriptor",
+                     tp->tp_name, name);
+        return -1;
+    }
+    Py_ssize_t off = ((PyMemberDescrObject *)descr)->d_member->offset;
+    Py_DECREF(descr);
+    return off;
+}
 
 /* Collect the member-descriptor offsets of every slot an instance of tp
  * carries — walking the whole MRO, not just tp's own __slots__, so a
@@ -155,13 +180,75 @@ register_task_type(PyObject *self, PyObject *arg)
         PyErr_SetString(PyExc_ValueError, "type lacks status/uid slots");
         return NULL;
     }
+    Py_ssize_t nn_off = member_offset(tp, "node_name");
+    Py_ssize_t j_off = member_offset(tp, "job");
+    Py_ssize_t p_off = member_offset(tp, "pod");
+    Py_ssize_t ns_off = member_offset(tp, "namespace");
+    Py_ssize_t nm_off = member_offset(tp, "name");
+    Py_ssize_t rr_off = member_offset(tp, "resreq");
+    Py_ssize_t k_off = member_offset(tp, "key_cache");
+    if (nn_off < 0 || j_off < 0 || p_off < 0 || ns_off < 0 || nm_off < 0 ||
+        rr_off < 0 || k_off < 0)
+        return NULL;
     memcpy(task_offsets, offsets, sizeof(offsets[0]) * count);
     n_task_slots = count;
     status_offset = st_off;
     uid_offset = u_off;
+    t_node_name_off = nn_off;
+    t_job_off = j_off;
+    t_pod_off = p_off;
+    t_namespace_off = ns_off;
+    t_name_off = nm_off;
+    t_resreq_off = rr_off;
+    t_key_off = k_off;
     Py_XDECREF((PyObject *)task_type);
     Py_INCREF(arg);
     task_type = tp;
+    Py_RETURN_NONE;
+}
+
+/* ---- TaskStatus members + allocated set (bind-echo pass) ---- */
+
+static PyObject *ts_running = NULL, *ts_releasing = NULL, *ts_bound = NULL,
+                *ts_pending = NULL, *ts_succeeded = NULL, *ts_failed = NULL,
+                *ts_unknown = NULL, *ts_allocated_set = NULL;
+
+/* register_task_status(TaskStatus, allocated_statuses): capture the enum
+ * members the C twin of job_info.get_task_status hands back, plus the
+ * allocated-status set. */
+static PyObject *
+register_task_status(PyObject *self, PyObject *args)
+{
+    PyObject *cls, *allocated;
+    if (!PyArg_ParseTuple(args, "OO", &cls, &allocated))
+        return NULL;
+    PyObject *run = PyObject_GetAttrString(cls, "Running");
+    PyObject *rel = PyObject_GetAttrString(cls, "Releasing");
+    PyObject *bnd = PyObject_GetAttrString(cls, "Bound");
+    PyObject *pen = PyObject_GetAttrString(cls, "Pending");
+    PyObject *suc = PyObject_GetAttrString(cls, "Succeeded");
+    PyObject *fai = PyObject_GetAttrString(cls, "Failed");
+    PyObject *unk = PyObject_GetAttrString(cls, "Unknown");
+    if (run == NULL || rel == NULL || bnd == NULL || pen == NULL ||
+        suc == NULL || fai == NULL || unk == NULL) {
+        Py_XDECREF(run); Py_XDECREF(rel); Py_XDECREF(bnd); Py_XDECREF(pen);
+        Py_XDECREF(suc); Py_XDECREF(fai); Py_XDECREF(unk);
+        return NULL;
+    }
+    PyObject *alloc_set = PySet_New(allocated);
+    if (alloc_set == NULL) {
+        Py_DECREF(run); Py_DECREF(rel); Py_DECREF(bnd); Py_DECREF(pen);
+        Py_DECREF(suc); Py_DECREF(fai); Py_DECREF(unk);
+        return NULL;
+    }
+    Py_XDECREF(ts_running);   ts_running = run;
+    Py_XDECREF(ts_releasing); ts_releasing = rel;
+    Py_XDECREF(ts_bound);     ts_bound = bnd;
+    Py_XDECREF(ts_pending);   ts_pending = pen;
+    Py_XDECREF(ts_succeeded); ts_succeeded = suc;
+    Py_XDECREF(ts_failed);    ts_failed = fai;
+    Py_XDECREF(ts_unknown);   ts_unknown = unk;
+    Py_XDECREF(ts_allocated_set); ts_allocated_set = alloc_set;
     Py_RETURN_NONE;
 }
 
@@ -287,6 +374,7 @@ static PyTypeObject *res_type = NULL;
 static Py_ssize_t res_offsets[MAX_SLOTS];
 static int n_res_slots = -1;
 static Py_ssize_t res_scalars_offset = -1;
+static Py_ssize_t res_cpu_offset = -1, res_mem_offset = -1;
 
 static PyObject *
 register_resource_type(PyObject *self, PyObject *arg)
@@ -307,9 +395,15 @@ register_resource_type(PyObject *self, PyObject *arg)
         PyErr_SetString(PyExc_ValueError, "type lacks a scalars slot");
         return NULL;
     }
+    Py_ssize_t cpu_off = member_offset(tp, "milli_cpu");
+    Py_ssize_t mem_off = member_offset(tp, "memory");
+    if (cpu_off < 0 || mem_off < 0)
+        return NULL;
     memcpy(res_offsets, offsets, sizeof(offsets[0]) * count);
     n_res_slots = count;
     res_scalars_offset = sc_off;
+    res_cpu_offset = cpu_off;
+    res_mem_offset = mem_off;
     Py_XDECREF((PyObject *)res_type);
     Py_INCREF(arg);
     res_type = tp;
@@ -477,6 +571,1625 @@ fail:
     return NULL;
 }
 
+/* ---- native bind-flush publish + echo (docs/design/bind_pipeline.md) ---- */
+
+static PyObject *s_modified, *s_uid, *s_deletion_timestamp, *s_phase,
+    *s_status, *s_task_status_index, *s_tasks, *s_queue, *s_status_version,
+    *ph_running, *ph_pending, *ph_succeeded, *ph_failed;
+
+/* publish_shard(objs, infl, kind, shard, news, rv_base)
+ *     -> (entries, pairs)
+ *
+ * The ordered-publish step of one bulk-patch shard in a single call
+ * (the Python twin is ObjectStore._install_shard_locked's loop): install
+ * news[i] under shard[i]'s key, release the key from the in-flight set,
+ * and build both the journal-entry batch [(rv, "MODIFIED", kind, new)]
+ * (contiguous reserved rvs from rv_base+1) and the watch-delivery pairs
+ * [(old, new)].  Caller holds the store lock; on any failure the caller
+ * falls back to the Python loop, which re-applies idempotently. */
+static PyObject *
+publish_shard(PyObject *self, PyObject *args)
+{
+    PyObject *objs, *infl, *kind, *shard, *news;
+    long long rv_base;
+    if (!PyArg_ParseTuple(args, "O!O!UO!O!L", &PyDict_Type, &objs,
+                          &PySet_Type, &infl, &kind, &PyList_Type, &shard,
+                          &PyList_Type, &news, &rv_base))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(shard);
+    if (PyList_GET_SIZE(news) != n) {
+        PyErr_SetString(PyExc_ValueError, "shard/news length mismatch");
+        return NULL;
+    }
+    PyObject *entries = PyList_New(n);
+    PyObject *pairs = PyList_New(n);
+    if (entries == NULL || pairs == NULL)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *item = PyList_GET_ITEM(shard, i);
+        if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) < 2) {
+            PyErr_SetString(PyExc_TypeError,
+                            "shard items must be (key, old, ...) tuples");
+            goto fail;
+        }
+        PyObject *key = PyTuple_GET_ITEM(item, 0);
+        PyObject *old = PyTuple_GET_ITEM(item, 1);
+        PyObject *new = PyList_GET_ITEM(news, i);
+        if (PyDict_SetItem(objs, key, new) < 0)
+            goto fail;
+        if (PySet_Discard(infl, key) < 0)
+            goto fail;
+        PyObject *rv = PyLong_FromLongLong(rv_base + 1 + (long long)i);
+        if (rv == NULL)
+            goto fail;
+        PyObject *entry = PyTuple_New(4);
+        if (entry == NULL) {
+            Py_DECREF(rv);
+            goto fail;
+        }
+        PyTuple_SET_ITEM(entry, 0, rv);            /* steals rv */
+        Py_INCREF(s_modified);
+        PyTuple_SET_ITEM(entry, 1, s_modified);
+        Py_INCREF(kind);
+        PyTuple_SET_ITEM(entry, 2, kind);
+        Py_INCREF(new);
+        PyTuple_SET_ITEM(entry, 3, new);
+        PyList_SET_ITEM(entries, i, entry);
+        PyObject *pair = PyTuple_New(2);
+        if (pair == NULL)
+            goto fail;
+        Py_INCREF(old);
+        PyTuple_SET_ITEM(pair, 0, old);
+        Py_INCREF(new);
+        PyTuple_SET_ITEM(pair, 1, new);
+        PyList_SET_ITEM(pairs, i, pair);
+    }
+    return Py_BuildValue("(NN)", entries, pairs);
+fail:
+    Py_XDECREF(entries);
+    Py_XDECREF(pairs);
+    return NULL;
+}
+
+/* borrowed __dict__ value of a plain-object attribute, or NULL (no
+ * exception): obj.__dict__[name] without the descriptor machinery */
+static inline PyObject *
+dict_attr(PyObject *o, PyObject *name)
+{
+    PyObject **dp = _PyObject_GetDictPtr(o);
+    if (dp == NULL || *dp == NULL)
+        return NULL;
+    return PyDict_GetItemWithError(*dp, name);   /* borrowed */
+}
+
+static inline int
+str_eq(PyObject *a, PyObject *b)
+{
+    if (a == b)
+        return 1;
+    if (a == NULL || b == NULL)
+        return 0;
+    if (PyUnicode_Check(a) && PyUnicode_Check(b))
+        return PyUnicode_Compare(a, b) == 0 && !PyErr_Occurred();
+    return PyObject_RichCompareBool(a, b, Py_EQ) == 1;
+}
+
+/* C twin of job_info.get_task_status: pod phase (+ node_name and
+ * deletion_timestamp) -> registered TaskStatus member (borrowed ref,
+ * NULL when the pod's shape is unexpected — caller falls back) */
+static PyObject *
+task_status_of(PyObject *pod_dict, PyObject *meta, PyObject *spec)
+{
+    PyObject *status = PyDict_GetItemWithError(pod_dict, s_status);
+    if (status == NULL)
+        return NULL;
+    PyObject *phase = dict_attr(status, s_phase);
+    if (phase == NULL)
+        return NULL;
+    if (str_eq(phase, ph_running)) {
+        PyObject *dt = dict_attr(meta, s_deletion_timestamp);
+        return (dt != NULL && dt != Py_None) ? ts_releasing : ts_running;
+    }
+    if (str_eq(phase, ph_pending)) {
+        PyObject *dt = dict_attr(meta, s_deletion_timestamp);
+        if (dt != NULL && dt != Py_None)
+            return ts_releasing;
+        PyObject *nn = dict_attr(spec, s_node_name);
+        int truthy = nn == NULL ? 0 : PyObject_IsTrue(nn);
+        if (truthy < 0)
+            return NULL;
+        return truthy ? ts_bound : ts_pending;
+    }
+    if (str_eq(phase, ph_succeeded))
+        return ts_succeeded;
+    if (str_eq(phase, ph_failed))
+        return ts_failed;
+    return ts_unknown;
+}
+
+/* slot write with refcount handling */
+static inline void
+slot_store(PyObject *o, Py_ssize_t off, PyObject *v)
+{
+    PyObject **p = (PyObject **)((char *)o + off);
+    PyObject *old = *p;
+    Py_XINCREF(v);
+    *p = v;
+    Py_XDECREF(old);
+}
+
+#define TASK_SLOT(t, off) (*(PyObject **)((char *)(t) + (off)))
+
+/* close one echo-apply run: append (keys, queue) to runs_out for the
+ * ledger (only when key collection is on) and release the keys list.
+ * run_keys is owned by the caller; consumed here. */
+static int
+echo_close_run(PyObject *run_job, PyObject **run_keys, PyObject *runs_out)
+{
+    PyObject *keys = *run_keys;
+    *run_keys = NULL;
+    if (keys == NULL)
+        return 0;
+    PyObject *queue = PyObject_GetAttr(run_job, s_queue);
+    if (queue == NULL) {
+        Py_DECREF(keys);
+        return -1;
+    }
+    PyObject *item = Py_BuildValue("(NN)", keys, queue);  /* steals both */
+    if (item == NULL)
+        return -1;
+    int rc = PyList_Append(runs_out, item);
+    Py_DECREF(item);
+    return rc;
+}
+
+/* one `job._status_version += 1` (per consecutive run, matching the
+ * Python path's one move_tasks_status_bulk call per run) */
+static int
+bump_status_version(PyObject *jd)
+{
+    PyObject *sv = PyDict_GetItemWithError(jd, s_status_version);
+    if (sv == NULL)
+        return PyErr_Occurred() ? -1 : 0;
+    if (!PyLong_Check(sv))
+        return 0;
+    PyObject *nv = PyLong_FromLongLong(PyLong_AsLongLong(sv) + 1);
+    if (nv == NULL)
+        return -1;
+    int rc = PyDict_SetItem(jd, s_status_version, nv);
+    Py_DECREF(nv);
+    return rc;
+}
+
+/* bind_echo_apply(pairs, exp, jobs, nodes, want_keys)
+ *     -> (runs, rest)
+ *
+ * The expected-bind-echo ingest of one bulk delivery in a single C pass
+ * (the Python twin is the hint branch of update_pods_bulk): for every
+ * (old, new) pair whose new.metadata.uid matches the hint map and whose
+ * guards hold (node_name == hinted host on both views, both statuses
+ * allocated), move the cached task's status index entry old->new, bump
+ * the job's status version once per consecutive (job, status) run,
+ * refresh the shared pod's resource_version, and sync the node-side
+ * stored view.  Both statuses being allocated (and neither Pending)
+ * means NO Resource accounting moves — exactly why the Python path used
+ * move_tasks_status_bulk, whose per-run bookkeeping this pass inlines.
+ *
+ * Returns (runs, rest): runs = [(keys, queue)] per run for ONE
+ * ledger.confirm_runs call (keys None-skipped when want_keys is false),
+ * rest = [(old, new)] pairs that missed a guard, for the Python
+ * fallback loop.  Caller holds the cache mutex. */
+static PyObject *
+bind_echo_apply(PyObject *self, PyObject *args)
+{
+    PyObject *pairs, *exp, *jobs, *nodes;
+    int want_keys;
+    if (!PyArg_ParseTuple(args, "O!O!O!O!p", &PyList_Type, &pairs,
+                          &PyDict_Type, &exp, &PyDict_Type, &jobs,
+                          &PyDict_Type, &nodes, &want_keys))
+        return NULL;
+    if (task_type == NULL || ts_allocated_set == NULL) {
+        PyErr_SetString(PyExc_RuntimeError,
+                        "task type/status members not registered");
+        return NULL;
+    }
+    PyObject *runs_out = PyList_New(0);
+    PyObject *rest = PyList_New(0);
+    PyObject *run_job = NULL;       /* borrowed */
+    PyObject *run_status = NULL;    /* borrowed */
+    PyObject *run_keys = NULL;      /* owned, alive while run open */
+    if (runs_out == NULL || rest == NULL)
+        goto fail;
+    /* cache the last node lookup: hosts repeat ~5x in a burst */
+    PyObject *last_host = NULL, *last_node_tasks = NULL; /* borrowed */
+    Py_ssize_t n = PyList_GET_SIZE(pairs);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *pair = PyList_GET_ITEM(pairs, i);
+        if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) {
+            PyErr_SetString(PyExc_TypeError, "pairs items must be 2-tuples");
+            goto fail;
+        }
+        PyObject *new = PyTuple_GET_ITEM(pair, 1);
+        PyObject **ndp = _PyObject_GetDictPtr(new);
+        PyObject *hint = NULL;
+        PyObject *meta = NULL, *spec = NULL;
+        if (ndp != NULL && *ndp != NULL) {
+            meta = PyDict_GetItemWithError(*ndp, s_metadata);
+            spec = PyDict_GetItemWithError(*ndp, s_spec);
+            if (meta != NULL && spec != NULL) {
+                PyObject *uid = dict_attr(meta, s_uid);
+                if (uid != NULL)
+                    hint = PyDict_GetItemWithError(exp, uid);
+            }
+        }
+        if (PyErr_Occurred())
+            goto fail;
+        PyObject *task = NULL, *host = NULL, *job = NULL;
+        PyObject *new_status = NULL, *old_status = NULL;
+        if (hint != NULL && PyTuple_Check(hint)
+                && PyTuple_GET_SIZE(hint) == 2) {
+            task = PyTuple_GET_ITEM(hint, 0);
+            host = PyTuple_GET_ITEM(hint, 1);
+        }
+        /* guards — any miss sends the pair to the Python fallback (the
+         * same chain the Python hint branch evaluates, in order) */
+        if (task != NULL && Py_TYPE(task) == task_type) {
+            PyObject *nn = dict_attr(spec, s_node_name);
+            old_status = TASK_SLOT(task, status_offset);
+            if (str_eq(nn, host)
+                    && str_eq(TASK_SLOT(task, t_node_name_off), host)
+                    && old_status != NULL
+                    && PySet_Contains(ts_allocated_set, old_status) == 1) {
+                new_status = task_status_of(*ndp, meta, spec);
+                if (new_status != NULL
+                        && PySet_Contains(ts_allocated_set,
+                                          new_status) == 1) {
+                    PyObject *jid = TASK_SLOT(task, t_job_off);
+                    if (jid != NULL)
+                        job = PyDict_GetItemWithError(jobs, jid);
+                }
+            }
+            if (PyErr_Occurred())
+                goto fail;
+        }
+        PyObject **jdp = job == NULL ? NULL : _PyObject_GetDictPtr(job);
+        if (jdp == NULL || *jdp == NULL) {
+            if (run_job != NULL) {
+                if (echo_close_run(run_job, &run_keys, runs_out) < 0)
+                    goto fail;
+                run_job = NULL;
+            }
+            if (PyList_Append(rest, pair) < 0)
+                goto fail;
+            continue;
+        }
+        PyObject *jd = *jdp;
+        if (job != run_job || new_status != run_status) {
+            if (run_job != NULL
+                    && echo_close_run(run_job, &run_keys, runs_out) < 0)
+                goto fail;
+            run_job = job;
+            run_status = new_status;
+            if (want_keys) {
+                run_keys = PyList_New(0);
+                if (run_keys == NULL)
+                    goto fail;
+            }
+            if (bump_status_version(jd) < 0)
+                goto fail;
+        }
+        /* status-index move old->new (the move_tasks_status_bulk body
+         * for the no-Resource-flip case: both statuses allocated) */
+        PyObject *uid = TASK_SLOT(task, uid_offset);
+        PyObject *tsi = PyDict_GetItemWithError(jd, s_task_status_index);
+        PyObject *jtasks = PyDict_GetItemWithError(jd, s_tasks);
+        if (uid == NULL || tsi == NULL || !PyDict_Check(tsi)
+                || jtasks == NULL || !PyDict_Check(jtasks)) {
+            if (PyErr_Occurred())
+                goto fail;
+            PyErr_SetString(PyExc_TypeError, "job lacks task index dicts");
+            goto fail;
+        }
+        PyObject *old_idx = PyDict_GetItemWithError(tsi, old_status);
+        if (old_idx != NULL && PyDict_Check(old_idx)) {
+            if (PyDict_DelItem(old_idx, uid) < 0)
+                PyErr_Clear();                     /* pop(uid, None) */
+            if (PyDict_GET_SIZE(old_idx) == 0 && old_status != new_status
+                    && PyDict_DelItem(tsi, old_status) < 0)
+                PyErr_Clear();
+        } else if (PyErr_Occurred())
+            goto fail;
+        PyObject *new_idx = PyDict_GetItemWithError(tsi, new_status);
+        if (new_idx == NULL) {
+            if (PyErr_Occurred())
+                goto fail;
+            new_idx = PyDict_New();       /* defaultdict(dict) materialize */
+            if (new_idx == NULL
+                    || PyDict_SetItem(tsi, new_status, new_idx) < 0) {
+                Py_XDECREF(new_idx);
+                goto fail;
+            }
+            Py_DECREF(new_idx);           /* tsi holds it; borrowed now */
+        }
+        slot_store(task, status_offset, new_status);
+        if (PyDict_SetItem(jtasks, uid, task) < 0
+                || PyDict_SetItem(new_idx, uid, task) < 0)
+            goto fail;
+        /* shared pod picks up the committed resource_version */
+        PyObject *rv = dict_attr(meta, s_resource_version);
+        PyObject *pod = TASK_SLOT(task, t_pod_off);
+        if (rv != NULL && pod != NULL) {
+            PyObject *pmeta = dict_attr(pod, s_metadata);
+            PyObject **pmd = pmeta == NULL ? NULL
+                : _PyObject_GetDictPtr(pmeta);
+            if (pmd != NULL && *pmd != NULL
+                    && PyDict_SetItem(*pmd, s_resource_version, rv) < 0)
+                goto fail;
+        }
+        /* "ns/name" key (precomputed TaskInfo slot): the node-side
+         * view lookup and the ledger both want it */
+        PyObject *key = TASK_SLOT(task, t_key_off);
+        if (key == NULL) {
+            PyErr_SetString(PyExc_TypeError, "task lacks key_cache");
+            goto fail;
+        }
+        Py_INCREF(key);
+        if (run_keys != NULL && PyList_Append(run_keys, key) < 0) {
+            Py_DECREF(key);
+            goto fail;
+        }
+        if (host != last_host) {
+            PyObject *node = PyDict_GetItemWithError(nodes, host);
+            if (node == NULL && PyErr_Occurred()) {
+                Py_DECREF(key);
+                goto fail;
+            }
+            last_node_tasks = node == NULL ? NULL
+                : dict_attr(node, s_tasks);
+            last_host = host;
+        }
+        if (last_node_tasks != NULL && PyDict_Check(last_node_tasks)) {
+            PyObject *stored = PyDict_GetItemWithError(last_node_tasks,
+                                                       key);
+            if (stored == NULL && PyErr_Occurred()) {
+                Py_DECREF(key);
+                goto fail;
+            }
+            if (stored != NULL && stored != task
+                    && Py_TYPE(stored) == task_type) {
+                slot_store(stored, status_offset, new_status);
+                PyObject *spod = TASK_SLOT(stored, t_pod_off);
+                if (spod != NULL && spod != pod && rv != NULL) {
+                    PyObject *smeta = dict_attr(spod, s_metadata);
+                    PyObject **smd = smeta == NULL ? NULL
+                        : _PyObject_GetDictPtr(smeta);
+                    if (smd != NULL && *smd != NULL
+                            && PyDict_SetItem(*smd, s_resource_version,
+                                              rv) < 0) {
+                        Py_DECREF(key);
+                        goto fail;
+                    }
+                }
+            }
+        }
+        Py_DECREF(key);
+    }
+    if (run_job != NULL
+            && echo_close_run(run_job, &run_keys, runs_out) < 0)
+        goto fail;
+    return Py_BuildValue("(NN)", runs_out, rest);
+fail:
+    Py_XDECREF(runs_out);
+    Py_XDECREF(rest);
+    Py_XDECREF(run_keys);
+    return NULL;
+}
+
+/* ---- native lifecycle-ledger completion (trace/ledger.confirm_runs:
+ * the 50k-per-flush bind-echo completion loop) ---- */
+
+static PyTypeObject *entry_type = NULL, *agg_type = NULL;
+static Py_ssize_t e_stamps_off = -1, e_detours_off = -1, e_trace_off = -1,
+    e_queue_off = -1;
+static Py_ssize_t a_count_off = -1, a_total_off = -1, a_samples_off = -1;
+static PyObject *hop_table = NULL;   /* ledger._HOP_NAME (list of lists) */
+static long commit_idx = -1, echo_idx = -1;
+static PyObject *s_append, *s_hop, *s_queue_label;
+
+/* register_ledger_types(_Entry, _Agg, hop_table, commit_idx, echo_idx) */
+static PyObject *
+register_ledger_types(PyObject *self, PyObject *args)
+{
+    PyObject *etp, *atp, *table;
+    long ci, ei;
+    if (!PyArg_ParseTuple(args, "OOO!ll", &etp, &atp, &PyList_Type,
+                          &table, &ci, &ei))
+        return NULL;
+    if (!PyType_Check(etp) || !PyType_Check(atp)) {
+        PyErr_SetString(PyExc_TypeError, "expected types");
+        return NULL;
+    }
+    Py_ssize_t so = member_offset((PyTypeObject *)etp, "stamps");
+    Py_ssize_t dto = member_offset((PyTypeObject *)etp, "detours");
+    Py_ssize_t tro = member_offset((PyTypeObject *)etp, "trace");
+    Py_ssize_t qo = member_offset((PyTypeObject *)etp, "queue");
+    Py_ssize_t co = member_offset((PyTypeObject *)atp, "count");
+    Py_ssize_t to = member_offset((PyTypeObject *)atp, "total");
+    Py_ssize_t smo = member_offset((PyTypeObject *)atp, "samples");
+    if (so < 0 || dto < 0 || tro < 0 || qo < 0 || co < 0 || to < 0
+            || smo < 0)
+        return NULL;
+    e_stamps_off = so; e_detours_off = dto; e_trace_off = tro;
+    e_queue_off = qo;
+    a_count_off = co; a_total_off = to; a_samples_off = smo;
+    Py_INCREF(etp);
+    Py_XDECREF((PyObject *)entry_type);
+    entry_type = (PyTypeObject *)etp;
+    Py_INCREF(atp);
+    Py_XDECREF((PyObject *)agg_type);
+    agg_type = (PyTypeObject *)atp;
+    Py_INCREF(table);
+    Py_XDECREF(hop_table);
+    hop_table = table;
+    commit_idx = ci;
+    echo_idx = ei;
+    Py_RETURN_NONE;
+}
+
+/* one aggregate sink: the _Agg plus its cached deque-append bound
+ * method and its staged-export list */
+typedef struct {
+    PyObject *agg;      /* borrowed (held by _hops/_queue_e2e) */
+    PyObject *append;   /* owned bound method */
+    PyObject *exports;  /* borrowed (held by _pending_exports) */
+} sink_t;
+
+/* agg.count += 1; agg.total += ms; agg.samples.append(ms);
+ * exports.append(ms) — the exact _Agg.add + export staging sequence */
+static int
+sink_add(sink_t *s, double ms)
+{
+    PyObject **cp = (PyObject **)((char *)s->agg + a_count_off);
+    PyObject *nv = PyLong_FromLongLong(PyLong_AsLongLong(*cp) + 1);
+    if (nv == NULL)
+        return -1;
+    Py_DECREF(*cp);
+    *cp = nv;
+    PyObject **tp = (PyObject **)((char *)s->agg + a_total_off);
+    nv = PyFloat_FromDouble(PyFloat_AS_DOUBLE(*tp) + ms);
+    if (nv == NULL)
+        return -1;
+    Py_DECREF(*tp);
+    *tp = nv;
+    PyObject *msv = PyFloat_FromDouble(ms);
+    if (msv == NULL)
+        return -1;
+    PyObject *r = PyObject_CallOneArg(s->append, msv);
+    if (r == NULL) {
+        Py_DECREF(msv);
+        return -1;
+    }
+    Py_DECREF(r);
+    int rc = 0;
+    if (s->exports != NULL)
+        rc = PyList_Append(s->exports, msv);
+    Py_DECREF(msv);
+    return rc;
+}
+
+/* resolve (or create) the _Agg in aggs[name] and its export list in
+ * pending[_export_keys[label_key]]; label_kind/label_value build the
+ * export key tuple (metric, ((label_kind, label_value),)). */
+static int
+sink_resolve(sink_t *s, PyObject *aggs, PyObject *name, PyObject *pending,
+             PyObject *ekeys, PyObject *metric, PyObject *label_kind,
+             PyObject *label_value, PyObject *ekey_probe)
+{
+    s->agg = NULL;
+    s->append = NULL;
+    s->exports = NULL;
+    PyObject *agg = PyDict_GetItemWithError(aggs, name);
+    if (agg == NULL) {
+        if (PyErr_Occurred())
+            return -1;
+        agg = PyObject_CallNoArgs((PyObject *)agg_type);
+        if (agg == NULL || PyDict_SetItem(aggs, name, agg) < 0) {
+            Py_XDECREF(agg);
+            return -1;
+        }
+        Py_DECREF(agg);   /* aggs holds it */
+    }
+    if (Py_TYPE(agg) != agg_type) {
+        PyErr_SetString(PyExc_TypeError, "unexpected aggregate type");
+        return -1;
+    }
+    s->agg = agg;
+    PyObject *samples = *(PyObject **)((char *)agg + a_samples_off);
+    if (samples == NULL) {
+        PyErr_SetString(PyExc_TypeError, "aggregate lacks samples");
+        return -1;
+    }
+    s->append = PyObject_GetAttr(samples, s_append);
+    if (s->append == NULL)
+        return -1;
+    if (pending == NULL)
+        return 0;   /* exports disabled (no metric plumbed) */
+    PyObject *ek = PyDict_GetItemWithError(ekeys, ekey_probe);
+    if (ek == NULL) {
+        if (PyErr_Occurred())
+            return -1;
+        PyObject *label = Py_BuildValue("((OO))", label_kind, label_value);
+        if (label == NULL)
+            return -1;
+        ek = Py_BuildValue("(ON)", metric, label);
+        if (ek == NULL || PyDict_SetItem(ekeys, ekey_probe, ek) < 0) {
+            Py_XDECREF(ek);
+            return -1;
+        }
+        Py_DECREF(ek);
+        ek = PyDict_GetItem(ekeys, ekey_probe);
+    }
+    PyObject *lst = PyDict_GetItemWithError(pending, ek);
+    if (lst == NULL) {
+        if (PyErr_Occurred())
+            return -1;
+        lst = PyList_New(0);
+        if (lst == NULL || PyDict_SetItem(pending, ek, lst) < 0) {
+            Py_XDECREF(lst);
+            return -1;
+        }
+        Py_DECREF(lst);
+        lst = PyDict_GetItem(pending, ek);
+    }
+    s->exports = lst;
+    return 0;
+}
+
+/* ledger_confirm_runs(entries, hops, queue_e2e, pending, ekeys, recent,
+ *                     hop_metric, e2e_metric, runs, commit_t, echo_t)
+ *     -> completed count
+ *
+ * The bind-echo completion loop of trace/ledger.confirm_runs in C:
+ * for every key still open, stamp store_committed @commit_t (unless
+ * already stamped) and echo_confirmed @echo_t, aggregate every hop +
+ * the e2e into the hop/queue aggregates, stage the prometheus exports
+ * and the recent-completions ring entry, and retire the entry. The
+ * caller holds the ledger lock; arithmetic is the exact per-pod
+ * sequence of the Python loop (fingerprints must not see which ran). */
+static PyObject *
+ledger_confirm_runs(PyObject *self, PyObject *args)
+{
+    PyObject *entries, *hops, *queue_e2e, *pending, *ekeys, *recent;
+    PyObject *hop_metric, *e2e_metric, *runs;
+    double commit_t, echo_t;
+    if (!PyArg_ParseTuple(args, "O!O!O!O!O!OOOO!dd",
+                          &PyDict_Type, &entries, &PyDict_Type, &hops,
+                          &PyDict_Type, &queue_e2e, &PyDict_Type, &pending,
+                          &PyDict_Type, &ekeys, &recent,
+                          &hop_metric, &e2e_metric, &PyList_Type, &runs,
+                          &commit_t, &echo_t))
+        return NULL;
+    if (entry_type == NULL || agg_type == NULL || hop_table == NULL) {
+        PyErr_SetString(PyExc_RuntimeError, "ledger types not registered");
+        return NULL;
+    }
+    long completed = 0;
+    PyObject *recent_append = PyObject_GetAttr(recent, s_append);
+    if (recent_append == NULL)
+        return NULL;
+    /* per-call sink caches: hop name -> sink, plus the e2e + queue
+     * sinks (queue constant per run) */
+    PyObject *sink_keys = PyList_New(0);   /* keeps append refs alive */
+    /* 7 stages -> at most 21 distinct hop names; 24 is unreachable */
+    sink_t hop_sinks[24];
+    PyObject *hop_names[24];
+    int n_hop_sinks = 0;
+    sink_t e2e_sink = {NULL, NULL, NULL};
+    PyObject *e2e_name = PyUnicode_InternFromString("e2e");
+    if (sink_keys == NULL || e2e_name == NULL)
+        goto fail;
+    if (sink_resolve(&e2e_sink, hops, e2e_name, NULL, NULL, NULL, NULL,
+                     NULL, NULL) < 0)
+        goto fail;
+    if (PyList_Append(sink_keys, e2e_sink.append) < 0) {
+        Py_DECREF(e2e_sink.append);
+        goto fail;
+    }
+    Py_DECREF(e2e_sink.append);   /* sink_keys holds it */
+    Py_ssize_t nr = PyList_GET_SIZE(runs);
+    for (Py_ssize_t r = 0; r < nr; r++) {
+        PyObject *run = PyList_GET_ITEM(runs, r);
+        if (!PyTuple_Check(run) || PyTuple_GET_SIZE(run) != 2) {
+            PyErr_SetString(PyExc_TypeError, "runs items must be 2-tuples");
+            goto fail;
+        }
+        PyObject *keys = PyTuple_GET_ITEM(run, 0);
+        PyObject *queue = PyTuple_GET_ITEM(run, 1);
+        if (!PyList_Check(keys)) {
+            PyErr_SetString(PyExc_TypeError, "run keys must be a list");
+            goto fail;
+        }
+        PyObject *q = (queue == Py_None || queue == NULL)
+            ? PyUnicode_InternFromString("") : (Py_INCREF(queue), queue);
+        if (q == NULL)
+            goto fail;
+        sink_t q_sink;
+        PyObject *probe = Py_BuildValue("(sO)", "q", q);
+        if (probe == NULL) {
+            Py_DECREF(q);
+            goto fail;
+        }
+        int rc = sink_resolve(&q_sink, queue_e2e, q, pending, ekeys,
+                              e2e_metric, s_queue_label, q, probe);
+        Py_DECREF(probe);
+        if (rc < 0) {
+            Py_DECREF(q);
+            goto fail;
+        }
+        if (PyList_Append(sink_keys, q_sink.append) < 0) {
+            Py_DECREF(q);
+            Py_DECREF(q_sink.append);
+            goto fail;
+        }
+        Py_DECREF(q_sink.append);   /* sink_keys holds it */
+        Py_ssize_t nk = PyList_GET_SIZE(keys);
+        for (Py_ssize_t ki = 0; ki < nk; ki++) {
+            PyObject *key = PyList_GET_ITEM(keys, ki);
+            PyObject *e = PyDict_GetItemWithError(entries, key);
+            if (e == NULL) {
+                if (PyErr_Occurred()) {
+                    Py_DECREF(q);
+                    goto fail;
+                }
+                continue;
+            }
+            if (Py_TYPE(e) != entry_type) {
+                Py_DECREF(q);
+                PyErr_SetString(PyExc_TypeError, "unexpected entry type");
+                goto fail;
+            }
+            PyObject *stamps = *(PyObject **)((char *)e + e_stamps_off);
+            if (stamps == NULL || !PyList_Check(stamps)) {
+                Py_DECREF(q);
+                PyErr_SetString(PyExc_TypeError, "entry lacks stamps");
+                goto fail;
+            }
+            Py_ssize_t ns = PyList_GET_SIZE(stamps);
+            long last_i = -1;
+            double last_t = 0.0;
+            if (ns > 0) {
+                PyObject *last = PyList_GET_ITEM(stamps, ns - 1);
+                last_i = PyLong_AsLong(PyTuple_GET_ITEM(last, 0));
+                last_t = PyFloat_AsDouble(PyTuple_GET_ITEM(last, 1));
+                if (PyErr_Occurred()) {
+                    Py_DECREF(q);
+                    goto fail;
+                }
+            }
+            if (last_i >= echo_idx)
+                continue;
+            if (queue != Py_None && queue != NULL)
+                slot_store(e, e_queue_off, queue);
+            /* the virtual commit/echo stamps (appended by the Python
+             * loop; computed in place here) */
+            double tc = 0.0;
+            int have_commit = 0;
+            if (last_i < commit_idx) {
+                tc = commit_t >= last_t ? commit_t : last_t;
+                have_commit = 1;
+            }
+            double base = have_commit ? tc : last_t;
+            double te = echo_t >= base ? echo_t : base;
+            double t0 = ns > 0
+                ? PyFloat_AsDouble(PyTuple_GET_ITEM(
+                      PyList_GET_ITEM(stamps, 0), 1))
+                : (have_commit ? tc : te);
+            if (PyErr_Occurred()) {
+                Py_DECREF(q);
+                goto fail;
+            }
+            double e2e_ms = (te - t0) * 1000.0;
+            PyObject *hop_list = PyList_New(0);
+            if (hop_list == NULL) {
+                Py_DECREF(q);
+                goto fail;
+            }
+            /* walk: existing stamp pairs, then ->commit, then ->echo */
+            long prev_i = -1;
+            double prev_t = 0.0;
+            int first = 1;
+            int ok = 1;
+            for (Py_ssize_t si = 0; ok && si <= ns + 1; si++) {
+                long i1;
+                double t1;
+                if (si < ns) {
+                    PyObject *st = PyList_GET_ITEM(stamps, si);
+                    i1 = PyLong_AsLong(PyTuple_GET_ITEM(st, 0));
+                    t1 = PyFloat_AsDouble(PyTuple_GET_ITEM(st, 1));
+                    if (PyErr_Occurred()) {
+                        ok = 0;
+                        break;
+                    }
+                } else if (si == ns) {
+                    if (!have_commit)
+                        continue;
+                    i1 = commit_idx;
+                    t1 = tc;
+                } else {
+                    i1 = echo_idx;
+                    t1 = te;
+                }
+                if (first) {
+                    first = 0;
+                    prev_i = i1;
+                    prev_t = t1;
+                    continue;
+                }
+                PyObject *hop = PyList_GET_ITEM(
+                    PyList_GET_ITEM(hop_table, prev_i), i1);
+                double ms = (t1 - prev_t) * 1000.0;
+                prev_i = i1;
+                prev_t = t1;
+                sink_t *hs = NULL;
+                for (int h = 0; h < n_hop_sinks; h++)
+                    if (hop_names[h] == hop) {
+                        hs = &hop_sinks[h];
+                        break;
+                    }
+                if (hs == NULL) {
+                    if (n_hop_sinks >= 24) {
+                        PyErr_SetString(PyExc_RuntimeError,
+                                        "too many hop kinds");
+                        ok = 0;
+                        break;
+                    }
+                    hs = &hop_sinks[n_hop_sinks];
+                    if (sink_resolve(hs, hops, hop, pending, ekeys,
+                                     hop_metric, s_hop, hop, hop) < 0) {
+                        ok = 0;
+                        break;
+                    }
+                    if (PyList_Append(sink_keys, hs->append) < 0) {
+                        Py_DECREF(hs->append);
+                        ok = 0;
+                        break;
+                    }
+                    Py_DECREF(hs->append);
+                    hop_names[n_hop_sinks++] = hop;
+                }
+                PyObject *pair = Py_BuildValue("(Od)", hop, ms);
+                if (pair == NULL || PyList_Append(hop_list, pair) < 0) {
+                    Py_XDECREF(pair);
+                    ok = 0;
+                    break;
+                }
+                Py_DECREF(pair);
+                if (sink_add(hs, ms) < 0) {
+                    ok = 0;
+                    break;
+                }
+            }
+            if (!ok) {
+                Py_DECREF(hop_list);
+                Py_DECREF(q);
+                goto fail;
+            }
+            if (sink_add(&e2e_sink, e2e_ms) < 0
+                    || sink_add(&q_sink, e2e_ms) < 0) {
+                Py_DECREF(hop_list);
+                Py_DECREF(q);
+                goto fail;
+            }
+            PyObject *trace = *(PyObject **)((char *)e + e_trace_off);
+            PyObject *detours = *(PyObject **)((char *)e + e_detours_off);
+            PyObject *rec = Py_BuildValue(
+                "(OOOdOO)", key, trace == NULL ? Py_None : trace, q,
+                e2e_ms, hop_list,
+                detours == NULL ? Py_None : detours);
+            Py_DECREF(hop_list);
+            if (rec == NULL) {
+                Py_DECREF(q);
+                goto fail;
+            }
+            PyObject *rr = PyObject_CallOneArg(recent_append, rec);
+            Py_DECREF(rec);
+            if (rr == NULL) {
+                Py_DECREF(q);
+                goto fail;
+            }
+            Py_DECREF(rr);
+            if (PyDict_DelItem(entries, key) < 0) {
+                Py_DECREF(q);
+                goto fail;
+            }
+            completed++;
+        }
+        Py_DECREF(q);
+    }
+    Py_DECREF(recent_append);
+    Py_DECREF(sink_keys);
+    Py_XDECREF(e2e_name);
+    return PyLong_FromLong(completed);
+fail:
+    Py_DECREF(recent_append);
+    Py_XDECREF(sink_keys);
+    Py_XDECREF(e2e_name);
+    return NULL;
+}
+
+/* ---- native bind APPLY (the _BindBurst status-move + node accounting
+ * pass of cache._apply_bind_bursts, docs/design/bind_pipeline.md) ---- */
+
+static PyObject *s_pairs, *s_accepted, *s_bound, *s_idle, *s_used,
+    *s_name, *s_node, *s_gpu_devices, *s_allocated, *s_pending_request,
+    *s_namespace_str;
+
+#define RES_DBL(r, off) PyFloat_AS_DOUBLE(*(PyObject **)((char *)(r) + (off)))
+#define RES_OBJ(r, off) (*(PyObject **)((char *)(r) + (off)))
+
+static inline int
+le_eps(double l, double r, double eps)
+{
+    return l < r || fabs(l - r) < eps;
+}
+
+/* accumulate src (a Resource.scalars dict) into *accp, creating the
+ * accumulator dict lazily — the C twin of Resource.add's scalar loop
+ * against a fresh Resource (same name insertion order, same float-add
+ * order) */
+static int
+acc_scalars(PyObject **accp, PyObject *src)
+{
+    if (src == NULL || !PyDict_Check(src) || PyDict_GET_SIZE(src) == 0)
+        return 0;
+    if (*accp == NULL) {
+        *accp = PyDict_New();
+        if (*accp == NULL)
+            return -1;
+    }
+    Py_ssize_t pos = 0;
+    PyObject *name, *val;
+    while (PyDict_Next(src, &pos, &name, &val)) {
+        if (!PyFloat_Check(val))
+            return -2;   /* unexpected shape: caller falls back */
+        PyObject *cur = PyDict_GetItemWithError(*accp, name);
+        if (cur == NULL && PyErr_Occurred())
+            return -1;
+        double d = (cur == NULL ? 0.0 : PyFloat_AS_DOUBLE(cur))
+            + PyFloat_AS_DOUBLE(val);
+        PyObject *nv = PyFloat_FromDouble(d);
+        if (nv == NULL || PyDict_SetItem(*accp, name, nv) < 0) {
+            Py_XDECREF(nv);
+            return -1;
+        }
+        Py_DECREF(nv);
+    }
+    return 0;
+}
+
+/* acc_scalars for the mutation phase, where validation already proved
+ * every scalars dict float-valued: any failure is a real error */
+static int
+acc_scalars_strict(PyObject **accp, PyObject *src)
+{
+    int rc = acc_scalars(accp, src);
+    if (rc == -2)
+        PyErr_SetString(PyExc_TypeError, "non-float resource scalar");
+    return rc ? -1 : 0;
+}
+
+/* total(acc) <= res within EPS under Zero defaults — the C twin of
+ * Resource.less_equal(res, ZERO) for an accumulated (tcpu, tmem, tsc)
+ * left side. 1 yes, 0 no, -1 error. */
+static int
+le_eps_resource(double tcpu, double tmem, PyObject *tsc, PyObject *res,
+                double eps)
+{
+    if (!le_eps(tcpu, RES_DBL(res, res_cpu_offset), eps)
+            || !le_eps(tmem, RES_DBL(res, res_mem_offset), eps))
+        return 0;
+    PyObject *rsc = RES_OBJ(res, res_scalars_offset);
+    int t_empty = tsc == NULL || PyDict_GET_SIZE(tsc) == 0;
+    int r_empty = rsc == NULL || !PyDict_Check(rsc)
+        || PyDict_GET_SIZE(rsc) == 0;
+    if (t_empty && r_empty)
+        return 1;
+    Py_ssize_t pos = 0;
+    PyObject *name, *val;
+    if (!t_empty) {
+        while (PyDict_Next(tsc, &pos, &name, &val)) {
+            double l = PyFloat_AS_DOUBLE(val);
+            PyObject *rv = r_empty ? NULL
+                : PyDict_GetItemWithError(rsc, name);
+            if (rv == NULL && PyErr_Occurred())
+                return -1;
+            double r = rv == NULL ? 0.0
+                : (PyFloat_Check(rv) ? PyFloat_AS_DOUBLE(rv) : -1.0);
+            if (rv != NULL && !PyFloat_Check(rv))
+                return -1;
+            if (isinf(r) && r > 0)
+                continue;
+            if ((isinf(l) && l > 0) || !le_eps(l, r, eps))
+                return 0;
+        }
+    }
+    if (!r_empty) {
+        pos = 0;
+        while (PyDict_Next(rsc, &pos, &name, &val)) {
+            if (!t_empty) {
+                PyObject *lv = PyDict_GetItemWithError(tsc, name);
+                if (lv == NULL && PyErr_Occurred())
+                    return -1;
+                if (lv != NULL)
+                    continue;   /* already compared above */
+            }
+            if (!PyFloat_Check(val))
+                return -1;
+            double r = PyFloat_AS_DOUBLE(val);
+            if (isinf(r) && r > 0)
+                continue;
+            if (!le_eps(0.0, r, eps))
+                return 0;
+        }
+    }
+    return 1;
+}
+
+/* res.milli_cpu/memory += (or -=) the accumulated deltas; scalars follow
+ * Resource.add's (always iterate rr) / sub_unchecked's (skip when self
+ * empty) semantics via the add_semantics flag */
+static int
+apply_res_delta(PyObject *res, double dcpu, double dmem, PyObject *dsc,
+                int sign, int add_semantics)
+{
+    PyObject *nv = PyFloat_FromDouble(
+        RES_DBL(res, res_cpu_offset) + sign * dcpu);
+    if (nv == NULL)
+        return -1;
+    PyObject **slot = (PyObject **)((char *)res + res_cpu_offset);
+    Py_DECREF(*slot);
+    *slot = nv;
+    nv = PyFloat_FromDouble(RES_DBL(res, res_mem_offset) + sign * dmem);
+    if (nv == NULL)
+        return -1;
+    slot = (PyObject **)((char *)res + res_mem_offset);
+    Py_DECREF(*slot);
+    *slot = nv;
+    if (dsc == NULL || PyDict_GET_SIZE(dsc) == 0)
+        return 0;
+    PyObject *rsc = RES_OBJ(res, res_scalars_offset);
+    if (rsc == NULL || !PyDict_Check(rsc))
+        return -1;
+    if (!add_semantics && PyDict_GET_SIZE(rsc) == 0)
+        return 0;   /* sub_unchecked: `if not self.scalars: return` */
+    Py_ssize_t pos = 0;
+    PyObject *name, *val;
+    while (PyDict_Next(dsc, &pos, &name, &val)) {
+        PyObject *cur = PyDict_GetItemWithError(rsc, name);
+        if (cur == NULL && PyErr_Occurred())
+            return -1;
+        double d = (cur == NULL ? 0.0 : PyFloat_AS_DOUBLE(cur))
+            + sign * PyFloat_AS_DOUBLE(val);
+        nv = PyFloat_FromDouble(d);
+        if (nv == NULL || PyDict_SetItem(rsc, name, nv) < 0) {
+            Py_XDECREF(nv);
+            return -1;
+        }
+        Py_DECREF(nv);
+    }
+    return 0;
+}
+
+/* bind_apply_bursts(bursts, jobs, nodes, dirty_jobs, dirty_nodes,
+ *                   binding, eps) -> bool
+ *
+ * The coalesced cross-gang bind apply in one C pass: group every
+ * burst's (task_info, hostname) pairs by job, move the cached tasks to
+ * Binding (status-index move + allocated/pending_request flips, one
+ * status-version bump per job), then run ONE accounting pass per node
+ * (idle/used update + task clone install) and populate each burst's
+ * accepted/bound lists in (job-group, node-group) order — exactly the
+ * Python _apply_bind_bursts sequence.
+ *
+ * All-or-nothing: a full validation pass runs FIRST (missing job/task/
+ * node, node-name conflicts, duplicate keys, idle fit, GPU-sharing
+ * nodes, unexpected shapes) and returns False with NOTHING mutated —
+ * the caller then takes the Python path, which handles every irregular
+ * case with its per-task fallback semantics. */
+static PyObject *
+bind_apply_bursts(PyObject *self, PyObject *args)
+{
+    PyObject *bursts, *jobs, *nodes, *dirty_jobs, *dirty_nodes, *binding;
+    double eps;
+    if (!PyArg_ParseTuple(args, "O!O!O!O!O!Od", &PyList_Type, &bursts,
+                          &PyDict_Type, &jobs, &PyDict_Type, &nodes,
+                          &PySet_Type, &dirty_jobs, &PySet_Type,
+                          &dirty_nodes, &binding, &eps))
+        return NULL;
+    if (task_type == NULL || res_type == NULL || ts_allocated_set == NULL) {
+        PyErr_SetString(PyExc_RuntimeError, "types not registered");
+        return NULL;
+    }
+    PyObject *by_job = PyDict_New();    /* jid -> [(burst, ti, stored)] */
+    PyObject *by_node = PyDict_New();   /* host -> [(burst, ti, stored)] */
+    if (by_job == NULL || by_node == NULL)
+        goto err;
+
+    /* ---- grouping ---- */
+    Py_ssize_t nb = PyList_GET_SIZE(bursts);
+    for (Py_ssize_t b = 0; b < nb; b++) {
+        PyObject *burst = PyList_GET_ITEM(bursts, b);
+        PyObject *bpairs = PyObject_GetAttr(burst, s_pairs);
+        if (bpairs == NULL || !PyList_Check(bpairs)) {
+            Py_XDECREF(bpairs);
+            goto fallback;
+        }
+        Py_ssize_t np = PyList_GET_SIZE(bpairs);
+        for (Py_ssize_t i = 0; i < np; i++) {
+            PyObject *pr = PyList_GET_ITEM(bpairs, i);
+            if (!PyTuple_Check(pr) || PyTuple_GET_SIZE(pr) != 2) {
+                Py_DECREF(bpairs);
+                goto fallback;
+            }
+            PyObject *ti = PyTuple_GET_ITEM(pr, 0);
+            PyObject *host = PyTuple_GET_ITEM(pr, 1);
+            if (Py_TYPE(ti) != task_type) {
+                Py_DECREF(bpairs);
+                goto fallback;
+            }
+            PyObject *jid = TASK_SLOT(ti, t_job_off);
+            PyObject *lst = PyDict_GetItemWithError(by_job, jid);
+            if (lst == NULL) {
+                if (PyErr_Occurred()) {
+                    Py_DECREF(bpairs);
+                    goto err;
+                }
+                lst = PyList_New(0);
+                if (lst == NULL
+                        || PyDict_SetItem(by_job, jid, lst) < 0) {
+                    Py_XDECREF(lst);
+                    Py_DECREF(bpairs);
+                    goto err;
+                }
+                Py_DECREF(lst);
+            }
+            PyObject *item = PyTuple_Pack(3, burst, ti, host);
+            if (item == NULL || PyList_Append(lst, item) < 0) {
+                Py_XDECREF(item);
+                Py_DECREF(bpairs);
+                goto err;
+            }
+            Py_DECREF(item);
+        }
+        Py_DECREF(bpairs);
+    }
+
+    /* ---- validation: resolve stored tasks + nodes, build by_node ---- */
+    Py_ssize_t jpos = 0;
+    PyObject *jid, *items;
+    while (PyDict_Next(by_job, &jpos, &jid, &items)) {
+        PyObject *job = PyDict_GetItemWithError(jobs, jid);
+        if (job == NULL) {
+            if (PyErr_Occurred())
+                goto err;
+            goto fallback;
+        }
+        PyObject **jdp = _PyObject_GetDictPtr(job);
+        if (jdp == NULL || *jdp == NULL)
+            goto fallback;
+        PyObject *jtasks = PyDict_GetItemWithError(*jdp, s_tasks);
+        PyObject *alloc = PyDict_GetItemWithError(*jdp, s_allocated);
+        PyObject *pend = PyDict_GetItemWithError(*jdp, s_pending_request);
+        PyObject *vtsi = PyDict_GetItemWithError(*jdp, s_task_status_index);
+        if (jtasks == NULL || !PyDict_Check(jtasks) || alloc == NULL
+                || Py_TYPE(alloc) != res_type || pend == NULL
+                || Py_TYPE(pend) != res_type || vtsi == NULL
+                || !PyDict_Check(vtsi)) {
+            if (PyErr_Occurred())
+                goto err;
+            goto fallback;
+        }
+        double p_cpu = 0.0, p_mem = 0.0;
+        PyObject *p_sc = NULL;
+        int p_any = 0;
+        Py_ssize_t ni = PyList_GET_SIZE(items);
+        for (Py_ssize_t i = 0; i < ni; i++) {
+            PyObject *item = PyList_GET_ITEM(items, i);
+            PyObject *ti = PyTuple_GET_ITEM(item, 1);
+            PyObject *host = PyTuple_GET_ITEM(item, 2);
+            PyObject *stored = PyDict_GetItemWithError(
+                jtasks, TASK_SLOT(ti, uid_offset));
+            if (stored == NULL || Py_TYPE(stored) != task_type) {
+                Py_XDECREF(p_sc);
+                if (PyErr_Occurred())
+                    goto err;
+                goto fallback;
+            }
+            PyObject *node = PyDict_GetItemWithError(nodes, host);
+            if (node == NULL) {
+                Py_XDECREF(p_sc);
+                if (PyErr_Occurred())
+                    goto err;
+                goto fallback;
+            }
+            PyObject *resreq = TASK_SLOT(stored, t_resreq_off);
+            if (resreq == NULL || Py_TYPE(resreq) != res_type
+                    || !PyFloat_Check(RES_OBJ(resreq, res_cpu_offset))
+                    || !PyFloat_Check(RES_OBJ(resreq, res_mem_offset))) {
+                Py_XDECREF(p_sc);
+                goto fallback;
+            }
+            /* pending_request.sub() assert pre-check accumulation */
+            PyObject *old = TASK_SLOT(stored, status_offset);
+            if (old == ts_pending) {
+                p_any = 1;
+                p_cpu += RES_DBL(resreq, res_cpu_offset);
+                p_mem += RES_DBL(resreq, res_mem_offset);
+                int rc = acc_scalars(&p_sc,
+                                     RES_OBJ(resreq, res_scalars_offset));
+                if (rc == -1) {
+                    Py_XDECREF(p_sc);
+                    goto err;
+                }
+                if (rc == -2) {
+                    Py_XDECREF(p_sc);
+                    goto fallback;
+                }
+            }
+            /* stash (burst, ti, stored) under the node, and swap the
+             * by_job item for the resolved 4-tuple the mutation pass
+             * reads (index 3 = stored) */
+            PyObject *nlst = PyDict_GetItemWithError(by_node, host);
+            if (nlst == NULL) {
+                if (PyErr_Occurred()) {
+                    Py_XDECREF(p_sc);
+                    goto err;
+                }
+                nlst = PyList_New(0);
+                if (nlst == NULL
+                        || PyDict_SetItem(by_node, host, nlst) < 0) {
+                    Py_XDECREF(nlst);
+                    Py_XDECREF(p_sc);
+                    goto err;
+                }
+                Py_DECREF(nlst);
+            }
+            PyObject *nitem = PyTuple_Pack(
+                3, PyTuple_GET_ITEM(item, 0), ti, stored);
+            if (nitem == NULL || PyList_Append(nlst, nitem) < 0) {
+                Py_XDECREF(nitem);
+                Py_XDECREF(p_sc);
+                goto err;
+            }
+            Py_DECREF(nitem);
+            PyObject *ritem = PyTuple_Pack(
+                4, PyTuple_GET_ITEM(item, 0), ti,
+                PyTuple_GET_ITEM(item, 2), stored);
+            if (ritem == NULL
+                    || PyList_SetItem(items, i, ritem) < 0) {  /* steals */
+                Py_XDECREF(ritem);
+                Py_XDECREF(p_sc);
+                goto err;
+            }
+        }
+        if (p_any) {
+            int ok = le_eps_resource(p_cpu, p_mem, p_sc, pend, eps);
+            Py_XDECREF(p_sc);
+            if (ok < 0)
+                goto err;
+            if (!ok)
+                goto fallback;   /* sub() would assert */
+        } else
+            Py_XDECREF(p_sc);
+    }
+
+    /* ---- validation: per-node accounting preconditions ---- */
+    Py_ssize_t npos = 0;
+    PyObject *host, *nitems;
+    while (PyDict_Next(by_node, &npos, &host, &nitems)) {
+        PyObject *node = PyDict_GetItem(nodes, host);   /* resolved above */
+        PyObject **ndp = node == NULL ? NULL : _PyObject_GetDictPtr(node);
+        if (ndp == NULL || *ndp == NULL)
+            goto fallback;
+        PyObject *nd = *ndp;
+        PyObject *gpus = PyDict_GetItemWithError(nd, s_gpu_devices);
+        if (PyErr_Occurred())
+            goto err;
+        int truthy = gpus == NULL ? 0 : PyObject_IsTrue(gpus);
+        if (truthy != 0)
+            goto fallback;   /* GPU-sharing nodes keep the Python path */
+        PyObject *nname = PyDict_GetItemWithError(nd, s_name);
+        PyObject *ntasks = PyDict_GetItemWithError(nd, s_tasks);
+        PyObject *nodeobj = PyDict_GetItemWithError(nd, s_node);
+        PyObject *idle = PyDict_GetItemWithError(nd, s_idle);
+        PyObject *used = PyDict_GetItemWithError(nd, s_used);
+        if (PyErr_Occurred())
+            goto err;
+        if (nname == NULL || ntasks == NULL || !PyDict_Check(ntasks)
+                || idle == NULL || Py_TYPE(idle) != res_type
+                || used == NULL || Py_TYPE(used) != res_type)
+            goto fallback;
+        double t_cpu = 0.0, t_mem = 0.0;
+        PyObject *t_sc = NULL;
+        Py_ssize_t ni = PyList_GET_SIZE(nitems);
+        int bad = 0;
+        PyObject *seen = PySet_New(NULL);
+        if (seen == NULL)
+            goto err;
+        for (Py_ssize_t i = 0; i < ni && !bad; i++) {
+            PyObject *stored = PyTuple_GET_ITEM(
+                PyList_GET_ITEM(nitems, i), 2);
+            PyObject *tn = TASK_SLOT(stored, t_node_name_off);
+            int tn_t = tn == NULL ? 0 : PyObject_IsTrue(tn);
+            int nn_t = PyObject_IsTrue(nname);
+            if (tn_t < 0 || nn_t < 0) {
+                Py_DECREF(seen);
+                Py_XDECREF(t_sc);
+                goto err;
+            }
+            if (tn_t && nn_t && !str_eq(tn, nname)) {
+                bad = 1;   /* already on a different node */
+                break;
+            }
+            PyObject *key = TASK_SLOT(stored, t_key_off);
+            if (key == NULL) {
+                Py_DECREF(seen);
+                Py_XDECREF(t_sc);
+                PyErr_SetString(PyExc_TypeError, "task lacks key_cache");
+                goto err;
+            }
+            Py_INCREF(key);
+            int dup = PyDict_Contains(ntasks, key);
+            int dup2 = dup == 0 ? PySet_Contains(seen, key) : dup;
+            if (dup < 0 || dup2 < 0 || PySet_Add(seen, key) < 0) {
+                Py_DECREF(key);
+                Py_DECREF(seen);
+                Py_XDECREF(t_sc);
+                goto err;
+            }
+            Py_DECREF(key);
+            if (dup || dup2) {
+                bad = 1;
+                break;
+            }
+            PyObject *resreq = TASK_SLOT(stored, t_resreq_off);
+            t_cpu += RES_DBL(resreq, res_cpu_offset);
+            t_mem += RES_DBL(resreq, res_mem_offset);
+            int rc = acc_scalars(&t_sc,
+                                 RES_OBJ(resreq, res_scalars_offset));
+            if (rc == -1) {
+                Py_DECREF(seen);
+                Py_XDECREF(t_sc);
+                goto err;
+            }
+            if (rc == -2)
+                bad = 1;
+        }
+        Py_DECREF(seen);
+        if (!bad && nodeobj != NULL && nodeobj != Py_None) {
+            int fit = le_eps_resource(t_cpu, t_mem, t_sc, idle, eps);
+            if (fit < 0) {
+                Py_XDECREF(t_sc);
+                goto err;
+            }
+            if (!fit)
+                bad = 1;
+        }
+        Py_XDECREF(t_sc);
+        if (bad)
+            goto fallback;
+    }
+
+    /* ---- mutation: per-job status moves + flips ---- */
+    jpos = 0;
+    while (PyDict_Next(by_job, &jpos, &jid, &items)) {
+        if (PySet_Add(dirty_jobs, jid) < 0)
+            goto err;
+        PyObject *job = PyDict_GetItem(jobs, jid);
+        PyObject *jd = *_PyObject_GetDictPtr(job);
+        PyObject *jtasks = PyDict_GetItem(jd, s_tasks);
+        PyObject *alloc = PyDict_GetItem(jd, s_allocated);
+        PyObject *pend = PyDict_GetItem(jd, s_pending_request);
+        PyObject *tsi = PyDict_GetItem(jd, s_task_status_index);  /* validated */
+        if (bump_status_version(jd) < 0)
+            goto err;
+        /* new-status bucket up front, like move_tasks_status_bulk */
+        PyObject *new_idx = PyDict_GetItemWithError(tsi, binding);
+        if (new_idx == NULL) {
+            if (PyErr_Occurred())
+                goto err;
+            new_idx = PyDict_New();
+            if (new_idx == NULL
+                    || PyDict_SetItem(tsi, binding, new_idx) < 0) {
+                Py_XDECREF(new_idx);
+                goto err;
+            }
+            Py_DECREF(new_idx);
+            new_idx = PyDict_GetItem(tsi, binding);
+        }
+        double f_cpu = 0.0, f_mem = 0.0, p_cpu = 0.0, p_mem = 0.0;
+        PyObject *f_sc = NULL, *p_sc = NULL;
+        int f_any = 0, p_any = 0;
+        Py_ssize_t ni = PyList_GET_SIZE(items);
+        for (Py_ssize_t i = 0; i < ni; i++) {
+            PyObject *stored = PyTuple_GET_ITEM(
+                PyList_GET_ITEM(items, i), 3);   /* resolved 4-tuple */
+            PyObject *uid = TASK_SLOT(stored, uid_offset);
+            PyObject *old = TASK_SLOT(stored, status_offset);
+            PyObject *old_idx = PyDict_GetItemWithError(tsi, old);
+            if (old_idx != NULL && PyDict_Check(old_idx)) {
+                if (PyDict_DelItem(old_idx, uid) < 0)
+                    PyErr_Clear();
+                if (PyDict_GET_SIZE(old_idx) == 0 && old != binding
+                        && PyDict_DelItem(tsi, old) < 0)
+                    PyErr_Clear();
+            } else if (PyErr_Occurred())
+                goto err;
+            PyObject *resreq = TASK_SLOT(stored, t_resreq_off);
+            if (PySet_Contains(ts_allocated_set, old) != 1) {
+                f_any = 1;
+                f_cpu += RES_DBL(resreq, res_cpu_offset);
+                f_mem += RES_DBL(resreq, res_mem_offset);
+                if (acc_scalars_strict(
+                        &f_sc, RES_OBJ(resreq, res_scalars_offset)) < 0)
+                    goto err;
+            }
+            if (old == ts_pending) {
+                p_any = 1;
+                p_cpu += RES_DBL(resreq, res_cpu_offset);
+                p_mem += RES_DBL(resreq, res_mem_offset);
+                if (acc_scalars_strict(
+                        &p_sc, RES_OBJ(resreq, res_scalars_offset)) < 0)
+                    goto err;
+            }
+            slot_store(stored, status_offset, binding);
+            if (PyDict_SetItem(jtasks, uid, stored) < 0
+                    || PyDict_SetItem(new_idx, uid, stored) < 0)
+                goto err;
+        }
+        int rc = 0;
+        if (f_any)
+            rc |= apply_res_delta(alloc, f_cpu, f_mem, f_sc, +1, 1);
+        if (p_any && rc == 0)
+            rc |= apply_res_delta(pend, p_cpu, p_mem, p_sc, -1, 1);
+        Py_XDECREF(f_sc);
+        Py_XDECREF(p_sc);
+        if (rc != 0)
+            goto err;
+    }
+
+    /* ---- mutation: one accounting pass per node + burst results ---- */
+    npos = 0;
+    while (PyDict_Next(by_node, &npos, &host, &nitems)) {
+        if (PySet_Add(dirty_nodes, host) < 0)
+            goto err;
+        PyObject *node = PyDict_GetItem(nodes, host);
+        PyObject *nd = *_PyObject_GetDictPtr(node);
+        PyObject *nname = PyDict_GetItem(nd, s_name);
+        PyObject *ntasks = PyDict_GetItem(nd, s_tasks);
+        PyObject *nodeobj = PyDict_GetItem(nd, s_node);
+        PyObject *idle = PyDict_GetItem(nd, s_idle);
+        PyObject *used = PyDict_GetItem(nd, s_used);
+        Py_ssize_t ni = PyList_GET_SIZE(nitems);
+        if (nodeobj != NULL && nodeobj != Py_None) {
+            double t_cpu = 0.0, t_mem = 0.0;
+            PyObject *t_sc = NULL;
+            for (Py_ssize_t i = 0; i < ni; i++) {
+                PyObject *resreq = TASK_SLOT(PyTuple_GET_ITEM(
+                    PyList_GET_ITEM(nitems, i), 2), t_resreq_off);
+                t_cpu += RES_DBL(resreq, res_cpu_offset);
+                t_mem += RES_DBL(resreq, res_mem_offset);
+                if (acc_scalars_strict(
+                        &t_sc, RES_OBJ(resreq, res_scalars_offset)) < 0)
+                    goto err;
+            }
+            int rc = apply_res_delta(idle, t_cpu, t_mem, t_sc, -1, 0);
+            if (rc == 0)
+                rc = apply_res_delta(used, t_cpu, t_mem, t_sc, +1, 1);
+            Py_XDECREF(t_sc);
+            if (rc != 0)
+                goto err;
+        }
+        PyObject *last_burst = NULL, *accepted = NULL, *bound = NULL;
+        for (Py_ssize_t i = 0; i < ni; i++) {
+            PyObject *nitem = PyList_GET_ITEM(nitems, i);
+            PyObject *burst = PyTuple_GET_ITEM(nitem, 0);
+            PyObject *ti = PyTuple_GET_ITEM(nitem, 1);
+            PyObject *stored = PyTuple_GET_ITEM(nitem, 2);
+            PyObject *key = TASK_SLOT(stored, t_key_off);
+            if (key == NULL) {
+                PyErr_SetString(PyExc_TypeError, "task lacks key_cache");
+                goto err;
+            }
+            Py_INCREF(key);
+            PyObject *clone = clone_one(stored);
+            if (clone == NULL) {
+                Py_DECREF(key);
+                goto err;
+            }
+            slot_store(stored, t_node_name_off, nname);
+            slot_store(clone, t_node_name_off, nname);
+            int rc = PyDict_SetItem(ntasks, key, clone);
+            Py_DECREF(clone);
+            Py_DECREF(key);
+            if (rc < 0)
+                goto err;
+            if (burst != last_burst) {
+                Py_XDECREF(accepted);
+                Py_XDECREF(bound);
+                accepted = PyObject_GetAttr(burst, s_accepted);
+                bound = PyObject_GetAttr(burst, s_bound);
+                last_burst = burst;
+                if (accepted == NULL || bound == NULL) {
+                    Py_XDECREF(accepted);
+                    Py_XDECREF(bound);
+                    goto err;
+                }
+            }
+            PyObject *bt = PyTuple_Pack(3, stored,
+                                        TASK_SLOT(stored, t_pod_off), host);
+            if (bt == NULL || PyList_Append(accepted, ti) < 0
+                    || PyList_Append(bound, bt) < 0) {
+                Py_XDECREF(bt);
+                Py_XDECREF(accepted);
+                Py_XDECREF(bound);
+                goto err;
+            }
+            Py_DECREF(bt);
+        }
+        Py_XDECREF(accepted);
+        Py_XDECREF(bound);
+    }
+    Py_DECREF(by_job);
+    Py_DECREF(by_node);
+    Py_RETURN_TRUE;
+
+fallback:
+    Py_XDECREF(by_job);
+    Py_XDECREF(by_node);
+    Py_RETURN_FALSE;
+err:
+    Py_XDECREF(by_job);
+    Py_XDECREF(by_node);
+    return NULL;
+}
+
+/* attr_eq_filter_pairs(pairs, attr0, attr1, expected)
+ *     -> (delivery, flips)
+ *
+ * Watch-filter evaluation for one bulk delivery when the watcher
+ * declared its filter as an attribute equality
+ * (Watch.filter_attr — obj.<attr0>.<attr1> == expected): pass->pass
+ * pairs collect into the delivery list; filter FLIPS come back as
+ * ordered (is_add, obj) events — fail->pass yields (True, new),
+ * pass->fail (False, old) — in pair order, so the caller fires
+ * on_add/on_delete exactly as the per-pair Python loop would.
+ * fail->fail drops. Two Python filter calls per pod otherwise. */
+static PyObject *
+attr_eq_filter_pairs(PyObject *self, PyObject *args)
+{
+    PyObject *pairs, *attr0, *attr1, *expected;
+    if (!PyArg_ParseTuple(args, "O!UUO", &PyList_Type, &pairs,
+                          &attr0, &attr1, &expected))
+        return NULL;
+    PyObject *delivery = PyList_New(0);
+    PyObject *flips = PyList_New(0);
+    if (delivery == NULL || flips == NULL)
+        goto fail;
+    Py_ssize_t n = PyList_GET_SIZE(pairs);
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *pair = PyList_GET_ITEM(pairs, i);
+        if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) {
+            PyErr_SetString(PyExc_TypeError, "pairs items must be 2-tuples");
+            goto fail;
+        }
+        PyObject *old = PyTuple_GET_ITEM(pair, 0);
+        PyObject *new = PyTuple_GET_ITEM(pair, 1);
+        int flags[2];
+        PyObject *objs[2] = {old, new};
+        for (int k = 0; k < 2; k++) {
+            PyObject **dp = _PyObject_GetDictPtr(objs[k]);
+            PyObject *sub = (dp == NULL || *dp == NULL) ? NULL
+                : PyDict_GetItemWithError(*dp, attr0);
+            PyObject *val = sub == NULL ? NULL : dict_attr(sub, attr1);
+            if (PyErr_Occurred())
+                goto fail;
+            if (val == NULL || (!PyUnicode_Check(val)
+                                && val != Py_None)) {
+                /* unexpected shape: fall back to the Python filter */
+                PyErr_SetString(PyExc_TypeError, "unfilterable shape");
+                goto fail;
+            }
+            flags[k] = str_eq(val, expected);
+        }
+        if (flags[0] && flags[1]) {
+            if (PyList_Append(delivery, pair) < 0)
+                goto fail;
+        } else if (flags[0] != flags[1]) {
+            PyObject *ev = PyTuple_Pack(
+                2, flags[1] ? Py_True : Py_False, flags[1] ? new : old);
+            if (ev == NULL || PyList_Append(flips, ev) < 0) {
+                Py_XDECREF(ev);
+                goto fail;
+            }
+            Py_DECREF(ev);
+        }
+    }
+    return Py_BuildValue("(NN)", delivery, flips);
+fail:
+    Py_XDECREF(delivery);
+    Py_XDECREF(flips);
+    return NULL;
+}
+
+/* bind_request_items(items) -> (requests, keys)
+ *
+ * The binder-seam list plumbing of one flush in a single pass: items is
+ * [(pod, hostname)]; returns ([(name, namespace, hostname)] — the
+ * store.bind_pods request — and the parallel ["ns/name"] key list the
+ * binder's bind-channel recording wants). Two interpreted listcomps +
+ * 50k f-strings on the drain thread otherwise. */
+static PyObject *
+bind_request_items(PyObject *self, PyObject *args)
+{
+    PyObject *items;
+    int want_reqs = 1, want_keys = 1;
+    if (!PyArg_ParseTuple(args, "O!|pp", &PyList_Type, &items,
+                          &want_reqs, &want_keys))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(items);
+    PyObject *reqs = want_reqs ? PyList_New(n) : (Py_INCREF(Py_None),
+                                                  Py_None);
+    PyObject *keys = want_keys ? PyList_New(n) : (Py_INCREF(Py_None),
+                                                  Py_None);
+    if (reqs == NULL || keys == NULL)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *it = PyList_GET_ITEM(items, i);
+        if (!PyTuple_Check(it) || PyTuple_GET_SIZE(it) != 2) {
+            PyErr_SetString(PyExc_TypeError, "items must be (pod, host)");
+            goto fail;
+        }
+        PyObject *pod = PyTuple_GET_ITEM(it, 0);
+        PyObject *host = PyTuple_GET_ITEM(it, 1);
+        PyObject **pdp = _PyObject_GetDictPtr(pod);
+        PyObject *meta = (pdp == NULL || *pdp == NULL) ? NULL
+            : PyDict_GetItemWithError(*pdp, s_metadata);
+        PyObject *name = meta == NULL ? NULL : dict_attr(meta, s_name);
+        PyObject *ns = meta == NULL ? NULL
+            : dict_attr(meta, s_namespace_str);
+        if (name == NULL || ns == NULL) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_TypeError,
+                                "pod lacks metadata name/namespace");
+            goto fail;
+        }
+        if (want_reqs) {
+            PyObject *req = PyTuple_New(3);
+            if (req == NULL)
+                goto fail;
+            Py_INCREF(name);
+            PyTuple_SET_ITEM(req, 0, name);
+            Py_INCREF(ns);
+            PyTuple_SET_ITEM(req, 1, ns);
+            Py_INCREF(host);
+            PyTuple_SET_ITEM(req, 2, host);
+            PyList_SET_ITEM(reqs, i, req);
+        }
+        if (want_keys) {
+            PyObject *key = PyUnicode_FromFormat("%U/%U", ns, name);
+            if (key == NULL)
+                goto fail;
+            PyList_SET_ITEM(keys, i, key);
+        }
+    }
+    return Py_BuildValue("(NN)", reqs, keys);
+fail:
+    Py_XDECREF(reqs);
+    Py_XDECREF(keys);
+    return NULL;
+}
+
 static PyObject *
 shell_clone(PyObject *self, PyObject *src)
 {
@@ -518,6 +2231,26 @@ static PyMethodDef methods[] = {
      "New instance of type(obj) with a shallow __dict__ copy."},
     {"bind_clone_pods", bind_clone_pods, METH_VARARGS,
      "Batch bind clone: minimal pod shells with node_name + rv set."},
+    {"register_task_status", register_task_status, METH_VARARGS,
+     "Register TaskStatus members + the allocated-status set."},
+    {"register_ledger_types", register_ledger_types, METH_VARARGS,
+     "Register the ledger _Entry/_Agg types + hop-name table."},
+    {"ledger_confirm_runs", ledger_confirm_runs, METH_VARARGS,
+     "Bind-echo ledger completion for a whole delivery's runs."},
+    {"publish_shard", publish_shard, METH_VARARGS,
+     "Install one bulk-patch shard: objects, barrier release, journal "
+     "entries and delivery pairs in one pass."},
+    {"bind_echo_apply", bind_echo_apply, METH_VARARGS,
+     "Expected-bind-echo ingest of one bulk delivery: guards, status "
+     "index moves, rv refresh, node-view sync, ledger run grouping."},
+    {"attr_eq_filter_pairs", attr_eq_filter_pairs, METH_VARARGS,
+     "Bulk watch-filter classification for attribute-equality filters."},
+    {"bind_request_items", bind_request_items, METH_VARARGS,
+     "Binder-seam plumbing: [(pod, host)] -> ([(name, ns, host)], "
+     "[\"ns/name\"])."},
+    {"bind_apply_bursts", bind_apply_bursts, METH_VARARGS,
+     "Coalesced cross-gang bind apply: per-job status moves + one "
+     "accounting pass per node, all-or-nothing with Python fallback."},
     {NULL, NULL, 0, NULL}
 };
 
@@ -533,8 +2266,44 @@ PyInit_fastmodel(void)
     s_spec = PyUnicode_InternFromString("spec");
     s_node_name = PyUnicode_InternFromString("node_name");
     s_resource_version = PyUnicode_InternFromString("resource_version");
+    s_modified = PyUnicode_InternFromString("MODIFIED");
+    s_uid = PyUnicode_InternFromString("uid");
+    s_deletion_timestamp = PyUnicode_InternFromString("deletion_timestamp");
+    s_phase = PyUnicode_InternFromString("phase");
+    s_status = PyUnicode_InternFromString("status");
+    s_task_status_index = PyUnicode_InternFromString("task_status_index");
+    s_tasks = PyUnicode_InternFromString("tasks");
+    s_queue = PyUnicode_InternFromString("queue");
+    s_status_version = PyUnicode_InternFromString("_status_version");
+    ph_running = PyUnicode_InternFromString("Running");
+    ph_pending = PyUnicode_InternFromString("Pending");
+    ph_succeeded = PyUnicode_InternFromString("Succeeded");
+    ph_failed = PyUnicode_InternFromString("Failed");
+    s_pairs = PyUnicode_InternFromString("pairs");
+    s_accepted = PyUnicode_InternFromString("accepted");
+    s_bound = PyUnicode_InternFromString("bound");
+    s_idle = PyUnicode_InternFromString("idle");
+    s_used = PyUnicode_InternFromString("used");
+    s_name = PyUnicode_InternFromString("name");
+    s_node = PyUnicode_InternFromString("node");
+    s_gpu_devices = PyUnicode_InternFromString("gpu_devices");
+    s_allocated = PyUnicode_InternFromString("allocated");
+    s_pending_request = PyUnicode_InternFromString("pending_request");
+    s_namespace_str = PyUnicode_InternFromString("namespace");
+    s_append = PyUnicode_InternFromString("append");
+    s_hop = PyUnicode_InternFromString("hop");
+    s_queue_label = PyUnicode_InternFromString("queue");
     if (s_metadata == NULL || s_spec == NULL || s_node_name == NULL ||
-        s_resource_version == NULL)
+        s_resource_version == NULL || s_modified == NULL || s_uid == NULL ||
+        s_deletion_timestamp == NULL || s_phase == NULL || s_status == NULL ||
+        s_task_status_index == NULL || s_tasks == NULL || s_queue == NULL ||
+        s_status_version == NULL || ph_running == NULL ||
+        ph_pending == NULL || ph_succeeded == NULL || ph_failed == NULL ||
+        s_pairs == NULL || s_accepted == NULL || s_bound == NULL ||
+        s_idle == NULL || s_used == NULL || s_name == NULL ||
+        s_node == NULL || s_gpu_devices == NULL || s_allocated == NULL ||
+        s_pending_request == NULL || s_namespace_str == NULL ||
+        s_append == NULL || s_hop == NULL || s_queue_label == NULL)
         return NULL;
     return PyModule_Create(&moduledef);
 }

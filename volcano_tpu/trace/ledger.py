@@ -51,6 +51,13 @@ STAGES = ("submitted", "enqueued", "session_eligible", "kernel_placed",
 _STAGE_IDX = {s: i for i, s in enumerate(STAGES)}
 DETOURS = ("retry", "quarantined", "healed")
 
+# interned hop names, indexed [from_idx][to_idx] — a 50k-bind flush
+# completes 50k entries and building "a->b" strings per completion was
+# a measurable slice of the commit path (tools/flush_bench.py --profile)
+_HOP_NAME = [[f"{a}->{b}" for b in STAGES] for a in STAGES]
+_COMMIT_IDX = _STAGE_IDX["store_committed"]
+_ECHO_IDX = _STAGE_IDX["echo_confirmed"]
+
 # /debug/latency percentile window per hop (deterministic: the LAST N
 # completions, not a randomized reservoir)
 SAMPLE_WINDOW = 1024
@@ -70,9 +77,6 @@ class _Entry:
         self.trace: Optional[str] = None
         self.queue: Optional[str] = None
         self.job: Optional[str] = None
-
-    def has(self, idx: int) -> bool:
-        return any(i == idx for i, _ in self.stamps)
 
 
 class _Agg:
@@ -114,6 +118,10 @@ _entries: Dict[str, _Entry] = {}
 _hops: Dict[str, _Agg] = {}          # "submitted->enqueued", ..., "e2e"
 _queue_e2e: Dict[str, _Agg] = {}     # queue name -> e2e agg
 _detour_totals: Dict[str, int] = {}
+# completion ring: raw (key, trace, queue, e2e_ms, stamps, detours)
+# tuples, FORMATTED lazily by report() — only the surviving
+# RECENT_CAPACITY entries ever pay the dict/round work, not all 50k
+# completions of a flush
 _recent: deque = deque(maxlen=RECENT_CAPACITY)
 _completed = 0
 _dropped = 0
@@ -123,6 +131,45 @@ _dropped = 0
 # completed pod (a 50k-bind flush echo otherwise pays ~300k lock
 # acquisitions on the executor thread)
 _pending_exports: Dict[tuple, list] = {}
+# staged-export key tuples, interned per (metric, label) — rebuilt
+# per completion they were another per-pod allocation
+_export_keys: Dict[tuple, tuple] = {}
+_metrics_mod = None
+
+
+def _metrics():
+    """The metrics module, imported once (the per-completion
+    ``from ..metrics import metrics`` showed up in flush profiles)."""
+    global _metrics_mod
+    if _metrics_mod is None:
+        from ..metrics import metrics as m
+        _metrics_mod = m
+    return _metrics_mod
+
+
+# native completion switch — module attr so the native-vs-Python parity
+# tests can force either engine
+NATIVE_CONFIRM = True
+_native = None
+_native_tried = False
+
+
+def _ledger_native():
+    """The fastmodel C completion pass (None = Python loop). Registered
+    lazily with this module's _Entry/_Agg layouts and hop table."""
+    global _native, _native_tried
+    if not _native_tried:
+        _native_tried = True
+        try:
+            from ..native.build import fastmodel
+            fm = fastmodel()
+            if fm is not None and hasattr(fm, "ledger_confirm_runs"):
+                fm.register_ledger_types(_Entry, _Agg, _HOP_NAME,
+                                         _COMMIT_IDX, _ECHO_IDX)
+                _native = fm
+        except Exception:
+            _native = None
+    return _native
 
 
 # -- control ----------------------------------------------------------------
@@ -151,6 +198,7 @@ def reset() -> None:
         _detour_totals.clear()
         _recent.clear()
         _pending_exports.clear()
+        _export_keys.clear()
         _completed = 0
         _dropped = 0
 
@@ -165,7 +213,7 @@ def _drain_exports() -> None:
             return
         staged = dict(_pending_exports)
         _pending_exports.clear()
-    from ..metrics import metrics as m
+    m = _metrics()
     for (name, labels), values in staged.items():
         m.observe_bulk(name, values, **dict(labels))
 
@@ -190,16 +238,18 @@ def _stamp_locked(key: str, idx: int, now: float, queue, job, trace) -> None:
         e.job = job
     if trace is not None:
         e.trace = trace
-    if e.has(idx):
-        return
-    # monotonic chain: a stage earlier than one already stamped is a
-    # replay (restart relist, duplicate echo) — ignore it
-    if e.stamps and idx < e.stamps[-1][0]:
-        return
-    if e.stamps and now < e.stamps[-1][1]:
-        now = e.stamps[-1][1]   # clamp: hops are never negative
-    e.stamps.append((idx, now))
-    if idx == _STAGE_IDX["echo_confirmed"]:
+    stamps = e.stamps
+    if stamps:
+        last_i, last_t = stamps[-1]
+        # stamp indexes are strictly ascending, so "already stamped" and
+        # "earlier than the newest stage" (a replay — restart relist,
+        # duplicate echo) collapse to one compare
+        if idx <= last_i:
+            return
+        if now < last_t:
+            now = last_t   # clamp: hops are never negative
+    stamps.append((idx, now))
+    if idx == _ECHO_IDX:
         _complete_locked(key, e)
 
 
@@ -227,32 +277,205 @@ def stamp_bulk(keys, stage: str, now: float, trace: Optional[str] = None,
     _drain_exports()
 
 
-def confirm(key: str, now: float, queue: Optional[str] = None) -> None:
-    """Bind-echo ingest: stamp ``store_committed`` then
-    ``echo_confirmed`` in one lock pass. The in-process store delivers
-    echoes synchronously from the committing write, so for it the two
-    stamps coincide (a zero hop); a remote mirror's delayed echo leaves
-    the earlier write-time store_committed stamp in place (set-once) and
-    the hop measures the real propagation delay."""
+def stamp_runs(runs, stage: str, trace: Optional[str] = None) -> None:
+    """``stamp_bulk`` for several key batches with DIFFERENT timestamps
+    in one lock pass — ``runs = [(keys, t)]``. The coalesced bind drain
+    stamps every burst's ``bind_staged`` (each with its own foreground
+    staging instant) through ONE ledger call per flush instead of one
+    per gang."""
     if not _enabled:
         return
+    idx = _STAGE_IDX[stage]
+    complete = idx == _ECHO_IDX
     with _lock:
-        _stamp_locked(key, _STAGE_IDX["store_committed"], now, queue,
-                      None, None)
-        _stamp_locked(key, _STAGE_IDX["echo_confirmed"], now, queue,
-                      None, None)
+        for keys, t in runs:
+            for key in keys:
+                e = _entries.get(key)
+                if e is None:
+                    continue   # only "submitted" creates entries
+                if trace is not None:
+                    e.trace = trace
+                stamps = e.stamps
+                if stamps:
+                    last_i, last_t = stamps[-1]
+                    if idx <= last_i:
+                        continue
+                    stamps.append((idx, t if t >= last_t else last_t))
+                else:
+                    stamps.append((idx, t))
+                if complete:
+                    _complete_locked(key, e)
     _drain_exports()
 
 
-def confirm_bulk(items, now: float) -> None:
+def _confirm_one_locked(key: str, queue, commit_t: float,
+                        echo_t: float) -> None:
+    """Stamp ``store_committed`` @commit_t then ``echo_confirmed``
+    @echo_t on one entry — the flat form of two ``_stamp_locked`` calls,
+    specialized for the bind-echo hot path (one dict probe, no per-stage
+    re-validation)."""
+    e = _entries.get(key)
+    if e is None:
+        return   # completed/dropped already, or never submitted
+    if queue is not None:
+        e.queue = queue
+    stamps = e.stamps
+    last_i, last_t = stamps[-1] if stamps else (-1, 0.0)
+    if last_i >= _ECHO_IDX:
+        return
+    if last_i < _COMMIT_IDX:
+        t = commit_t if commit_t >= last_t else last_t
+        stamps.append((_COMMIT_IDX, t))
+        last_t = t
+    t = echo_t if echo_t >= last_t else last_t
+    stamps.append((_ECHO_IDX, t))
+    _complete_locked(key, e)
+
+
+def confirm(key: str, now: float, queue: Optional[str] = None,
+            commit_t: Optional[float] = None) -> None:
+    """Bind-echo ingest: stamp ``store_committed`` then
+    ``echo_confirmed`` in one lock pass. ``commit_t`` (default ``now``)
+    is the instant the owning shard PUBLISHED to the store, so the
+    ``store_committed->echo_confirmed`` hop measures the echo pipeline's
+    internal queue wait instead of folding into staged->committed. With
+    no commit_t the two stamps coincide (a zero hop); a remote mirror's
+    delayed echo leaves the earlier write-time store_committed stamp in
+    place (set-once) and the hop measures the real propagation delay."""
+    if not _enabled:
+        return
+    with _lock:
+        _confirm_one_locked(key, queue, commit_t if commit_t is not None
+                            else now, now)
+    _drain_exports()
+
+
+def confirm_bulk(items, now: float, commit_t: Optional[float] = None) -> None:
     """``confirm`` for a whole echo delivery: items = [(key, queue)]."""
     if not _enabled:
         return
-    ci, ei = _STAGE_IDX["store_committed"], _STAGE_IDX["echo_confirmed"]
+    ct = commit_t if commit_t is not None else now
     with _lock:
         for key, queue in items:
-            _stamp_locked(key, ci, now, queue, None, None)
-            _stamp_locked(key, ei, now, queue, None, None)
+            _confirm_one_locked(key, queue, ct, now)
+    _drain_exports()
+
+
+def confirm_runs(runs, now: float, commit_t: Optional[float] = None) -> None:
+    """``confirm`` for a whole echo delivery grouped into per-job runs —
+    ``runs = [(keys, queue)]``, ONE ledger call per delivery with one
+    queue lookup per run instead of one (key, queue) pair per pod (the
+    native echo pass hands its run segments straight here).
+
+    This is the commit path's hottest ledger loop (50k completions per
+    flush), so the per-run invariants — queue aggregate, e2e export
+    list, the per-hop aggregate/export resolution — are hoisted out of
+    the per-pod body, and the entry completion is inlined for the
+    common shape (no out-of-order stamps). Aggregation arithmetic is
+    IDENTICAL to :func:`_complete_locked` — fingerprints must not see
+    which loop ran."""
+    if not _enabled:
+        return
+    global _completed
+    ct = commit_t if commit_t is not None else now
+    m = _metrics()
+    fm = _ledger_native() if NATIVE_CONFIRM else None
+    if fm is not None:
+        try:
+            with _lock:
+                _completed += fm.ledger_confirm_runs(
+                    _entries, _hops, _queue_e2e, _pending_exports,
+                    _export_keys, _recent, m.POD_HOP_LATENCY,
+                    m.POD_E2E_LATENCY, runs, ct, float(now))
+        except Exception:
+            import logging
+            logging.getLogger(__name__).exception(
+                "native ledger completion failed; Python fallback")
+            # fall through: fully completed entries already left
+            # _entries and the loop below finishes the rest. The C pass
+            # can only fail on memory exhaustion (its hop-sink table
+            # exceeds the theoretical hop-name count), so a torn entry
+            # — aggregated but not retired — is an OOM-only artifact.
+        else:
+            _drain_exports()
+            return
+    with _lock:
+        hop_cache: dict = {}
+
+        def hop_sinks(hop):
+            agg = _hops.get(hop)
+            if agg is None:
+                agg = _hops[hop] = _Agg()
+            ek = _export_keys.get(hop)
+            if ek is None:
+                ek = _export_keys[hop] = (m.POD_HOP_LATENCY,
+                                          (("hop", hop),))
+            lst = _pending_exports.get(ek)
+            if lst is None:
+                lst = _pending_exports[ek] = []
+            sinks = hop_cache[hop] = (agg, agg.samples, lst)
+            return sinks
+
+        e2e_agg = _hops.get("e2e")
+        if e2e_agg is None:
+            e2e_agg = _hops["e2e"] = _Agg()
+        for keys, queue in runs:
+            q = queue or ""
+            qagg = _queue_e2e.get(q)
+            if qagg is None:
+                qagg = _queue_e2e[q] = _Agg()
+            ek = _export_keys.get(("q", q))
+            if ek is None:
+                ek = _export_keys[("q", q)] = (m.POD_E2E_LATENCY,
+                                               (("queue", q),))
+            q_exports = _pending_exports.get(ek)
+            if q_exports is None:
+                q_exports = _pending_exports[ek] = []
+            for key in keys:
+                e = _entries.get(key)
+                if e is None:
+                    continue
+                stamps = e.stamps
+                last_i, last_t = stamps[-1] if stamps else (-1, 0.0)
+                if last_i >= _ECHO_IDX:
+                    continue
+                if queue is not None:
+                    e.queue = queue
+                if last_i < _COMMIT_IDX:
+                    t = ct if ct >= last_t else last_t
+                    stamps.append((_COMMIT_IDX, t))
+                    last_t = t
+                stamps.append((_ECHO_IDX,
+                               now if now >= last_t else last_t))
+                # inline completion (the _complete_locked body with the
+                # per-run lookups above already resolved)
+                del _entries[key]
+                _completed += 1
+                e2e_ms = (stamps[-1][1] - stamps[0][1]) * 1000.0
+                hop_list: list = []
+                prev_i, prev_t = stamps[0]
+                for i1, t1 in stamps[1:]:
+                    hop = _HOP_NAME[prev_i][i1]
+                    ms = (t1 - prev_t) * 1000.0
+                    prev_i, prev_t = i1, t1
+                    hop_list.append((hop, ms))
+                    sinks = hop_cache.get(hop)
+                    if sinks is None:
+                        sinks = hop_sinks(hop)
+                    agg, samples, exports = sinks
+                    agg.count += 1
+                    agg.total += ms
+                    samples.append(ms)
+                    exports.append(ms)
+                e2e_agg.count += 1
+                e2e_agg.total += e2e_ms
+                e2e_agg.samples.append(e2e_ms)
+                qagg.count += 1
+                qagg.total += e2e_ms
+                qagg.samples.append(e2e_ms)
+                q_exports.append(e2e_ms)
+                _recent.append((key, e.trace, q, e2e_ms, hop_list,
+                                e.detours))
     _drain_exports()
 
 
@@ -308,17 +531,30 @@ def _complete_locked(key: str, e: _Entry) -> None:
     global _completed
     del _entries[key]
     _completed += 1
+    m = _metrics()
     stamps = e.stamps
     e2e_ms = (stamps[-1][1] - stamps[0][1]) * 1000.0
-    hop_ms: Dict[str, float] = {}
-    for (i0, t0), (i1, t1) in zip(stamps, stamps[1:]):
-        hop = f"{STAGES[i0]}->{STAGES[i1]}"
-        hop_ms[hop] = (t1 - t0) * 1000.0
-    for hop, ms in hop_ms.items():
+    hop_list: list = []   # stamp idxs are strictly ascending: no dup keys
+    prev_i, prev_t = stamps[0]
+    for i1, t1 in stamps[1:]:
+        hop = _HOP_NAME[prev_i][i1]
+        ms = (t1 - prev_t) * 1000.0
+        prev_i, prev_t = i1, t1
+        hop_list.append((hop, ms))
         agg = _hops.get(hop)
         if agg is None:
             agg = _hops[hop] = _Agg()
         agg.add(ms)
+        # prometheus export rides the completion (staged here under
+        # _lock with an interned key tuple, drained in bulk by the
+        # public entry point that triggered it)
+        ek = _export_keys.get(hop)
+        if ek is None:
+            ek = _export_keys[hop] = (m.POD_HOP_LATENCY, (("hop", hop),))
+        lst = _pending_exports.get(ek)
+        if lst is None:
+            lst = _pending_exports[ek] = []
+        lst.append(ms)
     agg = _hops.get("e2e")
     if agg is None:
         agg = _hops["e2e"] = _Agg()
@@ -328,18 +564,15 @@ def _complete_locked(key: str, e: _Entry) -> None:
     if qagg is None:
         qagg = _queue_e2e[q] = _Agg()
     qagg.add(e2e_ms)
-    _recent.append({"pod": key, "trace": e.trace, "queue": q,
-                    "e2e_ms": round(e2e_ms, 3),
-                    "hops": {h: round(ms, 3) for h, ms in hop_ms.items()},
-                    "detours": dict(e.detours) if e.detours else {}})
-    # prometheus export rides the completion (staged here under _lock,
-    # drained in bulk by the public entry point that triggered it)
-    from ..metrics import metrics as m
-    _pending_exports.setdefault(
-        (m.POD_E2E_LATENCY, (("queue", q),)), []).append(e2e_ms)
-    for hop, ms in hop_ms.items():
-        _pending_exports.setdefault(
-            (m.POD_HOP_LATENCY, (("hop", hop),)), []).append(ms)
+    _recent.append((key, e.trace, q, e2e_ms, hop_list,
+                    e.detours))   # formatted lazily by report()
+    ek = _export_keys.get(("q", q))
+    if ek is None:
+        ek = _export_keys[("q", q)] = (m.POD_E2E_LATENCY, (("queue", q),))
+    lst = _pending_exports.get(ek)
+    if lst is None:
+        lst = _pending_exports[ek] = []
+    lst.append(e2e_ms)
 
 
 # -- reading ----------------------------------------------------------------
@@ -388,7 +621,12 @@ def report() -> dict:
             "hops": {hop: agg.report() for hop, agg in sorted(_hops.items())},
             "per_queue_e2e": {q: agg.report()
                               for q, agg in sorted(_queue_e2e.items())},
-            "recent": list(_recent),
+            "recent": [
+                {"pod": key, "trace": trace, "queue": q,
+                 "e2e_ms": round(e2e_ms, 3),
+                 "hops": {h: round(ms, 3) for h, ms in hop_list},
+                 "detours": dict(detours) if detours else {}}
+                for key, trace, q, e2e_ms, hop_list, detours in _recent],
         }
 
 

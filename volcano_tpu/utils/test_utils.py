@@ -64,12 +64,23 @@ class FakeBinder:
             trace=getattr(self, "trace", None))
         if used_batch:
             gone = set(map(id, (pod for pod, _ in failed)))
-            for pod, hostname in items:
-                if id(pod) in gone:
-                    continue
-                key = f"{pod.metadata.namespace}/{pod.metadata.name}"
-                self.binds[key] = hostname
-                self.channel.append(key)
+            keys = None
+            if not gone:
+                # common case (everything bound): record through the
+                # native key builder — the per-pod f-string loop was a
+                # visible slice of the 50k-bind drain
+                from ..cache.interface import native_bind_request_items
+                _, keys = native_bind_request_items(items, False, True)
+            if keys is not None:
+                self.binds.update(zip(keys, (h for _, h in items)))
+                self.channel.extend(keys)
+            else:
+                for pod, hostname in items:
+                    if id(pod) in gone:
+                        continue
+                    key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+                    self.binds[key] = hostname
+                    self.channel.append(key)
         return failed
 
 
