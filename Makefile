@@ -4,7 +4,7 @@ PYTHON ?= python
 
 .PHONY: test unit-test e2e bench bench-all bench-check multichip-dryrun \
 	deploy deploy-up trace-smoke sim-smoke flush-bench chaos-smoke \
-	failover-smoke obs-smoke incr-smoke
+	failover-smoke obs-smoke incr-smoke multichip-smoke
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -106,17 +106,35 @@ obs-smoke: failover-smoke
 incr-smoke: obs-smoke
 	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli incr
 
-# bench regression gate: compare the fresh BENCH_r08.json row (written
-# by `make bench`) against the BENCH_r07 baseline with machine-
+# bench regression gate: compare the fresh BENCH_r09.json row (written
+# by `make bench`) against the BENCH_r08 baseline with machine-
 # calibration scaling (this box drifts up to ~2.3x across captures).
-# Exit 1 on a scaled regression, a row missing the r06 observability
-# fields, an incremental steady-state cycle missing/over its 20 ms
-# machine-adjusted budget, or a bind flush over the <=800 ms
-# r05-machine commit-path target (docs/design/bind_pipeline.md).
+# When the fresh row carries the 10x metric (500k x 50k, round 9) the
+# gate switches to the 10x mode: kernel budget task-linear off the
+# same-capture sharded anchor, incremental-steady budget off the
+# absolute 20 ms r05-machine target with a shape-linear ceiling,
+# sharded-tier proof + flush-residue lines required
+# (docs/design/sharded_kernel.md). Same-metric rows keep the full
+# r08-era key-for-key gate.
 bench-check:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_check.py
 
-# multi-chip sharding dryrun on the virtual CPU mesh
+# multi-chip sharded-default gate (docs/design/sharded_kernel.md),
+# after incr-smoke: the same seeded 200-tick churn (flaps, gang pod
+# losses, quiet tail) run on the 8-device sharded solver TWICE and on
+# the single-device solver once. Exit 1 unless every audited tick
+# stayed invariant-clean in all three runs, the sharded kernel provably
+# served the mesh runs' placements, the mesh runs' bind AND
+# lifecycle-ledger fingerprints are bit-identical with the
+# single-device run (the exactness contract under churn), and the
+# sharded double run reproduced itself bit-identically.
+multichip-smoke: incr-smoke
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	$(PYTHON) -m volcano_tpu.sim.cli mesh
+
+# multi-chip sharding dryrun on the virtual CPU mesh (the raw
+# shard_map program + full-pipeline one-shot; multichip-smoke is the
+# gated churn version)
 multichip-dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

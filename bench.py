@@ -1,6 +1,9 @@
 """Headline benchmark: the FULL scheduling cycle (runOnce: snapshot ->
-plugin opens -> encode -> placement kernel -> commit -> close) at 50k
-pending tasks x 10k nodes.
+plugin opens -> encode -> placement kernel -> commit -> close) at 500k
+pending tasks x 50k nodes — the 10x regime the sharded (multi-chip)
+placement kernel serves as the production default
+(docs/design/sharded_kernel.md). The previous 50k x 10k shape is the
+first fallback rung and stays the cross-round comparison anchor.
 
 The reference's cycle budget is 1 s (--schedule-period,
 cmd/scheduler/app/options/options.go:86) and covers runOnce
@@ -33,13 +36,30 @@ import time
 import traceback
 
 BASELINE_MS = 1000.0
-N_TASKS = 50_000
-N_NODES = 10_000
-SHAPES = [(50_000, 10_000), (20_000, 4_000), (5_000, 1_000), (1_000, 256)]
+N_TASKS = 500_000
+N_NODES = 50_000
+SHAPES = [(500_000, 50_000), (50_000, 10_000), (20_000, 4_000),
+          (5_000, 1_000), (1_000, 256)]
 WORKER_TIMEOUT_S = float(os.environ.get("VOLCANO_BENCH_WORKER_TIMEOUT", 420))
 # the full-cycle worker populates a 50k-pod store-backed cluster and runs
 # cold + 2 warm cycles with executor flushes — minutes, not seconds
 CYCLE_TIMEOUT_S = float(os.environ.get("VOLCANO_BENCH_CYCLE_TIMEOUT", 1500))
+# the 10x shape runs ONE cold + ONE measured env (populate alone is
+# ~4 min per env through the store) under a wider deadline, on a forced
+# multi-device mesh when the platform exposes only one device (the
+# production default needs >1 device visible to auto-select sharding).
+# The virtual mesh maps one device per physical core — shard_map on a
+# CPU backend is EMULATION (every "chip" timeslices the same cores), so
+# more virtual devices than cores only adds per-step sync overhead; the
+# 8-way mesh is covered by tier-1 and `make multichip-smoke`, and real
+# TPU/GPU deployments use their real chip count.
+CYCLE_TIMEOUT_10X_S = float(os.environ.get("VOLCANO_BENCH_CYCLE_TIMEOUT_10X",
+                                           7200))
+MESH_DEVICES_10X = int(os.environ.get("VOLCANO_BENCH_MESH_DEVICES", 0)) \
+    or max(2, min(8, os.cpu_count() or 2))
+# collective cadence: one candidate-table refresh per 64 placements
+# (scanned 16/64/128 on this box; 64 minimizes the virtual-mesh step tax)
+MESH_CHUNK_10X = int(os.environ.get("VOLCANO_BENCH_MESH_CHUNK", 64))
 
 
 def log(msg: str) -> None:
@@ -124,28 +144,50 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
     devs = jax.devices()
     log(f"cycle worker backend: {devs[0].platform} x{len(devs)}")
 
-    def kernel_total() -> float:
+    def hist_total(metric: str) -> float:
         with m._lock:
             return sum(h.total for (name, _), h in m._histograms.items()
-                       if name == m.SOLVER_KERNEL_LATENCY)
+                       if name == metric)
+
+    def kernel_total() -> float:
+        return hist_total(m.SOLVER_KERNEL_LATENCY)
 
     def flush_total() -> float:
         # the coalesced bind drain's own latency metric (apply + store
         # pass + echo ingest) — the BIND FLUSH, as distinct from the
         # whole flush_executors wait, which also drains the session's
         # PodGroup status writeback and the snapshot prebuild
-        with m._lock:
-            return sum(h.total for (name, _), h in m._histograms.items()
-                       if name == m.BIND_FLUSH_LATENCY)
+        return hist_total(m.BIND_FLUSH_LATENCY)
+
+    _TIERS = ("sharded", "pallas", "native", "chunked", "scan")
+
+    def kernel_runs() -> dict:
+        return {t: m.counter_total(m.SOLVER_KERNEL_RUNS, kernel=t)
+                for t in _TIERS}
+
+    # the 10x shape: one cold + one measured env (populate alone is
+    # minutes), mesh collective cadence widened for the sharded kernel
+    big = n_tasks >= 200_000
+    runs = 1 if big else 3   # min-of-3 on the smaller shapes: single
+    #                          wall numbers carry ±15-25% co-tenant noise
+    conf_text = CONF_FULL
+    if big and len(devs) > 1:
+        conf_text += f"""
+configurations:
+- name: solver
+  arguments:
+    mesh.chunk: "{MESH_CHUNK_10X}"
+"""
+    flush_to = 3600 if big else 900
 
     pop = dict(n_nodes=n_nodes, n_jobs=n_tasks // 8, gang=8)
     log(f"cold env: populating {n_tasks}x{n_nodes} through the store")
-    store, cache, binder, conf = _cycle_env(CONF_FULL)
+    store, cache, binder, conf = _cycle_env(conf_text)
     _populate(store, **pop)
     t0 = time.perf_counter()
     _run_cycle(cache, conf)
     log(f"cold cycle (incl compile): {time.perf_counter() - t0:.1f}s")
-    flush_timeout = not cache.flush_executors(timeout=900)
+    flush_timeout = not cache.flush_executors(timeout=flush_to)
     cache.stop()   # the executor thread pins the whole env alive; a bare
     #                del leaks every 50k-object env for the process
     #                lifetime and the leak's heap pressure is what the
@@ -154,24 +196,33 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
 
     best = None
     best_rec = None
-    runs = 3   # min-of-3: single wall numbers on this shared machine
-    #            carry ±15-25% co-tenant noise
     for i in range(runs):
-        s2, c2, b2, cf2 = _cycle_env(CONF_FULL)
+        s2, c2, b2, cf2 = _cycle_env(conf_text)
         _populate(s2, **pop)
         k0 = kernel_total()
         f0 = flush_total()
+        w0 = hist_total(m.STATUS_WRITEBACK_LATENCY)
+        p0 = hist_total(m.SNAPSHOT_PREBUILD_LATENCY)
+        kr0 = kernel_runs()
         ms = _run_cycle(c2, cf2)
         rec = tracer.last_record()
         kernel_ms = kernel_total() - k0
         t0 = time.perf_counter()
-        flushed = c2.flush_executors(timeout=900)
+        flushed = c2.flush_executors(timeout=flush_to)
         # flush_wall_ms: the whole post-cycle executor drain (bind flush
         # + status writeback + snapshot prebuild). bind_flush_ms: the
         # bind drain alone, from its own latency histogram — the number
         # the ROADMAP's <=800ms commit-path target is about
         flush_wall_ms = (time.perf_counter() - t0) * 1000.0
         flush_ms = flush_total() - f0
+        # the flush_wall residue, split into its own budget lines
+        # (docs/design/bind_pipeline.md): the session's PodGroup status
+        # writeback and the inter-cycle snapshot prebuild the drain also
+        # waits on
+        writeback_ms = hist_total(m.STATUS_WRITEBACK_LATENCY) - w0
+        prebuild_ms = hist_total(m.SNAPSHOT_PREBUILD_LATENCY) - p0
+        kr1 = kernel_runs()
+        tiers = {t: kr1[t] - kr0[t] for t in kr1 if kr1[t] > kr0[t]}
         if not flushed:
             # a truncated flush_ms would quietly flatter the number — a
             # timed-out flush must fail the bench, not shade it
@@ -205,23 +256,31 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
             if denom else 0.0
         c2.incremental = False
         log(f"warm {i + 1}/{runs}: cycle={ms:.1f} ms kernel={kernel_ms:.1f} "
-            f"ms flush={flush_ms:.1f} ms (wall {flush_wall_ms:.1f} ms) "
-            f"steady={steady:.1f} ms "
+            f"ms [{'/'.join(f'{t}:{int(n)}' for t, n in tiers.items())}] "
+            f"flush={flush_ms:.1f} ms (wall {flush_wall_ms:.1f} ms, "
+            f"writeback {writeback_ms:.1f} ms, prebuild {prebuild_ms:.1f} "
+            f"ms) steady={steady:.1f} ms "
             f"steady_incr={steady_incr:.1f} ms "
             f"(mode={snap_stats.get('mode')} quiet={snap_stats.get('quiet')} "
             f"dirty={dirty_fraction:.4f}) binds={len(b2.binds)}")
         if best is None or ms < best["cycle_ms"]:
             prev_flush = best["bind_flush_ms"] if best else flush_ms
             prev_wall = best["flush_wall_ms"] if best else flush_wall_ms
+            prev_wb = best["status_writeback_ms"] if best else writeback_ms
+            prev_pb = best["snapshot_prebuild_ms"] if best else prebuild_ms
             best = {"cycle_ms": ms, "kernel_ms": kernel_ms,
                     "bind_flush_ms": min(flush_ms, prev_flush),
                     "flush_wall_ms": min(flush_wall_ms, prev_wall),
+                    "status_writeback_ms": min(writeback_ms, prev_wb),
+                    "snapshot_prebuild_ms": min(prebuild_ms, prev_pb),
                     "steady_state_ms": steady,
                     "steady_state_incremental_ms": steady_incr,
                     "dirty_fraction": round(dirty_fraction, 5),
                     "incr_snapshot": snap_stats,
                     "binds": len(b2.binds),
-                    "platform": devs[0].platform}
+                    "solver_kernels": tiers,
+                    "platform": devs[0].platform,
+                    "devices": len(devs)}
             best_rec = rec
         else:
             # flush min-of-runs like every other noise-sensitive metric
@@ -229,9 +288,51 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
             best["bind_flush_ms"] = min(best["bind_flush_ms"], flush_ms)
             best["flush_wall_ms"] = min(best["flush_wall_ms"],
                                         flush_wall_ms)
+            best["status_writeback_ms"] = min(best["status_writeback_ms"],
+                                              writeback_ms)
+            best["snapshot_prebuild_ms"] = min(best["snapshot_prebuild_ms"],
+                                               prebuild_ms)
         c2.stop()   # see the cold-env note: a leaked executor thread
         #             keeps the env resident and run i+1 pays run i's heap
         del s2, c2, b2
+    if big and best is not None:
+        # sharded-kernel ANCHOR at the previous headline shape (same
+        # mesh, same chunk, same capture): the 10x kernel budget in
+        # tools/bench_check.py is task-linear off this number — the
+        # scan's step count is task-linear and the node axis is the
+        # sharded one, so 10x tasks => ~10x kernel wall on any box,
+        # without cross-tier (native-vs-sharded) or cross-box guesses
+        try:
+            import numpy as _np
+            from jax.sharding import Mesh as _Mesh
+
+            from volcano_tpu.ops.score import ScoreWeights as _SW
+            from volcano_tpu.ops.sharded import (make_sharded_gang_allocate
+                                                 as _mk, shard_synth as _ss)
+            from volcano_tpu.utils.synth import synth_arrays as _sa
+            log("measuring sharded-kernel anchor at 50000x10000")
+            # shard_synth's even NamedSharding split needs the padded
+            # node axis to divide the device count (synth's default pad
+            # is 10240, which 3/6/7-device boxes don't divide)
+            n_pad = -(-10_240 // len(devs)) * len(devs)
+            sa = _sa(50_000, 10_000, gang_size=8, seed=42, utilization=0.3,
+                     node_pad_to=n_pad)
+            mesh = _Mesh(_np.array(devs), ("nodes",))
+            fn = _mk(mesh, chunk=MESH_CHUNK_10X)
+            args = _ss(mesh, sa)
+            w = _SW.make(sa.group_req.shape[1], binpack=1.0)
+            out = fn(*args, w)
+            jax.block_until_ready(out[0])           # compile
+            t0 = time.perf_counter()
+            out = fn(*args, w)
+            jax.block_until_ready(out[0])
+            best["kernel_anchor_sharded_ms"] = round(
+                (time.perf_counter() - t0) * 1000.0, 2)
+            log(f"sharded anchor 50kx10k: "
+                f"{best['kernel_anchor_sharded_ms']:.1f} ms")
+            del args, out, sa
+        except Exception as e:   # the anchor must never fail the bench
+            log(f"sharded anchor measurement failed ({e!r})")
     if best_rec is not None:
         best["phases"] = tracer.flat_phases(best_rec)
         # where the flush time goes: the executor-side span tree of the
@@ -267,11 +368,15 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
                                 f"profile_cycle_{n_tasks}x{n_nodes}")
         try:
             os.makedirs(prof_dir, exist_ok=True)
-            s3, c3, b3, cf3 = _cycle_env(CONF_FULL)
+            # same conf as the measured cycles (the big shape's
+            # mesh.chunk tuning included) — a profile of a different
+            # kernel configuration would attribute time the measured
+            # run never spends
+            s3, c3, b3, cf3 = _cycle_env(conf_text)
             _populate(s3, **pop)
             with jax.profiler.trace(prof_dir):
                 _run_cycle(c3, cf3)
-            c3.flush_executors(timeout=900)
+            c3.flush_executors(timeout=flush_to)
             c3.stop()
             del s3, c3, b3
             best["profile_dir"] = prof_dir
@@ -290,7 +395,7 @@ def write_bench_row(row: dict) -> None:
     """Persist the headline row (BENCH_r08.json by default; override or
     disable with VOLCANO_BENCH_ROW_OUT) with a machine-calibration
     fingerprint so tools/bench_check.py can scale cross-box compares."""
-    out = os.environ.get("VOLCANO_BENCH_ROW_OUT", "BENCH_r08.json")
+    out = os.environ.get("VOLCANO_BENCH_ROW_OUT", "BENCH_r09.json")
     if not out:
         return
     try:
@@ -389,13 +494,26 @@ def try_cycle_worker(platform: str, n_tasks: int, n_nodes: int):
     env = dict(os.environ)
     if platform != "cpu":
         env.pop("JAX_PLATFORMS", None)
+    timeout_s = CYCLE_TIMEOUT_S
+    if n_tasks >= 200_000:
+        timeout_s = CYCLE_TIMEOUT_10X_S
+        if platform == "cpu":
+            # the sharded production default needs >1 device visible:
+            # a CPU-only box exposes the virtual host-device mesh (the
+            # same mesh tier-1 runs under; real deployments have real
+            # chips and skip this)
+            flags = env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    f"{MESH_DEVICES_10X}").strip()
     cmd = [sys.executable, os.path.abspath(__file__), "--cycle-worker",
            platform, str(n_tasks), str(n_nodes)]
     log(f"spawning cycle worker: platform={platform} "
-        f"shape={n_tasks}x{n_nodes} (timeout {CYCLE_TIMEOUT_S:.0f}s)")
+        f"shape={n_tasks}x{n_nodes} (timeout {timeout_s:.0f}s)")
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=CYCLE_TIMEOUT_S, env=env,
+                           timeout=timeout_s, env=env,
                            cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
         log("cycle worker timed out (killed)")
@@ -631,10 +749,16 @@ def main() -> None:
                 if platform == "tpu":
                     tpu_failures += 1
                 continue
-            full = (n_tasks, n_nodes) == (N_TASKS, N_NODES)
-            name = "schedule_cycle_latency_50k_tasks_x_10k_nodes" if full \
-                else (f"schedule_cycle_latency_{n_tasks}_tasks_x_"
-                      f"{n_nodes}_nodes_REDUCED")
+            if (n_tasks, n_nodes) == (N_TASKS, N_NODES):
+                name = "schedule_cycle_latency_500k_tasks_x_50k_nodes"
+            elif (n_tasks, n_nodes) == (50_000, 10_000):
+                # the previous headline shape keeps its canonical name:
+                # a 10x-incapable box still produces a row the r08-era
+                # gates can compare 1:1
+                name = "schedule_cycle_latency_50k_tasks_x_10k_nodes"
+            else:
+                name = (f"schedule_cycle_latency_{n_tasks}_tasks_x_"
+                        f"{n_nodes}_nodes_REDUCED")
             if res.get("flush_timeout"):
                 # label the timeout with the shape that actually ran —
                 # the ladder may have shrunk below the headline config
@@ -673,6 +797,19 @@ def main() -> None:
                     float(res.get("bind_flush_ms", 0.0)), 2),
                 "flush_wall_ms": round(
                     float(res.get("flush_wall_ms", 0.0)), 2),
+                # the flush_wall residue split (BENCH_r09 onward): the
+                # PodGroup status writeback and the inter-cycle snapshot
+                # prebuild get their own budget lines
+                "status_writeback_ms": round(
+                    float(res.get("status_writeback_ms", 0.0)), 2),
+                "snapshot_prebuild_ms": round(
+                    float(res.get("snapshot_prebuild_ms", 0.0)), 2),
+                # which kernel tier served the measured cycle — the
+                # sharded-default auto-selection proof (BENCH_r09)
+                "solver_kernels": res.get("solver_kernels"),
+                "devices": res.get("devices"),
+                "kernel_anchor_sharded_ms": res.get(
+                    "kernel_anchor_sharded_ms"),
                 "binds": res.get("binds"),
                 # per-phase attribution from the flight recorder
                 # (volcano_tpu/trace): '/'-joined span paths -> {ms, count}

@@ -110,6 +110,378 @@ configurations:
     assert len(sharded) == 48
 
 
+# -- topology-aware shard plan (docs/design/sharded_kernel.md) ---------------
+
+
+class TestShardPlan:
+    def test_equal_split_without_pressure(self):
+        from volcano_tpu.ops.sharded import build_shard_plan
+        plan = build_shard_plan(64, 4)
+        assert plan.bounds.tolist() == [0, 16, 32, 48, 64]
+        assert plan.rows_per_shard == 16
+        assert plan.n_layout == 64
+
+    def test_pressure_balanced_contiguous(self):
+        from volcano_tpu.ops.sharded import build_shard_plan
+        # the task pressure leans into the first quarter of the node
+        # order: the first shards must own NARROW ranges there and the
+        # later shards wide ranges of the idle tail
+        pressure = np.zeros(1024)
+        pressure[:256] = 3.0
+        plan = build_shard_plan(1024, 4, pressure=pressure)
+        widths = np.diff(plan.bounds)
+        assert widths.sum() == 1024
+        assert (widths > 0).all()
+        assert widths[0] < widths[-1]
+        # per-shard pressure balanced (the naive N/D split would load
+        # the first shard 2.3x the last)
+        per = plan.pressure_per_shard
+        assert max(per) <= 1.1 * min(per)
+
+    def test_max_skew_caps_layout_width(self):
+        from volcano_tpu.ops.sharded import build_shard_plan
+        # one hot node: without the cap one shard would own ~everything
+        pressure = np.zeros(1000)
+        pressure[0] = 1e9
+        plan = build_shard_plan(1000, 4, pressure=pressure, max_skew=2.0)
+        assert int(np.diff(plan.bounds).max()) <= 500
+        assert plan.n_layout <= 4 * 500
+
+    def test_gather_strictly_increasing_over_real_rows(self):
+        """The tie-break proof: layout order must preserve node order,
+        so min-layout-index ties equal min-node-index ties."""
+        from volcano_tpu.ops.sharded import build_shard_plan
+        rng = np.random.default_rng(5)
+        plan = build_shard_plan(777, 8, pressure=rng.random(777) * 9)
+        real = plan.gather[plan.gather >= 0]
+        assert (np.diff(real) > 0).all()
+        assert sorted(real.tolist()) == list(range(777))
+        # scatter is the exact inverse on real rows
+        for node, layout in enumerate(plan.layout_of_node):
+            assert plan.gather[layout] == node
+
+    def test_take_gathers_and_pads(self):
+        from volcano_tpu.ops.sharded import build_shard_plan
+        plan = build_shard_plan(10, 4)   # ranges of 3,3,3,1 -> Nl=3
+        a = np.arange(10, dtype=np.float32)
+        out = plan.take(a, axis=0, fill=-7.0)
+        assert out.shape[0] == plan.n_layout
+        assert (out[plan.gather < 0] == -7.0).all()
+        assert (out[plan.gather >= 0] ==
+                a[plan.gather[plan.gather >= 0]]).all()
+
+
+def test_sharded_plan_parity_with_skewed_pressure():
+    """A pressure-skewed (unequal-range) plan must still match the
+    single-device kernel bit-for-bit — the layout keeps node order, so
+    boundaries cannot move tie-breaks."""
+    from volcano_tpu.ops.sharded import build_shard_plan
+    n_dev = 4
+    devices = jax.devices()[:n_dev]
+    if len(devices) < n_dev:
+        pytest.skip("not enough virtual devices")
+    mesh = Mesh(np.array(devices), ("nodes",))
+    sa = synth_arrays(96, 32, gang_size=4, node_pad_to=32, seed=9,
+                      utilization=0.4, n_queues=2)
+    weights = ScoreWeights.make(sa.group_req.shape[1], binpack=1.0)
+    a_s, p_s, r_s, k_s, _ = _single(sa, weights)
+
+    pressure = np.zeros(32)
+    pressure[:8] = 50.0      # skew: narrow first shard, wide tail shards
+    plan = build_shard_plan(32, n_dev, pressure=pressure)
+    assert np.diff(plan.bounds).tolist() != [8, 8, 8, 8]
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    n = NamedSharding(mesh, P("nodes"))
+    nr = NamedSharding(mesh, P("nodes", None))
+    gn = NamedSharding(mesh, P(None, "nodes"))
+    rep = NamedSharding(mesh, P())
+    put = jax.device_put
+    fn = make_sharded_gang_allocate(mesh)
+    args = [
+        put(sa.task_group, rep), put(sa.task_job, rep),
+        put(sa.task_valid, rep), put(sa.group_req, rep),
+        put(plan.take(sa.group_mask, axis=1, fill=False), gn),
+        put(plan.take(sa.group_static_score, axis=1, fill=0.0), gn),
+        put(sa.task_bucket, rep), put(sa.group_pack_bonus, rep),
+        put(sa.job_min_available, rep), put(sa.job_ready_base, rep),
+        put(sa.job_task_start, rep), put(sa.job_n_tasks, rep),
+        put(sa.job_queue, rep), put(sa.pool_queue, rep),
+        put(sa.pool_ns, rep), put(sa.pool_job_start, rep),
+        put(sa.pool_njobs, rep), put(sa.ns_weight, rep),
+        put(sa.ns_alloc0, rep), put(sa.ns_total, rep),
+        put(sa.queue_deserved, rep), put(sa.queue_alloc0, rep),
+        put(plan.take(sa.node_idle, axis=0), nr),
+        put(plan.take(sa.node_future, axis=0), nr),
+        put(plan.take(sa.node_alloc, axis=0), nr),
+        put(plan.take(sa.node_ntasks, axis=0), n),
+        put(plan.take(sa.node_max_tasks, axis=0), n),
+        put(sa.eps, rep)]
+    a_m, p_m, r_m, k_m, _ = fn(*args, weights)
+    a_m = np.asarray(a_m)
+    mapped = np.where(a_m >= 0,
+                      plan.gather[np.clip(a_m, 0, plan.n_layout - 1)], -1)
+    np.testing.assert_array_equal(np.asarray(a_s), mapped)
+    np.testing.assert_array_equal(np.asarray(p_s), np.asarray(p_m))
+    np.testing.assert_array_equal(np.asarray(r_s), np.asarray(r_m))
+    np.testing.assert_array_equal(np.asarray(k_s), np.asarray(k_m))
+
+
+# -- production-default selection logic (docs/design/sharded_kernel.md) -----
+
+_BASE_CONF = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+def _conf_with_solver(**args):
+    lines = "\n".join(f"    {k}: \"{v}\"" for k, v in args.items())
+    return _BASE_CONF + f"""
+configurations:
+- name: solver
+  arguments:
+{lines}
+"""
+
+
+def _small_cluster(h, n_nodes=16, n_jobs=6, gang=4):
+    from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                              build_pod_group, build_queue)
+    h.add("queues", build_queue("default", weight=1))
+    for i in range(n_nodes):
+        h.add("nodes", build_node(f"node-{i}",
+                                  {"cpu": "16", "memory": "32Gi"}))
+    for j in range(n_jobs):
+        h.add("podgroups", build_pod_group(f"pg-{j}", "ns1", "default",
+                                           gang, phase="Inqueue"))
+        for t in range(gang):
+            h.add("pods", build_pod("ns1", f"p{j}-{t}", "", "Pending",
+                                    {"cpu": "2", "memory": "4Gi"},
+                                    f"pg-{j}"))
+    return h
+
+
+class TestMeshDefaultSelection:
+    """Device-count / node-floor autodetect: the sharded kernel is the
+    default whenever >1 device is visible AND the node axis clears
+    mesh.min_nodes; explicit kernel forces, sampling, and
+    mesh.enable:"false" all win over auto."""
+
+    def _run(self, conf):
+        from tests.harness import Harness
+        h = _small_cluster(Harness(conf))
+        h.run_actions("enqueue", "allocate")
+        solver = h.ssn.solver
+        h.close_session()
+        return h, solver
+
+    def test_auto_selects_mesh_above_floor(self):
+        from volcano_tpu.metrics import metrics as m
+        before = m.counter_total(m.SOLVER_KERNEL_RUNS, kernel="sharded")
+        h, solver = self._run(_conf_with_solver(**{"mesh.min_nodes": 8}))
+        assert solver.mesh is not None
+        assert solver.mesh.devices.size == len(jax.devices())
+        after = m.counter_total(m.SOLVER_KERNEL_RUNS, kernel="sharded")
+        assert after > before          # the sharded tier actually served
+        assert len(h.binds) == 24
+
+    def test_auto_respects_default_floor(self):
+        # 16 nodes < MESH_MIN_NODES: auto stays on single-device kernels
+        from volcano_tpu.framework.solver import MESH_MIN_NODES
+        assert MESH_MIN_NODES > 16
+        h, solver = self._run(_BASE_CONF)
+        assert solver.mesh is None
+        assert len(h.binds) == 24
+
+    def test_explicit_false_wins_over_auto(self):
+        h, solver = self._run(_conf_with_solver(
+            **{"mesh.enable": "false", "mesh.min_nodes": 0}))
+        assert solver.mesh is None
+
+    def test_explicit_kernel_wins_over_auto(self):
+        h, solver = self._run(_conf_with_solver(
+            **{"kernel": "chunked", "mesh.min_nodes": 0}))
+        assert solver.mesh is None
+
+    def test_sampling_wins_over_auto(self):
+        h, solver = self._run(_conf_with_solver(
+            **{"sampling.enable": "true", "sampling.minNodes": 4,
+               "mesh.min_nodes": 0}))
+        assert solver.mesh is None
+
+    def test_forced_mesh_beats_explicit_kernel(self):
+        # mesh.enable "true" keeps its historical force semantics
+        h, solver = self._run(_conf_with_solver(
+            **{"mesh.enable": "true", "kernel": "chunked"}))
+        assert solver.mesh is not None
+
+    def test_auto_parity_with_single_device(self):
+        h_mesh, _ = self._run(_conf_with_solver(**{"mesh.min_nodes": 8}))
+        h_single, _ = self._run(_conf_with_solver(
+            **{"mesh.enable": "false"}))
+        assert h_mesh.binds == h_single.binds
+
+
+class TestMeshBreakerFallback:
+    """A crashing sharded tier degrades to chunked/scan WITHIN the same
+    cycle (the cycle is never lost), opens the breaker over the sharded
+    tier, and recovers through the half-open probe."""
+
+    def test_mid_cycle_fallback_and_breaker(self, monkeypatch):
+        import volcano_tpu.framework.solver as solver_mod
+        from volcano_tpu.framework.solver import (breaker_state,
+                                                  reset_breaker)
+        from volcano_tpu.metrics import metrics as m
+        reset_breaker()
+
+        def boom(*a, **k):
+            raise RuntimeError("injected sharded-tier crash")
+
+        monkeypatch.setattr(solver_mod.BatchSolver, "_run_sharded", boom)
+        chunked0 = m.counter_total(m.SOLVER_KERNEL_RUNS, kernel="chunked")
+        scan0 = m.counter_total(m.SOLVER_KERNEL_RUNS, kernel="scan")
+        from tests.harness import Harness
+        h = _small_cluster(Harness(_conf_with_solver(
+            **{"mesh.enable": "true", "mesh.min_nodes": 0})))
+        h.run_actions("enqueue", "allocate")
+        assert h.ssn.solver.mesh is not None
+        h.close_session()
+        # the cycle survived on a single-device tier and still bound
+        assert len(h.binds) == 24
+        fell_to = (m.counter_total(m.SOLVER_KERNEL_RUNS, kernel="chunked")
+                   - chunked0) + \
+            (m.counter_total(m.SOLVER_KERNEL_RUNS, kernel="scan") - scan0)
+        assert fell_to > 0
+        assert "sharded" in breaker_state()
+
+        # breaker open: the (restored) sharded tier is skipped until the
+        # half-open window, so the next cycle still runs single-device
+        monkeypatch.undo()
+        h2 = _small_cluster(Harness(_conf_with_solver(
+            **{"mesh.enable": "true", "mesh.min_nodes": 0})))
+        h2.run_actions("enqueue", "allocate")
+        h2.close_session()
+        assert len(h2.binds) == 24
+        assert h2.binds == h.binds     # tier fallback changed no decision
+        assert "sharded" in breaker_state()
+        reset_breaker()
+
+
+class TestMeshIncremental:
+    """The sharded path on the incremental steady-state cycle: the
+    topology plan rebalances ONLY on structural node changes, the
+    per-device resident buffers scatter dirty rows in between, and the
+    scoped working set changes no decision vs forced-full rebuilds."""
+
+    def _env(self, incremental=True):
+        from volcano_tpu.apiserver import ObjectStore
+        from volcano_tpu.cache import SchedulerCache
+        from volcano_tpu.scheduler import Scheduler
+        from volcano_tpu.utils.test_utils import (FakeBinder, FakeEvictor,
+                                                  build_node, build_queue)
+        conf = _conf_with_solver(**{"mesh.enable": "true",
+                                    "mesh.min_nodes": 0})
+        store = ObjectStore()
+        binder = FakeBinder(store)
+        cache = SchedulerCache(store, binder=binder,
+                               evictor=FakeEvictor(store))
+        sched = Scheduler(store, cache=cache, scheduler_conf=conf,
+                          incremental=incremental, anti_entropy_every=0)
+        store.create("queues", build_queue("default", weight=1))
+        for i in range(8):
+            store.create("nodes", build_node(
+                f"node-{i}", {"cpu": "16", "memory": "32Gi"}))
+        cache.run()
+        return store, cache, binder, sched
+
+    @staticmethod
+    def _add_gang(store, name, size=3, cpu="2"):
+        from volcano_tpu.utils.test_utils import build_pod, build_pod_group
+        store.create("podgroups", build_pod_group(
+            name, "default", "default", size, phase="Inqueue"))
+        for t in range(size):
+            store.create("pods", build_pod(
+                "default", f"{name}-{t}", "", "Pending",
+                {"cpu": cpu, "memory": "4Gi"}, groupname=name))
+
+    @staticmethod
+    def _cycle(sched, cache):
+        sched.run_once()
+        cache.flush_executors(timeout=60)
+
+    def test_plan_rebalances_only_on_structural_change(self):
+        from volcano_tpu.utils.test_utils import build_node
+        store, cache, binder, sched = self._env()
+        self._add_gang(store, "g0")
+        self._cycle(sched, cache)
+        self._cycle(sched, cache)          # settle: persistent narr live
+        state = cache._incr_solver_state
+        assert state.plan is not None
+        plan1 = state.plan
+        dev1 = state.shard_dev
+        assert dev1 is not None
+
+        # non-structural churn (a new gang binds, nodes go dirty): the
+        # plan AND the resident buffers must survive
+        self._add_gang(store, "g1")
+        self._cycle(sched, cache)
+        assert state.plan is plan1
+        assert state.shard_dev is dev1
+
+        # structural change (node added): the next PLACING cycle must
+        # rebuild the persistent arrays wholesale and rebalance the plan
+        store.create("nodes", build_node("node-new",
+                                         {"cpu": "16", "memory": "32Gi"}))
+        self._add_gang(store, "g2")
+        self._cycle(sched, cache)
+        self._cycle(sched, cache)          # rebuilt persistent state
+        assert state.plan is not None
+        assert state.plan is not plan1
+        cache.stop()
+
+    def test_device_buffer_scatter_reuse_on_mesh(self):
+        from volcano_tpu.metrics import metrics as m
+        store, cache, binder, sched = self._env()
+        self._add_gang(store, "g0")
+        self._cycle(sched, cache)
+        self._cycle(sched, cache)
+        reuse0 = m.counter_total(m.SOLVER_DEVICE_BUFFER, event="reuse")
+        self._add_gang(store, "g1")        # dirty rows, same structure
+        self._cycle(sched, cache)
+        assert m.counter_total(m.SOLVER_DEVICE_BUFFER,
+                               event="reuse") > reuse0
+        cache.stop()
+
+    def test_scoped_working_set_parity(self):
+        """Incremental (scoped allocate working set, patched snapshot,
+        resident sharded buffers) vs forced-full on the mesh: the bind
+        stream must be identical through arrival + bind + quiet churn."""
+        def drive(incremental):
+            store, cache, binder, sched = self._env(incremental)
+            self._add_gang(store, "a", size=4)
+            self._cycle(sched, cache)
+            self._add_gang(store, "b", size=3)
+            self._cycle(sched, cache)
+            self._cycle(sched, cache)      # quiet
+            self._add_gang(store, "c", size=2, cpu="4")
+            self._cycle(sched, cache)
+            binds = dict(binder.binds)
+            cache.stop()
+            return binds
+
+        assert drive(True) == drive(False)
+
+
 @pytest.mark.parametrize("chunk", [1, 3, 16])
 @pytest.mark.parametrize("scenario", ["base", "buckets", "pipelined", "tight"])
 def test_chunked_sharded_exactness(chunk, scenario):

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 import zlib
 from collections import deque
 from typing import Dict, List, Optional
@@ -486,9 +487,12 @@ class SchedulerCache(EventHandlersMixin):
             # mutex against the hot path and be invalidated by that same
             # cycle's mutations anyway; the next end_cycle resubmits
             return
+        t0 = time.perf_counter()
         with self.mutex:
             self._drain_applies_locked()
             self._prebuilt = (self._state_version, self._snapshot_locked())
+        m.observe(m.SNAPSHOT_PREBUILD_LATENCY,
+                  (time.perf_counter() - t0) * 1000.0)
 
     def flush_executors(self, timeout: float = 30.0) -> bool:
         """Block until all submitted bind/evict writes have executed. In
@@ -1790,7 +1794,17 @@ class SchedulerCache(EventHandlersMixin):
         writeback (``[(job, update_pg)]``): events first, then ONE bulk
         PodGroup status push (StoreStatusUpdater.update_pod_groups) —
         the per-group get+update round trips dominated the post-burst
-        flush at 6k jobs."""
+        flush at 6k jobs. Runs on the executor, so its wall time is part
+        of the flush_wall residue — measured into its own budget line
+        (STATUS_WRITEBACK_LATENCY)."""
+        t0 = time.perf_counter()
+        try:
+            self._update_job_statuses(updates)
+        finally:
+            m.observe(m.STATUS_WRITEBACK_LATENCY,
+                      (time.perf_counter() - t0) * 1000.0)
+
+    def _update_job_statuses(self, updates) -> None:
         push = []
         for job, update_pg in updates:
             self.record_job_status_event(job)

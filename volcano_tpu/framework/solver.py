@@ -69,6 +69,44 @@ def reset_breaker() -> None:
     _breaker_open_until.clear()
 
 
+# -- multi-chip mesh (docs/design/sharded_kernel.md) --------------------------
+# The sharded kernel is the PRODUCTION DEFAULT whenever more than one
+# device is visible and the node axis is large enough to pay for the
+# per-chunk candidate all-gather; below the floor the single-device
+# kernels (native/chunked/scan, exhaustively proven faster at small N)
+# keep the cycle. `mesh.enable: "true"` forces the mesh regardless of
+# size, `"false"` disables it, `mesh.min_nodes` moves the floor.
+MESH_MIN_NODES = 4096
+
+# Mesh and jitted-kernel caches are module-level: BatchSolver instances
+# are per-session (one per cycle), and rebuilding the shard_map + jit
+# wrapper each cycle would recompile the kernel every time.
+_mesh_cache: Dict[tuple, object] = {}
+_sharded_fn_cache: Dict[tuple, Callable] = {}
+
+
+def _get_mesh(devices):
+    from jax.sharding import Mesh
+    key = tuple(d.id for d in devices)
+    mesh = _mesh_cache.get(key)
+    if mesh is None:
+        mesh = Mesh(np.array(devices), ("nodes",))
+        _mesh_cache[key] = mesh
+    return mesh
+
+
+def _get_sharded_fn(mesh, allow_pipeline: bool, ns_live: bool, chunk: int):
+    key = (tuple(d.id for d in mesh.devices.flat),
+           bool(allow_pipeline), bool(ns_live), int(chunk))
+    fn = _sharded_fn_cache.get(key)
+    if fn is None:
+        from ..ops.sharded import make_sharded_gang_allocate
+        fn = make_sharded_gang_allocate(mesh, allow_pipeline=allow_pipeline,
+                                        ns_live=ns_live, chunk=chunk)
+        _sharded_fn_cache[key] = fn
+    return fn
+
+
 # -- incremental node tensors (docs/design/incremental_cycle.md) -------------
 
 class _IncrNodeState:
@@ -82,7 +120,7 @@ class _IncrNodeState:
     rindex change — or a full snapshot rebuild — invalidates wholesale."""
 
     __slots__ = ("seq", "narr", "rindex", "names", "pending", "dev",
-                 "dev_dirty_rows")
+                 "dev_dirty_rows", "plan", "shard_dev", "shard_dirty_rows")
 
     def __init__(self):
         self.seq = -1
@@ -92,6 +130,20 @@ class _IncrNodeState:
         self.pending = set()       # node names needing host row re-encode
         self.dev = None            # {field: device array} or None
         self.dev_dirty_rows = set()  # row indices needing device scatter
+        # sharded (multi-chip) twin of the dense device buffers: the
+        # topology-aware ShardPlan and PER-DEVICE resident node tensors
+        # in layout order, scatter-updated so a dirty row's bytes travel
+        # only to the owning shard. The plan is rebuilt ONLY when the
+        # persistent host arrays rebuild (structural node change), so
+        # the buffers keep their dirty-row scatter path across cycles.
+        self.plan = None
+        self.shard_dev = None      # {field: sharded device array} or None
+        self.shard_dirty_rows = set()
+
+    def drop_sharded(self) -> None:
+        self.plan = None
+        self.shard_dev = None
+        self.shard_dirty_rows = set()
 
 
 def note_incremental_snapshot(cache, snap) -> None:
@@ -108,6 +160,7 @@ def note_incremental_snapshot(cache, snap) -> None:
         state.dev = None
         state.pending.clear()
         state.dev_dirty_rows.clear()
+        state.drop_sharded()
     else:
         state.pending |= snap.patched_nodes
 
@@ -186,14 +239,24 @@ class BatchSolver:
         self.bucket_fn: Optional[Callable] = None
         self.vectorized_plugins: set = set()
         self.enable_default_predicates = False
-        # node-axis sharding over a device mesh (SURVEY §7 step 6): enabled
-        # by the scheduler conf's `solver` configuration —
+        # node-axis sharding over a device mesh (SURVEY §7 step 6,
+        # docs/design/sharded_kernel.md): the PRODUCTION DEFAULT — with
+        # `mesh.enable: "auto"` (the default) the mesh is built whenever
+        # >1 device is visible, the node axis clears `mesh.min_nodes`,
+        # and no explicit single-device kernel was forced. Conf:
         #   configurations:
         #   - name: solver
-        #     arguments: {mesh.enable: "true", mesh.devices: 8}
+        #     arguments: {mesh.enable: "auto"|"true"|"false",
+        #                 mesh.devices: 8, mesh.chunk: 16,
+        #                 mesh.min_nodes: 4096}
         # The sharded kernel (ops/sharded.py) is exact vs the single-device
-        # scan; tests/test_sharded.py holds the parity proof.
+        # scan; tests/test_sharded.py holds the parity proof, and the tier
+        # ladder below degrades sharded -> chunked -> scan mid-cycle.
         self.mesh = None
+        self.mesh_chunk = 16
+        self.mesh_min_nodes = MESH_MIN_NODES
+        mesh_mode = "auto"
+        mesh_devices = 0
         # kernel selection (the production analogue of the reference's hot
         # path always running in-process, allocate.go:201-262):
         #   configurations:
@@ -236,18 +299,15 @@ class BatchSolver:
             if hasattr(solver_args, "get_int"):
                 self.breaker_window = solver_args.get_int(
                     "breaker.window", 20)
-            if getattr(solver_args, "get_bool",
-                       lambda *_: False)("mesh.enable", False):
-                import jax
-                from jax.sharding import Mesh
-                n_dev = solver_args.get_int("mesh.devices", 0) or \
-                    len(jax.devices())
-                devices = jax.devices()[:n_dev]
-                if len(devices) >= 2:
-                    self.mesh = Mesh(np.array(devices), ("nodes",))
+                mesh_devices = solver_args.get_int("mesh.devices", 0)
                 # collective cadence: one candidate all-gather per `chunk`
                 # placements (ops/sharded.py chunked kernel; exact)
                 self.mesh_chunk = solver_args.get_int("mesh.chunk", 16)
+                self.mesh_min_nodes = solver_args.get_int(
+                    "mesh.min_nodes", MESH_MIN_NODES)
+            if hasattr(solver_args, "get_str"):
+                mesh_mode = (solver_args.get_str("mesh.enable", "auto")
+                             or "auto").strip().lower()
             self.kernel = solver_args.get_str("kernel", "auto") \
                 if hasattr(solver_args, "get_str") else "auto"
             if hasattr(solver_args, "get_str") and \
@@ -260,8 +320,32 @@ class BatchSolver:
                     "sampling.percentage", 0.0)
                 self.sampling_min = solver_args.get_int(
                     "sampling.minNodes", 100)
-        self._sharded_fns: Dict[bool, Callable] = {}
+        if mesh_mode in ("true", "1", "yes", "on"):
+            self.mesh = self._build_mesh(mesh_devices)
+        elif mesh_mode not in ("false", "0", "no", "off"):
+            # auto (the production default): shard whenever >1 device is
+            # visible and the node axis clears the floor — but an
+            # explicitly forced single-device kernel (`kernel:` conf) or
+            # node sampling wins over auto-selection
+            if self.kernel == "auto" and not self.sampling \
+                    and len(ssn.node_list) >= self.mesh_min_nodes:
+                self.mesh = self._build_mesh(mesh_devices)
         self._sampled_names: Optional[List[str]] = None
+
+    def _build_mesh(self, n_dev: int = 0):
+        """The cached device mesh, or None when <2 devices are visible
+        (or mesh construction fails — degrading to the dense kernels
+        must never cost the cycle)."""
+        try:
+            devices = jax.devices()
+            devices = devices[:n_dev] if n_dev else devices
+            if len(devices) < 2:
+                return None
+            return _get_mesh(devices)
+        except Exception as e:
+            _log_once(f"device mesh construction failed ({e!r}); "
+                      "falling back to single-device kernels")
+            return None
 
     def _node_order(self) -> List[str]:
         """The node-name order the contexts are built over: every ready
@@ -440,7 +524,12 @@ class BatchSolver:
             rows = state.narr.update_rows(ssn.nodes, state.pending)
             state.pending = set()
             state.dev_dirty_rows.update(rows)
+            state.shard_dirty_rows.update(rows)
             return state.narr
+        # STRUCTURAL rebuild: membership/order/rindex changed (or the
+        # dirty set outgrew the scatter path) — the persistent device
+        # buffers AND the shard plan are invalidated wholesale; this is
+        # the only point the topology-aware partition rebalances.
         narr = NodeArrays.build(ssn.nodes, order, self.rindex)
         state.narr = narr
         state.rindex = self.rindex
@@ -448,6 +537,7 @@ class BatchSolver:
         state.pending = set()
         state.dev = None
         state.dev_dirty_rows = set()
+        state.drop_sharded()
         return narr
 
     _DEV_NODE_FIELDS = ("idle", "future_idle", "allocatable", "n_tasks",
@@ -843,6 +933,7 @@ class BatchSolver:
                 m.set_gauge(m.SOLVER_BREAKER_OPEN, 0.0, kernel=tier)
                 _logger.warning(
                     "solver kernel %r recovered; breaker closed", tier)
+            m.inc(m.SOLVER_KERNEL_RUNS, kernel=tier)
             break
         m.observe(m.SOLVER_KERNEL_LATENCY,
                   (time.perf_counter() - t_kernel) * 1000.0)
@@ -939,42 +1030,111 @@ class BatchSolver:
                     self._record_fit_errors(job, task, narr, row_of[g])
         return result
 
+    def _shard_plan(self, narr: NodeArrays, n_devices: int):
+        """The topology-aware node partition for this place: reused from
+        the persistent solver state while the host arrays persist
+        (rebalance ONLY on structural node change — the per-device
+        buffers keep their dirty-row scatter path), rebuilt from the
+        snapshot's per-node resident-task pressure otherwise."""
+        from ..ops.sharded import build_shard_plan
+        state = self._incr_state()
+        if state is not None and state.narr is narr \
+                and state.plan is not None \
+                and state.plan.n_devices == n_devices \
+                and state.plan.n_rows == narr.idle.shape[0]:
+            return state.plan
+        plan = build_shard_plan(narr.idle.shape[0], n_devices,
+                                pressure=narr.n_tasks)
+        if state is not None and state.narr is narr:
+            state.plan = plan
+            state.shard_dev = None
+            state.shard_dirty_rows = set()
+        return plan
+
+    def _sharded_device_node_inputs(self, narr: NodeArrays, plan, mesh):
+        """Sharded twin of :meth:`_device_node_inputs`: the five node
+        tensors in LAYOUT order as per-device resident buffers. On a
+        steady-state cycle only the dirty rows are scattered — the
+        update is routed to the owning shard (the scatter indices land
+        inside one device's layout block per node). Returns
+        ({field: device array}, host->device bytes)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..metrics import metrics as m
+        n = NamedSharding(mesh, P("nodes"))
+        nr = NamedSharding(mesh, P("nodes", None))
+        sharding_of = {"idle": nr, "future_idle": nr, "allocatable": nr,
+                       "n_tasks": n, "max_tasks": n}
+
+        def full_host():
+            return {"idle": plan.take(narr.idle, 0),
+                    "future_idle": plan.take(narr.future_idle, 0),
+                    "allocatable": plan.take(narr.allocatable, 0),
+                    "n_tasks": plan.take(narr.n_tasks, 0),
+                    "max_tasks": plan.take(narr.max_tasks, 0)}
+
+        state = self._incr_state()
+        if state is None or state.narr is not narr \
+                or state.plan is not plan:
+            host = full_host()
+            return {f: jax.device_put(a, sharding_of[f])
+                    for f, a in host.items()}, \
+                sum(int(a.nbytes) for a in host.values())
+        if state.shard_dev is None:
+            host = full_host()
+            state.shard_dev = {f: jax.device_put(a, sharding_of[f])
+                               for f, a in host.items()}
+            state.shard_dirty_rows = set()
+            m.inc(m.SOLVER_DEVICE_BUFFER, event="rebuild")
+            return dict(state.shard_dev), \
+                sum(int(a.nbytes) for a in host.values())
+        xfer = 0
+        rows = sorted(r for r in state.shard_dirty_rows
+                      if r < plan.n_rows)
+        if rows:
+            lrows = plan.layout_of_node[rows]
+            idx = jnp.asarray(lrows.astype(np.int32))
+            host_rows = {
+                "idle": narr.idle[rows],
+                "future_idle": narr.idle[rows] + narr.releasing[rows]
+                - narr.pipelined[rows],
+                "allocatable": narr.allocatable[rows],
+                "n_tasks": narr.n_tasks[rows],
+                "max_tasks": narr.max_tasks[rows]}
+            for f in self._DEV_NODE_FIELDS:
+                hr = host_rows[f]
+                state.shard_dev[f] = \
+                    state.shard_dev[f].at[idx].set(jnp.asarray(hr))
+                xfer += int(hr.nbytes)
+            state.shard_dirty_rows = set()
+        m.inc(m.SOLVER_DEVICE_BUFFER, event="reuse")
+        return dict(state.shard_dev), xfer
+
     def _run_sharded(self, batch, narr, gmask, static_score, task_bucket,
                      pack_bonus, q_deserved, q_alloc0, ns_weight, ns_alloc0,
                      ns_total, ns_live, eps, allow_pipeline):
-        """Node-axis-sharded placement over the device mesh: each chip owns
-        N/D nodes' scan state, collectives ride ICI (ops/sharded.py)."""
+        """Node-axis-sharded placement over the device mesh: each chip
+        owns a topology-aware contiguous node range's scan state (the
+        ShardPlan balances per-shard resident-task pressure, not a naive
+        N/D split), collectives ride ICI (ops/sharded.py). Placement
+        indices come back in layout order and are mapped to node order
+        through the plan's gather."""
         from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from ..ops.sharded import make_sharded_gang_allocate
 
         mesh = self.mesh
         d = mesh.devices.size
-        n_pad = narr.idle.shape[0]
-        n2 = ((n_pad + d - 1) // d) * d
+        plan = self._shard_plan(narr, d)
 
-        def pad_nodes(a, axis, fill=0):
-            if a.shape[axis] == n2:
-                return a
-            widths = [(0, 0)] * a.ndim
-            widths[axis] = (0, n2 - a.shape[axis])
-            return np.pad(np.asarray(a), widths, constant_values=fill)
+        fn = _get_sharded_fn(mesh, allow_pipeline, ns_live,
+                             getattr(self, "mesh_chunk", 16))
 
-        fn = self._sharded_fns.get((allow_pipeline, ns_live))
-        if fn is None:
-            fn = make_sharded_gang_allocate(
-                mesh, allow_pipeline=allow_pipeline, ns_live=ns_live,
-                chunk=getattr(self, "mesh_chunk", 16))
-            self._sharded_fns[(allow_pipeline, ns_live)] = fn
-
-        n = NamedSharding(mesh, P("nodes"))
-        nr = NamedSharding(mesh, P("nodes", None))
         gn = NamedSharding(mesh, P(None, "nodes"))
         rep = NamedSharding(mesh, P())
-        import jax
 
         from ..metrics import metrics as m
-        xfer = [0]
+        dev_nodes, node_xfer = self._sharded_device_node_inputs(
+            narr, plan, mesh)
+        xfer = [node_xfer]
 
         def put(a, s):
             # host->device byte accounting: numpy inputs are genuine
@@ -984,11 +1144,16 @@ class BatchSolver:
                 xfer[0] += int(a.nbytes)
             return jax.device_put(a, s)
 
+        # [G, N] -> [G, layout] gathers run device-side (gmask and
+        # static_score are products of the device context build)
+        gmask_l = plan.take_device(jnp.asarray(gmask), axis=1, fill=False)
+        score_l = plan.take_device(jnp.asarray(static_score), axis=1,
+                                   fill=0.0)
+
         assign, pipelined, ready, kept, _idle = fn(
             put(batch.task_group, rep), put(batch.task_job, rep),
             put(batch.task_valid, rep), put(batch.group_req, rep),
-            put(pad_nodes(gmask, 1, False), gn),
-            put(pad_nodes(static_score, 1, 0.0), gn),
+            put(gmask_l, gn), put(score_l, gn),
             put(task_bucket, rep), put(pack_bonus, rep),
             put(batch.job_min_available, rep),
             put(batch.job_ready_base, rep),
@@ -998,15 +1163,19 @@ class BatchSolver:
             put(batch.pool_njobs, rep), put(ns_weight, rep),
             put(ns_alloc0, rep), put(ns_total, rep),
             put(q_deserved, rep), put(q_alloc0, rep),
-            put(pad_nodes(narr.idle, 0), nr),
-            put(pad_nodes(narr.future_idle, 0), nr),
-            put(pad_nodes(narr.allocatable, 0), nr),
-            put(pad_nodes(narr.n_tasks, 0), n),
-            put(pad_nodes(narr.max_tasks, 0), n),
+            dev_nodes["idle"], dev_nodes["future_idle"],
+            dev_nodes["allocatable"], dev_nodes["n_tasks"],
+            dev_nodes["max_tasks"],
             put(np.asarray(eps), rep), self.score_weights())
         if xfer[0]:
             m.inc(m.DEVICE_TRANSFER_BYTES, float(xfer[0]))
             trace.add_tags(transfer_bytes=xfer[0])
+        # layout index -> node index (the gather is strictly increasing
+        # over real rows, so tie-breaks already matched node order)
+        a = np.asarray(assign)
+        assign = np.where(a >= 0,
+                          plan.gather[np.clip(a, 0, plan.n_layout - 1)],
+                          -1).astype(np.int32)
         return assign, pipelined, ready, kept
 
     def _record_fit_errors(self, job: JobInfo, task: TaskInfo,

@@ -69,6 +69,13 @@ UNSCHEDULABLE_REASON = f"{NS}_unschedulable_reason_total"
 BIND_FLUSH_LATENCY = f"{NS}_bind_flush_latency_milliseconds"
 BIND_FLUSH_BINDS = f"{NS}_bind_flush_binds_total"
 STORE_PATCH_SHARDS = f"{NS}_store_patch_shards"
+# the flush_wall residue (docs/design/bind_pipeline.md): the two
+# non-bind executor tasks the post-cycle drain also waits on — the
+# session's PodGroup status writeback and the inter-cycle snapshot
+# prebuild — split into their own budget lines so the commit-path tail
+# stays attributable at the 10x shape
+STATUS_WRITEBACK_LATENCY = f"{NS}_status_writeback_latency_milliseconds"
+SNAPSHOT_PREBUILD_LATENCY = f"{NS}_snapshot_prebuild_latency_milliseconds"
 # commit-path resilience (docs/design/resilience.md): bind failures by
 # reason, resync retry volume, pods quarantined after budget exhaustion,
 # gang-atomic heal events, the cycle watchdog, and the solver kernel
@@ -80,6 +87,10 @@ GANG_HEALS = f"{NS}_gang_heal_total"
 CYCLE_DEADLINE_EXCEEDED = f"{NS}_cycle_deadline_exceeded_total"
 SOLVER_FALLBACK = f"{NS}_solver_fallback_total"
 SOLVER_BREAKER_OPEN = f"{NS}_solver_breaker_open"
+# which kernel tier actually served each placement (sharded / pallas /
+# native / chunked / scan) — the auto-selection proof for the mesh
+# default (docs/design/sharded_kernel.md)
+SOLVER_KERNEL_RUNS = f"{NS}_solver_kernel_runs_total"
 # control-plane failover (docs/design/failover.md): writes rejected for a
 # superseded fencing token, cache-vs-store anti-entropy divergences by
 # kind, remote-store transient write retries, and watch-stream restarts
@@ -174,6 +185,16 @@ def set_gauge(name: str, value: float, **labels):
 def inc(name: str, value: float = 1.0, **labels):
     with _lock:
         _counters[(name, tuple(sorted(labels.items())))] += value
+
+
+def counter_total(name: str, **labels) -> float:
+    """Current value of a counter series (exact labels), or the sum over
+    every series of ``name`` when no labels are given — the read half
+    the smoke gates use to assert a path actually ran."""
+    with _lock:
+        if labels:
+            return _counters.get((name, tuple(sorted(labels.items()))), 0.0)
+        return sum(v for (n, _), v in _counters.items() if n == name)
 
 
 @contextmanager

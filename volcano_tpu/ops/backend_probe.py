@@ -18,6 +18,21 @@ child under a hard timeout and kill, records
 returns a structured verdict dict that bench.py logs and embeds in its
 JSON row.
 
+ROOT CAUSE of the since-r03 hang (diagnosed round 9, reproducer in
+docs/design/sharded_kernel.md): this deployment bakes in the ``libtpu``
+PJRT plugin (plus ``libtpu_nightly`` — a known-conflicting pair) but
+the container exposes NO TPU device (``/dev/accel*`` and ``/dev/vfio``
+are absent). ``jax.devices()`` therefore discovers the TPU plugin,
+prefers it over CPU, and blocks forever inside
+``xla_client.initialize_pjrt_plugin`` — the PJRT TPU client init has no
+device-discovery timeout, so bring-up wedges in native code rather than
+failing fast. The probe now runs a ``hw_scan`` phase FIRST: when the
+TPU plugin is installed but no TPU device node exists, the verdict is
+``dead`` with a named ``root_cause`` in ~1 s instead of burning the
+full init timeout per bench (`VOLCANO_PROBE_FORCE_INIT=1` forces the
+init attempt anyway). On a genuine hang the child's ``faulthandler``
+dump rides the verdict as ``hang_stack`` so the wedged frame is named.
+
 Run standalone:  python -m volcano_tpu.ops.backend_probe [--timeout 120]
 """
 
@@ -37,8 +52,19 @@ DEFAULT_TIMEOUT_S = 120.0
 # which would both pre-pay the import the "import_jax" phase is supposed
 # to measure and drag jax into any parent that merely wants run_probe.
 _CHILD_CODE = r"""
-import json, time
+import faulthandler, json, os, sys, time
 t0 = time.monotonic()
+
+# a hang must name its wedged frame: dump every thread's stack to
+# stderr shortly before the parent's kill lands (the parent folds the
+# dump into the verdict as hang_stack)
+try:
+    budget = float(os.environ.get("VOLCANO_PROBE_STACK_AFTER", "0"))
+    if budget > 0:
+        faulthandler.dump_traceback_later(budget, exit=False,
+                                          file=sys.stderr)
+except Exception:
+    pass
 
 def emit(phase, **extra):
     rec = {"phase": phase, "ms": round((time.monotonic() - t0) * 1000.0, 1)}
@@ -54,6 +80,34 @@ x = jnp.arange(8)
 jax.block_until_ready(x + 1)
 emit("device_op", platform=devs[0].platform)
 """
+
+
+def _tpu_hw_scan() -> dict:
+    """Host-side TPU presence scan, no jax import: the PJRT TPU plugin
+    wedges backend_init when installed without hardware, so the probe
+    checks the hardware story FIRST. ``/dev/accel*`` is a definitive
+    TPU signal; ``/dev/vfio/*`` is AMBIGUOUS (newer TPU VMs attach via
+    vfio, but so does GPU passthrough), so vfio presence keeps the real
+    init attempt — only a host with neither gets the fast dead verdict.
+    Returns {plugin_installed, device_nodes, accel_nodes,
+    tpu_hw_present}."""
+    import glob
+    import importlib.util
+    plugin = any(importlib.util.find_spec(m) is not None
+                 for m in ("libtpu", "libtpu_nightly"))
+    accel = sorted(glob.glob("/dev/accel*"))
+    nodes = accel + sorted(glob.glob("/dev/vfio/*"))
+    return {"plugin_installed": plugin,
+            "device_nodes": nodes,
+            "accel_nodes": accel,
+            "tpu_hw_present": bool(nodes)}
+
+
+_NO_HW_ROOT_CAUSE = (
+    "libtpu PJRT plugin installed but no TPU device node exists "
+    "(/dev/accel*, /dev/vfio absent): jax.devices() blocks forever in "
+    "xla_client.initialize_pjrt_plugin — the TPU client init has no "
+    "device-discovery timeout (docs/design/sharded_kernel.md)")
 
 
 def run_probe(timeout_s: Optional[float] = None, env: Optional[dict] = None,
@@ -79,21 +133,54 @@ def run_probe(timeout_s: Optional[float] = None, env: Optional[dict] = None,
     else:
         child_env = dict(os.environ)
         child_env.pop("JAX_PLATFORMS", None)
-    cmd = [sys.executable, "-c", _CHILD_CODE]
     t0 = time.monotonic()
+
+    # phase 0: hardware scan — the diagnosed no-hardware hang is decided
+    # in ~1 ms instead of burning the whole init timeout per bench
+    hw = _tpu_hw_scan()
+    force_init = bool(child_env.get("VOLCANO_PROBE_FORCE_INIT")
+                      or (env or {}).get("JAX_PLATFORMS"))
+    if hw["plugin_installed"] and not hw["tpu_hw_present"] \
+            and not force_init:
+        try:
+            m.inc(m.BACKEND_PROBE, outcome="dead")
+        except Exception:
+            pass
+        verdict = {"alive": False, "platform": None, "timed_out": False,
+                   "last_phase": "hw_scan",
+                   "phases": [dict(phase="hw_scan", ms=0.0, **hw)],
+                   "rc": None, "hw_scan": hw,
+                   "root_cause": _NO_HW_ROOT_CAUSE,
+                   "wall_s": round(time.monotonic() - t0, 1)}
+        if log is not None:
+            log("backend probe: TPU plugin installed but NO TPU device "
+                "nodes — skipping the (known-hanging) init; "
+                "VOLCANO_PROBE_FORCE_INIT=1 forces it")
+            log(f"backend probe root cause: {_NO_HW_ROOT_CAUSE}")
+        return verdict
+
+    # arm the child's hang-stack dump just inside the kill window
+    child_env.setdefault("VOLCANO_PROBE_STACK_AFTER",
+                         str(max(1.0, float(timeout_s) - 5.0)))
+    cmd = [sys.executable, "-c", _CHILD_CODE]
     timed_out = False
     rc: Optional[int] = None
     out = ""
+    err = ""
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout_s, env=child_env)
         rc = r.returncode
         out = r.stdout or ""
+        err = r.stderr or ""
     except subprocess.TimeoutExpired as e:
         timed_out = True
         raw = e.stdout or b""
         out = raw.decode(errors="replace") if isinstance(raw, bytes) \
             else raw
+        raw_err = e.stderr or b""
+        err = raw_err.decode(errors="replace") \
+            if isinstance(raw_err, bytes) else raw_err
     phases = []
     for line in out.splitlines():
         line = line.strip()
@@ -117,8 +204,21 @@ def run_probe(timeout_s: Optional[float] = None, env: Optional[dict] = None,
         pass
     verdict = {"alive": alive, "platform": platform,
                "timed_out": timed_out, "last_phase": last_phase,
-               "phases": phases, "rc": rc,
+               "phases": phases, "rc": rc, "hw_scan": hw,
                "wall_s": round(time.monotonic() - t0, 1)}
+    if timed_out:
+        # the faulthandler dump names the wedged frame; keep the tail
+        # (the main thread's innermost frames) bounded for the JSON row
+        stack = [ln for ln in err.splitlines()
+                 if ln.strip().startswith(("Thread", "Current thread",
+                                           "File "))]
+        if stack:
+            verdict["hang_stack"] = stack[-12:]
+        # no definitive TPU node: a vfio-only host that hung is most
+        # likely the same plugin-without-TPU wedge (vfio can belong to
+        # GPU passthrough), so name the root cause there too
+        if hw["plugin_installed"] and not hw.get("accel_nodes"):
+            verdict["root_cause"] = _NO_HW_ROOT_CAUSE
     if log is not None:
         for p in phases:
             log(f"backend probe phase {p['phase']}: {p['ms']} ms "

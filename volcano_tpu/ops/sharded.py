@@ -26,6 +26,8 @@ from __future__ import annotations
 from functools import partial
 from typing import NamedTuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -256,7 +258,7 @@ def _sharded_body_chunked(task_group, task_job, task_valid, group_req,
                           node_future, node_alloc, node_ntasks,
                           node_max_tasks, eps, weights,
                           allow_pipeline: bool, ns_live: bool, axis: str,
-                          chunk: int):
+                          chunk: int, n_dev: int = 1):
     """Chunked-candidate variant of :func:`_sharded_body`: instead of one
     all-gather per scan step, each shard gathers its top-``chunk``
     candidates per fit class (idle / future) into a replicated candidate
@@ -283,8 +285,12 @@ def _sharded_body_chunked(task_group, task_job, task_valid, group_req,
         offset = jnp.int32(0)
         n_dev = 1
     else:
+        # n_dev arrives statically from make_sharded_gang_allocate
+        # (mesh.size): the candidate-table height K must be a static
+        # shape, and jax.lax.axis_size does not exist on every
+        # supported jax version (0.4.x lacks it — the former dynamic
+        # lookup made every sharded chunked call crash)
         offset = jax.lax.axis_index(axis) * Nl
-        n_dev = jax.lax.axis_size(axis)
     K = 2 * C * n_dev
     F = 5 + 3 * R   # gidx, static, pack, ntasks, maxtasks, idle, future, alloc
 
@@ -449,7 +455,8 @@ def make_sharded_gang_allocate(mesh: Mesh, axis: str = "nodes",
     out_specs = (rep, rep, rep, rep, nr)
     if chunk and chunk > 1:
         body = partial(_sharded_body_chunked, allow_pipeline=allow_pipeline,
-                       ns_live=ns_live, axis=axis, chunk=int(chunk))
+                       ns_live=ns_live, axis=axis, chunk=int(chunk),
+                       n_dev=int(mesh.devices.size))
     else:
         body = partial(_sharded_body, allow_pipeline=allow_pipeline,
                        ns_live=ns_live, axis=axis)
@@ -460,6 +467,134 @@ def make_sharded_gang_allocate(mesh: Mesh, axis: str = "nodes",
         sm = shard_map(body, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs, check_rep=False)
     return jax.jit(sm)
+
+
+# -- topology-aware node partition (docs/design/sharded_kernel.md) -----------
+
+class ShardPlan:
+    """Contiguous node-range partition of the (padded) node axis over the
+    device mesh, balanced by per-node task pressure instead of a naive
+    N/D split.
+
+    shard_map still requires EQUAL per-device shard widths, so the plan
+    materializes a *layout*: device ``d`` owns the contiguous node rows
+    ``[bounds[d], bounds[d+1])`` placed at layout rows ``[d*Nl, d*Nl +
+    len_d)`` with inert padding rows (gather index -1) filling the rest
+    of its block. Because every range is contiguous and the blocks are
+    in node order, the layout index is strictly increasing over real
+    rows — the kernel's lowest-global-index tie-break therefore equals
+    the single-device node-order tie-break, keeping the sharded run
+    bit-identical regardless of where the boundaries fall.
+
+    The plan is persistent: it is rebuilt only on STRUCTURAL node
+    changes (membership/order churn invalidates the persistent host
+    arrays wholesale, and the plan with them), so the per-device
+    resident kernel-input buffers keep their dirty-row scatter path
+    across steady-state cycles.
+    """
+
+    __slots__ = ("n_devices", "n_rows", "rows_per_shard", "bounds",
+                 "gather", "layout_of_node", "pressure_per_shard")
+
+    def __init__(self, n_devices: int, n_rows: int, bounds):
+        self.n_devices = int(n_devices)
+        self.n_rows = int(n_rows)
+        self.bounds = np.asarray(bounds, np.int64)
+        widths = self.bounds[1:] - self.bounds[:-1]
+        nl = int(widths.max()) if len(widths) else 1
+        self.rows_per_shard = max(nl, 1)
+        gather = np.full(self.n_devices * self.rows_per_shard, -1, np.int64)
+        layout_of_node = np.full(self.n_rows, -1, np.int64)
+        for d in range(self.n_devices):
+            lo, hi = int(self.bounds[d]), int(self.bounds[d + 1])
+            base = d * self.rows_per_shard
+            gather[base:base + (hi - lo)] = np.arange(lo, hi)
+            layout_of_node[lo:hi] = np.arange(base, base + (hi - lo))
+        self.gather = gather
+        self.layout_of_node = layout_of_node
+        self.pressure_per_shard = None
+
+    @property
+    def n_layout(self) -> int:
+        return self.n_devices * self.rows_per_shard
+
+    def take(self, a, axis: int = 0, fill=0):
+        """Gather a node-axis numpy array into layout order; padding rows
+        get ``fill``."""
+        a = np.asarray(a)
+        if self.n_rows == 0:
+            # empty plan (zero ready nodes): all layout rows are padding
+            shape = list(a.shape)
+            shape[axis] = self.n_layout
+            return np.full(shape, fill, a.dtype)
+        idx = np.clip(self.gather, 0, self.n_rows - 1)
+        out = np.take(a, idx, axis=axis)
+        pad = self.gather < 0
+        if pad.any():
+            sl = [slice(None)] * out.ndim
+            sl[axis] = pad
+            out[tuple(sl)] = fill
+        return out
+
+    def take_device(self, a, axis: int = 1, fill=0.0):
+        """Device-side gather for arrays already on the accelerator
+        (gmask / static_score are products of the context build)."""
+        if self.n_rows == 0:
+            shape = list(a.shape)
+            shape[axis] = self.n_layout
+            return jnp.full(shape, fill, a.dtype)
+        idx = jnp.asarray(np.clip(self.gather, 0, self.n_rows - 1))
+        out = jnp.take(a, idx, axis=axis)
+        pad = jnp.asarray(self.gather < 0)
+        shape = [1] * out.ndim
+        shape[axis] = pad.shape[0]
+        return jnp.where(pad.reshape(shape), fill, out)
+
+
+def build_shard_plan(n_rows: int, n_devices: int, pressure=None,
+                     max_skew: float = 2.0) -> ShardPlan:
+    """Partition ``n_rows`` node rows into ``n_devices`` contiguous
+    ranges whose per-shard summed ``pressure`` (resident task count per
+    node from the snapshot rollups, +1 so empty nodes still carry their
+    sweep cost) is as balanced as a prefix-sum split can make it.
+
+    ``max_skew`` bounds the layout blow-up: no range may exceed
+    ``max_skew * ceil(n/D)`` rows, so a pathologically skewed pressure
+    profile cannot make one shard own most of the cluster (the layout is
+    D * max-range wide). ``pressure=None`` degrades to the naive equal
+    split."""
+    n_rows = int(n_rows)
+    d = max(int(n_devices), 1)
+    if n_rows <= 0:
+        return ShardPlan(d, 0, [0] * (d + 1))
+    w_max = max(1, int(np.ceil(n_rows / d * max_skew)))
+    if pressure is None:
+        step = int(np.ceil(n_rows / d))
+        bounds = [min(i * step, n_rows) for i in range(d + 1)]
+        bounds[-1] = n_rows
+        return ShardPlan(d, n_rows, bounds)
+    p = np.maximum(np.asarray(pressure, np.float64), 0.0) + 1.0
+    if p.shape[0] < n_rows:            # padding rows carry pressure 1.0
+        p = np.concatenate([p, np.ones(n_rows - p.shape[0])])
+    p = p[:n_rows]
+    prefix = np.concatenate([[0.0], np.cumsum(p)])
+    total = prefix[-1]
+    bounds = [0]
+    for i in range(1, d):
+        target = total * i / d
+        b = int(np.searchsorted(prefix, target))
+        # monotonic + width cap forward; leave room for the remaining
+        # shards to absorb the tail under the same cap
+        b = max(b, bounds[-1])
+        b = min(b, bounds[-1] + w_max, n_rows)
+        b = max(b, n_rows - (d - i) * w_max)
+        bounds.append(b)
+    bounds.append(n_rows)
+    plan = ShardPlan(d, n_rows, bounds)
+    plan.pressure_per_shard = [
+        float(prefix[bounds[i + 1]] - prefix[bounds[i]])
+        for i in range(d)]
+    return plan
 
 
 def shard_synth(mesh: Mesh, sa, axis: str = "nodes"):

@@ -120,6 +120,20 @@ def add_sim_parser(sub) -> None:
     incr.add_argument("--nodes", type=int, default=256)
     incr.add_argument("--json", action="store_true")
 
+    mesh = sim.add_parser(
+        "mesh", help="CI gate (make multichip-smoke): the same seeded "
+                     "200-tick churn run on the 8-device sharded solver "
+                     "AND the single-device solver — invariants clean on "
+                     "every audited tick, bind + ledger fingerprints "
+                     "bit-identical across the two, sharded kernel "
+                     "provably the one that ran, and a sharded double "
+                     "run bit-identical")
+    mesh.add_argument("--seed", type=int, default=31)
+    mesh.add_argument("--ticks", type=int, default=200)
+    mesh.add_argument("--nodes", type=int, default=128)
+    mesh.add_argument("--devices", type=int, default=8)
+    mesh.add_argument("--json", action="store_true")
+
     rep = sim.add_parser("replay", help="re-run a violation repro bundle")
     rep.add_argument("--bundle", required=True)
     rep.add_argument("--use-trace", action="store_true",
@@ -319,6 +333,33 @@ def incr_config(seed: int = 23, ticks: int = 200, nodes: int = 256,
             seed=seed, flap_rate=0.04, flap_down_s=6.0),
         fail_rate=0.05,
         incremental=incremental,
+        repro_dir=".")
+
+
+def mesh_config(seed: int = 31, ticks: int = 200, nodes: int = 128,
+                mesh: bool = True, devices: int = 8):
+    """The `make multichip-smoke` shape (docs/design/sharded_kernel.md):
+    200 ticks of the incr-style churn — bursty resident backlog, Poisson
+    arrivals with node flaps through 60% of the horizon, quiet tail,
+    mid-run gang pod losses — with the scheduler conf FORCING the
+    device mesh (``mesh.min_nodes: 0``), vs the identical run on the
+    single-device solver. The sharded kernel's exactness contract must
+    survive churn: bind AND ledger fingerprints bit-identical between
+    the two runs, and across a sharded double run."""
+    from .engine import DEFAULT_CONF, SimConfig
+    from .faults import FaultConfig
+    from .workload import mesh_scenario_workload, with_mesh_solver
+    conf_text = with_mesh_solver(DEFAULT_CONF, devices=devices) \
+        if mesh else DEFAULT_CONF
+    return SimConfig(
+        seed=seed, ticks=ticks, tick_s=1.0, n_nodes=nodes,
+        node_cpu="16", node_mem="32Gi",
+        conf_text=conf_text,
+        resident_jobs=64, resident_gang=8,
+        workload=mesh_scenario_workload(seed, ticks),
+        faults=FaultConfig(
+            seed=seed, flap_rate=0.04, flap_down_s=6.0),
+        fail_rate=0.05,
         repro_dir=".")
 
 
@@ -605,6 +646,72 @@ def dispatch_sim(args) -> int:
             for name, ok in checks.items():
                 print(f"  {name}: {'ok' if ok else 'FAIL'}")
             print(f"incr-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
+        return 0 if verdict["pass"] else 1
+
+    if args.verb == "mesh":
+        import jax
+
+        from ..framework.solver import reset_breaker
+        from ..metrics import metrics as m
+        if len(jax.devices()) < max(2, args.devices):
+            print(f"multichip-smoke needs {args.devices} devices, have "
+                  f"{len(jax.devices())} — run under XLA_FLAGS="
+                  f"--xla_force_host_platform_device_count="
+                  f"{args.devices}")
+            return 2
+
+        def kernel_runs(kernel: str) -> float:
+            return m.counter_total(m.SOLVER_KERNEL_RUNS, kernel=kernel)
+
+        reset_breaker()
+        sh0 = kernel_runs("sharded")
+        r1 = run_sim(mesh_config(seed=args.seed, ticks=args.ticks,
+                                 nodes=args.nodes, devices=args.devices))
+        sharded_ran = kernel_runs("sharded") - sh0
+        # determinism half: sharded double run, fresh engine, same seed
+        reset_breaker()
+        r2 = run_sim(mesh_config(seed=args.seed, ticks=args.ticks,
+                                 nodes=args.nodes, devices=args.devices))
+        # parity half: the identical churn on the single-device solver
+        reset_breaker()
+        sh1 = kernel_runs("sharded")
+        r3 = run_sim(mesh_config(seed=args.seed, ticks=args.ticks,
+                                 nodes=args.nodes, mesh=False))
+        checks = {
+            "no_violations": not r1.violations and not r2.violations
+                             and not r3.violations,
+            # the mesh solver demonstrably served the placements (and
+            # the single-device control demonstrably did NOT)
+            "sharded_kernel_ran": sharded_ran > 0,
+            "control_ran_single_device":
+                kernel_runs("sharded") == sh1,
+            # the exactness contract under churn/faults: mesh on vs off
+            # must be bind-for-bind AND ledger-for-ledger identical
+            "bind_parity_with_single_device":
+                r1.bind_fingerprint() == r3.bind_fingerprint(),
+            "ledger_parity_with_single_device":
+                r1.ledger.get("fingerprint") == r3.ledger.get("fingerprint"),
+            # and deterministic with itself across a double run
+            "deterministic_replay":
+                r1.bind_fingerprint() == r2.bind_fingerprint()
+                and r1.ledger.get("fingerprint")
+                == r2.ledger.get("fingerprint"),
+        }
+        verdict = {
+            "mesh": r1.summary(),
+            "sharded_kernel_runs": sharded_ran,
+            "checks": checks,
+            "pass": all(checks.values()),
+        }
+        if args.json:
+            print(json.dumps(verdict, indent=1))
+        else:
+            _print_summary(r1.summary(), False)
+            print(f"sharded kernel runs: {int(sharded_ran)}  binds: "
+                  f"{len(r1.bind_sequence)}")
+            for name, ok in checks.items():
+                print(f"  {name}: {'ok' if ok else 'FAIL'}")
+            print(f"multichip-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
         return 0 if verdict["pass"] else 1
 
     if args.verb == "replay":

@@ -90,6 +90,45 @@ def resident_backlog(n_jobs: int, gang: int, cpu: str = "2",
             for j in range(n_jobs)]
 
 
+# -- sharded-default (multi-chip) scenario -----------------------------------
+# docs/design/sharded_kernel.md: the sharded kernel is the production
+# default at scale, so the simulator must prove it under CHURN AND
+# FAULTS, not just in the one-shot dry run — same seeded workload run
+# with the mesh on and off, bind + ledger fingerprints required to be
+# bit-identical (the sharded kernel's exactness contract surviving
+# rollbacks, node flaps and retries).
+
+def with_mesh_solver(conf_text: str, devices: int = 8, chunk: int = 16,
+                     min_nodes: int = 0) -> str:
+    """Append a solver configuration forcing the device mesh to a
+    scheduler conf that has none (``mesh.min_nodes`` 0 = force even on
+    sim-sized clusters)."""
+    if "configurations:" in conf_text:
+        raise ValueError("conf already carries a configurations section; "
+                         "merge mesh args into it explicitly")
+    return conf_text + f"""
+configurations:
+- name: solver
+  arguments:
+    mesh.enable: "true"
+    mesh.devices: "{int(devices)}"
+    mesh.chunk: "{int(chunk)}"
+    mesh.min_nodes: "{int(min_nodes)}"
+"""
+
+
+def mesh_scenario_workload(seed: int, ticks: int,
+                           arrival_rate: float = 0.4) -> WorkloadConfig:
+    """The sharded-default churn shape: a Poisson stream through the
+    first 60% of the horizon then a quiet tail, mixed gang sizes so the
+    kernel sees rollback-heavy AND quiet regimes on the mesh (mirrors
+    the incr scenario so the two gates stay comparable)."""
+    return WorkloadConfig(
+        seed=seed, horizon_s=float(ticks) * 0.6,
+        arrival_rate=arrival_rate,
+        duration_min_s=15.0, duration_max_s=90.0)
+
+
 # -- JSONL trace I/O ---------------------------------------------------------
 
 
