@@ -4,7 +4,7 @@ PYTHON ?= python
 
 .PHONY: test unit-test e2e bench bench-all bench-check multichip-dryrun \
 	deploy deploy-up trace-smoke sim-smoke flush-bench chaos-smoke \
-	failover-smoke obs-smoke incr-smoke multichip-smoke
+	failover-smoke obs-smoke incr-smoke multichip-smoke constraint-smoke
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -106,8 +106,8 @@ obs-smoke: failover-smoke
 incr-smoke: obs-smoke
 	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli incr
 
-# bench regression gate: compare the fresh BENCH_r09.json row (written
-# by `make bench`) against the BENCH_r08 baseline with machine-
+# bench regression gate: compare the fresh BENCH_r10.json row (written
+# by `make bench`) against the BENCH_r09 baseline with machine-
 # calibration scaling (this box drifts up to ~2.3x across captures).
 # When the fresh row carries the 10x metric (500k x 50k, round 9) the
 # gate switches to the 10x mode: kernel budget task-linear off the
@@ -115,7 +115,10 @@ incr-smoke: obs-smoke
 # absolute 20 ms r05-machine target with a shape-linear ceiling,
 # sharded-tier proof + flush-residue lines required
 # (docs/design/sharded_kernel.md). Same-metric rows keep the full
-# r08-era key-for-key gate.
+# r08-era key-for-key gate. Round 10 additionally requires the
+# constraint columns: constrained 50k x 10k kernel <= 1.5x the
+# unconstrained one, victim-selection kernel faster than the Python
+# walk (docs/design/constraints.md).
 bench-check:
 	JAX_PLATFORMS=cpu $(PYTHON) tools/bench_check.py
 
@@ -131,6 +134,20 @@ bench-check:
 multichip-smoke: incr-smoke
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	$(PYTHON) -m volcano_tpu.sim.cli mesh
+
+# constraint-kernel gate (docs/design/constraints.md), after
+# multichip-smoke: seeded churn of zone-spread gangs, one-per-zone
+# anti-affinity pairs and a priority preemption/reclaim storm over
+# elastic filler, run THREE times — compiled constraint tensors +
+# vmapped victim-selection kernel (twice, for determinism) and with the
+# per-task Python predicate path + Python victim walk forced. Exit 1
+# unless every audited tick is clean on the whole invariant catalog
+# (incl. the spread_skew / anti_affinity checkers), both kernels
+# provably ran with zero crash fallbacks, evictions happened, and all
+# three runs' bind+evict AND lifecycle-ledger fingerprints are
+# bit-identical.
+constraint-smoke: multichip-smoke
+	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli constraints
 
 # multi-chip sharding dryrun on the virtual CPU mesh (the raw
 # shard_map program + full-pipeline one-shot; multichip-smoke is the

@@ -144,10 +144,7 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
     devs = jax.devices()
     log(f"cycle worker backend: {devs[0].platform} x{len(devs)}")
 
-    def hist_total(metric: str) -> float:
-        with m._lock:
-            return sum(h.total for (name, _), h in m._histograms.items()
-                       if name == metric)
+    hist_total = m.histogram_total
 
     def kernel_total() -> float:
         return hist_total(m.SOLVER_KERNEL_LATENCY)
@@ -391,11 +388,186 @@ configurations:
     print(json.dumps(best))
 
 
+def constraint_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
+    """Constraint-cost A/B at the canonical shape
+    (docs/design/constraints.md): the same populate run unconstrained
+    and constraint-heavy (zoned nodes, hard-spread gangs, one-per-zone
+    anti pairs), reporting the placement-kernel latency of each plus the
+    constraint-compilation cost — the `make bench-check` gate holds the
+    constrained kernel to <= 1.5x the unconstrained one. Rides along: a
+    preempt victim-selection A/B (vmapped kernel vs the Python walk on
+    a vectorizable plugin chain) whose action wall times the gate
+    requires to favor the kernel."""
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")  # beat sitecustomize pin
+    from volcano_tpu.bench_suite import (CONF_FULL, _cycle_env, _populate,
+                                         _run_cycle)
+    from volcano_tpu.metrics import metrics as m
+
+    hist_total = m.histogram_total
+
+    gang = 8
+    pop = dict(n_nodes=n_nodes, n_jobs=n_tasks // gang, gang=gang)
+    heavy = dict(zones=8, spread_every=4, anti_every=8)
+    out: dict = {"tasks": n_tasks, "nodes": n_nodes,
+                 "platform": jax.devices()[0].platform}
+
+    def measure(tag: str, constraints: dict) -> float:
+        # cold env compiles this variant's padded shapes (constraint
+        # slot-splitting changes the group count, hence g_pad), then a
+        # fresh identical env is the measured one
+        for phase in ("cold", "measured"):
+            store, cache, binder, conf = _cycle_env(CONF_FULL)
+            _populate(store, **pop, **constraints)
+            k0 = hist_total(m.SOLVER_KERNEL_LATENCY)
+            b0 = hist_total(m.CONSTRAINT_BUILD_LATENCY)
+            _run_cycle(cache, conf)
+            kernel_ms = hist_total(m.SOLVER_KERNEL_LATENCY) - k0
+            build_ms = hist_total(m.CONSTRAINT_BUILD_LATENCY) - b0
+            binds = len(binder.binds)
+            cache.flush_executors(timeout=900)
+            cache.stop()
+            del store, cache, binder
+        log(f"{tag}: kernel={kernel_ms:.1f} ms constraint_build="
+            f"{build_ms:.1f} ms binds={binds}")
+        out[f"kernel_{tag}_ms"] = round(kernel_ms, 2)
+        if constraints:
+            out["constraint_build_ms"] = round(build_ms, 2)
+        return kernel_ms
+
+    measure("unconstrained", {})
+    measure("constrained", heavy)
+
+    # -- victim-selection A/B (vmapped kernel vs Python walk) --------------
+    conf_vec = """
+actions: "preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: conformance
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: nodeorder
+"""
+    conf_off = conf_vec + """
+configurations:
+- name: solver
+  arguments:
+    victims.kernel: "off"
+"""
+
+    def victim_env(conf_text, vn_nodes=2000, n_low=250, n_high=125):
+        from volcano_tpu.models.objects import ObjectMeta, PriorityClass
+        from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                                  build_pod_group,
+                                                  build_queue)
+        store, cache, binder, conf = _cycle_env(conf_text)
+        store.create("queues", build_queue("default", weight=1))
+        store.create("priorityclasses", PriorityClass(
+            metadata=ObjectMeta(name="high"), value=100))
+        store.create("priorityclasses", PriorityClass(
+            metadata=ObjectMeta(name="low"), value=1))
+        for i in range(vn_nodes):
+            store.create("nodes", build_node(
+                f"node-{i}", {"cpu": "16", "memory": "32Gi"}))
+        for j in range(n_low):
+            store.create("podgroups", build_pod_group(
+                f"lo-{j}", "ns1", "default", 4, phase="Running",
+                priority_class="low"))
+            for t in range(8):
+                store.create("pods", build_pod(
+                    "ns1", f"lo-{j}-{t}", f"node-{(j * 8 + t) % vn_nodes}",
+                    "Running", {"cpu": "14", "memory": "28Gi"}, f"lo-{j}"))
+        for j in range(n_high):
+            store.create("podgroups", build_pod_group(
+                f"hi-{j}", "ns1", "default", 8, phase="Inqueue",
+                priority_class="high"))
+            for t in range(8):
+                store.create("pods", build_pod(
+                    "ns1", f"hi-{j}-{t}", "", "Pending",
+                    {"cpu": "14", "memory": "28Gi"}, f"hi-{j}"))
+        return store, cache, binder, conf
+
+    from volcano_tpu.framework import close_session, get_action, open_session
+
+    def victim_measure(tag: str, conf_text: str) -> None:
+        best = None
+        evicts = 0
+        for i in range(2):   # cold (compile/caches) + measured, min-of-2
+            store, cache, binder, conf = victim_env(conf_text)
+            ssn = open_session(cache, conf.tiers, conf.configurations)
+            t0 = time.perf_counter()
+            get_action("preempt").execute(ssn)
+            ms = (time.perf_counter() - t0) * 1000.0
+            close_session(ssn)
+            cache.flush_executors(timeout=300)
+            evicts = len(cache.evictor.evicts)
+            cache.stop()
+            del store, cache, binder
+            if best is None or ms < best:
+                best = ms
+        # a no-op action wall is not an A/B: the scenario must evict, or
+        # the bench-check victim gate would be comparing noise
+        if not evicts:
+            raise RuntimeError(
+                f"victim-selection {tag} leg evicted nothing — the "
+                "synthetic preempt scenario went stale")
+        log(f"victim-selection {tag}: preempt action {best:.1f} ms "
+            f"({evicts} evictions)")
+        out[f"victim_select_{tag}_ms"] = round(best, 2)
+        out[f"victim_evictions_{tag}"] = evicts
+
+    k0 = m.counter_total(m.VICTIM_SELECT_RUNS, mode="kernel")
+    victim_measure("kernel", conf_vec)
+    out["victim_kernel_runs"] = m.counter_total(
+        m.VICTIM_SELECT_RUNS, mode="kernel") - k0
+    victim_measure("python", conf_off)
+    print(json.dumps(out))
+
+
+def try_constraint_worker(platform: str, n_tasks: int, n_nodes: int):
+    env = dict(os.environ)
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    else:
+        env.pop("JAX_PLATFORMS", None)
+    timeout_s = float(os.environ.get("VOLCANO_BENCH_CONSTRAINT_TIMEOUT",
+                                     1500))
+    cmd = [sys.executable, os.path.abspath(__file__), "--constraint-worker",
+           platform, str(n_tasks), str(n_nodes)]
+    log(f"spawning constraint worker: platform={platform} "
+        f"shape={n_tasks}x{n_nodes} (timeout {timeout_s:.0f}s)")
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout_s, env=env,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        log("constraint worker timed out (killed)")
+        return None
+    for line in (r.stderr or "").splitlines():
+        print(line, file=sys.stderr)
+    if r.returncode != 0:
+        log(f"constraint worker rc={r.returncode}; "
+            f"stdout tail: {(r.stdout or '')[-200:]!r}")
+        return None
+    try:
+        return json.loads((r.stdout or "").strip().splitlines()[-1])
+    except Exception:
+        log(f"constraint worker output unparseable: "
+            f"{(r.stdout or '')[-200:]!r}")
+        return None
+
+
 def write_bench_row(row: dict) -> None:
     """Persist the headline row (BENCH_r08.json by default; override or
     disable with VOLCANO_BENCH_ROW_OUT) with a machine-calibration
     fingerprint so tools/bench_check.py can scale cross-box compares."""
-    out = os.environ.get("VOLCANO_BENCH_ROW_OUT", "BENCH_r09.json")
+    out = os.environ.get("VOLCANO_BENCH_ROW_OUT", "BENCH_r10.json")
     if not out:
         return
     try:
@@ -661,6 +833,15 @@ def main() -> None:
             sys.exit(1)
         return
 
+    if len(sys.argv) > 1 and sys.argv[1] == "--constraint-worker":
+        try:
+            constraint_worker(sys.argv[2], int(sys.argv[3]),
+                              int(sys.argv[4]))
+        except Exception:
+            log("constraint worker failed:\n" + traceback.format_exc())
+            sys.exit(1)
+        return
+
     if len(sys.argv) > 1 and sys.argv[1] == "--worker":
         try:
             worker(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),
@@ -828,6 +1009,23 @@ def main() -> None:
                 # CPU fallback)
                 "backend_probe": _probe_verdict,
             }
+            # constraint-cost A/B at the canonical 50k x 10k shape
+            # (docs/design/constraints.md) — BENCH_r10 onward:
+            # unconstrained vs constraint-heavy kernel latency, the
+            # constraint-compilation cost, and the victim-selection
+            # kernel-vs-Python action walls, all gated by bench_check
+            cres = try_constraint_worker(platform, 50_000, 10_000)
+            if cres is not None:
+                for k in ("kernel_unconstrained_ms", "kernel_constrained_ms",
+                          "constraint_build_ms", "victim_select_kernel_ms",
+                          "victim_select_python_ms", "victim_kernel_runs",
+                          "victim_evictions_kernel",
+                          "victim_evictions_python"):
+                    if k in cres:
+                        row[k] = cres[k]
+            else:
+                log("constraint worker failed; row ships without the "
+                    "constraint columns (bench-check will flag it)")
             print(json.dumps(row))
             write_bench_row(row)
             return

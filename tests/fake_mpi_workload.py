@@ -31,8 +31,43 @@ def main() -> int:
     ssh_dir = mount_root / "root/.ssh"
     pod_name = os.environ["POD_NAME"]
 
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import padding
+    try:
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import padding
+    except ImportError:
+        # containers without the cryptography package use the controller's
+        # own fallback (same PEM/OpenSSH/PKCS1v15 wire forms); the kubelet
+        # spawns this file with cwd=pod_dir, so the repo root must be put
+        # on the path explicitly
+        repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+        if repo_root not in sys.path:
+            sys.path.insert(0, repo_root)
+        from volcano_tpu.utils.rsa_fallback import RSAKey
+
+        class _Key:
+            def __init__(self, key):
+                self._key = key
+
+            def sign(self, data, *_):
+                return self._key.sign(data)
+
+            def verify(self, sig, data, *_):
+                self._key.verify(sig, data)   # raises on mismatch
+
+        class serialization:  # noqa: N801 — mirror the real module's API
+            @staticmethod
+            def load_pem_private_key(pem, password=None):
+                return _Key(RSAKey.from_private_pem(pem))
+
+            @staticmethod
+            def load_ssh_public_key(line):
+                return _Key(RSAKey.from_public_openssh(line))
+
+        class padding:  # noqa: N801
+            PKCS1v15 = staticmethod(lambda: None)
+
+        class hashes:  # noqa: N801
+            SHA256 = staticmethod(lambda: None)
 
     if role == "master":
         hosts = (etc / "worker.host").read_text().split()

@@ -1,0 +1,651 @@
+"""Constraint-compilation + victim-selection kernel tests
+(docs/design/constraints.md).
+
+Three surfaces:
+
+* placement SEMANTICS — hard/soft topology spread and required
+  self-anti-affinity honored by the allocate path (zoned clusters,
+  unlabeled-node exclusion, unsatisfiable replicas held back);
+* kernel-vs-reference PARITY — the compiled mask/score tensors
+  (`constraints.compile: auto`) and the per-task Python predicate path
+  (`: off`) must place bit-identically, and the vmapped victim-selection
+  kernel (`victims.kernel: auto`/`off`) must evict bit-identically on
+  preempt AND reclaim, with the metrics counters proving which path ran;
+* RESILIENCE — a compile/kernel crash falls back to the Python
+  reference mid-action instead of costing the cycle, and the persistent
+  node-side constraint state refreshes only dirty rows.
+"""
+
+import numpy as np
+import pytest
+
+from tests.harness import Harness
+from volcano_tpu.metrics import metrics as m
+from volcano_tpu.models.job_info import TaskStatus
+from volcano_tpu.models.objects import (Affinity, NodeSelectorRequirement,
+                                        ObjectMeta, PodAffinity,
+                                        PodAffinityTerm, PodGroupPhase,
+                                        PriorityClass,
+                                        TopologySpreadConstraint)
+from volcano_tpu.ops import constraints
+from volcano_tpu.utils.test_utils import (build_node, build_pod,
+                                          build_pod_group, build_queue,
+                                          build_resource_list)
+
+ZONE = "topology.kubernetes.io/zone"
+RL1 = build_resource_list("1", "1Gi")
+
+ALLOC_CONF = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+ALLOC_REFERENCE_CONF = ALLOC_CONF + """
+configurations:
+- name: solver
+  arguments:
+    constraints.compile: "off"
+"""
+
+PREEMPT_CONF = """
+actions: "preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: conformance
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: nodeorder
+"""
+
+RECLAIM_CONF = """
+actions: "reclaim"
+tiers:
+- plugins:
+  - name: conformance
+  - name: gang
+  - name: proportion
+"""
+
+
+def _walk_off(conf):
+    return conf + """
+configurations:
+- name: solver
+  arguments:
+    victims.kernel: "off"
+"""
+
+
+def pg(name, ns, queue, minm, **kw):
+    return build_pod_group(name, ns, queue, minm,
+                           phase=PodGroupPhase.INQUEUE, **kw)
+
+
+def spread_pod(ns, name, group, skew=1, mode="DoNotSchedule", key=ZONE):
+    pod = build_pod(ns, name, "", "Pending", RL1, group)
+    pod.spec.topology_spread = [TopologySpreadConstraint(
+        max_skew=skew, topology_key=key, when_unsatisfiable=mode)]
+    return pod
+
+
+def anti_pod(ns, name, group, key=ZONE):
+    """One-replica-per-domain idiom: required self-anti-affinity over
+    ``key`` — the pod's own job label selects its siblings."""
+    pod = build_pod(ns, name, "", "Pending", RL1, group,
+                    labels={"job-group": group})
+    pod.spec.affinity = Affinity(pod_anti_affinity=PodAffinity(
+        required=[PodAffinityTerm(
+            label_selector=[NodeSelectorRequirement(
+                key="job-group", operator="In", values=[group])],
+            topology_key=key)]))
+    return pod
+
+
+def zoned_cluster(h, zones, per_zone=2, cpu="4", mem="4Gi",
+                  unlabeled=0):
+    h.add("queues", build_queue("q1"))
+    i = 0
+    for z in range(zones):
+        for _ in range(per_zone):
+            h.add("nodes", build_node(
+                f"n{i}", build_resource_list(cpu, mem),
+                labels={ZONE: f"zone-{z}"}))
+            i += 1
+    for _ in range(unlabeled):
+        h.add("nodes", build_node(f"n{i}", build_resource_list(cpu, mem)))
+        i += 1
+    return h
+
+
+def _zone_counts(h, pods_prefix=""):
+    counts = {}
+    for key, node in h.binds.items():
+        if pods_prefix and pods_prefix not in key:
+            continue
+        n = h.store.get("nodes", node)
+        z = n.metadata.labels.get(ZONE)
+        counts[z] = counts.get(z, 0) + 1
+    return counts
+
+
+# ---------------------------------------------------------------------------
+# placement semantics
+# ---------------------------------------------------------------------------
+
+
+class TestSpreadSemantics:
+    def test_hard_spread_gang_within_max_skew(self):
+        h = zoned_cluster(Harness(ALLOC_CONF), zones=3, per_zone=2)
+        h.add("podgroups", pg("pg1", "c1", "q1", 6))
+        h.add("pods", *[spread_pod("c1", f"p{t}", "pg1")
+                        for t in range(6)])
+        h.run_actions("enqueue", "allocate").close_session()
+        assert len(h.binds) == 6
+        counts = _zone_counts(h)
+        assert max(counts.values()) - min(counts.values()) <= 1
+        assert None not in counts
+
+    def test_hard_spread_excludes_unlabeled_nodes(self):
+        # one tiny labeled zone + big unlabeled nodes: the constrained
+        # pods must all land on the labeled node and the rest stay
+        # pending (upstream PodTopologySpread: absent label never
+        # satisfies)
+        h = zoned_cluster(Harness(ALLOC_CONF), zones=1, per_zone=1,
+                          cpu="2", unlabeled=3)
+        h.add("podgroups", pg("pg1", "c1", "q1", 2))
+        h.add("pods", *[spread_pod("c1", f"p{t}", "pg1")
+                        for t in range(4)])
+        h.run_actions("enqueue", "allocate").close_session()
+        for key, node in h.binds.items():
+            labels = h.store.get("nodes", node).metadata.labels
+            assert ZONE in labels, f"{key} bound to unlabeled {node}"
+
+    def test_anti_affinity_pair_distinct_zones(self):
+        h = zoned_cluster(Harness(ALLOC_CONF), zones=2, per_zone=2)
+        h.add("podgroups", pg("pg1", "c1", "q1", 2))
+        h.add("pods", anti_pod("c1", "p0", "pg1"),
+              anti_pod("c1", "p1", "pg1"))
+        h.run_actions("enqueue", "allocate").close_session()
+        assert len(h.binds) == 2
+        counts = _zone_counts(h)
+        assert counts == {"zone-0": 1, "zone-1": 1}
+
+    def test_anti_affinity_replica_beyond_domains_stays_pending(self):
+        # 3 replicas over 2 zones with min_available=2: two place (one
+        # per zone), the third compiles to an all-false row and pends
+        h = zoned_cluster(Harness(ALLOC_CONF), zones=2, per_zone=2)
+        h.add("podgroups", pg("pg1", "c1", "q1", 2))
+        h.add("pods", *[anti_pod("c1", f"p{t}", "pg1")
+                        for t in range(3)])
+        h.run_actions("enqueue", "allocate").close_session()
+        assert len(h.binds) == 2
+        assert max(_zone_counts(h).values()) == 1
+
+    def test_soft_spread_prefers_least_loaded_zone(self):
+        # zone-0 already carries a SIBLING (the empty selector spreads a
+        # job against its own assigned tasks); with no other score
+        # plugins the tie-break alone would pick n0, so a zone-1 bind
+        # proves the soft-spread penalty moved the choice
+        conf = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: proportion
+"""
+        h = zoned_cluster(Harness(conf), zones=2, per_zone=1)
+        h.add("podgroups", pg("pg1", "c1", "q1", 1))
+        h.add("pods",
+              build_pod("c1", "r0", "n0", "Running", RL1, "pg1"),
+              spread_pod("c1", "p0", "pg1", mode="ScheduleAnyway"))
+        h.run_actions("enqueue", "allocate").close_session()
+        assert h.binds["c1/p0"] == "n1"
+
+    def test_spread_skew_respected_against_existing_residents(self):
+        # zone-0 holds 2 residents of the SAME job; a hard-spread
+        # max_skew=1 sibling burst must fill the other zones first
+        h = zoned_cluster(Harness(ALLOC_CONF), zones=3, per_zone=2)
+        h.add("podgroups", pg("pg1", "c1", "q1", 2))
+        h.add("pods",
+              build_pod("c1", "r0", "n0", "Running", RL1, "pg1"),
+              build_pod("c1", "r1", "n1", "Running", RL1, "pg1"),
+              spread_pod("c1", "p0", "pg1"), spread_pod("c1", "p1", "pg1"))
+        h.run_actions("enqueue", "allocate").close_session()
+        assert len(h.binds) == 2
+        for key in ("c1/p0", "c1/p1"):
+            z = h.store.get("nodes",
+                            h.binds[key]).metadata.labels.get(ZONE)
+            assert z != "zone-0", f"{key} stacked onto the loaded zone"
+
+
+class TestTieredPacking:
+    def test_high_priority_packs_toward_high_tier_node(self):
+        conf = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+    arguments:
+      tieredpack.weight: "10.0"
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+        h = Harness(conf)
+        h.add("queues", build_queue("q1"))
+        h.add("priorityclasses",
+              PriorityClass(metadata=ObjectMeta(name="high"), value=1000),
+              PriorityClass(metadata=ObjectMeta(name="low"), value=1))
+        h.add("nodes",
+              build_node("n0", build_resource_list("8", "8Gi")),
+              build_node("n1", build_resource_list("8", "8Gi")))
+        h.add("podgroups",
+              pg("pg-lo", "c1", "q1", 1, priority_class="low"),
+              pg("pg-hi", "c1", "q1", 1, priority_class="high"),
+              pg("pg-new", "c1", "q1", 1, priority_class="high"))
+        h.add("pods",
+              build_pod("c1", "lo0", "n0", "Running", RL1, "pg-lo"),
+              build_pod("c1", "hi0", "n1", "Running", RL1, "pg-hi"),
+              build_pod("c1", "p0", "", "Pending", RL1, "pg-new"))
+        h.run_actions("enqueue", "allocate").close_session()
+        # n1 hosts the high tier, n0 the low tier: the high-priority
+        # arrival aligns with its own tier
+        assert h.binds["c1/p0"] == "n1"
+
+
+# ---------------------------------------------------------------------------
+# compiled-vs-reference parity
+# ---------------------------------------------------------------------------
+
+
+def _constraint_heavy_binds(conf, n_nodes=24, n_jobs=18, gang=4):
+    from volcano_tpu.utils.synth import populate_store
+    h = Harness(conf)
+    populate_store(h.store, n_nodes=n_nodes, n_jobs=n_jobs,
+                   gang_size=gang, cpu_req="2", mem_req="4Gi",
+                   node_cpu="8", node_mem="16Gi",
+                   zones=4, spread_every=3, anti_every=4)
+    h.run_actions("enqueue", "allocate").close_session()
+    return dict(h.binds)
+
+
+class TestCompiledParity:
+    def test_compiled_equals_reference_binds(self):
+        compiled = _constraint_heavy_binds(ALLOC_CONF)
+        reference = _constraint_heavy_binds(ALLOC_REFERENCE_CONF)
+        assert compiled, "constraint-heavy populate produced no binds"
+        assert compiled == reference
+
+    def test_compiled_double_run_deterministic(self):
+        assert _constraint_heavy_binds(ALLOC_CONF) \
+            == _constraint_heavy_binds(ALLOC_CONF)
+
+    def test_compiled_path_provably_ran(self):
+        c0 = m.counter_total(m.CONSTRAINT_BUILD_RUNS, mode="compiled")
+        _constraint_heavy_binds(ALLOC_CONF)
+        c1 = m.counter_total(m.CONSTRAINT_BUILD_RUNS, mode="compiled")
+        assert c1 > c0
+
+    def test_reference_path_provably_ran(self):
+        r0 = m.counter_total(m.CONSTRAINT_BUILD_RUNS, mode="reference")
+        _constraint_heavy_binds(ALLOC_REFERENCE_CONF)
+        r1 = m.counter_total(m.CONSTRAINT_BUILD_RUNS, mode="reference")
+        assert r1 > r0
+
+    def test_compile_crash_falls_back_to_reference(self, monkeypatch):
+        def boom(ssn, batch, narr):
+            raise RuntimeError("forced compile crash")
+        monkeypatch.setattr(constraints, "compile_mask", boom)
+        f0 = m.counter_total(m.CONSTRAINT_FALLBACK)
+        crashed = _constraint_heavy_binds(ALLOC_CONF)
+        assert m.counter_total(m.CONSTRAINT_FALLBACK) > f0
+        monkeypatch.undo()
+        assert crashed == _constraint_heavy_binds(ALLOC_REFERENCE_CONF)
+
+    def test_assignment_crash_excludes_constrained_jobs(self, monkeypatch):
+        """Every lowering (compiled AND split reference) consumes the
+        slot assignments, so a deterministic crash in the assignment
+        itself has no other path to fall back to: the constrained jobs
+        are excluded for the cycle (pending, like an unsatisfiable
+        slot) while unconstrained work keeps scheduling."""
+        def boom(*a, **kw):
+            raise RuntimeError("forced assignment crash")
+        monkeypatch.setattr(constraints, "assign_spread_slots", boom)
+        f0 = m.counter_total(m.CONSTRAINT_FALLBACK)
+        h = zoned_cluster(Harness(ALLOC_CONF), zones=2, per_zone=2)
+        h.add("podgroups", pg("plain", "c1", "q1", 2))
+        h.add("pods", *[build_pod("c1", f"u{t}", "", "Pending", RL1,
+                                  "plain") for t in range(2)])
+        h.add("podgroups", pg("spread", "c1", "q1", 2))
+        h.add("pods", *[spread_pod("c1", f"s{t}", "spread")
+                        for t in range(2)])
+        h.run_actions("enqueue", "allocate").close_session()
+        assert m.counter_total(m.CONSTRAINT_FALLBACK) > f0
+        assert set(h.binds) == {"c1/u0", "c1/u1"}
+
+    def test_score_crash_drops_score_not_cycle(self, monkeypatch):
+        # the additive score is a preference: a compile crash degrades
+        # to no score for the cycle (logged fallback), never aborts it
+        def boom(*a, **kw):
+            raise RuntimeError("forced score crash")
+        monkeypatch.setattr(constraints, "compile_score", boom)
+        f0 = m.counter_total(m.CONSTRAINT_FALLBACK)
+        binds = _constraint_heavy_binds(ALLOC_CONF)
+        assert binds, "cycle aborted on a score-compile crash"
+        assert m.counter_total(m.CONSTRAINT_FALLBACK) > f0
+
+    def test_compiled_masks_on_forced_mesh_equal_single_device(self):
+        """The sharded slot path (with_slots kernels + the ShardPlan
+        node-axis gather of slot_ok) is the production default at scale
+        but below mesh.min_nodes in every other gate — force the mesh
+        on a constraint-heavy cluster and require bind-for-bind parity
+        with the single-device run."""
+        mesh_conf = ALLOC_CONF + """
+configurations:
+- name: solver
+  arguments:
+    mesh.enable: "true"
+    mesh.devices: 8
+"""
+
+        def build(conf):
+            h = zoned_cluster(Harness(conf), zones=4, per_zone=8)
+            for j in range(6):
+                h.add("podgroups", pg(f"sp-{j}", "c1", "q1", 4))
+                h.add("pods", *[spread_pod("c1", f"sp{j}-{t}", f"sp-{j}")
+                                for t in range(4)])
+                h.add("podgroups", pg(f"an-{j}", "c1", "q1", 2))
+                h.add("pods", *[anti_pod("c1", f"an{j}-{t}", f"an-{j}")
+                                for t in range(2)])
+            h.open_session()
+            h.run_actions("enqueue", "allocate")
+            return h
+
+        s0 = m.counter_total(m.SOLVER_KERNEL_RUNS, kernel="sharded")
+        h1 = build(mesh_conf)
+        assert h1.ssn.solver.mesh is not None
+        assert m.counter_total(m.SOLVER_KERNEL_RUNS,
+                               kernel="sharded") > s0
+        h1.close_session()
+        h2 = build(ALLOC_CONF)
+        assert h2.ssn.solver.mesh is None
+        h2.close_session()
+        assert h1.binds, "mesh constraint scenario produced no binds"
+        assert h1.binds == h2.binds
+        assert max(_zone_counts(h1, pods_prefix="/sp").values()) \
+            - min(_zone_counts(h1, pods_prefix="/sp").values()) <= 1
+
+    def test_mask_tensor_parity_direct(self):
+        """compile_mask vs reference_mask on a live session's own batch:
+        cell-for-cell equality over the real node rows."""
+        from volcano_tpu.utils.synth import populate_store
+        h = Harness(ALLOC_CONF)
+        populate_store(h.store, n_nodes=12, n_jobs=8, gang_size=4,
+                       cpu_req="2", mem_req="4Gi", node_cpu="8",
+                       node_mem="16Gi", zones=3, spread_every=2,
+                       anti_every=3)
+        ssn = h.open_session()
+        solver = ssn.solver
+        ordered = [(job, [t for t in job.tasks.values()
+                          if t.status == TaskStatus.Pending])
+                   for job in ssn.jobs.values()]
+        ordered = [(j, ts) for j, ts in ordered if ts]
+        from volcano_tpu.models.arrays import NodeArrays, TaskBatch
+        narr = NodeArrays.build(ssn.nodes,
+                                [n.name for n in ssn.node_list],
+                                solver.rindex)
+        constraints.assign_spread_slots(ssn, ordered, narr.names)
+        # no sig_override/feature-pair lowering here: both passes read
+        # the same merged groups' dense slot rows — the parity surface
+        batch = TaskBatch.build(ordered, solver.rindex)
+        compiled = constraints.compile_mask(ssn, batch, narr)
+        reference = constraints.reference_mask(ssn, batch, narr)
+        n = len(narr.names)
+        if compiled is None or reference is None:
+            assert compiled is None and reference is None
+        else:
+            np.testing.assert_array_equal(
+                compiled[:batch.n_groups, :n],
+                reference[:batch.n_groups, :n])
+        h.close_session()
+
+
+# ---------------------------------------------------------------------------
+# victim-selection kernel parity
+# ---------------------------------------------------------------------------
+
+
+def _preempt_cluster(conf, n_nodes=6):
+    h = Harness(conf)
+    h.add("queues", build_queue("q1"))
+    h.add("priorityclasses",
+          PriorityClass(metadata=ObjectMeta(name="high"), value=1000),
+          PriorityClass(metadata=ObjectMeta(name="low"), value=1))
+    for i in range(n_nodes):
+        h.add("nodes", build_node(f"n{i}", build_resource_list("4", "4Gi")))
+    # elastic low-priority residents filling the cluster (min_available
+    # below size so the gang plugin admits victims)
+    for j in range(n_nodes):
+        h.add("podgroups", pg(f"lo-{j}", "c1", "q1", 2,
+                              priority_class="low"))
+        for t in range(4):
+            h.add("pods", build_pod("c1", f"lo{j}-{t}", f"n{j}",
+                                    "Running", RL1, f"lo-{j}"))
+    # high-priority preemptor gangs
+    for j in range(3):
+        h.add("podgroups", pg(f"hi-{j}", "c1", "q1", 2,
+                              priority_class="high"))
+        for t in range(2):
+            h.add("pods", build_pod("c1", f"hi{j}-{t}", "", "Pending",
+                                    RL1, f"hi-{j}"))
+    return h
+
+
+def _reclaim_cluster(conf, n_nodes=4):
+    h = Harness(conf)
+    h.add("queues", build_queue("q1", weight=1), build_queue("q2", weight=1))
+    for i in range(n_nodes):
+        h.add("nodes", build_node(f"n{i}", build_resource_list("3", "3Gi")))
+    for j in range(n_nodes):
+        h.add("podgroups", pg(f"own-{j}", "c1", "q1", 1))
+        for t in range(3):
+            h.add("pods", build_pod("c1", f"own{j}-{t}", f"n{j}",
+                                    "Running", RL1, f"own-{j}"))
+    for j in range(2):
+        h.add("podgroups", pg(f"rc-{j}", "c1", "q2", 1))
+        for t in range(2):
+            h.add("pods", build_pod("c1", f"rc{j}-{t}", "", "Pending",
+                                    RL1, f"rc-{j}"))
+    return h
+
+
+class TestVictimKernelParity:
+    def test_preempt_kernel_equals_python_walk(self):
+        k0 = m.counter_total(m.VICTIM_SELECT_RUNS, mode="kernel")
+        h1 = _preempt_cluster(PREEMPT_CONF)
+        h1.run_actions("preempt").close_session()
+        assert m.counter_total(m.VICTIM_SELECT_RUNS, mode="kernel") > k0
+        h2 = _preempt_cluster(_walk_off(PREEMPT_CONF))
+        h2.run_actions("preempt").close_session()
+        assert h1.evicts, "preempt scenario produced no evictions"
+        assert h1.evicts == h2.evicts
+
+    def test_multi_tier_preempt_kernel_equals_python_walk(self):
+        """Two-tier vectorizable chain: the tier dispatch couples nodes
+        (an eviction can ACTIVATE another node's tier-2 rows), so the
+        kernel's serve-rejection flags reset wholesale on events instead
+        of riding the single-tier monotonicity argument — parity must
+        hold through a multi-eviction storm."""
+        conf = """
+actions: "preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: conformance
+"""
+        k0 = m.counter_total(m.VICTIM_SELECT_RUNS, mode="kernel")
+        h1 = _preempt_cluster(conf)
+        h1.run_actions("preempt").close_session()
+        assert m.counter_total(m.VICTIM_SELECT_RUNS, mode="kernel") > k0
+        h2 = _preempt_cluster(_walk_off(conf))
+        h2.run_actions("preempt").close_session()
+        assert h1.evicts, "preempt scenario produced no evictions"
+        assert h1.evicts == h2.evicts
+
+    def test_reclaim_kernel_equals_python_walk(self):
+        k0 = m.counter_total(m.VICTIM_SELECT_RUNS, mode="kernel")
+        h1 = _reclaim_cluster(RECLAIM_CONF)
+        h1.run_actions("reclaim").close_session()
+        assert m.counter_total(m.VICTIM_SELECT_RUNS, mode="kernel") > k0
+        h2 = _reclaim_cluster(_walk_off(RECLAIM_CONF))
+        h2.run_actions("reclaim").close_session()
+        assert h1.evicts, "reclaim scenario produced no evictions"
+        assert h1.evicts == h2.evicts
+
+    def test_kernel_crash_falls_back_to_walk(self, monkeypatch):
+        from volcano_tpu.ops.victims import VictimKernel
+
+        def boom(self, *a, **kw):
+            raise RuntimeError("forced kernel crash")
+        monkeypatch.setattr(VictimKernel, "place", boom)
+        p0 = m.counter_total(m.VICTIM_SELECT_RUNS, mode="python")
+        h1 = _preempt_cluster(PREEMPT_CONF)
+        h1.run_actions("preempt").close_session()
+        assert m.counter_total(m.VICTIM_SELECT_RUNS, mode="python") > p0
+        monkeypatch.undo()
+        h2 = _preempt_cluster(_walk_off(PREEMPT_CONF))
+        h2.run_actions("preempt").close_session()
+        assert h1.evicts == h2.evicts
+
+    def test_unvectorizable_chain_uses_python_walk(self):
+        # drf has no closed per-victim form: its presence in the tier
+        # must route the action through the Python walk untouched
+        conf = """
+actions: "preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: conformance
+  - name: gang
+  - name: drf
+- plugins:
+  - name: predicates
+  - name: nodeorder
+"""
+        k0 = m.counter_total(m.VICTIM_SELECT_RUNS, mode="kernel")
+        p0 = m.counter_total(m.VICTIM_SELECT_RUNS, mode="python")
+        h = _preempt_cluster(conf)
+        h.run_actions("preempt").close_session()
+        assert m.counter_total(m.VICTIM_SELECT_RUNS, mode="kernel") == k0
+        assert m.counter_total(m.VICTIM_SELECT_RUNS, mode="python") > p0
+
+
+# ---------------------------------------------------------------------------
+# persistent node-side state
+# ---------------------------------------------------------------------------
+
+
+class TestPersistentState:
+    def test_sync_refreshes_all_then_none(self):
+        h = zoned_cluster(Harness(ALLOC_CONF), zones=2, per_zone=2)
+        h.add("podgroups", pg("pg1", "c1", "q1", 2))
+        h.add("pods", *[spread_pod("c1", f"p{t}", "pg1")
+                        for t in range(2)])
+        ssn = h.open_session()
+        state = constraints.constraint_state(h.cache)
+        names = [n.name for n in ssn.node_list]
+        assert constraints._sync_state(state, ssn, names) == len(names)
+        assert constraints._sync_state(state, ssn, names) == 0
+        state.pending.add(names[0])
+        assert constraints._sync_state(state, ssn, names) == 1
+        # a structural change (node order) forces the wholesale rebuild
+        state.force_full = True
+        assert constraints._sync_state(state, ssn, names) == len(names)
+        h.close_session()
+
+    def test_legacy_mode_resyncs_relabeled_node(self):
+        """Non-incremental caches (Harness default) have no dirty-set
+        feed: every cycle must force the full row rebuild, or a node
+        relabeled between cycles keeps its stale topology code and the
+        compiled anti/spread masks admit the wrong nodes."""
+        h = zoned_cluster(Harness(ALLOC_CONF), zones=3, per_zone=1)
+        h.add("podgroups", pg("pg1", "c1", "q1", 3))
+        h.add("pods", *[anti_pod("c1", f"a{t}", "pg1") for t in range(3)])
+        h.run_actions("enqueue", "allocate").close_session()
+        assert len(h.binds) == 3   # one per zone; the state is synced
+        # relabel n2 zone-2 -> zone-0: the cluster now has TWO zones
+        n2 = h.store.get("nodes", "n2")
+        n2.metadata.labels = {ZONE: "zone-0"}
+        h.store.update("nodes", n2)
+        h.add("podgroups", pg("pg2", "c1", "q1", 2))
+        h.add("pods", *[anti_pod("c1", f"b{t}", "pg2") for t in range(3)])
+        h.run_actions("enqueue", "allocate").close_session()
+        # only 2 of 3 replicas have a distinct zone left; a stale
+        # zone row would admit n2 as "zone-2" and bind all 3
+        pg2_binds = {k: v for k, v in h.binds.items() if "/b" in k}
+        assert len(pg2_binds) == 2
+        assert max(_zone_counts(h, pods_prefix="/b").values()) == 1
+
+    def test_vanished_domain_not_assigned(self):
+        """The persistent topology vocab only ever grows (codes must
+        stay stable for the cached rows) — but the slot splitter must
+        only assign LIVE domains, or a zone that vanished via relabel
+        keeps winning the greedy balance with its zero count and pins a
+        replica to an all-false row, holding the gang pending forever."""
+        h = zoned_cluster(Harness(ALLOC_CONF), zones=4, per_zone=2)
+        h.add("podgroups", pg("warm", "c1", "q1", 1))
+        h.add("pods", spread_pod("c1", "w0", "warm"))
+        h.run_actions("enqueue", "allocate").close_session()
+        assert len(h.binds) == 1   # vocab warmed over all 4 zones
+        # zone-3 vanishes: its nodes relabel into zone-0
+        for name in ("n6", "n7"):
+            nd = h.store.get("nodes", name)
+            nd.metadata.labels = {ZONE: "zone-0"}
+            h.store.update("nodes", nd)
+        h.add("podgroups", pg("pg2", "c1", "q1", 4))
+        h.add("pods", *[spread_pod("c1", f"s{t}", "pg2")
+                        for t in range(4)])
+        h.run_actions("enqueue", "allocate").close_session()
+        # 4 replicas over the 3 LIVE zones = 2+1+1, within max_skew 1
+        s_binds = {k: v for k, v in h.binds.items() if "/s" in k}
+        assert len(s_binds) == 4
+        zc = _zone_counts(h, pods_prefix="/s")
+        assert "zone-3" not in zc
+        assert max(zc.values()) - min(zc.values()) <= 1
+
+    def test_topo_rows_persist_across_syncs(self):
+        h = zoned_cluster(Harness(ALLOC_CONF), zones=2, per_zone=1)
+        h.add("podgroups", pg("pg1", "c1", "q1", 1))
+        h.add("pods", spread_pod("c1", "p0", "pg1"))
+        ssn = h.open_session()
+        state = constraints.constraint_state(h.cache)
+        names = [n.name for n in ssn.node_list]
+        constraints._sync_state(state, ssn, names)
+        row1, vocab1 = constraints._topo_row(state, ssn, names, ZONE)
+        constraints._sync_state(state, ssn, names)
+        row2, _ = constraints._topo_row(state, ssn, names, ZONE)
+        assert row1 is row2   # the persistent row, not a rebuild
+        assert sorted(vocab1) == ["zone-0", "zone-1"]
+        h.close_session()
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-v"]))

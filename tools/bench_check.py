@@ -1,8 +1,15 @@
 """Bench regression gate: compare a fresh bench row against a baseline.
 
-    python tools/bench_check.py                         # BENCH_r09 vs r08
-    python tools/bench_check.py --row BENCH_r09.json \
-        --baseline BENCH_r08.json --tolerance 0.35
+    python tools/bench_check.py                         # BENCH_r10 vs r09
+    python tools/bench_check.py --row BENCH_r10.json \
+        --baseline BENCH_r09.json --tolerance 0.35
+
+Round 10 adds the constraint columns (required on every fresh row): the
+constraint-heavy 50k x 10k kernel must stay <= 1.5x the unconstrained
+kernel of the same capture, the vmapped victim-selection kernel must
+beat the Python walk on the preempt-action A/B and must have provably
+engaged (victim_kernel_runs > 0), and constraint_build_ms must be
+reported (docs/design/constraints.md).
 
 Round 9 moved the headline to the 10x shape (500k tasks x 50k nodes,
 sharded kernel as the auto-selected production default). When the fresh
@@ -56,7 +63,12 @@ GATED_KEYS = (("value", None, "full cycle ms", 0.0),
               ("kernel_ms", None, "placement kernel ms", 0.0),
               ("steady_state_ms", None, "steady-state cycle ms", 0.0),
               ("flush_wall_ms", "bind_flush_ms", "flush wall ms", 0.70),
-              ("bind_flush_ms", "bind_flush_ms", "bind flush ms", 0.70))
+              ("bind_flush_ms", "bind_flush_ms", "bind flush ms", 0.70),
+              # the PodGroup status writeback — batched through
+              # patch_batch in round 10, so it must not regress back to
+              # the per-group commit shape (the largest flush_wall
+              # residue before the batching)
+              ("status_writeback_ms", None, "status writeback ms", 0.70))
 
 # the r05 box's documented calibration fingerprint (bench_suite
 # machine_calibration docstring: round-5 observed ~32-40 ms)
@@ -77,6 +89,16 @@ BIND_FLUSH_TARGET_MS = 800.0
 # a churn-heavy measurement would not be the steady-state claim.
 INCR_TARGET_MS = 20.0
 INCR_MAX_DIRTY_FRACTION = 0.01
+
+# constraint-kernel budget (round 10, docs/design/constraints.md): the
+# constraint-heavy 50k x 10k placement kernel (zoned nodes, hard-spread
+# gangs, one-per-zone anti pairs — bench.py's constraint worker) must
+# stay within 1.5x the unconstrained kernel of the SAME capture — the
+# whole point of lowering constraints to precomputed mask/score tensors
+# is that they ride the vmapped kernel at near-zero marginal cost. The
+# vmapped victim-selection kernel must also beat the Python walk on the
+# preempt-action A/B, and must have provably run (victim_kernel_runs).
+CONSTRAINED_KERNEL_FACTOR = 1.5
 
 # -- 10x-shape gate (round 9, docs/design/sharded_kernel.md) -----------------
 METRIC_10X = "schedule_cycle_latency_500k_tasks_x_50k_nodes"
@@ -113,6 +135,64 @@ def load_row(path: str) -> dict:
 def current_calibration() -> float:
     from volcano_tpu.bench_suite import machine_calibration
     return float(machine_calibration()["value_ms"])
+
+
+def check_constraints(fresh: dict, failures: list) -> None:
+    """The round-10 constraint columns (bench.py's constraint worker at
+    the canonical 50k x 10k shape): required on every fresh row, with
+    the constrained-kernel and victim-selection budgets enforced."""
+    required = ("kernel_unconstrained_ms", "kernel_constrained_ms",
+                "constraint_build_ms", "victim_select_kernel_ms",
+                "victim_select_python_ms", "victim_kernel_runs")
+    missing = [k for k in required if fresh.get(k) is None]
+    if missing:
+        failures.append(
+            f"constraint columns missing: {', '.join(missing)} — the "
+            "round-10 constraint worker did not run (re-run `python "
+            "bench.py`)")
+        return
+    unc = float(fresh["kernel_unconstrained_ms"])
+    con = float(fresh["kernel_constrained_ms"])
+    budget = unc * CONSTRAINED_KERNEL_FACTOR
+    verdict = "ok" if con <= budget else "REGRESSION"
+    print(f"  {'constrained kernel ms':<24} {con:9.1f} vs budget "
+          f"{budget:9.1f} (unconstrained {unc:9.1f} "
+          f"x{CONSTRAINED_KERNEL_FACTOR}) {verdict}")
+    if verdict != "ok":
+        failures.append(
+            f"constrained kernel: {con:.1f} ms > {budget:.1f} ms "
+            f"({CONSTRAINED_KERNEL_FACTOR}x the {unc:.1f} ms "
+            f"unconstrained kernel) — constraint tensors are no longer "
+            f"near-free in the vmapped kernel")
+    print(f"  {'constraint build ms':<24} "
+          f"{float(fresh['constraint_build_ms']):9.1f} (informational)")
+    vk = float(fresh["victim_select_kernel_ms"])
+    vp = float(fresh["victim_select_python_ms"])
+    verdict = "ok" if vk < vp else "REGRESSION"
+    print(f"  {'victim select (kernel)':<24} {vk:9.1f} vs python "
+          f"{vp:9.1f} {verdict}")
+    if verdict != "ok":
+        failures.append(
+            f"victim selection: kernel {vk:.1f} ms is not faster than "
+            f"the Python walk {vp:.1f} ms")
+    if not fresh.get("victim_kernel_runs"):
+        failures.append("victim_kernel_runs is 0 — the vmapped "
+                        "victim-selection kernel never engaged in the "
+                        "preempt A/B")
+    # both legs must actually evict, identically (the kernel is
+    # bit-identical to the walk): a no-op scenario measures noise.
+    # Absent on pre-gate rows — required only when either leg reports.
+    ek = fresh.get("victim_evictions_kernel")
+    ep = fresh.get("victim_evictions_python")
+    if ek is not None or ep is not None:
+        if not ek or not ep:
+            failures.append("a victim-selection A/B leg evicted nothing "
+                            f"(kernel={ek}, python={ep}) — the synthetic "
+                            "preempt scenario went stale")
+        elif ek != ep:
+            failures.append(f"victim-selection eviction counts diverge "
+                            f"(kernel={ek}, python={ep}) — kernel/walk "
+                            "parity broke in the bench scenario")
 
 
 def check(fresh: dict, baseline: dict, tolerance: float,
@@ -219,6 +299,7 @@ def check(fresh: dict, baseline: dict, tolerance: float,
         failures.append("backend_probe missing — the row predates the "
                         "instrumented pre-probe (re-run `python "
                         "bench.py`)")
+    check_constraints(fresh, failures)
     if failures:
         print("bench-check: FAIL")
         for fmsg in failures:
@@ -328,14 +409,16 @@ def check_10x(fresh: dict, tolerance: float, fresh_cal: float,
             failures.append(
                 f"dirty_fraction {dirty} > {INCR_MAX_DIRTY_FRACTION} — "
                 "not measured at steady state")
-    # the flush residue split (round 9): its own budget lines must be
-    # present so the commit-path tail stays attributable at this shape
+    # the flush residue split (round 9): both lines must be present so
+    # the commit-path tail stays attributable at this shape; the status
+    # writeback additionally carries a same-shape budget via GATED_KEYS
+    # (round 10 batched it through patch_batch)
     for key in ("status_writeback_ms", "snapshot_prebuild_ms"):
         val = fresh.get(key)
         if val is None:
             failures.append(f"{key} missing — the flush residue split "
                             "(round 9) is required on 10x rows")
-        else:
+        elif key == "snapshot_prebuild_ms" or not same_shape:
             print(f"  {key:<24} {float(val):9.1f} (informational)")
     for key in ("value", "bind_flush_ms", "flush_wall_ms"):
         val = fresh.get(key)
@@ -364,6 +447,7 @@ def check_10x(fresh: dict, tolerance: float, fresh_cal: float,
               f"last_phase={probe.get('last_phase')!r} "
               f"root_cause={'yes' if probe.get('root_cause') else 'no'} "
               f"ok")
+    check_constraints(fresh, failures)
     if failures:
         print("bench-check: FAIL")
         for fmsg in failures:
@@ -375,10 +459,10 @@ def check_10x(fresh: dict, tolerance: float, fresh_cal: float,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--row", default=os.path.join(REPO, "BENCH_r09.json"),
+    ap.add_argument("--row", default=os.path.join(REPO, "BENCH_r10.json"),
                     help="fresh bench row (bench.py writes it)")
     ap.add_argument("--baseline",
-                    default=os.path.join(REPO, "BENCH_r08.json"))
+                    default=os.path.join(REPO, "BENCH_r09.json"))
     ap.add_argument("--tolerance", type=float, default=0.35,
                     help="allowed fractional slowdown after calibration "
                          "scaling (shared-box noise is ±15-25%%)")

@@ -118,11 +118,13 @@ def _run_cycle(cache, conf) -> float:
 
 
 def _populate(store, n_nodes, n_jobs, gang, queues=None, cpu="2",
-              mem="4Gi", node_cpu="64", node_mem="256Gi"):
+              mem="4Gi", node_cpu="64", node_mem="256Gi", **constraints):
+    """``constraints`` forwards populate_store's constraint-shape kwargs
+    (zones / spread_every / anti_every — docs/design/constraints.md)."""
     from volcano_tpu.utils.synth import populate_store
     populate_store(store, n_nodes=n_nodes, n_jobs=n_jobs, gang_size=gang,
                    queues=queues, cpu_req=cpu, mem_req=mem,
-                   node_cpu=node_cpu, node_mem=node_mem)
+                   node_cpu=node_cpu, node_mem=node_mem, **constraints)
 
 
 

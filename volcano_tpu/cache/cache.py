@@ -1806,10 +1806,24 @@ class SchedulerCache(EventHandlersMixin):
 
     def _update_job_statuses(self, updates) -> None:
         push = []
+        conditions: list = []
         for job, update_pg in updates:
-            self.record_job_status_event(job)
+            self.record_job_status_event(job, condition_sink=conditions)
             if update_pg and job.pod_group is not None:
                 push.append(job)
+        if conditions:
+            # ONE bulk commit for the whole session's Unschedulable
+            # condition writes (same order the per-pod loop produced) —
+            # at the 10x shape the per-pod get+update round trips were
+            # the dominant status-writeback cost
+            bulk_cond = getattr(self.status_updater,
+                                "update_pod_conditions", None)
+            if bulk_cond is not None:
+                bulk_cond(conditions)
+            else:
+                for pod, reason, message in conditions:
+                    self.status_updater.update_pod_condition(
+                        pod, reason, message)
         if not push:
             return
         bulk = getattr(self.status_updater, "update_pod_groups", None)
@@ -1825,9 +1839,13 @@ class SchedulerCache(EventHandlersMixin):
                 job.pod_group = pg
                 job.pod_group_owned = True
 
-    def record_job_status_event(self, job: JobInfo) -> None:
+    def record_job_status_event(self, job: JobInfo,
+                                condition_sink: Optional[list] = None) -> None:
         """Pending-not-ready jobs get FailedScheduling events on their
-        unscheduled tasks (cache.go:659-698)."""
+        unscheduled tasks (cache.go:659-698). With ``condition_sink``,
+        the per-pod Unschedulable condition writes are collected as
+        ``(pod, reason, message)`` for the caller's bulk push instead of
+        being written one get+update round trip at a time."""
         if job.pod_group is None:
             return
         phase = job.pod_group.status.phase
@@ -1841,8 +1859,12 @@ class SchedulerCache(EventHandlersMixin):
                     reason = fit_errors.error() if fit_errors is not None else msg
                     self.store.record_event("pods", task.pod, "Warning",
                                             "FailedScheduling", reason)
-                    self.status_updater.update_pod_condition(
-                        task.pod, "Unschedulable", reason)
+                    if condition_sink is not None:
+                        condition_sink.append(
+                            (task.pod, "Unschedulable", reason))
+                    else:
+                        self.status_updater.update_pod_condition(
+                            task.pod, "Unschedulable", reason)
 
     def update_scheduler_numa_info(self, node_res_sets: Dict[str, Dict[str, set]]) -> None:
         """Write allocated NUMA sets back (numaaware plugin session close)."""

@@ -18,9 +18,18 @@ SSH_ABS_PATH = "/root/.ssh"
 
 
 def generate_rsa_key() -> Dict[str, bytes]:
-    """ssh.go:168-199 — 1024-bit RSA keypair + authorized_keys."""
-    from cryptography.hazmat.primitives import serialization
-    from cryptography.hazmat.primitives.asymmetric import rsa
+    """ssh.go:168-199 — 1024-bit RSA keypair + authorized_keys.
+
+    Prefers the ``cryptography`` package; containers without it fall back
+    to the dependency-free implementation (utils/rsa_fallback.py) — same
+    serialized forms, so consumers can't tell which produced the Secret.
+    """
+    try:
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+    except ImportError:
+        from ....utils.rsa_fallback import generate_keypair
+        return generate_keypair(1024)
     key = rsa.generate_private_key(public_exponent=65537, key_size=1024)
     private_pem = key.private_bytes(
         encoding=serialization.Encoding.PEM,

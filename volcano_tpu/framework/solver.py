@@ -95,14 +95,17 @@ def _get_mesh(devices):
     return mesh
 
 
-def _get_sharded_fn(mesh, allow_pipeline: bool, ns_live: bool, chunk: int):
+def _get_sharded_fn(mesh, allow_pipeline: bool, ns_live: bool, chunk: int,
+                    with_slots: bool = False):
     key = (tuple(d.id for d in mesh.devices.flat),
-           bool(allow_pipeline), bool(ns_live), int(chunk))
+           bool(allow_pipeline), bool(ns_live), int(chunk),
+           bool(with_slots))
     fn = _sharded_fn_cache.get(key)
     if fn is None:
         from ..ops.sharded import make_sharded_gang_allocate
         fn = make_sharded_gang_allocate(mesh, allow_pipeline=allow_pipeline,
-                                        ns_live=ns_live, chunk=chunk)
+                                        ns_live=ns_live, chunk=chunk,
+                                        with_slots=with_slots)
         _sharded_fn_cache[key] = fn
     return fn
 
@@ -163,6 +166,10 @@ def note_incremental_snapshot(cache, snap) -> None:
         state.drop_sharded()
     else:
         state.pending |= snap.patched_nodes
+    # the constraint compiler's persistent node rows (topology codes,
+    # tier mass) ride the same dirty sets (ops/constraints.py)
+    from ..ops import constraints as _constraints
+    _constraints.note_snapshot(cache, snap)
 
 
 def breaker_state() -> Dict[str, int]:
@@ -482,12 +489,23 @@ class BatchSolver:
                         raise
         return mask
 
-    def _context_arrays(self, ordered_jobs):
+    def _context_arrays(self, ordered_jobs, slot_tensors: bool = False):
         """Shared front half of both context builds: materialize deferred
         placements, then the SoA encodes. The FIRST build of an
         incremental session reuses the persistent NodeArrays with only
         the patched rows re-encoded; later builds in the same cycle see
-        session-mutated nodes and always encode fresh."""
+        session-mutated nodes and always encode fresh.
+
+        ``slot_tensors`` (the _place/device path) lowers hard topology
+        spread / self-anti-affinity domains to the kernels' per-task
+        ``task_slot``/``slot_rows`` inputs with groups keeping their
+        BASE sigs — the candidate-table kernels then amortize refreshes
+        across a domain-rotating gang exactly like an unconstrained one.
+        Without it (host contexts, ``constraints.compile: off``, a
+        SLOT_CAP overflow, or a tensor-build crash), the REFERENCE
+        lowering runs: per-domain derived group sigs whose mask rows
+        ride the selector feature pairs — bit-identical placements, per-
+        task refresh cost."""
         ssn = self.ssn
         ssn.materialize()   # deferred placements must be visible to arrays
         narr = None
@@ -497,8 +515,69 @@ class BatchSolver:
         if narr is None:
             narr = NodeArrays.build(ssn.nodes, self._node_order(),
                                     self.rindex)
-        batch = TaskBatch.build(ordered_jobs, self.rindex)
-        feats = PredicateFeatures.build(ssn.nodes, narr, batch)
+        sig_override = None
+        use_tensors = False
+        from ..metrics import metrics as m
+        from ..ops import constraints as _constraints
+        if _constraints.has_constraints(ordered_jobs):
+            use_tensors = slot_tensors \
+                and _constraints.compile_conf(ssn) != "off"
+            if use_tensors:
+                try:
+                    _constraints.assign_spread_slots(
+                        ssn, ordered_jobs, narr.names, split=False)
+                    if _constraints.count_batch_slots(
+                            ssn, ordered_jobs) > _constraints.SLOT_CAP:
+                        use_tensors = False
+                        sig_override = _constraints.derive_sig_overrides(
+                            ssn, ordered_jobs)
+                except Exception:
+                    _logger.exception(
+                        "constraint slot-tensor lowering crashed; falling "
+                        "back to the split reference lowering")
+                    m.inc(m.CONSTRAINT_FALLBACK)
+                    use_tensors = False
+                    sig_override, ordered_jobs = \
+                        _constraints.split_assign_or_exclude(
+                            ssn, ordered_jobs, narr.names)
+            else:
+                sig_override, ordered_jobs = \
+                    _constraints.split_assign_or_exclude(
+                        ssn, ordered_jobs, narr.names)
+        batch = TaskBatch.build(ordered_jobs, self.rindex,
+                                sig_override=sig_override)
+        if use_tensors:
+            try:
+                slot_data = _constraints.build_slot_tensors(ssn, batch,
+                                                            narr)
+            except Exception:
+                # the batch was built on base sigs, which are only sound
+                # with the per-task tensors: rebuild it under the split
+                # reference lowering
+                _logger.exception(
+                    "constraint slot-tensor build crashed; rebuilding "
+                    "the batch under the split reference lowering")
+                m.inc(m.CONSTRAINT_FALLBACK)
+                slot_data = None
+                use_tensors = False
+                sig_override = _constraints.derive_sig_overrides(
+                    ssn, ordered_jobs)
+                batch = TaskBatch.build(ordered_jobs, self.rindex,
+                                        sig_override=sig_override)
+            if slot_data is not None:
+                batch.task_slot, batch.slot_rows = slot_data
+            else:
+                use_tensors = False
+        # slot-assigned domains lower through the selector feature pairs
+        # (compact [G, F] x [F, N] matmul) in split mode, or through the
+        # batch's task_slot/slot_rows kernel inputs in tensor mode;
+        # compile_mask sees the flag and skips its dense slot rows
+        slots = getattr(ssn, "_constraint_slots", None) \
+            if sig_override else None
+        if slots or batch.task_slot is not None:
+            ssn._constraint_slots_lowered = True
+        feats = PredicateFeatures.build(ssn.nodes, narr, batch,
+                                        slot_entries=slots)
         return narr, batch, feats
 
     def _incr_state(self) -> Optional[_IncrNodeState]:
@@ -623,16 +702,20 @@ class BatchSolver:
                     else static_score + contrib
         return gmask, static_score
 
-    def _build_context(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]]):
+    def _build_context(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]],
+                       slot_tensors: bool = False):
         """Snapshot the session's current node state and compute the static
         predicate mask + static score for the batch: (narr, batch, gmask,
         static_score) — the DEVICE formulation (the [G, N] arrays stay on
-        the accelerator; only the small inputs cross the link)."""
+        the accelerator; only the small inputs cross the link).
+        ``slot_tensors`` picks the per-task domain lowering for the
+        placement kernels (see _context_arrays)."""
         with trace.span("build_context"):
-            return self._build_context_inner(ordered_jobs)
+            return self._build_context_inner(ordered_jobs, slot_tensors)
 
-    def _build_context_inner(self, ordered_jobs):
-        narr, batch, feats = self._context_arrays(ordered_jobs)
+    def _build_context_inner(self, ordered_jobs, slot_tensors=False):
+        narr, batch, feats = self._context_arrays(ordered_jobs,
+                                                  slot_tensors=slot_tensors)
         eps = jnp.asarray(self.rindex.eps)
         # capability fit through unique capability rows: clusters have a
         # handful of node shapes, so the [G,N,R] broadcast reduce becomes
@@ -759,7 +842,8 @@ class BatchSolver:
 
     def _place(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]],
                allow_pipeline: bool = True) -> PlacementResult:
-        narr, batch, gmask, static_score = self._build_context(ordered_jobs)
+        narr, batch, gmask, static_score = self._build_context(
+            ordered_jobs, slot_tensors=True)
         eps = jnp.asarray(self.rindex.eps)
 
         # queue fair-share budgets (live Overused gate inside the scan)
@@ -819,11 +903,26 @@ class BatchSolver:
         # breaker-open tiers are skipped until their half-open window
         global _place_counter
         _place_counter += 1
+        # per-task topology-domain inputs (ops/constraints.py): every
+        # kernel consumes the same (task_slot, slot_ok) pair uniformly
+        slot_kwargs = {}
+        if batch.task_slot is not None:
+            slot_kwargs = {"task_slot": jnp.asarray(batch.task_slot),
+                           "slot_ok": jnp.asarray(batch.slot_rows)}
         if self.mesh is not None:
             ladder = [("sharded", None, {})]
         else:
             kernel_fn, kernel_kwargs = self._select_kernel(
                 len(batch.ns_names))
+            if slot_kwargs and kernel_fn.__name__ == "gang_allocate_pallas":
+                # the Pallas TPU kernel has no slot inputs (yet): a
+                # constrained batch runs the chunked XLA kernel instead
+                _log_once("solver kernel=pallas with per-task constraint "
+                          "slots; running the chunked kernel for this "
+                          "batch")
+                from ..ops.allocate import \
+                    gang_allocate_chunked as _chunked
+                kernel_fn, kernel_kwargs = _chunked, {}
             ladder = [(_TIER_OF_KERNEL.get(kernel_fn.__name__, "scan"),
                        kernel_fn, kernel_kwargs)]
         if ladder[0][0] != "scan":
@@ -858,7 +957,7 @@ class BatchSolver:
                             batch, narr, gmask, static_score, task_bucket,
                             pack_bonus, q_deserved, q_alloc0, ns_weight,
                             ns_alloc0, ns_total, ns_live, eps,
-                            allow_pipeline)
+                            allow_pipeline, slot_kwargs=slot_kwargs)
                     else:
                         if kernel_inputs is None:
                             account_transfer = True
@@ -905,11 +1004,13 @@ class BatchSolver:
                                 int(getattr(a, "nbytes", 0))
                                 for i, a in enumerate(kernel_inputs)
                                 if i not in (4, 5, 22, 23, 24, 25, 26))
+                            xfer += sum(int(getattr(a, "nbytes", 0))
+                                        for a in slot_kwargs.values())
                             m.inc(m.DEVICE_TRANSFER_BYTES, float(xfer))
                             trace.add_tags(transfer_bytes=xfer)
                         assign, pipelined, ready, kept, _ = kfn(
                             *kernel_inputs, allow_pipeline=allow_pipeline,
-                            ns_live=ns_live, **kkwargs)
+                            ns_live=ns_live, **slot_kwargs, **kkwargs)
 
                     # blocks until the device finishes (a deferred kernel
                     # crash surfaces here, inside the tier's try)
@@ -1112,7 +1213,8 @@ class BatchSolver:
 
     def _run_sharded(self, batch, narr, gmask, static_score, task_bucket,
                      pack_bonus, q_deserved, q_alloc0, ns_weight, ns_alloc0,
-                     ns_total, ns_live, eps, allow_pipeline):
+                     ns_total, ns_live, eps, allow_pipeline,
+                     slot_kwargs=None):
         """Node-axis-sharded placement over the device mesh: each chip
         owns a topology-aware contiguous node range's scan state (the
         ShardPlan balances per-shard resident-task pressure, not a naive
@@ -1125,8 +1227,10 @@ class BatchSolver:
         d = mesh.devices.size
         plan = self._shard_plan(narr, d)
 
+        with_slots = bool(slot_kwargs)
         fn = _get_sharded_fn(mesh, allow_pipeline, ns_live,
-                             getattr(self, "mesh_chunk", 16))
+                             getattr(self, "mesh_chunk", 16),
+                             with_slots=with_slots)
 
         gn = NamedSharding(mesh, P(None, "nodes"))
         rep = NamedSharding(mesh, P())
@@ -1149,6 +1253,15 @@ class BatchSolver:
         gmask_l = plan.take_device(jnp.asarray(gmask), axis=1, fill=False)
         score_l = plan.take_device(jnp.asarray(static_score), axis=1,
                                    fill=0.0)
+        slot_args = ()
+        if with_slots:
+            # slot rows ride the same node-axis layout gather; the
+            # all-true row's padding columns go False with fill, which
+            # is inert (gmask already excludes layout padding rows)
+            srows_l = plan.take_device(
+                jnp.asarray(slot_kwargs["slot_ok"]), axis=1, fill=False)
+            slot_args = (put(np.asarray(batch.task_slot), rep),
+                         put(srows_l, gn))
 
         assign, pipelined, ready, kept, _idle = fn(
             put(batch.task_group, rep), put(batch.task_job, rep),
@@ -1166,7 +1279,7 @@ class BatchSolver:
             dev_nodes["idle"], dev_nodes["future_idle"],
             dev_nodes["allocatable"], dev_nodes["n_tasks"],
             dev_nodes["max_tasks"],
-            put(np.asarray(eps), rep), self.score_weights())
+            put(np.asarray(eps), rep), self.score_weights(), *slot_args)
         if xfer[0]:
             m.inc(m.DEVICE_TRANSFER_BYTES, float(xfer[0]))
             trace.add_tags(transfer_bytes=xfer[0])

@@ -35,10 +35,12 @@ directly, so no re-encode ever happens mid-action.
 from __future__ import annotations
 
 import functools
+import time as _time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..metrics import metrics as _m
 from ..models.job_info import JobInfo, TaskInfo, TaskStatus
 from ..ops.score import node_score
 
@@ -330,6 +332,19 @@ class PreemptContext:
                     enabled_r.add(opt.name)
         self._persist_ok_reclaim = \
             enabled_r <= {"gang", "conformance", "proportion"}
+        # vectorized victim selection (ops/victims.py): replaces the lazy
+        # Python walk below when every enabled preemptable/reclaimable
+        # plugin has a compiled form; `victims.kernel: off` (solver conf)
+        # forces the Python reference, and a kernel crash falls back to
+        # it for the rest of the action (breaker semantics)
+        self._victim_kernel = None
+        self._victim_kernel_broken = False
+        conf = "auto"
+        args = (getattr(ssn, "configurations", None) or {}).get("solver")
+        if args is not None and hasattr(args, "get_str"):
+            conf = (args.get_str("victims.kernel", "auto")
+                    or "auto").strip().lower()
+        self._victim_kernel_conf = conf
 
     # -- state deltas (mirror Statement.evict / pipeline) ------------------
     # Deltas are logged so a Statement.discard can be mirrored exactly:
@@ -350,10 +365,14 @@ class PreemptContext:
                 if row is not None:
                     self.victims.alive[row] = True
                     self.victims._flip_sum(row, +1.0)
+                    if self._victim_kernel is not None:
+                        self._victim_kernel.note_revive(row)
             else:   # pipeline
                 if i is not None:
                     self.future[i] += vec
                     self.n_tasks[i] -= 1
+                    if self._victim_kernel is not None:
+                        self._victim_kernel.note_node(i)
         self._log = []
         self._reject_mask[:] = False   # restored state can flip rejections
         self._persistent_reject.clear()
@@ -361,6 +380,8 @@ class PreemptContext:
         self._walk_masked = None
         self._walk_order = None
         self._walk_ptr = 0
+        if self._victim_kernel is not None:
+            self._victim_kernel.reset_walk()
 
     def mark_dead(self, victim: TaskInfo) -> None:
         """Drop a victim from the candidate index without any node-state
@@ -369,6 +390,8 @@ class PreemptContext:
         if row is not None and self.victims.alive[row]:
             self.victims.alive[row] = False
             self.victims._flip_sum(row, -1.0)
+            if self._victim_kernel is not None:
+                self._victim_kernel.note_evict(row)
 
     def apply_evict(self, node_name: str, victim: TaskInfo) -> None:
         """Running -> Releasing: future idle grows by the victim's request."""
@@ -380,6 +403,8 @@ class PreemptContext:
         if row is not None:
             self.victims.alive[row] = False
             self.victims._flip_sum(row, -1.0)
+            if self._victim_kernel is not None:
+                self._victim_kernel.note_evict(row)
         self._log.append(("evict", i, vec, row))
         if i is not None:
             self._reject_mask[i] = False
@@ -393,6 +418,8 @@ class PreemptContext:
         if i is not None:
             self.future[i] -= vec
             self.n_tasks[i] += 1
+            if self._victim_kernel is not None:
+                self._victim_kernel.note_node(i)
         self._log.append(("pipeline", i, vec, None))
         if i is not None:
             self._reject_mask[i] = False
@@ -422,6 +449,8 @@ class PreemptContext:
                         and self._walk_key[0] == CROSS_QUEUE:
                     self._walk_key = None
                     self._walk_masked = None
+                if self._victim_kernel is not None:
+                    self._victim_kernel.reset_walk()
 
     # -- per-preemptor evaluation ------------------------------------------
 
@@ -458,6 +487,44 @@ class PreemptContext:
         req = self.batch.group_req[g]
         n_real = len(self.narr.names)
         use_cache = mode != CROSS_QUEUE
+
+        skey = req.tobytes() if self._static_trivial else g
+        score = self._score_cache.get(skey)
+        if score is None:
+            score = np.asarray(node_score(req, self.idle, self.alloc,
+                                          self.weights, self.static[g],
+                                          xp=np))[:n_real]
+            self._score_cache[skey] = score
+
+        # vectorized victim-selection kernel: one task x node pass over
+        # every candidate instead of the per-node plugin-chain walk;
+        # bit-identical by construction (tests/test_constraints.py).
+        # Runs BEFORE the walk's resume-key/persistent-reject setup: the
+        # kernel never reads them, and allocating a per-(job, request)
+        # reject mask per place made apply_evict/apply_pipeline sweep a
+        # growing mask dict the kernel path never consults.
+        if self._victim_kernel_conf != "off" \
+                and not self._victim_kernel_broken:
+            vk = self._victim_kernel
+            if vk is None:
+                from ..ops.victims import VictimKernel
+                vk = self._victim_kernel = VictimKernel(self)
+            if vk.supports(mode):
+                t0 = _time.perf_counter()
+                try:
+                    return vk.place(preemptor, mode, g, pj, pq, req,
+                                    score, victim_cb=victim_cb)
+                except Exception:
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "victim-selection kernel crashed; falling back "
+                        "to the Python walk for this action")
+                    self._victim_kernel_broken = True
+                finally:
+                    _m.observe(_m.VICTIM_SELECT_LATENCY,
+                               (_time.perf_counter() - t0) * 1000.0)
+        _m.inc(_m.VICTIM_SELECT_RUNS, mode="python")
+
         # walk resume key: content-keyed when persistence is sound (see
         # _gmask_hash) so identical consecutive jobs resume one walk; else
         # the group id, which encodes (job, task spec, request, scheduling
@@ -496,14 +563,6 @@ class PreemptContext:
             if persist is None:
                 persist = np.zeros(n_real, bool)
                 self._persistent_reject[pkey] = persist
-
-        skey = req.tobytes() if self._static_trivial else g
-        score = self._score_cache.get(skey)
-        if score is None:
-            score = np.asarray(node_score(req, self.idle, self.alloc,
-                                          self.weights, self.static[g],
-                                          xp=np))[:n_real]
-            self._score_cache[skey] = score
 
         if key == self._walk_key and self._walk_masked is not None:
             # resume task k's walk for task k+1 (same job/mode/request), or

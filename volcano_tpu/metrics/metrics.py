@@ -119,6 +119,17 @@ BACKEND_PROBE = f"{NS}_backend_probe_total"
 CYCLE_MODE = f"{NS}_cycle_mode_total"
 DIRTY_SET_SIZE = f"{NS}_dirty_set_size"
 SOLVER_DEVICE_BUFFER = f"{NS}_solver_device_buffer_total"
+# constraint compilation (docs/design/constraints.md): per-pass build
+# latency, node rows refreshed by the persistent-state sync
+# (event="refresh"), compile crashes that fell back to the per-task
+# Python reference, and victim-selection kernel engagements
+# (mode="kernel"|"python")
+CONSTRAINT_BUILD_LATENCY = f"{NS}_constraint_build_latency_milliseconds"
+CONSTRAINT_BUILD_RUNS = f"{NS}_constraint_build_runs_total"
+CONSTRAINT_ROWS = f"{NS}_constraint_rows_total"
+CONSTRAINT_FALLBACK = f"{NS}_constraint_fallback_total"
+VICTIM_SELECT_RUNS = f"{NS}_victim_select_runs_total"
+VICTIM_SELECT_LATENCY = f"{NS}_victim_select_latency_milliseconds"
 
 # component health registry behind /debug/health: a component absent from
 # the registry is healthy by default; the watchdog (scheduler.py) flips
@@ -195,6 +206,14 @@ def counter_total(name: str, **labels) -> float:
         if labels:
             return _counters.get((name, tuple(sorted(labels.items()))), 0.0)
         return sum(v for (n, _), v in _counters.items() if n == name)
+
+
+def histogram_total(name: str) -> float:
+    """Summed observation total over every series of a histogram — the
+    bench workers' delta reads (kernel/flush/constraint-build latency)."""
+    with _lock:
+        return sum(h.total for (n, _), h in _histograms.items()
+                   if n == name)
 
 
 @contextmanager

@@ -63,6 +63,11 @@ class NodeInfo:
         self.numa_chg_flag: str = ""     # ""|"more"|"less" (NumaChgFlag)
         self.revocable_zone: str = ""
         self.others: Dict[str, object] = {}
+        # topology labels the placement constraints read (zone/rack/...):
+        # captured once per NodeInfo build — node labels are effectively
+        # immutable for a Node object's lifetime (a relabel arrives as a
+        # new Node through the watch, rebuilding the NodeInfo)
+        self.topology: Dict[str, str] = {}
         self.gpu_devices: Dict[int, GPUDevice] = {}
         self.oversubscription_node: bool = False
         self.offline_job_evicting: bool = False
@@ -116,6 +121,23 @@ class NodeInfo:
         if node is None:
             return
         self.revocable_zone = node.metadata.labels.get(objects.REVOCABLE_ZONE_LABEL, "")
+        # topology label capture for the constraint compiler
+        # (ops/constraints.py): the conventional topology.* namespace plus
+        # the hostname identity key — arbitrary keys fall back to
+        # :meth:`topology_value`'s label lookup
+        labels = node.metadata.labels
+        self.topology = {k: v for k, v in labels.items()
+                         if k.startswith("topology.")
+                         or k == "kubernetes.io/hostname"}
+
+    def topology_value(self, key: str) -> Optional[str]:
+        """The node's value for a topology key (zone/rack/hostname/...),
+        None when the label is absent — absent-label nodes never satisfy a
+        constraint over that key (upstream PodTopologySpread semantics)."""
+        v = self.topology.get(key)
+        if v is None and self.node is not None:
+            v = self.node.metadata.labels.get(key)
+        return v
 
     def _set_gpu_info(self, node: Optional[Node]) -> None:
         """Populate shareable GPU devices from capacity (node_info.go:264-289)."""
@@ -373,6 +395,7 @@ class NodeInfo:
                                  if self.numa_scheduler_info is not None else None)
         c.numa_chg_flag = self.numa_chg_flag
         c.revocable_zone = self.revocable_zone
+        c.topology = self.topology   # immutable after build: share
         c.others = dict(self.others)
         devices = {}
         for i, d in self.gpu_devices.items():

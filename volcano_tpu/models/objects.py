@@ -178,6 +178,22 @@ class Affinity:
 
 
 @dataclass
+class TopologySpreadConstraint:
+    """PodTopologySpread slice (k8s topologySpreadConstraints): spread the
+    selected pods across the values of a node topology label, bounding the
+    count difference between the most- and least-loaded topology by
+    ``max_skew``. An empty ``label_selector`` selects the pod's OWN job
+    siblings (the volcano gang case — the scheduler fills it from the
+    job's pods)."""
+    max_skew: int = 1
+    topology_key: str = "topology.kubernetes.io/zone"
+    # DoNotSchedule (hard, lowered into the kernel mask) |
+    # ScheduleAnyway (soft, lowered into the additive score)
+    when_unsatisfiable: str = "DoNotSchedule"
+    label_selector: List[NodeSelectorRequirement] = field(default_factory=list)
+
+
+@dataclass
 class Container:
     name: str = "main"
     image: str = ""
@@ -208,6 +224,9 @@ class PodSpec:
     node_name: str = ""
     node_selector: Dict[str, str] = field(default_factory=dict)
     affinity: Optional[Affinity] = None
+    # immutable-after-store like affinity (clones share the list)
+    topology_spread: List[TopologySpreadConstraint] = field(
+        default_factory=list)
     tolerations: List[Toleration] = field(default_factory=list)
     scheduler_name: str = DEFAULT_SCHEDULER_NAME
     priority: Optional[int] = None

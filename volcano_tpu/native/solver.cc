@@ -10,6 +10,14 @@
 //     chunk) refreshed on group-CONTENT change (rows memcmp-verified),
 //     bucket-chain change, or budget exhaustion — shape-identical gang
 //     bursts (the production conf) sweep nodes ~T/C2 times total;
+//   * per-constraint-slot SUB-tables (Args.S > 0): the constraint
+//     compiler's per-task topology domains (task_slot [T] / slot_ok
+//     [S+1,N], ops/constraints.py) serve from a top-C2 table restricted
+//     to their domain, all (1+S) tables rebuilt in the ONE pass-A sweep
+//     of a refresh and kept complete by apply-time overflow insertion —
+//     a gang whose tasks rotate domains amortizes refreshes exactly
+//     like an unconstrained one (the Solver::rows comment carries the
+//     per-table dominance argument);
 //   * a branchless two-pass node sweep over plane-transposed state
 //     (auto-vectorizes; the XLA kernel materializes the same sweep per
 //     refresh inside lax.scan);
@@ -159,12 +167,15 @@ static inline float ns_share_one(const float* alloc, const float* total,
 struct Args {
   int32_t T, G, J, Q, P, NS, N, R;
   int32_t C2;                 // candidate-table size per fit class
+  int32_t S;                  // constraint slots (0 = none)
   const int32_t* task_group;
   const int32_t* task_job;
   const uint8_t* task_valid;
+  const int32_t* task_slot;   // [T] slot per task (S = unconstrained)
   const float* group_req;     // [G,R]
   const uint8_t* group_mask;  // [G,N]
   const float* group_static;  // [G,N]
+  const uint8_t* slot_ok;     // [S+1,N] domain rows (row S all-true)
   const int32_t* task_bucket;
   const float* pack_bonus;    // [G]
   const int32_t* job_min;     // [J]
@@ -219,7 +230,26 @@ struct Solver {
   std::vector<float> sw_rank, sw_serve;     // [N]
   std::vector<uint8_t> sw_fi, sw_ff;        // [N]
 
-  // candidate table: 2 classes x C2 rows (idle-class then future-class)
+  // Candidate tables. Table 0 is the GLOBAL table (the classic 2
+  // classes x C2 rows); with constraint slots (a.S > 0), tables 1+s
+  // are per-slot SUB-tables — the same two top-C2 classes restricted
+  // to slot s's domain nodes, all rebuilt from the ONE pass-A sweep of
+  // a refresh. A task with slot s serves from table 1+s, so a gang
+  // whose tasks rotate domains never forces per-task refreshes (the
+  // group CONTENT stays the base content; rotating groups were the
+  // 19x constrained-kernel regression).
+  //
+  // Exactness per table: placements between refreshes are bounded by
+  // the shared touch budget (< C2), every placement lands its node in
+  // EVERY table whose domain contains it (updated in place when
+  // present, INSERTED into the table's overflow region when not — a
+  // slot task's placement is otherwise invisible to the global table
+  // and vice versa), so all state-changed nodes are in-table and every
+  // untouched out-of-table node stays dominated by an untouched
+  // in-table entry of its own (class, slot) — the same argument as the
+  // single-table case. Overflow capacity C2 can't exhaust within a
+  // budget window; if a rollback-leaked slot ever would, the table set
+  // is dropped and the next serve refreshes (exact, just slower).
   struct Row {
     int32_t gidx;       // -1 = dead
     float stat;         // static score column
@@ -229,18 +259,24 @@ struct Solver {
     float score;        // cached serve score
     uint8_t fi, ff;     // cached fits per class
   };
-  std::vector<Row> rows;                  // [2*C2]
-  std::vector<float> s_idle, s_fut;       // [2*C2] masked serve scores
+  int S_eff = 0;        // active slot count (0 = no slot inputs)
+  int TT = 1;           // table count = 1 + S_eff
+  int OV = 0;           // shared overflow rows per table (C2 when slots)
+  int STRIDE = 0;       // rows per table = 2*C2 + OV
+  std::vector<Row> rows;                  // [TT*STRIDE]
+  std::vector<float> s_idle, s_fut;       // [TT*STRIDE] masked serve scores
   std::vector<int32_t> rowmap_i, rowmap_f;
-  std::vector<int32_t> rowmap_ep;         // [N]
+  std::vector<int32_t> rowmap_ep;         // [TT*N]
+  std::vector<int32_t> ov_used;           // [TT] overflow rows consumed
+  std::vector<uint8_t> serve_valid_t, serve_sb_t;   // [TT]
+  // node -> member slot list (CSR over slot_ok, built once)
+  std::vector<int32_t> mem_off, mem_slot;
   int32_t rowmap_gen = 1;
   int table_group = -1;
   int verified_group = -1;                // last group memcmp'd == table's
   int32_t table_bucket = -2;
   int touched = 0;                        // gross serves since refresh
   bool have_table = false;
-  bool serve_valid = false;
-  bool serve_sb = false;
 
   // stats (VOLCANO_NATIVE_STATS=1)
   bool stats = false;
@@ -254,18 +290,23 @@ struct Solver {
 
   // undo log for the current gang (pre-placement values). Row indices
   // are only meaningful for the rowmap generation they were recorded
-  // under: a mid-gang refresh() reinstalls the table and reassigns the
+  // under: a mid-gang refresh() reinstalls the tables and reassigns the
   // slots, so each entry carries its generation and rollback discards
-  // the table instead of restoring rows across generations.
+  // the tables instead of restoring rows across generations. Saved row
+  // copies and inserted-row indices live in shared arenas (ranges per
+  // entry) so multi-table placements don't heap-allocate per undo.
+  struct SavedRow { int32_t k; Row row; };
   struct Undo {
     int32_t node;
     float idle[8], fut[8];
     int32_t ntasks;
-    int32_t row_i, row_f;
+    int32_t saved_lo, saved_hi;   // range in saved_arena
+    int32_t ins_lo, ins_hi;       // range in ins_arena
     int32_t gen;         // rowmap_gen at record time
-    Row ri, rf;          // full row copies (small)
   };
   std::vector<Undo> undo;
+  std::vector<SavedRow> saved_arena;
+  std::vector<int32_t> ins_arena;
 
   explicit Solver(const Args& args)
       : a(args), N(args.N), R(args.R) {
@@ -294,14 +335,44 @@ struct Solver {
     sw_serve.assign(N, NEG);
     sw_fi.assign(N, 0);
     sw_ff.assign(N, 0);
-    int k = 2 * a.C2;
+    S_eff = (a.S > 0 && a.task_slot && a.slot_ok) ? a.S : 0;
+    TT = 1 + S_eff;
+    OV = S_eff > 0 ? a.C2 : 0;
+    STRIDE = 2 * a.C2 + OV;
+    size_t k = (size_t)TT * STRIDE;
     rows.assign(k, Row{});
     for (auto& r : rows) r.gidx = -1;
     s_idle.assign(k, NEG);
     s_fut.assign(k, NEG);
-    rowmap_i.assign(N, -1);
-    rowmap_f.assign(N, -1);
-    rowmap_ep.assign(N, 0);
+    rowmap_i.assign((size_t)TT * N, -1);
+    rowmap_f.assign((size_t)TT * N, -1);
+    rowmap_ep.assign((size_t)TT * N, 0);
+    ov_used.assign(TT, 0);
+    serve_valid_t.assign(TT, 0);
+    serve_sb_t.assign(TT, 0);
+    if (S_eff > 0) {
+      // node -> member-slot CSR (row S, the all-true unconstrained row,
+      // is a Python-side convention the sub-tables don't need)
+      mem_off.assign(N + 1, 0);
+      for (int s = 0; s < S_eff; ++s) {
+        const uint8_t* row = &a.slot_ok[(size_t)s * N];
+        for (int n = 0; n < N; ++n) mem_off[n + 1] += row[n] ? 1 : 0;
+      }
+      for (int n = 0; n < N; ++n) mem_off[n + 1] += mem_off[n];
+      mem_slot.assign(mem_off[N], 0);
+      std::vector<int32_t> cur(mem_off.begin(), mem_off.end() - 1);
+      for (int s = 0; s < S_eff; ++s) {
+        const uint8_t* row = &a.slot_ok[(size_t)s * N];
+        for (int n = 0; n < N; ++n)
+          if (row[n]) mem_slot[cur[n]++] = s;
+      }
+    }
+  }
+
+  inline int table_of(int32_t t_idx) const {
+    if (S_eff == 0) return 0;
+    int32_t s = a.task_slot[t_idx];
+    return (s >= 0 && s < S_eff) ? 1 + s : 0;
   }
 
   inline float pack_of(int n) const {
@@ -474,7 +545,9 @@ struct Solver {
     }
 
     if (stats) { int64_t t = now_ns(); t_passa += t - _t0; _t0 = t; }
-    // ---- pass B: per-class top-C2 heaps keyed (score asc, idx desc)
+    // ---- pass B: per-(table, class) top-C2 heaps keyed (score asc,
+    // idx desc); the global table plus one sub-table per member slot,
+    // all fed from the one pass-A sweep
     int C2 = a.C2;
     struct HC { float s; int32_t n; };
     auto worse = [](const HC& x, const HC& y) {
@@ -482,36 +555,42 @@ struct Solver {
       return x.n > y.n;
     };
     auto heap_cmp = [&](const HC& x, const HC& y) { return !worse(x, y); };
-    std::vector<HC> hi, hf;
-    hi.reserve(C2 + 1); hf.reserve(C2 + 1);
+    auto hpush = [&](std::vector<HC>& h, const HC& c) {
+      if ((int)h.size() < C2) {
+        h.push_back(c); std::push_heap(h.begin(), h.end(), heap_cmp);
+      } else if (worse(h.front(), c)) {
+        std::pop_heap(h.begin(), h.end(), heap_cmp);
+        h.back() = c; std::push_heap(h.begin(), h.end(), heap_cmp);
+      }
+    };
+    std::vector<std::vector<HC>> his(TT), hfs(TT);
+    for (int t = 0; t < TT; ++t) {
+      his[t].reserve(C2 + 1); hfs[t].reserve(C2 + 1);
+    }
     for (int n = 0; n < N; ++n) {
       if (!(fi[n] | (a.allow_pipeline ? ff[n] : 0))) continue;
       float sb_score = rank[n];
       if (sb_score <= NEG * 0.5f) continue;   // lax.top_k dead-row cutoff
       HC c{sb_score, n};
-      if (fi[n]) {
-        if ((int)hi.size() < C2) {
-          hi.push_back(c); std::push_heap(hi.begin(), hi.end(), heap_cmp);
-        } else if (worse(hi.front(), c)) {
-          std::pop_heap(hi.begin(), hi.end(), heap_cmp);
-          hi.back() = c; std::push_heap(hi.begin(), hi.end(), heap_cmp);
+      if (fi[n]) hpush(his[0], c);
+      if (a.allow_pipeline && ff[n]) hpush(hfs[0], c);
+      if (S_eff > 0)
+        for (int mi = mem_off[n]; mi < mem_off[n + 1]; ++mi) {
+          int t = 1 + mem_slot[mi];
+          if (fi[n]) hpush(his[t], c);
+          if (a.allow_pipeline && ff[n]) hpush(hfs[t], c);
         }
-      }
-      if (a.allow_pipeline && ff[n]) {
-        if ((int)hf.size() < C2) {
-          hf.push_back(c); std::push_heap(hf.begin(), hf.end(), heap_cmp);
-        } else if (worse(hf.front(), c)) {
-          std::pop_heap(hf.begin(), hf.end(), heap_cmp);
-          hf.back() = c; std::push_heap(hf.begin(), hf.end(), heap_cmp);
-        }
-      }
     }
     if (stats) { int64_t t = now_ns(); t_passb += t - _t0; _t0 = t; }
     // ---- install rows + serve caches (values straight from pass A)
     rowmap_gen++;
-    auto install = [&](std::vector<HC>& h, int base, bool is_idle_class) {
+    auto install = [&](std::vector<HC>& h, int tt, int base,
+                       bool is_idle_class, int width) {
       int cnt = (int)h.size();
-      for (int i = 0; i < C2; ++i) {
+      int32_t* rep = &rowmap_ep[(size_t)tt * N];
+      int32_t* ri = &rowmap_i[(size_t)tt * N];
+      int32_t* rf = &rowmap_f[(size_t)tt * N];
+      for (int i = 0; i < width; ++i) {
         int k = base + i;
         Row& r = rows[k];
         if (i < cnt) {
@@ -531,12 +610,12 @@ struct Solver {
           r.ff = a.allow_pipeline ? ff[n] : 0;
           s_idle[k] = r.fi ? r.score : NEG;
           s_fut[k] = r.ff ? r.score : NEG;
-          if (rowmap_ep[n] != rowmap_gen) {
-            rowmap_ep[n] = rowmap_gen;
-            rowmap_i[n] = -1; rowmap_f[n] = -1;
+          if (rep[n] != rowmap_gen) {
+            rep[n] = rowmap_gen;
+            ri[n] = -1; rf[n] = -1;
           }
-          if (is_idle_class) rowmap_i[n] = k;
-          else rowmap_f[n] = k;
+          if (is_idle_class) ri[n] = k;
+          else rf[n] = k;
         } else {
           r.gidx = -1;
           s_idle[k] = NEG;
@@ -544,15 +623,22 @@ struct Solver {
         }
       }
     };
-    install(hi, 0, true);
-    install(hf, a.C2, false);
+    for (int t = 0; t < TT; ++t) {
+      int base = t * STRIDE;
+      // layout per table: [C2 idle-built][OV shared overflow][C2 fut-
+      // built]; the overflow region is dead-filled here and consumed by
+      // apply-time insertions
+      install(his[t], t, base, true, C2 + OV);
+      install(hfs[t], t, base + C2 + OV, false, C2);
+      ov_used[t] = 0;
+      serve_valid_t[t] = 1;
+      serve_sb_t[t] = chain ? 1 : 0;
+    }
     table_group = g;
     verified_group = g;
     table_bucket = b;
     touched = 0;
     have_table = true;
-    serve_valid = true;
-    serve_sb = chain;
     if (stats) t_install += now_ns() - _t0;
   }
 
@@ -611,29 +697,37 @@ struct Solver {
           int64_t t0 = stats ? now_ns() : 0;
           refresh(g, b, req, bonus);
           if (stats) { t_refresh += now_ns() - t0; n_refresh++; }
-        } else if (!serve_valid || serve_sb != sb) {
-          // serve-cache rebuild over table rows only; exact because the
-          // serving group's content equals the table group's (verified)
-          for (int k = 0; k < 2 * a.C2; ++k)
-            row_score(rows[k], req, bonus, sb, k);
-          serve_valid = true;
-          serve_sb = sb;
         }
-        // argmax: idle fits first, ties by lowest node index
+        int tt = table_of(t_idx);
+        int base = tt * STRIDE;
+        if (!need && (!serve_valid_t[tt] ||
+                      (serve_sb_t[tt] != 0) != sb)) {
+          // serve-cache rebuild over THIS table's rows only (lazy per
+          // table); exact because the serving group's content equals
+          // the table group's (verified)
+          for (int k = base; k < base + STRIDE; ++k)
+            row_score(rows[k], req, bonus, sb, k);
+          serve_valid_t[tt] = 1;
+          serve_sb_t[tt] = sb ? 1 : 0;
+        }
+        // argmax over the serving table: idle fits first, ties by
+        // lowest node index (the s_idle/s_fut caches of BOTH class
+        // regions and the overflow carry each row's per-class scores)
         int64_t ts0 = stats ? now_ns() : 0;
-        int K = 2 * a.C2;
         float best = NEG;
-        for (int k = 0; k < K; ++k) best = std::max(best, s_idle[k]);
+        for (int k = base; k < base + STRIDE; ++k)
+          best = std::max(best, s_idle[k]);
         bool any_idle = best > NEG * 0.5f;
         const std::vector<float>& sc = any_idle ? s_idle : s_fut;
         if (!any_idle) {
           best = NEG;
-          for (int k = 0; k < K; ++k) best = std::max(best, sc[k]);
+          for (int k = base; k < base + STRIDE; ++k)
+            best = std::max(best, sc[k]);
         }
         if (stats) { t_serve += now_ns() - ts0; n_serve++; }
         if (best > NEG * 0.5f) {
           int32_t min_idx = INT32_MAX;
-          for (int k = 0; k < K; ++k)
+          for (int k = base; k < base + STRIDE; ++k)
             if (sc[k] >= best && rows[k].gidx >= 0 &&
                 rows[k].gidx < min_idx)
               min_idx = rows[k].gidx;
@@ -653,13 +747,9 @@ struct Solver {
           u.fut[r] = futT[(size_t)r * N + sel];
         }
         u.ntasks = ntasks[sel];
-        bool mapped = rowmap_ep[sel] == rowmap_gen;
-        u.row_i = mapped ? rowmap_i[sel] : -1;
-        u.row_f = mapped ? rowmap_f[sel] : -1;
         u.gen = rowmap_gen;
-        if (u.row_i >= 0) u.ri = rows[u.row_i];
-        if (u.row_f >= 0) u.rf = rows[u.row_f];
-        undo.push_back(u);
+        u.saved_lo = (int32_t)saved_arena.size();
+        u.ins_lo = (int32_t)ins_arena.size();
         // state apply (same arithmetic as the scan's .add(-req))
         for (int r = 0; r < R; ++r) {
           if (take_idle) idleT[(size_t)r * N + sel] += -req[r];
@@ -674,19 +764,73 @@ struct Solver {
           pack_epoch[sel] = epoch; pack_val[sel] = 0.0f;
         }
         pack_val[sel] += 1.0f;
-        // table rows of sel: same updates + pack column + score recompute
-        for (int which = 0; which < 2; ++which) {
-          int k = which == 0 ? u.row_i : u.row_f;
-          if (k < 0) continue;
-          Row& r = rows[k];
-          for (int rr = 0; rr < R; ++rr) {
-            if (take_idle) r.idle[rr] += -req[rr];
-            r.fut[rr] += -req[rr];
+        // sel's rows in EVERY table whose domain holds it (global +
+        // member sub-tables): update in place when present, insert into
+        // the table's overflow when not — the membership half of each
+        // table's dominance argument (see the table comment above)
+        int tcount = 1;
+        int tlist[1 + 16];
+        tlist[0] = 0;
+        if (S_eff > 0)
+          for (int mi = mem_off[sel];
+               mi < mem_off[sel + 1] && tcount < (int)(sizeof(tlist) /
+                                                       sizeof(tlist[0]));
+               ++mi)
+            tlist[tcount++] = 1 + mem_slot[mi];
+        if (S_eff > 0 &&
+            mem_off[sel + 1] - mem_off[sel] > (int)(sizeof(tlist) /
+                                                    sizeof(tlist[0])) - 1)
+          have_table = false;   // absurd membership: refresh next serve
+        for (int ti = 0; ti < tcount; ++ti) {
+          int t = tlist[ti];
+          size_t mslot = (size_t)t * N + sel;
+          bool mapped = rowmap_ep[mslot] == rowmap_gen;
+          int32_t ki = mapped ? rowmap_i[mslot] : -1;
+          int32_t kf = mapped ? rowmap_f[mslot] : -1;
+          if (ki < 0 && kf < 0) {
+            if (S_eff == 0) continue;   // classic single-table behavior
+            if (ov_used[t] >= OV) {     // can't keep the table complete
+              have_table = false;
+              continue;
+            }
+            int k = t * STRIDE + a.C2 + ov_used[t]++;
+            Row& r = rows[k];
+            r.gidx = sel;
+            r.stat = a.group_static[(size_t)g * N + sel];
+            r.pack = pack_of(sel);
+            r.ntasks = (float)ntasks[sel];
+            r.maxt = (float)a.node_max[sel];
+            for (int rr = 0; rr < R; ++rr) {
+              r.idle[rr] = idleT[(size_t)rr * N + sel];
+              r.fut[rr] = futT[(size_t)rr * N + sel];
+              r.alloc[rr] = allocT[(size_t)rr * N + sel];
+            }
+            row_score(r, req, bonus, sb, k);
+            if (!mapped) {
+              rowmap_ep[mslot] = rowmap_gen;
+              rowmap_f[mslot] = -1;
+            }
+            rowmap_i[mslot] = k;
+            ins_arena.push_back(k);
+            continue;
           }
-          r.ntasks += 1.0f;
-          r.pack += 1.0f;
-          row_score(r, req, bonus, sb, k);
+          for (int which = 0; which < 2; ++which) {
+            int k = which == 0 ? ki : kf;
+            if (k < 0 || (which == 1 && kf == ki)) continue;
+            saved_arena.push_back(SavedRow{k, rows[k]});
+            Row& r = rows[k];
+            for (int rr = 0; rr < R; ++rr) {
+              if (take_idle) r.idle[rr] += -req[rr];
+              r.fut[rr] += -req[rr];
+            }
+            r.ntasks += 1.0f;
+            r.pack += 1.0f;
+            row_score(r, req, bonus, sb, k);
+          }
         }
+        u.saved_hi = (int32_t)saved_arena.size();
+        u.ins_hi = (int32_t)ins_arena.size();
+        undo.push_back(u);
         touched++;
         placed += 1;
         if (take_idle) placed_alloc += 1;
@@ -729,22 +873,34 @@ struct Solver {
               // reassigned, so restoring the snapshots would write one
               // node's pre-placement state into another node's row.
               // Globals above are generation-independent and exact; drop
-              // the table and let the next serve refresh from them.
+              // the tables and let the next serve refresh from them.
               have_table = false;
               continue;
             }
-            if (it->row_i >= 0) {
-              float pk = rows[it->row_i].pack;   // pack survives rollback
-              rows[it->row_i] = it->ri;
-              rows[it->row_i].pack = pk;
+            for (int32_t si = it->saved_hi - 1; si >= it->saved_lo; --si) {
+              const SavedRow& sr = saved_arena[si];
+              float pk = rows[sr.k].pack;   // pack survives rollback
+              rows[sr.k] = sr.row;
+              rows[sr.k].pack = pk;
             }
-            if (it->row_f >= 0) {
-              float pk = rows[it->row_f].pack;
-              rows[it->row_f] = it->rf;
-              rows[it->row_f].pack = pk;
+            for (int32_t ii = it->ins_hi - 1; ii >= it->ins_lo; --ii) {
+              // an apply-time overflow insertion: kill the row and its
+              // rowmap entry (the overflow slot itself stays consumed —
+              // the exhaustion valve drops the tables if that ever bites)
+              int k = ins_arena[ii];
+              Row& r = rows[k];
+              if (r.gidx >= 0) {
+                size_t mslot = (size_t)(k / STRIDE) * N + r.gidx;
+                if (rowmap_ep[mslot] == rowmap_gen &&
+                    rowmap_i[mslot] == k)
+                  rowmap_i[mslot] = -1;
+              }
+              r.gidx = -1;
+              s_idle[k] = NEG;
+              s_fut[k] = NEG;
             }
           }
-          serve_valid = false;
+          std::fill(serve_valid_t.begin(), serve_valid_t.end(), 0);
         }
         if (keep) {
           int p = cur_pool < 0 ? 0 : cur_pool;
@@ -759,6 +915,8 @@ struct Solver {
         if (is_ready) ready[job] = 1;
         if (is_kept) kept[job] = 1;
         undo.clear();
+        saved_arena.clear();
+        ins_arena.clear();
         t_off = 0; placed = 0; placed_alloc = 0;
         std::fill(placed_res.begin(), placed_res.end(), 0.0f);
         select(&cur_pool, &cur_job);
@@ -796,7 +954,8 @@ struct Solver {
 
 extern "C" int vc_gang_allocate(const Args* args) {
   if (!args || args->T < 0 || args->N <= 0 || args->R <= 0 ||
-      args->R > 8 || args->C2 <= 0)
+      args->R > 8 || args->C2 <= 0 || args->S < 0 ||
+      (args->S > 0 && (!args->task_slot || !args->slot_ok)))
     return 1;
   for (int32_t t = 0; t < args->T; ++t) {
     args->assign[t] = -1;
@@ -809,4 +968,4 @@ extern "C" int vc_gang_allocate(const Args* args) {
   return 0;
 }
 
-extern "C" int vc_abi_version() { return 1; }
+extern "C" int vc_abi_version() { return 2; }

@@ -168,9 +168,19 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
                   eps: jax.Array,             # [R] f32
                   weights: ScoreWeights,
                   allow_pipeline: bool = True,
-                  ns_live: bool = False):
+                  ns_live: bool = False,
+                  task_slot: jax.Array = None,  # [T] i32 slot row (S = none)
+                  slot_ok: jax.Array = None):   # [S+1, N] bool domain rows
     """Returns (assign [T] node-or--1, pipelined [T] bool, ready [J] bool,
-    kept [J] bool, final AllocState)."""
+    kept [J] bool, final AllocState).
+
+    ``task_slot``/``slot_ok`` are the constraint compiler's per-task
+    topology-domain restriction (ops/constraints.py): task t may only
+    use nodes where ``slot_ok[task_slot[t]]`` holds; row S is all-true
+    and unconstrained tasks carry slot S. Keeping the restriction per
+    TASK (instead of splitting task groups per assigned domain) keeps
+    the group axis at its base size, which is what lets the candidate-
+    table kernels amortize their refresh sweeps across a gang."""
     T = task_group.shape[0]
     J = job_min_available.shape[0]
 
@@ -203,6 +213,8 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
 
         req = group_req[g]                       # [R]
         static_ok = group_mask[g]                # [N]
+        if task_slot is not None:
+            static_ok = static_ok & slot_ok[task_slot[t_idx]]
         pods_ok = (node_max_tasks == 0) | (state.n_tasks < node_max_tasks)
         base_ok = static_ok & pods_ok & valid
 
@@ -302,7 +314,9 @@ def gang_allocate(task_group: jax.Array,      # [T] i32
 
 @partial(jax.jit, static_argnames=("allow_pipeline", "ns_live", "chunk"))
 def gang_allocate_chunked(*args, allow_pipeline: bool = True,
-                          ns_live: bool = False, chunk: int = 16):
+                          ns_live: bool = False, chunk: int = 16,
+                          task_slot: jax.Array = None,
+                          slot_ok: jax.Array = None):
     """Chunked-candidate form of :func:`gang_allocate`: identical
     semantics (ops/sharded.py holds the exactness argument), but each
     scan step works on a top-``chunk``-per-fit-class candidate table that
@@ -313,4 +327,5 @@ def gang_allocate_chunked(*args, allow_pipeline: bool = True,
     AllocState."""
     from .sharded import _sharded_body_chunked
     return _sharded_body_chunked(*args, allow_pipeline=allow_pipeline,
-                                 ns_live=ns_live, axis=None, chunk=chunk)
+                                 ns_live=ns_live, axis=None, chunk=chunk,
+                                 task_slot=task_slot, slot_ok=slot_ok)

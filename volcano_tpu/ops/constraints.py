@@ -1,0 +1,884 @@
+"""Constraint compilation: production placement constraints lowered into
+the solver's dense task-group x node mask / additive-score tensors.
+
+The vmapped placement kernels (ops/allocate.py and friends) consume two
+uniform inputs per group x node — a boolean feasibility MASK and an
+additive static SCORE — so every constraint that can be expressed as a
+precomputed tensor rides the kernels at zero marginal cost. This module
+is the compilation pass that builds those tensors once per cycle from the
+snapshot (grounded in "Scheduling Parallel-Task Jobs Subject to Packing
+and Placement Constraints", arxiv 2004.00518, and "Priority Matters:
+constraint-based pod packing", arxiv 2511.08373):
+
+* **Pod affinity / anti-affinity** (required): the cycle-static interpod
+  index (plugins/interpod.py) evaluated per constraint-carrying group —
+  mask rows. Semantics identical to the host predicate (the reference's
+  session-open k8s snapshot: in-cycle placements of OTHER jobs are not
+  visible; see plugins/interpod.py's module docstring for why that is
+  faithful, not a simplification).
+
+* **Topology spread** (``PodSpec.topology_spread``, zone/rack labels on
+  NodeInfo): hard constraints (DoNotSchedule) are lowered by *slot
+  splitting* — the issue's "task x node" masks. A spread-constrained
+  job's pending tasks are deterministically distributed over the
+  topology domains (greedy-balanced against the job's existing
+  per-domain counts, ties by domain value then node order), and each
+  task's mask row admits only its assigned domain. Because the
+  distribution itself satisfies ``max_skew``, a gang placed in ONE cycle
+  cannot violate the skew bound — the failure mode a purely
+  cycle-static mask has (every pod of a burst sees the same stale
+  counts). The cost is conservatism: a task is pinned to its domain
+  even when another domain could also have satisfied the skew bound;
+  the gang then pipelines/rolls back exactly as if the domain were
+  full. Soft constraints (ScheduleAnyway) become an additive score
+  penalty proportional to the domain's existing load.
+
+  Self-anti-affinity (a required pod-anti-affinity term whose selector
+  matches the pod's own labels — the "one replica per zone/host" gang
+  idiom) is lowered through the same slot splitter with a hard cap of
+  one per domain: pending replicas get DISTINCT empty domains; replicas
+  beyond the free-domain count compile to an all-false row (correct:
+  unsatisfiable this cycle).
+
+* **Priority-tiered packing** (arxiv 2511.08373): an additive score
+  aligning each group with nodes resident to its own-or-higher priority
+  tier and away from lower-tier nodes, so high-priority work packs onto
+  "safe" nodes and future preemption fallout shrinks. Off by default
+  (``tieredpack.weight`` solver/priority-plugin argument).
+
+Incremental mode (docs/design/incremental_cycle.md): the node-side
+encodings — topology codes per key and the per-tier resident mass — are
+PERSISTENT per cache and refreshed only for dirty nodes (PR 7's dirty
+sets, folded in through ``note_snapshot`` alongside the solver's
+per-device resident tensors). The compiled [G, N] products are rebuilt
+per cycle (group sets change every cycle) from those cached rows. On the
+mesh, the products ride the same ShardPlan node-axis gather every other
+[G, N] input uses (solver._run_sharded), so the sharded default keeps
+working unchanged.
+
+The pure-Python per-task predicate path (plugins/predicates.py's
+``predicate_fn`` + :func:`reference_mask` here) stays the bit-identical
+reference: parity-tested in tests/test_constraints.py, and the compiled
+pass falls back to it (breaker-style, logged) if compilation ever
+crashes mid-cycle.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..metrics import metrics as m
+from ..models.arrays import derived_sig, _group_sig
+from ..models.job_info import TaskStatus, allocated_status
+from ..trace import tracer as trace
+
+_logger = logging.getLogger(__name__)
+_logged_once: set = set()
+
+ZONE_KEY = "topology.kubernetes.io/zone"
+RACK_KEY = "topology.kubernetes.io/rack"
+HOSTNAME_KEY = "kubernetes.io/hostname"
+
+
+def _log_once(msg: str) -> None:
+    if msg not in _logged_once:
+        _logged_once.add(msg)
+        _logger.warning(msg)
+
+
+# ---------------------------------------------------------------------------
+# persistent node-side encodings
+# ---------------------------------------------------------------------------
+
+
+class _ConstraintState:
+    """Per-cache persistent constraint tensors (the constraint twin of
+    solver._IncrNodeState): topology-code rows per key and the per-tier
+    resident-task mass, refreshed only for dirty node rows on
+    steady-state cycles."""
+
+    __slots__ = ("names", "node_ids", "topo_rows", "topo_vocab",
+                 "tier_mass", "tier_vocab", "pending", "force_full",
+                 "cycle_token", "synced_token", "last_refreshed")
+
+    def __init__(self):
+        self.names: Optional[List[str]] = None   # node order encoded
+        self.node_ids: Optional[list] = None     # id(NodeInfo.node) per row
+        self.topo_rows: Dict[str, np.ndarray] = {}    # key -> [n] i32
+        self.topo_vocab: Dict[str, Dict[str, int]] = {}
+        self.tier_mass: Optional[np.ndarray] = None   # [n, T] f32
+        self.tier_vocab: Dict[int, int] = {}          # priority -> column
+        self.pending: set = set()          # node names needing row refresh
+        self.force_full = True
+        self.cycle_token = 0
+        self.synced_token = -1
+        self.last_refreshed = 0   # rows refreshed at the last sync
+
+
+def constraint_state(cache) -> Optional[_ConstraintState]:
+    if cache is None:
+        return None
+    state = getattr(cache, "_constraint_state", None)
+    if state is None:
+        state = cache._constraint_state = _ConstraintState()
+    return state
+
+
+def note_snapshot(cache, snap) -> None:
+    """Fold one snapshot's invalidation surface into the persistent
+    constraint state (called per cycle from solver.note_incremental_
+    snapshot, riding the same dirty sets as the device node tensors)."""
+    state = constraint_state(cache)
+    if state is None:
+        return
+    state.cycle_token += 1
+    if getattr(snap, "incr_mode", None) == "incremental":
+        state.pending |= set(snap.patched_nodes)
+    else:
+        state.force_full = True
+
+
+def _sync_for_session(state: Optional[_ConstraintState], ssn,
+                      names: List[str]) -> None:
+    """Once-per-session entry to :func:`_sync_state` (compile_slots and
+    compile_mask both call it; the second call must be a no-op). In
+    incremental mode note_snapshot bumped ``cycle_token``, so the token
+    compare scopes the sync AND carries the dirty-pending rows. A legacy
+    non-incremental snapshot never calls note_snapshot — the tokens stay
+    equal forever, which before this guard meant the rows were NEVER
+    refreshed after the first cycle (stale zone labels / tier mass); with
+    no dirty surface to ride, legacy cycles force the full row rebuild,
+    i.e. exactly the rebuild-from-snapshot semantics the legacy path has
+    everywhere else."""
+    if state is None or getattr(ssn, "_constraint_synced", False):
+        return
+    if state.synced_token == state.cycle_token:
+        state.force_full = True   # no note_snapshot fed this cycle
+    refreshed = _sync_state(state, ssn, names)
+    state.synced_token = state.cycle_token
+    state.last_refreshed = refreshed
+    ssn._constraint_synced = True
+    m.inc(m.CONSTRAINT_ROWS, float(refreshed), event="refresh")
+
+
+def _sync_state(state: _ConstraintState, ssn, names: List[str]) -> int:
+    """Bring the persistent rows up to date for this cycle's node order;
+    returns the number of refreshed rows (the incremental proof surface).
+
+    Refresh policy: a full rebuild on node order change or full-snapshot
+    cycles; otherwise only rows whose name is in the dirty-pending set or
+    whose backing Node OBJECT changed identity (a relabel arrives as a
+    new Node through the watch, so object identity is a sound label-change
+    detector even outside incremental mode)."""
+    n = len(names)
+    full = state.force_full or state.names != names
+    if full:
+        state.names = list(names)
+        state.topo_rows = {k: None for k in state.topo_rows}
+        state.tier_mass = None
+        state.node_ids = [None] * n
+        state.pending = set()
+        state.force_full = False
+        dirty = list(range(n))
+    else:
+        dirty = []
+        for i, name in enumerate(names):
+            ni = ssn.nodes.get(name)
+            oid = id(ni.node) if ni is not None and ni.node is not None \
+                else None
+            if name in state.pending or state.node_ids[i] != oid:
+                dirty.append(i)
+        state.pending = set()
+    if not dirty:
+        return 0
+    for i in dirty:
+        ni = ssn.nodes.get(names[i])
+        state.node_ids[i] = id(ni.node) \
+            if ni is not None and ni.node is not None else None
+    # topology rows refresh lazily per key (see _topo_row); here we just
+    # mark the dirty rows by invalidating their codes
+    for key, row in list(state.topo_rows.items()):
+        if row is None or len(row) != n:
+            state.topo_rows[key] = None       # rebuilt on next use
+            continue
+        vocab = state.topo_vocab.setdefault(key, {})
+        for i in dirty:
+            ni = ssn.nodes.get(names[i])
+            v = ni.topology_value(key) if ni is not None else None
+            row[i] = -1 if v is None else vocab.setdefault(v, len(vocab))
+    # per-tier resident mass
+    if state.tier_mass is not None and state.tier_mass.shape[0] == n:
+        for i in dirty:
+            _encode_tier_row(state, ssn, names[i], i)
+    else:
+        state.tier_mass = None
+    return len(dirty)
+
+
+def _task_tier(ssn, t) -> int:
+    """A task's priority TIER: its job's priority (the PodGroup priority
+    class — what the priority plugin's Preemptable compares) when the
+    job is in session, else the pod-level priority."""
+    job = ssn.jobs.get(t.job) if t.job else None
+    return job.priority if job is not None else t.priority
+
+
+def _encode_tier_row(state: _ConstraintState, ssn, name: str,
+                     i: int) -> None:
+    row = state.tier_mass[i]
+    row[:] = 0.0
+    ni = ssn.nodes.get(name)
+    if ni is None:
+        return
+    for t in ni.tasks.values():
+        tier = _task_tier(ssn, t)
+        col = state.tier_vocab.get(tier)
+        if col is None:
+            col = state.tier_vocab[tier] = len(state.tier_vocab)
+            if state.tier_mass.shape[1] <= col:
+                state.tier_mass = np.concatenate(
+                    [state.tier_mass,
+                     np.zeros((state.tier_mass.shape[0], 4), np.float32)],
+                    axis=1)
+                row = state.tier_mass[i]
+        row[col] += 1.0
+
+
+def _topo_row(state: Optional[_ConstraintState], ssn, names: List[str],
+              key: str) -> Tuple[np.ndarray, Dict[str, int]]:
+    """[n_real] i32 topology code per node for ``key`` (-1 = label
+    absent), through the persistent state when available."""
+    if state is not None and state.names == names:
+        row = state.topo_rows.get(key)
+        if row is not None and len(row) == len(names):
+            return row, state.topo_vocab[key]
+        vocab = state.topo_vocab.setdefault(key, {})
+        row = np.full(len(names), -1, np.int32)
+        for i, name in enumerate(names):
+            ni = ssn.nodes.get(name)
+            v = ni.topology_value(key) if ni is not None else None
+            if v is not None:
+                row[i] = vocab.setdefault(v, len(vocab))
+        state.topo_rows[key] = row
+        return row, vocab
+    vocab = {}
+    row = np.full(len(names), -1, np.int32)
+    for i, name in enumerate(names):
+        ni = ssn.nodes.get(name)
+        v = ni.topology_value(key) if ni is not None else None
+        if v is not None:
+            row[i] = vocab.setdefault(v, len(vocab))
+    return row, vocab
+
+
+def _tier_mass(state: Optional[_ConstraintState], ssn,
+               names: List[str]) -> Tuple[np.ndarray, Dict[int, int]]:
+    """[n_real, T] resident-task count per priority tier per node."""
+    if state is not None and state.names == names \
+            and state.tier_mass is not None \
+            and state.tier_mass.shape[0] == len(names):
+        return state.tier_mass, state.tier_vocab
+    n = len(names)
+    if state is not None and state.names == names:
+        state.tier_mass = np.zeros((n, max(4, len(state.tier_vocab))),
+                                   np.float32)
+        for i, name in enumerate(names):
+            _encode_tier_row(state, ssn, name, i)
+        return state.tier_mass, state.tier_vocab
+    vocab: Dict[int, int] = {}
+    mass = np.zeros((n, 8), np.float32)
+    for i, name in enumerate(names):
+        ni = ssn.nodes.get(name)
+        if ni is None:
+            continue
+        for t in ni.tasks.values():
+            tier = _task_tier(ssn, t)
+            col = vocab.get(tier)
+            if col is None:
+                col = vocab[tier] = len(vocab)
+                if mass.shape[1] <= col:
+                    mass = np.concatenate(
+                        [mass, np.zeros((n, 8), np.float32)], axis=1)
+            mass[i, col] += 1.0
+    return mass, vocab
+
+
+# ---------------------------------------------------------------------------
+# spread-slot assignment (the task x node lowering)
+# ---------------------------------------------------------------------------
+
+
+def _self_anti_terms(task) -> list:
+    """Required pod-anti-affinity terms whose selector matches the task's
+    OWN labels in its own namespace — the per-domain-exclusive gang
+    idiom, lowered via slot splitting."""
+    aff = task.pod.spec.affinity
+    if aff is None or aff.pod_anti_affinity is None:
+        return []
+    from ..plugins.interpod import _term_matches
+    labels = task.pod.metadata.labels
+    ns = task.namespace
+    return [t for t in aff.pod_anti_affinity.required
+            if _term_matches(t, labels, ns, ns)]
+
+
+def _job_domain_counts(ssn, job, key: str, vocab: Dict[str, int],
+                       selector, pairs=None) -> np.ndarray:
+    """Existing per-domain counts the spread/anti lowering seeds from:
+    the job's own assigned (resource-occupying) tasks when the selector
+    is empty (the gang case), else every assigned pod in the cluster the
+    selector matches. Domains outside ``vocab`` (labels of non-ready
+    nodes) are ignored — they can't receive placements this cycle.
+
+    ``pairs`` is an optional precomputed ``[(pod labels, domain code)]``
+    list of every resident pod on a labeled node (assign_spread_slots
+    builds it ONCE per cycle per key): matching against it replaces the
+    per-job all-nodes sweep that made the selector case O(jobs x nodes)
+    per cycle."""
+    counts = np.zeros(max(1, len(vocab)), np.float64)
+    if not selector:
+        if job is None:
+            return counts
+        for t in job.tasks.values():
+            if not t.node_name or not (allocated_status(t.status)
+                                       or t.status == TaskStatus.Running):
+                continue
+            ni = ssn.nodes.get(t.node_name)
+            v = ni.topology_value(key) if ni is not None else None
+            c = vocab.get(v) if v is not None else None
+            if c is not None:
+                counts[c] += 1.0
+        return counts
+    if pairs is not None:
+        for labels, c in pairs:
+            if all(req.matches(labels) for req in selector):
+                counts[c] += 1.0
+        return counts
+    for ni in ssn.nodes.values():
+        v = ni.topology_value(key)
+        c = vocab.get(v) if v is not None else None
+        if c is None:
+            continue
+        for t in ni.tasks.values():
+            if all(req.matches(t.pod.metadata.labels) for req in selector):
+                counts[c] += 1.0
+    return counts
+
+
+def has_constraints(ordered_jobs) -> bool:
+    """Cheap pre-gate: does any pending task carry a constraint the
+    compiler lowers (spread or required self-anti-affinity)?"""
+    for _, jtasks in ordered_jobs:
+        for t in jtasks:
+            spec = t.pod.spec
+            if spec.topology_spread:
+                return True
+            aff = spec.affinity
+            if aff is not None and aff.pod_anti_affinity is not None \
+                    and aff.pod_anti_affinity.required:
+                return True
+    return False
+
+
+def assign_spread_slots(ssn, ordered_jobs, names: List[str],
+                        split: bool = True):
+    """The slot-assignment pass: deterministically assign every hard-
+    spread / self-anti-affinity pending task a topology domain and
+    record the per-task allowed-domain sets.
+
+    With ``split`` (the REFERENCE lowering, and the host-context
+    default), also derive per-slot group sigs and return ``{task_uid:
+    derived_sig}`` for TaskBatch.build's ``sig_override`` (None when
+    nothing to split) — each assigned domain becomes its own task
+    group whose [G, N] mask row carries the restriction. The compiled
+    production path passes ``split=False`` (returns None): groups keep
+    their BASE sigs and the assignment lowers to the per-task
+    ``task_slot``/``slot_rows`` kernel inputs via
+    :func:`build_slot_tensors` instead — splitting a gang whose tasks
+    rotate domains made consecutive groups content-distinct, which
+    broke every candidate-table kernel's refresh amortization (the
+    19x constrained-kernel regression the bench gate caught).
+
+    Always stores ``ssn._constraint_slots = {task_uid: ((key, values,
+    hard), ...)}`` for the mask compiler and the host predicate
+    reference.
+    """
+    state = constraint_state(getattr(ssn, "cache", None))
+    # sync BEFORE the per-job loop so _topo_row hits the persistent
+    # rows (compile_mask's later sync is a no-op via the session flag);
+    # without this the first caller rebuilt every row per job
+    _sync_for_session(state, ssn, names)
+    # per-call memos shared across ALL jobs: topology rows (one
+    # _topo_row per key, not per job) and the resident-pod label pairs
+    # the selector-matching seed counts sweep
+    rows_memo: Dict[str, tuple] = {}
+    pairs_memo: Dict[str, list] = {}
+    live_memo: Dict[str, frozenset] = {}
+
+    def topo(key: str):
+        got = rows_memo.get(key)
+        if got is None:
+            got = rows_memo[key] = _topo_row(state, ssn, names, key)
+        return got
+
+    def live_codes(key: str) -> frozenset:
+        """Domain codes with at least one CURRENT node: the persistent
+        vocab only ever grows (codes must stay stable for the cached
+        rows), so a vanished domain — zone relabel, node removal —
+        lingers there with a zero seed count and would win the greedy
+        balance, pinning a replica to an all-false row forever."""
+        got = live_memo.get(key)
+        if got is None:
+            row, _vocab = topo(key)
+            got = live_memo[key] = frozenset(
+                int(c) for c in np.unique(row) if c >= 0)
+        return got
+
+    def resident_pairs(key: str) -> list:
+        got = pairs_memo.get(key)
+        if got is None:
+            row, _vocab = topo(key)
+            got = pairs_memo[key] = [
+                (t.pod.metadata.labels, int(row[i]))
+                for i, name in enumerate(names)
+                if row[i] >= 0
+                for ni in (ssn.nodes.get(name),) if ni is not None
+                for t in ni.tasks.values()]
+        return got
+
+    slots: Dict[str, tuple] = {}
+    override: Dict[str, int] = {}
+    for job, jtasks in ordered_jobs:
+        # constraints are per task SPEC (a volcano job's TaskSpecs can
+        # differ), but the greedy balance state is shared per (job,
+        # constraint identity) so same-constraint siblings spread
+        # against each other in task order
+        spread_state: Dict[tuple, tuple] = {}   # ck -> (values, proj)
+        anti_state: Dict[tuple, list] = {}      # ak -> mutable [free, next]
+        for t in jtasks:
+            spec = t.pod.spec
+            hard = [c for c in spec.topology_spread
+                    if c.when_unsatisfiable == "DoNotSchedule"]
+            anti = _self_anti_terms(t)
+            if not hard and not anti:
+                continue
+            entries: list = []
+            for c in hard:
+                ck = (c.topology_key, repr(c.label_selector))
+                cached = spread_state.get(ck)
+                if cached is None:
+                    _, vocab = topo(c.topology_key)
+                    base = _job_domain_counts(
+                        ssn, job, c.topology_key, vocab, c.label_selector,
+                        pairs=resident_pairs(c.topology_key)
+                        if c.label_selector else None) \
+                        if vocab else np.zeros(1)
+                    live = live_codes(c.topology_key)
+                    # [(value, code)] over LIVE domains, sorted by
+                    # domain VALUE: stable across node-order churn
+                    cached = (sorted((v, c2) for v, c2 in vocab.items()
+                                     if c2 in live), base.copy())
+                    spread_state[ck] = cached
+                values, proj = cached
+                if not values:
+                    # no ready node carries the label: all-false row
+                    entries.append((c.topology_key, (), True))
+                    continue
+                best = min(values, key=lambda vc: (proj[vc[1]], vc[0]))
+                proj[best[1]] += 1.0
+                entries.append((c.topology_key, (best[0],), True))
+            for term in anti:
+                ak = ("anti", term.topology_key, repr(term.label_selector))
+                st = anti_state.get(ak)
+                if st is None:
+                    _, vocab = topo(term.topology_key)
+                    base = _job_domain_counts(
+                        ssn, job, term.topology_key, vocab,
+                        term.label_selector,
+                        pairs=resident_pairs(term.topology_key)
+                        if term.label_selector else None) \
+                        if vocab else np.zeros(1)
+                    live = live_codes(term.topology_key)
+                    free = sorted(v for v, c2 in vocab.items()
+                                  if base[c2] == 0.0 and c2 in live)
+                    st = anti_state[ak] = [free, 0]
+                free, nxt = st
+                vals = (free[nxt],) if nxt < len(free) else ()
+                st[1] += 1
+                entries.append((term.topology_key, vals, True))
+            ent = tuple(entries)
+            slots[t.uid] = ent
+            if split:
+                base_sig = t.group_sig_cache \
+                    if t.group_sig_cache is not None else _group_sig(t)
+                override[t.uid] = derived_sig(base_sig, ent)
+    existing = getattr(ssn, "_constraint_slots", None)
+    if existing is None:
+        ssn._constraint_slots = slots
+    else:
+        existing.update(slots)   # later context builds refine, never drop
+    return override or None
+
+
+# A batch whose slot assignments intern to more distinct domain tuples
+# than this falls back to the reference split lowering: the native
+# solver materializes one candidate sub-table per slot, and an
+# unbounded slot axis would let an adversarial workload balloon it.
+SLOT_CAP = 64
+
+
+def count_batch_slots(ssn, ordered_jobs) -> int:
+    """Distinct slot-entry tuples among the batch's pending tasks (the
+    native sub-table axis height — checked against SLOT_CAP before the
+    tensor lowering is chosen)."""
+    slots = getattr(ssn, "_constraint_slots", None)
+    if not slots:
+        return 0
+    seen = set()
+    for _job, jtasks in ordered_jobs:
+        for t in jtasks:
+            ent = slots.get(t.uid)
+            if ent is not None:
+                seen.add(ent)
+    return len(seen)
+
+
+def derive_sig_overrides(ssn, ordered_jobs) -> Optional[Dict[str, int]]:
+    """The split-mode sig overrides from already-stored slot entries
+    (the SLOT_CAP-overflow fallback: assignment ran with split=False,
+    then the batch turned out to need the reference lowering)."""
+    slots = getattr(ssn, "_constraint_slots", None)
+    if not slots:
+        return None
+    override: Dict[str, int] = {}
+    for _job, jtasks in ordered_jobs:
+        for t in jtasks:
+            ent = slots.get(t.uid)
+            if ent is None:
+                continue
+            base_sig = t.group_sig_cache if t.group_sig_cache is not None \
+                else _group_sig(t)
+            override[t.uid] = derived_sig(base_sig, ent)
+    return override or None
+
+
+def build_slot_tensors(ssn, batch, narr):
+    """Lower the stored slot assignments to the kernels' per-task domain
+    inputs: (task_slot [t_pad] i32, slot_rows [S+1, n_pad] bool) or None
+    when no batch task carries a slot.
+
+    Slot ids intern on the entries TUPLE, so every job's "zone-3" tasks
+    share one row — S stays O(domains), not O(tasks). Row S is all-true
+    and unconstrained/padding tasks carry S; an unsatisfiable empty
+    assignment compiles to an all-false row (correct: no node can take
+    the task this cycle, the gang pipelines/rolls back exactly as if
+    the domain were full)."""
+    slots = getattr(ssn, "_constraint_slots", None)
+    if not slots:
+        return None
+    state = constraint_state(getattr(ssn, "cache", None))
+    names = narr.names
+    n = len(names)
+    t_pad = int(batch.task_group.shape[0])
+    ids: Dict[tuple, int] = {}
+    task_slot: Optional[np.ndarray] = None
+    for i, t in enumerate(batch.tasks):
+        ent = slots.get(t.uid)
+        if ent is None:
+            continue
+        sid = ids.get(ent)
+        if sid is None:
+            sid = ids[ent] = len(ids)
+        if task_slot is None:
+            task_slot = np.full(t_pad, -1, np.int32)
+        task_slot[i] = sid
+    if task_slot is None:
+        return None
+    S = len(ids)
+    task_slot[task_slot < 0] = S
+    rows = np.zeros((S + 1, narr.n_pad), bool)
+    rows[S] = True
+    for ent, sid in ids.items():
+        row = np.ones(n, bool)
+        for key, values, _hard in ent:
+            trow, vocab = _topo_row(state, ssn, names, key)
+            codes = [vocab[v] for v in values if v in vocab]
+            if codes:
+                row &= np.isin(trow, np.asarray(codes, np.int32))
+            else:
+                row[:] = False
+                break
+        rows[sid, :n] = row
+    return task_slot, rows
+
+
+def task_slot_entries(ssn, task) -> Optional[tuple]:
+    """The task's assigned-domain entries for the host per-pair predicate
+    probe; computed on demand (singleton greedy) when the task was never
+    part of a batch compile."""
+    slots = getattr(ssn, "_constraint_slots", None)
+    if slots is not None and task.uid in slots:
+        return slots[task.uid]
+    spec = task.pod.spec
+    hard = [c for c in spec.topology_spread
+            if c.when_unsatisfiable == "DoNotSchedule"]
+    anti = _self_anti_terms(task)
+    if not hard and not anti:
+        return None
+    names = [n.name for n in ssn.node_list]
+    job = ssn.jobs.get(task.job)
+    override = assign_spread_slots(ssn, [(job, [task])]
+                                   if job is not None else [(None, [task])],
+                                   names)
+    del override   # the singleton sig is irrelevant; entries were stored
+    return ssn._constraint_slots.get(task.uid)
+
+
+def node_satisfies_slots(ssn, task, node) -> bool:
+    """Host-path twin of the compiled slot mask (the per-pair reference
+    the parity tests pin)."""
+    entries = task_slot_entries(ssn, task)
+    if not entries:
+        return True
+    for key, values, _hard in entries:
+        v = node.topology_value(key)
+        if v is None or v not in values:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# the [G, N] compile passes
+# ---------------------------------------------------------------------------
+
+
+def compile_mask(ssn, batch, narr) -> Optional[np.ndarray]:
+    """Compiled constraint MASK for the batch: interpod required
+    (anti-)affinity + the spread/anti slot rows. None = all-pass (no
+    dense [G, N] transfer)."""
+    from ..plugins import interpod
+    t0 = time.perf_counter()
+    state = constraint_state(getattr(ssn, "cache", None))
+    names = narr.names
+    _sync_for_session(state, ssn, names)
+    mask: Optional[np.ndarray] = None
+    n = len(names)
+
+    def buf() -> np.ndarray:
+        nonlocal mask
+        if mask is None:
+            mask = np.ones((batch.g_pad, narr.n_pad), bool)
+        return mask
+
+    # interpod required terms (+ the existing-pod symmetry rule)
+    needs = {g for g, ti in enumerate(batch.group_first)
+             if interpod.task_has_pod_affinity(batch.tasks[ti])}
+    existing_aff = any(interpod.task_has_pod_affinity(t)
+                       for node in ssn.nodes.values()
+                       for t in node.tasks.values())
+    if needs or existing_aff:
+        index = interpod.get_index(ssn, names)
+        if index.anti_required:
+            needs = set(range(batch.n_groups))
+        for g in needs:
+            row = index.required_mask(batch.tasks[batch.group_first[g]])
+            if row is not None:
+                buf()[g, :n] &= row
+
+    # spread/anti slot rows — only when the context build did NOT
+    # already lower them through the selector feature pairs or the
+    # batch's per-task slot tensors (the normal vectorized paths do;
+    # this dense form serves host contexts built without slot lowering
+    # and the parity tests' direct calls). A tensor-carrying batch MUST
+    # skip them here: its groups are base groups, so a group-wide dense
+    # row would pin every task to the rep's domain.
+    slots = getattr(ssn, "_constraint_slots", None)
+    if slots and getattr(batch, "task_slot", None) is not None:
+        slots = None
+    if slots and not getattr(ssn, "_constraint_slots_lowered", False):
+        for g, ti in enumerate(batch.group_first):
+            entries = slots.get(batch.tasks[ti].uid)
+            if not entries:
+                continue
+            for key, values, _hard in entries:
+                row, vocab = _topo_row(state, ssn, names, key)
+                codes = [vocab[v] for v in values if v in vocab]
+                if codes:
+                    buf()[g, :n] &= np.isin(row, codes)
+                else:
+                    buf()[g, :n] = False
+    m.observe(m.CONSTRAINT_BUILD_LATENCY,
+              (time.perf_counter() - t0) * 1000.0)
+    trace.add_tags(constraint_rows_refreshed=state.last_refreshed
+                   if state is not None else 0)
+    return mask
+
+
+def compile_score(ssn, batch, narr, tiered_weight: float = 0.0,
+                  spread_weight: float = 10.0) -> Optional[np.ndarray]:
+    """Compiled additive SCORE: soft topology spread (ScheduleAnyway,
+    penalty proportional to a domain's existing load above the global
+    minimum) and priority-tiered packing alignment. None = all-zero."""
+    t0 = time.perf_counter()
+    state = constraint_state(getattr(ssn, "cache", None))
+    names = narr.names
+    n = len(names)
+    score: Optional[np.ndarray] = None
+
+    def buf() -> np.ndarray:
+        nonlocal score
+        if score is None:
+            score = np.zeros((batch.g_pad, narr.n_pad), np.float32)
+        return score
+
+    for g, ti in enumerate(batch.group_first):
+        if not spread_weight:
+            break
+        rep = batch.tasks[ti]
+        soft = [c for c in rep.pod.spec.topology_spread
+                if c.when_unsatisfiable != "DoNotSchedule"]
+        for c in soft:
+            row, vocab = _topo_row(state, ssn, names, c.topology_key)
+            if not vocab:
+                continue
+            job = ssn.jobs.get(rep.job)
+            base = _job_domain_counts(ssn, job, c.topology_key, vocab,
+                                      c.label_selector)
+            rel = base - base.min()
+            per_node = np.where(row >= 0, rel[np.maximum(row, 0)],
+                                rel.max() + 1.0)
+            buf()[g, :n] -= (spread_weight *
+                             per_node).astype(np.float32)
+
+    if tiered_weight:
+        mass, vocab = _tier_mass(state, ssn, names)
+        if vocab:
+            prios = np.full(max(vocab.values()) + 1, 0, np.int64)
+            for prio, col in vocab.items():
+                prios[col] = prio
+            total = mass[:, :len(prios)]
+            for g, ti in enumerate(batch.group_first):
+                p = _task_tier(ssn, batch.tasks[ti])
+                ge = total[:, prios >= p].sum(axis=1)
+                lt = total[:, prios < p].sum(axis=1)
+                raw = ge - lt
+                span = float(np.abs(raw).max())
+                if span > 0.0:
+                    buf()[g, :n] += (tiered_weight * 100.0 *
+                                     raw / span).astype(np.float32)
+    m.observe(m.CONSTRAINT_BUILD_LATENCY,
+              (time.perf_counter() - t0) * 1000.0)
+    return score
+
+
+# ---------------------------------------------------------------------------
+# the bit-identical Python reference + the fallback wrapper
+# ---------------------------------------------------------------------------
+
+
+def reference_mask(ssn, batch, narr) -> Optional[np.ndarray]:
+    """Per-(group, node) pure-Python evaluation of exactly the semantics
+    :func:`compile_mask` lowers — the parity oracle and the breaker
+    fallback. Deliberately unoptimized (per-pair predicate calls)."""
+    from ..plugins import interpod
+    names = narr.names
+    mask: Optional[np.ndarray] = None
+    existing_aff = any(interpod.task_has_pod_affinity(t)
+                       for node in ssn.nodes.values()
+                       for t in node.tasks.values())
+    index = interpod.get_index(ssn, names)
+    # a tensor-carrying batch keeps BASE groups: its per-task domains
+    # ride the kernel's task_slot/slot_ok inputs, never a group row
+    tensor_batch = getattr(batch, "task_slot", None) is not None
+    for g, ti in enumerate(batch.group_first):
+        rep = batch.tasks[ti]
+        rows_needed = interpod.task_has_pod_affinity(rep) or existing_aff
+        irow = index.required_mask(rep) if rows_needed else None
+        entries = None if tensor_batch else task_slot_entries(ssn, rep)
+        if irow is None and not entries:
+            continue
+        if mask is None:
+            mask = np.ones((batch.g_pad, narr.n_pad), bool)
+        for i, name in enumerate(names):
+            ok = True
+            if irow is not None and not irow[i]:
+                ok = False
+            if ok and entries:
+                ok = node_satisfies_slots(ssn, rep, ssn.nodes[name])
+            mask[g, i] &= ok
+    return mask
+
+
+def compile_conf(ssn) -> str:
+    """The ``constraints.compile`` solver argument: "auto" (default,
+    compiled pass with the reference as crash fallback) or "off" (force
+    the per-pair Python reference — the parity-smoke control run)."""
+    args = (getattr(ssn, "configurations", None) or {}).get("solver")
+    if args is not None and hasattr(args, "get_str"):
+        return (args.get_str("constraints.compile", "auto")
+                or "auto").strip().lower()
+    return "auto"
+
+
+def masked_or_reference(ssn, batch, narr) -> Optional[np.ndarray]:
+    """compile_mask with the breaker fallback to the Python reference: a
+    compile crash must cost log noise, never the cycle. ``constraints.
+    compile: off`` (solver conf) forces the reference outright — the
+    constraint-smoke control run proving both strategies place
+    identically."""
+    if compile_conf(ssn) == "off":
+        m.inc(m.CONSTRAINT_BUILD_RUNS, mode="reference")
+        return reference_mask(ssn, batch, narr)
+    try:
+        mask = compile_mask(ssn, batch, narr)
+        m.inc(m.CONSTRAINT_BUILD_RUNS, mode="compiled")
+        return mask
+    except Exception:
+        _logger.exception("constraint compile crashed; falling back to "
+                          "the per-task Python reference for this cycle")
+        m.inc(m.CONSTRAINT_FALLBACK)
+        return reference_mask(ssn, batch, narr)
+
+
+def split_assign_or_exclude(ssn, ordered_jobs, names: List[str]):
+    """``assign_spread_slots(split=True)`` with last-resort containment:
+    if the ASSIGNMENT itself crashes, the constraint-carrying jobs are
+    excluded from this cycle's batch — their gangs stay pending exactly
+    like an unsatisfiable slot — instead of the crash aborting run_once.
+    The mask/tensor fallbacks upstream can't help here: every lowering
+    (compiled AND split reference) consumes the slot assignments, so a
+    deterministic assignment crash would otherwise stop ALL scheduling
+    while the triggering object exists. Returns (sig_override,
+    ordered_jobs)."""
+    try:
+        return assign_spread_slots(ssn, ordered_jobs, names), ordered_jobs
+    except Exception:
+        _logger.exception(
+            "constraint slot assignment crashed; excluding constrained "
+            "jobs from this cycle (unconstrained work keeps scheduling)")
+        m.inc(m.CONSTRAINT_FALLBACK)
+        kept = [jj for jj in ordered_jobs if not has_constraints([jj])]
+        return None, kept
+
+
+def score_or_fallback(ssn, batch, narr, tiered_weight: float = 0.0,
+                      spread_weight: float = 10.0) -> Optional[np.ndarray]:
+    """compile_score with the same crash contract as the mask side: log
+    noise, never the cycle. The additive score is a PREFERENCE (soft
+    spread / tiered packing) with no per-pair reference twin, so it runs
+    under BOTH `constraints.compile` modes (that is what keeps the
+    smoke's `off` control outcome-parity with the compiled runs) and a
+    crash degrades to no score for the cycle."""
+    try:
+        return compile_score(ssn, batch, narr,
+                             tiered_weight=tiered_weight,
+                             spread_weight=spread_weight)
+    except Exception:
+        _logger.exception("constraint score compile crashed; dropping "
+                          "the additive constraint score for this cycle")
+        m.inc(m.CONSTRAINT_FALLBACK)
+        return None

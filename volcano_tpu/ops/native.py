@@ -37,11 +37,11 @@ class _Args(ctypes.Structure):
         ("J", ctypes.c_int32), ("Q", ctypes.c_int32),
         ("P", ctypes.c_int32), ("NS", ctypes.c_int32),
         ("N", ctypes.c_int32), ("R", ctypes.c_int32),
-        ("C2", ctypes.c_int32),
+        ("C2", ctypes.c_int32), ("S", ctypes.c_int32),
         ("task_group", ctypes.c_void_p), ("task_job", ctypes.c_void_p),
-        ("task_valid", ctypes.c_void_p),
+        ("task_valid", ctypes.c_void_p), ("task_slot", ctypes.c_void_p),
         ("group_req", ctypes.c_void_p), ("group_mask", ctypes.c_void_p),
-        ("group_static", ctypes.c_void_p),
+        ("group_static", ctypes.c_void_p), ("slot_ok", ctypes.c_void_p),
         ("task_bucket", ctypes.c_void_p), ("pack_bonus", ctypes.c_void_p),
         ("job_min", ctypes.c_void_p), ("job_base", ctypes.c_void_p),
         ("job_start", ctypes.c_void_p), ("job_ntasks", ctypes.c_void_p),
@@ -74,7 +74,7 @@ def _load():
         lib = ctypes.CDLL(path)
         lib.vc_gang_allocate.restype = ctypes.c_int
         lib.vc_gang_allocate.argtypes = [ctypes.POINTER(_Args)]
-        if lib.vc_abi_version() != 1:
+        if lib.vc_abi_version() != 2:
             raise RuntimeError("native solver ABI mismatch")
         _lib = lib
     except Exception as e:   # missing toolchain, build failure
@@ -109,11 +109,20 @@ def gang_allocate_native(task_group, task_job, task_valid, group_req,
                          node_future, node_alloc, node_ntasks,
                          node_max_tasks, eps, weights,
                          allow_pipeline: bool = True,
-                         ns_live: bool = False):
+                         ns_live: bool = False,
+                         task_slot=None, slot_ok=None):
     """Same signature/returns as ops.allocate.gang_allocate; numpy outputs.
 
     ``job_n_tasks`` may be the TaskBatch property (end-start); ``job_queue``
     is accepted for signature parity but unused (pool tables carry it).
+
+    ``task_slot``/``slot_ok`` are the constraint compiler's per-task
+    topology-domain restriction (task t only uses nodes where
+    ``slot_ok[task_slot[t]]``; value S = unconstrained). The C solver
+    keeps one candidate sub-table per slot alongside the global table,
+    all rebuilt in the ONE refresh sweep, so a gang whose tasks rotate
+    domains amortizes refreshes exactly like an unconstrained gang
+    (solver.cc documents the sub-table exactness argument).
     """
     lib = _load()
     if lib is None:
@@ -147,6 +156,14 @@ def gang_allocate_native(task_group, task_job, task_valid, group_req,
     node_max = _c(node_max_tasks, np.int32)
     eps = _c(eps, np.float32)
     binpack_res = _c(weights.binpack_res, np.float32)
+    S = 0
+    if task_slot is not None and slot_ok is not None:
+        task_slot = _c(task_slot, np.int32)
+        slot_ok = _c(slot_ok, np.uint8)
+        S = int(slot_ok.shape[0]) - 1   # row S is the all-true row
+    else:
+        task_slot = None
+        slot_ok = None
 
     T = task_group.shape[0]
     G, R = group_req.shape
@@ -164,13 +181,18 @@ def gang_allocate_native(task_group, task_job, task_valid, group_req,
     kept = np.zeros(J, np.uint8)
     out_idle = np.zeros((N, R), np.float32)
 
+    if slot_ok is not None:
+        assert slot_ok.shape == (S + 1, N), (slot_ok.shape, (S + 1, N))
+        assert task_slot.shape == (T,)
     args = _Args(
         T=T, G=G, J=J, Q=Q, P=P, NS=NS, N=N, R=R,
-        C2=max(8, min(_C2, N)),
+        C2=max(8, min(_C2, N)), S=S,
         task_group=_ptr(task_group), task_job=_ptr(task_job),
         task_valid=_ptr(task_valid),
+        task_slot=_ptr(task_slot) if task_slot is not None else None,
         group_req=_ptr(group_req), group_mask=_ptr(group_mask),
         group_static=_ptr(group_static),
+        slot_ok=_ptr(slot_ok) if slot_ok is not None else None,
         task_bucket=_ptr(task_bucket), pack_bonus=_ptr(pack_bonus),
         job_min=_ptr(job_min), job_base=_ptr(job_base),
         job_start=_ptr(job_start), job_ntasks=_ptr(job_ntasks),

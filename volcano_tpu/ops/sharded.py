@@ -143,8 +143,13 @@ def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
                   ns_alloc0, ns_total, queue_deserved, queue_alloc0,
                   node_idle, node_future, node_alloc, node_ntasks,
                   node_max_tasks, eps, weights, allow_pipeline: bool,
-                  ns_live: bool, axis: str):
-    """Runs inside shard_map: node-axis arrays are the local shard."""
+                  ns_live: bool, axis: str, task_slot=None, slot_ok=None):
+    """Runs inside shard_map: node-axis arrays are the local shard.
+
+    ``task_slot``/``slot_ok`` are the per-task topology-domain rows of
+    the constraint compiler (ops/allocate.gang_allocate documents the
+    contract); ``slot_ok`` is sharded along the node axis like every
+    other [*, N] input."""
     T = task_group.shape[0]
     J = job_min_available.shape[0]
     Nl = node_idle.shape[0]
@@ -168,6 +173,8 @@ def _sharded_body(task_group, task_job, task_valid, group_req, group_mask,
 
         req = group_req[g]
         static_ok = group_mask[g]                      # [Nl]
+        if task_slot is not None:
+            static_ok = static_ok & slot_ok[task_slot[t_idx]]
         pods_ok = (node_max_tasks == 0) | (state.n_tasks < node_max_tasks)
         base_ok = static_ok & pods_ok & valid
 
@@ -258,7 +265,8 @@ def _sharded_body_chunked(task_group, task_job, task_valid, group_req,
                           node_future, node_alloc, node_ntasks,
                           node_max_tasks, eps, weights,
                           allow_pipeline: bool, ns_live: bool, axis: str,
-                          chunk: int, n_dev: int = 1):
+                          chunk: int, n_dev: int = 1,
+                          task_slot=None, slot_ok=None):
     """Chunked-candidate variant of :func:`_sharded_body`: instead of one
     all-gather per scan step, each shard gathers its top-``chunk``
     candidates per fit class (idle / future) into a replicated candidate
@@ -275,6 +283,14 @@ def _sharded_body_chunked(task_group, task_job, task_valid, group_req,
     remains in the table whenever the outside node would have won.
     ``lax.top_k``'s lowest-index tie order matches the kernel's global
     lowest-node-index tie-break.
+
+    Per-task topology domains (``task_slot``/``slot_ok``) join the
+    refresh condition: a slot change refreshes the table with the slot
+    row folded into the mask, so every serve's table was built under the
+    serving task's own domain — the membership half of the exactness
+    argument is untouched. (The NATIVE solver instead keeps per-slot
+    sub-tables so rotating-domain gangs don't refresh per task; here the
+    chunked tier is the fallback/parity path, not the at-scale one.)
     """
     T = task_group.shape[0]
     J = job_min_available.shape[0]
@@ -301,23 +317,27 @@ def _sharded_body_chunked(task_group, task_job, task_valid, group_req,
                              queue_alloc0, ns_alloc0, pool_njobs, eps, J)
     cand0 = jnp.full((K, F), NEG, jnp.float32).at[:, 0].set(-1.0)
     carry0 = (init, cand0, jnp.int32(C), jnp.int32(-1), jnp.int32(-1),
-              jnp.bool_(True))
+              jnp.int32(-1), jnp.bool_(True))
 
     def step(carry, _):
-        state, cand, since, prev_g, prev_b, force = carry
+        state, cand, since, prev_g, prev_b, prev_s, force = carry
         active = state.cur_job >= 0
         job = jnp.maximum(state.cur_job, 0)
         t_idx = jnp.clip(job_task_start[job] + state.t_off, 0, T - 1)
         g = task_group[t_idx]
         b = task_bucket[t_idx]
+        slot = task_slot[t_idx] if task_slot is not None else jnp.int32(-1)
         valid = task_valid[t_idx] & active & \
             (state.t_off < job_n_tasks[job])
         req = group_req[g]
 
-        need = force | (since >= C) | (g != prev_g) | (b != prev_b)
+        need = force | (since >= C) | (g != prev_g) | (b != prev_b) | \
+            (slot != prev_s)
 
         def refresh(_):
             static_ok = group_mask[g]
+            if task_slot is not None:
+                static_ok = static_ok & slot_ok[slot]
             pods_ok = (node_max_tasks == 0) | \
                 (state.n_tasks < node_max_tasks)
             base_ok = static_ok & pods_ok
@@ -423,7 +443,7 @@ def _sharded_body_chunked(task_group, task_job, task_valid, group_req,
                                     job_min_available)
         emit_t = jnp.where(valid, t_idx, T)
         emit_sel = jnp.where(placed_ok, sel_g, -1)
-        return (state, cand, since, g, b, roll), \
+        return (state, cand, since, g, b, slot, roll), \
             (emit_t, emit_sel, pipelined)
 
     (state, *_), (emit_t, emit_sel, emit_pipe) = jax.lax.scan(
@@ -434,13 +454,18 @@ def _sharded_body_chunked(task_group, task_job, task_valid, group_req,
 
 def make_sharded_gang_allocate(mesh: Mesh, axis: str = "nodes",
                                allow_pipeline: bool = True,
-                               chunk: int = 16, ns_live: bool = False):
+                               chunk: int = 16, ns_live: bool = False,
+                               with_slots: bool = False):
     """Build the jitted node-sharded gang-allocate for a device mesh.
 
     Node-axis inputs ([N,...] and [G,N]) must be padded so N divides the mesh
     size. Same argument order as ops.allocate.gang_allocate (minus the
     weights keyword); returns (assign [T] global node index, pipelined [T],
     ready [J], kept [J], final node idle [N,R]).
+
+    ``with_slots`` appends two trailing positional inputs — the
+    constraint compiler's ``task_slot`` [T] (replicated) and ``slot_ok``
+    [S+1, N] (node-sharded like the other [*, N] inputs).
     """
     n = P(axis)               # [N] vectors
     nr = P(axis, None)        # [N, R]
@@ -452,13 +477,27 @@ def make_sharded_gang_allocate(mesh: Mesh, axis: str = "nodes",
                 rep, rep,
                 nr, nr, nr, n, n, rep,
                 ScoreWeights(rep, rep, rep, rep, rep))
+    if with_slots:
+        in_specs = in_specs + (rep, gn)
     out_specs = (rep, rep, rep, rep, nr)
     if chunk and chunk > 1:
-        body = partial(_sharded_body_chunked, allow_pipeline=allow_pipeline,
+        base = _sharded_body_chunked
+        if with_slots:
+            def base(*args, **kw):
+                *pos, tslot, sok = args
+                return _sharded_body_chunked(*pos, task_slot=tslot,
+                                             slot_ok=sok, **kw)
+        body = partial(base, allow_pipeline=allow_pipeline,
                        ns_live=ns_live, axis=axis, chunk=int(chunk),
                        n_dev=int(mesh.devices.size))
     else:
-        body = partial(_sharded_body, allow_pipeline=allow_pipeline,
+        base = _sharded_body
+        if with_slots:
+            def base(*args, **kw):
+                *pos, tslot, sok = args
+                return _sharded_body(*pos, task_slot=tslot, slot_ok=sok,
+                                     **kw)
+        body = partial(base, allow_pipeline=allow_pipeline,
                        ns_live=ns_live, axis=axis)
     try:
         sm = shard_map(body, mesh=mesh, in_specs=in_specs,

@@ -170,7 +170,8 @@ class PredicatesPlugin(Plugin):
             ssn.solver.enable_default_predicates = True
             ssn.solver.mark_vectorized(NAME)
             ssn.solver.add_mask_fn(self._ports_and_gpu_mask(ssn))
-            ssn.solver.add_mask_fn(self._interpod_mask(ssn))
+            ssn.solver.add_mask_fn(self._constraint_mask(ssn))
+            ssn.solver.add_static_score_fn(self._constraint_score(ssn))
             if self.proportional:
                 ssn.solver.add_mask_fn(self._proportional_mask())
 
@@ -229,6 +230,15 @@ class PredicatesPlugin(Plugin):
                         raise FitException(FitError(
                             task=task, node=node,
                             reasons=[POD_AFFINITY_FAILED]))
+            # topology-spread / self-anti slot assignment (the per-pair
+            # reference of the compiled constraint mask — identical
+            # semantics by construction, parity-pinned)
+            from ..ops import constraints
+            if not constraints.node_satisfies_slots(ssn, task, node):
+                raise FitException(FitError(
+                    task=task, node=node,
+                    reasons=["node(s) didn't satisfy topology spread "
+                             "constraints"]))
             # proportional resource reserve (predicates.go:353-361)
             if self.proportional and \
                     not _proportional_ok(task, node, self.proportional):
@@ -268,31 +278,24 @@ class PredicatesPlugin(Plugin):
             return mask
         return mask_fn
 
-    def _interpod_mask(self, ssn):
-        from . import interpod
+    def _constraint_mask(self, ssn):
+        """The compiled constraint MASK (ops/constraints.py): interpod
+        required (anti-)affinity + the topology-spread / self-anti slot
+        rows, with the per-task Python reference as the crash fallback."""
+        from ..ops import constraints
 
         def mask_fn(batch, narr, feats):
-            needs = {g for g, ti in enumerate(batch.group_first)
-                     if interpod.task_has_pod_affinity(batch.tasks[ti])}
-            # the symmetry rule can constrain affinity-free groups too, but
-            # only when some existing pod carries required anti-affinity —
-            # check cheaply before indexing everything
-            existing_aff = any(interpod.task_has_pod_affinity(t)
-                               for node in ssn.nodes.values()
-                               for t in node.tasks.values())
-            if not needs and not existing_aff:
-                return None   # pass-through, no dense [G,N] transfer
-            mask = np.ones((batch.g_pad, narr.n_pad), bool)
-            index = interpod.get_index(ssn, narr.names)
-            if index.anti_required:
-                needs = set(range(batch.n_groups))
-            n = len(narr.names)
-            for g in needs:
-                m = index.required_mask(batch.tasks[batch.group_first[g]])
-                if m is not None:
-                    mask[g, :n] &= m
-            return mask
+            return constraints.masked_or_reference(ssn, batch, narr)
         return mask_fn
+
+    def _constraint_score(self, ssn):
+        """The compiled constraint SCORE: soft (ScheduleAnyway) topology
+        spread; priority-tiered packing rides the priority plugin."""
+        from ..ops import constraints
+
+        def score_fn(batch, narr, feats):
+            return constraints.score_or_fallback(ssn, batch, narr)
+        return score_fn
 
     def _ports_and_gpu_mask(self, ssn):
         def mask_fn(batch, narr, feats):

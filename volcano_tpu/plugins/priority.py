@@ -1,7 +1,11 @@
 """priority plugin (reference: pkg/scheduler/plugins/priority/priority.go).
 
 TaskOrder/JobOrder by priority; Preemptable admits only strictly
-lower-priority victims.
+lower-priority victims. With ``tieredpack.weight`` set, the plugin also
+contributes the priority-tiered packing score (arxiv 2511.08373,
+lowered by ops/constraints.py): groups pack toward nodes resident to
+their own-or-higher priority tier and away from lower-tier nodes, so
+high-priority work lands where future preemption fallout is smallest.
 """
 
 from __future__ import annotations
@@ -16,11 +20,24 @@ NAME = "priority"
 class PriorityPlugin(Plugin):
     def __init__(self, arguments=None):
         self.arguments = arguments or {}
+        args = self.arguments
+        get_f = args.get_float if hasattr(args, "get_float") else \
+            (lambda k, d: float(args.get(k, d) or d))
+        self.tieredpack_w = get_f("tieredpack.weight", 0.0)
 
     def name(self) -> str:
         return NAME
 
     def on_session_open(self, ssn) -> None:
+        if self.tieredpack_w and ssn.solver is not None:
+            from ..ops import constraints
+
+            def tiered_score(batch, narr, feats):
+                return constraints.score_or_fallback(
+                    ssn, batch, narr, tiered_weight=self.tieredpack_w,
+                    spread_weight=0.0)   # spread rides the predicates plugin
+            ssn.solver.add_static_score_fn(tiered_score)
+
         def task_order_fn(l, r):
             if l.priority == r.priority:
                 return 0

@@ -76,9 +76,20 @@ class SimConfig:
     # (name, weight, capability resource-list or None)
     queues: List[tuple] = field(
         default_factory=lambda: [("default", 1, None)])
+    # topology labels for the placement constraints
+    # (docs/design/constraints.md): >0 stamps every node with
+    # topology.kubernetes.io/zone = zone-<idx % node_zones> (derived from
+    # the node NAME, so a killed node re-adds into its old zone and
+    # replays stay deterministic)
+    node_zones: int = 0
+    # PriorityClass objects created at base setup: [(name, value)] —
+    # preemption storms need real priority tiers, which the arrival
+    # events reference by class name
+    priority_classes: List[tuple] = field(default_factory=list)
     conf_text: str = DEFAULT_CONF
     resident_jobs: int = 0                # t=0 backlog gangs
     resident_gang: int = 8
+    resident_min: int = 0                 # 0 = full gang; lower = elastic
     workload: WorkloadConfig = field(default_factory=WorkloadConfig)
     faults: FaultConfig = field(default_factory=FaultConfig)
     # fraction of jobs whose gang loses a pod mid-run (lifecycle "fail")
@@ -146,6 +157,7 @@ class TickStats:
 class SimResult:
     def __init__(self):
         self.bind_sequence: List[Tuple[str, str]] = []   # (pod key, node)
+        self.evict_sequence: List[str] = []              # pod keys, in order
         self.violations: List[Tuple[int, Violation]] = []  # (tick, v)
         self.ticks: List[TickStats] = []
         self.events_applied: List[Event] = []
@@ -185,6 +197,17 @@ class SimResult:
             h.update(f"{key}->{host}\n".encode())
         return h.hexdigest()
 
+    def outcome_fingerprint(self) -> str:
+        """Binds AND evictions in one digest — the constraint-smoke
+        parity surface (victim selection shows up in WHO got evicted,
+        not just in where the preemptors later bind)."""
+        h = hashlib.sha256()
+        for key, host in self.bind_sequence:
+            h.update(f"bind {key}->{host}\n".encode())
+        for key in self.evict_sequence:
+            h.update(f"evict {key}\n".encode())
+        return h.hexdigest()
+
     def cycle_ms_percentiles(self, skip: int = 0) -> Dict[str, float]:
         """Nearest-rank percentiles over the tick cycle latencies;
         ``skip`` drops leading ticks (bench's steady-state view excludes
@@ -203,7 +226,9 @@ class SimResult:
             "arrived_jobs": self.arrived_jobs,
             "completed_jobs": self.completed_jobs,
             "binds": len(self.bind_sequence),
+            "evictions": len(self.evict_sequence),
             "bind_fingerprint": self.bind_fingerprint(),
+            "outcome_fingerprint": self.outcome_fingerprint(),
             "resync_retries": self.resync_retries,
             "quarantined": list(self.quarantined),
             "restarts": self.restarts,
@@ -267,6 +292,7 @@ class SimEngine:
         # node name -> (cpu, mem, pods) for kill/re-add cycles
         self._node_catalog: Dict[str, tuple] = {}
         self._bind_cursor = 0
+        self._evict_cursor = 0
         # gang-atomicity convergence streaks (invariants.py): persists
         # across per-tick CycleContexts
         self._partial_streaks: Dict[str, int] = {}
@@ -422,7 +448,8 @@ class SimEngine:
             horizon = cfg.ticks * cfg.tick_s
             events = []
             events += resident_backlog(cfg.resident_jobs, cfg.resident_gang,
-                                       queue=cfg.queues[0][0])
+                                       queue=cfg.queues[0][0],
+                                       min_available=cfg.resident_min)
             events += synthesize_arrivals(cfg.workload)
             node_names = [f"node-{i}" for i in range(cfg.n_nodes)]
             events += synthesize_node_churn(cfg.faults, node_names, horizon)
@@ -437,15 +464,32 @@ class SimEngine:
         for name, weight, capability in cfg.queues:
             self.store.create("queues", build_queue(
                 name, weight=weight, capability=capability))
+        for name, value in cfg.priority_classes:
+            from ..models.objects import ObjectMeta, PriorityClass
+            self.store.create("priorityclasses", PriorityClass(
+                metadata=ObjectMeta(name=name), value=int(value)))
         for i in range(cfg.n_nodes):
             self._add_node(f"node-{i}", cfg.node_cpu, cfg.node_mem,
                            cfg.node_pods)
         self.cache.run()
 
+    def _node_labels(self, name: str) -> Dict[str, str]:
+        """Deterministic topology labels from the node NAME (zone
+        membership must survive kill/re-add cycles and trace replays)."""
+        if self.cfg.node_zones <= 0:
+            return {}
+        try:
+            idx = int(name.rsplit("-", 1)[1])
+        except (IndexError, ValueError):
+            idx = sum(name.encode()) % max(1, self.cfg.node_zones)
+        from .workload import ZONE_KEY
+        return {ZONE_KEY: f"zone-{idx % self.cfg.node_zones}"}
+
     def _add_node(self, name: str, cpu: str, mem: str, pods: str) -> None:
         self._node_catalog[name] = (cpu, mem, pods)
         self.store.create("nodes", build_node(
-            name, {"cpu": cpu, "memory": mem, "pods": pods}))
+            name, {"cpu": cpu, "memory": mem, "pods": pods},
+            labels=self._node_labels(name)))
 
     # -- event application -------------------------------------------------
 
@@ -465,9 +509,34 @@ class SimEngine:
             name, ns, e["queue"], int(e["min_available"]), phase="Inqueue",
             priority_class=e.get("priority_class", "")))
         for t in range(int(e["size"])):
-            self.store.create("pods", build_pod(
+            pod = build_pod(
                 ns, f"{name}-{t}", "", "Pending",
-                {"cpu": e["cpu"], "memory": e["mem"]}, groupname=name))
+                {"cpu": e["cpu"], "memory": e["mem"]}, groupname=name,
+                labels={"sim-job": name} if e.get("anti_key") else None)
+            self._apply_constraints(pod, e)
+            self.store.create("pods", pod)
+
+    @staticmethod
+    def _apply_constraints(pod, e: Event) -> None:
+        """Materialize the arrival event's optional placement-constraint
+        fields onto the pod spec (docs/design/constraints.md)."""
+        if e.get("spread_key"):
+            from ..models.objects import TopologySpreadConstraint
+            pod.spec.topology_spread = [TopologySpreadConstraint(
+                max_skew=int(e.get("spread_skew", 1)),
+                topology_key=e["spread_key"],
+                when_unsatisfiable=("DoNotSchedule"
+                                    if e.get("spread_mode", "hard") == "hard"
+                                    else "ScheduleAnyway"))]
+        if e.get("anti_key"):
+            from ..models.objects import (Affinity, NodeSelectorRequirement,
+                                          PodAffinity, PodAffinityTerm)
+            pod.spec.affinity = Affinity(pod_anti_affinity=PodAffinity(
+                required=[PodAffinityTerm(
+                    label_selector=[NodeSelectorRequirement(
+                        key="sim-job", operator="In",
+                        values=[e["name"]])],
+                    topology_key=e["anti_key"])]))
 
     def _ev_job_complete(self, e: Event) -> None:
         ns, name = e["namespace"], e["name"]
@@ -631,6 +700,10 @@ class SimEngine:
             self._bind_cursor += 1
             self.result.bind_sequence.append((key, self.binder.binds[key]))
             new += 1
+        echan = self.evictor.channel
+        while self._evict_cursor < len(echan):
+            self.result.evict_sequence.append(echan[self._evict_cursor])
+            self._evict_cursor += 1
         return new
 
     # -- main loop ---------------------------------------------------------

@@ -13,7 +13,12 @@ Kinds interpreted by the engine:
 
 ``job_arrival``    name, namespace, queue, size, min_available, cpu, mem,
                    duration (virtual seconds of service after full bind),
-                   priority_class
+                   priority_class; optional placement constraints
+                   (docs/design/constraints.md): spread_key/spread_skew/
+                   spread_mode ("hard"|"soft") put a topology-spread
+                   constraint on every pod of the gang, anti_key puts a
+                   required self-anti-affinity term over that topology
+                   key (one replica per domain)
 ``job_complete``   name, namespace — gang finishes as a unit (MPI-style):
                    pods + podgroup deleted
 ``pod_fail``       name, namespace, task — one pod dies (marks the job

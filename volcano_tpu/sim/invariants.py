@@ -32,6 +32,13 @@ Catalog (docs/design/simulation.md carries the prose version):
   gap-free, its tail matches the watch-visible resource version, and no
   reservation (sharded bind flush, docs/design/bind_pipeline.md) is
   left open at the tick boundary: no parked entries, no in-flight keys.
+* ``spread_skew`` — hard (DoNotSchedule) topology-spread constraints are
+  honored at placement: a fully-placed full gang's per-domain counts stay
+  within ``max_skew``, and no constrained pod lands on a node missing the
+  topology label (docs/design/constraints.md).
+* ``anti_affinity`` — required self-anti-affinity is honored: no two
+  allocated siblings selected by the same required term share that
+  term's topology domain (the one-replica-per-domain idiom).
 * ``no_silent_rebind`` — a bound pod's node never changes without an
   observed unbind (node_name cleared by a gang heal) or delete between
   the two placements. The signature of a DEPOSED leader double-binding
@@ -395,9 +402,95 @@ def check_no_silent_rebind(ctx: CycleContext) -> List[Violation]:
     return out
 
 
+def _node_topology_value(ctx: CycleContext, node_name: str, key: str):
+    ni = ctx.cache.nodes.get(node_name)
+    if ni is not None:
+        v = ni.topology_value(key)
+        if v is not None:
+            return v
+    n = ctx.store.get("nodes", node_name)
+    return n.metadata.labels.get(key) if n is not None else None
+
+
+def check_spread_skew(ctx: CycleContext) -> List[Violation]:
+    """Hard topology-spread honored at placement: for every FULL gang
+    (min_available == gang size — the shape whose membership preemption
+    and gang healing never shrink) carrying a DoNotSchedule spread
+    constraint and untouched by churn, the per-domain counts of its
+    allocated tasks stay within max_skew once the gang is fully placed.
+    Partially-placed gangs are the gang_atomicity checker's business;
+    jobs whose pods churned away (node kill, evict storm, pod_fail) can
+    skew without scheduler fault and are exempt like everywhere else."""
+    out: List[Violation] = []
+    for key, job in ctx.cache.jobs.items():
+        if key in ctx.dirty_jobs or job.min_available < len(job.tasks) \
+                or not job.tasks:
+            continue
+        placed = [t for t in job.tasks.values()
+                  if t.node_name and allocated_status(t.status)]
+        if len(placed) < len(job.tasks):
+            continue   # not fully placed this tick
+        rep = next(iter(job.tasks.values()))
+        for c in rep.pod.spec.topology_spread:
+            if c.when_unsatisfiable != "DoNotSchedule":
+                continue
+            counts: Dict[str, int] = {}
+            unlabeled = 0
+            for t in placed:
+                v = _node_topology_value(ctx, t.node_name, c.topology_key)
+                if v is None:
+                    unlabeled += 1
+                else:
+                    counts[v] = counts.get(v, 0) + 1
+            if unlabeled:
+                out.append(Violation(
+                    "spread_skew",
+                    f"job {key}: {unlabeled} pod(s) placed on nodes "
+                    f"missing topology label {c.topology_key} despite a "
+                    "hard spread constraint over it"))
+            if counts and max(counts.values()) - min(counts.values()) \
+                    > c.max_skew:
+                out.append(Violation(
+                    "spread_skew",
+                    f"job {key}: per-{c.topology_key} counts {counts} "
+                    f"violate max_skew {c.max_skew}"))
+    return out
+
+
+def check_anti_affinity(ctx: CycleContext) -> List[Violation]:
+    """Required self-anti-affinity honored at placement: no two allocated
+    siblings matched by the same required pod-anti-affinity term share
+    that term's topology domain. Scoped to SELF-matching terms (the
+    one-replica-per-domain gang idiom the compiler lowers); churn-dirty
+    jobs are exempt for the same reason as everywhere else."""
+    from ..ops.constraints import _self_anti_terms
+    out: List[Violation] = []
+    for key, job in ctx.cache.jobs.items():
+        if key in ctx.dirty_jobs or not job.tasks:
+            continue
+        rep = next(iter(job.tasks.values()))
+        for term in _self_anti_terms(rep):
+            domains: Dict[str, List[str]] = {}
+            for t in job.tasks.values():
+                if not t.node_name or not allocated_status(t.status):
+                    continue
+                v = _node_topology_value(ctx, t.node_name,
+                                         term.topology_key)
+                if v is not None:
+                    domains.setdefault(v, []).append(t.key())
+            for v, pods in domains.items():
+                if len(pods) > 1:
+                    out.append(Violation(
+                        "anti_affinity",
+                        f"job {key}: pods {pods} share "
+                        f"{term.topology_key}={v} despite required "
+                        "self-anti-affinity over that key"))
+    return out
+
+
 CHECKERS = (check_node_accounting, check_gang_atomicity, check_queue_quota,
             check_no_orphans, check_snapshot_coherence, check_journal_order,
-            check_no_silent_rebind)
+            check_no_silent_rebind, check_spread_skew, check_anti_affinity)
 
 
 def check_all(ctx: CycleContext) -> List[Violation]:

@@ -19,6 +19,8 @@ from typing import Dict, List
 
 from .events import Event, make_event, validate_event
 
+ZONE_KEY = "topology.kubernetes.io/zone"
+
 
 @dataclass
 class WorkloadConfig:
@@ -36,6 +38,19 @@ class WorkloadConfig:
     queues: List[str] = field(default_factory=lambda: ["default"])
     namespace: str = "default"
     priority_class_rate: float = 0.0    # fraction tagged "high"
+    # placement-constraint mix (docs/design/constraints.md): fractions of
+    # arriving gangs carrying a HARD zone topology-spread (max-skew 1,
+    # min_available == size so the per-tick skew invariant is exact), a
+    # SOFT (ScheduleAnyway) spread, or pair self-anti-affinity over the
+    # zone key (one replica per zone). Disjoint draws off the same rng.
+    spread_rate: float = 0.0
+    soft_spread_rate: float = 0.0
+    anti_affinity_rate: float = 0.0
+    # fraction of UNCONSTRAINED gangs arriving elastic (min_available =
+    # size // 2): the gang plugin only admits preemption victims from
+    # jobs above min_available, so a cluster of full gangs is
+    # preemption-proof — storms need elastic filler to evict
+    elastic_rate: float = 0.0
 
 
 def synthesize_arrivals(cfg: WorkloadConfig, start_at: float = 0.0,
@@ -59,18 +74,39 @@ def synthesize_arrivals(cfg: WorkloadConfig, start_at: float = 0.0,
         # multi-hour stragglers that keep residency high
         lo, hi = math.log(cfg.duration_min_s), math.log(cfg.duration_max_s)
         duration = math.exp(rng.uniform(lo, hi))
+        # constraint draw: ONE coin partitions [0, 1) into disjoint
+        # hard-spread / soft-spread / anti-affinity / unconstrained bands
+        # so enabling one band never perturbs another's job sequence
+        extra = {}
+        coin = rng.random() if (cfg.spread_rate or cfg.soft_spread_rate
+                                or cfg.anti_affinity_rate) else 1.0
+        if coin < cfg.spread_rate:
+            extra = {"spread_key": ZONE_KEY, "spread_skew": 1,
+                     "spread_mode": "hard"}
+        elif coin < cfg.spread_rate + cfg.soft_spread_rate:
+            extra = {"spread_key": ZONE_KEY, "spread_skew": 1,
+                     "spread_mode": "soft"}
+        elif coin < (cfg.spread_rate + cfg.soft_spread_rate
+                     + cfg.anti_affinity_rate):
+            extra = {"anti_key": ZONE_KEY}
+            size = 2   # the pair idiom: one replica per zone
+        min_available = size
+        if not extra and cfg.elastic_rate \
+                and rng.random() < cfg.elastic_rate:
+            min_available = max(1, size // 2)
         events.append(make_event(
             t, "job_arrival",
             name=f"{name_prefix}-{i}",
             namespace=cfg.namespace,
             queue=cfg.queues[i % len(cfg.queues)],
             size=size,
-            min_available=size,
+            min_available=min_available,
             cpu=rng.choice(cfg.cpu_choices),
             mem=rng.choice(cfg.mem_choices),
             duration=round(duration, 3),
             priority_class=("high" if rng.random() < cfg.priority_class_rate
-                            else "")))
+                            else ""),
+            **extra))
         i += 1
     return events
 
@@ -79,13 +115,15 @@ def resident_backlog(n_jobs: int, gang: int, cpu: str = "2",
                      mem: str = "4Gi", queue: str = "default",
                      namespace: str = "default",
                      duration_s: float = 1e9,
-                     name_prefix: str = "rj") -> List[Event]:
+                     name_prefix: str = "rj",
+                     min_available: int = 0) -> List[Event]:
     """A cold backlog: ``n_jobs`` gangs all arriving at t=0 (the sim's
     analogue of bench.py's one-shot populate; near-infinite duration keeps
-    them resident unless faults kill them)."""
+    them resident unless faults kill them). ``min_available`` below the
+    gang size makes the residents elastic — preemptable down to min."""
     return [make_event(0.0, "job_arrival", name=f"{name_prefix}-{j}",
                        namespace=namespace, queue=queue, size=gang,
-                       min_available=gang, cpu=cpu, mem=mem,
+                       min_available=min_available or gang, cpu=cpu, mem=mem,
                        duration=duration_s, priority_class="")
             for j in range(n_jobs)]
 
@@ -127,6 +165,73 @@ def mesh_scenario_workload(seed: int, ticks: int,
         seed=seed, horizon_s=float(ticks) * 0.6,
         arrival_rate=arrival_rate,
         duration_min_s=15.0, duration_max_s=90.0)
+
+
+# -- constraint-heavy scenario (docs/design/constraints.md) ------------------
+# The compiled constraint tensors and the vmapped victim-selection
+# kernel must be proven under CHURN, not just in unit parity tests: the
+# same seeded stream of spread gangs / anti-affinity pairs / priority
+# preemption storms is run with the compiled kernels on and with the
+# per-task Python reference forced, and the bind+evict outcomes must be
+# bit-identical (plus a compiled double run for determinism).
+
+CONSTRAINT_CONF = """
+actions: "enqueue, allocate, backfill, preempt, reclaim"
+tiers:
+- plugins:
+  - name: priority
+  - name: conformance
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+# no drf here by design: drf's what-if share tree is the one builtin
+# victim filter with no closed vectorized form (ops/victims.py), so a
+# conf carrying it falls back to the Python walk — this scenario exists
+# to prove the KERNEL, with {priority, gang, conformance} preempt and
+# {gang, conformance, proportion} reclaim chains
+
+CONSTRAINT_REFERENCE_CONF = CONSTRAINT_CONF + """
+configurations:
+- name: solver
+  arguments:
+    constraints.compile: "off"
+    victims.kernel: "off"
+"""
+
+
+def constraint_scenario_workload(seed: int, ticks: int,
+                                 arrival_rate: float = 0.35,
+                                 queue: str = "default") -> WorkloadConfig:
+    """The constraint-smoke churn shape: a Poisson stream through the
+    first 60% of the horizon where ~45% of gangs carry a constraint
+    (hard zone spread / soft spread / one-per-zone anti pairs), mixed
+    with unconstrained filler, then a quiet drain tail."""
+    return WorkloadConfig(
+        seed=seed, horizon_s=float(ticks) * 0.6,
+        arrival_rate=arrival_rate, queues=[queue],
+        gang_sizes=[2, 4, 6], gang_weights=[3, 3, 1],
+        duration_min_s=15.0, duration_max_s=90.0,
+        spread_rate=0.2, soft_spread_rate=0.1, anti_affinity_rate=0.15,
+        elastic_rate=0.6)
+
+
+def preempt_storm(at: float, n_jobs: int, gang: int = 2, cpu: str = "2",
+                  mem: str = "4Gi", queue: str = "default",
+                  namespace: str = "default",
+                  duration_s: float = 30.0,
+                  name_prefix: str = "storm") -> List[Event]:
+    """A burst of high-priority gangs arriving at one instant — the
+    priority preemption storm that drives the vmapped victim-selection
+    kernel through eviction-heavy cycles."""
+    return [make_event(at, "job_arrival", name=f"{name_prefix}-{j}",
+                       namespace=namespace, queue=queue, size=gang,
+                       min_available=gang, cpu=cpu, mem=mem,
+                       duration=duration_s, priority_class="storm-high")
+            for j in range(n_jobs)]
 
 
 # -- JSONL trace I/O ---------------------------------------------------------

@@ -220,8 +220,16 @@ def populate_store(store, *, n_nodes: int, n_jobs: int, gang_size: int,
                    cpu_req: str = "2", mem_req: str = "4Gi",
                    node_cpu: str = "64", node_mem: str = "256Gi",
                    seed: int = 0, namespace: str = "default",
-                   phase: str = "Inqueue") -> Dict[str, int]:
-    """Object-level synthetic cluster in an ObjectStore (e2e bench path)."""
+                   phase: str = "Inqueue", zones: int = 0,
+                   spread_every: int = 0,
+                   anti_every: int = 0) -> Dict[str, int]:
+    """Object-level synthetic cluster in an ObjectStore (e2e bench path).
+
+    ``zones`` > 0 labels node i with topology.kubernetes.io/zone =
+    zone-<i % zones>; ``spread_every`` / ``anti_every`` give every Nth
+    job a hard zone topology-spread constraint / a required one-replica-
+    per-zone self-anti-affinity term — the constraint-heavy bench shape
+    (docs/design/constraints.md). Deterministic by job index, no rng."""
     from .test_utils import (build_node, build_pod, build_pod_group,
                              build_queue)
     rng = np.random.default_rng(seed)
@@ -230,16 +238,40 @@ def populate_store(store, *, n_nodes: int, n_jobs: int, gang_size: int,
         if store.get("queues", qname) is None:
             store.create("queues", build_queue(qname, weight=weight))
     for i in range(n_nodes):
+        labels = {"rack": f"rack-{i % 32}"}
+        if zones > 0:
+            labels["topology.kubernetes.io/zone"] = f"zone-{i % zones}"
         store.create("nodes", build_node(
             f"node-{i}", {"cpu": node_cpu, "memory": node_mem, "pods": "110"},
-            labels={"rack": f"rack-{i % 32}"}))
+            labels=labels))
     for j in range(n_jobs):
         qname = queues[j % len(queues)][0]
         pg = build_pod_group(f"pg-{j}", namespace, qname, gang_size,
                              phase=phase)
         store.create("podgroups", pg)
+        spread = zones > 0 and spread_every > 0 and j % spread_every == 0
+        anti = zones > 0 and anti_every > 0 and not spread \
+            and j % anti_every == 1 % max(1, anti_every)
         for t in range(gang_size):
-            store.create("pods", build_pod(
+            pod = build_pod(
                 namespace, f"job{j}-task{t}", "", "Pending",
-                {"cpu": cpu_req, "memory": mem_req}, groupname=f"pg-{j}"))
+                {"cpu": cpu_req, "memory": mem_req}, groupname=f"pg-{j}",
+                labels={"synth-job": f"pg-{j}"} if anti else None)
+            if spread:
+                from ..models.objects import TopologySpreadConstraint
+                pod.spec.topology_spread = [TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key="topology.kubernetes.io/zone",
+                    when_unsatisfiable="DoNotSchedule")]
+            elif anti:
+                from ..models.objects import (Affinity,
+                                              NodeSelectorRequirement,
+                                              PodAffinity, PodAffinityTerm)
+                pod.spec.affinity = Affinity(pod_anti_affinity=PodAffinity(
+                    required=[PodAffinityTerm(
+                        label_selector=[NodeSelectorRequirement(
+                            key="synth-job", operator="In",
+                            values=[f"pg-{j}"])],
+                        topology_key="topology.kubernetes.io/zone")]))
+            store.create("pods", pod)
     return {"nodes": n_nodes, "jobs": n_jobs, "tasks": n_jobs * gang_size}
