@@ -4,7 +4,8 @@ PYTHON ?= python
 
 .PHONY: test unit-test e2e bench bench-all bench-check multichip-dryrun \
 	deploy deploy-up trace-smoke sim-smoke flush-bench chaos-smoke \
-	failover-smoke obs-smoke incr-smoke multichip-smoke constraint-smoke
+	failover-smoke obs-smoke incr-smoke multichip-smoke constraint-smoke \
+	storm-smoke
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -148,6 +149,19 @@ multichip-smoke: incr-smoke
 # bit-identical.
 constraint-smoke: multichip-smoke
 	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli constraints
+
+# watcher-storm serving gate (docs/design/serving.md), after
+# constraint-smoke: the real scheduler churns through a bind-flush
+# storm while the serving hub fans the journal out to 1k+ subscribers
+# across tenants, with seeded frame-layer drops and a mid-storm journal
+# gap. Exit 1 unless every subscriber cursor converges to the final
+# store rv with ZERO unrecovered frame-chain gaps, the structured
+# relist path was taken, at least one tenant was throttled at the
+# admission edge, bursts arrived as coalesced frames (events per frame
+# >> 1), the engine's invariant catalog stayed clean, and a double run
+# was bit-identical on bind AND ledger fingerprints.
+storm-smoke: constraint-smoke
+	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli storm
 
 # multi-chip sharding dryrun on the virtual CPU mesh (the raw
 # shard_map program + full-pipeline one-shot; multichip-smoke is the

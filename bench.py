@@ -563,11 +563,122 @@ def try_constraint_worker(platform: str, n_tasks: int, n_nodes: int):
         return None
 
 
+def serving_worker(n_tasks: int, n_nodes: int, watchers: int) -> None:
+    """Watch fan-out leg (docs/design/serving.md): the canonical
+    50k-bind flush through the store with ``watchers`` hub subscribers
+    attached — most filtered to one of 64 tenant namespaces (the
+    multi-tenant informer shape), a few unfiltered firehose consumers —
+    measuring per-frame fan-out latency percentiles and the coalescing
+    ratio (a flush must reach an interested subscriber as framed
+    batches, not per-event deliveries). Pure store + hub path: no jax,
+    no scheduler."""
+    from volcano_tpu.apiserver.store import ObjectStore
+    from volcano_tpu.serving.hub import ServingHub
+    from volcano_tpu.utils.test_utils import build_pod
+
+    N_NS = 64
+    FIREHOSE = 8
+    store = ObjectStore()
+    hub = ServingHub(store, shards=8)
+    log(f"serving worker: populating {n_tasks} pods across {N_NS} "
+        f"namespaces")
+    for i in range(n_tasks):
+        store.create("pods", build_pod(
+            f"ns-{i % N_NS}", f"b-{i}", "", "Pending",
+            {"cpu": "2", "memory": "4Gi"}), skip_admission=True)
+    # subscribers anchor at the journal tail: the FLUSH is what they
+    # watch (prime=False: counting consumers need no old_p baseline)
+    subs = []
+    for i in range(watchers):
+        if i < FIREHOSE:
+            subs.append(hub.subscribe(f"fire-{i:03d}", tenant="firehose",
+                                      kinds=("pods",), prime=False))
+        else:
+            subs.append(hub.subscribe(
+                f"w-{i:05d}", tenant=f"t-{i % N_NS}", kinds=("pods",),
+                filter_attr=(("metadata", "namespace"),
+                             f"ns-{i % N_NS}"),
+                prime=False))
+    log(f"{len(subs)} subscribers attached; starting hub + flush")
+    hub.start()
+    bindings = [(f"b-{i}", f"ns-{i % N_NS}", f"node-{i % n_nodes}")
+                for i in range(n_tasks)]
+    t0 = time.perf_counter()
+    pairs, missing = store.bind_pods(bindings)
+    bind_wall_ms = (time.perf_counter() - t0) * 1000.0
+    assert not missing and len(pairs) == n_tasks, (len(pairs),
+                                                   len(missing))
+    # drain client-side as frames land (bounds outbox memory) until
+    # every cursor reaches the final rv
+    final_rv = store.current_rv()
+    deadline = time.time() + 300.0
+    while time.time() < deadline:
+        laggards = 0
+        for s in subs:
+            s.take_frames()
+            if s.cursor < final_rv:
+                laggards += 1
+        if laggards == 0:
+            break
+        time.sleep(0.01)
+    drain_ms = (time.perf_counter() - t0) * 1000.0
+    hub.stop()
+    converged = sum(1 for s in subs if s.cursor >= final_rv)
+    p = hub.fanout_percentiles()
+    ratio = hub.events_total / max(1, hub.frames_total)
+    out = {
+        "watchers": len(subs),
+        "watchers_converged": converged,
+        "watch_fanout_p50_ms": p["p50"],
+        "watch_fanout_p95_ms": p["p95"],
+        "watch_fanout_p99_ms": p["p99"],
+        "watch_coalesced_batches": hub.frames_total,
+        "watch_events_delivered": hub.events_total,
+        "watch_coalesce_ratio": round(ratio, 1),
+        "watch_drain_ms": round(drain_ms, 2),
+        "serving_bind_wall_ms": round(bind_wall_ms, 2),
+    }
+    if converged != len(subs):
+        out["error"] = "subscribers failed to converge"
+        print(json.dumps(out))
+        sys.exit(1)
+    print(json.dumps(out))
+
+
+def try_serving_worker(n_tasks: int, n_nodes: int, watchers: int):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"   # pure store path; keep jax quiet
+    timeout_s = float(os.environ.get("VOLCANO_BENCH_SERVING_TIMEOUT", 900))
+    cmd = [sys.executable, os.path.abspath(__file__), "--serving-worker",
+           str(n_tasks), str(n_nodes), str(watchers)]
+    log(f"spawning serving worker: {watchers} watchers over a "
+        f"{n_tasks}x{n_nodes} flush (timeout {timeout_s:.0f}s)")
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout_s, env=env,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        log("serving worker timed out (killed)")
+        return None
+    for line in (r.stderr or "").splitlines():
+        print(line, file=sys.stderr)
+    if r.returncode != 0:
+        log(f"serving worker rc={r.returncode}; "
+            f"stdout tail: {(r.stdout or '')[-200:]!r}")
+        return None
+    try:
+        return json.loads((r.stdout or "").strip().splitlines()[-1])
+    except Exception:
+        log(f"serving worker output unparseable: "
+            f"{(r.stdout or '')[-200:]!r}")
+        return None
+
+
 def write_bench_row(row: dict) -> None:
-    """Persist the headline row (BENCH_r08.json by default; override or
+    """Persist the headline row (BENCH_r11.json by default; override or
     disable with VOLCANO_BENCH_ROW_OUT) with a machine-calibration
     fingerprint so tools/bench_check.py can scale cross-box compares."""
-    out = os.environ.get("VOLCANO_BENCH_ROW_OUT", "BENCH_r10.json")
+    out = os.environ.get("VOLCANO_BENCH_ROW_OUT", "BENCH_r11.json")
     if not out:
         return
     try:
@@ -833,6 +944,15 @@ def main() -> None:
             sys.exit(1)
         return
 
+    if len(sys.argv) > 1 and sys.argv[1] == "--serving-worker":
+        try:
+            serving_worker(int(sys.argv[2]), int(sys.argv[3]),
+                           int(sys.argv[4]))
+        except Exception:
+            log("serving worker failed:\n" + traceback.format_exc())
+            sys.exit(1)
+        return
+
     if len(sys.argv) > 1 and sys.argv[1] == "--constraint-worker":
         try:
             constraint_worker(sys.argv[2], int(sys.argv[3]),
@@ -909,6 +1029,15 @@ def main() -> None:
     # after the measured runs so the numbers stay clean
     if "--profile" in sys.argv:
         os.environ["VOLCANO_BENCH_PROFILE"] = "1"
+    # --watchers N: subscriber count for the watch fan-out leg (the
+    # serving worker always runs — the r11 gate requires its columns —
+    # this just scales the population)
+    watchers = int(os.environ.get("VOLCANO_BENCH_WATCHERS", 1000))
+    if "--watchers" in sys.argv:
+        try:
+            watchers = int(sys.argv[sys.argv.index("--watchers") + 1])
+        except (IndexError, ValueError):
+            log("--watchers needs an integer; keeping the default")
 
     # HEADLINE ladder: the full runOnce (scope=full_cycle) — TPU first,
     # CPU fallback; shrink the shape only after every platform failed on
@@ -1026,6 +1155,23 @@ def main() -> None:
             else:
                 log("constraint worker failed; row ships without the "
                     "constraint columns (bench-check will flag it)")
+            # watch fan-out leg at the canonical 50k x 10k flush shape
+            # (docs/design/serving.md) — BENCH_r11 onward: subscribers
+            # attached during the flush, fan-out latency percentiles +
+            # coalesced-batch counts gated by bench_check
+            sres = try_serving_worker(50_000, 10_000, watchers)
+            if sres is not None:
+                for k in ("watchers", "watch_fanout_p50_ms",
+                          "watch_fanout_p95_ms", "watch_fanout_p99_ms",
+                          "watch_coalesced_batches",
+                          "watch_events_delivered",
+                          "watch_coalesce_ratio", "watch_drain_ms",
+                          "serving_bind_wall_ms"):
+                    if k in sres:
+                        row[k] = sres[k]
+            else:
+                log("serving worker failed; row ships without the "
+                    "watch fan-out columns (bench-check will flag it)")
             print(json.dumps(row))
             write_bench_row(row)
             return

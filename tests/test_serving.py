@@ -1,0 +1,587 @@
+"""Multi-tenant serving hub tests (docs/design/serving.md): sharded
+watch fan-out with journal cursors and coalesced frames, filter-flip
+parity with the store's own filtered watches, tenant admission at the
+write/watch edge, HTTP/1.1 keep-alive + /watchstream over real HTTP,
+the RemoteStore cursor-gap relist contract, and a small watcher storm.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+
+from volcano_tpu.apiserver.http import (ApiError, StoreClient,
+                                        StoreHTTPServer)
+from volcano_tpu.apiserver.remote import RemoteStore, retry_transient
+from volcano_tpu.apiserver.store import ObjectStore
+from volcano_tpu.serving.admission import (AdmissionController,
+                                           TenantPolicy, ThrottledError)
+from volcano_tpu.serving.hub import ServingHub
+from volcano_tpu.sim.faults import FlakyWatch
+from volcano_tpu.utils.test_utils import build_node, build_pod, build_queue
+
+SCHED_FILTER = (("spec", "scheduler_name"), "volcano")
+
+
+def _pod(ns, name, sched="volcano"):
+    p = build_pod(ns, name, "", "Pending", {"cpu": "1", "memory": "1Gi"})
+    p.spec.scheduler_name = sched
+    return p
+
+
+# ---------------------------------------------------------------------------
+# hub core
+# ---------------------------------------------------------------------------
+
+class TestHub:
+    def test_shard_placement_deterministic_and_spread(self):
+        store = ObjectStore()
+        hub = ServingHub(store, shards=4)
+        ids = [f"client-{i}" for i in range(64)]
+        homes = {cid: hub.shard_of(cid).index for cid in ids}
+        assert homes == {cid: hub.shard_of(cid).index for cid in ids}
+        assert len(set(homes.values())) == 4   # all shards populated
+
+    def test_burst_coalesces_into_one_frame(self):
+        store = ObjectStore()
+        hub = ServingHub(store, shards=2)
+        sub = hub.subscribe("c1", kinds=("pods",), since_rv=0)
+        for i in range(100):
+            store.create("pods", _pod("default", f"p{i}"))
+        assert hub.pump() == 1
+        frames = sub.take_frames()
+        assert len(frames) == 1
+        assert len(frames[0]["events"]) == 100
+        assert frames[0]["coalesced_from"] == 100
+        assert frames[0]["to_rv"] == store.current_rv()
+        assert sub.cursor == store.current_rv()
+        # nothing new: no frame, cursor stays
+        assert hub.pump() == 0
+
+    def test_sharded_patch_burst_one_frame_per_round(self):
+        """A 600-pod bind-style patch (the sharded store pipeline)
+        reaches a subscriber as coalesced frames whose total events
+        equal the burst — never 600 deliveries."""
+        store = ObjectStore()
+        hub = ServingHub(store, shards=2)
+        for i in range(600):
+            store.create("pods", _pod("default", f"p{i}"))
+        sub = hub.subscribe("c1", kinds=("pods",))
+        patches = [(f"p{i}", "default",
+                    (lambda nw: setattr(nw.spec, "node_name", "n0")))
+                   for i in range(600)]
+        pairs, missing = store.patch_batch("pods", patches)
+        assert len(pairs) == 600 and not missing
+        hub.pump()
+        frames = sub.take_frames()
+        assert sum(len(f["events"]) for f in frames) == 600
+        assert len(frames) <= 2   # coalesced, not per-event
+
+    def test_kind_filter(self):
+        store = ObjectStore()
+        hub = ServingHub(store, shards=1)
+        sub = hub.subscribe("c1", kinds=("nodes",), since_rv=0)
+        store.create("pods", _pod("default", "p0"))
+        store.create("nodes", build_node("n0", {"cpu": "8"}))
+        hub.pump()
+        frames = sub.take_frames()
+        assert len(frames) == 1
+        assert [(e[1], e[2]) for e in frames[0]["events"]] == \
+            [("ADDED", "nodes")]
+
+    def test_frame_chain_survives_silent_advance(self):
+        """Rounds where every event is filtered out advance the cursor
+        silently; the next delivered frame's ``prev`` must still equal
+        the last frame the client saw (chain unbroken)."""
+        store = ObjectStore()
+        hub = ServingHub(store, shards=1)
+        sub = hub.subscribe("c1", kinds=("pods",),
+                            filter_attr=SCHED_FILTER, since_rv=0)
+        store.create("pods", _pod("default", "mine"))
+        hub.pump()
+        f1 = sub.take_frames()[-1]
+        store.create("pods", _pod("default", "other", sched="someone"))
+        assert hub.pump() == 0          # filtered out: silent advance
+        assert sub.cursor == store.current_rv()
+        store.create("pods", _pod("default", "mine2"))
+        hub.pump()
+        f2 = sub.take_frames()[-1]
+        assert f2["prev"] == f1["to_rv"]
+
+    def test_relist_on_lagging_cursor(self):
+        store = ObjectStore()
+        hub = ServingHub(store, shards=1)
+        store.create("queues", build_queue("default", weight=1))
+        sub = hub.subscribe("lagger", since_rv=0)
+        store.create("pods", _pod("default", "p0"))
+        FlakyWatch.force_gap(store)
+        store.create("pods", _pod("default", "p1"))
+        hub.pump()
+        frames = sub.take_frames()
+        assert frames and frames[0].get("relist")
+        assert frames[0]["rv"] == store.current_rv()
+        assert sub.cursor == store.current_rv()
+        assert hub.relists_total >= 1
+
+    def test_rewind_redelivers(self):
+        store = ObjectStore()
+        hub = ServingHub(store, shards=1)
+        sub = hub.subscribe("c1", kinds=("pods",), since_rv=0)
+        store.create("pods", _pod("default", "p0"))
+        hub.pump()
+        f1 = sub.take_frames()[0]
+        hub.rewind(sub, f1["prev"])     # pretend the frame was lost
+        hub.pump()
+        f2 = sub.take_frames()[0]
+        assert f2["prev"] == f1["prev"]
+        assert [e[0] for e in f2["events"]] == [e[0] for e in f1["events"]]
+
+    def test_slow_consumer_resets_via_relist(self):
+        store = ObjectStore()
+        hub = ServingHub(store, shards=1)
+        sub = hub.subscribe("slow", kinds=("pods",), since_rv=0)
+        for i in range(sub.MAX_OUTBOX + 5):
+            store.create("pods", _pod("default", f"p{i}"))
+            hub.pump()
+        frames = sub.take_frames()
+        assert any(f.get("relist") for f in frames)
+        assert len(frames) <= sub.MAX_OUTBOX
+        # the overflow reset is counted as a hub relist (the overload
+        # signal /debug/serving and the metric exist for)
+        assert hub.relists_total >= 1
+
+    def test_replay_subscription_starts_from_empty_baseline(self):
+        """An explicit past cursor must NOT prime the flip baseline
+        from the CURRENT store state: the store's now is not the view
+        at that rv. Replayed first-pass events classify as ADDED
+        (informer relist semantics)."""
+        store = ObjectStore()
+        hub = ServingHub(store, shards=1)
+        store.create("pods", _pod("default", "old"))
+        rv_then = store.current_rv()
+        store.create("pods", _pod("default", "newer"))
+        sub = hub.subscribe("replayer", kinds=("pods",),
+                            filter_attr=SCHED_FILTER, since_rv=rv_then)
+        assert not sub._passing   # no future-state baseline
+        hub.pump()
+        frames = sub.take_frames()
+        got = [(e[1], e[3].metadata.name) for f in frames
+               for e in f["events"]]
+        assert got == [("ADDED", "newer")]
+
+    def test_subscription_admission_cap(self):
+        store = ObjectStore()
+        adm = AdmissionController(
+            tenants={"small": TenantPolicy(max_subscriptions=2)})
+        hub = ServingHub(store, shards=2, admission=adm)
+        s1 = hub.subscribe("a", tenant="small")
+        hub.subscribe("b", tenant="small")
+        with pytest.raises(ThrottledError):
+            hub.subscribe("c", tenant="small")
+        hub.unsubscribe(s1)
+        hub.subscribe("c", tenant="small")   # slot released
+
+
+# ---------------------------------------------------------------------------
+# filter-flip parity with the store's own filtered watches (PR-3 semantics)
+# ---------------------------------------------------------------------------
+
+class TestFilterFlipParity:
+    """pass→fail ⇒ DELETED, fail→pass ⇒ ADDED, pass→pass ⇒ MODIFIED —
+    the four delivery paths (create/update/patch-serial/patch-sharded/
+    delete) must classify identically whether the filter runs in the
+    store's watch bus or server-side in the hub."""
+
+    @staticmethod
+    def _run(mutate):
+        store = ObjectStore()
+        ref = []
+        store.watch("pods",
+                    on_add=lambda o: ref.append(("ADDED",
+                                                 o.metadata.name)),
+                    on_update=lambda old, new: ref.append(
+                        ("MODIFIED", new.metadata.name)),
+                    on_delete=lambda o: ref.append(("DELETED",
+                                                    o.metadata.name)),
+                    filter_fn=lambda o: o.spec.scheduler_name == "volcano",
+                    sync=False)
+        hub = ServingHub(store, shards=1)
+        sub = hub.subscribe("c1", kinds=("pods",),
+                            filter_attr=SCHED_FILTER)
+        mutate(store)
+        hub.pump()
+        got = [(e[1], e[3].metadata.name)
+               for f in sub.take_frames() if not f.get("relist")
+               for e in f["events"]]
+        assert got == ref, (got, ref)
+        return got
+
+    def test_create_classifies(self):
+        def mutate(store):
+            store.create("pods", _pod("default", "pass0"))
+            store.create("pods", _pod("default", "fail0", sched="x"))
+        got = self._run(mutate)
+        assert got == [("ADDED", "pass0")]
+
+    def test_update_flips(self):
+        def mutate(store):
+            store.create("pods", _pod("default", "a"))
+            store.create("pods", _pod("default", "b", sched="x"))
+            pa = store.get("pods", "a")
+            pa.spec.scheduler_name = "x"       # pass -> fail
+            store.update("pods", pa)
+            pb = store.get("pods", "b")
+            pb.spec.scheduler_name = "volcano"  # fail -> pass
+            store.update("pods", pb)
+            pb2 = store.get("pods", "b")
+            pb2.spec.node_name = "n0"           # pass -> pass
+            store.update("pods", pb2)
+        got = self._run(mutate)
+        assert got == [("ADDED", "a"), ("DELETED", "a"), ("ADDED", "b"),
+                       ("MODIFIED", "b")]
+
+    @pytest.mark.parametrize("n", [40, 600])   # serial and sharded paths
+    def test_patch_batch_flips(self, n):
+        def mutate(store):
+            for i in range(n):
+                store.create("pods", _pod(
+                    "default", f"p{i}",
+                    sched="volcano" if i % 3 else "x"))
+
+            def flip(new):
+                # rotate: passing pods 0 mod 2 flip out, failing pods
+                # flip in
+                new.spec.scheduler_name = \
+                    "x" if new.spec.scheduler_name == "volcano" \
+                    and int(new.metadata.name[1:]) % 2 == 0 else "volcano"
+            store.patch_batch("pods",
+                              [(f"p{i}", "default", flip)
+                               for i in range(n)])
+        self._run(mutate)
+
+    def test_delete_classifies(self):
+        def mutate(store):
+            store.create("pods", _pod("default", "a"))
+            store.create("pods", _pod("default", "b", sched="x"))
+            store.delete("pods", "a", "default")
+            store.delete("pods", "b", "default")
+        got = self._run(mutate)
+        assert got == [("ADDED", "a"), ("DELETED", "a")]
+
+
+# ---------------------------------------------------------------------------
+# admission
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_token_bucket_refill_deterministic(self):
+        now = [0.0]
+        adm = AdmissionController(
+            tenants={"t": TenantPolicy(write_rate=2.0, write_burst=4.0)},
+            now_fn=lambda: now[0])
+        for _ in range(4):
+            adm.admit_write("t")
+        with pytest.raises(ThrottledError) as ei:
+            adm.admit_write("t")
+        assert ei.value.retry_after == pytest.approx(0.5)
+        now[0] = 1.0   # 2 tokens refilled
+        adm.admit_write("t")
+        adm.admit_write("t")
+        with pytest.raises(ThrottledError):
+            adm.admit_write("t")
+        assert adm.admitted["t"] == 6
+        assert adm.throttled["t"] == 2
+        assert "t" in adm.throttled_tenants()
+
+    def test_default_tenant_generous(self):
+        adm = AdmissionController(now_fn=lambda: 0.0)
+        for _ in range(int(AdmissionController.DEFAULT_WRITE_BURST)):
+            adm.admit_write()
+        with pytest.raises(ThrottledError):
+            adm.admit_write()
+
+    def test_tenant_isolation(self):
+        adm = AdmissionController(
+            tenants={"small": TenantPolicy(write_rate=1, write_burst=1)},
+            now_fn=lambda: 0.0)
+        adm.admit_write("small")
+        with pytest.raises(ThrottledError):
+            adm.admit_write("small")
+        adm.admit_write("other")   # unaffected
+
+    def test_metrics_and_report(self):
+        from volcano_tpu.metrics import metrics as m
+        t0 = m.counter_total(m.SERVING_THROTTLED, tenant="rpt")
+        adm = AdmissionController(
+            tenants={"rpt": TenantPolicy(write_rate=1, write_burst=1)},
+            now_fn=lambda: 0.0)
+        adm.admit_write("rpt")
+        with pytest.raises(ThrottledError):
+            adm.admit_write("rpt")
+        assert m.counter_total(m.SERVING_THROTTLED, tenant="rpt") == t0 + 1
+        rep = adm.report()
+        assert rep["admitted"]["rpt"] == 1
+        assert rep["throttled"]["rpt"] == 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP edge: keep-alive, 429, /watchstream, /debug/serving
+# ---------------------------------------------------------------------------
+
+class TestServingHTTP:
+    def test_keepalive_two_ops_one_connection(self):
+        """The satellite regression: HTTP/1.1 + Content-Length on every
+        response (404 JSON bodies included) means two sequential ops
+        reuse ONE TCP connection."""
+        store = ObjectStore()
+        store.create("queues", build_queue("default", weight=1))
+        server = StoreHTTPServer(store, port=0)
+        server.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port)
+            conn.request("GET", "/rv")
+            r = conn.getresponse()
+            assert r.status == 200 and r.read()
+            # a 404 JSON body mid-stream must not poison the connection
+            conn.request("GET", "/apis/queues/missing")
+            r = conn.getresponse()
+            assert r.status == 404
+            assert r.headers.get("Content-Length") is not None
+            assert json.loads(r.read())["error"]
+            # a write over the SAME connection
+            body = json.dumps({"metadata": {"name": "q2"},
+                               "spec": {"weight": 1}}).encode()
+            conn.request("POST", "/apis/queues", body=body,
+                         headers={"Content-Type": "application/json"})
+            r = conn.getresponse()
+            assert r.status == 201, r.read()
+            r.read()
+            assert server.connections_accepted == 1
+            conn.close()
+        finally:
+            server.stop()
+
+    def test_pooled_client_reuses_connection(self):
+        store = ObjectStore()
+        server = StoreHTTPServer(store, port=0)
+        server.start()
+        try:
+            client = StoreClient(f"http://127.0.0.1:{server.port}")
+            for i in range(5):
+                client.create("queues", build_queue(f"q{i}", weight=1))
+            assert len(client.list("queues")) == 5
+            assert server.connections_accepted == 1
+        finally:
+            server.stop()
+
+    def test_throttled_write_429_with_retry_after(self):
+        store = ObjectStore()
+        adm = AdmissionController(
+            tenants={"noisy": TenantPolicy(write_rate=0.5,
+                                           write_burst=1.0)})
+        server = StoreHTTPServer(store, port=0, admission=adm)
+        server.start()
+        try:
+            client = StoreClient(f"http://127.0.0.1:{server.port}")
+            client._request("POST", "/apis/queues?tenant=noisy",
+                            {"metadata": {"name": "a"},
+                             "spec": {"weight": 1}})
+            with pytest.raises(ApiError) as ei:
+                client._request("POST", "/apis/queues?tenant=noisy",
+                                {"metadata": {"name": "b"},
+                                 "spec": {"weight": 1}})
+            assert ei.value.code == 429
+            assert ei.value.retry_after and ei.value.retry_after >= 1.0
+            # the default tenant is untouched
+            client.create("queues", build_queue("c", weight=1))
+        finally:
+            server.stop()
+
+    def test_retry_transient_honors_retry_after(self):
+        calls = []
+        delays = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                raise ApiError(429, "throttled", retry_after=3.0)
+            return "ok"
+
+        out = retry_transient("create", "k", flaky,
+                              sleep=lambda d: delays.append(d))
+        assert out == "ok"
+        assert delays and delays[0] >= 3.0
+
+    def test_watchstream_over_http(self):
+        store = ObjectStore()
+        hub = ServingHub(store, shards=2, poll_timeout=0.2)
+        server = StoreHTTPServer(store, port=0, hub=hub)
+        server.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=10.0)
+            conn.request("GET", "/watchstream?cursor=-1&heartbeat=5"
+                                "&client=t1&kinds=pods"
+                                "&filter=spec.scheduler_name=volcano")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            hello = json.loads(resp.readline())
+            assert hello.get("hello")
+            store.create("pods", _pod("default", "seen"))
+            store.create("pods", _pod("default", "unseen", sched="x"))
+            frame = json.loads(resp.readline())
+            assert [e["action"] for e in frame["events"]] == ["ADDED"]
+            assert frame["events"][0]["object"]["metadata"]["name"] == \
+                "seen"
+            assert frame["coalesced_from"] >= 1
+            conn.close()
+        finally:
+            server.stop()
+
+    def test_watchstream_rejects_bad_params(self):
+        store = ObjectStore()
+        hub = ServingHub(store, shards=1)
+        server = StoreHTTPServer(store, port=0, hub=hub)
+        server.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port)
+            # malformed filter: must 400, never a silent firehose
+            conn.request("GET", "/watchstream?cursor=-1"
+                                "&filter=metadata.labels.app=web")
+            r = conn.getresponse()
+            assert r.status == 400 and b"filter" in r.read()
+            conn.request("GET", "/watchstream?cursor=-1&filter=spec.x")
+            r = conn.getresponse()
+            assert r.status == 400
+            r.read()
+            conn.request("GET", "/watchstream?cursor=abc")
+            r = conn.getresponse()
+            assert r.status == 400
+            r.read()
+        finally:
+            server.stop()
+
+    def test_watchstream_without_hub_404(self):
+        store = ObjectStore()
+        server = StoreHTTPServer(store, port=0)
+        server.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port)
+            conn.request("GET", "/watchstream?cursor=0")
+            r = conn.getresponse()
+            assert r.status == 404
+            r.read()
+        finally:
+            server.stop()
+
+    def test_debug_serving_endpoint(self):
+        from volcano_tpu import serving
+        from volcano_tpu.metrics.server import MetricsServer
+        store = ObjectStore()
+        adm = AdmissionController()
+        hub = ServingHub(store, shards=3, admission=adm)
+        serving.set_active(hub=hub, admission=adm)
+        ms = MetricsServer(port=0)
+        ms.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", ms.port)
+            conn.request("GET", "/debug/serving")
+            r = conn.getresponse()
+            assert r.status == 200
+            payload = json.loads(r.read())
+            assert payload["hub"]["shards"] == 3
+            assert "admitted" in payload["admission"]
+        finally:
+            ms.stop()
+            serving.clear_active()
+
+
+# ---------------------------------------------------------------------------
+# RemoteStore: cursor-gap relist + streaming transport
+# ---------------------------------------------------------------------------
+
+class TestRemoteStoreRelist:
+    @staticmethod
+    def _force_gap_scenario(with_hub: bool):
+        store = ObjectStore()
+        hub = ServingHub(store, shards=2, poll_timeout=0.2) \
+            if with_hub else None
+        server = StoreHTTPServer(store, port=0, hub=hub)
+        server.start()
+        url = f"http://127.0.0.1:{server.port}"
+        try:
+            store.create("queues", build_queue("default", weight=1))
+            rs = RemoteStore(url, poll_timeout=1.0)   # anchors here
+            # the mirror falls BEHIND, then the window rolls past it
+            store.create("pods", _pod("default", "pre1"))
+            store.create("pods", _pod("default", "pre2"))
+            FlakyWatch.force_gap(store)
+            store.create("pods", _pod("default", "post"))
+            rs.run()
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                if rs.mirror.get("pods", "post") is not None and \
+                        rs.mirror.get("pods", "pre1") is not None:
+                    break
+                time.sleep(0.05)
+            assert rs.mirror.get("pods", "post") is not None
+            assert rs.mirror.get("pods", "pre1") is not None
+            # the gap took the EXPLICIT structured relist, not the
+            # restart-backoff guess
+            assert rs.watch_relists >= 1
+            assert rs.watch_restarts == 0
+            assert rs._use_stream == with_hub
+            rs.stop()
+        finally:
+            server.stop()
+
+    def test_force_gap_relists_longpoll(self):
+        self._force_gap_scenario(with_hub=False)
+
+    def test_force_gap_relists_stream(self):
+        self._force_gap_scenario(with_hub=True)
+
+    def test_stream_delivers_writes(self):
+        store = ObjectStore()
+        hub = ServingHub(store, shards=2, poll_timeout=0.2)
+        server = StoreHTTPServer(store, port=0, hub=hub)
+        server.start()
+        try:
+            rs = RemoteStore(f"http://127.0.0.1:{server.port}",
+                             poll_timeout=1.0)
+            rs.run()
+            store.create("pods", _pod("default", "s0"))
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                if rs.mirror.get("pods", "s0") is not None:
+                    break
+                time.sleep(0.05)
+            assert rs.mirror.get("pods", "s0") is not None
+            assert rs._use_stream
+            # mirror-read offload: live refs, no HTTP, no clone
+            assert [p.metadata.name
+                    for p in rs.list_cached("pods")] == ["s0"]
+            assert rs.get_cached("pods", "s0") is not None
+            rs.stop()
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# a small watcher storm (the full gate is `make storm-smoke`)
+# ---------------------------------------------------------------------------
+
+class TestStormSmall:
+    def test_small_storm_converges(self):
+        from volcano_tpu.serving.storm import run_storm
+        v = run_storm(seed=43, ticks=12, nodes=64, subscribers=60,
+                      shards=3, drop_rate=0.08, resident=24, gap_tick=6)
+        assert v["violations"] == 0
+        assert v["converged"] == v["subscribers"] == 60
+        assert v["gaps_unrecovered"] == 0
+        assert v["frames_dropped"] > 0
+        assert v["relists"] >= 1
+        assert v["noisy_throttled_writes"] >= 1
+        assert v["coalesce_ratio"] > 5.0

@@ -1,8 +1,15 @@
 """Bench regression gate: compare a fresh bench row against a baseline.
 
-    python tools/bench_check.py                         # BENCH_r10 vs r09
-    python tools/bench_check.py --row BENCH_r10.json \
-        --baseline BENCH_r09.json --tolerance 0.35
+    python tools/bench_check.py                         # BENCH_r11 vs r10
+    python tools/bench_check.py --row BENCH_r11.json \
+        --baseline BENCH_r10.json --tolerance 0.35
+
+Round 11 adds the watch fan-out columns (required on every fresh row):
+the serving worker attaches 1k hub subscribers during the canonical
+50k x 10k flush and the row must carry fan-out latency percentiles
+(``watch_fanout_p99_ms``) plus the coalescing proof — a 50k-bind flush
+reaches interested subscribers as framed BATCHES, so events-per-frame
+must stay >= 10x (docs/design/serving.md).
 
 Round 10 adds the constraint columns (required on every fresh row): the
 constraint-heavy 50k x 10k kernel must stay <= 1.5x the unconstrained
@@ -89,6 +96,12 @@ BIND_FLUSH_TARGET_MS = 800.0
 # a churn-heavy measurement would not be the steady-state claim.
 INCR_TARGET_MS = 20.0
 INCR_MAX_DIRTY_FRACTION = 0.01
+
+# watch fan-out coalescing floor (round 11, docs/design/serving.md):
+# events-per-frame over the serving worker's whole population — 1k
+# subscribers (64-way namespace-filtered + a firehose slice) over the
+# 50k-bind flush lands around x40-80; 10 is the "not per-event" line
+SERVING_COALESCE_MIN = 10.0
 
 # constraint-kernel budget (round 10, docs/design/constraints.md): the
 # constraint-heavy 50k x 10k placement kernel (zoned nodes, hard-spread
@@ -193,6 +206,45 @@ def check_constraints(fresh: dict, failures: list) -> None:
             failures.append(f"victim-selection eviction counts diverge "
                             f"(kernel={ek}, python={ep}) — kernel/walk "
                             "parity broke in the bench scenario")
+
+
+def check_serving(fresh: dict, failures: list) -> None:
+    """The round-11 watch fan-out columns (bench.py's serving worker:
+    1k subscribers over the canonical 50k x 10k flush): required on
+    every fresh row, with the coalescing ratio enforced — the serving
+    hub's whole point is that a flush burst reaches an interested
+    subscriber as framed batches, not per-event deliveries."""
+    required = ("watch_fanout_p99_ms", "watch_coalesced_batches",
+                "watch_events_delivered", "watchers")
+    missing = [k for k in required if fresh.get(k) is None]
+    if missing:
+        failures.append(
+            f"serving columns missing: {', '.join(missing)} — the "
+            "round-11 watch fan-out worker did not run (re-run `python "
+            "bench.py`)")
+        return
+    print(f"  {'watch fan-out ms':<24} "
+          f"p50={fresh.get('watch_fanout_p50_ms')} "
+          f"p95={fresh.get('watch_fanout_p95_ms')} "
+          f"p99={fresh.get('watch_fanout_p99_ms')} "
+          f"({int(fresh['watchers'])} watchers) ok")
+    batches = float(fresh["watch_coalesced_batches"]) or 0.0
+    events = float(fresh["watch_events_delivered"]) or 0.0
+    if not batches or not events:
+        failures.append("watch fan-out delivered nothing "
+                        f"(batches={batches:g}, events={events:g}) — "
+                        "the serving leg went stale")
+        return
+    ratio = events / batches
+    verdict = "ok" if ratio >= SERVING_COALESCE_MIN else "REGRESSION"
+    print(f"  {'watch coalescing':<24} {events:9.0f} events / "
+          f"{batches:.0f} frames = x{ratio:.1f} "
+          f"(>= x{SERVING_COALESCE_MIN:.0f}) {verdict}")
+    if verdict != "ok":
+        failures.append(
+            f"watch coalescing ratio x{ratio:.1f} < "
+            f"x{SERVING_COALESCE_MIN:.0f} — the flush is degrading "
+            "toward per-event delivery")
 
 
 def check(fresh: dict, baseline: dict, tolerance: float,
@@ -300,6 +352,7 @@ def check(fresh: dict, baseline: dict, tolerance: float,
                         "instrumented pre-probe (re-run `python "
                         "bench.py`)")
     check_constraints(fresh, failures)
+    check_serving(fresh, failures)
     if failures:
         print("bench-check: FAIL")
         for fmsg in failures:
@@ -448,6 +501,7 @@ def check_10x(fresh: dict, tolerance: float, fresh_cal: float,
               f"root_cause={'yes' if probe.get('root_cause') else 'no'} "
               f"ok")
     check_constraints(fresh, failures)
+    check_serving(fresh, failures)
     if failures:
         print("bench-check: FAIL")
         for fmsg in failures:
@@ -459,10 +513,10 @@ def check_10x(fresh: dict, tolerance: float, fresh_cal: float,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--row", default=os.path.join(REPO, "BENCH_r10.json"),
+    ap.add_argument("--row", default=os.path.join(REPO, "BENCH_r11.json"),
                     help="fresh bench row (bench.py writes it)")
     ap.add_argument("--baseline",
-                    default=os.path.join(REPO, "BENCH_r09.json"))
+                    default=os.path.join(REPO, "BENCH_r10.json"))
     ap.add_argument("--tolerance", type=float, default=0.35,
                     help="allowed fractional slowdown after calibration "
                          "scaling (shared-box noise is ±15-25%%)")
@@ -478,7 +532,7 @@ def main(argv=None) -> int:
         fresh = load_row(args.row)
     except OSError as e:
         print(f"bench-check: cannot read fresh row {args.row}: {e}\n"
-              f"run `python bench.py` first (it writes BENCH_r08.json)")
+              f"run `python bench.py` first (it writes BENCH_r11.json)")
         return 2
     try:
         baseline = load_row(args.baseline)

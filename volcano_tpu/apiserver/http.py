@@ -14,11 +14,23 @@ Routes (namespaced kinds):
     DELETE /apis/{kind}/{ns}/{name}       delete
 Cluster-scoped kinds use /apis/{kind}/{name}.
 Admission rejections -> 422, conflicts -> 409, missing -> 404.
+
+Serving-hub era (docs/design/serving.md): the server speaks HTTP/1.1
+with keep-alive (every response carries Content-Length or chunked
+framing — one TCP connection serves a client's whole write stream), an
+optional :class:`~volcano_tpu.serving.hub.ServingHub` adds the chunked
+``/watchstream?cursor=rv`` streaming endpoint (coalesced event-batch
+frames pushed as they publish, heartbeat pings between), and an optional
+:class:`~volcano_tpu.serving.admission.AdmissionController` enforces
+per-tenant write rate limits at the edge — throttled writes answer a
+structured 429 with Retry-After, which :class:`StoreClient` surfaces as
+``ApiError.retry_after`` and RemoteStore honors in its backoff.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import threading
 import urllib.error
 import urllib.parse
@@ -54,29 +66,101 @@ def _trace_of(query: dict):
     return raw[:TRACE_MAX_LEN] if raw else None
 
 
+class _CountingThreadingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that counts accepted TCP connections — the
+    keep-alive regression surface: two sequential ops over one client
+    connection must leave ``connections_accepted`` at 1."""
+
+    connections_accepted = 0
+
+    def get_request(self):
+        req = super().get_request()
+        self.connections_accepted += 1
+        return req
+
+
+def _tenant_of(query: dict) -> str:
+    """Tenant identity on every request (docs/design/serving.md);
+    absent = the default tenant, so single-tenant deployments never
+    notice the edge exists."""
+    return query.get("tenant", ["default"])[0] or "default"
+
+
 class StoreHTTPServer:
+    """The apiserver seam. ``hub``/``admission`` are optional: without
+    them the server behaves exactly as the pre-serving era (no
+    /watchstream, no write throttling) — cmd/apiserver wires both in
+    for the production multi-tenant edge."""
+
     def __init__(self, store: ObjectStore, host: str = "127.0.0.1",
-                 port: int = 8181):
+                 port: int = 8181, hub=None, admission=None):
         self.store = store
+        self.hub = hub
+        self.admission = admission
         handler = self._make_handler()
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd = _CountingThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_port
         self._thread: Optional[threading.Thread] = None
 
+    @property
+    def connections_accepted(self) -> int:
+        return self.httpd.connections_accepted
+
     def _make_handler(self):
         store = self.store
+        server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 + accurate Content-Length (or chunked framing) on
+            # EVERY response = persistent connections. The pre-serving
+            # server answered HTTP/1.0-style — one request per TCP
+            # connection, a fresh handshake per write on the seam that
+            # carries every bind.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):  # quiet
                 pass
 
-            def _send(self, code: int, payload) -> None:
+            def _send(self, code: int, payload, headers=None) -> None:
+                # keep-alive hygiene: a response sent BEFORE the request
+                # body was read (throttled write, unknown route, bad
+                # fence) must still drain that body, or its bytes parse
+                # as the connection's next request line. self.headers is
+                # fresh per request, so it carries the consumed flag.
+                try:
+                    remaining = int(self.headers.get("Content-Length",
+                                                     0) or 0)
+                    if remaining and not getattr(self.headers,
+                                                 "_body_consumed", False):
+                        self.headers._body_consumed = True
+                        self.rfile.read(remaining)
+                except (ValueError, OSError):
+                    self.close_connection = True
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _admit_tenant(self, query: dict) -> bool:
+                """Per-tenant write admission; False = throttled (the
+                429 with Retry-After already went out)."""
+                if server.admission is None:
+                    return True
+                from ..serving.admission import ThrottledError
+                try:
+                    server.admission.admit_write(_tenant_of(query))
+                    return True
+                except ThrottledError as e:
+                    self._send(429, {"error": str(e),
+                                     "retry_after": e.retry_after},
+                               headers={"Retry-After":
+                                        str(max(1, math.ceil(
+                                            e.retry_after)))})
+                    return False
 
             def _parse(self):
                 parsed = urllib.parse.urlparse(self.path)
@@ -97,7 +181,115 @@ class StoreHTTPServer:
 
             def _body(self):
                 length = int(self.headers.get("Content-Length", 0))
+                self.headers._body_consumed = True
                 return json.loads(self.rfile.read(length)) if length else None
+
+            def _encode_events(self, events) -> list:
+                # ONE trace-map snapshot for the whole batch (a
+                # 50k-event response must not copy the map per event);
+                # each rv resolves by bisect
+                from .store import trace_in_ranges
+                ranges = store.trace_ranges() if events else []
+                payload = []
+                for erv, action, kind, o in events:
+                    ev = {"rv": erv, "action": action, "kind": kind,
+                          "object": encode_object(kind, o)}
+                    trace = trace_in_ranges(ranges, erv)
+                    if trace is not None:
+                        ev["trace"] = trace
+                    payload.append(ev)
+                return payload
+
+            def _chunk(self, payload: dict) -> None:
+                body = json.dumps(payload).encode() + b"\n"
+                self.wfile.write(f"{len(body):X}\r\n".encode() + body
+                                 + b"\r\n")
+                self.wfile.flush()
+
+            def _watchstream(self, q: dict) -> None:
+                """Chunked streaming watch: hold the connection and
+                frame coalesced batches as the hub publishes them
+                (docs/design/serving.md). One frame = one chunk-framed
+                NDJSON line; heartbeat pings keep half-open detection
+                cheap; a cursor off the journal window gets the
+                structured relist frame."""
+                hub = server.hub
+                if hub is None:
+                    return self._send(404, {
+                        "error": "watchstream not enabled (no serving "
+                                 "hub on this apiserver)"})
+                from ..serving.admission import ThrottledError
+                try:
+                    cursor = int(q.get("cursor", ["-1"])[0])
+                    # clamp: heartbeat=0 would spin ping chunks at full
+                    # speed off one unauthenticated request; negative
+                    # would crash the Condition wait
+                    heartbeat = max(1.0, min(60.0, float(
+                        q.get("heartbeat", ["10"])[0])))
+                except ValueError:
+                    return self._send(400, {"error": "malformed cursor/"
+                                                     "heartbeat"})
+                client = q.get("client", [""])[0] \
+                    or f"anon-{threading.get_ident()}"
+                kinds_raw = q.get("kinds", [""])[0]
+                kinds = tuple(k for k in kinds_raw.split(",") if k) or None
+                filter_attr = None
+                filt = q.get("filter", [""])[0]
+                if filt:
+                    # an unsupported filter must REJECT, never silently
+                    # degrade to an unfiltered firehose
+                    path_, eq, expected = filt.partition("=")
+                    parts = path_.split(".")
+                    if not eq or len(parts) != 2 or not all(parts):
+                        return self._send(400, {
+                            "error": f"unsupported filter {filt!r} "
+                                     "(want attr0.attr1=value)"})
+                    filter_attr = ((parts[0], parts[1]), expected)
+                try:
+                    sub = hub.subscribe(
+                        client, tenant=_tenant_of(q), kinds=kinds,
+                        filter_attr=filter_attr,
+                        since_rv=None if cursor < 0 else cursor)
+                except ThrottledError as e:
+                    return self._send(
+                        429, {"error": str(e),
+                              "retry_after": e.retry_after},
+                        headers={"Retry-After":
+                                 str(max(1, math.ceil(e.retry_after)))})
+                # a stream monopolizes its connection; never keep-alive
+                self.close_connection = True
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    self._chunk({"hello": True, "rv": sub.cursor,
+                                 "client": client})
+                    while True:
+                        frame = sub.next_frame(timeout=heartbeat)
+                        if sub.closed:
+                            break
+                        if frame is None:
+                            self._chunk({"ping": True,
+                                         "rv": store.current_rv()})
+                            continue
+                        if frame.get("relist"):
+                            self._chunk({"relist": True,
+                                         "rv": frame["rv"],
+                                         "prev": frame.get("prev")})
+                            continue
+                        self._chunk({
+                            "prev": frame["prev"],
+                            "from_rv": frame["from_rv"],
+                            "to_rv": frame["to_rv"],
+                            "coalesced_from": frame["coalesced_from"],
+                            "events": self._encode_events(
+                                frame["events"])})
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass   # client went away: normal stream teardown
+                finally:
+                    hub.unsubscribe(sub)
 
             def do_GET(self):
                 parsed = urllib.parse.urlparse(self.path)
@@ -105,42 +297,45 @@ class StoreHTTPServer:
                     return self._send(200, {"rv": store.current_rv()})
                 if parsed.path == "/fence":
                     return self._send(200, {"floor": store.fence_floor()})
+                if parsed.path == "/watchstream":
+                    return self._watchstream(
+                        urllib.parse.parse_qs(parsed.query))
                 if parsed.path == "/watch":
                     q = urllib.parse.parse_qs(parsed.query)
                     since = int(q.get("since", ["0"])[0])
                     timeout = min(60.0, float(q.get("timeout", ["25"])[0]))
                     events, rv, resync = store.events_since(since, timeout)
-                    # ONE trace-map snapshot for the whole response (a
-                    # 50k-event long poll must not copy the map per
-                    # event); each rv resolves by bisect
-                    from .store import trace_in_ranges
-                    ranges = store.trace_ranges() if events else []
-                    payload = []
-                    for erv, action, kind, o in events:
-                        ev = {"rv": erv, "action": action, "kind": kind,
-                              "object": encode_object(kind, o)}
-                        trace = trace_in_ranges(ranges, erv)
-                        if trace is not None:
-                            ev["trace"] = trace
-                        payload.append(ev)
+                    payload = self._encode_events(events)
+                    # "gone" is the structured signal that the cursor
+                    # fell off the journal window: the client MUST
+                    # re-list and re-anchor at "rv" ("resync" kept for
+                    # pre-serving clients — same meaning)
                     return self._send(200, {"rv": rv, "resync": resync,
+                                            "gone": resync,
                                             "events": payload})
                 route = self._parse()
                 if route is None:
                     return self._send(404, {"error": "not found"})
                 kind, ns, name, query = route
+                # read-path offload (docs/design/serving.md): serve from
+                # live refs — encoding only READS, stored objects are
+                # replaced never mutated, so the per-request deep copy
+                # bought nothing but writer-lock contention
                 if name is None:
                     namespace = query.get("namespace", [None])[0]
-                    items = store.list(kind, namespace)
+                    items = store.list_refs(kind, namespace)
                     return self._send(200, {"items": [
                         encode_object(kind, o) for o in items]})
-                o = store.get(kind, name, ns)
+                o = store.get_ref(kind, name, ns)
                 if o is None:
                     return self._send(404, {"error": f"{kind} {name} not found"})
                 return self._send(200, encode_object(kind, o))
 
             def do_POST(self):
                 parsed = urllib.parse.urlparse(self.path)
+                if not self._admit_tenant(
+                        urllib.parse.parse_qs(parsed.query)):
+                    return
                 if parsed.path == "/fence":
                     # the LeaderElector of a remote process announcing its
                     # freshly-acquired token; floor advance is monotonic
@@ -197,6 +392,8 @@ class StoreHTTPServer:
                 if route is None:
                     return self._send(404, {"error": "not found"})
                 kind, _ns, _name, query = route
+                if not self._admit_tenant(query):
+                    return
                 try:
                     fence = _fence_of(query)
                 except ValueError:
@@ -220,6 +417,8 @@ class StoreHTTPServer:
                 if route is None or route[2] is None:
                     return self._send(404, {"error": "not found"})
                 kind, ns, name, query = route
+                if not self._admit_tenant(query):
+                    return
                 try:
                     fence = _fence_of(query)
                 except ValueError:
@@ -238,43 +437,148 @@ class StoreHTTPServer:
         return Handler
 
     def start(self) -> threading.Thread:
+        if self.hub is not None:
+            self.hub.start()
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
         return self._thread
 
     def stop(self) -> None:
+        if self.hub is not None:
+            self.hub.stop()
         self.httpd.shutdown()
         self.httpd.server_close()
 
 
 class ApiError(Exception):
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str,
+                 retry_after: Optional[float] = None):
         super().__init__(message)
         self.code = code
         self.message = message
+        # the 429 edge's Retry-After, parsed so RemoteStore's backoff
+        # can honor the server's own horizon instead of guessing
+        self.retry_after = retry_after
+
+
+class PooledConnection:
+    """Per-thread persistent HTTP/1.1 connections to one base URL.
+
+    The pre-serving client opened a fresh ``urllib.urlopen`` (TCP
+    handshake + slow-start) PER WRITE — on the seam that carries every
+    bind. With the server speaking HTTP/1.1 this keeps one
+    ``http.client.HTTPConnection`` per (thread, endpoint) and replays a
+    request once when a cached connection turns out to have been closed
+    idle by the peer (``RemoteDisconnected`` before any response bytes —
+    the same at-least-once caveat ``retry_transient`` documents)."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        u = urllib.parse.urlsplit(base_url)
+        if u.scheme not in ("http", ""):
+            raise ValueError(f"PooledConnection is http-only, got "
+                             f"{base_url!r}")
+        self.host = u.hostname or "127.0.0.1"
+        self.port = u.port or 80
+        self.timeout = timeout
+        self._local = threading.local()
+
+    def _conn(self, fresh: bool = False):
+        import http.client
+        conn = getattr(self._local, "conn", None)
+        if fresh and conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            conn = None
+        if conn is None:
+            conn = http.client.HTTPConnection(self.host, self.port,
+                                              timeout=self.timeout)
+            self._local.conn = conn
+        return conn
+
+    def request(self, method: str, path: str, body: Optional[bytes] = None,
+                headers: Optional[dict] = None) -> tuple:
+        """(status, headers, body bytes); retries once on a stale cached
+        connection, never on a fresh one."""
+        import http.client
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        for attempt in (0, 1):
+            conn = self._conn(fresh=attempt > 0)
+            reused = attempt == 0 and getattr(self._local, "used", False)
+            try:
+                conn.request(method, path, body=body, headers=hdrs)
+                resp = conn.getresponse()
+                data = resp.read()
+                self._local.used = True
+                if resp.will_close:
+                    self.close()
+                return resp.status, resp.headers, data
+            except (http.client.RemoteDisconnected,
+                    http.client.BadStatusLine,
+                    http.client.CannotSendRequest,
+                    BrokenPipeError, ConnectionResetError):
+                self.close()
+                if not reused:
+                    raise
+                # stale keep-alive connection: reconnect and replay once
+            except BaseException:
+                # ANY other failure (connection refused, timeout, ...)
+                # must DROP the cached connection: http.client leaves a
+                # half-started request state behind a failed connect,
+                # and every later request on that object would raise
+                # CannotSendRequest forever
+                self.close()
+                raise
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+            self._local.conn = None
+            self._local.used = False
 
 
 class StoreClient:
-    """Remote client mirroring the ObjectStore CRUD surface."""
+    """Remote client mirroring the ObjectStore CRUD surface, over a
+    pooled keep-alive connection (writes reuse one TCP connection; the
+    RemoteStore watch loop streams on its own)."""
 
-    def __init__(self, base_url: str):
+    def __init__(self, base_url: str, timeout: float = 10.0):
         self.base_url = base_url.rstrip("/")
+        self.pool = PooledConnection(self.base_url, timeout=timeout)
 
     def _request(self, method: str, path: str, payload=None):
+        import http.client
         data = json.dumps(payload).encode() if payload is not None else None
-        req = urllib.request.Request(
-            f"{self.base_url}{path}", data=data, method=method,
-            headers={"Content-Type": "application/json"})
         try:
-            with urllib.request.urlopen(req, timeout=10) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
+            status, headers, body = self.pool.request(method, path,
+                                                      body=data)
+        except (OSError, http.client.HTTPException) as e:
+            # keep the pre-pool error contract: connection-level blips
+            # surface as URLError (what retry_transient classifies)
+            raise urllib.error.URLError(e) from None
+        if status >= 400:
             try:
-                message = json.loads(e.read()).get("error", str(e))
+                message = json.loads(body).get("error", "")
             except Exception:
-                message = str(e)
-            raise ApiError(e.code, message) from None
+                message = ""
+            message = message or f"HTTP {status}"
+            retry_after = None
+            ra = headers.get("Retry-After") if headers is not None else None
+            if ra:
+                try:
+                    retry_after = float(ra)
+                except ValueError:
+                    pass
+            raise ApiError(status, message, retry_after=retry_after)
+        return json.loads(body) if body else None
 
     def _path(self, kind: str, name: Optional[str] = None,
               namespace: str = "default") -> str:

@@ -38,8 +38,10 @@ log = logging.getLogger(__name__)
 
 # HTTP statuses worth retrying: the server hiccuped, not the request.
 # Everything else (404/409/412/422) is a semantic verdict that a replay
-# would only repeat.
-_TRANSIENT_CODES = frozenset({500, 502, 503, 504})
+# would only repeat. 429 is transient BY CONTRACT (docs/design/
+# serving.md): the admission edge says "later", names the horizon in
+# Retry-After, and retry_transient honors it as the backoff floor.
+_TRANSIENT_CODES = frozenset({429, 500, 502, 503, 504})
 
 
 def _is_transient(e: Exception) -> bool:
@@ -49,6 +51,11 @@ def _is_transient(e: Exception) -> bool:
     # all in URLError (HTTPError is an ApiError by the time it's here)
     return isinstance(e, (urllib.error.URLError, TimeoutError,
                           ConnectionError))
+
+
+class _StreamUnsupported(Exception):
+    """/watchstream answered 404: a pre-serving server — downgrade to
+    the long-poll transport without a backoff cycle."""
 
 
 class RemoteAdmissionHook:
@@ -145,6 +152,12 @@ def retry_transient(op: str, key: str, fn, *, attempts: int = 4,
                 pass
             delay = seeded_backoff(f"{op}:{key}", attempt, base, cap,
                                    seed=seed)
+            # a throttled write (429) carries the server's own horizon:
+            # honor it as the floor — retrying earlier is a guaranteed
+            # second rejection that only burns the tenant's bucket
+            retry_after = getattr(e, "retry_after", None)
+            if retry_after:
+                delay = max(delay, float(retry_after))
             log.warning("store write %s %s failed (%s); retry %d/%d in "
                         "%.3fs", op, key, e, attempt, attempts - 1, delay)
             sleep(delay)
@@ -186,6 +199,15 @@ class RemoteStore:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.watch_restarts = 0
+        # explicit cursor-gap relists (the structured "gone" contract,
+        # docs/design/serving.md): counted apart from restart backoff —
+        # a gap is a re-anchor, not a failure
+        self.watch_relists = 0
+        # streaming transport (/watchstream): preferred; a 404 from a
+        # pre-serving server downgrades to the long-poll /watch forever
+        self._use_stream = True
+        import os as _os
+        self._client_id = f"remote-{_os.getpid()}-{id(self):x}"
         self._resync()
         self.events = self.mirror.events   # local event record view
 
@@ -255,34 +277,109 @@ class RemoteStore:
         except KeyError:
             log.exception("mirror apply %s %s failed", action, kind)
 
+    def _relist(self, anchor_rv: Optional[int] = None) -> None:
+        """The structured cursor-gap path (docs/design/serving.md): the
+        server said ``gone``/``relist`` — the cursor fell off the
+        journal window — so re-list everything and re-anchor, explicitly
+        and immediately, instead of burning a restart-backoff cycle on
+        what is not a failure."""
+        self.watch_relists += 1
+        try:
+            from ..metrics import metrics as _m
+            _m.inc(_m.WATCH_RELISTS)
+        except Exception:
+            pass
+        self._resync()
+        if anchor_rv is not None:
+            self._rv = max(self._rv, int(anchor_rv))
+
+    def _apply_wire_event(self, ev: dict) -> None:
+        o = decode_object(ev["kind"], ev["object"])
+        if ev.get("trace") is not None:
+            with self._seen_lock:
+                self._trace_events.append((int(ev["rv"]), ev["trace"]))
+        self._apply(ev["action"], ev["kind"], o, int(ev["rv"]))
+        self._rv = max(self._rv, int(ev["rv"]))
+
+    def _poll_once(self) -> None:
+        """One long-poll round against /watch (the pre-serving
+        transport, kept as the fallback)."""
+        url = (f"{self.base_url}/watch?since={self._rv}"
+               f"&timeout={self.poll_timeout}")
+        with urllib.request.urlopen(
+                url, timeout=self.poll_timeout + 10.0) as resp:
+            data = json.loads(resp.read().decode())
+        if data.get("gone") or data.get("resync"):
+            self._relist(data.get("rv"))
+            return
+        for ev in data.get("events", []):
+            self._apply_wire_event(ev)
+
+    def _stream_once(self) -> None:
+        """One /watchstream session: hold the chunked connection and
+        apply coalesced frames as the hub publishes them. Returns on a
+        relist (after re-anchoring — the caller restarts the stream
+        from the fresh cursor); raises on any transport failure (the
+        caller's seeded-backoff restart, same as the long-poll)."""
+        import http.client
+        u = urllib.parse.urlsplit(self.base_url)
+        conn = http.client.HTTPConnection(
+            u.hostname or "127.0.0.1", u.port or 80,
+            timeout=self.poll_timeout + 10.0)
+        try:
+            hb = max(1.0, min(self.poll_timeout, 10.0))
+            conn.request(
+                "GET",
+                f"/watchstream?cursor={self._rv}&heartbeat={hb}"
+                f"&client={urllib.parse.quote(self._client_id)}")
+            resp = conn.getresponse()
+            if resp.status == 404:
+                resp.read()
+                raise _StreamUnsupported()
+            if resp.status != 200:
+                raise ApiError(resp.status,
+                               f"watchstream HTTP {resp.status}")
+            while not self._stop.is_set():
+                line = resp.readline()
+                if not line:
+                    raise ConnectionError("watch stream closed")
+                frame = json.loads(line)
+                if frame.get("ping") or frame.get("hello"):
+                    continue
+                if frame.get("relist"):
+                    self._relist(frame.get("rv"))
+                    return   # restart the stream from the fresh anchor
+                for ev in frame.get("events", []):
+                    self._apply_wire_event(ev)
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
     def _poll_loop(self) -> None:
-        """Long-poll the journal forever. EVERY failure mode — a dead
-        server, a poisoned event payload, a resync that itself fails —
-        restarts the stream with capped exponential backoff
-        (``volcano_watch_restarts_total``) instead of killing the thread:
-        a watch thread dying silently leaves the mirror frozen at a stale
-        rv with nothing ever noticing (the pre-failover behavior)."""
+        """Keep the mirror current forever — streaming /watchstream
+        when the server offers it (one held connection, frames pushed
+        as they publish), long-poll /watch otherwise. EVERY failure
+        mode — a dead server, a poisoned event payload, a resync that
+        itself fails — restarts the stream with capped seeded
+        exponential backoff (``volcano_watch_restarts_total``) instead
+        of killing the thread: a watch thread dying silently leaves the
+        mirror frozen at a stale rv with nothing ever noticing (the
+        pre-failover behavior). A cursor GAP is not a failure: the
+        structured gone/relist signal takes the explicit re-anchor path
+        (``volcano_watch_relists_total``) with no backoff."""
         failures = 0
         while not self._stop.is_set():
-            url = (f"{self.base_url}/watch?since={self._rv}"
-                   f"&timeout={self.poll_timeout}")
             try:
-                with urllib.request.urlopen(
-                        url, timeout=self.poll_timeout + 10.0) as resp:
-                    data = json.loads(resp.read().decode())
-                if data.get("resync"):
-                    self._resync()
-                    self._rv = max(self._rv, int(data.get("rv", self._rv)))
+                if self._use_stream:
+                    self._stream_once()
                 else:
-                    for ev in data.get("events", []):
-                        o = decode_object(ev["kind"], ev["object"])
-                        if ev.get("trace") is not None:
-                            with self._seen_lock:
-                                self._trace_events.append(
-                                    (int(ev["rv"]), ev["trace"]))
-                        self._apply(ev["action"], ev["kind"], o,
-                                    int(ev["rv"]))
-                        self._rv = max(self._rv, int(ev["rv"]))
+                    self._poll_once()
+            except _StreamUnsupported:
+                log.info("server has no /watchstream; long-polling")
+                self._use_stream = False
+                continue
             except Exception:
                 if self._stop.is_set():
                     return
@@ -301,7 +398,7 @@ class RemoteStore:
                             exc_info=True)
                 self._stop.wait(delay)
                 continue
-            failures = 0   # a clean poll closes the backoff window
+            failures = 0   # a clean round closes the backoff window
 
     def run(self) -> None:
         if self._thread is not None:
@@ -423,6 +520,20 @@ class RemoteStore:
 
     def list(self, kind: str, namespace=None) -> list:
         return self.client.list(kind, namespace)
+
+    # read-path offload (docs/design/serving.md): monitoring/read-heavy
+    # consumers can serve from the watch-maintained, anti-entropy-
+    # repaired mirror without an HTTP round trip or a per-object clone
+    # (the list_refs no-copy contract: refs are consistent views, MUST
+    # NOT be mutated). Mirror resource_versions are MIRROR-LOCAL — a
+    # get+mutate+update round trip needs list()/get() for the server rv.
+
+    def list_cached(self, kind: str, namespace=None) -> list:
+        return self.mirror.list_refs(kind, namespace)
+
+    def get_cached(self, kind: str, name: str,
+                   namespace: str = "default"):
+        return self.mirror.get_ref(kind, name, namespace)
 
     def watch(self, kind: str, on_add=None, on_update=None, on_delete=None,
               filter_fn=None, sync: bool = True, on_bulk_update=None):

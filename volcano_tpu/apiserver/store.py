@@ -977,6 +977,18 @@ class ObjectStore:
             items = [o for o in items if o.metadata.namespace == namespace]
         return [fast_clone(o) for o in items]
 
+    def get_ref(self, kind: str, name: str, namespace: str = "default"):
+        """Live object reference for one key — the single-key sibling of
+        :meth:`list_refs` (no clone). Stored objects are replaced, never
+        mutated in place, so the ref is a consistent view; callers MUST
+        NOT mutate. This is the HTTP read path's no-copy serve
+        (docs/design/serving.md): encoding a response reads the object,
+        it never writes it, and the per-request deep copy was the read
+        path's whole cost."""
+        key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
+        with self._lock:
+            return self._objects[kind].get(key)
+
     def list_refs(self, kind: str, namespace: Optional[str] = None) -> list:
         """Live object references — no clone. Stored objects are replaced,
         never mutated in place (the same property the journal relies on),
@@ -1025,6 +1037,17 @@ class ObjectStore:
         reserved-but-unpublished entries."""
         with self._lock:
             return self._journal_tail
+
+    def journal_window(self) -> tuple:
+        """``(head_rv, tail_rv)`` of the contiguous journal window: head
+        is the first retained entry's rv (``tail + 1`` when the journal
+        is empty), tail the watch-visible contiguous tail. A cursor c is
+        servable iff ``c + 1 >= head`` — the serving hub's structured-
+        relist decision (docs/design/serving.md)."""
+        with self._lock:
+            head = self._journal[0][0] if self._journal \
+                else self._journal_tail + 1
+            return head, self._journal_tail
 
     def events_since(self, rv: int, timeout: float = 25.0):
         """Long-poll the change journal: block until an event with

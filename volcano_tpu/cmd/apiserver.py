@@ -40,6 +40,16 @@ def main(argv=None) -> int:
                              "restore it on startup (the etcd durability "
                              "role; apiserver/persistence.py)")
     parser.add_argument("--checkpoint-interval", type=float, default=30.0)
+    # multi-tenant serving hub (docs/design/serving.md): the sharded
+    # watch fan-out behind /watchstream plus per-tenant admission at the
+    # write edge. On by default; --serving-shards 0 disables the hub
+    # (clients fall back to the long-poll /watch).
+    parser.add_argument("--serving-shards", type=int, default=4)
+    parser.add_argument("--tenant-write-rate", type=float, default=1000.0,
+                        help="per-tenant write tokens per second")
+    parser.add_argument("--tenant-write-burst", type=float, default=2000.0)
+    parser.add_argument("--max-subscriptions", type=int, default=1024,
+                        help="per-tenant concurrent watch-stream cap")
     parser.add_argument("--version", action="store_true")
     args = parser.parse_args(argv)
     if args.version:
@@ -74,7 +84,20 @@ def main(argv=None) -> int:
             ensure("nodes", Node(
                 metadata=ObjectMeta(name=f"node-{i}"),
                 status=NodeStatus(allocatable=dict(rl), capacity=dict(rl))))
-    server = StoreHTTPServer(store, host=args.host, port=args.port)
+    hub = admission = None
+    if args.serving_shards > 0:
+        from .. import serving
+        from ..serving.admission import AdmissionController
+        from ..serving.hub import ServingHub
+        admission = AdmissionController(
+            write_rate=args.tenant_write_rate,
+            write_burst=args.tenant_write_burst,
+            max_subscriptions=args.max_subscriptions)
+        hub = ServingHub(store, shards=args.serving_shards,
+                         admission=admission)
+        serving.set_active(hub=hub, admission=admission)
+    server = StoreHTTPServer(store, host=args.host, port=args.port,
+                             hub=hub, admission=admission)
     server.start()
     print(f"vc-apiserver serving on {args.host}:{server.port}", flush=True)
     stop = threading.Event()

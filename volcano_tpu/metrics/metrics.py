@@ -130,6 +130,20 @@ CONSTRAINT_ROWS = f"{NS}_constraint_rows_total"
 CONSTRAINT_FALLBACK = f"{NS}_constraint_fallback_total"
 VICTIM_SELECT_RUNS = f"{NS}_victim_select_runs_total"
 VICTIM_SELECT_LATENCY = f"{NS}_victim_select_latency_milliseconds"
+# multi-tenant serving hub (docs/design/serving.md): per-frame fan-out
+# latency, coalesced frame/event volumes (their ratio is the coalescing
+# proof), structured cursor relists pushed by the hub, per-tenant
+# admission verdicts at the write/watch edge, per-shard outbox depth,
+# and the RemoteStore's explicit cursor-gap relists (the client half of
+# the structured "gone" contract)
+SERVING_FANOUT_LATENCY = f"{NS}_serving_fanout_latency_milliseconds"
+SERVING_BATCHES = f"{NS}_serving_batches_total"
+SERVING_EVENTS = f"{NS}_serving_events_total"
+SERVING_RELISTS = f"{NS}_serving_relists_total"
+SERVING_ADMITTED = f"{NS}_serving_admitted_total"
+SERVING_THROTTLED = f"{NS}_serving_throttled_total"
+SERVING_SHARD_DEPTH = f"{NS}_serving_hub_shard_depth"
+WATCH_RELISTS = f"{NS}_watch_relists_total"
 
 # component health registry behind /debug/health: a component absent from
 # the registry is healthy by default; the watchdog (scheduler.py) flips

@@ -38,6 +38,8 @@ DEBUG_ENDPOINTS = {
     "/debug/health": "component health (503 while degraded)",
     "/debug/latency": "pod lifecycle ledger: per-hop/e2e latency percentiles",
     "/debug/timeseries": "last N cycles of key gauges/counters",
+    "/debug/serving": "serving hub shard depths / fan-out latency + "
+                      "per-tenant admission counters",
 }
 
 
@@ -76,6 +78,9 @@ def _debug_response(path: str, query: dict):
     if path == "/debug/health":
         report = m.health_report()
         return (200 if report["healthy"] else 503), report
+    if path == "/debug/serving":
+        from ..serving import serving_report
+        return 200, serving_report()
     if path == "/debug/pending":
         report = tracer.pending_report()
         if report is None:

@@ -1,0 +1,582 @@
+"""Sharded watch hub: fan-out serving over the store journal
+(docs/design/serving.md).
+
+The store's synchronous watch bus is built for the handful of in-process
+informers (cache, controllers); a serving edge with thousands of remote
+watchers needs a different shape. The hub subscribes NOTHING on the
+store — it is a pure journal consumer:
+
+* **Shards** — subscribers hash by client id onto N dispatch shards
+  (crc32, so placement is a pure function of the id and double runs are
+  identical). Each shard reads the journal once per round from the
+  minimum cursor of its subscribers and fans the burst out; one shard's
+  slow consumer never blocks another shard's dispatch.
+* **Cursors** — every subscriber carries a persistent journal cursor
+  (the rv-sorted, gap-free journal from the bind pipeline is the
+  stream). A cursor that falls off the journal window gets a structured
+  ``relist`` frame — the client re-lists and re-anchors, exactly the
+  RemoteStore resync path — instead of silently missing events.
+* **Coalescing** — everything a dispatch round finds for one subscriber
+  lands in ONE frame: a 50k-bind flush reaches an interested client as
+  a handful of framed batches (one per published journal extent seen),
+  not 50k deliveries. ``volcano_serving_batches_total`` vs
+  ``volcano_serving_events_total`` is the measured ratio.
+* **Server-side filters** — per-subscriber kind sets and field filters
+  evaluated in the hub, ONCE per distinct filter per burst (the native
+  ``attr_eq_filter_pairs`` entry classifies a whole burst in one call
+  when the filter is a declared attribute equality; Python fallback
+  otherwise). Filter FLIPS keep the PR-3 lifecycle semantics: pass→fail
+  delivers DELETED, fail→pass delivers ADDED, only pass→pass is
+  MODIFIED.
+
+Frames are plain dicts carrying journal object REFS (the store replaces
+objects wholesale, never mutates — the same property the journal
+relies on); the HTTP layer encodes them at the wire. Frame chain
+integrity: each frame carries ``prev`` (the previous frame's ``to_rv``)
+so a client can detect a lost frame and ``rewind`` — the storm gate's
+fault-recovery contract.
+
+Two drive modes: ``start()`` runs one dispatch thread per shard (the
+serving process), ``pump()`` dispatches synchronously (the simulator's
+deterministic tick hook and tests).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from ..apiserver.store import ObjectStore
+from .admission import AdmissionController
+
+# native attribute-equality classification (fastmodel.attr_eq_filter_pairs,
+# the PR-8 entry): resolved lazily, shared probe state
+_NATIVE = [None, False]
+
+
+def _native():
+    if not _NATIVE[1]:
+        _NATIVE[1] = True
+        try:
+            from ..native.build import fastmodel
+            fm = fastmodel()
+            if fm is not None and hasattr(fm, "attr_eq_filter_pairs"):
+                _NATIVE[0] = fm
+        except Exception:
+            _NATIVE[0] = None
+    return _NATIVE[0]
+
+
+class Subscription:
+    """One client's session on the hub. Owned by exactly one shard."""
+
+    MAX_OUTBOX = 256   # frames; overflow resets the subscriber via relist
+    #                    (a consumer that stopped draining re-lists rather
+    #                    than pinning unbounded memory server-side)
+
+    def __init__(self, client_id: str, tenant: str, kinds, filter_attr,
+                 filter_fn, cursor: int):
+        self.hub = None   # backref set at subscribe (relist accounting)
+        self.client_id = client_id
+        self.tenant = tenant
+        self.kinds = frozenset(kinds) if kinds else None
+        # ((a0, a1), expected) — declared attribute equality, the native
+        # classification path; filter_fn is the authority when both given
+        self.filter_attr = filter_attr
+        self.filter_fn = filter_fn
+        self.cursor = int(cursor)       # last journal rv this sub covered
+        self.last_framed = int(cursor)  # to_rv of the last frame enqueued
+        self.outbox: deque = deque()
+        self.cond = threading.Condition()
+        # keys currently PASSING the filter from this subscriber's view —
+        # the old_p half of the flip classification (the journal has no
+        # old object). Primed from the store at subscribe time.
+        self._passing: set = set()
+        self.frames_sent = 0
+        self.events_sent = 0
+        self.relists = 0
+        self.closed = False
+
+    @property
+    def filtered(self) -> bool:
+        return self.filter_attr is not None or self.filter_fn is not None
+
+    def filter_key(self):
+        if self.filter_attr is not None:
+            (a0, a1), exp = self.filter_attr
+            return ("attr", a0, a1, exp)
+        if self.filter_fn is not None:
+            return ("fn", id(self.filter_fn))
+        return None
+
+    def _passes(self, o) -> bool:
+        if self.filter_fn is not None:
+            return bool(self.filter_fn(o))
+        (a0, a1), exp = self.filter_attr
+        return getattr(getattr(o, a0, None), a1, None) == exp
+
+    # -- consumer side -----------------------------------------------------
+
+    def take_frames(self) -> List[dict]:
+        """Drain everything queued (non-blocking; the pump-mode client)."""
+        with self.cond:
+            frames = list(self.outbox)
+            self.outbox.clear()
+        return frames
+
+    def next_frame(self, timeout: Optional[float] = None) -> Optional[dict]:
+        """Block for the next frame (the streaming HTTP handler)."""
+        with self.cond:
+            if not self.outbox:
+                self.cond.wait(timeout)
+            return self.outbox.popleft() if self.outbox else None
+
+    # -- shard side (shard lock held) --------------------------------------
+
+    def _enqueue(self, frame: dict) -> None:
+        overflowed = False
+        with self.cond:
+            if len(self.outbox) >= self.MAX_OUTBOX:
+                # slow consumer: reset via relist instead of growing
+                self.outbox.clear()
+                frame = {"relist": True, "rv": frame.get("to_rv",
+                                                         frame.get("rv", 0)),
+                         "prev": self.last_framed}
+                self.relists += 1
+                overflowed = True
+            self.outbox.append(frame)
+            self.cond.notify_all()
+        if overflowed and self.hub is not None:
+            self.hub._note_relist()
+
+
+class HubShard:
+    """One dispatch shard: a set of subscribers + the journal read loop."""
+
+    def __init__(self, index: int, store: ObjectStore, hub: "ServingHub"):
+        self.index = index
+        self.store = store
+        self.hub = hub
+        self.lock = threading.Lock()
+        self.subs: List[Subscription] = []
+        self._thread: Optional[threading.Thread] = None
+
+    # -- membership --------------------------------------------------------
+
+    def add(self, sub: Subscription) -> None:
+        with self.lock:
+            self.subs.append(sub)
+
+    def remove(self, sub: Subscription) -> None:
+        with self.lock:
+            if sub in self.subs:
+                self.subs.remove(sub)
+        sub.closed = True
+        with sub.cond:
+            sub.cond.notify_all()
+
+    def depth(self) -> int:
+        with self.lock:
+            return sum(len(s.outbox) for s in self.subs)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def dispatch_once(self, timeout: float = 0.0) -> int:
+        """One fan-out round: read the journal once from the shard's
+        minimum cursor, deliver ONE coalesced frame per subscriber with
+        news, relist cursors that fell off the window. Returns frames
+        enqueued."""
+        with self.lock:
+            subs = list(self.subs)
+        if not subs:
+            if timeout:
+                self.hub._stop.wait(timeout)
+            return 0
+        frames = 0
+        head, tail = self.store.journal_window()
+        # structured relist for cursors that fell off the journal window
+        # (the window rolled past them, or a snapshot restore cleared it)
+        for sub in subs:
+            if sub.cursor + 1 < head:
+                self._relist(sub, tail)
+                frames += 1
+        min_cursor = min(sub.cursor for sub in subs)
+        events, tail, resync = self.store.events_since(min_cursor, timeout)
+        if resync:
+            # the window moved between our check and the read (or the
+            # journal was force-cleared): re-anchor every lagging cursor
+            head, tail = self.store.journal_window()
+            for sub in subs:
+                if sub.cursor < tail and sub.cursor + 1 < head:
+                    self._relist(sub, tail)
+                    frames += 1
+            return frames
+        if not events:
+            return frames
+        t0 = time.perf_counter()
+        burst = _BurstIndex(self.store, events)
+        from bisect import bisect_right
+        for sub in subs:
+            if sub.cursor >= tail:
+                continue
+            start = bisect_right(burst.rvs, sub.cursor)
+            delivered = self._select(sub, burst, start)
+            considered = len(events) - start
+            sub.cursor = tail
+            if not delivered:
+                continue   # cursor advanced silently: nothing of interest
+            frame = {"prev": sub.last_framed,
+                     "from_rv": events[start][0], "to_rv": tail,
+                     "events": delivered, "coalesced_from": considered}
+            sub.last_framed = tail
+            sub._enqueue(frame)
+            sub.frames_sent += 1
+            sub.events_sent += len(delivered)
+            frames += 1
+            self.hub._note_frame(len(delivered),
+                                 (time.perf_counter() - t0) * 1000.0)
+        self.hub._note_depth(self.index, self.depth())
+        return frames
+
+    def _relist(self, sub: Subscription, tail: int) -> None:
+        """Push the structured relist signal and re-anchor the cursor:
+        the client must re-list and resume from ``rv`` (exactly the
+        informer resync-after-watch-expiry contract)."""
+        sub._enqueue({"relist": True, "rv": tail, "prev": sub.last_framed})
+        sub.cursor = tail
+        sub.last_framed = tail
+        sub._passing.clear()
+        sub.relists += 1
+        self.hub._note_relist()
+
+    def _select(self, sub: Subscription, burst: "_BurstIndex",
+                start: int):
+        """Apply the subscriber's kind + field filters to the burst's
+        ``[start:]`` slice, classifying flips as lifecycle transitions
+        (see module doc). Per-sub cost is proportional to DELIVERED
+        events, not burst size: the burst index precomputes, once per
+        distinct filter per round, the verdict vector, the passing
+        indices and a failing-key map — so 1k identically-filtered
+        subscribers pay one classification plus their own slices."""
+        from bisect import bisect_left
+        events = burst.events
+        kinds = sub.kinds
+        if not sub.filtered:
+            if kinds is None:
+                return events[start:]
+            out = []
+            for kind in kinds:
+                idx = burst.kind_idx().get(kind)
+                if idx:
+                    out.extend(idx[bisect_left(idx, start):])
+            if len(kinds) > 1:
+                out.sort()
+            return [events[i] for i in out]
+        pass_set, pass_idx = burst.filter_index(sub)
+        keys = burst.keys()
+        key_idx = burst.key_idx()
+        passing = sub._passing
+        # candidate indices: every passing event past the cursor, plus
+        # FAILING events whose key this subscriber currently sees as
+        # passing (the potential pass->fail flips) — including keys that
+        # BECOME passing within this very burst (add-then-flip). Cost is
+        # O(delivered + |passing|), never O(burst).
+        cand = pass_idx[bisect_left(pass_idx, start):]
+        flip_keys = set(passing)
+        flip_keys.update(keys[i] for i in cand)
+        fail_idx = []
+        for key in flip_keys:
+            for i in key_idx.get(key, ()):
+                if i >= start and i not in pass_set:
+                    fail_idx.append(i)
+        if fail_idx:
+            cand = sorted(set(cand).union(fail_idx))
+        out = []
+        for i in cand:
+            rv, action, kind, o = events[i]
+            if kinds is not None and kind not in kinds:
+                continue
+            key = keys[i]
+            old_p = key in passing
+            if action == "DELETED":
+                if old_p:
+                    passing.discard(key)
+                    out.append((rv, "DELETED", kind, o))
+                continue
+            if i in pass_set:
+                passing.add(key)
+                # fail->pass (or a fresh ADDED) surfaces as ADDED; only
+                # pass->pass is MODIFIED — the four delivery paths of
+                # the store's filtered watches, evaluated hub-side
+                out.append((rv, "MODIFIED" if old_p else "ADDED", kind, o))
+            elif old_p:
+                passing.discard(key)
+                out.append((rv, "DELETED", kind, o))
+        return out
+
+    # -- threaded mode -----------------------------------------------------
+
+    def run_loop(self) -> None:
+        while not self.hub._stop.is_set():
+            try:
+                self.dispatch_once(timeout=self.hub.poll_timeout)
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception(
+                    "hub shard %d dispatch failed", self.index)
+                self.hub._stop.wait(0.2)
+
+
+class _BurstIndex:
+    """Shared per-dispatch-round indexes over one fetched burst: rvs for
+    cursor bisects, (kind, key) per event, per-kind and per-key index
+    lists, the (o, o) pair list the native classifier consumes, and per
+    DISTINCT filter the passing index set. Everything here is computed
+    at most once per round no matter how many subscribers consume it —
+    the server-side cost of 1k identically-filtered watchers is ONE
+    classification."""
+
+    def __init__(self, store, events: list):
+        self.store = store
+        self.events = events
+        self.rvs = [e[0] for e in events]
+        self._keys: Optional[list] = None
+        self._kind_idx: Optional[dict] = None
+        self._key_idx: Optional[dict] = None
+        self._pairs: Optional[list] = None
+        self._id2idx: Optional[dict] = None
+        self._filters: dict = {}
+
+    def keys(self) -> list:
+        if self._keys is None:
+            key_of = self.store.key_of
+            self._keys = [(e[2], key_of(e[2], e[3]))
+                          for e in self.events]
+        return self._keys
+
+    def kind_idx(self) -> dict:
+        if self._kind_idx is None:
+            idx: dict = {}
+            for i, e in enumerate(self.events):
+                idx.setdefault(e[2], []).append(i)
+            self._kind_idx = idx
+        return self._kind_idx
+
+    def key_idx(self) -> dict:
+        """(kind, key) -> [indices] over the whole burst (shared by
+        every filtered subscriber's flip lookup)."""
+        if self._key_idx is None:
+            idx: dict = {}
+            for i, key in enumerate(self.keys()):
+                idx.setdefault(key, []).append(i)
+            self._key_idx = idx
+        return self._key_idx
+
+    def _pair_list(self) -> list:
+        if self._pairs is None:
+            self._pairs = [(e[3], e[3]) for e in self.events]
+            # the index key is the PAIR TUPLE's identity, not the
+            # object's: a DELETED journal entry reuses the ADDED/
+            # MODIFIED entry's object instance, but each pair tuple
+            # here is freshly allocated and unique per index
+            self._id2idx = {id(p): i
+                            for i, p in enumerate(self._pairs)}
+        return self._pairs
+
+    def filter_index(self, sub: Subscription) -> tuple:
+        """(pass_set, pass_idx) for the subscriber's filter, computed
+        once per distinct filter per round — natively via the PR-8
+        ``attr_eq_filter_pairs`` entry for declared attribute equalities
+        ((o, o) pairs: pass->pass membership IS the verdict, one C call
+        per burst per filter), Python ``filter_fn`` otherwise."""
+        fkey = sub.filter_key()
+        got = self._filters.get(fkey)
+        if got is not None:
+            return got
+        events = self.events
+        pass_idx = None
+        if sub.filter_attr is not None and sub.filter_fn is None:
+            fm = _native()
+            if fm is not None:
+                (a0, a1), exp = sub.filter_attr
+                pairs = self._pair_list()
+                try:
+                    delivery, _ = fm.attr_eq_filter_pairs(pairs, a0, a1,
+                                                          exp)
+                    id2idx = self._id2idx
+                    pass_idx = sorted(id2idx[id(p)] for p in delivery)
+                except Exception:
+                    pass_idx = None
+        if pass_idx is None:
+            pass_idx = [i for i, e in enumerate(events)
+                        if sub._passes(e[3])]
+        self._filters[fkey] = (set(pass_idx), pass_idx)
+        return self._filters[fkey]
+
+
+class ServingHub:
+    """The multi-tenant watch hub over one store's journal."""
+
+    def __init__(self, store: ObjectStore, shards: int = 4,
+                 admission: Optional[AdmissionController] = None,
+                 poll_timeout: float = 0.5):
+        self.store = store
+        self.admission = admission
+        self.poll_timeout = poll_timeout
+        self.shards = [HubShard(i, store, self)
+                       for i in range(max(1, int(shards)))]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        # bounded rolling window of per-frame fan-out latencies (ms) for
+        # the bench percentiles; the histogram metric is the full record
+        self.fanout_ms: deque = deque(maxlen=65536)
+        self.frames_total = 0
+        self.events_total = 0
+        self.relists_total = 0
+
+    # -- subscriber lifecycle ----------------------------------------------
+
+    def shard_of(self, client_id: str) -> HubShard:
+        return self.shards[zlib.crc32(client_id.encode())
+                           % len(self.shards)]
+
+    def subscribe(self, client_id: str, tenant: str = "default",
+                  kinds=None, filter_attr=None,
+                  filter_fn: Optional[Callable] = None,
+                  since_rv: Optional[int] = None,
+                  prime: bool = True) -> Subscription:
+        """Create a session. ``since_rv=None`` anchors at the journal
+        tail (new events only — the list half is the client's job);
+        an explicit rv replays the journal from there, or relists if it
+        already fell off the window. Raises ThrottledError past the
+        tenant's subscription cap."""
+        if self.admission is not None:
+            self.admission.acquire_subscription(tenant)
+        try:
+            tail = self.store.current_rv()
+            cursor = tail if since_rv is None else int(since_rv)
+            sub = Subscription(client_id, tenant, kinds, filter_attr,
+                               filter_fn, cursor)
+            sub.hub = self
+            if prime and sub.filtered and cursor >= tail:
+                # old_p baseline: what a list-then-watch client already
+                # sees passing (kind-scoped; the whole store otherwise).
+                # ONLY valid when the cursor anchors at the tail — the
+                # store's CURRENT state is not the view at a past rv, so
+                # a replaying subscriber starts from an empty baseline
+                # instead (replayed first-pass events classify as ADDED,
+                # exactly informer relist semantics).
+                from ..apiserver.store import KINDS
+                for kind in (sub.kinds or KINDS):
+                    for o in self.store.list_refs(kind):
+                        if sub._passes(o):
+                            sub._passing.add((kind,
+                                              self.store.key_of(kind, o)))
+            self.shard_of(client_id).add(sub)
+            return sub
+        except BaseException:
+            if self.admission is not None:
+                self.admission.release_subscription(tenant)
+            raise
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        self.shard_of(sub.client_id).remove(sub)
+        if self.admission is not None:
+            self.admission.release_subscription(sub.tenant)
+
+    def rewind(self, sub: Subscription, rv: int) -> None:
+        """Client-detected frame loss: replay the journal from ``rv``
+        (the client's last applied frame chain point). If ``rv`` already
+        fell off the window the next dispatch relists instead."""
+        shard = self.shard_of(sub.client_id)
+        with shard.lock:
+            sub.cursor = min(sub.cursor, int(rv))
+            sub.last_framed = int(rv)
+
+    def subscriber_count(self) -> int:
+        return sum(len(s.subs) for s in self.shards)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def pump(self) -> int:
+        """Synchronous dispatch round over every shard (deterministic —
+        the simulator's tick hook and the tests)."""
+        return sum(shard.dispatch_once(timeout=0.0)
+                   for shard in self.shards)
+
+    def start(self) -> None:
+        if self._threads:
+            return
+        self._stop.clear()
+        for shard in self.shards:
+            t = threading.Thread(target=shard.run_loop, daemon=True,
+                                 name=f"hub-shard-{shard.index}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        # wake the journal waiters so shard threads observe the stop
+        try:
+            with self.store._lock:
+                self.store._journal_cond.notify_all()
+        except Exception:
+            pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+
+    # -- accounting ----------------------------------------------------------
+
+    def _note_frame(self, n_events: int, latency_ms: float) -> None:
+        with self._lock:
+            self.frames_total += 1
+            self.events_total += n_events
+            self.fanout_ms.append(latency_ms)
+        try:
+            from ..metrics import metrics as m
+            m.inc(m.SERVING_BATCHES)
+            m.inc(m.SERVING_EVENTS, n_events)
+            m.observe(m.SERVING_FANOUT_LATENCY, latency_ms)
+        except Exception:
+            pass
+
+    def _note_relist(self) -> None:
+        with self._lock:
+            self.relists_total += 1
+        try:
+            from ..metrics import metrics as m
+            m.inc(m.SERVING_RELISTS)
+        except Exception:
+            pass
+
+    def _note_depth(self, shard: int, depth: int) -> None:
+        try:
+            from ..metrics import metrics as m
+            m.set_gauge(m.SERVING_SHARD_DEPTH, depth, shard=str(shard))
+        except Exception:
+            pass
+
+    def fanout_percentiles(self) -> dict:
+        with self._lock:
+            lat = sorted(self.fanout_ms)
+        if not lat:
+            return {"p50": 0.0, "p95": 0.0, "p99": 0.0, "count": 0}
+        at = lambda q: lat[min(len(lat) - 1, int(q * len(lat)))]
+        return {"p50": round(at(0.50), 3), "p95": round(at(0.95), 3),
+                "p99": round(at(0.99), 3), "count": len(lat)}
+
+    def report(self) -> dict:
+        return {
+            "shards": len(self.shards),
+            "subscribers": self.subscriber_count(),
+            "shard_depths": {s.index: s.depth() for s in self.shards},
+            "frames_total": self.frames_total,
+            "events_total": self.events_total,
+            "relists_total": self.relists_total,
+            "fanout_ms": self.fanout_percentiles(),
+        }

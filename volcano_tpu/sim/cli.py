@@ -151,6 +151,24 @@ def add_sim_parser(sub) -> None:
     cons.add_argument("--zones", type=int, default=4)
     cons.add_argument("--json", action="store_true")
 
+    storm = sim.add_parser(
+        "storm", help="CI gate (make storm-smoke): watcher storm — 1k+ "
+                      "hub subscribers across tenants with seeded frame "
+                      "drops, a mid-storm journal gap and real cache "
+                      "watch faults, through a bind-flush storm; every "
+                      "cursor must converge to the final rv with zero "
+                      "unrecovered gaps, >=1 structured relist, >=1 "
+                      "throttled tenant, coalesced (not per-event) "
+                      "delivery, and a bit-identical double run on bind "
+                      "AND ledger fingerprints")
+    storm.add_argument("--seed", type=int, default=43)
+    storm.add_argument("--ticks", type=int, default=80)
+    storm.add_argument("--nodes", type=int, default=192)
+    storm.add_argument("--subscribers", type=int, default=1024)
+    storm.add_argument("--shards", type=int, default=8)
+    storm.add_argument("--drop-rate", type=float, default=0.03)
+    storm.add_argument("--json", action="store_true")
+
     rep = sim.add_parser("replay", help="re-run a violation repro bundle")
     rep.add_argument("--bundle", required=True)
     rep.add_argument("--use-trace", action="store_true",
@@ -844,6 +862,83 @@ def dispatch_sim(args) -> int:
                 print(f"  {name}: {'ok' if ok else 'FAIL'}")
             print("constraint-smoke: "
                   f"{'PASS' if verdict['pass'] else 'FAIL'}")
+        return 0 if verdict["pass"] else 1
+
+    if args.verb == "storm":
+        from ..framework.solver import reset_breaker
+        from ..serving.storm import run_storm
+
+        def one_run():
+            reset_breaker()
+            return run_storm(seed=args.seed, ticks=args.ticks,
+                             nodes=args.nodes,
+                             subscribers=args.subscribers,
+                             shards=args.shards, drop_rate=args.drop_rate)
+
+        v1 = one_run()
+        v2 = one_run()
+        checks = {
+            # the engine's own invariant catalog (journal order incl.)
+            # stayed clean under the storm in both runs
+            "no_violations": v1["violations"] == 0
+                             and v2["violations"] == 0,
+            # every subscriber session reached the final store rv
+            "all_converged": v1["converged"] == v1["subscribers"]
+                             and v2["converged"] == v2["subscribers"]
+                             and v1["subscribers"] >= args.subscribers
+                             - max(16, args.subscribers // 50),
+            # and no frame-chain hole survived recovery
+            "zero_gaps": v1["gaps_unrecovered"] == 0
+                         and v2["gaps_unrecovered"] == 0,
+            # the faults provably fired: frames dropped + chain gaps
+            # detected and recovered client-side
+            "faults_fired": v1["frames_dropped"] > 0
+                            and v1["gaps_detected"] > 0,
+            # the mid-storm journal gap took the structured relist path
+            "relist_taken": v1["relists"] >= 1,
+            # the noisy tenant was throttled at the admission edge
+            "throttled_tenant_observed":
+                v1["noisy_throttled_writes"] >= 1
+                or v1["noisy_subscription_throttles"] >= 1,
+            # a storm burst reaches a client as coalesced frames, not
+            # per-event deliveries
+            "coalesced_delivery": v1["coalesce_ratio"] >= 5.0,
+            "deterministic_replay":
+                v1["bind_fingerprint"] == v2["bind_fingerprint"]
+                and v1["ledger_fingerprint"] == v2["ledger_fingerprint"]
+                and v1["noisy_throttled_writes"]
+                == v2["noisy_throttled_writes"],
+        }
+        verdict = {
+            "storm": v1["storm"],
+            "fanout_ms": v1["fanout_ms"],
+            "subscribers": v1["subscribers"],
+            "frames_total": v1["frames_total"],
+            "events_total": v1["events_total"],
+            "coalesce_ratio": v1["coalesce_ratio"],
+            "relists": v1["relists"],
+            "throttled": v1["throttled"],
+            "checks": checks,
+            "pass": all(checks.values()),
+        }
+        if args.json:
+            print(json.dumps(verdict, indent=1))
+        else:
+            _print_summary(v1["storm"], False)
+            print(f"subscribers={v1['subscribers']} "
+                  f"converged={v1['converged']} "
+                  f"frames={v1['frames_total']} "
+                  f"events={v1['events_total']} "
+                  f"(x{v1['coalesce_ratio']} coalesced) "
+                  f"dropped={v1['frames_dropped']} "
+                  f"gaps={v1['gaps_detected']} relists={v1['relists']}")
+            f = v1["fanout_ms"]
+            print(f"fan-out ms: p50={f['p50']} p95={f['p95']} "
+                  f"p99={f['p99']} (n={f['count']})")
+            print(f"throttled: {v1['throttled']}")
+            for name, ok in checks.items():
+                print(f"  {name}: {'ok' if ok else 'FAIL'}")
+            print(f"storm-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
         return 0 if verdict["pass"] else 1
 
     if args.verb == "replay":

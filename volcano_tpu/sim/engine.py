@@ -293,6 +293,12 @@ class SimEngine:
         self._node_catalog: Dict[str, tuple] = {}
         self._bind_cursor = 0
         self._evict_cursor = 0
+        # per-tick observer hooks, called at the tick barrier (after the
+        # flush + kubelet step, before the next tick's clock advance)
+        # with the tick index. Observers only: the watcher-storm gate
+        # (serving/storm.py) pumps its hub fan-out here — hooks must not
+        # mutate scheduler/cache/store state or determinism breaks.
+        self.tick_hooks: List = []
         # gang-atomicity convergence streaks (invariants.py): persists
         # across per-tick CycleContexts
         self._partial_streaks: Dict[str, int] = {}
@@ -799,6 +805,8 @@ class SimEngine:
                 # simulated kubelet runs after the audit: the checkers see
                 # the scheduler's output state, not the lifecycle echo
                 self._kubelet_step()
+                for hook in self.tick_hooks:
+                    hook(tick)
                 self.result.ticks.append(TickStats(
                     tick=tick, vtime=self.clock.now(), cycle_ms=cycle_ms,
                     events=len(events), new_binds=new_binds,
