@@ -5,7 +5,7 @@ PYTHON ?= python
 .PHONY: test unit-test e2e bench bench-all bench-check multichip-dryrun \
 	deploy deploy-up trace-smoke sim-smoke flush-bench chaos-smoke \
 	failover-smoke obs-smoke incr-smoke multichip-smoke constraint-smoke \
-	storm-smoke
+	storm-smoke lint sanitize
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -17,12 +17,28 @@ deploy:
 deploy-up:
 	$(PYTHON) -m volcano_tpu.cmd.deploy --keep
 
+# invariant lint suite (the `go vet` equivalent,
+# docs/design/static_analysis.md): AST-enforced clock / lock /
+# native-fallback / seeded-randomness / jit-purity contracts over
+# volcano_tpu/. Nonzero exit on any finding or stale baseline entry.
+lint:
+	$(PYTHON) -m volcano_tpu.lint
+
+# native sanitizer gate (the `go test -race` equivalent for the C hot
+# path): rebuilds fastmodel.c + solver.cc under ASan/UBSan at a
+# distinct artifact hash and re-runs the native parity suites with the
+# runtimes LD_PRELOADed (tools/sanitize_gate.py). ~2 min.
+sanitize:
+	JAX_PLATFORMS=cpu $(PYTHON) tools/sanitize_gate.py
+
 # the standard unit gate (reference: make unit-test, go test -p 8 -race ...)
 # tests force the virtual 8-device CPU mesh (tests/conftest.py); the
-# concurrency suite is the -race-equivalent adversarial gate
+# concurrency suite is the -race-equivalent adversarial gate; the lint
+# suite runs first — a contract violation fails the gate before the
+# (much slower) pytest sweep starts
 test: unit-test
 
-unit-test:
+unit-test: lint
 	$(PYTHON) -m pytest tests/ -q
 
 # the multi-process control-plane e2e alone (four OS processes)

@@ -341,22 +341,42 @@ class TestNativeParity:
     and the same lifecycle-ledger aggregate fingerprint. These are the
     acceptance fingerprints of docs/design/bind_pipeline.md."""
 
+    #: native entry -> the switch that routes the pipeline through it
+    #: (the registry parity tests below isolate them one at a time)
+    SWITCHES = {
+        "publish_shard": ("store", "NATIVE_PUBLISH"),
+        "bind_echo_apply": ("cache", "NATIVE_ECHO"),
+        "bind_apply_bursts": ("cache", "NATIVE_APPLY"),
+        "ledger_confirm_runs": ("ledger", "NATIVE_CONFIRM"),
+    }
+
     @staticmethod
-    def _set_native(on: bool) -> None:
+    def _set_switches(**states) -> None:
+        """Set the four native-engine switches; unnamed ones default to
+        the ``native`` kwarg (all-on/all-off)."""
         from volcano_tpu.apiserver.store import ObjectStore as S
         from volcano_tpu.cache.cache import SchedulerCache as C
         from volcano_tpu.trace import ledger as L
-        S.NATIVE_PUBLISH = on
-        C.NATIVE_ECHO = on
-        C.NATIVE_APPLY = on
-        L.NATIVE_CONFIRM = on
+        base = states.pop("native", True)
+        known = {attr for _, attr in TestNativeParity.SWITCHES.values()}
+        unknown = set(states) - known
+        assert not unknown, \
+            f"unknown native switch(es) {unknown}; valid: {sorted(known)}"
+        owners = {"store": S, "cache": C, "ledger": L}
+        for entry, (owner, attr) in TestNativeParity.SWITCHES.items():
+            setattr(owners[owner], attr, states.get(attr, base))
+
+    @classmethod
+    def _set_native(cls, on: bool) -> None:
+        cls._set_switches(native=on)
 
     @pytest.fixture(autouse=True)
     def _restore_native(self):
         yield
         self._set_native(True)
 
-    def _run_flush(self, native: bool, n_jobs=64, gang=8, n_nodes=16):
+    def _run_flush(self, native: bool, n_jobs=64, gang=8, n_nodes=16,
+                   switches=None):
         """One full coalesced cache flush (write-behind applies, sharded
         store commit, echo ingest) on a virtual clock; returns a
         deep fingerprint of every observable surface."""
@@ -366,7 +386,10 @@ class TestNativeParity:
         from volcano_tpu.trace import ledger
         from volcano_tpu.utils.clock import FakeClock
 
-        self._set_native(native)
+        if switches is None:
+            self._set_native(native)
+        else:
+            self._set_switches(native=native, **switches)
         store = ObjectStore(clock=FakeClock(start=1.0))
         store.SHARD_SERIAL_MAX = 0
         store.SHARD_TARGET = 128        # 512 binds -> 4 shards
@@ -460,6 +483,23 @@ class TestNativeParity:
         b = self._run_flush(native=False)
         assert a["completed"] == 64 * 8 and a["open"] == 0
         assert a == b
+
+    @pytest.mark.parametrize("entry", sorted(SWITCHES))
+    def test_per_entry_native_parity(self, entry):
+        """Registry-level parity, one native entry at a time: a flush
+        with ONLY this entry's engine native must fingerprint
+        bit-identically to the all-Python pipeline (publish_shard /
+        bind_echo_apply / bind_apply_bursts / ledger_confirm_runs —
+        the all-on/all-off test above can mask a pair of engines whose
+        divergences cancel)."""
+        from volcano_tpu.native.build import fastmodel
+        if fastmodel() is None:
+            pytest.skip("fastmodel unavailable")
+        _, attr = self.SWITCHES[entry]
+        only = self._run_flush(native=False, n_jobs=16,
+                               switches={attr: True})
+        pure = self._run_flush(native=False, n_jobs=16)
+        assert only == pure
 
     def test_native_publish_vs_python_raising_fn_state(self):
         """The raising-fn containment path (no-op version, gap-free

@@ -1,0 +1,115 @@
+"""`make sanitize` — rebuild both natives under ASan/UBSan and run the
+native parity suites against the sanitized artifacts.
+
+The lint suite (docs/design/static_analysis.md) proves the CONTRACTS
+hold statically; this gate turns the 2.3k-line fastmodel.c + solver.cc
+hot path from "parity-tested" into "parity-AND-memory-safety-tested":
+every parity fingerprint is recomputed with AddressSanitizer and
+UndefinedBehaviorSanitizer interposed, so an out-of-bounds slot copy, a
+leaked reference pattern that scribbles, or UB the optimizer happened
+to be kind to fails the run loudly instead of corrupting a 50k-bind
+flush one day.
+
+Mechanics (see native/build.py): VOLCANO_SANITIZE=address,undefined
+switches both builds to sanitized CFLAGS at a DISTINCT artifact name
+(`...-asan-ubsan.so`), so sanitized .so's never shadow production ones;
+python itself is uninstrumented, so the sanitizer runtimes are
+LD_PRELOADed into the test children. Leak checking is off by design —
+CPython/jax intentionally leak at interpreter exit; ASan's
+use-after-free / OOB / UBSan checks are the signal here.
+
+Exit nonzero on: missing toolchain runtimes, a sanitized build that
+fails to load (a silent Python-fallback run would make the gate
+meaningless), or any test failure / sanitizer report.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SANITIZE = "address,undefined"
+#: the native parity suites: fastmodel pipeline engines + registry +
+#: model clones, and the C++ solver vs the XLA kernels
+SUITES = [
+    "tests/test_flush_pipeline.py::TestNativeParity",
+    "tests/test_native_registry.py",
+    "tests/test_native_model.py",
+    "tests/test_native_kernel.py",
+]
+
+_PREFLIGHT = r"""
+import json
+from volcano_tpu.native import build
+mode = build.sanitize_mode()
+assert mode == "asan-ubsan", f"unexpected sanitize mode: {mode!r}"
+fm = build.fastmodel()
+assert fm is not None, "sanitized fastmodel failed to build/load"
+fm_path = build.fastmodel_path()
+assert mode in fm_path, fm_path
+from volcano_tpu.ops import native as solver
+assert solver.available(), f"sanitized solver unavailable: {solver._lib_err}"
+so_path = build.ensure_built()
+assert mode in so_path, so_path
+print(json.dumps({"fastmodel": fm_path, "solver": so_path}))
+"""
+
+
+def _runtime(compiler: str, lib: str) -> str:
+    out = subprocess.run([compiler, f"-print-file-name={lib}"],
+                         capture_output=True, text=True).stdout.strip()
+    if not out or not os.path.isabs(out) or not os.path.exists(out):
+        raise SystemExit(f"sanitize: {lib} not found via {compiler} "
+                         f"(toolchain without sanitizer runtimes?)")
+    return out
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env["VOLCANO_SANITIZE"] = SANITIZE
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # runtimes must be interposed before uninstrumented python's malloc
+    env["LD_PRELOAD"] = " ".join(
+        filter(None, [_runtime("gcc", "libasan.so"),
+                      _runtime("gcc", "libubsan.so"),
+                      os.environ.get("LD_PRELOAD", "")])).strip()
+    # detect_leaks=0: CPython + jax leak at exit by design; the gate's
+    # signal is OOB/UAF/UB, which still aborts the process
+    env.setdefault("ASAN_OPTIONS", "detect_leaks=0")
+    env.setdefault("UBSAN_OPTIONS",
+                   "print_stacktrace=1:halt_on_error=1")
+
+    print(f"sanitize: building natives with -fsanitize={SANITIZE} ...")
+    r = subprocess.run([sys.executable, "-c", _PREFLIGHT], env=env,
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=600)
+    if r.returncode != 0:
+        print(r.stdout)
+        print(r.stderr, file=sys.stderr)
+        print("sanitize: FAIL — sanitized natives did not build/load "
+              "(a Python-fallback run would prove nothing)",
+              file=sys.stderr)
+        return 1
+    arts = json.loads(r.stdout.strip().splitlines()[-1])
+    print(f"sanitize: fastmodel -> {os.path.basename(arts['fastmodel'])}")
+    print(f"sanitize: solver    -> {os.path.basename(arts['solver'])}")
+
+    cmd = [sys.executable, "-m", "pytest", *SUITES, "-q",
+           "-p", "no:cacheprovider"]
+    print(f"sanitize: {' '.join(cmd)}")
+    rc = subprocess.run(cmd, env=env, cwd=REPO).returncode
+    if rc != 0:
+        print("sanitize: FAIL — parity suites under ASan/UBSan",
+              file=sys.stderr)
+        return rc
+    print("sanitize: OK — native parity suites clean under "
+          "AddressSanitizer + UndefinedBehaviorSanitizer")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -804,7 +804,7 @@ class ObjectStore:
                 with tracer.async_span("store.patch.clone_wait"):
                     news = fut.result()
                 with tracer.async_span("store.patch.publish"):
-                    spairs = self._install_shard_locked(kind, shard, news,
+                    spairs = self._install_shard(kind, shard, news,
                                                         base)
                 published += 1
                 pairs_all.extend(spairs)
@@ -821,7 +821,7 @@ class ObjectStore:
                     news = [clone_fn(old) for _, old, _ in shard]
                     for i, new in enumerate(news):
                         new.metadata.resource_version = base + i + 1
-                    self._install_shard_locked(kind, shard, news, base)
+                    self._install_shard(kind, shard, news, base)
             # echo drain: the patch must not return (nor the bind flush
             # release its barrier) with deliveries still in flight
             if deliveries:
@@ -834,8 +834,9 @@ class ObjectStore:
             raise deliver_err[0]
         return pairs_all, missing
 
-    def _install_shard_locked(self, kind, shard, news, rv_base) -> list:
-        """Ordered-publish step: install a shard's new versions, append
+    def _install_shard(self, kind, shard, news, rv_base) -> list:
+        """Ordered-publish step (acquires the store lock itself — NOT a
+        `*_locked` callee): install a shard's new versions, append
         their journal entries (the contiguous reserved rvs from
         ``rv_base + 1``) and release the shard's write barrier. The whole
         per-shard loop — install + journal-entry construction + delivery

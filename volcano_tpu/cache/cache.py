@@ -629,7 +629,8 @@ class SchedulerCache(EventHandlersMixin):
         fair share; priority-class and quota edits re-resolve every job;
         numa topology feeds every node's scheduler view; an anti-entropy
         repair means the dirty sets themselves cannot be trusted)."""
-        self._dirty_structural = True
+        with self.mutex:   # RLock: safe from callers already holding it
+            self._dirty_structural = True
 
     def absorb_session_touches(self, jobs, nodes) -> None:
         """Fold a closing session's own mutations (placements, pipelined

@@ -1,0 +1,35 @@
+"""Project-specific static analysis: the invariant lint suite.
+
+Every perf/robustness layer in this tree leans on a handful of contracts
+that are cheap to state and expensive to re-verify at runtime:
+
+- **clock discipline** — deterministic paths read time through the
+  injected :class:`~volcano_tpu.utils.clock.Clock` seam, never the wall
+  clock directly (a stray ``time.time()`` is a latent double-run
+  determinism bug that only a storm-scale smoke gate would catch);
+- **lock discipline** — ``*_locked`` methods in the store and cache run
+  only under their owning lock, and the declared guarded fields are
+  mutated only under it;
+- **native-fallback parity** — every C entry exported by
+  ``native/fastmodel.c`` has a guarded Python call site (a fallback path
+  exists) and a parity test naming it in ``tests/``;
+- **seeded randomness** — sim/ops/framework draw randomness from seeded
+  generators only, never the process-global RNG;
+- **jit purity** — jitted / ``shard_map``-ped kernel bodies in ``ops/``
+  contain no metric bumps, ledger stamps, prints or clock reads (they
+  silently no-op under tracing or force recompiles).
+
+``python -m volcano_tpu.lint`` runs all rules over the package and exits
+nonzero on any finding.  Deliberate violations carry an inline pragma
+with a reason::
+
+    x = time.time()   # lint: allow(clock-discipline): export metadata only
+
+or live in the checked-in baseline file
+(``volcano_tpu/lint/baseline.txt``); a baseline entry whose violation no
+longer exists fails the run, so the allowlist only ever shrinks.
+See docs/design/static_analysis.md.
+"""
+
+from .framework import Finding, LintContext, Rule, collect_modules  # noqa: F401
+from .runner import default_rules, run_lint  # noqa: F401

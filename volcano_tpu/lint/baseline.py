@@ -1,0 +1,75 @@
+"""Checked-in finding allowlist with stale-entry detection.
+
+Format, one entry per line (``#`` comments and blanks ignored)::
+
+    <rule> <repo-relative-path> <line-crc8>   # free-form note
+
+The third token is :meth:`Finding.key`'s crc of the STRIPPED violating
+source line — line numbers drift on unrelated edits, line content only
+changes when the violation itself changes.  Matching is content-based:
+a baseline entry suppresses every current finding with the same
+(rule, path, crc).
+
+The allowlist only shrinks: an entry whose violation no longer exists
+is itself an error (``stale baseline entry``), so fixed code cannot
+leave a dangling waiver behind for a future regression to hide under.
+The shipped baseline is EMPTY — deliberate violations carry inline
+``# lint: allow(rule): reason`` pragmas instead; the baseline exists
+for bulk-migration situations where annotating hundreds of legacy
+sites inline would drown the diff.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Set, Tuple
+
+from .framework import Finding
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.txt")
+
+
+def load(path: str) -> List[Tuple[str, str, str]]:
+    entries: List[Tuple[str, str, str]] = []
+    if not os.path.exists(path):
+        return entries
+    with open(path, encoding="utf-8") as f:
+        for ln, raw in enumerate(f, start=1):
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) != 3:
+                raise ValueError(
+                    f"{path}:{ln}: malformed baseline entry (want "
+                    f"`<rule> <path> <crc>`): {raw.strip()!r}")
+            entries.append((parts[0], parts[1], parts[2]))
+    return entries
+
+
+def apply(findings: Iterable[Finding], entries,
+          raw_findings: Iterable[Finding] = None
+          ) -> Tuple[List[Finding], List[Finding]]:
+    """Returns (unsuppressed findings, stale-entry findings).
+
+    Staleness is judged against ``raw_findings`` (pre-pragma) when
+    given: a violation that still exists but gained an inline pragma
+    must NOT make its baseline entry report "the violation is gone" —
+    during a bulk migration the two waiver forms legitimately overlap
+    until the baseline is pruned."""
+    table: Set[Tuple[str, str, str]] = set(entries)
+    remaining: List[Finding] = []
+    for f in findings:
+        if (f.rule, f.path, f.line_crc) not in table:
+            remaining.append(f)
+    present = {(f.rule, f.path, f.line_crc)
+               for f in (raw_findings if raw_findings is not None
+                         else findings)}
+    stale = [Finding("stale-baseline", path, 0,
+                     f"baseline entry `{rule} {path} {crc}` matches no "
+                     f"current finding — the violation is gone, delete "
+                     f"the entry (the allowlist only shrinks)")
+             for rule, path, crc in entries
+             if (rule, path, crc) not in present]
+    return remaining, stale

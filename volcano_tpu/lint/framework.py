@@ -1,0 +1,261 @@
+"""Shared visitor framework for the invariant lint suite.
+
+The unit of work is a :class:`ParsedModule` — source text, AST with
+parent links, and the per-line pragma index.  Rules receive a
+:class:`LintContext` (all parsed modules plus repo-layout anchors) and
+return :class:`Finding`s; suppression (pragmas, baseline) is applied by
+the runner, never inside a rule, so a rule's raw findings stay visible
+to the stale-baseline check.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+#: pragma grammar: ``# lint: allow(rule-a, rule-b): reason text``.
+#: The reason is MANDATORY — an allow without a why is how conventions
+#: rot; the runner rejects bare pragmas as findings of their own.
+_PRAGMA_RE = re.compile(
+    r"#\s*lint:\s*allow\(\s*(?P<rules>[\w\-, ]+?)\s*\)\s*"
+    r"(?::\s*(?P<reason>\S.*))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str            # repo-relative, e.g. volcano_tpu/trace/tracer.py
+    line: int            # 1-based; 0 for whole-file/whole-tree findings
+    message: str
+
+    def key(self) -> str:
+        """Stable baseline identity: rule + path + a crc of the stripped
+        source line (line NUMBERS drift on unrelated edits; line CONTENT
+        only changes when the violating code itself changes)."""
+        return f"{self.rule}|{self.path}|{self.line_crc}"
+
+    @property
+    def line_crc(self) -> str:
+        # whole-file findings (line 0) have no source line; crc the
+        # MESSAGE instead so distinct synthetic findings on the same
+        # rule+path never collapse onto one baseline key (one entry
+        # must not silently waive every future line-0 finding there)
+        text = self._line_text or self.message
+        return format(zlib.crc32(text.encode()), "08x")
+
+    # populated by ParsedModule.finding(); empty for synthetic findings
+    _line_text: str = field(default="", compare=False)
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.rule}] {self.message}"
+
+
+class ParsedModule:
+    """One Python source file: text, AST (with ``.parent`` links), and
+    the pragma index mapping line -> {rule: reason}."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        annotate_parents(self.tree)
+        self.pragmas: Dict[int, Dict[str, str]] = {}
+        self.bad_pragmas: List[int] = []
+        self._index_pragmas()
+
+    def _index_pragmas(self) -> None:
+        for i, text in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(text)
+            if not m:
+                continue
+            reason = (m.group("reason") or "").strip()
+            if not reason:
+                self.bad_pragmas.append(i)
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",")
+                     if r.strip()}
+            entry = self.pragmas.setdefault(i, {})
+            for r in rules:
+                entry[r] = reason
+            # a standalone pragma comment covers the next line too, so
+            # multi-line statements can carry the allow above them
+            if text.lstrip().startswith("#"):
+                nxt = self.pragmas.setdefault(i + 1, {})
+                for r in rules:
+                    nxt.setdefault(r, reason)
+
+    def allowed(self, rule: str, line: int) -> bool:
+        return rule in self.pragmas.get(line, ())
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        line = getattr(node_or_line, "lineno", node_or_line) or 0
+        return Finding(rule=rule, path=self.relpath, line=line,
+                       message=message, _line_text=self.line_text(line))
+
+
+def annotate_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.parent = node  # type: ignore[attr-defined]
+
+
+def ancestors(node: ast.AST) -> Iterable[ast.AST]:
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "parent", None)
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return a
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` Attribute/Name chain -> ``"a.b.c"`` (None if the root
+    isn't a plain Name)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def import_aliases(tree: ast.AST, module: str) -> Set[str]:
+    """Local names bound to ``module`` (``import time`` -> {"time"},
+    ``import numpy as np`` with module="numpy" -> {"np"}).
+
+    ``import numpy.random`` (no asname) binds the ROOT name — it counts
+    for module="numpy", not for module="numpy.random"; with an asname
+    the bound name refers to the full dotted module, so
+    ``import numpy.random as npr`` counts ONLY for "numpy.random"."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    if a.name == module:
+                        out.add(a.asname)
+                elif (a.name == module
+                      or a.name.startswith(module + ".")) \
+                        and a.name.split(".")[0] == module:
+                    out.add(module)
+    return out
+
+
+def importfrom_aliases(tree: ast.AST, module_suffix: str,
+                       names: Optional[Set[str]] = None) -> Set[str]:
+    """Local names bound by ``from <...module_suffix> import X [as Y]``.
+    Relative imports match on the suffix (``..metrics`` vs ``metrics``)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == module_suffix or mod.endswith("." + module_suffix):
+                for a in node.names:
+                    if names is None or a.name in names:
+                        out.add(a.asname or a.name)
+    return out
+
+
+@dataclass
+class LintContext:
+    """Everything a rule may look at.
+
+    ``package_root`` is the ``volcano_tpu`` package directory;
+    ``tests_dir`` the repo's ``tests/`` directory (may be absent for
+    fixture trees); ``native_src`` the fastmodel C source path."""
+
+    package_root: str
+    tests_dir: Optional[str]
+    modules: List[ParsedModule]
+    repo_root: str
+
+    def module(self, relpath: str) -> Optional[ParsedModule]:
+        for m in self.modules:
+            if m.relpath == relpath or m.relpath.endswith("/" + relpath):
+                return m
+        return None
+
+    def in_scope(self, mod: ParsedModule,
+                 prefixes: Tuple[str, ...]) -> bool:
+        """Scope test against the module path RELATIVE to the package
+        root (so fixture trees in tmp dirs scope identically)."""
+        rel = self.pkg_relpath(mod)
+        return rel.startswith(prefixes)
+
+    def pkg_relpath(self, mod: ParsedModule) -> str:
+        rel = os.path.relpath(mod.path, self.package_root)
+        return rel.replace(os.sep, "/")
+
+    @property
+    def native_src(self) -> str:
+        return os.path.join(self.package_root, "native", "fastmodel.c")
+
+    def tests_sources(self) -> List[Tuple[str, str]]:
+        out = []
+        if self.tests_dir and os.path.isdir(self.tests_dir):
+            for name in sorted(os.listdir(self.tests_dir)):
+                if name.endswith(".py"):
+                    p = os.path.join(self.tests_dir, name)
+                    try:
+                        with open(p, encoding="utf-8") as f:
+                            out.append((name, f.read()))
+                    except OSError:
+                        pass
+        return out
+
+
+class Rule:
+    """Base class: ``name`` is the pragma/baseline token, ``check``
+    returns raw findings (suppression happens in the runner)."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        raise NotImplementedError
+
+
+def collect_modules(package_root: str,
+                    exclude_prefixes: Tuple[str, ...] = ("lint/",)
+                    ) -> List[ParsedModule]:
+    """Parse every .py under ``package_root`` except the lint suite
+    itself (its fixtures would trip its own rules), sorted for
+    deterministic output order."""
+    repo_root = os.path.dirname(os.path.abspath(package_root))
+    mods: List[ParsedModule] = []
+    for dirpath, dirnames, filenames in os.walk(package_root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel_pkg = os.path.relpath(path, package_root).replace(os.sep, "/")
+            if rel_pkg.startswith(exclude_prefixes):
+                continue
+            relpath = os.path.relpath(path, repo_root).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            try:
+                mods.append(ParsedModule(path, relpath, source))
+            except SyntaxError as e:
+                raise SyntaxError(f"lint: cannot parse {relpath}: {e}")
+    return mods

@@ -1,0 +1,90 @@
+"""clock-discipline: deterministic paths never read the wall clock.
+
+Double-run determinism (sim-smoke, flush-bench, storm-smoke) holds only
+because every time-dependent decision reads the injected
+:class:`~volcano_tpu.utils.clock.Clock`.  A stray ``time.time()`` /
+``time.monotonic()`` / ``datetime.now()`` in the store, cache, sim,
+trace, scheduler or serving paths re-couples behavior to the wall clock
+and only shows up as a storm-scale fingerprint mismatch much later.
+
+``time.perf_counter`` is deliberately NOT banned here: duration
+telemetry (histograms, span timings) never feeds a scheduling decision
+or a fingerprint.  Wall-clock-by-design sites (``plugins/tdm.py``'s
+revocable windows, daemon-loop pacing) carry inline pragmas with the
+why.  ``utils/clock.py`` is the one sanctioned implementation site and
+is outside this rule's scope by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..framework import (Finding, LintContext, ParsedModule, Rule,
+                         dotted_name, import_aliases, importfrom_aliases)
+
+#: attribute paths (relative to the imported module) that read the wall
+#: clock; referencing one is as bad as calling it (it gets passed around
+#: as a now_fn)
+_TIME_ATTRS = {"time", "monotonic", "monotonic_ns", "time_ns"}
+_DATETIME_ATTRS = {"now", "utcnow", "today", "fromtimestamp"}
+
+_DEFAULT_SCOPE = ("apiserver/", "cache/", "sim/", "trace/", "serving/",
+                  "plugins/", "scheduler.py")
+
+
+class ClockDisciplineRule(Rule):
+    name = "clock-discipline"
+    description = ("no time.time()/time.monotonic()/datetime.now() in "
+                   "deterministic paths; read the injected Clock seam")
+
+    def __init__(self, scope=_DEFAULT_SCOPE):
+        self.scope = tuple(scope)
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in ctx.modules:
+            if not ctx.in_scope(mod, self.scope):
+                continue
+            out.extend(self._check_module(mod))
+        return out
+
+    def _check_module(self, mod: ParsedModule) -> List[Finding]:
+        out: List[Finding] = []
+        time_names = import_aliases(mod.tree, "time")
+        dt_mod_names = import_aliases(mod.tree, "datetime")
+        dt_cls_names = importfrom_aliases(mod.tree, "datetime",
+                                          {"datetime", "date"})
+        # `from time import time/monotonic` is a violation at the import
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name in _TIME_ATTRS:
+                        out.append(mod.finding(
+                            self.name, node,
+                            f"wall-clock import `from time import "
+                            f"{a.name}`; use the injected Clock"))
+            if not isinstance(node, ast.Attribute):
+                continue
+            dn = dotted_name(node)
+            if dn is None:
+                continue
+            parts = dn.split(".")
+            root, attr = parts[0], parts[-1]
+            bad = False
+            if root in time_names and len(parts) == 2 \
+                    and attr in _TIME_ATTRS:
+                bad = True
+            elif root in dt_cls_names and len(parts) == 2 \
+                    and attr in _DATETIME_ATTRS:
+                bad = True
+            elif root in dt_mod_names and len(parts) == 3 \
+                    and parts[1] in ("datetime", "date") \
+                    and attr in _DATETIME_ATTRS:
+                bad = True
+            if bad:
+                out.append(mod.finding(
+                    self.name, node,
+                    f"wall-clock read `{dn}`; deterministic paths must "
+                    f"read the injected Clock (utils/clock.py)"))
+        return out

@@ -1,0 +1,184 @@
+"""lock-discipline: ``*_locked`` callees and guarded fields stay under
+their owning lock.
+
+The store (`apiserver/store.py`, lock attr ``_lock``) and the scheduler
+cache (`cache/cache.py`, lock attr ``mutex``) follow the Go-era
+``fooLocked()`` convention: a method named ``*_locked`` asserts nothing
+and relies on every caller holding the lock.  That contract is enforced
+here by a lexical call-graph walk per class:
+
+- a call ``self.X_locked(...)`` must sit inside a ``with self.<lock>:``
+  block or inside another ``*_locked`` method (a nested function starts
+  a NEW scope: a closure runs at some later time, so it inherits nothing
+  lexically — name it ``*_locked`` if it runs under the lock);
+- a mutation of a declared guarded field (assignment / augmented
+  assignment / `del` / a known mutating method call rooted at the field)
+  must likewise happen under the lock.  ``__init__`` is exempt (no other
+  thread can hold a reference yet).
+
+The guarded-field sets are declared per file below — they are the
+store's object map / rv counter / journal triple and the cache's
+snapshot state, i.e. exactly the fields whose unlocked mutation would be
+a real data race, not every attribute.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..framework import Finding, LintContext, ParsedModule, Rule
+
+#: method names that mutate common containers in place
+_MUTATORS = {"append", "extend", "update", "pop", "popitem", "clear",
+             "setdefault", "add", "remove", "discard", "insert",
+             "appendleft", "popleft", "__setitem__"}
+
+#: file (relative to the package root) -> lock attr names + guarded
+#: field names. Files absent from the tree are skipped (fixture trees).
+_DEFAULT_SCOPES: Dict[str, Dict[str, Set[str]]] = {
+    "apiserver/store.py": {
+        "locks": {"_lock"},
+        "guarded": {"_objects", "_rv", "_journal", "_journal_tail",
+                    "_journal_parked"},
+    },
+    "cache/cache.py": {
+        "locks": {"mutex"},
+        "guarded": {"_prebuilt", "_incr_snap", "_state_version",
+                    "_dirty_structural"},
+    },
+}
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = ("*_locked methods and guarded-field mutations only "
+                   "under `with self.<lock>:` or another *_locked method")
+
+    def __init__(self, scopes: Dict[str, Dict[str, Set[str]]] = None):
+        self.scopes = scopes if scopes is not None else _DEFAULT_SCOPES
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in ctx.modules:
+            rel = ctx.pkg_relpath(mod)
+            cfg = self.scopes.get(rel)
+            if cfg is None:
+                continue
+            out.extend(self._check_module(mod, cfg["locks"],
+                                          cfg["guarded"]))
+        return out
+
+    def _check_module(self, mod: ParsedModule, locks: Set[str],
+                      guarded: Set[str]) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self._walk_fn(mod, item, locks, guarded, out)
+        return out
+
+    # -- lexical walk -----------------------------------------------------
+
+    def _is_lock_attr(self, expr: ast.AST, locks: Set[str]) -> bool:
+        return (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and expr.attr in locks)
+
+    def _walk_fn(self, mod, fn, locks, guarded, out) -> None:
+        # __init__ is exempt wholesale: fields are born there before any
+        # other thread can hold a reference
+        locked = fn.name.endswith("_locked") or fn.name == "__init__"
+        for stmt in fn.body:
+            self._walk(mod, stmt, locks, guarded, locked, out)
+
+    def _walk(self, mod, node, locks, guarded, locked, out):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # new runtime scope: a closure only counts as locked when its
+            # NAME carries the contract
+            inner = node.name.endswith("_locked")
+            for child in node.body:
+                self._walk(mod, child, locks, guarded, inner, out)
+            return
+        if isinstance(node, ast.Lambda):
+            self._walk(mod, node.body, locks, guarded, False, out)
+            return
+        if isinstance(node, ast.With):
+            acquires = any(self._is_lock_attr(item.context_expr, locks)
+                           for item in node.items)
+            for item in node.items:
+                self._walk(mod, item.context_expr, locks, guarded,
+                           locked, out)
+            for child in node.body:
+                self._walk(mod, child, locks, guarded,
+                           locked or acquires, out)
+            return
+        self._check_node(mod, node, locks, guarded, locked, out)
+        for child in ast.iter_child_nodes(node):
+            self._walk(mod, child, locks, guarded, locked, out)
+
+    def _check_node(self, mod, node, locks, guarded, locked, out):
+        if locked:
+            return
+        # self.X_locked(...) call outside any lock scope
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr.endswith("_locked") \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            out.append(mod.finding(
+                self.name, node,
+                f"`self.{node.func.attr}()` called without holding the "
+                f"lock ({'/'.join(sorted(locks))}); wrap in `with "
+                f"self.<lock>:` or rename the caller `*_locked`"))
+            return
+        # guarded-field mutations
+        tgt = None
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                f = self._guarded_root(t, guarded)
+                if f:
+                    tgt = (f, "assignment")
+                    break
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                f = self._guarded_root(t, guarded)
+                if f:
+                    tgt = (f, "del")
+                    break
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            f = self._guarded_root(node.func.value, guarded)
+            if f:
+                tgt = (f, f".{node.func.attr}()")
+        if tgt:
+            field_name, how = tgt
+            out.append(mod.finding(
+                self.name, node,
+                f"guarded field `self.{field_name}` mutated ({how}) "
+                f"outside `with self.<lock>:` "
+                f"({'/'.join(sorted(locks))})"))
+
+    def _guarded_root(self, expr: ast.AST, guarded: Set[str]):
+        """Peel Tuple/Starred/Subscript/Attribute wrappers down to a
+        ``self.<field>`` root; returns the field name when guarded."""
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            for el in expr.elts:
+                f = self._guarded_root(el, guarded)
+                if f:
+                    return f
+            return None
+        if isinstance(expr, ast.Starred):
+            return self._guarded_root(expr.value, guarded)
+        while isinstance(expr, (ast.Subscript, ast.Attribute)):
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self" and expr.attr in guarded:
+                return expr.attr
+            expr = expr.value
+        return None

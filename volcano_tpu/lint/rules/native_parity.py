@@ -1,0 +1,132 @@
+"""native-fallback-parity: every exported C entry keeps its Python twin.
+
+``native/fastmodel.c`` exports its entries through one ``PyMethodDef``
+table.  The contract since PR 8: the native module is an ACCELERATION,
+never a semantic fork — every entry has (a) a Python-side call site
+wrapped in a fallback path (a ``try/except`` or an
+``is not None``/``hasattr``/switch guard, so a missing toolchain or a
+native failure degrades to the bit-identical Python body), and (b) at
+least one parity test in ``tests/`` that names the entry, so the twin
+implementations cannot drift silently.
+
+This rule parses the method table straight out of the C source (no
+compiled module needed — the lint gate must run on toolchain-less
+boxes) and audits both halves of the contract per entry.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Set
+
+from ..framework import Finding, LintContext, Rule, ancestors
+
+_TABLE_RE = re.compile(
+    r"static\s+PyMethodDef\s+\w+\[\]\s*=\s*\{(?P<body>.*?)\};",
+    re.S)
+_ENTRY_RE = re.compile(r'\{\s*"(?P<name>\w+)"\s*,')
+#: C-side pragma: `lint: allow(native-fallback-parity, <entry>): reason`
+#: anywhere in a comment of fastmodel.c waives BOTH halves of the
+#: contract for that entry (test-seam exports exercised directly by
+#: tests rather than wired behind a package fallback).
+_C_PRAGMA_RE = re.compile(
+    r"lint:\s*allow\(\s*native-fallback-parity\s*,\s*(?P<name>\w+)\s*\)"
+    r"\s*:\s*(?P<reason>\S)")
+
+#: substrings in a guard test that mark the native path as optional
+_GUARD_MARKERS = ("is not None", "hasattr", "NATIVE", "is None")
+
+
+def exported_entries(c_source: str) -> List[str]:
+    m = _TABLE_RE.search(c_source)
+    if not m:
+        return []
+    return _ENTRY_RE.findall(m.group("body"))
+
+
+class NativeFallbackParityRule(Rule):
+    name = "native-fallback-parity"
+    description = ("every fastmodel.c exported entry has a guarded "
+                   "Python call site and a parity test naming it")
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        src_path = ctx.native_src
+        if not os.path.exists(src_path):
+            return out    # fixture trees without a native dir
+        with open(src_path, encoding="utf-8") as f:
+            c_source = f.read()
+        all_entries = exported_entries(c_source)
+        if not all_entries:
+            out.append(Finding(self.name,
+                               os.path.relpath(src_path, ctx.repo_root),
+                               0, "no PyMethodDef table found"))
+            return out
+        allowed = {m.group("name")
+                   for m in _C_PRAGMA_RE.finditer(c_source)}
+        entries = [e for e in all_entries if e not in allowed]
+        calls = self._call_sites(ctx, set(entries))
+        tests = ctx.tests_sources()
+        c_rel = os.path.relpath(src_path, ctx.repo_root).replace(
+            os.sep, "/")
+        for name in entries:
+            sites = calls.get(name, [])
+            if not sites:
+                out.append(Finding(
+                    self.name, c_rel, 0,
+                    f"native entry `{name}` has no Python call site — "
+                    f"dead export or a fallback that was never wired"))
+            elif not any(guarded for _, _, guarded in sites):
+                mod, node, _ = sites[0]
+                out.append(mod.finding(
+                    self.name, node,
+                    f"native entry `{name}` is called without a "
+                    f"fallback guard (no enclosing try/except or "
+                    f"`is not None`/`hasattr`/NATIVE-switch test)"))
+            if not any(re.search(rf"\b{name}\b", src)
+                       for _, src in tests):
+                out.append(Finding(
+                    self.name, c_rel, 0,
+                    f"native entry `{name}` has no parity test naming "
+                    f"it in tests/"))
+        return out
+
+    # -- call-site discovery ----------------------------------------------
+
+    def _call_sites(self, ctx: LintContext, names: Set[str]
+                    ) -> Dict[str, list]:
+        sites: Dict[str, list] = {}
+        for mod in ctx.modules:
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in names):
+                    continue
+                # `self.x(...)` is a method, not the native module
+                if isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "self":
+                    continue
+                sites.setdefault(node.func.attr, []).append(
+                    (mod, node, self._is_guarded(mod, node)))
+        return sites
+
+    def _is_guarded(self, mod, call: ast.Call) -> bool:
+        """A call site counts as fallback-wrapped when an enclosing
+        try/except exists or an enclosing If's test carries a
+        native-availability marker.  The walk crosses nested-function
+        boundaries deliberately: a closure DEFINED under
+        ``if fm is not None:`` only exists when the native module does
+        (the store's ``batch_shard`` idiom), which is as much a fallback
+        guard as a try around the call."""
+        for a in ancestors(call):
+            if isinstance(a, (ast.ClassDef, ast.Module)):
+                break
+            if isinstance(a, ast.Try) and a.handlers:
+                return True
+            if isinstance(a, (ast.If, ast.IfExp)):
+                test_src = ast.unparse(a.test)
+                if any(m in test_src for m in _GUARD_MARKERS):
+                    return True
+        return False

@@ -1,0 +1,107 @@
+"""seeded-randomness: no process-global RNG in sim/ops/framework.
+
+The sim's double-run gates (sim-smoke, chaos, failover, storm) and the
+kernel fuzz suites are only meaningful because every random draw flows
+from a seed the run controls: ``random.Random(seed)`` instances,
+seed-derived crc32 coins, or jax PRNG keys.  A bare ``random.random()``
+or ``np.random.shuffle()`` reads the PROCESS-global generator — shared
+mutable state whose sequence depends on import order and on every other
+caller, i.e. exactly the non-reproducibility the gates exist to rule
+out.
+
+Allowed: constructing generators (``random.Random(seed)``,
+``np.random.default_rng(seed)``, ``SeedSequence``/bit-generator
+classes) and anything not rooted at the global modules.  Flagged even
+when merely referenced (passing ``random.shuffle`` around is the same
+leak).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..framework import (Finding, LintContext, ParsedModule, Rule,
+                         dotted_name, import_aliases,
+                         importfrom_aliases)
+
+_DEFAULT_SCOPE = ("sim/", "ops/", "framework/")
+
+#: attributes of the `random` module that do NOT touch the global RNG
+_RANDOM_OK = {"Random", "SystemRandom"}
+#: np.random attributes that construct explicit generators
+_NP_RANDOM_OK = {"default_rng", "Generator", "SeedSequence", "PCG64",
+                 "Philox", "MT19937", "BitGenerator", "RandomState"}
+
+
+class SeededRandomnessRule(Rule):
+    name = "seeded-randomness"
+    description = ("no bare random.* / np.random.* global-RNG use in "
+                   "sim/, ops/, framework/ — seeded generators only")
+
+    def __init__(self, scope=_DEFAULT_SCOPE):
+        self.scope = tuple(scope)
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in ctx.modules:
+            if not ctx.in_scope(mod, self.scope):
+                continue
+            out.extend(self._check_module(mod))
+        return out
+
+    def _check_module(self, mod: ParsedModule) -> List[Finding]:
+        out: List[Finding] = []
+        random_names = import_aliases(mod.tree, "random")
+        numpy_names = import_aliases(mod.tree, "numpy")
+        # names bound DIRECTLY to the numpy.random module:
+        # `import numpy.random as npr`, `from numpy import random as nr`
+        np_random_names = (import_aliases(mod.tree, "numpy.random")
+                           | importfrom_aliases(mod.tree, "numpy",
+                                                {"random"}))
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) \
+                    and node.module == "random":
+                for a in node.names:
+                    if a.name not in _RANDOM_OK:
+                        out.append(mod.finding(
+                            self.name, node,
+                            f"`from random import {a.name}` binds the "
+                            f"process-global RNG; construct a seeded "
+                            f"random.Random instead"))
+                continue
+            if not isinstance(node, ast.Attribute):
+                continue
+            dn = dotted_name(node)
+            if dn is None:
+                continue
+            parts = dn.split(".")
+            # resolve the np.random attribute, through either spelling:
+            # `np.random.X` (alias of numpy) or `npr.X` (alias of
+            # numpy.random itself)
+            np_attr = None
+            if parts[0] in numpy_names and len(parts) == 3 \
+                    and parts[1] == "random":
+                np_attr = parts[2]
+            elif parts[0] in np_random_names and len(parts) == 2:
+                np_attr = parts[1]
+            if parts[0] in random_names and len(parts) == 2 \
+                    and parts[1] not in _RANDOM_OK:
+                out.append(mod.finding(
+                    self.name, node,
+                    f"global-RNG use `{dn}`; draw from a seeded "
+                    f"random.Random"))
+            elif np_attr is not None and np_attr not in _NP_RANDOM_OK:
+                out.append(mod.finding(
+                    self.name, node,
+                    f"global-RNG use `{dn}`; use "
+                    f"np.random.default_rng(seed)"))
+            elif np_attr == "default_rng" \
+                    and isinstance(getattr(node, "parent", None), ast.Call) \
+                    and node.parent.func is node \
+                    and not node.parent.args and not node.parent.keywords:
+                out.append(mod.finding(
+                    self.name, node,
+                    "`np.random.default_rng()` without a seed is "
+                    "OS-entropy-seeded; pass an explicit seed"))
+        return out

@@ -1,0 +1,125 @@
+"""Lint runner: collect modules, run rules, apply pragmas + baseline."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Sequence, Tuple
+
+from . import baseline as baseline_mod
+from .framework import Finding, LintContext, Rule, collect_modules
+from .rules import (ClockDisciplineRule, JitPurityRule,
+                    LockDisciplineRule, NativeFallbackParityRule,
+                    SeededRandomnessRule)
+
+
+def default_rules() -> List[Rule]:
+    return [ClockDisciplineRule(), LockDisciplineRule(),
+            NativeFallbackParityRule(), SeededRandomnessRule(),
+            JitPurityRule()]
+
+
+def run_lint(package_root: str, tests_dir: Optional[str] = None,
+             rules: Optional[Sequence[Rule]] = None,
+             baseline_path: Optional[str] = None
+             ) -> Tuple[List[Finding], LintContext]:
+    """Run ``rules`` over the package; returns the POST-suppression
+    findings (pragma'd and baselined ones removed, stale-baseline and
+    malformed-pragma findings added)."""
+    package_root = os.path.abspath(package_root)
+    if tests_dir is None:
+        cand = os.path.join(os.path.dirname(package_root), "tests")
+        tests_dir = cand if os.path.isdir(cand) else None
+    modules = collect_modules(package_root)
+    ctx = LintContext(package_root=package_root, tests_dir=tests_dir,
+                      modules=modules,
+                      repo_root=os.path.dirname(package_root))
+    if rules is None:
+        rules = default_rules()
+    raw: List[Finding] = []
+    for rule in rules:
+        raw.extend(rule.check(ctx))
+    # inline pragmas: `# lint: allow(rule): reason` on the finding's
+    # line (or a standalone pragma comment directly above it)
+    by_path = {m.relpath: m for m in modules}
+    unsuppressed = []
+    for f in raw:
+        mod = by_path.get(f.path)
+        if mod is not None and f.line and mod.allowed(f.rule, f.line):
+            continue
+        unsuppressed.append(f)
+    # a pragma without a reason is itself a finding: an allow with no
+    # why is how a convention rots
+    for mod in modules:
+        for line in mod.bad_pragmas:
+            unsuppressed.append(mod.finding(
+                "malformed-pragma", line,
+                "lint pragma without a reason — write "
+                "`# lint: allow(rule): <why>`"))
+    if baseline_path is None:
+        baseline_path = baseline_mod.DEFAULT_BASELINE
+    entries = baseline_mod.load(baseline_path)
+    # stale detection only sees entries for rules that actually RAN (a
+    # --rule subset run computes no findings for the other rules, and
+    # their still-valid waivers must not be reported as stale) and is
+    # judged against the RAW findings — a pragma'd-but-present
+    # violation does not make its baseline entry stale
+    run_names = {r.name for r in rules}
+    entries = [e for e in entries if e[0] in run_names]
+    findings, stale = baseline_mod.apply(unsuppressed, entries,
+                                         raw_findings=raw)
+    findings.extend(stale)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, ctx
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m volcano_tpu.lint",
+        description="Invariant lint suite: statically enforce the "
+                    "determinism, lock, native-fallback, randomness "
+                    "and jit-purity contracts "
+                    "(docs/design/static_analysis.md).")
+    parser.add_argument("--root", default=None,
+                        help="package root to lint (default: the "
+                             "installed volcano_tpu package)")
+    parser.add_argument("--rule", action="append", default=None,
+                        help="run only this rule (repeatable)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline file (default: "
+                             "volcano_tpu/lint/baseline.txt)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="list rules and exit")
+    args = parser.parse_args(argv)
+
+    rules = default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.name:24s} {r.description}")
+        return 0
+    if args.rule:
+        known = {r.name: r for r in rules}
+        unknown = [n for n in args.rule if n not in known]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)}; "
+                  f"--list-rules shows the catalog", file=sys.stderr)
+            return 2
+        rules = [known[n] for n in args.rule]
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    findings, ctx = run_lint(root, rules=rules,
+                             baseline_path=args.baseline)
+    for f in findings:
+        print(f.render())
+    n_rules = len(rules)
+    n_files = len(ctx.modules)
+    if findings:
+        print(f"\nlint: {len(findings)} finding(s) "
+              f"({n_rules} rules over {n_files} files). Fix it, or "
+              f"carry a `# lint: allow(<rule>): <reason>` pragma.",
+              file=sys.stderr)
+        return 1
+    print(f"lint: ok — {n_rules} rules over {n_files} files, "
+          f"0 findings")
+    return 0

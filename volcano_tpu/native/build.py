@@ -30,7 +30,45 @@ _log = logging.getLogger(__name__)
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "solver.cc")
 _LOCK = threading.Lock()
-_cached_path = None
+_cached_path: dict = {}      # sanitize mode -> built .so path
+
+# -- sanitizer build mode (make sanitize; docs/design/static_analysis.md) --
+#
+# VOLCANO_SANITIZE=address,undefined rebuilds BOTH natives under
+# ASan/UBSan. The mode is part of the artifact name, so a sanitized .so
+# can never shadow a production one (and vice versa): the production
+# hash scheme stays untouched and the two coexist in this directory.
+# Loading a sanitized .so into an uninstrumented python requires the
+# sanitizer runtimes to be LD_PRELOADed — tools/sanitize_gate.py does
+# that; without the preload the dlopen fails and callers take their
+# normal Python fallbacks.
+_SANITIZERS = {"address": "asan", "undefined": "ubsan"}
+
+
+def sanitize_mode() -> str:
+    """Normalized VOLCANO_SANITIZE artifact tag ('' when off), e.g.
+    ``address,undefined`` -> ``asan-ubsan``. Unknown sanitizers raise —
+    a typo must not silently build an unsanitized artifact under a
+    sanitized-looking gate."""
+    raw = os.environ.get("VOLCANO_SANITIZE", "").strip()
+    if not raw:
+        return ""
+    parts = sorted({p.strip() for p in raw.split(",") if p.strip()})
+    unknown = [p for p in parts if p not in _SANITIZERS]
+    if unknown:
+        raise RuntimeError(
+            f"VOLCANO_SANITIZE: unknown sanitizer(s) {unknown}; "
+            f"supported: {sorted(_SANITIZERS)}")
+    return "-".join(_SANITIZERS[p] for p in parts)
+
+
+def _sanitize_cflags() -> list:
+    raw = os.environ.get("VOLCANO_SANITIZE", "").strip()
+    if not raw:
+        return []
+    parts = sorted({p.strip() for p in raw.split(",") if p.strip()})
+    return [f"-fsanitize={','.join(parts)}", "-fno-omit-frame-pointer",
+            "-g"]
 
 
 def _host_tag() -> str:
@@ -58,8 +96,13 @@ def _src_tag() -> str:
 
 
 def lib_path() -> str:
-    """Path of the built library for the current source (not yet built)."""
-    return os.path.join(_DIR, f"libvcsolver-{_src_tag()}-{_host_tag()}.so")
+    """Path of the built library for the current source (not yet built).
+    A VOLCANO_SANITIZE mode lands in the name — distinct artifact hash
+    space, so sanitized and production builds never shadow each other."""
+    mode = sanitize_mode()
+    suffix = f"-{mode}" if mode else ""
+    return os.path.join(
+        _DIR, f"libvcsolver-{_src_tag()}-{_host_tag()}{suffix}.so")
 
 
 def ensure_built() -> str:
@@ -68,10 +111,11 @@ def ensure_built() -> str:
     Raises on compiler failure — callers gate on availability and fall
     back to the XLA kernels.
     """
-    global _cached_path
+    mode = sanitize_mode()
     with _LOCK:
-        if _cached_path is not None and os.path.exists(_cached_path):
-            return _cached_path
+        cached = _cached_path.get(mode)
+        if cached is not None and os.path.exists(cached):
+            return cached
         path = lib_path()
         if not os.path.exists(path):
             tmp = path + f".tmp{os.getpid()}"
@@ -86,6 +130,7 @@ def ensure_built() -> str:
             cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
                    "-fno-fast-math", "-ffp-contract=off", "-march=native",
                    "-fno-trapping-math", "-fno-math-errno",
+                   *_sanitize_cflags(),
                    "-o", tmp, _SRC]
             _log.info("building native solver: %s", " ".join(cmd))
             r = subprocess.run(cmd, capture_output=True, text=True,
@@ -97,9 +142,11 @@ def ensure_built() -> str:
             # drop superseded hashes: every source edit used to leave its
             # build artifact behind and the directory accumulated stale
             # .so files. Unlinking is safe even for a library a running
-            # process still maps (the inode lives until unmapped).
+            # process still maps (the inode lives until unmapped). The
+            # sweep is scoped to this build's sanitize mode — see
+            # _clean_superseded.
             _clean_superseded("libvcsolver-", path)
-        _cached_path = path
+        _cached_path[mode] = path
         return path
 
 
@@ -112,14 +159,25 @@ _TMP_STALE_SECONDS = 600.0
 def _clean_superseded(prefix: str, keep: str) -> None:
     """Best-effort removal of older-hash build artifacts sharing
     ``prefix``, plus .tmp files ORPHANED by crashed builds (age-gated:
-    a fresh tmp belongs to a concurrent builder about to os.replace)."""
+    a fresh tmp belongs to a concurrent builder about to os.replace).
+
+    The sweep stays inside ``keep``'s hash space: a production build
+    reaps only unsanitized names, a sanitized build only names carrying
+    the SAME sanitize tag — the two can never shadow or delete each
+    other, and neither accumulates unboundedly."""
     import time
     keep_name = os.path.basename(keep)
+    keep_tags = {tag for tag in _SANITIZERS.values()
+                 if f"-{tag}" in keep_name}
     try:
         for name in os.listdir(_DIR):
             if not name.startswith(prefix):
                 continue
             if name == keep_name:
+                continue
+            tags = {tag for tag in _SANITIZERS.values()
+                    if f"-{tag}" in name}
+            if tags != keep_tags:
                 continue
             path = os.path.join(_DIR, name)
             try:
@@ -138,31 +196,40 @@ def _clean_superseded(prefix: str, keep: str) -> None:
 
 
 _FM_SRC = os.path.join(_DIR, "fastmodel.c")
-_fm_module = None
-_fm_failed = False
+_fm_module: dict = {}      # sanitize mode -> module
+_fm_failed: dict = {}      # sanitize mode -> True
+
+
+def fastmodel_path() -> str:
+    """Path of the fastmodel extension for the current source + python
+    + VOLCANO_SANITIZE mode (not necessarily built yet)."""
+    import sys
+    with open(_FM_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    tag += f"-py{sys.version_info[0]}{sys.version_info[1]}"
+    mode = sanitize_mode()
+    suffix = f"-{mode}" if mode else ""
+    return os.path.join(_DIR, f"fastmodel-{tag}-{_host_tag()}{suffix}.so")
 
 
 def fastmodel():
     """Import (building on demand) the fastmodel C extension; returns the
     module or None when the toolchain/headers are unavailable."""
-    global _fm_module, _fm_failed
-    if _fm_module is not None or _fm_failed:
-        return _fm_module
+    mode = sanitize_mode()
+    if _fm_module.get(mode) is not None or _fm_failed.get(mode):
+        return _fm_module.get(mode)
     with _LOCK:
-        if _fm_module is not None or _fm_failed:
-            return _fm_module
+        if _fm_module.get(mode) is not None or _fm_failed.get(mode):
+            return _fm_module.get(mode)
         try:
             import importlib.util
-            import sys
             import sysconfig
-            with open(_FM_SRC, "rb") as f:
-                tag = hashlib.sha256(f.read()).hexdigest()[:16]
-            tag += f"-py{sys.version_info[0]}{sys.version_info[1]}"
-            so = os.path.join(_DIR, f"fastmodel-{tag}-{_host_tag()}.so")
+            so = fastmodel_path()
             if not os.path.exists(so):
                 inc = sysconfig.get_paths()["include"]
                 tmp = so + f".tmp{os.getpid()}"
                 cmd = ["gcc", "-O2", "-fPIC", "-shared", f"-I{inc}",
+                       *_sanitize_cflags(),
                        "-o", tmp, _FM_SRC]
                 r = subprocess.run(cmd, capture_output=True, text=True,
                                    timeout=300)
@@ -174,8 +241,8 @@ def fastmodel():
             spec = importlib.util.spec_from_file_location("fastmodel", so)
             mod = importlib.util.module_from_spec(spec)
             spec.loader.exec_module(mod)
-            _fm_module = mod
+            _fm_module[mode] = mod
         except Exception as e:
-            _fm_failed = True
+            _fm_failed[mode] = True
             _log.warning("fastmodel unavailable: %s", e)
-        return _fm_module
+        return _fm_module.get(mode)

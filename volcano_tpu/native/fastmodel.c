@@ -581,7 +581,7 @@ static PyObject *s_modified, *s_uid, *s_deletion_timestamp, *s_phase,
  *     -> (entries, pairs)
  *
  * The ordered-publish step of one bulk-patch shard in a single call
- * (the Python twin is ObjectStore._install_shard_locked's loop): install
+ * (the Python twin is ObjectStore._install_shard's loop): install
  * news[i] under shard[i]'s key, release the key from the in-flight set,
  * and build both the journal-entry batch [(rv, "MODIFIED", kind, new)]
  * (contiguous reserved rvs from rv_base+1) and the watch-delivery pairs
@@ -2218,6 +2218,11 @@ shell_clone(PyObject *self, PyObject *src)
 static PyMethodDef methods[] = {
     {"register_task_type", register_task_type, METH_O,
      "Register the TaskInfo class (reads slot offsets)."},
+    /* lint: allow(native-fallback-parity, clone_task): test seam — the
+     * per-slot clone primitive clone_task_table/clone_task_dict build
+     * on; exercised directly by tests/test_native_model.py, no package
+     * call site by design (the table/dict entries are the fallbacked
+     * production paths). */
     {"clone_task", clone_task, METH_O, "Verbatim slot-copy clone."},
     {"clone_task_table", clone_task_table, METH_O,
      "Clone a job's task dict and build the status index."},

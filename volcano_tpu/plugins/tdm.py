@@ -155,7 +155,7 @@ class TdmPlugin(Plugin):
             # shared across schedulers in-process, so mixing timebases
             # here would leak virtual stamps into production pacing
             global _last_evict_at
-            if _last_evict_at + self.evict_period > time.time():
+            if _last_evict_at + self.evict_period > time.time():   # lint: allow(clock-discipline): time-of-day multiplexing is wall-clock by design (windows parse against localtime; see comment above)
                 return []
             victims = []
             for rz in self.revocable_zone:
@@ -172,7 +172,7 @@ class TdmPlugin(Plugin):
                     job = ssn.jobs.get(job_uid)
                     if job is not None:
                         victims.extend(self._max_victims(job, tasks))
-            _last_evict_at = time.time()
+            _last_evict_at = time.time()   # lint: allow(clock-discipline): wall-clock by design — shared module-global evict pacing, see comment above
             return victims
 
         ssn.add_victim_tasks_fns(NAME, victims_fn)

@@ -243,7 +243,7 @@ class Scheduler:
         gc.freeze()
         cycles = 0
         while not self._stop.is_set():
-            cycle_start = time.monotonic()
+            cycle_start = time.monotonic()   # lint: allow(clock-discipline): daemon-loop pacing only; determinism gates drive run_once() directly on the injected clock
             try:
                 self.run_once()
             except Exception:
@@ -262,7 +262,7 @@ class Scheduler:
                     log.exception("anti-entropy pass failed; next "
                                   "interval retries")
             gc.collect(0)   # reap cycle-garbage with true ref cycles
-            elapsed = time.monotonic() - cycle_start
+            elapsed = time.monotonic() - cycle_start   # lint: allow(clock-discipline): daemon-loop pacing only (monotonic is immune to wall jumps; never feeds a scheduling decision)
             self._stop.wait(max(0.0, self.schedule_period - elapsed))
 
     def start(self) -> threading.Thread:

@@ -104,6 +104,7 @@ class AdmissionController:
             max_subscriptions if max_subscriptions is not None
             else self.DEFAULT_MAX_SUBSCRIPTIONS)
         self.tenants = dict(tenants or {})
+        # lint: allow(clock-discipline): injectable now_fn — the sim passes the virtual clock; the production default is monotonic ON PURPOSE (token buckets must not rewind on wall jumps)
         self.now_fn = now_fn or time.monotonic
         self._lock = threading.Lock()
         self._buckets: Dict[str, TokenBucket] = {}

@@ -353,7 +353,7 @@ def async_span(name: str, **tags):
 
 
 def _finish_cycle(root: Span, seq: int) -> None:
-    rec = CycleRecord(seq, time.time(), root)
+    rec = CycleRecord(seq, time.time(), root)   # lint: allow(clock-discipline): Chrome trace-export wall timestamp — presentation metadata; no fingerprint or decision reads it
     with _lock:
         _ring.append(rec)
     budget = _budgets or DEFAULT_BUDGETS
