@@ -5,7 +5,7 @@ PYTHON ?= python
 .PHONY: test unit-test e2e bench bench-all bench-check multichip-dryrun \
 	deploy deploy-up trace-smoke sim-smoke flush-bench chaos-smoke \
 	failover-smoke obs-smoke incr-smoke multichip-smoke constraint-smoke \
-	storm-smoke lint sanitize
+	storm-smoke explain-smoke lint sanitize
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -178,6 +178,18 @@ constraint-smoke: multichip-smoke
 # was bit-identical on bind AND ledger fingerprints.
 storm-smoke: constraint-smoke
 	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli storm
+
+# placement-explainer gate (docs/design/observability.md), after
+# storm-smoke: constrained churn plus a preemption storm with the
+# explainer on. Exit 1 unless every placed gang carries a provenance
+# record (winning node, per-constraint elimination ladder, top-k
+# candidates with score-term decomposition), every record's
+# eliminations sum exactly to the node axis, victim decisions were
+# recorded off the vectorized victim kernel, the explain fingerprint
+# is bit-identical across a same-seed double run, and the off-mode
+# hook overhead measures <2% on the steady cycle.
+explain-smoke: storm-smoke
+	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli explain
 
 # multi-chip sharding dryrun on the virtual CPU mesh (the raw
 # shard_map program + full-pipeline one-shot; multichip-smoke is the

@@ -416,19 +416,42 @@ def constraint_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
     out: dict = {"tasks": n_tasks, "nodes": n_nodes,
                  "platform": jax.devices()[0].platform}
 
-    def measure(tag: str, constraints: dict) -> float:
+    def measure(tag: str, constraints: dict,
+                explain_on: bool = False) -> float:
         # cold env compiles this variant's padded shapes (constraint
         # slot-splitting changes the group count, hence g_pad), then a
         # fresh identical env is the measured one
+        from volcano_tpu.trace import explain as ex
         for phase in ("cold", "measured"):
             store, cache, binder, conf = _cycle_env(CONF_FULL)
             _populate(store, **pop, **constraints)
             k0 = hist_total(m.SOLVER_KERNEL_LATENCY)
             b0 = hist_total(m.CONSTRAINT_BUILD_LATENCY)
+            # pruning-readiness baseline (docs/design/observability.md):
+            # the measured leg runs with the placement explainer on —
+            # its aggregate capture happens AFTER the kernel-latency
+            # window closes, so kernel_ms stays clean — and the row
+            # gains the per-gang feasible-node / top-k score-coverage
+            # columns the candidate-pruning ROADMAP item budgets against
+            harvest = explain_on and phase == "measured"
+            if harvest:
+                ex.enable()
+                ex.reset()
             _run_cycle(cache, conf)
             kernel_ms = hist_total(m.SOLVER_KERNEL_LATENCY) - k0
             build_ms = hist_total(m.CONSTRAINT_BUILD_LATENCY) - b0
             binds = len(binder.binds)
+            if harvest:
+                agg = ex.aggregates()
+                ex.disable()
+                ex.reset()
+                out["explain_feasible_nodes"] = agg["feasible_nodes"]
+                out["explain_topk_coverage"] = agg["topk_coverage"]
+                out["fragmentation_ratio"] = agg["fragmentation_ratio"]
+                log(f"explain baseline: feasible/gang="
+                    f"{agg['feasible_nodes']} coverage="
+                    f"{agg['topk_coverage']} frag="
+                    f"{agg['fragmentation_ratio']}")
             cache.flush_executors(timeout=900)
             cache.stop()
             del store, cache, binder
@@ -439,7 +462,7 @@ def constraint_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
             out["constraint_build_ms"] = round(build_ms, 2)
         return kernel_ms
 
-    measure("unconstrained", {})
+    measure("unconstrained", {}, explain_on=True)
     measure("constrained", heavy)
 
     # -- victim-selection A/B (vmapped kernel vs Python walk) --------------
@@ -675,10 +698,10 @@ def try_serving_worker(n_tasks: int, n_nodes: int, watchers: int):
 
 
 def write_bench_row(row: dict) -> None:
-    """Persist the headline row (BENCH_r11.json by default; override or
+    """Persist the headline row (BENCH_r12.json by default; override or
     disable with VOLCANO_BENCH_ROW_OUT) with a machine-calibration
     fingerprint so tools/bench_check.py can scale cross-box compares."""
-    out = os.environ.get("VOLCANO_BENCH_ROW_OUT", "BENCH_r11.json")
+    out = os.environ.get("VOLCANO_BENCH_ROW_OUT", "BENCH_r12.json")
     if not out:
         return
     try:
@@ -1149,7 +1172,15 @@ def main() -> None:
                           "constraint_build_ms", "victim_select_kernel_ms",
                           "victim_select_python_ms", "victim_kernel_runs",
                           "victim_evictions_kernel",
-                          "victim_evictions_python"):
+                          "victim_evictions_python",
+                          # pruning-readiness baseline (round 12,
+                          # docs/design/observability.md): per-gang
+                          # feasible-node percentiles + top-k score
+                          # coverage + fleet fragmentation at the
+                          # canonical shape
+                          "explain_feasible_nodes",
+                          "explain_topk_coverage",
+                          "fragmentation_ratio"):
                     if k in cres:
                         row[k] = cres[k]
             else:

@@ -157,7 +157,29 @@ def test_killed_worker_restart_task_policy(cl, tmp_path):
 def test_killed_worker_restart_job_policy(cl, tmp_path):
     """The reference's job-level variant (job_error_handling.go:37-47):
     PodFailed -> RestartJob kills and reruns the whole job, retry count
-    bumped, and the rerun completes."""
+    bumped, and the rerun completes.
+
+    Hardened against the environmental multiprocess flake PR 12
+    documented — TWO timing assumptions replaced with deterministic
+    barriers:
+
+    * the kill used to race the OS process lifecycle on a single
+      timing sample; the pre-kill wait is now a READINESS BARRIER (the
+      victim's store pod Running AND its process alive in the same
+      observation) and the kill retries bounded times, re-establishing
+      the barrier whenever the observed process is already gone;
+    * the rerun used to race run 1's PERSISTED launch tokens:
+      RestartJob's kill path runs ``plugin.on_job_delete`` (the
+      reference's killJob → OnJobDelete), so the ssh keypair is
+      REGENERATED on restart and a stale token can never verify
+      against the rerun's authorized_keys — a rerun worker that read
+      the old token before the new master re-signed exited 4, another
+      PodFailed → RestartJob, and three laps put the job in Failed.
+      Whether the test passed depended on which process won a 50 ms
+      poll race. The stale tokens are now removed BEFORE the release
+      gate opens; workers need launch+release together, so every rerun
+      worker deterministically waits for the rerun master's fresh
+      signature."""
     rdv = tmp_path / "rdv"
     rdv.mkdir()
     cl.store.create("nodes", build_node("n1", {"cpu": "8", "memory": "16Gi"}))
@@ -166,13 +188,36 @@ def test_killed_worker_restart_job_policy(cl, tmp_path):
                                            action=JobAction.RESTART_JOB)]))
 
     victim = make_pod_name("mpi", "worker", 1)
-    assert cl.pump(lambda: f"default/{victim}" in cl.kubelet.procs), \
-        "workers never started"
-    assert cl.kubelet.kill("default", victim)
+
+    def victim_running():
+        pod = cl.store.get("pods", victim, "default")
+        entry = cl.kubelet.procs.get(f"default/{victim}")
+        return pod is not None and pod.status.phase == "Running" \
+            and entry is not None and entry[0].poll() is None
+
+    assert cl.pump(victim_running, timeout=120), \
+        "worker never reached Running with a live process"
+    for _ in range(5):
+        if cl.kubelet.kill("default", victim):
+            break
+        # the observed process died/was replaced between the barrier
+        # and the signal: re-establish the barrier on the new one
+        assert cl.pump(victim_running, timeout=60), \
+            "worker process vanished and never came back"
+    else:
+        raise AssertionError("could not land the kill on a live worker")
     assert cl.pump(lambda: cl.store.get("jobs", "mpi").status.retry_count
-                   >= 1), "RestartJob never fired"
+                   >= 1, timeout=120), "RestartJob never fired"
+    # drop run 1's launch tokens BEFORE opening the release gate: the
+    # restart regenerated the ssh keypair, so they can only produce
+    # exit-4 verification failures (see docstring); with them gone and
+    # release still absent, no rerun worker can proceed until the rerun
+    # master signs fresh tokens with the current key
+    for stale in rdv.glob("go-*"):
+        stale.unlink()
     (rdv / "release").write_text("go")
-    assert cl.pump(lambda: cl.phase() == JobPhase.COMPLETED), \
+    assert cl.pump(lambda: cl.phase() == JobPhase.COMPLETED,
+                   timeout=120), \
         f"job stuck in {cl.phase()} after restart"
 
 

@@ -1,0 +1,458 @@
+"""Placement-explainer tests (docs/design/observability.md): decision
+provenance records off real placements, the elimination-ladder sum
+invariant, top-k score-term decomposition, /debug/explain + `vcctl
+debug explain` over real HTTP (shape, 404s, disabled mode), explain
+fingerprint double-run determinism on the sim's virtual clock, the
+fragmentation/padded-waste/shard gauges, victim-decision provenance,
+and the commit-order-stable FlakyWatch fault coin (the PR 11 residue)."""
+
+import argparse
+import json
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+from volcano_tpu.apiserver import ObjectStore
+from volcano_tpu.cache import SchedulerCache
+from volcano_tpu.cli import debug as cli_debug
+from volcano_tpu.metrics import metrics as m
+from volcano_tpu.metrics.server import MetricsServer
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.trace import explain, tracer
+from volcano_tpu.utils.test_utils import (FakeBinder, FakeEvictor, build_node,
+                                          build_pod, build_pod_group,
+                                          build_queue)
+
+CONF = """
+actions: "enqueue, allocate"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+CONF_EXPLAIN_OFF = CONF + """
+configurations:
+- name: solver
+  arguments:
+    explain.enable: "false"
+"""
+
+CONF_PREEMPT = """
+actions: "preempt"
+tiers:
+- plugins:
+  - name: priority
+  - name: conformance
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: nodeorder
+"""
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    tracer.reset()
+    explain.disable()
+    explain.reset()
+    m.reset()
+    yield
+    explain.disable()
+    explain.reset()
+    tracer.disable()
+    tracer.reset()
+    m.reset()
+
+
+def _env(n_nodes=4, n_gangs=2, gang=3, conf=CONF, node_cpu="8"):
+    store = ObjectStore()
+    binder = FakeBinder(store)
+    cache = SchedulerCache(store, binder=binder, evictor=FakeEvictor(store))
+    cache.run()
+    sched = Scheduler(store, scheduler_conf=conf, cache=cache)
+    store.create("queues", build_queue("default", weight=1))
+    for i in range(n_nodes):
+        store.create("nodes", build_node(f"n{i}", {"cpu": node_cpu,
+                                                   "memory": "16Gi"}))
+    for j in range(n_gangs):
+        store.create("podgroups", build_pod_group(
+            f"pg-{j}", "default", "default", gang, phase="Inqueue"))
+        for t in range(gang):
+            store.create("pods", build_pod(
+                "default", f"pg-{j}-{t}", "", "Pending",
+                {"cpu": "1", "memory": "1Gi"}, groupname=f"pg-{j}"))
+    return store, cache, binder, sched
+
+
+# -- provenance records ------------------------------------------------------
+
+
+def test_every_placed_gang_gets_a_record():
+    explain.enable()
+    tracer.enable()
+    _, cache, binder, sched = _env(n_gangs=3)
+    sched.run_once()
+    cache.flush_executors()
+    rep = explain.report()
+    assert rep["enabled"] and rep["records"] == 3
+    for j in range(3):
+        rec = rep["jobs"][f"default/pg-{j}"]
+        assert rec["kernel"] in ("sharded", "pallas", "native",
+                                 "chunked", "scan")
+        assert rec["committed"] is True
+        g = rec["groups"][0]
+        # the gang's winner is a node its pods really bound to
+        bound_nodes = {binder.binds[f"default/pg-{j}-{t}"]
+                       for t in range(3)}
+        assert g["winner"] in bound_nodes
+        assert g["placed"] == g["tasks"] == 3
+        # the elimination ladder telescopes exactly to the node axis
+        assert g["feasible"] + sum(g["eliminations"].values()) \
+            == g["nodes"] == 4
+        assert 0.0 <= min(g["coverage"].values())
+        assert max(g["coverage"].values()) <= 1.0
+    cache.stop()
+
+
+def test_elimination_ladder_counts_infeasible_nodes():
+    """A node too small for the gang's tasks must show up as a 'fit'
+    elimination, and feasible shrinks to the schedulable axis."""
+    explain.enable()
+    store, cache, _, sched = _env(n_nodes=3, n_gangs=1, gang=2)
+    store.create("nodes", build_node("tiny", {"cpu": "500m",
+                                              "memory": "1Gi"}))
+    sched.run_once()
+    cache.flush_executors()
+    g = explain.job_record("default/pg-0")["groups"][0]
+    assert g["nodes"] == 4
+    assert g["feasible"] == 3
+    assert g["eliminations"].get("fit") == 1
+    cache.stop()
+
+
+def test_topk_terms_and_margin():
+    explain.enable()
+    _, cache, _, sched = _env(n_gangs=1)
+    sched.run_once()
+    cache.flush_executors()
+    g = explain.job_record("default/pg-0")["groups"][0]
+    topk = g["topk"]
+    assert 1 <= len(topk) <= explain.TOPK
+    # candidates are score-sorted, the winner leads, and each entry
+    # decomposes into the kernel's additive score terms
+    scores = [e["score"] for e in topk]
+    assert scores == sorted(scores, reverse=True)
+    assert topk[0]["node"] == g["winner"]
+    assert "static" in topk[0]["terms"]
+    assert any(k in topk[0]["terms"] for k in ("binpack", "least",
+                                               "most", "balanced"))
+    assert g["win_margin"] >= 0.0
+    cache.stop()
+
+
+def test_disabled_mode_records_nothing():
+    _, cache, _, sched = _env()
+    sched.run_once()
+    cache.flush_executors()
+    rep = explain.report()
+    assert rep["enabled"] is False and rep["records"] == 0
+    assert rep["jobs"] == {} and rep["victims"] == []
+    assert explain.job_record("default/pg-0") is None
+    cache.stop()
+
+
+def test_conf_override_forces_off():
+    """`explain.enable: "false"` in the solver conf beats the module
+    switch — the production off-gate."""
+    explain.enable()
+    _, cache, _, sched = _env(conf=CONF_EXPLAIN_OFF)
+    sched.run_once()
+    cache.flush_executors()
+    assert explain.report()["records"] == 0
+    cache.stop()
+
+
+# -- aggregates + gauges -----------------------------------------------------
+
+
+def test_aggregates_and_gauges():
+    explain.enable()
+    _, cache, _, sched = _env(n_gangs=2)
+    sched.run_once()
+    cache.flush_executors()
+    agg = explain.aggregates()
+    assert agg["feasible_nodes"]["count"] == 2
+    assert set(agg["topk_coverage"]) == {str(k)
+                                         for k in explain.COVERAGE_KS}
+    assert agg["fragmentation_ratio"] is not None
+    assert 0.0 <= agg["fragmentation_ratio"] <= 1.0
+    snap = m.snapshot()
+    gauges = {k[0] for k in snap["gauges"]}
+    assert m.FRAGMENTATION_RATIO in gauges
+    assert m.PADDED_WASTE in gauges
+    hists = {k[0] for k in snap["histograms"]}
+    assert m.GANG_FEASIBLE_NODES in hists
+    assert m.TOPK_SCORE_COVERAGE in hists
+    cache.stop()
+
+
+def test_fragmentation_ratio_formula():
+    """Two nodes at unit [2, 2] per slot: one with a whole free slot,
+    one with a stranded half slot — ratio = 1 / 1.5."""
+    narr = types.SimpleNamespace(
+        names=["a", "b"],
+        idle=np.array([[2.0, 2.0], [1.0, 1.0]], np.float32),
+        allocatable=np.array([[8.0, 8.0], [8.0, 8.0]], np.float32),
+        max_tasks=np.array([4, 4], np.int32))
+    assert explain.fragmentation_ratio(narr) == pytest.approx(1 / 1.5)
+    # fully idle fleet = unfragmented
+    narr.idle = narr.allocatable.copy()
+    assert explain.fragmentation_ratio(narr) == pytest.approx(1.0)
+
+
+def test_kernel_subphase_spans():
+    """/debug/trace gains tensor_build / transfer / execute under the
+    kernel span (the per-tier cost attribution)."""
+    tracer.enable()
+    _, cache, _, sched = _env()
+    sched.run_once()
+    cache.flush_executors()
+    phases = tracer.flat_phases(tracer.last_record())
+    assert any(p.endswith("kernel/tensor_build") for p in phases)
+    assert any(p.endswith("tensor_build/transfer") for p in phases)
+    assert any(p.endswith("kernel/execute") for p in phases)
+    cache.stop()
+
+
+# -- victim provenance -------------------------------------------------------
+
+
+def _preempt_env():
+    from volcano_tpu.models.objects import ObjectMeta, PriorityClass
+    store = ObjectStore()
+    binder = FakeBinder(store)
+    cache = SchedulerCache(store, binder=binder, evictor=FakeEvictor(store))
+    cache.run()
+    sched = Scheduler(store, scheduler_conf=CONF_PREEMPT, cache=cache)
+    store.create("queues", build_queue("default", weight=1))
+    store.create("priorityclasses", PriorityClass(
+        metadata=ObjectMeta(name="high"), value=100))
+    store.create("priorityclasses", PriorityClass(
+        metadata=ObjectMeta(name="low"), value=1))
+    for i in range(4):
+        store.create("nodes", build_node(f"n{i}", {"cpu": "8",
+                                                   "memory": "16Gi"}))
+    for j in range(4):
+        store.create("podgroups", build_pod_group(
+            f"lo-{j}", "default", "default", 1, phase="Running",
+            priority_class="low"))
+        for t in range(2):
+            store.create("pods", build_pod(
+                "default", f"lo-{j}-{t}", f"n{j}", "Running",
+                {"cpu": "3", "memory": "6Gi"}, f"lo-{j}"))
+    store.create("podgroups", build_pod_group(
+        "hi", "default", "default", 2, phase="Inqueue",
+        priority_class="high"))
+    for t in range(2):
+        store.create("pods", build_pod(
+            "default", f"hi-{t}", "", "Pending",
+            {"cpu": "4", "memory": "8Gi"}, "hi"))
+    return store, cache, binder, sched
+
+
+def test_victim_decisions_recorded():
+    explain.enable()
+    store, cache, _, sched = _preempt_env()
+    sched.run_once()
+    cache.flush_executors()
+    victims = explain.report()["victims"]
+    assert victims, "preemption ran but recorded no victim decisions"
+    v = victims[0]
+    assert v["preemptor"].startswith("default/hi")
+    assert v["mode"] and v["node"].startswith("n")
+    assert v["candidates"] > 0 and v["victims"]
+    assert v["winning_tier"] is not None
+    # per-plugin admissibility counts + per-victim verdicts on the
+    # winning node, selected victims flagged
+    assert set(v["admissible"]) >= {"priority", "gang", "conformance"}
+    assert any(e["selected"] for e in v["verdicts"])
+    for e in v["verdicts"]:
+        assert set(e["verdicts"]) == set(v["admissible"])
+    cache.stop()
+
+
+# -- HTTP + CLI --------------------------------------------------------------
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}") as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_debug_explain_over_http():
+    explain.enable()
+    _, cache, _, sched = _env(n_gangs=2)
+    sched.run_once()
+    cache.flush_executors()
+    server = MetricsServer(port=0)
+    server.start()
+    try:
+        status, payload = _get(server.port, "/debug/explain")
+        assert status == 200
+        assert payload["enabled"] is True and payload["records"] == 2
+        assert set(payload) >= {"jobs", "victims", "aggregates",
+                                "fingerprint"}
+        rec = payload["jobs"]["default/pg-0"]
+        assert rec["groups"][0]["winner"]
+        # single-job lookup
+        status, single = _get(server.port,
+                              "/debug/explain?job=default/pg-1")
+        assert status == 200 and single["job"] == "default/pg-1"
+        # unknown job -> structured 404
+        status, err = _get(server.port, "/debug/explain?job=default/nope")
+        assert status == 404 and "error" in err
+        # the index lists the endpoint
+        status, idx = _get(server.port, "/debug")
+        assert "/debug/explain" in idx["endpoints"]
+
+        # vcctl debug explain over the same real HTTP seam
+        args = argparse.Namespace(
+            metrics=f"http://127.0.0.1:{server.port}", verb="explain",
+            job=None, json=False)
+        assert cli_debug.dispatch_debug(args) == 0
+        args.job = "default/pg-0"
+        assert cli_debug.dispatch_debug(args) == 0
+        args.job = "default/nope"
+        assert cli_debug.dispatch_debug(args) == 1
+    finally:
+        server.stop()
+        cache.stop()
+
+
+def test_debug_explain_disabled_mode_over_http():
+    server = MetricsServer(port=0)
+    server.start()
+    try:
+        status, payload = _get(server.port, "/debug/explain")
+        assert status == 200
+        assert payload["enabled"] is False and payload["records"] == 0
+        status, err = _get(server.port, "/debug/explain?job=default/x")
+        assert status == 404 and err["enabled"] is False
+    finally:
+        server.stop()
+
+
+# -- determinism (sim virtual clock) ----------------------------------------
+
+
+def _tiny_sim_cfg():
+    from volcano_tpu.sim.engine import SimConfig
+    from volcano_tpu.sim.faults import FaultConfig
+    from volcano_tpu.sim.workload import WorkloadConfig
+    return SimConfig(
+        seed=5, ticks=8, tick_s=1.0, n_nodes=16,
+        node_cpu="16", node_mem="32Gi",
+        resident_jobs=6, resident_gang=4,
+        workload=WorkloadConfig(seed=5, horizon_s=8.0, arrival_rate=0.4,
+                                duration_min_s=3.0, duration_max_s=6.0),
+        faults=FaultConfig(seed=5, bind_fail_rate=0.02),
+        repro_dir=None)
+
+
+def test_fingerprint_bit_identical_across_double_run():
+    from volcano_tpu.framework.solver import reset_breaker
+    from volcano_tpu.sim.engine import run_sim
+    explain.enable()
+    reset_breaker()
+    explain.reset()
+    r1 = run_sim(_tiny_sim_cfg())
+    fp1 = explain.fingerprint()
+    n1 = explain.report()["records"]
+    reset_breaker()
+    explain.reset()
+    r2 = run_sim(_tiny_sim_cfg())
+    fp2 = explain.fingerprint()
+    assert n1 > 0
+    assert r1.bind_fingerprint() == r2.bind_fingerprint()
+    assert fp1 == fp2
+
+
+# -- FlakyWatch re-key (the PR 11 residue) -----------------------------------
+
+
+def _deliveries(store_writes, seed=3, drop_rate=0.4):
+    """Apply ``store_writes(store)`` with a FlakyWatch-wrapped pod watch
+    and return the delivered (action, key) pairs."""
+    from volcano_tpu.sim.faults import FlakyWatch
+    store = ObjectStore()
+    seen = []
+    w = store.watch("pods",
+                    lambda o: seen.append(("ADDED", o.metadata.key())),
+                    lambda old, new: seen.append(
+                        ("MODIFIED", new.metadata.key())),
+                    lambda o: seen.append(("DELETED", o.metadata.key())))
+    fw = FlakyWatch(seed=seed, drop_rate=drop_rate)
+    fw.wrap(w)
+    store_writes(store)
+    return seen, fw
+
+
+def test_flaky_watch_coin_is_commit_order_stable():
+    """The drop coin rides (key, per-key sequence), NOT resource_version:
+    interleaving unrelated writers — which shifts every rv — must not
+    change which pod deliveries drop. This is what lets cache-side watch
+    faults run at storm scale (serving/storm.py)."""
+    def plain(store):
+        for i in range(8):
+            store.create("pods", build_pod(
+                "ns", f"p-{i}", "", "Pending",
+                {"cpu": "1", "memory": "1Gi"}))
+        for i in range(8):
+            p = store.get("pods", f"p-{i}", "ns")
+            p.status.phase = "Running"
+            store.update("pods", p, skip_admission=True)
+
+    def interleaved(store):
+        for i in range(8):
+            store.create("pods", build_pod(
+                "ns", f"p-{i}", "", "Pending",
+                {"cpu": "1", "memory": "1Gi"}))
+            # unrelated writer shifts every subsequent rv
+            store.create("nodes", build_node(
+                f"shift-{i}", {"cpu": "1", "memory": "1Gi"}))
+        for i in range(8):
+            p = store.get("pods", f"p-{i}", "ns")
+            p.status.phase = "Running"
+            store.update("pods", p, skip_admission=True)
+            store.create("nodes", build_node(
+                f"shift2-{i}", {"cpu": "1", "memory": "1Gi"}))
+
+    seen1, fw1 = _deliveries(plain)
+    seen2, fw2 = _deliveries(interleaved)
+    assert fw1.dropped > 0, "drop rate never fired — test went stale"
+    assert seen1 == seen2
+    assert fw1.dropped == fw2.dropped
+
+
+def test_flaky_watch_double_run_identical():
+    def writes(store):
+        for i in range(12):
+            store.create("pods", build_pod(
+                "ns", f"p-{i}", "", "Pending",
+                {"cpu": "1", "memory": "1Gi"}))
+    a = _deliveries(writes)[0]
+    b = _deliveries(writes)[0]
+    assert a == b

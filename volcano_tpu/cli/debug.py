@@ -1,10 +1,13 @@
 """`vcctl debug`: fetch and pretty-print a running scheduler's /debug/*.
 
-    vcctl debug cycles      last N traced cycles (seq, wall, phases)
-    vcctl debug pending     why-pending per job / per reason
-    vcctl debug health      component health (exit 1 while degraded)
-    vcctl debug latency     pod lifecycle ledger percentiles
-    vcctl debug timeseries  last N cycles of key gauges/counters
+    vcctl debug cycles          last N traced cycles (seq, wall, phases)
+    vcctl debug pending         why-pending per job / per reason
+    vcctl debug health          component health (exit 1 while degraded)
+    vcctl debug latency         pod lifecycle ledger percentiles
+    vcctl debug timeseries      last N cycles of key gauges/counters
+    vcctl debug explain [job]   placement decision provenance (one job's
+                                record, or the newest records + the
+                                pruning-readiness aggregates)
 
 Talks to the metrics server (`--metrics` / $VOLCANO_METRICS, default
 http://127.0.0.1:8080), not the apiserver; `--json` prints the raw
@@ -16,12 +19,14 @@ from __future__ import annotations
 import json
 import os
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import List
 
 DEFAULT_METRICS = os.environ.get("VOLCANO_METRICS",
                                  "http://127.0.0.1:8080")
-VERBS = ("cycles", "pending", "health", "latency", "timeseries")
+VERBS = ("cycles", "pending", "health", "latency", "timeseries",
+         "explain")
 
 
 def fetch(server: str, path: str, timeout: float = 10.0):
@@ -149,18 +154,92 @@ def _render_timeseries(payload: dict) -> str:
     return _table(rows, [short[c] for c in cols])
 
 
+def _fmt_elims(elims: dict) -> str:
+    return " ".join(f"{k}={v}" for k, v in sorted(elims.items())) or "-"
+
+
+def _render_explain(payload: dict) -> str:
+    if "error" in payload:     # structured 404 (unknown job)
+        return (f"{payload['error']} (explainer "
+                f"{'enabled' if payload.get('enabled') else 'DISABLED'})")
+    if "groups" in payload:    # single-job record (?job=)
+        lines = [f"job {payload.get('job')}  cycle={payload.get('cycle')} "
+                 f"kernel={payload.get('kernel')} "
+                 f"queue={payload.get('queue')} "
+                 f"committed={payload.get('committed')}"]
+        for g in payload.get("groups", []):
+            lines.append(
+                f"  gang {g['gang']}: placed {g['placed']}/{g['tasks']} "
+                f"winner={g['winner']} feasible={g['feasible']}/"
+                f"{g['nodes']} margin={g['win_margin']}")
+            lines.append(f"    eliminations: "
+                         f"{_fmt_elims(g.get('eliminations', {}))}")
+            lines.append("    coverage: " + " ".join(
+                f"k={k}:{v}" for k, v in sorted(
+                    g.get("coverage", {}).items(), key=lambda kv:
+                    int(kv[0]))))
+            for e in g.get("topk", [])[:8]:
+                terms = " ".join(f"{k}={v}" for k, v in
+                                 sorted(e.get("terms", {}).items()))
+                lines.append(f"    cand {e['node']} score={e['score']} "
+                             f"{terms}")
+        return "\n".join(lines)
+    lines = [f"explain: enabled={payload.get('enabled')} "
+             f"records={payload.get('records')} "
+             f"fingerprint={str(payload.get('fingerprint'))[:16]}…"]
+    agg = payload.get("aggregates") or {}
+    feas = agg.get("feasible_nodes") or {}
+    if feas.get("count"):
+        lines.append(f"feasible nodes/gang: n={feas['count']} "
+                     f"p50={feas.get('p50')} p90={feas.get('p90')} "
+                     f"p99={feas.get('p99')} mean={feas.get('mean')}")
+    cov = agg.get("topk_coverage") or {}
+    if cov:
+        lines.append("top-k score coverage: " + " ".join(
+            f"k={k}:{v}" for k, v in sorted(cov.items(),
+                                            key=lambda kv: int(kv[0]))))
+    if agg.get("fragmentation_ratio") is not None:
+        lines.append(f"fragmentation ratio: "
+                     f"{agg['fragmentation_ratio']}")
+    jobs = payload.get("jobs") or {}
+    if jobs:
+        rows = []
+        for key, rec in list(jobs.items())[-20:]:
+            g = (rec.get("groups") or [{}])[0]
+            rows.append([key, rec.get("kernel"), g.get("winner"),
+                         f"{g.get('feasible')}/{g.get('nodes')}",
+                         g.get("win_margin"),
+                         _fmt_elims(g.get("eliminations", {}))])
+        lines.append(_table(rows, ["job", "kernel", "winner",
+                                   "feasible", "margin",
+                                   "eliminations"]))
+    victims = payload.get("victims") or []
+    if victims:
+        rows = [[v["preemptor"], v["mode"], v["node"],
+                 v.get("winning_tier"), len(v.get("victims", [])),
+                 v.get("candidates")] for v in victims[-10:]]
+        lines.append("victim decisions:")
+        lines.append(_table(rows, ["preemptor", "mode", "node", "tier",
+                                   "victims", "candidates"]))
+    return "\n".join(lines)
+
+
 _RENDER = {"cycles": _render_cycles, "pending": _render_pending,
            "health": _render_health, "latency": _render_latency,
-           "timeseries": _render_timeseries}
+           "timeseries": _render_timeseries, "explain": _render_explain}
 
 
 def dispatch_debug(args) -> int:
-    status, payload = fetch(args.metrics, f"/debug/{args.verb}")
+    path = f"/debug/{args.verb}"
+    if args.verb == "explain" and getattr(args, "job", None):
+        path += "?job=" + urllib.parse.quote(args.job)
+    status, payload = fetch(args.metrics, path)
     if args.json:
         print(json.dumps(payload, indent=1))
     else:
         print(_RENDER[args.verb](payload))
     # /debug/health 503s while degraded — the exit code should say so
+    # (and an unknown-job explain lookup exits 1 the same way)
     return 0 if status < 400 else 1
 
 
@@ -169,6 +248,8 @@ def add_debug_parser(sub) -> None:
         "debug", help="fetch and pretty-print a running scheduler's "
                       "/debug endpoints")
     dbg.add_argument("verb", choices=VERBS)
+    dbg.add_argument("job", nargs="?", default=None,
+                     help="explain only: one job's record (ns/name)")
     dbg.add_argument("--metrics", "-m", default=DEFAULT_METRICS,
                      help="metrics server endpoint "
                           "(default $VOLCANO_METRICS or "

@@ -44,6 +44,12 @@ _logged_once: set = set()
 # level node cursor, scheduler_helper.go:95); advances per sampled session
 _node_cursor = 0
 
+# fleet fragmentation gauge cadence (docs/design/observability.md): the
+# O(N x R) numpy pass runs every place() when the explainer is on, else
+# once per this many place() calls so the steady-state cycle never pays
+# it on the measured path
+FRAG_EVERY = 16
+
 # -- solver circuit breaker (docs/design/resilience.md) ----------------------
 # A kernel tier that CRASHES mid-place (the known native-kernel divergence
 # class) is retried with the next tier of the degradation ladder
@@ -302,6 +308,14 @@ class BatchSolver:
         #     arguments: {breaker.window: 20}
         self.breaker_window = 20
         solver_args = (ssn.configurations or {}).get("solver")
+        # placement explainer (trace/explain.py): decision provenance +
+        # pruning-readiness aggregates, derived from the [G, N] tensors
+        # this solver compiles. `explain.enable` (solver conf) overrides
+        # the module switch; when off the only hot-path residue is this
+        # cached bool.
+        from ..trace import explain as _explain
+        self.explain = _explain.session_enabled(solver_args)
+        self._explain_stages = None
         if solver_args is not None:
             if hasattr(solver_args, "get_int"):
                 self.breaker_window = solver_args.get_int(
@@ -665,33 +679,49 @@ class BatchSolver:
         m.inc(m.SOLVER_DEVICE_BUFFER, event="reuse")
         return dict(state.dev), xfer
 
-    def _apply_masks_and_scores(self, gmask, batch, narr, feats, xp):
+    def _apply_masks_and_scores(self, gmask, batch, narr, feats, xp,
+                                stages=None):
         """Shared back half of both context builds — ONE formulation of
         the feature masks, plugin mask/score contributions and the host
         predicate fallback; ``xp`` (jnp or numpy) decides only where the
         arrays live. Contributions return None when trivially
         pass-through: a dense [G, N] array is tens-to-hundreds of MB at
         50k x 10k, and all-ones feature masks skip their matmuls
-        entirely."""
+        entirely.
+
+        ``stages`` (explain mode only) collects the cumulative mask
+        ladder as ``(label, survivors [G])`` pairs — each stage is
+        reduced to its per-group survivor count EAGERLY (an async [G]
+        device reduce), so the [G, N] intermediates keep their normal
+        XLA lifetime instead of being pinned until the post-place
+        capture (a 5-stage constrained ladder at 50k x 10k would
+        otherwise hold multiple ~500 MB masks live at once)."""
+        def cap(label, g):
+            if stages is not None:
+                stages.append((label, g.sum(axis=1)))
+            return g
+
         if self.enable_default_predicates:
             if feats.group_require_counts.any():
-                gmask = gmask & selector_mask(
+                gmask = cap("selector", gmask & selector_mask(
                     xp.asarray(feats.node_pairs),
                     xp.asarray(feats.group_requires),
-                    xp.asarray(feats.group_require_counts))
+                    xp.asarray(feats.group_require_counts)))
             if feats.node_taints.any():
-                gmask = gmask & taint_mask(
+                gmask = cap("taint", gmask & taint_mask(
                     xp.asarray(feats.node_taints),
-                    xp.asarray(feats.group_tolerates))
+                    xp.asarray(feats.group_tolerates)))
             if feats.group_affinity_ok is not None:
-                gmask = gmask & xp.asarray(feats.group_affinity_ok)
+                gmask = cap("node_affinity",
+                            gmask & xp.asarray(feats.group_affinity_ok))
         for fn in self.mask_fns:
             contrib = fn(batch, narr, feats)
             if contrib is not None:
-                gmask = gmask & xp.asarray(contrib)
+                gmask = cap(getattr(fn, "explain_label", "plugin"),
+                            gmask & xp.asarray(contrib))
         host_mask = self._host_predicate_mask(batch, narr)
         if host_mask is not None:
-            gmask = gmask & xp.asarray(host_mask)
+            gmask = cap("host_predicates", gmask & xp.asarray(host_mask))
 
         static_score = None
         for fn in self.static_score_fns:
@@ -729,8 +759,11 @@ class BatchSolver:
                                    jnp.asarray(uniq_cap),
                                    jnp.asarray(inv.astype(np.int32)),
                                    jnp.asarray(narr.valid), eps)
+        stages = [("fit", gmask.sum(axis=1))] \
+            if (slot_tensors and self.explain) else None
         gmask, static_score = self._apply_masks_and_scores(
-            gmask, batch, narr, feats, jnp)
+            gmask, batch, narr, feats, jnp, stages=stages)
+        self._explain_stages = stages
         if static_score is None:
             # no static contributions (the common conf): a [G, N] zeros is
             # ~256 MB at 50k x 10k and allocating one per context build
@@ -844,6 +877,7 @@ class BatchSolver:
                allow_pipeline: bool = True) -> PlacementResult:
         narr, batch, gmask, static_score = self._build_context(
             ordered_jobs, slot_tensors=True)
+        explain_stages, self._explain_stages = self._explain_stages, None
         eps = jnp.asarray(self.rindex.eps)
 
         # queue fair-share budgets (live Overused gate inside the scan)
@@ -903,6 +937,20 @@ class BatchSolver:
         # breaker-open tiers are skipped until their half-open window
         global _place_counter
         _place_counter += 1
+        # kernel cost attribution (docs/design/observability.md): padded
+        # vs live rows per kernel axis, and the fleet fragmentation
+        # gauge (every place when the explainer is on, else amortized)
+        n_real_nodes = len(narr.names)
+        m.set_gauge(m.PADDED_WASTE, round(
+            1.0 - n_real_nodes / max(1, narr.n_pad), 4), axis="nodes")
+        m.set_gauge(m.PADDED_WASTE, round(
+            1.0 - batch.n_groups / max(1, batch.g_pad), 4), axis="groups")
+        m.set_gauge(m.PADDED_WASTE, round(
+            1.0 - len(batch.tasks) / max(1, int(batch.task_group.shape[0])),
+            4), axis="tasks")
+        if self.explain or _place_counter % FRAG_EVERY == 0:
+            from ..trace import explain as _explain
+            _explain.note_fragmentation(narr)
         # per-task topology-domain inputs (ops/constraints.py): every
         # kernel consumes the same (task_slot, slot_ok) pair uniformly
         slot_kwargs = {}
@@ -961,36 +1009,43 @@ class BatchSolver:
                     else:
                         if kernel_inputs is None:
                             account_transfer = True
-                            dev_nodes, node_xfer = \
-                                self._device_node_inputs(narr)
-                            kernel_inputs = (
-                                jnp.asarray(batch.task_group),
-                                jnp.asarray(batch.task_job),
-                                jnp.asarray(batch.task_valid),
-                                jnp.asarray(batch.group_req),
-                                gmask, static_score,
-                                jnp.asarray(task_bucket),
-                                jnp.asarray(pack_bonus),
-                                jnp.asarray(batch.job_min_available),
-                                jnp.asarray(batch.job_ready_base),
-                                jnp.asarray(batch.job_task_start),
-                                jnp.asarray(batch.job_n_tasks),
-                                jnp.asarray(batch.job_queue),
-                                jnp.asarray(batch.pool_queue),
-                                jnp.asarray(batch.pool_ns),
-                                jnp.asarray(batch.pool_job_start),
-                                jnp.asarray(batch.pool_njobs),
-                                jnp.asarray(ns_weight),
-                                jnp.asarray(ns_alloc0),
-                                jnp.asarray(ns_total),
-                                jnp.asarray(q_deserved),
-                                jnp.asarray(q_alloc0),
-                                dev_nodes["idle"],
-                                dev_nodes["future_idle"],
-                                dev_nodes["allocatable"],
-                                dev_nodes["n_tasks"],
-                                dev_nodes["max_tasks"], eps,
-                                self.score_weights())
+                            # per-tier sub-phase attribution: the input
+                            # tensor assembly and the host->device node
+                            # staging get their own spans (compile vs
+                            # execute is the kernel span's `compiled`
+                            # tag, ops/kernel_span)
+                            with trace.span("tensor_build"):
+                                with trace.span("transfer"):
+                                    dev_nodes, node_xfer = \
+                                        self._device_node_inputs(narr)
+                                kernel_inputs = (
+                                    jnp.asarray(batch.task_group),
+                                    jnp.asarray(batch.task_job),
+                                    jnp.asarray(batch.task_valid),
+                                    jnp.asarray(batch.group_req),
+                                    gmask, static_score,
+                                    jnp.asarray(task_bucket),
+                                    jnp.asarray(pack_bonus),
+                                    jnp.asarray(batch.job_min_available),
+                                    jnp.asarray(batch.job_ready_base),
+                                    jnp.asarray(batch.job_task_start),
+                                    jnp.asarray(batch.job_n_tasks),
+                                    jnp.asarray(batch.job_queue),
+                                    jnp.asarray(batch.pool_queue),
+                                    jnp.asarray(batch.pool_ns),
+                                    jnp.asarray(batch.pool_job_start),
+                                    jnp.asarray(batch.pool_njobs),
+                                    jnp.asarray(ns_weight),
+                                    jnp.asarray(ns_alloc0),
+                                    jnp.asarray(ns_total),
+                                    jnp.asarray(q_deserved),
+                                    jnp.asarray(q_alloc0),
+                                    dev_nodes["idle"],
+                                    dev_nodes["future_idle"],
+                                    dev_nodes["allocatable"],
+                                    dev_nodes["n_tasks"],
+                                    dev_nodes["max_tasks"], eps,
+                                    self.score_weights())
                         if account_transfer:
                             # host->device staging bytes for this place
                             # (gmask/static_score at indices 4-5 are
@@ -1008,13 +1063,15 @@ class BatchSolver:
                                         for a in slot_kwargs.values())
                             m.inc(m.DEVICE_TRANSFER_BYTES, float(xfer))
                             trace.add_tags(transfer_bytes=xfer)
-                        assign, pipelined, ready, kept, _ = kfn(
-                            *kernel_inputs, allow_pipeline=allow_pipeline,
-                            ns_live=ns_live, **slot_kwargs, **kkwargs)
-
-                    # blocks until the device finishes (a deferred kernel
-                    # crash surfaces here, inside the tier's try)
-                    assign = np.asarray(assign)
+                        with trace.span("execute"):
+                            assign, pipelined, ready, kept, _ = kfn(
+                                *kernel_inputs,
+                                allow_pipeline=allow_pipeline,
+                                ns_live=ns_live, **slot_kwargs, **kkwargs)
+                            # blocks until the device finishes (a
+                            # deferred kernel crash surfaces here,
+                            # inside the tier's try)
+                            assign = np.asarray(assign)
             except Exception:
                 if i + 1 >= len(eligible):
                     raise   # last resort crashed too: fail the cycle
@@ -1035,6 +1092,7 @@ class BatchSolver:
                 _logger.warning(
                     "solver kernel %r recovered; breaker closed", tier)
             m.inc(m.SOLVER_KERNEL_RUNS, kernel=tier)
+            served_tier = tier
             break
         m.observe(m.SOLVER_KERNEL_LATENCY,
                   (time.perf_counter() - t_kernel) * 1000.0)
@@ -1129,6 +1187,23 @@ class BatchSolver:
                 row_of = {g: rows[i] for i, g in enumerate(gs)}
                 for job, task, g in unplaced_records:
                     self._record_fit_errors(job, task, narr, row_of[g])
+        if self.explain:
+            # decision provenance (trace/explain.py): derived from the
+            # SAME mask/score tensors this place compiled, via a few
+            # reductions; a capture failure costs log noise, never the
+            # cycle's placements
+            from ..trace import explain as _explain
+            with trace.span("explain_capture"):
+                try:
+                    _explain.record_place(
+                        self.ssn, batch, narr,
+                        explain_stages or [("fit", gmask.sum(axis=1))],
+                        gmask, static_score, self.score_weights(),
+                        assign, result, served_tier)
+                except Exception:
+                    _logger.exception(
+                        "placement explain capture failed "
+                        "(placements unaffected)")
         return result
 
     def _shard_plan(self, narr: NodeArrays, n_devices: int):
@@ -1146,11 +1221,37 @@ class BatchSolver:
             return state.plan
         plan = build_shard_plan(narr.idle.shape[0], n_devices,
                                 pressure=narr.n_tasks)
+        self._note_shard_gauges(plan, narr)
         if state is not None and state.narr is narr:
             state.plan = plan
             state.shard_dev = None
             state.shard_dirty_rows = set()
         return plan
+
+    @staticmethod
+    def _note_shard_gauges(plan, narr: NodeArrays) -> None:
+        """Per-shard occupancy (real rows vs the equal-width layout
+        block) and resident-task pressure off a freshly built ShardPlan,
+        plus the max/mean pressure-imbalance gauge — published once per
+        rebalance (the plan is persistent across steady-state cycles)."""
+        from ..metrics import metrics as m
+        if plan.n_devices <= 0:
+            return
+        pressures = []
+        for d in range(plan.n_devices):
+            lo, hi = int(plan.bounds[d]), int(plan.bounds[d + 1])
+            width = hi - lo
+            # the same pressure model build_shard_plan balances on:
+            # resident tasks + 1 per row
+            pressure = float(narr.n_tasks[lo:hi].sum()) + width
+            pressures.append(pressure)
+            m.set_gauge(m.SHARD_OCCUPANCY,
+                        round(width / max(1, plan.rows_per_shard), 4),
+                        shard=str(d))
+            m.set_gauge(m.SHARD_PRESSURE, pressure, shard=str(d))
+        mean = sum(pressures) / len(pressures)
+        m.set_gauge(m.SHARD_PRESSURE_IMBALANCE,
+                    round(max(pressures) / mean, 4) if mean > 0 else 1.0)
 
     def _sharded_device_node_inputs(self, narr: NodeArrays, plan, mesh):
         """Sharded twin of :meth:`_device_node_inputs`: the five node
@@ -1236,56 +1337,73 @@ class BatchSolver:
         rep = NamedSharding(mesh, P())
 
         from ..metrics import metrics as m
-        dev_nodes, node_xfer = self._sharded_device_node_inputs(
-            narr, plan, mesh)
-        xfer = [node_xfer]
+        # sub-phase attribution: the node-tensor staging + layout
+        # gathers are the sharded tier's "tensor build" (the small
+        # replicated put()s ride the execute span with the dispatch).
+        # try/finally: a crashing build must pop its span — the tier
+        # ladder catches the crash and the fallback tier's spans would
+        # otherwise nest under a dead parent
+        tb = trace.span("tensor_build")
+        tb.__enter__()
+        try:
+            with trace.span("transfer"):
+                dev_nodes, node_xfer = self._sharded_device_node_inputs(
+                    narr, plan, mesh)
+            xfer = [node_xfer]
 
-        def put(a, s):
-            # host->device byte accounting: numpy inputs are genuine
-            # transfers; already-device arrays (gmask/static_score) are
-            # reshards and don't count
-            if isinstance(a, np.ndarray):
-                xfer[0] += int(a.nbytes)
-            return jax.device_put(a, s)
+            def put(a, s):
+                # host->device byte accounting: numpy inputs are genuine
+                # transfers; already-device arrays (gmask/static_score) are
+                # reshards and don't count
+                if isinstance(a, np.ndarray):
+                    xfer[0] += int(a.nbytes)
+                return jax.device_put(a, s)
 
-        # [G, N] -> [G, layout] gathers run device-side (gmask and
-        # static_score are products of the device context build)
-        gmask_l = plan.take_device(jnp.asarray(gmask), axis=1, fill=False)
-        score_l = plan.take_device(jnp.asarray(static_score), axis=1,
-                                   fill=0.0)
-        slot_args = ()
-        if with_slots:
-            # slot rows ride the same node-axis layout gather; the
-            # all-true row's padding columns go False with fill, which
-            # is inert (gmask already excludes layout padding rows)
-            srows_l = plan.take_device(
-                jnp.asarray(slot_kwargs["slot_ok"]), axis=1, fill=False)
-            slot_args = (put(np.asarray(batch.task_slot), rep),
-                         put(srows_l, gn))
+            # [G, N] -> [G, layout] gathers run device-side (gmask and
+            # static_score are products of the device context build)
+            gmask_l = plan.take_device(jnp.asarray(gmask), axis=1, fill=False)
+            score_l = plan.take_device(jnp.asarray(static_score), axis=1,
+                                       fill=0.0)
+            slot_args = ()
+            if with_slots:
+                # slot rows ride the same node-axis layout gather; the
+                # all-true row's padding columns go False with fill, which
+                # is inert (gmask already excludes layout padding rows)
+                srows_l = plan.take_device(
+                    jnp.asarray(slot_kwargs["slot_ok"]), axis=1, fill=False)
+                slot_args = (put(np.asarray(batch.task_slot), rep),
+                             put(srows_l, gn))
+        finally:
+            tb.__exit__()
 
-        assign, pipelined, ready, kept, _idle = fn(
-            put(batch.task_group, rep), put(batch.task_job, rep),
-            put(batch.task_valid, rep), put(batch.group_req, rep),
-            put(gmask_l, gn), put(score_l, gn),
-            put(task_bucket, rep), put(pack_bonus, rep),
-            put(batch.job_min_available, rep),
-            put(batch.job_ready_base, rep),
-            put(batch.job_task_start, rep), put(batch.job_n_tasks, rep),
-            put(batch.job_queue, rep), put(batch.pool_queue, rep),
-            put(batch.pool_ns, rep), put(batch.pool_job_start, rep),
-            put(batch.pool_njobs, rep), put(ns_weight, rep),
-            put(ns_alloc0, rep), put(ns_total, rep),
-            put(q_deserved, rep), put(q_alloc0, rep),
-            dev_nodes["idle"], dev_nodes["future_idle"],
-            dev_nodes["allocatable"], dev_nodes["n_tasks"],
-            dev_nodes["max_tasks"],
-            put(np.asarray(eps), rep), self.score_weights(), *slot_args)
+        ex = trace.span("execute")
+        ex.__enter__()
+        try:
+            assign, pipelined, ready, kept, _idle = fn(
+                put(batch.task_group, rep), put(batch.task_job, rep),
+                put(batch.task_valid, rep), put(batch.group_req, rep),
+                put(gmask_l, gn), put(score_l, gn),
+                put(task_bucket, rep), put(pack_bonus, rep),
+                put(batch.job_min_available, rep),
+                put(batch.job_ready_base, rep),
+                put(batch.job_task_start, rep), put(batch.job_n_tasks, rep),
+                put(batch.job_queue, rep), put(batch.pool_queue, rep),
+                put(batch.pool_ns, rep), put(batch.pool_job_start, rep),
+                put(batch.pool_njobs, rep), put(ns_weight, rep),
+                put(ns_alloc0, rep), put(ns_total, rep),
+                put(q_deserved, rep), put(q_alloc0, rep),
+                dev_nodes["idle"], dev_nodes["future_idle"],
+                dev_nodes["allocatable"], dev_nodes["n_tasks"],
+                dev_nodes["max_tasks"],
+                put(np.asarray(eps), rep), self.score_weights(), *slot_args)
+            # layout index -> node index (the gather is strictly increasing
+            # over real rows, so tie-breaks already matched node order)
+            a = np.asarray(assign)
+        finally:
+            ex.__exit__()
         if xfer[0]:
             m.inc(m.DEVICE_TRANSFER_BYTES, float(xfer[0]))
             trace.add_tags(transfer_bytes=xfer[0])
-        # layout index -> node index (the gather is strictly increasing
-        # over real rows, so tie-breaks already matched node order)
-        a = np.asarray(assign)
         assign = np.where(a >= 0,
                           plan.gather[np.clip(a, 0, plan.n_layout - 1)],
                           -1).astype(np.int32)

@@ -144,6 +144,21 @@ SERVING_ADMITTED = f"{NS}_serving_admitted_total"
 SERVING_THROTTLED = f"{NS}_serving_throttled_total"
 SERVING_SHARD_DEPTH = f"{NS}_serving_hub_shard_depth"
 WATCH_RELISTS = f"{NS}_watch_relists_total"
+# placement explainer + pruning-readiness surface (docs/design/
+# observability.md): per-gang feasible-node-count and top-k
+# score-mass-coverage histograms (labeled k=<shortlist width>) — the
+# baseline the candidate-pruning ROADMAP item shortlists against —
+# plus the fleet fragmentation gauge (largest schedulable uniform-gang
+# vs total free capacity, the Tesserae defrag pre-metric), per-shard
+# occupancy/pressure gauges off the ShardPlan, and padded-vs-live
+# waste ratios per kernel axis
+GANG_FEASIBLE_NODES = f"{NS}_gang_feasible_nodes"
+TOPK_SCORE_COVERAGE = f"{NS}_topk_score_coverage"
+FRAGMENTATION_RATIO = f"{NS}_fragmentation_ratio"
+SHARD_OCCUPANCY = f"{NS}_shard_occupancy"
+SHARD_PRESSURE = f"{NS}_shard_pressure"
+SHARD_PRESSURE_IMBALANCE = f"{NS}_shard_pressure_imbalance"
+PADDED_WASTE = f"{NS}_padded_waste_ratio"
 
 # component health registry behind /debug/health: a component absent from
 # the registry is healthy by default; the watchdog (scheduler.py) flips

@@ -40,6 +40,8 @@ DEBUG_ENDPOINTS = {
     "/debug/timeseries": "last N cycles of key gauges/counters",
     "/debug/serving": "serving hub shard depths / fan-out latency + "
                       "per-tenant admission counters",
+    "/debug/explain": "placement decision provenance (?job=ns/name) + "
+                      "pruning-readiness aggregates",
 }
 
 
@@ -81,6 +83,22 @@ def _debug_response(path: str, query: dict):
     if path == "/debug/serving":
         from ..serving import serving_report
         return 200, serving_report()
+    if path == "/debug/explain":
+        from ..trace import explain
+        job = query.get("job")
+        if job:
+            rec = explain.job_record(job[0])
+            if rec is None:
+                return 404, {"error": "no explanation recorded for job "
+                                      f"{job[0]!r}",
+                             "enabled": explain.is_enabled()}
+            return 200, rec
+        limit = query.get("limit")
+        try:
+            n = int(limit[0]) if limit else 64
+        except ValueError:
+            return 400, {"error": f"bad limit {limit[0]!r}"}
+        return 200, explain.report(limit=n)
     if path == "/debug/pending":
         report = tracer.pending_report()
         if report is None:

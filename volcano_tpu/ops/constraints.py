@@ -865,6 +865,61 @@ def split_assign_or_exclude(ssn, ordered_jobs, names: List[str]):
         return None, kept
 
 
+def score_terms_for(ssn, task, node_names: List[str],
+                    tiered_weight: float = 0.0,
+                    spread_weight: float = 10.0) -> Dict[str, np.ndarray]:
+    """Per-term constraint score values for ONE task on the listed
+    nodes — the explain layer's decomposition of the additive static
+    score into its constraint components (docs/design/observability.md).
+    Same formulas as :func:`compile_score`, evaluated for a handful of
+    nodes host-side; returns ``{"soft_spread": [k], "tieredpack": [k]}``
+    with absent terms omitted."""
+    out: Dict[str, np.ndarray] = {}
+    state = constraint_state(getattr(ssn, "cache", None))
+    names = [n.name for n in ssn.node_list]
+    pos = {n: i for i, n in enumerate(names)}
+    idx = [pos.get(n, -1) for n in node_names]
+    soft = [c for c in task.pod.spec.topology_spread
+            if c.when_unsatisfiable != "DoNotSchedule"]
+    if soft and spread_weight:
+        vals = np.zeros(len(node_names), np.float32)
+        for c in soft:
+            row, vocab = _topo_row(state, ssn, names, c.topology_key)
+            if not vocab:
+                continue
+            job = ssn.jobs.get(task.job)
+            base = _job_domain_counts(ssn, job, c.topology_key, vocab,
+                                      c.label_selector)
+            rel = base - base.min()
+            for k, i in enumerate(idx):
+                if i < 0:
+                    continue
+                code = row[i]
+                per = rel[code] if code >= 0 else rel.max() + 1.0
+                vals[k] -= np.float32(spread_weight * per)
+        out["soft_spread"] = vals
+    if tiered_weight:
+        mass, vocab = _tier_mass(state, ssn, names)
+        if vocab:
+            prios = np.full(max(vocab.values()) + 1, 0, np.int64)
+            for prio, col in vocab.items():
+                prios[col] = prio
+            total = mass[:, :len(prios)]
+            p = _task_tier(ssn, task)
+            ge = total[:, prios >= p].sum(axis=1)
+            lt = total[:, prios < p].sum(axis=1)
+            raw = ge - lt
+            span = float(np.abs(raw).max())
+            vals = np.zeros(len(node_names), np.float32)
+            if span > 0.0:
+                for k, i in enumerate(idx):
+                    if i >= 0:
+                        vals[k] = np.float32(
+                            tiered_weight * 100.0 * raw[i] / span)
+            out["tieredpack"] = vals
+    return out
+
+
 def score_or_fallback(ssn, batch, narr, tiered_weight: float = 0.0,
                       spread_weight: float = 10.0) -> Optional[np.ndarray]:
     """compile_score with the same crash contract as the mask side: log

@@ -41,12 +41,15 @@ so batching across preemptors would change semantics.
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..metrics import metrics as m
 from ..models.job_info import TaskStatus
+
+_logger = logging.getLogger(__name__)
 
 PREEMPT_VECTORIZABLE = frozenset({"priority", "gang", "conformance"})
 RECLAIM_VECTORIZABLE = frozenset({"gang", "conformance", "proportion"})
@@ -138,6 +141,7 @@ class VictimKernel:
         from ..framework.victims import CROSS_QUEUE
         self._CQ = CROSS_QUEUE
         self.ctx = ctx
+        self._explain_cached = None
         ssn = ctx.ssn
         vi = ctx.victims
         mv = len(vi.tasks)
@@ -212,6 +216,69 @@ class VictimKernel:
 
     def supports(self, mode: str) -> bool:
         return self.reclaim_ok if mode == self._CQ else self.preempt_ok
+
+    # -- decision provenance (trace/explain.py) -----------------------------
+
+    def _explain_on(self) -> bool:
+        # cached at first use: place() runs per preemptor on the action
+        # hot path and the A/B gate holds the kernel to beating the
+        # Python walk — even attribute-chain checks per place add up
+        cached = self._explain_cached
+        if cached is None:
+            solver = getattr(self.ctx.ssn, "solver", None)
+            if solver is not None:
+                cached = bool(getattr(solver, "explain", False))
+            else:
+                from ..trace import explain
+                cached = explain.is_enabled()
+            self._explain_cached = cached
+        return cached
+
+    _VERDICT_CAP = 64   # per-victim verdict rows kept per decision
+
+    def _record_explain(self, preemptor, mode: str, tiers, best: int,
+                        rows_all, per_name: Dict[str, np.ndarray],
+                        live, seg_rows, selected_rows, victims,
+                        covered: bool) -> None:
+        """One victim decision into the explain registry: the tier
+        chain, per-plugin admissible counts over the candidate set, and
+        the winning node's per-victim verdicts. ``seg_rows`` are the
+        winning node's candidate indices INTO ``rows_all``'s index
+        space (``per_name``/``live`` are indexed the same way)."""
+        from ..trace import explain
+        vi = self.ctx.victims
+        live_arr = live if live is not None else np.ones(len(rows_all),
+                                                         bool)
+        admissible = {nm: int((arr & live_arr).sum())
+                      for nm, arr in per_name.items()}
+        winning_tier = None
+        for tier_idx, names in tiers:
+            acc = live_arr[seg_rows].copy()
+            for nm in names:
+                arr = per_name.get(nm)
+                acc &= arr[seg_rows] if arr is not None else False
+            if acc.any():
+                winning_tier = int(tier_idx)
+                break
+        sel_set = set(int(r) for r in selected_rows)
+        verdicts = []
+        for off in seg_rows[:self._VERDICT_CAP]:
+            off = int(off)
+            row = int(rows_all[off])
+            t = vi.tasks[row]
+            verdicts.append({
+                "task": f"{t.namespace}/{t.name}",
+                "live": bool(live_arr[off]),
+                "verdicts": {nm: bool(arr[off])
+                             for nm, arr in per_name.items()},
+                "selected": row in sel_set,
+            })
+        explain.record_victims(
+            f"{preemptor.namespace}/{preemptor.name}", mode,
+            self.ctx.narr.names[best], tiers, admissible,
+            len(rows_all), winning_tier,
+            [f"{v.namespace}/{v.name}" for v in victims], verdicts,
+            covered)
 
     def reset_walk(self) -> None:
         """Reset the CROSS_QUEUE multi-step walk memory and the views'
@@ -803,6 +870,15 @@ class VictimKernel:
             if victim_cb is not None:
                 victim_cb(victims)
             m.inc(m.VICTIM_SELECT_RUNS, mode="kernel")
+            if self._explain_on():
+                try:
+                    self._record_explain(
+                        preemptor, mode, self.preempt_tiers, best,
+                        view.rows, view.per_name, view.live,
+                        np.arange(lo, hi), sel[:k], victims[:k], True)
+                except Exception:
+                    _logger.exception("victim explain capture failed "
+                                      "(selection unaffected)")
             return ctx.narr.names[best], victims[:k], True
 
         # CROSS_QUEUE (reclaim): one-shot — proportion's acceptance
@@ -812,7 +888,13 @@ class VictimKernel:
         rows = self._structural_rows(mode, pj, pq)
         if not len(rows):
             return None
-        accept = self._accept(mode, rows, preemptor, req)
+        rows0 = rows
+        explain_parts: Optional[Dict[str, np.ndarray]] = None
+        if self._explain_on():
+            accept, explain_parts = self._accept(mode, rows, preemptor,
+                                                 req, want_parts=True)
+        else:
+            accept = self._accept(mode, rows, preemptor, req)
         rows = rows[accept]
         if not len(rows):
             return None
@@ -860,4 +942,16 @@ class VictimKernel:
         if victim_cb is not None:
             victim_cb(victims)
         m.inc(m.VICTIM_SELECT_RUNS, mode="kernel")
+        if explain_parts is not None:
+            try:
+                accepted_idx = np.flatnonzero(accept)
+                seg = accepted_idx[seg_lo[best]:
+                                   seg_lo[best] + int(counts[best])]
+                self._record_explain(
+                    preemptor, mode, self.reclaim_tiers, best, rows0,
+                    explain_parts, None, seg, sel[:k], victims[:k],
+                    covered)
+            except Exception:
+                _logger.exception("victim explain capture failed "
+                                  "(selection unaffected)")
         return ctx.narr.names[best], victims[:k], covered

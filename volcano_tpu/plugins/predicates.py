@@ -276,6 +276,7 @@ class PredicatesPlugin(Plugin):
                                           & (left_mem >= mem_reserved))
                     mask[g] &= ok
             return mask
+        mask_fn.explain_label = "proportional"
         return mask_fn
 
     def _constraint_mask(self, ssn):
@@ -286,6 +287,9 @@ class PredicatesPlugin(Plugin):
 
         def mask_fn(batch, narr, feats):
             return constraints.masked_or_reference(ssn, batch, narr)
+        # interpod required (anti-)affinity + dense spread slot rows:
+        # the explain ladder's "affinity" stage
+        mask_fn.explain_label = "affinity"
         return mask_fn
 
     def _constraint_score(self, ssn):
@@ -316,6 +320,7 @@ class PredicatesPlugin(Plugin):
                     elif uses_gpu and not _gpu_share_ok(rep, node):
                         mask[g, i] = False
             return mask
+        mask_fn.explain_label = "ports_gpu"
         return mask_fn
 
 

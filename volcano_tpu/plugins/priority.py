@@ -31,6 +31,10 @@ class PriorityPlugin(Plugin):
     def on_session_open(self, ssn) -> None:
         if self.tieredpack_w and ssn.solver is not None:
             from ..ops import constraints
+            # the explain layer's score-term decomposition re-derives
+            # the tieredpack term for top-k candidates and needs the
+            # session's configured weight (trace/explain.py)
+            ssn._tieredpack_weight = self.tieredpack_w
 
             def tiered_score(batch, narr, feats):
                 return constraints.score_or_fallback(

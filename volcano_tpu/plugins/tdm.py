@@ -225,6 +225,7 @@ class TdmPlugin(Plugin):
                 task_rz[g] = bool(batch.tasks[members[0]].revocable_zone)
             ok = ~revocable[None, :] | (active[None, :] & task_rz[:, None])
             return ok
+        mask_fn.explain_label = "tdm"
         return mask_fn
 
     def _solver_score(self, ssn):

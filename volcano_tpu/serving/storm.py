@@ -11,11 +11,13 @@ shape), some kind-scoped, some unfiltered — with THREE fault layers on:
   content-keyed crc32 over the frame chain) — the client detects the
   broken frame chain and rewinds;
 * a mid-storm ``force_gap`` clearing the journal — every lagging cursor
-  must take the structured relist, not silently skip.
-
-(Cache-side FlakyWatch drops stay with the failover gate — see
-:func:`storm_config` for the rv-interleaving finding that keeps them
-out of this scenario.)
+  must take the structured relist, not silently skip;
+* cache-side FlakyWatch drops on the scheduler's own pod watch —
+  enabled at storm scale since the fault coin re-keyed from
+  resource_version to the commit-order-stable (key, per-key sequence)
+  identity (sim/faults.py; the PR 11 rv-interleaving finding that used
+  to confine these faults to the failover gate), with anti-entropy
+  every tick so each divergence is repaired before that tick's audit.
 
 A noisy tenant hammers the admission edge (writes past its token bucket,
 subscriptions past its cap) and must be throttled without starving the
@@ -51,17 +53,14 @@ def storm_config(seed: int = 43, ticks: int = 80, nodes: int = 192,
     the opening flushes are a genuine bind storm (~1.5k binds), Poisson
     arrivals, node flaps and bind failures.
 
-    Cache-side FlakyWatch drops are deliberately OFF here (the failover
-    gate covers them at its scale): bisecting a double-run divergence
-    showed that at THIS scale the journal's rv INTERLEAVING between the
-    executor's bind/status-writeback commits and other writers is
-    timing-dependent — bit-identical in every scheduling outcome (bind
-    and ledger fingerprints hold with drops off), but FlakyWatch's
-    content-keyed coin hashes the resource_version, so a reordered rv
-    flips which deliveries drop and the divergence becomes semantic.
-    The storm's watch faults instead live at the FRAME layer (the
-    hub→client transport), where the hub is a read-only journal
-    observer and cannot feed back into scheduling."""
+    Cache-side FlakyWatch drops run here too now: the fault coin was
+    re-keyed from resource_version to the commit-order-stable (object
+    key, per-key delivery sequence) identity (sim/faults.py), so the
+    journal's timing-dependent rv interleaving at storm scale — the
+    PR 11 finding that used to confine these faults to the failover
+    gate — can no longer flip which deliveries drop. Anti-entropy runs
+    every tick so each divergence is detected and repaired before that
+    tick's invariant audit, exactly the failover gate's discipline."""
     from ..sim.engine import SimConfig
     from ..sim.faults import FaultConfig
     from ..sim.workload import WorkloadConfig
@@ -74,8 +73,10 @@ def storm_config(seed: int = 43, ticks: int = 80, nodes: int = 192,
             duration_min_s=15.0, duration_max_s=60.0),
         faults=FaultConfig(
             seed=seed, bind_fail_rate=0.01, api_latency_s=0.001,
-            flap_rate=0.02, flap_down_s=6.0),
+            flap_rate=0.02, flap_down_s=6.0,
+            watch_drop_rate=0.02),
         fail_rate=0.02,
+        anti_entropy_every_ticks=1,
         repro_dir=".")
 
 

@@ -169,6 +169,21 @@ def add_sim_parser(sub) -> None:
     storm.add_argument("--drop-rate", type=float, default=0.03)
     storm.add_argument("--json", action="store_true")
 
+    exp = sim.add_parser(
+        "explain", help="CI gate (make explain-smoke): constrained churn "
+                        "+ a preemption storm with the placement "
+                        "explainer on — every placed gang must carry a "
+                        "provenance record whose elimination ladder sums "
+                        "to the node axis, victim decisions must be "
+                        "recorded, the explain fingerprint must be "
+                        "bit-identical across a double run, and the "
+                        "off-mode hook overhead must measure <2%%")
+    exp.add_argument("--seed", type=int, default=47)
+    exp.add_argument("--ticks", type=int, default=80)
+    exp.add_argument("--nodes", type=int, default=64)
+    exp.add_argument("--zones", type=int, default=4)
+    exp.add_argument("--json", action="store_true")
+
     rep = sim.add_parser("replay", help="re-run a violation repro bundle")
     rep.add_argument("--bundle", required=True)
     rep.add_argument("--use-trace", action="store_true",
@@ -430,6 +445,75 @@ def constraint_config(seed: int = 41, ticks: int = 160, nodes: int = 96,
         workload=constraint_scenario_workload(seed, ticks, queue="batch"),
         control_events=storms,
         repro_dir=".")
+
+
+def _explain_overhead_probe() -> float:
+    """The explain-smoke overhead leg: interleaved min-of-N steady
+    run_once cycles with the tracer+explain hook sites fully OFF vs ON
+    their production off-path (tracer enabled, ``explain.enable`` off —
+    the shipping default). Returns the measured overhead in percent;
+    mirrors tests/test_trace.py's tracer gate, extended over the
+    explain layer's off-mode residue (one cached bool per place)."""
+    import time as _time
+
+    from ..apiserver import ObjectStore
+    from ..cache import SchedulerCache
+    from ..scheduler import Scheduler
+    from ..trace import tracer
+    from ..utils.test_utils import (FakeBinder, FakeEvictor, build_node,
+                                    build_pod, build_pod_group,
+                                    build_queue)
+    from .engine import DEFAULT_CONF
+    store = ObjectStore()
+    cache = SchedulerCache(store, binder=FakeBinder(store),
+                           evictor=FakeEvictor(store))
+    cache.run()
+    sched = Scheduler(store, scheduler_conf=DEFAULT_CONF, cache=cache)
+    store.create("queues", build_queue("default", weight=1))
+    for i in range(16):
+        store.create("nodes", build_node(
+            f"n{i}", {"cpu": "8", "memory": "16Gi"}))
+    for j in range(8):
+        store.create("podgroups", build_pod_group(
+            f"pg-{j}", "default", "default", 3, phase="Inqueue"))
+        for t in range(3):
+            store.create("pods", build_pod(
+                "default", f"pg-{j}-{t}", "", "Pending",
+                {"cpu": "1", "memory": "1Gi"}, groupname=f"pg-{j}"))
+    trace_was_on = tracer.is_enabled()
+    try:
+        sched.run_once()
+        cache.flush_executors()
+        for _ in range(3):      # settle: binds echoed, nothing pending
+            sched.run_once()
+
+        def steady(n=12):
+            best = float("inf")
+            for _ in range(n):
+                t0 = _time.perf_counter()
+                sched.run_once()
+                best = min(best, _time.perf_counter() - t0)
+            return best
+
+        steady(3)               # warm both code paths
+        pct = float("inf")
+        for _ in range(3):      # flake shield vs co-tenant bursts
+            base = hooked = float("inf")
+            for _ in range(4):  # interleave to cancel machine drift
+                tracer.disable()
+                base = min(base, steady())
+                tracer.enable()
+                hooked = min(hooked, steady())
+            # the 0.3 ms epsilon is the timer floor at this tiny scale
+            pct = min(pct, (hooked - base - 3e-4) / base * 100.0)
+            if pct < 2.0:
+                break
+        return max(pct, 0.0)
+    finally:
+        if not trace_was_on:
+            tracer.disable()
+        sched.stop()
+        cache.stop()
 
 
 def _print_summary(summary: dict, as_json: bool) -> None:
@@ -894,6 +978,12 @@ def dispatch_sim(args) -> int:
             # detected and recovered client-side
             "faults_fired": v1["frames_dropped"] > 0
                             and v1["gaps_detected"] > 0,
+            # cache-side watch faults at storm scale (the PR 11 residue:
+            # the commit-order-stable fault coin makes them replayable
+            # here), diverging the scheduler's cache and repaired by
+            # anti-entropy before each tick's audit
+            "cache_watch_faults_fired": v1["watch_drops"] > 0
+                                        and v1["divergence_repairs"] > 0,
             # the mid-storm journal gap took the structured relist path
             "relist_taken": v1["relists"] >= 1,
             # the noisy tenant was throttled at the admission edge
@@ -907,7 +997,8 @@ def dispatch_sim(args) -> int:
                 v1["bind_fingerprint"] == v2["bind_fingerprint"]
                 and v1["ledger_fingerprint"] == v2["ledger_fingerprint"]
                 and v1["noisy_throttled_writes"]
-                == v2["noisy_throttled_writes"],
+                == v2["noisy_throttled_writes"]
+                and v1["watch_drops"] == v2["watch_drops"],
         }
         verdict = {
             "storm": v1["storm"],
@@ -939,6 +1030,84 @@ def dispatch_sim(args) -> int:
             for name, ok in checks.items():
                 print(f"  {name}: {'ok' if ok else 'FAIL'}")
             print(f"storm-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
+        return 0 if verdict["pass"] else 1
+
+    if args.verb == "explain":
+        from ..framework.solver import reset_breaker
+        from ..trace import explain as ex
+
+        def cfg():
+            return constraint_config(seed=args.seed, ticks=args.ticks,
+                                     nodes=args.nodes, zones=args.zones)
+
+        # overhead leg FIRST (explain must be off): min-of-N interleaved
+        # steady cycles, hooks-off vs hooks-on-switch-off
+        ex.disable()
+        overhead_pct = _explain_overhead_probe()
+        ex.enable()
+        try:
+            reset_breaker()
+            ex.reset()
+            r1 = run_sim(cfg())
+            rep1 = ex.report(limit=0)
+            fp1 = rep1["fingerprint"]
+            reset_breaker()
+            ex.reset()
+            r2 = run_sim(cfg())
+            fp2 = ex.fingerprint()
+        finally:
+            ex.disable()
+        bound_jobs = {f"{key.rsplit('-', 1)[0]}"
+                      for key, _host in r1.bind_sequence}
+        explained = set(rep1["jobs"])
+        missing = sorted(bound_jobs - explained)
+        bad_sums = []
+        for jkey, rec in rep1["jobs"].items():
+            for g in rec["groups"]:
+                if g["feasible"] + sum(g["eliminations"].values()) \
+                        != g["nodes"]:
+                    bad_sums.append((jkey, g["gang"]))
+        checks = {
+            "no_violations": not r1.violations and not r2.violations,
+            # every bound pod's job carries a provenance record
+            "every_bind_explained": not missing and bool(bound_jobs),
+            # the elimination ladder telescopes exactly to the node axis
+            "eliminations_sum_to_nodes": not bad_sums,
+            # the preemption storm's victim decisions were recorded
+            "victim_decisions_recorded": len(rep1["victims"]) > 0,
+            "evictions_happened": len(r1.evict_sequence) > 0,
+            # bit-identical provenance across a same-seed double run
+            "fingerprint_deterministic":
+                fp1 == fp2
+                and r1.bind_fingerprint() == r2.bind_fingerprint(),
+            # the off-mode hook residue on the steady cycle
+            "overhead_under_2pct": overhead_pct < 2.0,
+        }
+        verdict = {
+            "explain": r1.summary(),
+            "records": rep1["records"],
+            "victim_records": len(rep1["victims"]),
+            "aggregates": rep1["aggregates"],
+            "fingerprint": fp1,
+            "overhead_off_pct": round(overhead_pct, 3),
+            "missing_records": missing[:10],
+            "checks": checks,
+            "pass": all(checks.values()),
+        }
+        if args.json:
+            print(json.dumps(verdict, indent=1))
+        else:
+            _print_summary(r1.summary(), False)
+            agg = rep1["aggregates"]
+            print(f"records={rep1['records']} victim_records="
+                  f"{len(rep1['victims'])} feasible/gang="
+                  f"{agg['feasible_nodes']} coverage="
+                  f"{agg['topk_coverage']} frag="
+                  f"{agg['fragmentation_ratio']}")
+            print(f"off-mode overhead: {overhead_pct:.2f}%")
+            for name, ok in checks.items():
+                print(f"  {name}: {'ok' if ok else 'FAIL'}")
+            print(f"explain-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
         return 0 if verdict["pass"] else 1
 
     if args.verb == "replay":

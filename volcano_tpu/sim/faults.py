@@ -161,13 +161,22 @@ class FlakyWatch:
     it.
 
     Determinism: each delivery's fate comes from a crc32 coin over
-    ``(action, object key, resource_version, seed)`` — content-keyed, so
-    it is independent of thread timing and identical across double runs
-    (the same property the resync backoff jitter relies on). Bulk
-    deliveries are coined per pair. Delayed deliveries are re-played in
-    recorded order by :meth:`release_delayed` (the engine calls it at
-    the top of each tick); the production handlers treat them like any
-    stale event.
+    ``(action, object key, per-key delivery sequence, seed)`` —
+    commit-order-stable, so it is independent of thread timing AND of
+    journal rv interleaving, identical across double runs (the same
+    property the resync backoff jitter relies on). The coin was
+    originally keyed on ``resource_version``; PR 11 found that at storm
+    scale the journal's rv INTERLEAVING between the executor's
+    bind/status-writeback commits and other writers is timing-dependent
+    — every scheduling outcome stays bit-identical, but an rv-keyed
+    coin turns the reordering semantic. A key's own delivery ORDER is
+    commit order (writes to one object serialize), so the per-key
+    sequence is the stable identity — which is what lets cache-side
+    watch faults run under the storm gate too (serving/storm.py), not
+    just the failover one. Bulk deliveries are coined per pair. Delayed
+    deliveries are re-played in recorded order by
+    :meth:`release_delayed` (the engine calls it at the top of each
+    tick); the production handlers treat them like any stale event.
     """
 
     def __init__(self, seed: int = 0, drop_rate: float = 0.0,
@@ -180,14 +189,19 @@ class FlakyWatch:
         self._watch = None
         self._orig: dict = {}
         self._pending: List[tuple] = []
+        # per-object-key delivery counter: survives wrap/unwrap cycles
+        # (a restart re-wraps the new cache's watch mid-run; the commit
+        # order of a key's writes is global, so the counter is too)
+        self._key_seq: dict = {}
 
     # coin outcomes
     _DELIVER, _DROP, _DELAY = 0, 1, 2
 
     def _coin(self, action: str, o) -> int:
-        h = zlib.crc32(
-            f"{action}:{o.metadata.key()}:"
-            f"{o.metadata.resource_version}:{self.seed}".encode())
+        key = o.metadata.key()
+        seq = self._key_seq.get(key, 0) + 1
+        self._key_seq[key] = seq
+        h = zlib.crc32(f"{action}:{key}:{seq}:{self.seed}".encode())
         u = (h % 10_000) / 10_000.0
         if u < self.drop_rate:
             return self._DROP

@@ -63,6 +63,13 @@ def write_repro_bundle(base_dir: str, engine, tick: int,
     with open(os.path.join(path, "timeseries.json"), "w") as f:
         json.dump({"samples": timeseries.series(),
                    "latency": ledger.report()}, f, indent=1)
+    # placement decision provenance at violation time (the explain
+    # layer, docs/design/observability.md) — only when the explainer
+    # recorded anything, so legacy bundles stay byte-identical
+    from ..trace import explain
+    if explain.is_enabled():
+        with open(os.path.join(path, "explain.json"), "w") as f:
+            json.dump(explain.report(limit=0), f, indent=1)
     return path
 
 
