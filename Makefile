@@ -5,7 +5,7 @@ PYTHON ?= python
 .PHONY: test unit-test e2e bench bench-all bench-check multichip-dryrun \
 	deploy deploy-up trace-smoke sim-smoke flush-bench chaos-smoke \
 	failover-smoke obs-smoke incr-smoke multichip-smoke constraint-smoke \
-	storm-smoke explain-smoke lint sanitize
+	storm-smoke explain-smoke prune-smoke lint sanitize
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -190,6 +190,18 @@ storm-smoke: constraint-smoke
 # hook overhead measures <2% on the steady cycle.
 explain-smoke: storm-smoke
 	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli explain
+
+# candidate-pruning gate (docs/design/pruning.md), after explain-smoke:
+# seeded constrained churn (zoned topology, hard/soft spread gangs,
+# one-per-zone anti pairs) run three ways — pruned (prune.enable true
+# at k = the node count, the complete-shortlist exactness regime), a
+# pruned double run, and a dense-forced control. Exit 1 unless every
+# audited tick stayed invariant-clean in all three runs, the pruned
+# kernel provably served (and the control provably did not), zero
+# prune crash/guard fallbacks fired, and the bind AND lifecycle-ledger
+# fingerprints are bit-identical across all three runs.
+prune-smoke: explain-smoke
+	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli prune
 
 # multi-chip sharding dryrun on the virtual CPU mesh (the raw
 # shard_map program + full-pipeline one-shot; multichip-smoke is the

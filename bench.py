@@ -162,6 +162,18 @@ def cycle_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
         return {t: m.counter_total(m.SOLVER_KERNEL_RUNS, kernel=t)
                 for t in _TIERS}
 
+    from volcano_tpu.ops.prune import FALLBACK_REASONS as _PRUNE_REASONS
+
+    def prune_counts() -> dict:
+        # candidate pruning (docs/design/pruning.md): the 10x gate needs
+        # proof the shortlist kernel served the measured cycle, and the
+        # fallback reasons must ride the row
+        c = {"runs": m.counter_total(m.PRUNE_RUNS, level="single")
+             + m.counter_total(m.PRUNE_RUNS, level="two_level")}
+        for r in _PRUNE_REASONS:
+            c[r] = m.counter_total(m.PRUNE_FALLBACK, reason=r)
+        return c
+
     # the 10x shape: one cold + one measured env (populate alone is
     # minutes), mesh collective cadence widened for the sharded kernel
     big = n_tasks >= 200_000
@@ -201,9 +213,14 @@ configurations:
         w0 = hist_total(m.STATUS_WRITEBACK_LATENCY)
         p0 = hist_total(m.SNAPSHOT_PREBUILD_LATENCY)
         kr0 = kernel_runs()
+        pc0 = prune_counts()
         ms = _run_cycle(c2, cf2)
         rec = tracer.last_record()
         kernel_ms = kernel_total() - k0
+        pc1 = prune_counts()
+        prune_runs = pc1["runs"] - pc0["runs"]
+        prune_fallbacks = {r: pc1[r] - pc0[r] for r in _PRUNE_REASONS
+                           if pc1[r] > pc0[r]}
         t0 = time.perf_counter()
         flushed = c2.flush_executors(timeout=flush_to)
         # flush_wall_ms: the whole post-cycle executor drain (bind flush
@@ -254,6 +271,7 @@ configurations:
         c2.incremental = False
         log(f"warm {i + 1}/{runs}: cycle={ms:.1f} ms kernel={kernel_ms:.1f} "
             f"ms [{'/'.join(f'{t}:{int(n)}' for t, n in tiers.items())}] "
+            f"prune_runs={prune_runs:g} fallbacks={prune_fallbacks} "
             f"flush={flush_ms:.1f} ms (wall {flush_wall_ms:.1f} ms, "
             f"writeback {writeback_ms:.1f} ms, prebuild {prebuild_ms:.1f} "
             f"ms) steady={steady:.1f} ms "
@@ -276,6 +294,8 @@ configurations:
                     "incr_snapshot": snap_stats,
                     "binds": len(b2.binds),
                     "solver_kernels": tiers,
+                    "prune_runs": prune_runs,
+                    "prune_fallbacks": prune_fallbacks,
                     "platform": devs[0].platform,
                     "devices": len(devs)}
             best_rec = rec
@@ -394,9 +414,14 @@ def constraint_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
     and constraint-heavy (zoned nodes, hard-spread gangs, one-per-zone
     anti pairs), reporting the placement-kernel latency of each plus the
     constraint-compilation cost — the `make bench-check` gate holds the
-    constrained kernel to <= 1.5x the unconstrained one. Rides along: a
-    preempt victim-selection A/B (vmapped kernel vs the Python walk on
-    a vectorizable plugin chain) whose action wall times the gate
+    constrained kernel to <= 1.5x the unconstrained one. The
+    unconstrained/constrained control legs force `prune.enable: off`
+    (so kernel_unconstrained_ms keeps its r12 dense semantics), and a
+    THIRD leg re-runs the unconstrained populate with the
+    candidate-pruning regime forced on — ``kernel_pruned_ms``, gated
+    pruned <= dense by round 13 (docs/design/pruning.md). Rides along:
+    a preempt victim-selection A/B (vmapped kernel vs the Python walk
+    on a vectorizable plugin chain) whose action wall times the gate
     requires to favor the kernel."""
     if platform == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -410,20 +435,36 @@ def constraint_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
 
     hist_total = m.histogram_total
 
+    # dense control legs pin pruning OFF (the exact r12 kernel path);
+    # the pruned leg forces it on at the default shortlist width
+    conf_prune_off = CONF_FULL + """
+configurations:
+- name: solver
+  arguments:
+    prune.enable: "off"
+"""
+    conf_pruned = CONF_FULL + """
+configurations:
+- name: solver
+  arguments:
+    prune.enable: "true"
+"""
+
     gang = 8
     pop = dict(n_nodes=n_nodes, n_jobs=n_tasks // gang, gang=gang)
     heavy = dict(zones=8, spread_every=4, anti_every=8)
     out: dict = {"tasks": n_tasks, "nodes": n_nodes,
                  "platform": jax.devices()[0].platform}
 
-    def measure(tag: str, constraints: dict,
-                explain_on: bool = False) -> float:
+    def measure(tag: str, constraints: dict, explain_on: bool = False,
+                conf_text: str = conf_prune_off,
+                explain_suffix: str = "") -> float:
         # cold env compiles this variant's padded shapes (constraint
         # slot-splitting changes the group count, hence g_pad), then a
         # fresh identical env is the measured one
         from volcano_tpu.trace import explain as ex
         for phase in ("cold", "measured"):
-            store, cache, binder, conf = _cycle_env(CONF_FULL)
+            store, cache, binder, conf = _cycle_env(conf_text)
             _populate(store, **pop, **constraints)
             k0 = hist_total(m.SOLVER_KERNEL_LATENCY)
             b0 = hist_total(m.CONSTRAINT_BUILD_LATENCY)
@@ -432,7 +473,12 @@ def constraint_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
             # its aggregate capture happens AFTER the kernel-latency
             # window closes, so kernel_ms stays clean — and the row
             # gains the per-gang feasible-node / top-k score-coverage
-            # columns the candidate-pruning ROADMAP item budgets against
+            # columns the candidate-pruning loss guard budgets against.
+            # Round 13 runs the harvest on the CONSTRAINED leg too
+            # (``explain_suffix``): the uniform populate records
+            # feasible == N and coverage 1.0 at every k, so the loss
+            # budget must also be measured where a shortlist can
+            # actually lose something.
             harvest = explain_on and phase == "measured"
             if harvest:
                 ex.enable()
@@ -445,11 +491,14 @@ def constraint_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
                 agg = ex.aggregates()
                 ex.disable()
                 ex.reset()
-                out["explain_feasible_nodes"] = agg["feasible_nodes"]
-                out["explain_topk_coverage"] = agg["topk_coverage"]
-                out["fragmentation_ratio"] = agg["fragmentation_ratio"]
-                log(f"explain baseline: feasible/gang="
-                    f"{agg['feasible_nodes']} coverage="
+                out[f"explain_feasible_nodes{explain_suffix}"] = \
+                    agg["feasible_nodes"]
+                out[f"explain_topk_coverage{explain_suffix}"] = \
+                    agg["topk_coverage"]
+                if not explain_suffix:
+                    out["fragmentation_ratio"] = agg["fragmentation_ratio"]
+                log(f"explain baseline{explain_suffix or ' (uniform)'}: "
+                    f"feasible/gang={agg['feasible_nodes']} coverage="
                     f"{agg['topk_coverage']} frag="
                     f"{agg['fragmentation_ratio']}")
             cache.flush_executors(timeout=900)
@@ -458,12 +507,32 @@ def constraint_worker(platform: str, n_tasks: int, n_nodes: int) -> None:
         log(f"{tag}: kernel={kernel_ms:.1f} ms constraint_build="
             f"{build_ms:.1f} ms binds={binds}")
         out[f"kernel_{tag}_ms"] = round(kernel_ms, 2)
-        if constraints:
+        if constraints and tag == "constrained":
             out["constraint_build_ms"] = round(build_ms, 2)
         return kernel_ms
 
     measure("unconstrained", {}, explain_on=True)
-    measure("constrained", heavy)
+    measure("constrained", heavy, explain_on=True,
+            explain_suffix="_constrained")
+
+    # -- pruned-vs-dense kernel A/B (round 13, docs/design/pruning.md) ----
+    from volcano_tpu.ops.prune import FALLBACK_REASONS as reasons
+
+    def prune_counters():
+        c = {"runs": m.counter_total(m.PRUNE_RUNS, level="single")
+             + m.counter_total(m.PRUNE_RUNS, level="two_level")}
+        for r in reasons:
+            c[r] = m.counter_total(m.PRUNE_FALLBACK, reason=r)
+        return c
+
+    p0 = prune_counters()
+    measure("pruned", {}, conf_text=conf_pruned)
+    p1 = prune_counters()
+    out["kernel_pruned_runs"] = p1["runs"] - p0["runs"]
+    out["prune_fallbacks_canonical"] = {
+        r: p1[r] - p0[r] for r in reasons if p1[r] > p0[r]}
+    log(f"pruned leg: runs={out['kernel_pruned_runs']:g} "
+        f"fallbacks={out['prune_fallbacks_canonical']}")
 
     # -- victim-selection A/B (vmapped kernel vs Python walk) --------------
     conf_vec = """
@@ -701,7 +770,7 @@ def write_bench_row(row: dict) -> None:
     """Persist the headline row (BENCH_r12.json by default; override or
     disable with VOLCANO_BENCH_ROW_OUT) with a machine-calibration
     fingerprint so tools/bench_check.py can scale cross-box compares."""
-    out = os.environ.get("VOLCANO_BENCH_ROW_OUT", "BENCH_r12.json")
+    out = os.environ.get("VOLCANO_BENCH_ROW_OUT", "BENCH_r13.json")
     if not out:
         return
     try:
@@ -1140,6 +1209,12 @@ def main() -> None:
                 # which kernel tier served the measured cycle — the
                 # sharded-default auto-selection proof (BENCH_r09)
                 "solver_kernels": res.get("solver_kernels"),
+                # candidate pruning (round 13, docs/design/pruning.md):
+                # shortlist-kernel engagements + fallback reasons over
+                # the measured cycle — the 10x gate's "the reduced
+                # kernel actually served" proof
+                "prune_runs": res.get("prune_runs"),
+                "prune_fallbacks": res.get("prune_fallbacks"),
                 "devices": res.get("devices"),
                 "kernel_anchor_sharded_ms": res.get(
                     "kernel_anchor_sharded_ms"),
@@ -1180,7 +1255,18 @@ def main() -> None:
                           # canonical shape
                           "explain_feasible_nodes",
                           "explain_topk_coverage",
-                          "fragmentation_ratio"):
+                          "fragmentation_ratio",
+                          # round 13 (docs/design/pruning.md): the
+                          # pruned-vs-dense kernel A/B at the canonical
+                          # shape, its provably-ran counter + fallback
+                          # reasons, and the CONSTRAINED explain leg
+                          # (the de-degenerate loss budget: a uniform
+                          # fleet records feasible == N and coverage
+                          # 1.0 at every k)
+                          "kernel_pruned_ms", "kernel_pruned_runs",
+                          "prune_fallbacks_canonical",
+                          "explain_feasible_nodes_constrained",
+                          "explain_topk_coverage_constrained"):
                     if k in cres:
                         row[k] = cres[k]
             else:

@@ -1,8 +1,23 @@
 """Bench regression gate: compare a fresh bench row against a baseline.
 
-    python tools/bench_check.py                         # BENCH_r12 vs r11
-    python tools/bench_check.py --row BENCH_r12.json \
-        --baseline BENCH_r11.json --tolerance 0.35
+    python tools/bench_check.py                         # BENCH_r13 vs r12
+    python tools/bench_check.py --row BENCH_r13.json \
+        --baseline BENCH_r12.json --tolerance 0.35
+
+Round 13 adds the candidate-pruning columns (docs/design/pruning.md),
+required on every fresh row: the pruned-vs-dense kernel A/B at the
+canonical 50k x 10k shape (``kernel_pruned_ms`` gated <= the
+same-capture dense ``kernel_unconstrained_ms``, with
+``kernel_pruned_runs`` proving the shortlist kernel actually served and
+``prune_fallbacks_canonical`` carrying zero crash fallbacks), and the
+CONSTRAINED explain leg (``explain_feasible_nodes_constrained`` /
+``explain_topk_coverage_constrained`` — the de-degenerate loss budget:
+the uniform populate records feasible == N and coverage 1.0 at every
+k, so the constrained mean feasible count must come in BELOW the
+uniform one). At the 10x shape the gate additionally requires the
+pruned kernel to have served the measured cycle (``prune_runs``) and
+budgets ``kernel_ms`` at <= 10x the same-capture 50k x 10k sharded
+anchor — the kernel-scale-wall target (r12 measured x88.7 dense).
 
 Round 12 adds the pruning-readiness columns (required on every fresh
 row): the placement explainer runs over the canonical 50k x 10k
@@ -132,6 +147,11 @@ METRIC_1X = "schedule_cycle_latency_50k_tasks_x_10k_nodes"
 # shape product off the same-capture 50k x 10k sharded anchor
 SHAPE_SCALE_10X = 50.0
 KERNEL_10X_TOLERANCE = 0.35
+# the candidate-pruning budget (round 13, docs/design/pruning.md): the
+# 10x kernel must land within 10x the same-capture 50k x 10k sharded
+# anchor — shrink-the-problem scaling instead of the dense
+# tasks-x-nodes product (r12 measured the dense kernel at x88.7)
+SHAPE_SCALE_PRUNED = 10.0
 # the incremental steady state is O(dirty) with small O(jobs) session
 # edges, not O(tasks x nodes); measured r09 = 330 ms at 10x vs 34 ms at
 # 1x — linear in the job axis as modeled — so the ceiling is the
@@ -258,6 +278,75 @@ def check_explain(fresh: dict, failures: list) -> None:
         failures.append(f"fragmentation_ratio {frag} outside [0, 1]")
     else:
         print(f"  {'fragmentation ratio':<24} {float(frag):9.4f} ok")
+
+
+def check_prune(fresh: dict, failures: list) -> None:
+    """The round-13 candidate-pruning columns (docs/design/pruning.md):
+    the pruned-vs-dense kernel A/B at the canonical shape and the
+    constrained explain leg, required on every fresh row."""
+    pruned = fresh.get("kernel_pruned_ms")
+    runs = fresh.get("kernel_pruned_runs")
+    fbs = fresh.get("prune_fallbacks_canonical")
+    missing = [k for k, v in (("kernel_pruned_ms", pruned),
+                              ("kernel_pruned_runs", runs),
+                              ("prune_fallbacks_canonical", fbs))
+               if v is None]
+    if missing:
+        failures.append(
+            f"pruning columns missing: {', '.join(missing)} — the "
+            "round-13 pruned kernel leg did not run (re-run `python "
+            "bench.py`)")
+        return
+    dense = fresh.get("kernel_unconstrained_ms")
+    if not runs:
+        failures.append("kernel_pruned_runs is 0 — the shortlist kernel "
+                        "never served the pruned leg (it fell back to "
+                        f"full width: {fbs!r})")
+    if fbs.get("crash"):
+        failures.append(f"prune crash fallbacks fired on the canonical "
+                        f"leg: {fbs!r}")
+    if dense and pruned:
+        verdict = "ok" if float(pruned) <= float(dense) else "REGRESSION"
+        print(f"  {'pruned kernel ms':<24} {float(pruned):9.1f} vs dense "
+              f"{float(dense):9.1f} (pruned <= dense) {verdict}")
+        if verdict != "ok":
+            failures.append(
+                f"pruned kernel: {pruned:.1f} ms > the {dense:.1f} ms "
+                "dense kernel of the same capture — the shortlist "
+                "distillation is costing more than it saves at the "
+                "canonical shape")
+    # the constrained explain leg: the loss budget measured where a
+    # shortlist can actually lose something (the uniform populate is
+    # degenerate: feasible == N, coverage 1.0 at every k)
+    feas_c = fresh.get("explain_feasible_nodes_constrained")
+    cov_c = fresh.get("explain_topk_coverage_constrained")
+    missing = [k for k, v in
+               (("explain_feasible_nodes_constrained", feas_c),
+                ("explain_topk_coverage_constrained", cov_c))
+               if v is None]
+    if missing:
+        failures.append(
+            f"constrained explain columns missing: {', '.join(missing)} "
+            "— the round-13 constrained explain leg did not run")
+        return
+    if not (isinstance(feas_c, dict) and feas_c.get("count")):
+        failures.append("explain_feasible_nodes_constrained is empty")
+        return
+    print(f"  {'feasible/gang (constr)':<24} p50={feas_c.get('p50')} "
+          f"mean={feas_c.get('mean')} (n={feas_c.get('count')}) ok")
+    bad = [k for k, v in (cov_c or {}).items()
+           if not (0.0 <= float(v) <= 1.0 + 1e-6)]
+    if bad:
+        failures.append("explain_topk_coverage_constrained out of "
+                        f"[0, 1] for k in {bad}: {cov_c}")
+    feas_u = fresh.get("explain_feasible_nodes") or {}
+    if feas_u.get("mean") is not None \
+            and float(feas_c["mean"]) >= float(feas_u["mean"]):
+        failures.append(
+            f"constrained mean feasible/gang ({feas_c['mean']}) is not "
+            f"below the uniform leg's ({feas_u['mean']}) — the "
+            "constrained populate went degenerate and the shortlist-"
+            "loss budget is measuring nothing")
 
 
 def check_serving(fresh: dict, failures: list) -> None:
@@ -406,6 +495,7 @@ def check(fresh: dict, baseline: dict, tolerance: float,
     check_constraints(fresh, failures)
     check_serving(fresh, failures)
     check_explain(fresh, failures)
+    check_prune(fresh, failures)
     if failures:
         print("bench-check: FAIL")
         for fmsg in failures:
@@ -467,17 +557,38 @@ def check_10x(fresh: dict, tolerance: float, fresh_cal: float,
                 failures.append(
                     f"{label}: {cur:.1f} ms > {budget:.1f} ms budget "
                     f"({base:.1f} x{scale:.2f} +{tol:.0%})")
-    # the sharded tier must have served the measured cycle — the whole
-    # point of the row ("sharded kernel as the auto-selected default")
+    # which kernel served the measured cycle: round 13's pruned regime
+    # shrinks the node axis below the mesh floor, so the reduced
+    # problem legitimately runs a single-device tier — the proof is
+    # then prune_runs (the shortlist kernel served) + a nonempty tier
+    # set; an UNPRUNED 10x row still must prove the sharded default
     tiers = fresh.get("solver_kernels") or {}
-    if not tiers.get("sharded"):
+    prune_runs = fresh.get("prune_runs") or 0
+    prune_fbs = fresh.get("prune_fallbacks")
+    if prune_runs and tiers:
+        print(f"  solver kernel            pruned "
+              f"(runs={prune_runs:g}, tiers={tiers}, "
+              f"devices={fresh.get('devices')}) ok")
+    elif not tiers.get("sharded"):
         failures.append(f"solver_kernels {tiers!r} does not show the "
                         "sharded tier serving the measured cycle — the "
-                        "mesh was not auto-selected")
+                        "mesh was not auto-selected (and the pruned "
+                        "kernel did not serve either)")
     else:
         print(f"  solver kernel            sharded "
               f"(runs={int(tiers['sharded'])}, "
               f"devices={fresh.get('devices')}) ok")
+    # round 13: the 10x cycle must be served by the pruned kernel with
+    # no crash fallbacks (guard fallbacks would show up as prune_runs 0
+    # on a single-place cycle, failing the budget below anyway)
+    if not prune_runs:
+        failures.append(
+            "prune_runs is 0/missing — round 13 requires the candidate-"
+            f"pruning kernel to serve the 10x cycle (fallbacks: "
+            f"{prune_fbs!r})")
+    elif isinstance(prune_fbs, dict) and prune_fbs.get("crash"):
+        failures.append(f"prune crash fallbacks fired on the 10x cycle: "
+                        f"{prune_fbs!r}")
     # kernel: task-linear off the same-capture sharded anchor. With a
     # SAME-SHAPE 10x baseline the relative key-for-key compare above is
     # the regression signal and the anchor ratio is telemetry (the
@@ -496,6 +607,22 @@ def check_10x(fresh: dict, tolerance: float, fresh_cal: float,
                         "`python bench.py`)")
     elif not kernel:
         failures.append("kernel_ms missing from the fresh row")
+    elif prune_runs:
+        # round 13 (docs/design/pruning.md): the kernel-scale-wall
+        # budget — the PRUNED 10x kernel must land within 10x the
+        # same-capture dense sharded anchor (shrink-the-problem
+        # scaling; the dense kernel measured x88.7 in r12)
+        tol = max(float(tolerance), KERNEL_10X_TOLERANCE)
+        budget = float(anchor) * SHAPE_SCALE_PRUNED * (1.0 + tol)
+        verdict = "ok" if float(kernel) <= budget else "REGRESSION"
+        print(f"  {'kernel ms (10x pruned)':<24} {float(kernel):9.1f} vs "
+              f"budget {budget:9.1f} (anchor {float(anchor):.1f} x"
+              f"{SHAPE_SCALE_PRUNED:.0f} +{tol:.0%}) {verdict}")
+        if verdict != "ok":
+            failures.append(
+                f"pruned kernel: {kernel:.1f} ms > {budget:.1f} ms "
+                f"(the <=10x-anchor kernel-scale-wall budget off the "
+                f"{anchor:.1f} ms sharded anchor)")
     elif same_shape:
         print(f"  {'kernel vs anchor':<24} {float(kernel):9.1f} = "
               f"x{float(kernel) / float(anchor):.1f} the "
@@ -591,6 +718,7 @@ def check_10x(fresh: dict, tolerance: float, fresh_cal: float,
     check_constraints(fresh, failures)
     check_serving(fresh, failures)
     check_explain(fresh, failures)
+    check_prune(fresh, failures)
     if failures:
         print("bench-check: FAIL")
         for fmsg in failures:
@@ -602,10 +730,10 @@ def check_10x(fresh: dict, tolerance: float, fresh_cal: float,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--row", default=os.path.join(REPO, "BENCH_r12.json"),
+    ap.add_argument("--row", default=os.path.join(REPO, "BENCH_r13.json"),
                     help="fresh bench row (bench.py writes it)")
     ap.add_argument("--baseline",
-                    default=os.path.join(REPO, "BENCH_r11.json"))
+                    default=os.path.join(REPO, "BENCH_r12.json"))
     ap.add_argument("--tolerance", type=float, default=0.35,
                     help="allowed fractional slowdown after calibration "
                          "scaling (shared-box noise is ±15-25%%)")
@@ -621,7 +749,7 @@ def main(argv=None) -> int:
         fresh = load_row(args.row)
     except OSError as e:
         print(f"bench-check: cannot read fresh row {args.row}: {e}\n"
-              f"run `python bench.py` first (it writes BENCH_r12.json)")
+              f"run `python bench.py` first (it writes BENCH_r13.json)")
         return 2
     try:
         baseline = load_row(args.baseline)

@@ -341,8 +341,28 @@ class BatchSolver:
                     "sampling.percentage", 0.0)
                 self.sampling_min = solver_args.get_int(
                     "sampling.minNodes", 100)
+        # candidate pruning + two-level hierarchical placement
+        # (ops/prune.py, docs/design/pruning.md): per-gang top-k node
+        # shortlists distilled from the compiled [G, N] mask/score
+        # tensors shrink the kernel's node axis to the shortlist union;
+        # `prune.enable: off` restores the exact unpruned path.
+        #   configurations:
+        #   - name: solver
+        #     arguments: {prune.enable: "auto"|"true"|"off",
+        #                 prune.k: 64, prune.coverage_floor: 0.9,
+        #                 prune.min_nodes: 4096, prune.partitions: 2,
+        #                 prune.max_union_frac: 0.6,
+        #                 prune.demand_aware: "on"}
+        from ..ops.prune import PruneConf
+        self.prune = PruneConf.from_args(solver_args)
+        if not self.prune.off:
+            # the operator-chosen shortlist width must always be one of
+            # the recorded coverage widths (the loss-budget surface)
+            _explain.register_prune_k(self.prune.k)
+        self.mesh_forced = False
         if mesh_mode in ("true", "1", "yes", "on"):
             self.mesh = self._build_mesh(mesh_devices)
+            self.mesh_forced = self.mesh is not None
         elif mesh_mode not in ("false", "0", "no", "off"):
             # auto (the production default): shard whenever >1 device is
             # visible and the node axis clears the floor — but an
@@ -352,6 +372,8 @@ class BatchSolver:
                     and len(ssn.node_list) >= self.mesh_min_nodes:
                 self.mesh = self._build_mesh(mesh_devices)
         self._sampled_names: Optional[List[str]] = None
+        self._mask_contributed = False
+        self._prune_dedupe_ok = False
 
     def _build_mesh(self, n_dev: int = 0):
         """The cached device mesh, or None when <2 devices are visible
@@ -695,8 +717,17 @@ class BatchSolver:
         device reduce), so the [G, N] intermediates keep their normal
         XLA lifetime instead of being pinned until the post-place
         capture (a 5-stage constrained ladder at 50k x 10k would
-        otherwise hold multiple ~500 MB masks live at once)."""
+        otherwise hold multiple ~500 MB masks live at once).
+
+        Side channel: ``self._mask_contributed`` records whether ANY
+        stage beyond the capability fit contributed — when none did,
+        every group's mask row is a pure function of its request row,
+        which is the exact-dedupe license the shortlist distillation
+        uses (ops/prune.py)."""
+        contributed = [False]
+
         def cap(label, g):
+            contributed[0] = True
             if stages is not None:
                 stages.append((label, g.sum(axis=1)))
             return g
@@ -730,6 +761,7 @@ class BatchSolver:
                 contrib = xp.asarray(contrib)
                 static_score = contrib if static_score is None \
                     else static_score + contrib
+        self._mask_contributed = contributed[0]
         return gmask, static_score
 
     def _build_context(self, ordered_jobs: List[Tuple[JobInfo, List[TaskInfo]]],
@@ -764,6 +796,12 @@ class BatchSolver:
         gmask, static_score = self._apply_masks_and_scores(
             gmask, batch, narr, feats, jnp, stages=stages)
         self._explain_stages = stages
+        # the shortlist distillation's exact-dedupe license
+        # (ops/prune.py): no mask contributions beyond the capability
+        # fit AND no static score contributions means identical request
+        # rows have identical mask/score rows by construction
+        self._prune_dedupe_ok = not self._mask_contributed \
+            and static_score is None
         if static_score is None:
             # no static contributions (the common conf): a [G, N] zeros is
             # ~256 MB at 50k x 10k and allocating one per context build
@@ -929,8 +967,6 @@ class BatchSolver:
                 pack_bonus[batch.task_group[t_idx]] = bonus
 
         from ..metrics import metrics as m
-        from ..ops import kernel_span
-        from ..ops.allocate import gang_allocate_chunked
 
         # tier ladder + circuit breaker (resilience.md): the selected
         # kernel first, then chunked, then the plain scan as last resort;
@@ -957,143 +993,24 @@ class BatchSolver:
         if batch.task_slot is not None:
             slot_kwargs = {"task_slot": jnp.asarray(batch.task_slot),
                            "slot_ok": jnp.asarray(batch.slot_rows)}
-        if self.mesh is not None:
-            ladder = [("sharded", None, {})]
-        else:
-            kernel_fn, kernel_kwargs = self._select_kernel(
-                len(batch.ns_names))
-            if slot_kwargs and kernel_fn.__name__ == "gang_allocate_pallas":
-                # the Pallas TPU kernel has no slot inputs (yet): a
-                # constrained batch runs the chunked XLA kernel instead
-                _log_once("solver kernel=pallas with per-task constraint "
-                          "slots; running the chunked kernel for this "
-                          "batch")
-                from ..ops.allocate import \
-                    gang_allocate_chunked as _chunked
-                kernel_fn, kernel_kwargs = _chunked, {}
-            ladder = [(_TIER_OF_KERNEL.get(kernel_fn.__name__, "scan"),
-                       kernel_fn, kernel_kwargs)]
-        if ladder[0][0] != "scan":
-            if ladder[0][0] != "chunked":
-                ladder.append(("chunked", gang_allocate_chunked, {}))
-            ladder.append(("scan", gang_allocate, {}))
-        ladder_names = {t[0] for t in ladder}
-        # a breaker whose window expired but whose tier is no longer
-        # selected at all (kernel selection moved on) will never get a
-        # half-open probe: retire it so the open-gauge doesn't stick
-        for tname in [k for k, until in _breaker_open_until.items()
-                      if _place_counter >= until
-                      and k not in ladder_names]:
-            del _breaker_open_until[tname]
-            m.set_gauge(m.SOLVER_BREAKER_OPEN, 0.0, kernel=tname)
-        eligible = [t for t in ladder
-                    if _place_counter >= _breaker_open_until.get(t[0], 0)]
-        if not eligible:
-            eligible = ladder[-1:]   # every tier open: still try the last
-
-        kernel_inputs = None
-        account_transfer = False
+        # candidate pruning (ops/prune.py, docs/design/pruning.md): the
+        # shortlist distillation, reduced-width kernel run, and the loss
+        # guard's full-width fallback all land inside the kernel-latency
+        # window — the bench's kernel_ms must price the whole placement
+        # decision, pruned or not
         t_kernel = time.perf_counter()
-        for i, (tier, kfn, kkwargs) in enumerate(eligible):
-            span_name = "sharded" if tier == "sharded" else kfn.__name__
-            try:
-                with kernel_span(span_name, g_pad=int(batch.g_pad),
-                                 n_pad=int(narr.idle.shape[0]),
-                                 t_pad=int(batch.task_group.shape[0])):
-                    if tier == "sharded":
-                        assign, pipelined, ready, kept = self._run_sharded(
-                            batch, narr, gmask, static_score, task_bucket,
-                            pack_bonus, q_deserved, q_alloc0, ns_weight,
-                            ns_alloc0, ns_total, ns_live, eps,
-                            allow_pipeline, slot_kwargs=slot_kwargs)
-                    else:
-                        if kernel_inputs is None:
-                            account_transfer = True
-                            # per-tier sub-phase attribution: the input
-                            # tensor assembly and the host->device node
-                            # staging get their own spans (compile vs
-                            # execute is the kernel span's `compiled`
-                            # tag, ops/kernel_span)
-                            with trace.span("tensor_build"):
-                                with trace.span("transfer"):
-                                    dev_nodes, node_xfer = \
-                                        self._device_node_inputs(narr)
-                                kernel_inputs = (
-                                    jnp.asarray(batch.task_group),
-                                    jnp.asarray(batch.task_job),
-                                    jnp.asarray(batch.task_valid),
-                                    jnp.asarray(batch.group_req),
-                                    gmask, static_score,
-                                    jnp.asarray(task_bucket),
-                                    jnp.asarray(pack_bonus),
-                                    jnp.asarray(batch.job_min_available),
-                                    jnp.asarray(batch.job_ready_base),
-                                    jnp.asarray(batch.job_task_start),
-                                    jnp.asarray(batch.job_n_tasks),
-                                    jnp.asarray(batch.job_queue),
-                                    jnp.asarray(batch.pool_queue),
-                                    jnp.asarray(batch.pool_ns),
-                                    jnp.asarray(batch.pool_job_start),
-                                    jnp.asarray(batch.pool_njobs),
-                                    jnp.asarray(ns_weight),
-                                    jnp.asarray(ns_alloc0),
-                                    jnp.asarray(ns_total),
-                                    jnp.asarray(q_deserved),
-                                    jnp.asarray(q_alloc0),
-                                    dev_nodes["idle"],
-                                    dev_nodes["future_idle"],
-                                    dev_nodes["allocatable"],
-                                    dev_nodes["n_tasks"],
-                                    dev_nodes["max_tasks"], eps,
-                                    self.score_weights())
-                        if account_transfer:
-                            # host->device staging bytes for this place
-                            # (gmask/static_score at indices 4-5 are
-                            # device-born — products of the context
-                            # build — and the node tensors at 22-26 may
-                            # be persistent device buffers whose real
-                            # transfer node_xfer already measured as the
-                            # scattered dirty rows)
-                            account_transfer = False
-                            xfer = node_xfer + sum(
-                                int(getattr(a, "nbytes", 0))
-                                for i, a in enumerate(kernel_inputs)
-                                if i not in (4, 5, 22, 23, 24, 25, 26))
-                            xfer += sum(int(getattr(a, "nbytes", 0))
-                                        for a in slot_kwargs.values())
-                            m.inc(m.DEVICE_TRANSFER_BYTES, float(xfer))
-                            trace.add_tags(transfer_bytes=xfer)
-                        with trace.span("execute"):
-                            assign, pipelined, ready, kept, _ = kfn(
-                                *kernel_inputs,
-                                allow_pipeline=allow_pipeline,
-                                ns_live=ns_live, **slot_kwargs, **kkwargs)
-                            # blocks until the device finishes (a
-                            # deferred kernel crash surfaces here,
-                            # inside the tier's try)
-                            assign = np.asarray(assign)
-            except Exception:
-                if i + 1 >= len(eligible):
-                    raise   # last resort crashed too: fail the cycle
-                nxt = eligible[i + 1][0]
-                _breaker_open_until[tier] = \
-                    _place_counter + self.breaker_window
-                m.inc(m.SOLVER_FALLBACK, **{"from": tier, "to": nxt})
-                m.set_gauge(m.SOLVER_BREAKER_OPEN, 1.0, kernel=tier)
-                _logger.exception(
-                    "solver kernel %r crashed; falling back to %r for "
-                    "this cycle (breaker open for the next %d placements)",
-                    tier, nxt, self.breaker_window)
-                continue
-            if tier in _breaker_open_until:
-                # half-open probe succeeded: close the breaker
-                del _breaker_open_until[tier]
-                m.set_gauge(m.SOLVER_BREAKER_OPEN, 0.0, kernel=tier)
-                _logger.warning(
-                    "solver kernel %r recovered; breaker closed", tier)
-            m.inc(m.SOLVER_KERNEL_RUNS, kernel=tier)
-            served_tier = tier
-            break
+        out = None
+        if self.prune.active(n_real_nodes):
+            out = self._place_pruned(
+                batch, narr, gmask, static_score, task_bucket, pack_bonus,
+                q_deserved, q_alloc0, ns_weight, ns_alloc0, ns_total,
+                ns_live, eps, allow_pipeline, slot_kwargs)
+        if out is None:
+            out = self._execute_ladder(
+                batch, narr, gmask, static_score, task_bucket, pack_bonus,
+                q_deserved, q_alloc0, ns_weight, ns_alloc0, ns_total,
+                ns_live, eps, allow_pipeline, slot_kwargs)
+        assign, pipelined, ready, kept, served_tier = out
         m.observe(m.SOLVER_KERNEL_LATENCY,
                   (time.perf_counter() - t_kernel) * 1000.0)
         pipelined_np = np.asarray(pipelined)
@@ -1206,6 +1123,309 @@ class BatchSolver:
                         "(placements unaffected)")
         return result
 
+    def _execute_ladder(self, batch, narr, gmask, static_score, task_bucket,
+                        pack_bonus, q_deserved, q_alloc0, ns_weight,
+                        ns_alloc0, ns_total, ns_live, eps, allow_pipeline,
+                        slot_kwargs, reduced=None):
+        """The tier ladder + circuit breaker over one set of kernel
+        inputs: the selected kernel first, then chunked, then the plain
+        scan as last resort; breaker-open tiers are skipped until their
+        half-open window (resilience.md).
+
+        ``reduced`` (an ops/prune.PruneContext) runs the SAME ladder on
+        the shortlist-union problem: the [G, N] mask/score tensors,
+        slot rows and node state are gathered down to the union columns
+        (sorted ascending, so the kernels' lowest-global-index
+        tie-break maps 1:1 back to node order) and the returned assign
+        indexes the REDUCED axis — the caller maps it back through the
+        union. The sharded tier composes: a forced mesh (or a union
+        still above the mesh floor) runs the reduced problem through
+        shard_map over a fresh equal-width plan, and a crashing tier
+        falls to the next one with the same reduced inputs.
+
+        Returns (assign [T] np, pipelined, ready, kept, served_tier)."""
+        from ..metrics import metrics as m
+        from ..ops import kernel_span
+        from ..ops.allocate import gang_allocate_chunked
+
+        reduced_host = None
+        reduced_plan = None
+        if reduced is not None:
+            gmask, static_score, slot_kwargs, reduced_host = \
+                self._reduced_inputs(batch, narr, gmask, static_score,
+                                     reduced)
+            n_axis = reduced.u_pad
+            # the reduced problem re-shards only when the operator
+            # FORCED the mesh: level 1 already did the partition work
+            # at distillation, and re-paying the per-step collective
+            # sync over a pruned axis is pure loss on the auto path
+            # (the 10x CPU emulation measured the dense sharded kernel
+            # at 624 s where the reduced single-device native kernel
+            # clears the same placements in seconds)
+            use_mesh = self.mesh is not None and self.mesh_forced
+            if use_mesh:
+                from ..ops.sharded import build_shard_plan
+                reduced_plan = build_shard_plan(
+                    n_axis, self.mesh.devices.size,
+                    pressure=reduced_host["n_tasks"])
+        else:
+            n_axis = int(narr.idle.shape[0])
+            use_mesh = self.mesh is not None
+
+        if use_mesh:
+            ladder = [("sharded", None, {})]
+        else:
+            kernel_fn, kernel_kwargs = self._select_kernel(
+                len(batch.ns_names))
+            if slot_kwargs and kernel_fn.__name__ == "gang_allocate_pallas":
+                # the Pallas TPU kernel has no slot inputs (yet): a
+                # constrained batch runs the chunked XLA kernel instead
+                _log_once("solver kernel=pallas with per-task constraint "
+                          "slots; running the chunked kernel for this "
+                          "batch")
+                kernel_fn, kernel_kwargs = gang_allocate_chunked, {}
+            ladder = [(_TIER_OF_KERNEL.get(kernel_fn.__name__, "scan"),
+                       kernel_fn, kernel_kwargs)]
+        if ladder[0][0] != "scan":
+            if ladder[0][0] != "chunked":
+                ladder.append(("chunked", gang_allocate_chunked, {}))
+            ladder.append(("scan", gang_allocate, {}))
+        ladder_names = {t[0] for t in ladder}
+        # a breaker whose window expired but whose tier is no longer
+        # selected at all (kernel selection moved on) will never get a
+        # half-open probe: retire it so the open-gauge doesn't stick
+        for tname in [k for k, until in _breaker_open_until.items()
+                      if _place_counter >= until
+                      and k not in ladder_names]:
+            del _breaker_open_until[tname]
+            m.set_gauge(m.SOLVER_BREAKER_OPEN, 0.0, kernel=tname)
+        eligible = [t for t in ladder
+                    if _place_counter >= _breaker_open_until.get(t[0], 0)]
+        if not eligible:
+            eligible = ladder[-1:]   # every tier open: still try the last
+
+        kernel_inputs = None
+        account_transfer = False
+        for i, (tier, kfn, kkwargs) in enumerate(eligible):
+            span_name = "sharded" if tier == "sharded" else kfn.__name__
+            try:
+                with kernel_span(span_name, g_pad=int(batch.g_pad),
+                                 n_pad=n_axis,
+                                 t_pad=int(batch.task_group.shape[0]),
+                                 pruned=reduced is not None):
+                    if tier == "sharded":
+                        assign, pipelined, ready, kept = self._run_sharded(
+                            batch, narr, gmask, static_score, task_bucket,
+                            pack_bonus, q_deserved, q_alloc0, ns_weight,
+                            ns_alloc0, ns_total, ns_live, eps,
+                            allow_pipeline, slot_kwargs=slot_kwargs,
+                            plan=reduced_plan, node_host=reduced_host)
+                    else:
+                        if kernel_inputs is None:
+                            account_transfer = True
+                            # per-tier sub-phase attribution: the input
+                            # tensor assembly and the host->device node
+                            # staging get their own spans (compile vs
+                            # execute is the kernel span's `compiled`
+                            # tag, ops/kernel_span)
+                            with trace.span("tensor_build"):
+                                with trace.span("transfer"):
+                                    if reduced_host is not None:
+                                        # the reduced union rows: a tiny
+                                        # fresh upload beats touching
+                                        # the full persistent buffers
+                                        dev_nodes = {
+                                            f: jnp.asarray(a) for f, a
+                                            in reduced_host.items()}
+                                        node_xfer = sum(
+                                            int(a.nbytes) for a
+                                            in reduced_host.values())
+                                    else:
+                                        dev_nodes, node_xfer = \
+                                            self._device_node_inputs(narr)
+                                kernel_inputs = (
+                                    jnp.asarray(batch.task_group),
+                                    jnp.asarray(batch.task_job),
+                                    jnp.asarray(batch.task_valid),
+                                    jnp.asarray(batch.group_req),
+                                    gmask, static_score,
+                                    jnp.asarray(task_bucket),
+                                    jnp.asarray(pack_bonus),
+                                    jnp.asarray(batch.job_min_available),
+                                    jnp.asarray(batch.job_ready_base),
+                                    jnp.asarray(batch.job_task_start),
+                                    jnp.asarray(batch.job_n_tasks),
+                                    jnp.asarray(batch.job_queue),
+                                    jnp.asarray(batch.pool_queue),
+                                    jnp.asarray(batch.pool_ns),
+                                    jnp.asarray(batch.pool_job_start),
+                                    jnp.asarray(batch.pool_njobs),
+                                    jnp.asarray(ns_weight),
+                                    jnp.asarray(ns_alloc0),
+                                    jnp.asarray(ns_total),
+                                    jnp.asarray(q_deserved),
+                                    jnp.asarray(q_alloc0),
+                                    dev_nodes["idle"],
+                                    dev_nodes["future_idle"],
+                                    dev_nodes["allocatable"],
+                                    dev_nodes["n_tasks"],
+                                    dev_nodes["max_tasks"], eps,
+                                    self.score_weights())
+                        if account_transfer:
+                            # host->device staging bytes for this place
+                            # (gmask/static_score at indices 4-5 are
+                            # device-born — products of the context
+                            # build — and the node tensors at 22-26 may
+                            # be persistent device buffers whose real
+                            # transfer node_xfer already measured as the
+                            # scattered dirty rows)
+                            account_transfer = False
+                            xfer = node_xfer + sum(
+                                int(getattr(a, "nbytes", 0))
+                                for i, a in enumerate(kernel_inputs)
+                                if i not in (4, 5, 22, 23, 24, 25, 26))
+                            xfer += sum(int(getattr(a, "nbytes", 0))
+                                        for a in slot_kwargs.values())
+                            m.inc(m.DEVICE_TRANSFER_BYTES, float(xfer))
+                            trace.add_tags(transfer_bytes=xfer)
+                        with trace.span("execute"):
+                            assign, pipelined, ready, kept, _ = kfn(
+                                *kernel_inputs,
+                                allow_pipeline=allow_pipeline,
+                                ns_live=ns_live, **slot_kwargs, **kkwargs)
+                            # blocks until the device finishes (a
+                            # deferred kernel crash surfaces here,
+                            # inside the tier's try)
+                            assign = np.asarray(assign)
+            except Exception:
+                if i + 1 >= len(eligible):
+                    raise   # last resort crashed too: fail the cycle
+                nxt = eligible[i + 1][0]
+                _breaker_open_until[tier] = \
+                    _place_counter + self.breaker_window
+                m.inc(m.SOLVER_FALLBACK, **{"from": tier, "to": nxt})
+                m.set_gauge(m.SOLVER_BREAKER_OPEN, 1.0, kernel=tier)
+                _logger.exception(
+                    "solver kernel %r crashed; falling back to %r for "
+                    "this cycle (breaker open for the next %d placements)",
+                    tier, nxt, self.breaker_window)
+                continue
+            if tier in _breaker_open_until:
+                # half-open probe succeeded: close the breaker
+                del _breaker_open_until[tier]
+                m.set_gauge(m.SOLVER_BREAKER_OPEN, 0.0, kernel=tier)
+                _logger.warning(
+                    "solver kernel %r recovered; breaker closed", tier)
+            m.inc(m.SOLVER_KERNEL_RUNS, kernel=tier)
+            return np.asarray(assign), pipelined, ready, kept, tier
+
+    def _reduced_inputs(self, batch, narr, gmask, static_score, reduced):
+        """Gather the node-axis inputs down to the shortlist union:
+        mask/score/slot columns device-side (they are device-born), the
+        five node tensors host-side (the union is small — a fresh
+        M-row upload is cheaper than scattering the persistent full
+        buffers). Padding columns are forced infeasible, so the kernels
+        can only select live union entries."""
+        u_idx = jnp.asarray(reduced.union_padded.astype(np.int32))
+        live = jnp.asarray(reduced.live)
+        gmask_r = jnp.take(jnp.asarray(gmask), u_idx, axis=1) \
+            & live[None, :]
+        if _zeros_cache.get(tuple(static_score.shape)) is static_score:
+            # the shared all-zeros buffer: a reduced-width shared zeros
+            # beats gathering columns out of a multi-GB zeros array
+            static_r = _shared_zeros((int(static_score.shape[0]),
+                                      reduced.u_pad))
+        else:
+            static_r = jnp.take(jnp.asarray(static_score), u_idx, axis=1)
+        slot_r = {}
+        if batch.task_slot is not None:
+            rows = np.take(batch.slot_rows, reduced.union_padded, axis=1)
+            rows[:, ~reduced.live] = False
+            slot_r = {"task_slot": jnp.asarray(batch.task_slot),
+                      "slot_ok": jnp.asarray(rows)}
+        uidx = reduced.union_padded
+        host = {"idle": narr.idle[uidx],
+                "future_idle": narr.future_idle[uidx],
+                "allocatable": narr.allocatable[uidx],
+                "n_tasks": narr.n_tasks[uidx],
+                "max_tasks": narr.max_tasks[uidx]}
+        return gmask_r, static_r, slot_r, host
+
+    def _place_pruned(self, batch, narr, gmask, static_score, task_bucket,
+                      pack_bonus, q_deserved, q_alloc0, ns_weight,
+                      ns_alloc0, ns_total, ns_live, eps, allow_pipeline,
+                      slot_kwargs):
+        """One pruned placement attempt (docs/design/pruning.md):
+        distill the per-gang shortlists, run the ladder on the union-
+        reduced problem, and map placements back. Returns None whenever
+        the full-width kernel must decide the cycle instead — a distill
+        or ladder crash, a pre-kernel loss guard (low coverage / wide
+        union / empty union), or the post-kernel exhaustion guard (a
+        feasible valid task went unplaced while any pair's shortlist
+        was truncated) — every fallback counted once on
+        volcano_prune_fallback_total{reason}, so pruning can never lose
+        a placement the dense kernel would have made."""
+        from ..metrics import metrics as m
+        from ..ops import prune as _prune
+        from ..trace import explain as _explain
+        plan = None
+        if self.mesh is not None:
+            # the ShardPlan's contiguous ranges are the two-level
+            # partition structure; its construction must never cost the
+            # cycle (single-level distillation is the degraded mode)
+            try:
+                plan = self._shard_plan(narr, self.mesh.devices.size)
+            except Exception:
+                plan = None
+        try:
+            with trace.span("prune_distill", k=self.prune.k):
+                ctx = _prune.distill(batch, narr, gmask, static_score,
+                                     self.score_weights(), self.prune,
+                                     plan=plan,
+                                     dedupe=self._prune_dedupe_ok)
+        except Exception:
+            _logger.exception("shortlist distillation crashed; running "
+                              "the full-width kernel for this cycle")
+            m.inc(m.PRUNE_FALLBACK, reason="crash")
+            return None
+        guard = ctx.pre_guard()
+        if guard is not None:
+            # one fallback per place(), whatever the reason — the pair
+            # count behind it rides the summary (fallback_pairs), not
+            # the counter, so the reasons stay unit-comparable
+            reason, count = guard
+            ctx.fallback = reason
+            ctx.fallback_pairs = int(count)
+            m.inc(m.PRUNE_FALLBACK, reason=reason)
+            _explain.note_prune(ctx.summary())
+            return None
+        try:
+            with trace.span("pruned_kernel", union=ctx.m_real,
+                            level=ctx.level):
+                out = self._execute_ladder(
+                    batch, narr, gmask, static_score, task_bucket,
+                    pack_bonus, q_deserved, q_alloc0, ns_weight, ns_alloc0,
+                    ns_total, ns_live, eps, allow_pipeline, slot_kwargs,
+                    reduced=ctx)
+        except Exception:
+            _logger.exception("pruned kernel ladder crashed at every "
+                              "tier; running the full-width kernel")
+            ctx.fallback = "crash"
+            m.inc(m.PRUNE_FALLBACK, reason="crash")
+            _explain.note_prune(ctx.summary())
+            return None
+        assign_r, pipelined, ready, kept, tier = out
+        assign = ctx.map_assign(assign_r)
+        if ctx.post_guard(assign, batch):
+            ctx.fallback = "shortlist_exhausted"
+            m.inc(m.PRUNE_FALLBACK, reason="shortlist_exhausted")
+            _explain.note_prune(ctx.summary())
+            return None
+        m.inc(m.PRUNE_RUNS, level=ctx.level)
+        m.set_gauge(m.PRUNE_UNION_WIDTH, float(ctx.m_real))
+        _explain.note_prune(ctx.summary())
+        return assign, pipelined, ready, kept, tier
+
     def _shard_plan(self, narr: NodeArrays, n_devices: int):
         """The topology-aware node partition for this place: reused from
         the persistent solver state while the host arrays persist
@@ -1315,18 +1535,26 @@ class BatchSolver:
     def _run_sharded(self, batch, narr, gmask, static_score, task_bucket,
                      pack_bonus, q_deserved, q_alloc0, ns_weight, ns_alloc0,
                      ns_total, ns_live, eps, allow_pipeline,
-                     slot_kwargs=None):
+                     slot_kwargs=None, plan=None, node_host=None):
         """Node-axis-sharded placement over the device mesh: each chip
         owns a topology-aware contiguous node range's scan state (the
         ShardPlan balances per-shard resident-task pressure, not a naive
         N/D split), collectives ride ICI (ops/sharded.py). Placement
         indices come back in layout order and are mapped to node order
-        through the plan's gather."""
+        through the plan's gather.
+
+        ``plan``/``node_host`` override the persistent topology plan and
+        node tensors for the PRUNED reduced-axis run (ops/prune.py): the
+        caller passes a fresh equal-width plan over the shortlist union
+        and the five union-gathered host node arrays — the persistent
+        full-width buffers stay untouched, and the returned assign
+        indexes the reduced axis."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh = self.mesh
         d = mesh.devices.size
-        plan = self._shard_plan(narr, d)
+        if plan is None:
+            plan = self._shard_plan(narr, d)
 
         with_slots = bool(slot_kwargs)
         fn = _get_sharded_fn(mesh, allow_pipeline, ns_live,
@@ -1347,8 +1575,20 @@ class BatchSolver:
         tb.__enter__()
         try:
             with trace.span("transfer"):
-                dev_nodes, node_xfer = self._sharded_device_node_inputs(
-                    narr, plan, mesh)
+                if node_host is None:
+                    dev_nodes, node_xfer = self._sharded_device_node_inputs(
+                        narr, plan, mesh)
+                else:
+                    n_s = NamedSharding(mesh, P("nodes"))
+                    nr_s = NamedSharding(mesh, P("nodes", None))
+                    sharding_of = {"idle": nr_s, "future_idle": nr_s,
+                                   "allocatable": nr_s, "n_tasks": n_s,
+                                   "max_tasks": n_s}
+                    host = {f: plan.take(node_host[f], 0)
+                            for f in self._DEV_NODE_FIELDS}
+                    dev_nodes = {f: jax.device_put(a, sharding_of[f])
+                                 for f, a in host.items()}
+                    node_xfer = sum(int(a.nbytes) for a in host.values())
             xfer = [node_xfer]
 
             def put(a, s):

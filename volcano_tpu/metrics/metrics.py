@@ -159,6 +159,16 @@ SHARD_OCCUPANCY = f"{NS}_shard_occupancy"
 SHARD_PRESSURE = f"{NS}_shard_pressure"
 SHARD_PRESSURE_IMBALANCE = f"{NS}_shard_pressure_imbalance"
 PADDED_WASTE = f"{NS}_padded_waste_ratio"
+# candidate pruning + two-level placement (docs/design/pruning.md):
+# place() calls served by the reduced shortlist kernel
+# (level="single"|"two_level"), fallbacks to the full-width kernel by
+# reason (reason="low_coverage"|"shortlist_exhausted"|"wide_union"|
+# "empty_union"|"crash" — the loss-guard contract: pruning never loses
+# a placement the dense kernel would have made), and the width of the
+# last reduced node axis (the union of every gang's shortlist)
+PRUNE_RUNS = f"{NS}_prune_runs_total"
+PRUNE_FALLBACK = f"{NS}_prune_fallback_total"
+PRUNE_UNION_WIDTH = f"{NS}_prune_union_width"
 
 # component health registry behind /debug/health: a component absent from
 # the registry is healthy by default; the watchdog (scheduler.py) flips

@@ -184,6 +184,25 @@ def add_sim_parser(sub) -> None:
     exp.add_argument("--zones", type=int, default=4)
     exp.add_argument("--json", action="store_true")
 
+    pr = sim.add_parser(
+        "prune", help="CI gate (make prune-smoke): seeded constrained "
+                      "churn (zoned topology, spread gangs, anti pairs) "
+                      "run three ways — pruned (prune.enable true, "
+                      "k = the node count so every shortlist is "
+                      "COMPLETE), a pruned double run, and a "
+                      "dense-forced control — gating bit-identical "
+                      "bind AND ledger fingerprints across all three "
+                      "runs, zero prune-crash fallbacks, and the "
+                      "pruned kernel provably serving")
+    pr.add_argument("--seed", type=int, default=53)
+    pr.add_argument("--ticks", type=int, default=120)
+    pr.add_argument("--nodes", type=int, default=96)
+    pr.add_argument("--zones", type=int, default=4)
+    pr.add_argument("--k", type=int, default=0,
+                    help="shortlist width (0 = node count: the "
+                         "complete-shortlist exactness regime)")
+    pr.add_argument("--json", action="store_true")
+
     rep = sim.add_parser("replay", help="re-run a violation repro bundle")
     rep.add_argument("--bundle", required=True)
     rep.add_argument("--use-trace", action="store_true",
@@ -444,6 +463,54 @@ def constraint_config(seed: int = 41, ticks: int = 160, nodes: int = 96,
         resident_jobs=40, resident_gang=8, resident_min=4,
         workload=constraint_scenario_workload(seed, ticks, queue="batch"),
         control_events=storms,
+        repro_dir=".")
+
+
+PRUNE_CONF = """
+actions: "enqueue, allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: binpack
+"""
+
+
+def prune_config(seed: int = 53, ticks: int = 120, nodes: int = 96,
+                 zones: int = 4, k: int = 0, pruned: bool = True):
+    """The `make prune-smoke` shape (docs/design/pruning.md): zoned
+    nodes and the constraint-heavy churn stream (hard/soft zone spread
+    gangs, one-per-zone anti pairs over elastic filler) with the
+    candidate-pruning regime FORCED on (``prune.min_nodes`` floor
+    bypassed via ``prune.enable: "true"``) at ``k`` = the node count —
+    complete shortlists, so pruned placements are bit-identical with
+    the dense control BY CONTRACT, and any fingerprint divergence is a
+    pruning bug, not a documented tie-break. ``pruned=False`` is the
+    dense-forced control leg."""
+    from .engine import SimConfig
+    from .faults import FaultConfig
+    from .workload import constraint_scenario_workload
+    k = int(k) or int(nodes)
+    arg = (f'    prune.enable: "true"\n    prune.k: "{k}"'
+           if pruned else '    prune.enable: "off"')
+    conf_text = PRUNE_CONF + f"""
+configurations:
+- name: solver
+  arguments:
+{arg}
+"""
+    return SimConfig(
+        seed=seed, ticks=ticks, tick_s=1.0, n_nodes=nodes,
+        node_cpu="8", node_mem="16Gi", node_zones=zones,
+        conf_text=conf_text,
+        queues=[("batch", 1, None)],
+        resident_jobs=40, resident_gang=8, resident_min=4,
+        workload=constraint_scenario_workload(seed, ticks, queue="batch"),
+        faults=FaultConfig(seed=seed),
         repro_dir=".")
 
 
@@ -1108,6 +1175,85 @@ def dispatch_sim(args) -> int:
             for name, ok in checks.items():
                 print(f"  {name}: {'ok' if ok else 'FAIL'}")
             print(f"explain-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
+        return 0 if verdict["pass"] else 1
+
+    if args.verb == "prune":
+        from ..framework.solver import reset_breaker
+        from ..metrics import metrics as m
+        from ..ops.prune import FALLBACK_REASONS
+        from ..trace import explain as ex
+
+        def counters():
+            c = {"runs": m.counter_total(m.PRUNE_RUNS, level="single")
+                 + m.counter_total(m.PRUNE_RUNS, level="two_level")}
+            for r in FALLBACK_REASONS:
+                c[r] = m.counter_total(m.PRUNE_FALLBACK, reason=r)
+            return c
+
+        def cfg(pruned=True):
+            return prune_config(seed=args.seed, ticks=args.ticks,
+                                nodes=args.nodes, zones=args.zones,
+                                k=args.k, pruned=pruned)
+
+        reset_breaker()
+        ex.reset()
+        c0 = counters()
+        r1 = run_sim(cfg())                    # pruned
+        c1 = counters()
+        reset_breaker()
+        r2 = run_sim(cfg())                    # pruned double run
+        c2 = counters()
+        reset_breaker()
+        r3 = run_sim(cfg(pruned=False))        # dense-forced control
+        c3 = counters()
+        prune_rep = ex.prune_report()
+        checks = {
+            "no_violations": not r1.violations and not r2.violations
+                             and not r3.violations,
+            # the pruned kernel provably served (and the dense control
+            # provably never pruned)
+            "pruned_kernel_ran": c1["runs"] > c0["runs"],
+            "control_ran_dense": c3["runs"] == c2["runs"],
+            # a crash fallback anywhere across the three runs means the
+            # reduced-problem plumbing broke (guard fallbacks would be
+            # contract-legal, but at k = node count the shortlists are
+            # COMPLETE, so exhaustion/low-coverage cannot fire either)
+            "zero_prune_crash_fallbacks": c3["crash"] == c0["crash"],
+            "zero_guard_fallbacks":
+                c3["shortlist_exhausted"] == c0["shortlist_exhausted"]
+                and c3["low_coverage"] == c0["low_coverage"],
+            # the exactness contract: complete shortlists make the
+            # pruned run bit-identical with the dense control, bind for
+            # bind AND ledger for ledger
+            "bind_parity_with_dense":
+                r1.bind_fingerprint() == r3.bind_fingerprint(),
+            "ledger_parity_with_dense":
+                r1.ledger.get("fingerprint") == r3.ledger.get("fingerprint"),
+            # and deterministic with itself across a double run
+            "deterministic_replay":
+                r1.bind_fingerprint() == r2.bind_fingerprint()
+                and r1.ledger.get("fingerprint")
+                == r2.ledger.get("fingerprint"),
+        }
+        verdict = {
+            "prune": r1.summary(),
+            "prune_runs": c1["runs"] - c0["runs"],
+            "prune_fallbacks": {r: c3[r] - c0[r]
+                                for r in FALLBACK_REASONS},
+            "shortlist_loss": prune_rep["last"],
+            "checks": checks,
+            "pass": all(checks.values()),
+        }
+        if args.json:
+            print(json.dumps(verdict, indent=1))
+        else:
+            _print_summary(r1.summary(), False)
+            print(f"pruned kernel runs: {int(verdict['prune_runs'])}  "
+                  f"binds: {len(r1.bind_sequence)}  "
+                  f"last shortlist: {prune_rep['last']}")
+            for name, ok in checks.items():
+                print(f"  {name}: {'ok' if ok else 'FAIL'}")
+            print(f"prune-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
         return 0 if verdict["pass"] else 1
 
     if args.verb == "replay":

@@ -75,6 +75,8 @@ COVERAGE_KS = (4, 16, 64)  # shortlist widths the coverage histograms
 #                            measure (the pruning baseline axis)
 _SAMPLE_CAP = 65536        # bounded aggregate sample window
 
+PRUNE_RECENT_CAP = 256     # per-place prune/shortlist-loss records kept
+
 _enabled = False
 _lock = threading.Lock()
 _records: "OrderedDict[str, dict]" = OrderedDict()   # job key -> record
@@ -86,6 +88,14 @@ _cov_count: Dict[int, int] = {}
 _frag_ratio: Optional[float] = None
 _detail_budget = DETAIL_CAP
 _topk_fn_cache: Dict[tuple, object] = {}
+# the operator-chosen shortlist width (solver conf `prune.k`) must
+# always be one of the recorded coverage widths — a prune.k outside the
+# static COVERAGE_KS would otherwise be flying blind on its loss budget
+_extra_cov_ks: set = set()
+# per-cycle shortlist-loss aggregates (ops/prune.py): recent per-place
+# summaries + monotone totals, surfaced on /debug/explain
+_prune_recent: deque = deque(maxlen=PRUNE_RECENT_CAP)
+_prune_totals: Dict[str, Dict[str, int]] = {"runs": {}, "fallbacks": {}}
 
 
 def _r(x) -> float:
@@ -123,6 +133,54 @@ def reset() -> None:
         _cov_count.clear()
         _frag_ratio = None
         _detail_budget = DETAIL_CAP
+        _extra_cov_ks.clear()
+        _prune_recent.clear()
+        _prune_totals["runs"] = {}
+        _prune_totals["fallbacks"] = {}
+
+
+def register_prune_k(k: int) -> None:
+    """Fold the solver conf's ``prune.k`` into the recorded coverage
+    widths (sticky for the process; re-registered by every session that
+    parses a prune-enabled conf, cleared by :func:`reset`)."""
+    with _lock:
+        _extra_cov_ks.add(int(k))
+
+
+def coverage_ks() -> tuple:
+    """The shortlist widths the coverage histograms measure: the static
+    baseline axis plus any registered operator-chosen ``prune.k``."""
+    with _lock:
+        return tuple(sorted(set(COVERAGE_KS) | _extra_cov_ks))
+
+
+def note_prune(rec: dict) -> None:
+    """One place() call's shortlist-loss summary (ops/prune.py
+    ``PruneContext.summary()``): pushed whether the reduced kernel
+    served or a guard fell the cycle back — the per-cycle loss surface
+    /debug/explain exposes. No wall-clock state, floats pre-rounded."""
+    with _lock:
+        _prune_recent.append(dict(rec))
+        if rec.get("fallback"):
+            key = str(rec["fallback"])
+            _prune_totals["fallbacks"][key] = \
+                _prune_totals["fallbacks"].get(key, 0) + 1
+        else:
+            key = str(rec.get("level", "single"))
+            _prune_totals["runs"][key] = \
+                _prune_totals["runs"].get(key, 0) + 1
+
+
+def prune_report() -> dict:
+    """The shortlist-loss aggregate block: totals + newest per-place
+    summaries (the /debug/explain "prune" section)."""
+    with _lock:
+        recent = list(_prune_recent)
+        totals = {"runs": dict(_prune_totals["runs"]),
+                  "fallbacks": dict(_prune_totals["fallbacks"])}
+    return {"totals": totals,
+            "last": recent[-1] if recent else None,
+            "recent": recent[-32:]}
 
 
 def session_enabled(solver_args) -> bool:
@@ -146,7 +204,12 @@ def _topk_fn(k: int, ks: tuple):
     feasible counts, top-k values/indices, min-shifted score-mass
     coverage per shortlist width, and the top-1 vs top-2 win margin.
     Cached per (k, ks); shapes re-jit per padded bucket like every
-    other kernel."""
+    other kernel. This is also the shortlist-distillation pass of the
+    candidate-pruning regime (ops/prune.py) — mask -> shortlist is
+    exactly this reduction, never a second predicate sweep. Widths are
+    clamped to the node axis so a ``prune.k`` above the padded width
+    (tiny fleets) degrades to full-width shortlists instead of a
+    top_k shape error."""
     key = (k, ks)
     fn = _topk_fn_cache.get(key)
     if fn is not None:
@@ -164,19 +227,27 @@ def _topk_fn(k: int, ks: tuple):
             lambda req, srow: node_score(req, idle, alloc, weights, srow)
         )(group_req, static)
         neg = jnp.float32(-1e30)
+        n_ax = mask.shape[1]
         masked = jnp.where(mask, score, neg)
-        vals, idx = jax.lax.top_k(masked, kmax)
+        vals, idx = jax.lax.top_k(masked, min(kmax, n_ax))
         feasible = mask.sum(axis=1)
         minf = jnp.min(jnp.where(mask, score, jnp.float32(1e30)), axis=1)
-        shifted = jnp.where(mask, score - minf[:, None], 0.0)
-        total = shifted.sum(axis=1)
-        svals, _ = jax.lax.top_k(shifted, kmax)
+        total = jnp.where(mask, score - minf[:, None], 0.0).sum(axis=1)
+        # the top-kk min-shifted score mass IS the top-kk masked values
+        # shifted and clipped (identical values in identical order;
+        # infeasible NEG entries clip to the 0 a masked-out column
+        # contributes) — ONE top_k instead of two, which is the
+        # difference between a fused pass and XLA re-materializing the
+        # whole score chain per consumer (~10x at 50k x 10k)
+        svals = jnp.maximum(vals - minf[:, None], 0.0)
         covs = [jnp.where(total > 0.0,
-                          svals[:, :kk].sum(axis=1) / total, 1.0)
+                          svals[:, :min(kk, n_ax)].sum(axis=1) / total, 1.0)
                 for kk in ks]
-        margin = jnp.where(feasible > 1, vals[:, 0] - vals[:, 1], 0.0)
-        return feasible, vals[:, :k], idx[:, :k], \
-            jnp.stack(covs, axis=1), margin
+        # NO in-jit win margin: a `vals[:, 0] - vals[:, 1]` consumer of
+        # the top_k output defeats the XLA:CPU fusion of the whole pass
+        # (measured 10x — 240 ms -> 2.4 s per 1024 x 10240 block);
+        # callers derive it host-side from the returned values
+        return feasible, vals[:, :k], idx[:, :k], jnp.stack(covs, axis=1)
 
     _topk_fn_cache[key] = fused
     return fused
@@ -271,8 +342,9 @@ def record_place(ssn, batch, narr, stages, gmask, static_score, weights,
     counts = [np.asarray(c).astype(np.int64) for _, c in ladder]
 
     # -- the fused aggregate pass (top-k, coverage, margin) -------------
-    fused = _topk_fn(TOPK, COVERAGE_KS)
-    feasible_d, top_vals_d, top_idx_d, cov_d, margin_d = fused(
+    cov_ks = coverage_ks()
+    fused = _topk_fn(TOPK, cov_ks)
+    feasible_d, top_vals_d, top_idx_d, cov_d = fused(
         jnp.asarray(batch.group_req), jnp.asarray(narr.idle),
         jnp.asarray(narr.allocatable), jnp.asarray(static_score),
         final, weights)
@@ -280,11 +352,13 @@ def record_place(ssn, batch, narr, stages, gmask, static_score, weights,
     top_vals = np.asarray(top_vals_d)
     top_idx = np.asarray(top_idx_d)
     coverage = np.asarray(cov_d)
-    margin = np.asarray(margin_d)
+    # the top-1 vs top-2 win margin, host-side (see _topk_fn: an in-jit
+    # cross-column consumer of the top_k output defeats the fusion)
+    margin = np.where(feasible > 1, top_vals[:, 0] - top_vals[:, 1], 0.0)
 
     real = np.arange(n_groups)
     m.observe_bulk(m.GANG_FEASIBLE_NODES, feasible[real].tolist())
-    for i, kk in enumerate(COVERAGE_KS):
+    for i, kk in enumerate(cov_ks):
         vals = coverage[real, i].tolist()
         m.observe_bulk(m.TOPK_SCORE_COVERAGE, vals, k=str(kk))
         with _lock:
@@ -341,7 +415,7 @@ def record_place(ssn, batch, narr, stages, gmask, static_score, weights,
                 "eliminations": elims,
                 "win_margin": _r(margin[g]),
                 "coverage": {str(kk): _r(coverage[g, i])
-                             for i, kk in enumerate(COVERAGE_KS)},
+                             for i, kk in enumerate(cov_ks)},
             }
             if _detail_budget > 0:
                 _detail_budget -= 1
@@ -536,8 +610,9 @@ def aggregates() -> dict:
         frag = _frag_ratio
     return {"feasible_nodes": _percentiles(feas),
             "topk_coverage": cov,
-            "coverage_ks": list(COVERAGE_KS),
-            "fragmentation_ratio": _r(frag) if frag is not None else None}
+            "coverage_ks": list(coverage_ks()),
+            "fragmentation_ratio": _r(frag) if frag is not None else None,
+            "prune": prune_report()}
 
 
 def report(limit: int = 64) -> dict:
