@@ -5,7 +5,7 @@ PYTHON ?= python
 .PHONY: test unit-test e2e bench bench-all bench-check multichip-dryrun \
 	deploy deploy-up trace-smoke sim-smoke flush-bench chaos-smoke \
 	failover-smoke obs-smoke incr-smoke multichip-smoke constraint-smoke \
-	storm-smoke explain-smoke prune-smoke lint sanitize
+	storm-smoke explain-smoke prune-smoke federation-smoke lint sanitize
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -202,6 +202,21 @@ explain-smoke: storm-smoke
 # fingerprints are bit-identical across all three runs.
 prune-smoke: explain-smoke
 	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli prune
+
+# federated-control-plane gate (docs/design/federation.md), after
+# prune-smoke: a seeded bind storm on the leader store while the
+# journal replicates to two follower mirrors and 1k+ subscribers watch
+# across all three replicas' hubs. Mid-storm one follower is killed
+# (every cursor it served hands off to a live peer), the leader
+# journal is force-cleared (followers bootstrap from snapshot), and an
+# election advances the epoch while the deposed leader ships one more
+# frame (the mirrors must fence it). Exit 1 unless every surviving
+# cursor converged, zero unrecovered gaps, >=1 fenced stale-leader
+# frame, the cross-replica anti-entropy audit reports every settled
+# mirror fingerprint-identical to the leader, and a double run is
+# bit-identical on bind AND ledger fingerprints.
+federation-smoke: prune-smoke
+	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli federation
 
 # multi-chip sharding dryrun on the virtual CPU mesh (the raw
 # shard_map program + full-pipeline one-shot; multichip-smoke is the

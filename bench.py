@@ -766,11 +766,159 @@ def try_serving_worker(n_tasks: int, n_nodes: int, watchers: int):
         return None
 
 
+def federation_worker(n_tasks: int, n_nodes: int, watchers: int,
+                      followers: int = 2) -> None:
+    """Federated serving leg (docs/design/federation.md): the canonical
+    50k-bind flush through a 3-replica set — one fenced leader plus
+    ``followers`` journal mirrors, each replica fronting its own serving
+    hub — with ``watchers`` subscribers placed deterministically across
+    the live replicas. Measures FOLLOWER-SIDE fan-out latency (the
+    replication hop rides inside the number), the final replication
+    lag, and the cross-replica anti-entropy audit verdict. Pure
+    store + replication + hub path: no jax, no scheduler."""
+    from volcano_tpu.apiserver.store import ObjectStore
+    from volcano_tpu.replication.federation import ReplicaSet
+    from volcano_tpu.utils.test_utils import build_pod
+
+    N_NS = 64
+    FIREHOSE = 8
+    store = ObjectStore()
+    rs = ReplicaSet(store, followers=followers, shards=8)
+    log(f"federation worker: populating {n_tasks} pods across {N_NS} "
+        f"namespaces, {followers} follower mirrors")
+    for i in range(n_tasks):
+        store.create("pods", build_pod(
+            f"ns-{i % N_NS}", f"b-{i}", "", "Pending",
+            {"cpu": "2", "memory": "4Gi"}), skip_admission=True)
+    # bring every mirror to the populated head BEFORE subscribing so
+    # follower cursors anchor at the mirror's journal tail — the FLUSH
+    # is what they watch, replicated (prime=False as in serving_worker)
+    for f in rs.followers:
+        f.sync_to_head(max_rounds=4096)
+    subs = []
+    for i in range(watchers):
+        cid = f"fire-{i:03d}" if i < FIREHOSE else f"w-{i:05d}"
+        hub = rs.hub_of(rs.place_subscriber(cid))
+        if i < FIREHOSE:
+            subs.append(hub.subscribe(cid, tenant="firehose",
+                                      kinds=("pods",), prime=False))
+        else:
+            subs.append(hub.subscribe(
+                cid, tenant=f"t-{i % N_NS}", kinds=("pods",),
+                filter_attr=(("metadata", "namespace"),
+                             f"ns-{i % N_NS}"),
+                prime=False))
+    log(f"{len(subs)} subscribers across {len(rs.live_names())} "
+        f"replicas; starting replica set + flush")
+    rs.start()   # follower sync threads + every hub's shard threads
+    bindings = [(f"b-{i}", f"ns-{i % N_NS}", f"node-{i % n_nodes}")
+                for i in range(n_tasks)]
+    t0 = time.perf_counter()
+    pairs, missing = store.bind_pods(bindings)
+    bind_wall_ms = (time.perf_counter() - t0) * 1000.0
+    assert not missing and len(pairs) == n_tasks, (len(pairs),
+                                                   len(missing))
+    # drain client-side until every cursor — leader- AND follower-homed
+    # — reaches the leader's final rv (follower hubs can only get there
+    # once replication lands the whole flush in their mirror)
+    final_rv = store.current_rv()
+    deadline = time.time() + 300.0
+    while time.time() < deadline:
+        laggards = 0
+        for s in subs:
+            s.take_frames()
+            if s.cursor < final_rv:
+                laggards += 1
+        if laggards == 0:
+            break
+        time.sleep(0.01)
+    drain_ms = (time.perf_counter() - t0) * 1000.0
+    rs.stop()
+    lag_final = max((f.lag() for f in rs.followers), default=0)
+    # settle the mirrors, then run the divergence audit at head: live
+    # mirrors must fingerprint IDENTICALLY to the leader
+    for f in rs.followers:
+        f.sync_to_head(max_rounds=4096)
+    audit = rs.audit()
+    converged = sum(1 for s in subs if s.cursor >= final_rv)
+    # follower-side fan-out latency: merge every mirror hub's samples —
+    # this is the number that carries the replication hop
+    samples = sorted(x for f in rs.followers for x in f.hub.fanout_ms)
+
+    def pct(q: float) -> float:
+        if not samples:
+            return 0.0
+        return round(samples[min(len(samples) - 1,
+                                 int(q * len(samples)))], 3)
+
+    frames = sum(f.hub.frames_total for f in rs.followers) \
+        + rs.leader_hub.frames_total
+    events = sum(f.hub.events_total for f in rs.followers) \
+        + rs.leader_hub.events_total
+    out = {
+        "fed_followers": followers,
+        "fed_watchers": len(subs),
+        "fed_watchers_converged": converged,
+        "fed_follower_fanout_p50_ms": pct(0.50),
+        "fed_follower_fanout_p95_ms": pct(0.95),
+        "fed_follower_fanout_p99_ms": pct(0.99),
+        "fed_coalesced_batches": frames,
+        "fed_events_delivered": events,
+        "fed_coalesce_ratio": round(events / max(1, frames), 1),
+        "fed_drain_ms": round(drain_ms, 2),
+        "fed_bind_wall_ms": round(bind_wall_ms, 2),
+        "fed_replication_lag_final": lag_final,
+        "fed_audit": audit["verdict"],
+    }
+    if converged != len(subs):
+        out["error"] = "federated subscribers failed to converge"
+        print(json.dumps(out))
+        sys.exit(1)
+    if audit["verdict"] != "identical":
+        out["error"] = f"divergent mirrors: {audit['divergent']}"
+        print(json.dumps(out))
+        sys.exit(1)
+    print(json.dumps(out))
+
+
+def try_federation_worker(n_tasks: int, n_nodes: int, watchers: int,
+                          followers: int = 2):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"   # pure store path; keep jax quiet
+    timeout_s = float(os.environ.get("VOLCANO_BENCH_FEDERATION_TIMEOUT",
+                                     900))
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--federation-worker", str(n_tasks), str(n_nodes),
+           str(watchers), str(followers)]
+    log(f"spawning federation worker: {watchers} watchers over "
+        f"{followers + 1} replicas, {n_tasks}x{n_nodes} flush "
+        f"(timeout {timeout_s:.0f}s)")
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout_s, env=env,
+                           cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        log("federation worker timed out (killed)")
+        return None
+    for line in (r.stderr or "").splitlines():
+        print(line, file=sys.stderr)
+    if r.returncode != 0:
+        log(f"federation worker rc={r.returncode}; "
+            f"stdout tail: {(r.stdout or '')[-200:]!r}")
+        return None
+    try:
+        return json.loads((r.stdout or "").strip().splitlines()[-1])
+    except Exception:
+        log(f"federation worker output unparseable: "
+            f"{(r.stdout or '')[-200:]!r}")
+        return None
+
+
 def write_bench_row(row: dict) -> None:
-    """Persist the headline row (BENCH_r12.json by default; override or
+    """Persist the headline row (BENCH_r14.json by default; override or
     disable with VOLCANO_BENCH_ROW_OUT) with a machine-calibration
     fingerprint so tools/bench_check.py can scale cross-box compares."""
-    out = os.environ.get("VOLCANO_BENCH_ROW_OUT", "BENCH_r13.json")
+    out = os.environ.get("VOLCANO_BENCH_ROW_OUT", "BENCH_r14.json")
     if not out:
         return
     try:
@@ -1045,6 +1193,17 @@ def main() -> None:
             sys.exit(1)
         return
 
+    if len(sys.argv) > 1 and sys.argv[1] == "--federation-worker":
+        try:
+            federation_worker(int(sys.argv[2]), int(sys.argv[3]),
+                              int(sys.argv[4]),
+                              int(sys.argv[5]) if len(sys.argv) > 5
+                              else 2)
+        except Exception:
+            log("federation worker failed:\n" + traceback.format_exc())
+            sys.exit(1)
+        return
+
     if len(sys.argv) > 1 and sys.argv[1] == "--constraint-worker":
         try:
             constraint_worker(sys.argv[2], int(sys.argv[3]),
@@ -1289,6 +1448,28 @@ def main() -> None:
             else:
                 log("serving worker failed; row ships without the "
                     "watch fan-out columns (bench-check will flag it)")
+            # federated serving leg at the canonical 50k x 10k flush
+            # shape (docs/design/federation.md) — BENCH_r14 onward:
+            # subscribers split across a 3-replica set, follower-side
+            # fan-out percentiles + replication lag + the cross-replica
+            # audit verdict, gated by bench_check round 14
+            fres = try_federation_worker(50_000, 10_000, watchers)
+            if fres is not None:
+                for k in ("fed_followers", "fed_watchers",
+                          "fed_watchers_converged",
+                          "fed_follower_fanout_p50_ms",
+                          "fed_follower_fanout_p95_ms",
+                          "fed_follower_fanout_p99_ms",
+                          "fed_coalesced_batches",
+                          "fed_events_delivered", "fed_coalesce_ratio",
+                          "fed_drain_ms", "fed_bind_wall_ms",
+                          "fed_replication_lag_final", "fed_audit"):
+                    if k in fres:
+                        row[k] = fres[k]
+            else:
+                log("federation worker failed; row ships without the "
+                    "federated serving columns (bench-check will flag "
+                    "it)")
             print(json.dumps(row))
             write_bench_row(row)
             return

@@ -108,9 +108,9 @@ class TestStoreFencing:
         store = _store_with_pods()
         store.advance_fence(1)
         with store._lock:
-            # freeze phase 1 of a sharded flush: the pod key is
-            # write-barriered until "its shard publishes"
-            store._inflight["pods"].add("ns1/pg0-0")
+            # freeze phase 1 of a sharded flush: an rv range is reserved
+            # but unpublished, so every writer settle-waits behind it
+            store._rv += 4
         outcome = {}
         pod = store.get("pods", "pg0-0", "ns1")
 
@@ -123,11 +123,11 @@ class TestStoreFencing:
 
         t = threading.Thread(target=deposed_writer)
         t.start()
-        time.sleep(0.2)            # writer is parked in the barrier wait
+        time.sleep(0.2)            # writer is parked in the settle wait
         assert t.is_alive()
         store.advance_fence(2)     # standby takes over mid-wait
         with store._lock:
-            store._inflight["pods"].clear()
+            store._rv -= 4         # the reservation "publishes"
             store._flush_cond.notify_all()
         t.join(timeout=5)
         assert outcome == {"fenced": True}
@@ -724,11 +724,17 @@ class TestParkedJournalRestore:
             # contiguous rv range with its keys write-barriered
             store._rv += 4
             store._inflight["pods"].update({"ns1/pg0-0", "ns1/pg0-1"})
-        # an interleaved writer on another kind: its journal entry must
-        # PARK (its rv is beyond the reserved range's unpublished tail)
-        q = store.get("queues", "default")
-        q.spec.weight = 7
-        store.update("queues", q, skip_admission=True)
+            # park a journal entry beyond the reserved range directly —
+            # the settle barrier means no API writer can produce one
+            # anymore, but the sequencer keeps parking as a defensive
+            # invariant and a snapshot must still restore through it
+            q = store.get("queues", "default")
+            q.spec.weight = 7
+            store._rv += 1
+            q.metadata.resource_version = store._rv
+            store._objects["queues"]["default"] = q
+            store._journal_append_locked(store._rv, "MODIFIED",
+                                         "queues", q)
         assert store._journal_parked            # genuinely non-contiguous
         assert store.current_rv() == pre_tail   # tail never advanced
         alloc = store._rv

@@ -177,10 +177,14 @@ class TestShardedEngine:
             assert p.spec.node_name == ("" if i == 2 else f"n{i}")
             assert p.metadata.resource_version > 6   # every rv consumed
 
-    def test_interleaved_writer_parks_until_publish(self):
-        """A single update racing a sharded patch takes an rv ABOVE the
-        reservation; its journal entry parks until the whole reservation
-        publishes, keeping the journal rv-sorted and gap-free."""
+    def test_interleaved_writer_settles_behind_reservation(self):
+        """A single update racing a sharded patch settle-waits: its rv
+        is allocated only after the whole reservation publishes, so it
+        returns with its entry already journal-visible (rv == tail) and
+        rv order is a pure function of commit order — the federation
+        determinism barrier (docs/design/federation.md). It used to
+        take an rv ABOVE the reservation and park its journal entry,
+        which made rv order depend on thread timing."""
         store = sharded(store_with_pods(8), target=2)
         store.create("nodes", build_node("n-aux", {"cpu": "1",
                                                    "memory": "1Gi"}))
@@ -200,16 +204,28 @@ class TestShardedEngine:
                      for i in range(8)]))
         t.start()
         assert entered.wait(timeout=5.0)
-        # the patch holds its reservation; write an UNRELATED kind's key
+        # the patch holds its reservation; a write on an UNRELATED kind
+        # blocks until the reservation publishes
         aux = store.get("nodes", "n-aux")
         aux.metadata.labels["touched"] = "yes"
-        store.update("nodes", aux, skip_admission=True)
-        # its entry must not be visible before the reservation publishes
-        events, _, _ = store.events_since(rv_before, timeout=0.05)
-        assert not any(k == "nodes" for _, _, k, _ in events)
+        updated = threading.Event()
+
+        def racing_update():
+            store.update("nodes", aux, skip_admission=True)
+            updated.set()
+
+        u = threading.Thread(target=racing_update)
+        u.start()
+        time.sleep(0.05)
+        assert not updated.is_set()   # settled behind the reservation
         release.set()
         t.join(timeout=10.0)
-        assert not t.is_alive()
+        u.join(timeout=10.0)
+        assert not t.is_alive() and updated.is_set()
+        # the write returned with its entry already visible: rv == tail,
+        # never ahead of the journal
+        assert store.get("nodes", "n-aux").metadata.resource_version \
+            == store.current_rv()
         assert_journal_clean(store)
         events, _, resync = store.events_since(rv_before, timeout=0.1)
         assert not resync

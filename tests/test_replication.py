@@ -1,0 +1,501 @@
+"""Federated control plane tests (docs/design/federation.md): journal
+replication to follower mirrors at the leader's rvs, fencing of
+deposed-leader frames, structured gap recovery (catch-up relist and
+snapshot bootstrap), cursor failover to a peer replica mid-gap, the
+cross-replica anti-entropy fingerprint audit, the chunked-NDJSON
+/replicate transport, the shared-encoded watchstream fan-out path, and
+the commit-order-deterministic rv assignment the whole subsystem rests
+on (double-run bit-identity with rv-keyed fault coins).
+"""
+
+import http.client
+import json
+
+import pytest
+
+from volcano_tpu.apiserver.http import StoreHTTPServer, json_object_encoder
+from volcano_tpu.apiserver.store import (FencedError, ObjectStore,
+                                         ReplicationGapError)
+from volcano_tpu.cache.cache import SchedulerCache
+from volcano_tpu.metrics import metrics as m
+from volcano_tpu.replication.federation import ReplicaSet
+from volcano_tpu.replication.follower import (FollowerReplica,
+                                              HTTPReplicationSource)
+from volcano_tpu.replication.leader import ReplicationSource, snapshot_payload
+from volcano_tpu.serving.hub import ServingHub
+from volcano_tpu.sim.faults import FlakyWatch
+from volcano_tpu.utils.test_utils import build_node, build_pod
+
+RL = {"cpu": "1", "memory": "1Gi"}
+
+
+def _pod(ns, name, sched="volcano"):
+    p = build_pod(ns, name, "", "Pending", RL)
+    p.spec.scheduler_name = sched
+    return p
+
+
+def _fingerprints(store):
+    """Per-kind anti-entropy fingerprint of one store — the same
+    (count, max_rv, crc) triple the ReplicaSet audit compares."""
+    fp = SchedulerCache._fingerprint
+    from volcano_tpu.apiserver.store import KINDS
+    return {kind: fp({store.key_of(kind, o):
+                      (o.metadata.resource_version, o)
+                      for o in store.list_refs(kind)})
+            for kind in KINDS}
+
+
+def _leader(n_pods=4):
+    store = ObjectStore()
+    store.advance_fence(1)
+    for i in range(n_pods):
+        store.create("pods", _pod("default", f"p{i}"))
+    return store
+
+
+# ---------------------------------------------------------------------------
+# store install path: apply_replicated / install_snapshot
+# ---------------------------------------------------------------------------
+
+class TestApplyReplicated:
+    def test_installs_at_leader_rvs_fingerprint_identical(self):
+        leader = _leader(5)
+        src = ReplicationSource(leader, epoch=1)
+        mirror = ObjectStore()
+        entries, tail, gone, epoch = src.collect(0)
+        assert not gone and tail == leader.current_rv()
+        assert mirror.apply_replicated(entries, epoch=epoch) == tail
+        assert mirror.current_rv() == leader.current_rv()
+        # the leader's rv on every object, not a re-stamped local one
+        assert _fingerprints(mirror) == _fingerprints(leader)
+
+    def test_delete_and_update_lifecycle_through_mirror(self):
+        leader = _leader(2)
+        p = leader.get("pods", "p0")
+        p.status.phase = "Running"
+        leader.update("pods", p, skip_admission=True)
+        leader.delete("pods", "p1", "default", skip_admission=True)
+        mirror = ObjectStore()
+        entries, tail, _, epoch = ReplicationSource(leader).collect(0)
+        mirror.apply_replicated(entries, epoch=epoch)
+        assert mirror.get("pods", "p1") is None
+        assert mirror.get("pods", "p0").status.phase == "Running"
+        assert _fingerprints(mirror) == _fingerprints(leader)
+
+    def test_gap_raises_and_leaves_mirror_untouched(self):
+        leader = _leader(4)
+        entries, _, _, epoch = ReplicationSource(leader).collect(0)
+        mirror = ObjectStore()
+        with pytest.raises(ReplicationGapError):
+            mirror.apply_replicated(entries[1:], epoch=epoch)
+        assert mirror.current_rv() == 0
+        assert not mirror.list_refs("pods")
+        # an internal hole is rejected too, before any mutation
+        with pytest.raises(ReplicationGapError):
+            mirror.apply_replicated(entries[:1] + entries[2:], epoch=epoch)
+        assert mirror.current_rv() == 0
+
+    def test_stale_epoch_fenced_before_mutation(self):
+        leader = _leader(3)
+        entries, _, _, _ = ReplicationSource(leader).collect(0)
+        mirror = ObjectStore()
+        mirror.advance_fence(5)
+        with pytest.raises(FencedError):
+            mirror.apply_replicated(entries, epoch=4)
+        assert mirror.current_rv() == 0
+
+    def test_install_snapshot_reanchors_sequencer_and_journal(self):
+        leader = _leader(6)
+        objects, rv, epoch = ReplicationSource(leader, epoch=1).snapshot()
+        mirror = ObjectStore()
+        assert mirror.install_snapshot(objects, rv, epoch=epoch) == rv
+        assert mirror.current_rv() == rv
+        assert _fingerprints(mirror) == _fingerprints(leader)
+        # history below the anchor is unknown: cursors below it relist
+        _events, _tail, resync = mirror.events_since(0, 0.0)
+        assert resync
+
+
+# ---------------------------------------------------------------------------
+# fencing: the deposed leader cannot ship frames
+# ---------------------------------------------------------------------------
+
+class TestFencing:
+    def test_deposed_leader_frame_fenced_at_follower(self):
+        leader = _leader(3)
+        rs = ReplicaSet(leader, followers=1, shards=2)
+        f = rs.followers[0]
+        rs.sync()
+        assert f.applied_rv() == leader.current_rv()
+        # a frame collected under the CURRENT epoch...
+        leader.create("pods", _pod("default", "late"))
+        stale = rs.epoch
+        entries, _, gone, _ = rs.source.collect(f.applied_rv(), 0.0,
+                                                epoch=stale)
+        assert entries and not gone
+        # ...then the election happens: shipping it is a deposed write
+        rs.advance_epoch()
+        before = f.applied_rv()
+        with pytest.raises(FencedError):
+            f.apply_frame(entries, epoch=stale)
+        assert f.fenced_frames == 1
+        assert f.applied_rv() == before          # mirror untouched
+        assert f.store.get("pods", "late") is None
+        # the NEW epoch's shipment of the same range lands fine
+        assert f.sync_once() == len(entries)
+        assert f.applied_rv() == leader.current_rv()
+        assert rs.audit()["verdict"] == "identical"
+
+    def test_observe_epoch_advances_store_fence_and_hub(self):
+        rs = ReplicaSet(_leader(1), followers=1, shards=1)
+        f = rs.followers[0]
+        assert f.epoch() == rs.epoch == f.hub.epoch
+        rs.advance_epoch()
+        assert f.epoch() == rs.epoch == f.hub.epoch
+        # stale-epoch installs are now fenced at the mirror store itself
+        with pytest.raises(FencedError):
+            f.store.apply_replicated(
+                [(f.applied_rv() + 1, "ADDED", "pods",
+                  _pod("default", "x"))], epoch=rs.epoch - 1)
+
+
+# ---------------------------------------------------------------------------
+# gap recovery: catch-up relist, snapshot bootstrap, restart re-anchoring
+# ---------------------------------------------------------------------------
+
+class _DroppingSource:
+    """Source wrapper that loses the head of the first non-empty frame —
+    the non-contiguous shipment the structured catch-up must repair."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.dropped = False
+
+    def current_rv(self):
+        return self.inner.current_rv()
+
+    def snapshot(self):
+        return self.inner.snapshot()
+
+    def collect(self, cursor, timeout=0.0, epoch=None):
+        entries, tail, gone, ep = self.inner.collect(cursor, timeout,
+                                                     epoch)
+        if not self.dropped and len(entries) >= 2:
+            self.dropped = True
+            return entries[1:], tail, gone, ep
+        return entries, tail, gone, ep
+
+
+class TestGapRecovery:
+    def test_noncontiguous_frame_triggers_catchup_relist(self):
+        leader = _leader(5)
+        f = FollowerReplica("f1", _DroppingSource(ReplicationSource(
+            leader, epoch=1)))
+        f.sync_once()
+        assert f.gaps_detected == 1 and f.catchup_relists == 1
+        assert f.snapshot_bootstraps == 0     # the relist was enough
+        assert f.applied_rv() == leader.current_rv()
+        assert _fingerprints(f.store) == _fingerprints(leader)
+
+    def test_journal_rollover_bootstraps_from_snapshot(self):
+        leader = _leader(4)
+        f = FollowerReplica("f1", ReplicationSource(leader, epoch=1))
+        f.sync_to_head()
+        # the mirror falls behind, then the retained window rolls past
+        # the range it still needs
+        for i in range(3):
+            leader.create("pods", _pod("default", f"missed-{i}"))
+        FlakyWatch.force_gap(leader)
+        leader.create("pods", _pod("default", "after-gap"))
+        f.sync_once()
+        assert f.snapshot_bootstraps == 1
+        f.sync_to_head()
+        assert f.applied_rv() == leader.current_rv()
+        assert f.store.get("pods", "after-gap") is not None
+        assert _fingerprints(f.store) == _fingerprints(leader)
+
+    def test_follower_restart_reanchors_mid_stream(self):
+        """A restarted follower process re-anchors at its mirror's
+        journal tail and continues the stream — no bootstrap needed
+        while the leader still retains the range."""
+        leader = _leader(3)
+        src = ReplicationSource(leader, epoch=1)
+        f1 = FollowerReplica("f1", src)
+        f1.sync_to_head()
+        mid = f1.applied_rv()
+        for i in range(3):                    # writes while "down"
+            leader.create("pods", _pod("default", f"down-{i}"))
+        restarted = FollowerReplica("f1", src, store=f1.store)
+        assert restarted.applied_rv() == mid  # re-anchored at the tail
+        restarted.sync_to_head()
+        assert restarted.snapshot_bootstraps == 0
+        assert restarted.applied_rv() == leader.current_rv()
+        assert _fingerprints(restarted.store) == _fingerprints(leader)
+
+    def test_restart_after_rollover_falls_back_to_bootstrap(self):
+        leader = _leader(3)
+        src = ReplicationSource(leader, epoch=1)
+        f1 = FollowerReplica("f1", src)
+        f1.sync_to_head()
+        for i in range(3):                    # writes while "down"...
+            leader.create("pods", _pod("default", f"down-{i}"))
+        FlakyWatch.force_gap(leader)          # ...and the window rolls
+        leader.create("pods", _pod("default", "post"))
+        restarted = FollowerReplica("f1", src, store=f1.store)
+        restarted.sync_to_head()
+        assert restarted.snapshot_bootstraps == 1
+        assert restarted.applied_rv() == leader.current_rv()
+        assert _fingerprints(restarted.store) == _fingerprints(leader)
+
+
+# ---------------------------------------------------------------------------
+# replica set: follower serving, cursor failover, divergence audit
+# ---------------------------------------------------------------------------
+
+class TestReplicaSet:
+    def test_follower_hub_serves_at_leader_rvs(self):
+        leader = ObjectStore()
+        rs = ReplicaSet(leader, followers=1, shards=2)
+        sub = rs.hub_of("replica-1").subscribe("c1", kinds=("pods",),
+                                               since_rv=0)
+        for i in range(10):
+            leader.create("pods", _pod("default", f"p{i}"))
+        rs.sync()
+        rs.pump()
+        frames = sub.take_frames()
+        assert frames and frames[-1]["to_rv"] == leader.current_rv()
+        assert frames[0]["epoch"] == rs.epoch
+        rvs = [e[0] for fr in frames for e in fr["events"]]
+        assert rvs == sorted(rvs)             # the leader's rv order
+
+    def test_cursor_handed_to_peer_mid_gap(self):
+        """The acceptance edge case: a replica dies, its cursor moves to
+        a peer whose journal window has already rolled past it — the
+        structured relist re-anchors the client."""
+        leader = ObjectStore()
+        rs = ReplicaSet(leader, followers=2, shards=2)
+        victim = rs.followers[1]
+        sub = victim.hub.subscribe("c1", since_rv=0)
+        for i in range(6):
+            leader.create("pods", _pod("default", f"p{i}"))
+        rs.sync()
+        rs.pump()
+        applied = 0
+        for fr in sub.take_frames():
+            applied = int(fr["to_rv"])
+        assert applied == leader.current_rv()
+        rs.kill(victim.name)
+        for i in range(3):
+            leader.create("pods", _pod("default", f"late-{i}"))
+        FlakyWatch.force_gap(leader)          # window rolls past applied
+        leader.create("pods", _pod("default", "post-gap"))
+        rs.sync()
+        name, new_sub = rs.handoff(sub, applied)
+        assert name in rs.live_names() and name != victim.name
+        assert rs.handoffs == 1
+        rs.sync()
+        rs.pump()
+        frames = new_sub.take_frames()
+        assert frames and frames[0].get("relist")   # mid-gap: relist
+        assert int(frames[0]["rv"]) >= applied
+        assert frames[0]["epoch"] == rs.epoch
+
+    def test_handoff_placement_is_deterministic(self):
+        leader = ObjectStore()
+        rs = ReplicaSet(leader, followers=2)
+        homes = [rs.place_subscriber(f"c-{i}") for i in range(32)]
+        assert homes == [rs.place_subscriber(f"c-{i}") for i in range(32)]
+        assert len(set(homes)) == 3           # all replicas serve
+
+    def test_audit_identical_then_flags_tampered_mirror(self):
+        leader = _leader(4)
+        leader.create("nodes", build_node("n0", {"cpu": "8"}))
+        rs = ReplicaSet(leader, followers=2, shards=1)
+        rs.sync()
+        audit = rs.audit()
+        assert audit["verdict"] == "identical" and not audit["divergent"]
+        # corrupt one mirror behind replication's back: a key vanishes
+        f = rs.followers[0]
+        with f.store._lock:
+            f.store._objects["pods"].pop("default/p0")
+        audit = rs.audit()
+        assert audit["verdict"] == "divergent"
+        assert audit["divergent"] == [f.name]
+
+    def test_audit_skips_lagging_mirror(self):
+        leader = _leader(2)
+        rs = ReplicaSet(leader, followers=1, shards=1)
+        # never synced: the mirror LAGS, which is not divergence
+        audit = rs.audit()
+        assert audit["verdict"] == "identical"
+        assert rs.followers[0].lag() == leader.current_rv()
+
+
+# ---------------------------------------------------------------------------
+# HTTP transport: /replicate + /replicate/snapshot
+# ---------------------------------------------------------------------------
+
+class TestHTTPReplication:
+    def _serve(self, store):
+        server = StoreHTTPServer(store, port=0)
+        server.start()
+        return server, f"http://127.0.0.1:{server.port}"
+
+    def test_snapshot_bootstrap_and_stream_end_to_end(self):
+        leader = _leader(5)
+        server, url = self._serve(leader)
+        try:
+            f = FollowerReplica("f1", HTTPReplicationSource(url))
+            f.bootstrap()
+            assert f.snapshot_bootstraps == 1
+            assert f.applied_rv() == leader.current_rv()
+            for i in range(4):
+                leader.create("pods", _pod("default", f"live-{i}"))
+            f.sync_to_head()
+            assert f.applied_rv() == leader.current_rv()
+            assert _fingerprints(f.store) == _fingerprints(leader)
+        finally:
+            server.stop()
+
+    def test_gone_frame_over_http_bootstraps(self):
+        leader = _leader(3)
+        server, url = self._serve(leader)
+        try:
+            f = FollowerReplica("f1", HTTPReplicationSource(url))
+            f.sync_to_head()
+            for i in range(3):
+                leader.create("pods", _pod("default", f"down-{i}"))
+            FlakyWatch.force_gap(leader)
+            leader.create("pods", _pod("default", "post"))
+            f.sync_to_head()
+            assert f.snapshot_bootstraps == 1
+            assert f.applied_rv() == leader.current_rv()
+        finally:
+            server.stop()
+
+    def test_snapshot_payload_anchor_and_epoch(self):
+        leader = _leader(2)
+        leader.advance_fence(7)
+        payload = snapshot_payload(leader)
+        assert payload["rv"] == leader.current_rv()
+        assert payload["epoch"] == 7
+        assert set(payload["objects"]["pods"]) == {"default/p0",
+                                                   "default/p1"}
+
+
+# ---------------------------------------------------------------------------
+# shared frame encoding + backpressure (the fan-out hot path)
+# ---------------------------------------------------------------------------
+
+class TestSharedEncoding:
+    def test_encoded_bytes_shared_across_subscribers(self):
+        store = ObjectStore()
+        hub = ServingHub(store, shards=1, encoder=json_object_encoder)
+        s1 = hub.subscribe("c1", since_rv=0)
+        s2 = hub.subscribe("c2", since_rv=0)
+        for i in range(8):
+            store.create("pods", _pod("default", f"p{i}"))
+        hub.pump()
+        f1 = s1.take_frames()[0]
+        f2 = s2.take_frames()[0]
+        assert len(f1["encoded"]) == len(f1["events"]) == 8
+        # serialized ONCE per burst: both subscribers hold the SAME
+        # bytes objects, not equal copies
+        assert all(a is b for a, b in zip(f1["encoded"], f2["encoded"]))
+        for blob, (rv, _a, _k, o) in zip(f1["encoded"], f1["events"]):
+            doc = json.loads(blob)
+            assert doc["metadata"]["name"] == o.metadata.name
+            assert doc["metadata"]["resource_version"] == rv
+
+    def test_encoded_aligned_with_filtered_selection(self):
+        """A filtered subscriber's encoded list must track ITS selected
+        events, not the whole burst (index misalignment would splice the
+        wrong object bytes into the wire frame)."""
+        store = ObjectStore()
+        hub = ServingHub(store, shards=1, encoder=json_object_encoder)
+        sub = hub.subscribe(
+            "c1", kinds=("pods",),
+            filter_attr=(("spec", "scheduler_name"), "volcano"),
+            since_rv=0)
+        store.create("pods", _pod("default", "skip-me", sched="other"))
+        store.create("nodes", build_node("n0", {"cpu": "8"}))
+        store.create("pods", _pod("default", "seen"))
+        hub.pump()
+        frame = sub.take_frames()[0]
+        assert [e[3].metadata.name for e in frame["events"]] == ["seen"]
+        assert len(frame["encoded"]) == 1
+        assert json.loads(frame["encoded"][0])["metadata"]["name"] == \
+            "seen"
+
+    def test_watchstream_splices_shared_bytes(self):
+        """Over real HTTP the shared-encoding path serves the same
+        object documents the legacy per-subscriber path would."""
+        store = ObjectStore()
+        hub = ServingHub(store, shards=2, poll_timeout=0.2)
+        server = StoreHTTPServer(store, port=0, hub=hub)
+        server.start()
+        try:
+            assert hub.encoder is json_object_encoder   # auto-wired
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=10.0)
+            conn.request("GET", "/watchstream?cursor=-1&heartbeat=5"
+                                "&client=t1&kinds=pods"
+                                "&filter=spec.scheduler_name=volcano")
+            resp = conn.getresponse()
+            hello = json.loads(resp.readline())
+            assert hello.get("hello") and "epoch" in hello
+            store.create("pods", _pod("default", "skip", sched="x"))
+            store.create("pods", _pod("default", "seen"))
+            frame = json.loads(resp.readline())
+            assert [e["object"]["metadata"]["name"]
+                    for e in frame["events"]] == ["seen"]
+            assert frame["events"][0]["action"] == "ADDED"
+            assert "epoch" in frame
+            conn.close()
+        finally:
+            server.stop()
+
+    def test_shard_backpressure_gauge_exported(self):
+        m.reset()
+        store = ObjectStore()
+        hub = ServingHub(store, shards=1)
+        hub.subscribe("c1", since_rv=0)
+        store.create("pods", _pod("default", "p0"))
+        hub.pump()
+        gauges = {k[0] for k in m._gauges}
+        assert m.SERVING_SHARD_BACKPRESSURE in gauges
+        assert m.SERVING_SHARD_DEPTH in gauges
+
+
+# ---------------------------------------------------------------------------
+# commit-order-deterministic rv assignment (the tentpole's foundation)
+# ---------------------------------------------------------------------------
+
+class TestRvDeterminism:
+    def test_rv_keyed_fault_coins_double_run_bit_identical(self):
+        """The PR-11 FlakyWatch finding, closed: with drop coins keyed
+        on the DELIVERED OBJECT'S rv (not the delivery sequence), a
+        double failover run must stay bit-identical on bind and ledger
+        fingerprints. Under the old timing-dependent rv assignment the
+        same scenario diverged — rvs depended on flush-thread
+        interleaving, so the coins (and everything downstream of a
+        dropped delivery) differed run to run."""
+        from volcano_tpu.framework.solver import reset_breaker
+        from volcano_tpu.sim.cli import failover_config
+        from volcano_tpu.sim.engine import SimEngine
+
+        def one_run():
+            reset_breaker()
+            m.reset()
+            cfg = failover_config(seed=29, ticks=100, nodes=64)
+            cfg.faults.watch_coin = "rv"      # no re-key workaround
+            cfg.repro_dir = None
+            return SimEngine(cfg).run()
+
+        r1, r2 = one_run(), one_run()
+        assert r1.watch_drops > 0, "rv-keyed drop coins never fired"
+        assert not r1.violations and not r2.violations
+        assert r1.bind_fingerprint() == r2.bind_fingerprint()
+        assert r1.ledger.get("fingerprint") == \
+            r2.ledger.get("fingerprint")

@@ -1,8 +1,23 @@
 """Bench regression gate: compare a fresh bench row against a baseline.
 
-    python tools/bench_check.py                         # BENCH_r13 vs r12
-    python tools/bench_check.py --row BENCH_r13.json \
-        --baseline BENCH_r12.json --tolerance 0.35
+    python tools/bench_check.py                         # BENCH_r14 vs r13
+    python tools/bench_check.py --row BENCH_r14.json \
+        --baseline BENCH_r13.json --tolerance 0.35
+
+Round 14 adds the federated-serving columns (docs/design/
+federation.md), required on every fresh row: the federation worker
+replays the canonical 50k x 10k flush through a 3-replica set (leader
+plus 2 journal-mirror followers, one serving hub each) with the
+watcher population split deterministically across replicas, and the
+row must carry follower-side fan-out percentiles
+(``fed_follower_fanout_p99_ms``), full convergence
+(``fed_watchers_converged == fed_watchers``), the coalescing floor on
+the federated population, and an ``identical`` cross-replica
+anti-entropy audit verdict (``fed_audit``). Round 14 also ratchets the
+single-process fan-out number the shared-bytes frame encoding targets:
+``watch_fanout_p99_ms`` must land at or below HALF the r13 capture
+(6284 ms on this box), calibration-scaled — the "materially better
+than 6.3 s" acceptance line.
 
 Round 13 adds the candidate-pruning columns (docs/design/pruning.md),
 required on every fresh row: the pruned-vs-dense kernel A/B at the
@@ -125,6 +140,17 @@ INCR_MAX_DIRTY_FRACTION = 0.01
 # subscribers (64-way namespace-filtered + a firehose slice) over the
 # 50k-bind flush lands around x40-80; 10 is the "not per-event" line
 SERVING_COALESCE_MIN = 10.0
+
+# the shared-bytes fan-out ratchet (round 14, docs/design/
+# federation.md): the r13 capture measured watch_fanout_p99_ms at
+# 6284 ms on this box (calibration 34.47 ms) under the 1k-subscriber
+# storm; pre-serializing each coalesced frame ONCE per burst and
+# splicing the shared bytes into every subscriber's stream must at
+# LEAST halve it — the gate scales the ceiling by the fresh row's own
+# calibration so a slower co-tenant day cannot fake a regression
+FANOUT_P99_R13_MS = 6284.0
+FANOUT_P99_R13_CAL = 34.47
+FANOUT_P99_IMPROVEMENT = 0.5
 
 # constraint-kernel budget (round 10, docs/design/constraints.md): the
 # constraint-heavy 50k x 10k placement kernel (zoned nodes, hard-spread
@@ -388,6 +414,81 @@ def check_serving(fresh: dict, failures: list) -> None:
             "toward per-event delivery")
 
 
+def check_federation(fresh: dict, failures: list,
+                     fresh_cal: float) -> None:
+    """The round-14 federated-serving columns (bench.py's federation
+    worker: the canonical flush replicated to 2 follower mirrors with
+    the watcher population split across a 3-replica set): required on
+    every fresh row, plus the shared-bytes fan-out ratchet on the
+    single-process ``watch_fanout_p99_ms``."""
+    required = ("fed_followers", "fed_watchers",
+                "fed_watchers_converged", "fed_follower_fanout_p99_ms",
+                "fed_coalesced_batches", "fed_events_delivered",
+                "fed_replication_lag_final", "fed_audit")
+    missing = [k for k in required if fresh.get(k) is None]
+    if missing:
+        failures.append(
+            f"federation columns missing: {', '.join(missing)} — the "
+            "round-14 federated serving worker did not run (re-run "
+            "`python bench.py`)")
+        return
+    print(f"  {'fed fan-out ms':<24} "
+          f"p50={fresh.get('fed_follower_fanout_p50_ms')} "
+          f"p95={fresh.get('fed_follower_fanout_p95_ms')} "
+          f"p99={fresh.get('fed_follower_fanout_p99_ms')} "
+          f"({int(fresh['fed_watchers'])} watchers / "
+          f"{int(fresh['fed_followers']) + 1} replicas) ok")
+    watchers = int(fresh["fed_watchers"])
+    converged = int(fresh["fed_watchers_converged"])
+    verdict = "ok" if converged == watchers else "REGRESSION"
+    print(f"  {'fed convergence':<24} {converged}/{watchers} cursors "
+          f"at leader head {verdict}")
+    if verdict != "ok":
+        failures.append(
+            f"federated convergence {converged}/{watchers} — follower-"
+            "homed cursors did not reach the leader's final rv")
+    audit = fresh.get("fed_audit")
+    verdict = "ok" if audit == "identical" else "REGRESSION"
+    print(f"  {'fed audit':<24} {audit} "
+          f"(lag_final={fresh.get('fed_replication_lag_final')}) "
+          f"{verdict}")
+    if verdict != "ok":
+        failures.append(
+            f"cross-replica audit verdict {audit!r} — a follower "
+            "mirror does not fingerprint-match the leader")
+    batches = float(fresh["fed_coalesced_batches"]) or 0.0
+    events = float(fresh["fed_events_delivered"]) or 0.0
+    if not batches or not events:
+        failures.append("federated fan-out delivered nothing "
+                        f"(batches={batches:g}, events={events:g})")
+    else:
+        ratio = events / batches
+        verdict = "ok" if ratio >= SERVING_COALESCE_MIN \
+            else "REGRESSION"
+        print(f"  {'fed coalescing':<24} {events:9.0f} events / "
+              f"{batches:.0f} frames = x{ratio:.1f} "
+              f"(>= x{SERVING_COALESCE_MIN:.0f}) {verdict}")
+        if verdict != "ok":
+            failures.append(
+                f"federated coalescing ratio x{ratio:.1f} < "
+                f"x{SERVING_COALESCE_MIN:.0f}")
+    # the shared-bytes ratchet: the single-process storm p99 must land
+    # at or below half the r13 capture, calibration-scaled
+    p99 = fresh.get("watch_fanout_p99_ms")
+    if p99 is not None:
+        scale = (fresh_cal / FANOUT_P99_R13_CAL) if fresh_cal else 1.0
+        budget = FANOUT_P99_R13_MS * scale * FANOUT_P99_IMPROVEMENT
+        verdict = "ok" if float(p99) <= budget else "REGRESSION"
+        print(f"  {'fan-out p99 ratchet':<24} {float(p99):9.1f} vs "
+              f"budget {budget:9.1f} (r13 {FANOUT_P99_R13_MS:.0f} "
+              f"x{scale:.2f} x{FANOUT_P99_IMPROVEMENT}) {verdict}")
+        if verdict != "ok":
+            failures.append(
+                f"watch_fanout_p99_ms {float(p99):.1f} > "
+                f"{budget:.1f} ms — the shared-bytes frame encoding "
+                "must at least halve the r13 fan-out p99")
+
+
 def check(fresh: dict, baseline: dict, tolerance: float,
           baseline_cal: float, fresh_cal: float) -> int:
     scale = fresh_cal / baseline_cal if baseline_cal > 0 else 1.0
@@ -496,6 +597,7 @@ def check(fresh: dict, baseline: dict, tolerance: float,
     check_serving(fresh, failures)
     check_explain(fresh, failures)
     check_prune(fresh, failures)
+    check_federation(fresh, failures, fresh_cal)
     if failures:
         print("bench-check: FAIL")
         for fmsg in failures:
@@ -719,6 +821,7 @@ def check_10x(fresh: dict, tolerance: float, fresh_cal: float,
     check_serving(fresh, failures)
     check_explain(fresh, failures)
     check_prune(fresh, failures)
+    check_federation(fresh, failures, fresh_cal)
     if failures:
         print("bench-check: FAIL")
         for fmsg in failures:
@@ -730,10 +833,10 @@ def check_10x(fresh: dict, tolerance: float, fresh_cal: float,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--row", default=os.path.join(REPO, "BENCH_r13.json"),
+    ap.add_argument("--row", default=os.path.join(REPO, "BENCH_r14.json"),
                     help="fresh bench row (bench.py writes it)")
     ap.add_argument("--baseline",
-                    default=os.path.join(REPO, "BENCH_r12.json"))
+                    default=os.path.join(REPO, "BENCH_r13.json"))
     ap.add_argument("--tolerance", type=float, default=0.35,
                     help="allowed fractional slowdown after calibration "
                          "scaling (shared-box noise is ±15-25%%)")
@@ -749,7 +852,7 @@ def main(argv=None) -> int:
         fresh = load_row(args.row)
     except OSError as e:
         print(f"bench-check: cannot read fresh row {args.row}: {e}\n"
-              f"run `python bench.py` first (it writes BENCH_r13.json)")
+              f"run `python bench.py` first (it writes BENCH_r14.json)")
         return 2
     try:
         baseline = load_row(args.baseline)

@@ -86,6 +86,15 @@ def _tenant_of(query: dict) -> str:
     return query.get("tenant", ["default"])[0] or "default"
 
 
+def json_object_encoder(kind: str, o) -> bytes:
+    """The hub's shared wire codec (docs/design/federation.md): one
+    JSON serialization of the object payload per event per burst,
+    byte-shared across every subscriber's frame. Compact separators —
+    these bytes are spliced verbatim into NDJSON frame lines."""
+    return json.dumps(encode_object(kind, o),
+                      separators=(",", ":")).encode()
+
+
 class StoreHTTPServer:
     """The apiserver seam. ``hub``/``admission`` are optional: without
     them the server behaves exactly as the pre-serving era (no
@@ -97,6 +106,10 @@ class StoreHTTPServer:
         self.store = store
         self.hub = hub
         self.admission = admission
+        if hub is not None and getattr(hub, "encoder", None) is None:
+            # pre-serialize frames once per burst at the hub so the
+            # watchstream fan-out shares object bytes across subscribers
+            hub.encoder = json_object_encoder
         handler = self._make_handler()
         self.httpd = _CountingThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_port
@@ -200,11 +213,39 @@ class StoreHTTPServer:
                     payload.append(ev)
                 return payload
 
-            def _chunk(self, payload: dict) -> None:
-                body = json.dumps(payload).encode() + b"\n"
+            def _chunk_raw(self, body: bytes) -> None:
                 self.wfile.write(f"{len(body):X}\r\n".encode() + body
                                  + b"\r\n")
                 self.wfile.flush()
+
+            def _chunk(self, payload: dict) -> None:
+                self._chunk_raw(json.dumps(payload).encode() + b"\n")
+
+            def _chunk_frame_shared(self, frame: dict) -> None:
+                """One event frame on the shared-bytes fast path: the
+                object payloads were serialized ONCE per burst by the
+                hub (``frame["encoded"]`` pairs 1:1 with the events);
+                this splices the shared bytes into a per-subscriber
+                wrapper carrying the per-sub action labels."""
+                from .store import trace_in_ranges
+                ranges = store.trace_ranges()
+                parts = []
+                for (erv, action, kind, _o), ob in zip(frame["events"],
+                                                       frame["encoded"]):
+                    head = {"rv": erv, "action": action, "kind": kind}
+                    trace = trace_in_ranges(ranges, erv)
+                    if trace is not None:
+                        head["trace"] = trace
+                    hb = json.dumps(head)
+                    parts.append(hb[:-1].encode()
+                                 + b', "object": ' + ob + b"}")
+                meta = json.dumps({
+                    "prev": frame["prev"], "from_rv": frame["from_rv"],
+                    "to_rv": frame["to_rv"],
+                    "coalesced_from": frame["coalesced_from"],
+                    "epoch": frame.get("epoch", 0)})
+                self._chunk_raw(meta[:-1].encode() + b', "events": ['
+                                + b", ".join(parts) + b"]}\n")
 
             def _watchstream(self, q: dict) -> None:
                 """Chunked streaming watch: hold the connection and
@@ -265,31 +306,86 @@ class StoreHTTPServer:
                     self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
                     self._chunk({"hello": True, "rv": sub.cursor,
-                                 "client": client})
+                                 "client": client, "epoch": hub.epoch})
                     while True:
                         frame = sub.next_frame(timeout=heartbeat)
                         if sub.closed:
                             break
                         if frame is None:
                             self._chunk({"ping": True,
-                                         "rv": store.current_rv()})
+                                         "rv": store.current_rv(),
+                                         "epoch": hub.epoch})
                             continue
                         if frame.get("relist"):
                             self._chunk({"relist": True,
                                          "rv": frame["rv"],
-                                         "prev": frame.get("prev")})
+                                         "prev": frame.get("prev"),
+                                         "epoch": frame.get(
+                                             "epoch", hub.epoch)})
+                            continue
+                        if frame.get("encoded") is not None:
+                            self._chunk_frame_shared(frame)
                             continue
                         self._chunk({
                             "prev": frame["prev"],
                             "from_rv": frame["from_rv"],
                             "to_rv": frame["to_rv"],
                             "coalesced_from": frame["coalesced_from"],
+                            "epoch": frame.get("epoch", hub.epoch),
                             "events": self._encode_events(
                                 frame["events"])})
                 except (BrokenPipeError, ConnectionResetError, OSError):
                     pass   # client went away: normal stream teardown
                 finally:
                     hub.unsubscribe(sub)
+
+            def _replicate_stream(self, q: dict) -> None:
+                """Leader half of journal replication (docs/design/
+                federation.md): stream contiguous journal ranges to a
+                follower replica as chunked NDJSON, every frame stamped
+                with this replica's newest observed leadership epoch
+                (the fence floor) so a deposed leader's frames are
+                rejectable at the follower. A cursor off the journal
+                window answers a ``gone`` frame — the follower must
+                bootstrap from ``/replicate/snapshot``."""
+                try:
+                    since = int(q.get("since", ["0"])[0])
+                    heartbeat = max(1.0, min(60.0, float(
+                        q.get("heartbeat", ["10"])[0])))
+                except ValueError:
+                    return self._send(400, {"error": "malformed since/"
+                                                     "heartbeat"})
+                self.close_connection = True
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    self._chunk({"hello": True, "rv": store.current_rv(),
+                                 "epoch": store.fence_floor()})
+                    cursor = since
+                    while True:
+                        events, rv, resync = store.events_since(
+                            cursor, heartbeat)
+                        if resync:
+                            self._chunk({"gone": True, "rv": rv,
+                                         "epoch": store.fence_floor()})
+                            return
+                        if not events:
+                            self._chunk({"ping": True, "rv": rv,
+                                         "epoch": store.fence_floor()})
+                            continue
+                        self._chunk({
+                            "from_rv": events[0][0], "to_rv": rv,
+                            "epoch": store.fence_floor(),
+                            "entries": [
+                                [e[0], e[1], e[2],
+                                 encode_object(e[2], e[3])]
+                                for e in events]})
+                        cursor = rv
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass   # follower went away: normal stream teardown
 
             def do_GET(self):
                 parsed = urllib.parse.urlparse(self.path)
@@ -300,6 +396,12 @@ class StoreHTTPServer:
                 if parsed.path == "/watchstream":
                     return self._watchstream(
                         urllib.parse.parse_qs(parsed.query))
+                if parsed.path == "/replicate":
+                    return self._replicate_stream(
+                        urllib.parse.parse_qs(parsed.query))
+                if parsed.path == "/replicate/snapshot":
+                    from ..replication.leader import snapshot_payload
+                    return self._send(200, snapshot_payload(store))
                 if parsed.path == "/watch":
                     q = urllib.parse.parse_qs(parsed.query)
                     since = int(q.get("since", ["0"])[0])

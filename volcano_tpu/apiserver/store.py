@@ -148,6 +148,21 @@ class FencedError(Exception):
     stop writing until it re-acquires leadership (and a fresh token)."""
 
 
+class ReplicationGapError(Exception):
+    """Raised by :meth:`ObjectStore.apply_replicated` when a replicated
+    frame does not extend the follower mirror's journal contiguously
+    (docs/design/federation.md). Carries ``expected``/``got`` rvs so the
+    follower client can run a structured catch-up (re-fetch the missing
+    range, or snapshot-bootstrap when the leader no longer retains it)
+    instead of guessing."""
+
+    def __init__(self, expected: int, got: int):
+        super().__init__(
+            f"replication gap: expected rv {expected}, got {got}")
+        self.expected = expected
+        self.got = got
+
+
 class AdmissionHook:
     """One admission service (reference: pkg/webhooks/router/interface.go:38-48).
 
@@ -382,13 +397,24 @@ class ObjectStore:
             for e in entries:
                 self._journal_parked[e[0]] = e
 
-    def _wait_key_writable_locked(self, kind: str, key: str) -> None:
-        """Block (releasing the lock) while ``key`` has a reserved bulk
-        patch in flight — the write must order after the shard publish."""
-        infl = self._inflight.get(kind)
-        if infl and key in infl:
+    def _wait_journal_settled_locked(self) -> None:
+        """Block (releasing the lock) until every allocated rv has
+        published to the journal (``_rv == _journal_tail``) — the
+        commit-order determinism barrier (docs/design/federation.md).
+
+        EVERY rv allocation waits here first, so rv order is a pure
+        function of commit order: a write can no longer slot before or
+        after an outstanding bulk reservation depending on thread
+        timing (the PR 11 interleaving finding), which is the
+        precondition for any cross-replica consumer keying on rv.
+        A settled journal implies no reservation is outstanding and no
+        key is inflight, so this subsumes both the old per-key write
+        barrier and the same-kind reservation wait. The parking
+        machinery in the sequencer stays as a defensive invariant, but
+        with this barrier no entry should ever park."""
+        if self._rv != self._journal_tail:
             self._flush_cond.wait_for(
-                lambda: key not in self._inflight.get(kind, ()))
+                lambda: self._rv == self._journal_tail)
 
     # -- admission ---------------------------------------------------------
 
@@ -427,6 +453,10 @@ class ObjectStore:
         if derive is not None:
             derive(o)   # after admission: mutating hooks may change the spec
         with self._lock:
+            self._wait_journal_settled_locked()
+            # fence AFTER the settle wait (which releases the lock): a
+            # takeover can happen while this writer queues behind an
+            # in-flight flush, and the stale write must not land then
             self._check_fence_locked(fence)
             key = self.key_of(kind, o)
             if key in self._objects[kind]:
@@ -469,7 +499,7 @@ class ObjectStore:
         if derive is not None:
             derive(o)
         with self._lock:
-            self._wait_key_writable_locked(kind, key)
+            self._wait_journal_settled_locked()
             # fence AFTER the barrier wait (which releases the lock): a
             # takeover can happen while this writer queues behind an
             # in-flight flush, and the stale write must not land then
@@ -627,13 +657,13 @@ class ObjectStore:
         cluster = kind in CLUSTER_SCOPED
         try:
             with self._lock:
-                # phase 1: resolve + (for big bursts) reserve. Waits out
-                # any other in-flight bulk patch on this kind first: two
-                # overlapping reservations on one kind could deadlock on
-                # each other's keys.
-                if self._inflight.get(kind):
-                    self._flush_cond.wait_for(
-                        lambda: not self._inflight.get(kind))
+                # phase 1: resolve + (for big bursts) reserve. Settles
+                # the journal first: a reservation may only be taken
+                # against a fully-published sequencer, so every rv range
+                # is a pure function of commit order (and two
+                # overlapping reservations can't deadlock on each
+                # other's keys).
+                self._wait_journal_settled_locked()
                 # after the wait: a takeover may have happened while this
                 # writer queued behind another flush — check at the last
                 # possible instant before anything is resolved/reserved
@@ -785,11 +815,16 @@ class ObjectStore:
 
         # a bulk patch issued FROM a watch delivery already runs on the
         # echo worker: submitting its deliveries to the same one-thread
-        # pool would deadlock — deliver inline instead (no pipeline)
+        # pool would deadlock — deliver inline instead (no pipeline).
+        # Inline deliveries are DEFERRED until every shard has
+        # published: a handler inside one may write, and the settle
+        # barrier (_wait_journal_settled_locked) would deadlock against
+        # this thread's own still-unpublished shards otherwise.
         inline_echo = getattr(_DELIVERY_CTX, "depth", 0) > 0
         pairs_all: list = []
         published = 0
         deliveries: list = []
+        inline_pending: list = []
         try:
             # everything from here until the last shard publishes sits
             # inside the recovery scope: a failure anywhere (pool
@@ -810,7 +845,7 @@ class ObjectStore:
                 pairs_all.extend(spairs)
                 commit_t = self.clock.now()
                 if epool is None:
-                    deliver_task(spairs, commit_t)
+                    inline_pending.append((spairs, commit_t))
                 else:
                     deliveries.append(
                         epool.submit(deliver_task, spairs, commit_t))
@@ -822,6 +857,10 @@ class ObjectStore:
                     for i, new in enumerate(news):
                         new.metadata.resource_version = base + i + 1
                     self._install_shard(kind, shard, news, base)
+            # deferred inline deliveries run with the journal settled
+            # (still shard order, still before the patch returns)
+            for spairs, commit_t in inline_pending:
+                deliver_task(spairs, commit_t)
             # echo drain: the patch must not return (nor the bind flush
             # release its barrier) with deliveries still in flight
             if deliveries:
@@ -948,7 +987,7 @@ class ObjectStore:
                 raise KeyError(f"{kind} {key!r} not found")
             self._admit(kind, "DELETE", None, old_pre)   # outside the lock
         with self._lock:
-            self._wait_key_writable_locked(kind, key)
+            self._wait_journal_settled_locked()
             # fence after the barrier wait — see update()
             self._check_fence_locked(fence)
             old = self._objects[kind].get(key)
@@ -964,6 +1003,118 @@ class ObjectStore:
             if w.on_delete and w._passes(old):
                 w.on_delete(old)   # removed from the store: exclusive now
         return deleted_rv
+
+    # -- replication: follower mirror install (docs/design/federation.md) ---
+
+    def apply_replicated(self, entries, epoch: Optional[int] = None) -> int:
+        """Install a contiguous run of replicated journal entries at the
+        LEADER'S rvs — the follower mirror's install path. Unlike the
+        RemoteStore informer mirror (which re-stamps mirror-local rvs),
+        the follower keeps the leader's rv on every object, so the
+        anti-entropy fingerprint over ``{key: (rv, obj)}`` views is
+        bit-identical across replicas — the cross-replica divergence
+        audit relies on it.
+
+        ``entries`` is ``[(rv, action, kind, obj)]``, ascending and
+        contiguous; the run must extend the mirror's journal tail
+        exactly (``entries[0].rv == tail + 1``) or
+        :class:`ReplicationGapError` carries ``(expected, got)`` for the
+        follower's structured catch-up. ``epoch`` is the shipping
+        leader's election epoch, checked against the fence floor like
+        any fenced write — a deposed leader's frames raise FencedError
+        before anything mutates. Local watchers see the usual
+        add/update/delete lifecycle (filter flips included). Returns
+        the new journal tail."""
+        if not entries:
+            return self.current_rv()
+        deliveries: list = []
+        with self._lock:
+            self._wait_journal_settled_locked()
+            self._check_fence_locked(epoch)
+            rvs = [int(e[0]) for e in entries]
+            expected = self._journal_tail + 1
+            if rvs[0] != expected:
+                raise ReplicationGapError(expected, rvs[0])
+            for a, b in zip(rvs, rvs[1:]):
+                if b != a + 1:
+                    raise ReplicationGapError(a + 1, b)
+            journal: list = []
+            for rv, (_, action, kind, o) in zip(rvs, entries):
+                objs = self._objects[kind]
+                key = self.key_of(kind, o)
+                old = objs.get(key)
+                if action == "DELETED":
+                    objs.pop(key, None)
+                else:
+                    derive = _DERIVED.get(kind)
+                    if derive is not None:
+                        derive(o)   # re-seed the request memo: HTTP
+                        #             decode dropped the leader's copy
+                    o.metadata.resource_version = rv
+                    objs[key] = o
+                journal.append((rv, action, kind, o))
+                deliveries.append((action, kind, old, o))
+            self._rv = rvs[-1]
+            self._journal_extend_locked(journal)
+            self._flush_cond.notify_all()
+            watches = {k: list(self._watches[k])
+                       for k in {d[1] for d in deliveries}}
+        for action, kind, old, o in deliveries:
+            for w in watches[kind]:
+                self._deliver_replicated(w, action, old, o)
+        return rvs[-1]
+
+    @staticmethod
+    def _deliver_replicated(w: Watch, action: str, old, o) -> None:
+        """One replicated entry through one watch, with the same filter-
+        flip lifecycle semantics as :meth:`update` (the journal only
+        carries the new object; ``old`` is the mirror's prior version,
+        None when the entry is the key's first appearance here)."""
+        if action == "DELETED":
+            if w.on_delete and w._passes(o):
+                w.on_delete(o)
+            return
+        if old is None:
+            if w.on_add and w._passes(o):
+                w.on_add(fast_clone(o))
+            return
+        old_p, new_p = w._passes(old), w._passes(o)
+        if old_p and new_p and w.on_update:
+            w.on_update(old, fast_clone(o))
+        elif not old_p and new_p and w.on_add:
+            w.on_add(fast_clone(o))
+        elif old_p and not new_p and w.on_delete:
+            w.on_delete(old)
+
+    def install_snapshot(self, objects: Dict[str, dict], rv: int,
+                         epoch: Optional[int] = None) -> int:
+        """Replace the mirror's entire object state with a leader
+        snapshot anchored at ``rv`` — the cold-follower bootstrap, and
+        the catch-up path when the leader no longer retains a gapped
+        range. ``objects`` is ``{kind: {key: obj}}`` with every object
+        already carrying its leader rv. The journal clears (history
+        below the anchor is unknown here), so journal cursors below the
+        new tail take the structured relist on their next dispatch —
+        exactly the contract a snapshot restore already has. Local
+        Watch handlers are NOT replayed: the mirror's consumers are
+        journal cursors (the serving hub), which the relist re-anchors."""
+        with self._lock:
+            self._wait_journal_settled_locked()
+            self._check_fence_locked(epoch)
+            for kind in KINDS:
+                incoming = objects.get(kind) or {}
+                derive = _DERIVED.get(kind)
+                if derive is not None:
+                    for o in incoming.values():
+                        derive(o)
+                self._objects[kind] = dict(incoming)
+            self._journal.clear()
+            self._journal_parked.clear()
+            self._trace_ranges.clear()
+            self._rv = self._journal_tail = int(rv)
+            self._journal_cond.notify_all()
+            self._flush_cond.notify_all()
+        return int(rv)
 
     def get(self, kind: str, name: str, namespace: str = "default"):
         key = name if kind in CLUSTER_SCOPED else f"{namespace}/{name}"
@@ -1016,14 +1167,12 @@ class ObjectStore:
         w = Watch(kind, on_add, on_update, on_delete, filter_fn,
                   on_bulk_update=on_bulk_update, filter_attr=filter_attr)
         with self._lock:
-            # wait out an in-flight sharded patch on this kind: its
-            # delivery list was snapshotted at reservation time, so a
-            # watch registered mid-flight would neither appear in that
-            # snapshot nor see the unpublished shards in its sync replay
-            # — it would silently miss part of the burst forever
-            if self._inflight.get(kind):
-                self._flush_cond.wait_for(
-                    lambda: not self._inflight.get(kind))
+            # wait out an in-flight sharded patch: its delivery list was
+            # snapshotted at reservation time, so a watch registered
+            # mid-flight would neither appear in that snapshot nor see
+            # the unpublished shards in its sync replay — it would
+            # silently miss part of the burst forever
+            self._wait_journal_settled_locked()
             self._watches[kind].append(w)
             existing = list(self._objects[kind].values()) if sync else []
         for o in existing:

@@ -8,6 +8,9 @@
     vcctl debug explain [job]   placement decision provenance (one job's
                                 record, or the newest records + the
                                 pruning-readiness aggregates)
+    vcctl debug replication     replica-set state: epoch, follower lag /
+                                applied rvs, gap/bootstrap/fence counters,
+                                last anti-entropy audit
 
 Talks to the metrics server (`--metrics` / $VOLCANO_METRICS, default
 http://127.0.0.1:8080), not the apiserver; `--json` prints the raw
@@ -26,7 +29,7 @@ from typing import List
 DEFAULT_METRICS = os.environ.get("VOLCANO_METRICS",
                                  "http://127.0.0.1:8080")
 VERBS = ("cycles", "pending", "health", "latency", "timeseries",
-         "explain")
+         "explain", "replication")
 
 
 def fetch(server: str, path: str, timeout: float = 10.0):
@@ -224,9 +227,52 @@ def _render_explain(payload: dict) -> str:
     return "\n".join(lines)
 
 
+def _render_replication(payload: dict) -> str:
+    f = payload.get("follower")
+    if f:   # this process IS a follower apiserver replica
+        return (f"follower {f['name']}: epoch={f['epoch']} "
+                f"applied_rv={f['applied_rv']} lag={f.get('lag_rvs')} "
+                f"frames={f['frames_applied']} gaps={f['gaps_detected']} "
+                f"catchup={f['catchup_relists']} "
+                f"bootstraps={f['snapshot_bootstraps']} "
+                f"fenced={f['fenced_frames']}")
+    rs = payload.get("replica_set")
+    if not rs:
+        return "no replica set registered (single-replica deployment)"
+    leader = rs.get("leader") or {}
+    lines = [f"epoch: {rs.get('epoch')}  leader rv={leader.get('rv')} "
+             f"frames_shipped={leader.get('frames_shipped')} "
+             f"events_shipped={leader.get('events_shipped')} "
+             f"snapshots_shipped={leader.get('snapshots_shipped')}"]
+    lag = rs.get("lag_rvs") or {}
+    followers = rs.get("followers") or []
+    if followers:
+        rows = [[f["name"], f["epoch"], f["applied_rv"],
+                 lag.get(f["name"], "-"), f["frames_applied"],
+                 f["gaps_detected"], f["catchup_relists"],
+                 f["snapshot_bootstraps"], f["fenced_frames"]]
+                for f in followers]
+        lines.append(_table(rows, ["follower", "epoch", "applied_rv",
+                                   "lag", "frames", "gaps", "catchup",
+                                   "bootstraps", "fenced"]))
+    if rs.get("dead"):
+        lines.append(f"dead: {', '.join(rs['dead'])}")
+    lines.append(f"cursor handoffs: {rs.get('cursor_handoffs', 0)}")
+    audit = rs.get("last_audit")
+    if audit:
+        lines.append(f"last audit: {audit['verdict']} "
+                     f"@ leader rv {audit['leader_rv']}"
+                     + (f" divergent: {', '.join(audit['divergent'])}"
+                        if audit.get("divergent") else ""))
+    else:
+        lines.append("last audit: (none run)")
+    return "\n".join(lines)
+
+
 _RENDER = {"cycles": _render_cycles, "pending": _render_pending,
            "health": _render_health, "latency": _render_latency,
-           "timeseries": _render_timeseries, "explain": _render_explain}
+           "timeseries": _render_timeseries, "explain": _render_explain,
+           "replication": _render_replication}
 
 
 def dispatch_debug(args) -> int:

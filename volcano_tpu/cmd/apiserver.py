@@ -50,6 +50,21 @@ def main(argv=None) -> int:
     parser.add_argument("--tenant-write-burst", type=float, default=2000.0)
     parser.add_argument("--max-subscriptions", type=int, default=1024,
                         help="per-tenant concurrent watch-stream cap")
+    # federated control plane (docs/design/federation.md): with
+    # --replicate-from this process is a FOLLOWER replica — its store is
+    # a read-only mirror fed from the leader's /replicate journal stream
+    # (snapshot bootstrap on cold start), and its hub serves watch /
+    # watchstream traffic at the leader's rvs.
+    parser.add_argument("--replicate-from", default=None, metavar="URL",
+                        help="leader apiserver URL; makes this replica a "
+                             "follower mirror serving reads and watches")
+    parser.add_argument("--replica-name", default=None,
+                        help="follower replica name (default host:port)")
+    parser.add_argument("--metrics", default=None, metavar="HOST:PORT",
+                        help="also serve the Prometheus /metrics + "
+                             "/debug endpoints (incl. "
+                             "/debug/replication) from this process — "
+                             "the same surface the scheduler exposes")
     parser.add_argument("--version", action="store_true")
     args = parser.parse_args(argv)
     if args.version:
@@ -96,12 +111,31 @@ def main(argv=None) -> int:
         hub = ServingHub(store, shards=args.serving_shards,
                          admission=admission)
         serving.set_active(hub=hub, admission=admission)
+    follower = None
+    if args.replicate_from:
+        from ..replication import set_active
+        from ..replication.follower import (FollowerReplica,
+                                            HTTPReplicationSource)
+        source = HTTPReplicationSource(args.replicate_from)
+        name = args.replica_name or f"{args.host}:{args.port}"
+        follower = FollowerReplica(name, source, store=store, hub=hub)
+        follower.bootstrap()                  # cold-start snapshot
+        follower.start()                      # continuous journal pull
+        set_active(follower=follower)
+    metrics_server = None
+    if args.metrics:
+        from ..metrics.server import MetricsServer
+        mhost, _, mport = args.metrics.rpartition(":")
+        metrics_server = MetricsServer(mhost or "127.0.0.1", int(mport))
+        metrics_server.start()
     server = StoreHTTPServer(store, host=args.host, port=args.port,
                              hub=hub, admission=admission)
     server.start()
-    print(f"vc-apiserver serving on {args.host}:{server.port}", flush=True)
+    role = f"follower of {args.replicate_from}" if follower else "leader"
+    print(f"vc-apiserver ({role}) serving on {args.host}:{server.port}",
+          flush=True)
     stop = threading.Event()
-    if checkpointer is not None:
+    if checkpointer is not None or follower is not None:
         import signal as _signal
 
         def _graceful(signum, frame):
@@ -109,6 +143,10 @@ def main(argv=None) -> int:
         for sig in (_signal.SIGTERM, _signal.SIGINT):
             _signal.signal(sig, _graceful)
     stop.wait()
+    if follower is not None:
+        follower.stop()
+    if metrics_server is not None:
+        metrics_server.stop()
     if checkpointer is not None:
         # stop accepting writes BEFORE the final checkpoint: an acked
         # write landing after the last save would be lost on restart

@@ -30,7 +30,7 @@ _TIME_ATTRS = {"time", "monotonic", "monotonic_ns", "time_ns"}
 _DATETIME_ATTRS = {"now", "utcnow", "today", "fromtimestamp"}
 
 _DEFAULT_SCOPE = ("apiserver/", "cache/", "sim/", "trace/", "serving/",
-                  "plugins/", "scheduler.py")
+                  "plugins/", "replication/", "scheduler.py")
 
 
 class ClockDisciplineRule(Rule):

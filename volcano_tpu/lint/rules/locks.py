@@ -47,6 +47,10 @@ _DEFAULT_SCOPES: Dict[str, Dict[str, Set[str]]] = {
         "guarded": {"_prebuilt", "_incr_snap", "_state_version",
                     "_dirty_structural"},
     },
+    "replication/follower.py": {
+        "locks": {"_lock"},
+        "guarded": {"_epoch", "_applied"},
+    },
 }
 
 

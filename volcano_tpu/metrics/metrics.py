@@ -143,6 +143,7 @@ SERVING_RELISTS = f"{NS}_serving_relists_total"
 SERVING_ADMITTED = f"{NS}_serving_admitted_total"
 SERVING_THROTTLED = f"{NS}_serving_throttled_total"
 SERVING_SHARD_DEPTH = f"{NS}_serving_hub_shard_depth"
+SERVING_SHARD_BACKPRESSURE = f"{NS}_serving_hub_shard_backpressure"
 WATCH_RELISTS = f"{NS}_watch_relists_total"
 # placement explainer + pruning-readiness surface (docs/design/
 # observability.md): per-gang feasible-node-count and top-k
@@ -169,6 +170,22 @@ PADDED_WASTE = f"{NS}_padded_waste_ratio"
 PRUNE_RUNS = f"{NS}_prune_runs_total"
 PRUNE_FALLBACK = f"{NS}_prune_fallback_total"
 PRUNE_UNION_WIDTH = f"{NS}_prune_union_width"
+# federated control plane (docs/design/federation.md): journal frames /
+# events replicated leader->follower, contiguity gaps detected at the
+# follower (each one triggers a structured catch-up), snapshot
+# bootstraps, frames REJECTED because they carried a stale leader epoch
+# (the fencing-token contract — a deposed leader cannot ship history),
+# per-follower replication lag in rvs, cursor handoffs served by a peer
+# replica's hub after failover, and cross-replica anti-entropy
+# fingerprint audits by verdict (verdict="identical"|"divergent")
+REPLICATION_FRAMES = f"{NS}_replication_frames_total"
+REPLICATION_EVENTS = f"{NS}_replication_events_total"
+REPLICATION_GAPS = f"{NS}_replication_gaps_total"
+REPLICATION_SNAPSHOTS = f"{NS}_replication_snapshots_total"
+REPLICATION_FENCED = f"{NS}_replication_fenced_frames_total"
+REPLICATION_LAG = f"{NS}_replication_follower_lag_rvs"
+REPLICATION_HANDOFFS = f"{NS}_replication_cursor_handoffs_total"
+REPLICATION_AUDITS = f"{NS}_replication_fingerprint_audits_total"
 
 # component health registry behind /debug/health: a component absent from
 # the registry is healthy by default; the watchdog (scheduler.py) flips

@@ -42,6 +42,8 @@ DEBUG_ENDPOINTS = {
                       "per-tenant admission counters",
     "/debug/explain": "placement decision provenance (?job=ns/name) + "
                       "pruning-readiness aggregates",
+    "/debug/replication": "replica-set state: epoch, follower lag/applied "
+                          "rvs, gap/bootstrap/fence counters, last audit",
 }
 
 
@@ -83,6 +85,9 @@ def _debug_response(path: str, query: dict):
     if path == "/debug/serving":
         from ..serving import serving_report
         return 200, serving_report()
+    if path == "/debug/replication":
+        from ..replication import replication_report
+        return 200, replication_report()
     if path == "/debug/explain":
         from ..trace import explain
         job = query.get("job")

@@ -1,0 +1,67 @@
+"""Federated control plane: journal replication + cross-replica serving
+(docs/design/federation.md).
+
+One apiserver replica is the LEADER — its store is the write path and
+its rv-sorted journal is the replication log. Every other replica is a
+FOLLOWER: a full store mirror fed by contiguous journal ranges shipped
+from the leader, serving reads and watch/watchstream traffic from its
+own :class:`~volcano_tpu.serving.hub.ServingHub`. The pieces:
+
+* :mod:`.leader` — :class:`ReplicationSource`: the leader half. Collects
+  contiguous journal ranges (object payloads cloned once per ship, so
+  mirrors never alias the leader's live objects) and whole-store
+  snapshots for cold-follower bootstrap, every frame stamped with the
+  leader's fencing epoch.
+* :mod:`.follower` — :class:`FollowerReplica`: the follower half.
+  Applies frames through :meth:`ObjectStore.apply_replicated` at the
+  LEADER's rvs (mirror fingerprints must be identical — this is the
+  opposite of the RemoteStore cache, which re-stamps local rvs), rejects
+  frames carrying a stale epoch (a deposed leader cannot ship history),
+  detects contiguity gaps and recovers via structured catch-up or
+  snapshot bootstrap. :class:`HTTPReplicationSource` is the same
+  contract over the apiserver's chunked-NDJSON ``/replicate`` routes.
+* :mod:`.federation` — :class:`ReplicaSet`: leader + followers, one
+  serving hub per replica (frames annotated with the replica's known
+  leadership epoch), cursor HANDOFF to a peer replica's hub when a
+  replica dies mid-stream, and the cross-replica anti-entropy
+  fingerprint audit (the PR-5 cache machinery pointed across mirrors).
+* :mod:`.gate` — the federation storm gate (`vcctl sim federation` /
+  `make federation-smoke`).
+
+``set_active``/``replication_report`` register the process's live
+ReplicaSet — or, in a follower apiserver process, its own
+:class:`FollowerReplica` — for ``/debug/replication`` (mirroring the
+serving registry).
+"""
+
+from __future__ import annotations
+
+_ACTIVE = {"replica_set": None, "follower": None}
+
+
+def set_active(replica_set=None, follower=None) -> None:
+    """Register the live ReplicaSet (a federated simulator/test
+    harness) and/or this process's own FollowerReplica (a follower
+    apiserver) for /debug/replication."""
+    if replica_set is not None:
+        _ACTIVE["replica_set"] = replica_set
+    if follower is not None:
+        _ACTIVE["follower"] = follower
+
+
+def clear_active() -> None:
+    _ACTIVE["replica_set"] = None
+    _ACTIVE["follower"] = None
+
+
+def replication_report() -> dict:
+    """The /debug/replication payload: leader epoch, per-follower lag
+    in rvs, last fingerprint audit, catch-up relists/bootstraps — from
+    whatever ReplicaSet / FollowerReplica is registered (empty when
+    none is)."""
+    rs = _ACTIVE["replica_set"]
+    f = _ACTIVE["follower"]
+    report = {"replica_set": rs.report() if rs is not None else None}
+    if f is not None:
+        report["follower"] = dict(f.report(), lag_rvs=f.lag())
+    return report

@@ -141,10 +141,12 @@ class Subscription:
         with self.cond:
             if len(self.outbox) >= self.MAX_OUTBOX:
                 # slow consumer: reset via relist instead of growing
-                self.outbox.clear()
                 frame = {"relist": True, "rv": frame.get("to_rv",
                                                          frame.get("rv", 0)),
                          "prev": self.last_framed}
+                if self.hub is not None:
+                    frame["epoch"] = self.hub.epoch
+                self.outbox.clear()
                 self.relists += 1
                 overflowed = True
             self.outbox.append(frame)
@@ -182,6 +184,16 @@ class HubShard:
         with self.lock:
             return sum(len(s.outbox) for s in self.subs)
 
+    def pressure(self) -> tuple:
+        """(total queued frames, worst outbox fill fraction) — the
+        backpressure surface: a fill fraction approaching 1.0 means a
+        subscriber is about to take the overflow-relist reset."""
+        with self.lock:
+            depths = [len(s.outbox) for s in self.subs]
+        total = sum(depths)
+        worst = max(depths, default=0) / float(Subscription.MAX_OUTBOX)
+        return total, worst
+
     # -- dispatch ----------------------------------------------------------
 
     def dispatch_once(self, timeout: float = 0.0) -> int:
@@ -204,7 +216,8 @@ class HubShard:
                 self._relist(sub, tail)
                 frames += 1
         min_cursor = min(sub.cursor for sub in subs)
-        events, tail, resync = self.store.events_since(min_cursor, timeout)
+        burst, tail, resync = self.hub._shared_burst(min_cursor, head,
+                                                     timeout)
         if resync:
             # the window moved between our check and the read (or the
             # journal was force-cleared): re-anchor every lagging cursor
@@ -214,23 +227,37 @@ class HubShard:
                     self._relist(sub, tail)
                     frames += 1
             return frames
-        if not events:
+        if burst is None:
             return frames
-        t0 = time.perf_counter()
-        burst = _BurstIndex(self.store, events)
+        events = burst.events
+        epoch = self.hub.epoch
+        encoder = self.hub.encoder
+        enc = burst.encoded(encoder) if encoder is not None else None
         from bisect import bisect_right
         for sub in subs:
             if sub.cursor >= tail:
                 continue
+            # per-frame latency is attributed per SUBSCRIBER (the clock
+            # starts when this subscriber's selection starts, not when
+            # the round started) — the shared burst index means the
+            # first consumer pays the build and everyone else measures
+            # only their own slice
+            t0 = time.perf_counter()
             start = bisect_right(burst.rvs, sub.cursor)
-            delivered = self._select(sub, burst, start)
+            delivered, idxs = self._select(sub, burst, start)
             considered = len(events) - start
             sub.cursor = tail
             if not delivered:
                 continue   # cursor advanced silently: nothing of interest
             frame = {"prev": sub.last_framed,
                      "from_rv": events[start][0], "to_rv": tail,
-                     "events": delivered, "coalesced_from": considered}
+                     "events": delivered, "coalesced_from": considered,
+                     "epoch": epoch}
+            if enc is not None:
+                # shared per-event object bytes: encoded ONCE per burst,
+                # every subscriber's frame carries refs into the same
+                # list (the wire wrapper re-labels per-sub actions)
+                frame["encoded"] = [enc[i] for i in idxs]
             sub.last_framed = tail
             sub._enqueue(frame)
             sub.frames_sent += 1
@@ -238,14 +265,15 @@ class HubShard:
             frames += 1
             self.hub._note_frame(len(delivered),
                                  (time.perf_counter() - t0) * 1000.0)
-        self.hub._note_depth(self.index, self.depth())
+        self.hub._note_depth(self.index, *self.pressure())
         return frames
 
     def _relist(self, sub: Subscription, tail: int) -> None:
         """Push the structured relist signal and re-anchor the cursor:
         the client must re-list and resume from ``rv`` (exactly the
         informer resync-after-watch-expiry contract)."""
-        sub._enqueue({"relist": True, "rv": tail, "prev": sub.last_framed})
+        sub._enqueue({"relist": True, "rv": tail, "prev": sub.last_framed,
+                      "epoch": self.hub.epoch})
         sub.cursor = tail
         sub.last_framed = tail
         sub._passing.clear()
@@ -260,13 +288,20 @@ class HubShard:
         events, not burst size: the burst index precomputes, once per
         distinct filter per round, the verdict vector, the passing
         indices and a failing-key map — so 1k identically-filtered
-        subscribers pay one classification plus their own slices."""
+        subscribers pay one classification plus their own slices.
+
+        Returns ``(delivered, idxs)`` — the delivered event tuples plus
+        their burst indices, so the caller can attach shared per-event
+        encoded bytes without re-deriving positions."""
         from bisect import bisect_left
         events = burst.events
         kinds = sub.kinds
         if not sub.filtered:
             if kinds is None:
-                return events[start:]
+                # firehose: the tail slice is cached per start index and
+                # SHARED across every unfiltered subscriber at the same
+                # cursor (frames carry refs, never mutate)
+                return burst.tail_slice(start), range(start, len(events))
             out = []
             for kind in kinds:
                 idx = burst.kind_idx().get(kind)
@@ -274,7 +309,7 @@ class HubShard:
                     out.extend(idx[bisect_left(idx, start):])
             if len(kinds) > 1:
                 out.sort()
-            return [events[i] for i in out]
+            return [events[i] for i in out], out
         pass_set, pass_idx = burst.filter_index(sub)
         keys = burst.keys()
         key_idx = burst.key_idx()
@@ -295,6 +330,7 @@ class HubShard:
         if fail_idx:
             cand = sorted(set(cand).union(fail_idx))
         out = []
+        idxs = []
         for i in cand:
             rv, action, kind, o = events[i]
             if kinds is not None and kind not in kinds:
@@ -305,6 +341,7 @@ class HubShard:
                 if old_p:
                     passing.discard(key)
                     out.append((rv, "DELETED", kind, o))
+                    idxs.append(i)
                 continue
             if i in pass_set:
                 passing.add(key)
@@ -312,10 +349,12 @@ class HubShard:
                 # pass->pass is MODIFIED — the four delivery paths of
                 # the store's filtered watches, evaluated hub-side
                 out.append((rv, "MODIFIED" if old_p else "ADDED", kind, o))
+                idxs.append(i)
             elif old_p:
                 passing.discard(key)
                 out.append((rv, "DELETED", kind, o))
-        return out
+                idxs.append(i)
+        return out, idxs
 
     # -- threaded mode -----------------------------------------------------
 
@@ -331,49 +370,85 @@ class HubShard:
 
 
 class _BurstIndex:
-    """Shared per-dispatch-round indexes over one fetched burst: rvs for
-    cursor bisects, (kind, key) per event, per-kind and per-key index
-    lists, the (o, o) pair list the native classifier consumes, and per
-    DISTINCT filter the passing index set. Everything here is computed
-    at most once per round no matter how many subscribers consume it —
-    the server-side cost of 1k identically-filtered watchers is ONE
-    classification."""
+    """Shared indexes over one fetched burst: rvs for cursor bisects,
+    (kind, key) per event, per-kind and per-key index lists, the (o, o)
+    pair list the native classifier consumes, per DISTINCT filter the
+    passing index set, cached firehose tail slices, and (when the hub
+    has an encoder) the per-event encoded object bytes. Everything here
+    is computed at most once per BURST no matter how many subscribers —
+    or how many SHARDS (the hub keeps a small cross-shard cache, see
+    ``ServingHub._shared_burst``) — consume it: the server-side cost of
+    1k identically-filtered watchers is ONE classification and ONE
+    serialization pass.
+
+    Lazy memoization is guarded by an RLock because shard dispatch
+    threads share one index; builders are idempotent so the lock only
+    prevents duplicated work and torn ``_pairs``/``_id2idx`` pairs."""
 
     def __init__(self, store, events: list):
         self.store = store
         self.events = events
         self.rvs = [e[0] for e in events]
+        self._lock = threading.RLock()
         self._keys: Optional[list] = None
         self._kind_idx: Optional[dict] = None
         self._key_idx: Optional[dict] = None
         self._pairs: Optional[list] = None
         self._id2idx: Optional[dict] = None
         self._filters: dict = {}
+        self._slices: dict = {}
+        self._encoded: Optional[list] = None
+        self._encoder = None
 
     def keys(self) -> list:
-        if self._keys is None:
-            key_of = self.store.key_of
-            self._keys = [(e[2], key_of(e[2], e[3]))
-                          for e in self.events]
-        return self._keys
+        with self._lock:
+            if self._keys is None:
+                key_of = self.store.key_of
+                self._keys = [(e[2], key_of(e[2], e[3]))
+                              for e in self.events]
+            return self._keys
 
     def kind_idx(self) -> dict:
-        if self._kind_idx is None:
-            idx: dict = {}
-            for i, e in enumerate(self.events):
-                idx.setdefault(e[2], []).append(i)
-            self._kind_idx = idx
-        return self._kind_idx
+        with self._lock:
+            if self._kind_idx is None:
+                idx: dict = {}
+                for i, e in enumerate(self.events):
+                    idx.setdefault(e[2], []).append(i)
+                self._kind_idx = idx
+            return self._kind_idx
 
     def key_idx(self) -> dict:
         """(kind, key) -> [indices] over the whole burst (shared by
         every filtered subscriber's flip lookup)."""
-        if self._key_idx is None:
-            idx: dict = {}
-            for i, key in enumerate(self.keys()):
-                idx.setdefault(key, []).append(i)
-            self._key_idx = idx
-        return self._key_idx
+        with self._lock:
+            if self._key_idx is None:
+                idx: dict = {}
+                for i, key in enumerate(self.keys()):
+                    idx.setdefault(key, []).append(i)
+                self._key_idx = idx
+            return self._key_idx
+
+    def tail_slice(self, start: int) -> list:
+        """``events[start:]``, cached per start index: N firehose
+        subscribers at the same cursor share ONE slice instead of each
+        copying the burst."""
+        with self._lock:
+            got = self._slices.get(start)
+            if got is None:
+                got = self._slices[start] = self.events[start:]
+            return got
+
+    def encoded(self, encoder) -> list:
+        """Per-event encoded object bytes, serialized ONCE per burst.
+        ``encoder(kind, obj) -> bytes`` is the hub's wire codec; the
+        per-subscriber frame wrapper carries rv/action/kind, so the
+        heavy object payload is byte-shared even when a filtered
+        subscriber re-labels the action."""
+        with self._lock:
+            if self._encoded is None or self._encoder is not encoder:
+                self._encoded = [encoder(e[2], e[3]) for e in self.events]
+                self._encoder = encoder
+            return self._encoded
 
     def _pair_list(self) -> list:
         if self._pairs is None:
@@ -388,33 +463,34 @@ class _BurstIndex:
 
     def filter_index(self, sub: Subscription) -> tuple:
         """(pass_set, pass_idx) for the subscriber's filter, computed
-        once per distinct filter per round — natively via the PR-8
+        once per distinct filter per burst — natively via the PR-8
         ``attr_eq_filter_pairs`` entry for declared attribute equalities
         ((o, o) pairs: pass->pass membership IS the verdict, one C call
         per burst per filter), Python ``filter_fn`` otherwise."""
-        fkey = sub.filter_key()
-        got = self._filters.get(fkey)
-        if got is not None:
-            return got
-        events = self.events
-        pass_idx = None
-        if sub.filter_attr is not None and sub.filter_fn is None:
-            fm = _native()
-            if fm is not None:
-                (a0, a1), exp = sub.filter_attr
-                pairs = self._pair_list()
-                try:
-                    delivery, _ = fm.attr_eq_filter_pairs(pairs, a0, a1,
-                                                          exp)
-                    id2idx = self._id2idx
-                    pass_idx = sorted(id2idx[id(p)] for p in delivery)
-                except Exception:
-                    pass_idx = None
-        if pass_idx is None:
-            pass_idx = [i for i, e in enumerate(events)
-                        if sub._passes(e[3])]
-        self._filters[fkey] = (set(pass_idx), pass_idx)
-        return self._filters[fkey]
+        with self._lock:
+            fkey = sub.filter_key()
+            got = self._filters.get(fkey)
+            if got is not None:
+                return got
+            events = self.events
+            pass_idx = None
+            if sub.filter_attr is not None and sub.filter_fn is None:
+                fm = _native()
+                if fm is not None:
+                    (a0, a1), exp = sub.filter_attr
+                    pairs = self._pair_list()
+                    try:
+                        delivery, _ = fm.attr_eq_filter_pairs(pairs, a0,
+                                                              a1, exp)
+                        id2idx = self._id2idx
+                        pass_idx = sorted(id2idx[id(p)] for p in delivery)
+                    except Exception:
+                        pass_idx = None
+            if pass_idx is None:
+                pass_idx = [i for i, e in enumerate(events)
+                            if sub._passes(e[3])]
+            self._filters[fkey] = (set(pass_idx), pass_idx)
+            return self._filters[fkey]
 
 
 class ServingHub:
@@ -422,21 +498,72 @@ class ServingHub:
 
     def __init__(self, store: ObjectStore, shards: int = 4,
                  admission: Optional[AdmissionController] = None,
-                 poll_timeout: float = 0.5):
+                 poll_timeout: float = 0.5, epoch: int = 0,
+                 encoder: Optional[Callable] = None):
         self.store = store
         self.admission = admission
         self.poll_timeout = poll_timeout
+        # replica epoch stamped into every frame: a federated client
+        # whose cursor is handed to a PEER replica's hub sees the epoch
+        # change and knows the prev-chain now names a different journal
+        # mirror (docs/design/federation.md)
+        self.epoch = int(epoch)
+        # optional wire codec ``(kind, obj) -> bytes``; when set, frames
+        # carry shared per-event encoded payloads (see _BurstIndex)
+        self.encoder = encoder
         self.shards = [HubShard(i, store, self)
                        for i in range(max(1, int(shards)))]
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._lock = threading.Lock()
+        # small cross-shard burst cache: 8 shards fetching overlapping
+        # journal ranges in the same storm reuse ONE index + encoding
+        # (keyed by rv coverage; entries invalidated by window checks)
+        self._bursts: deque = deque(maxlen=4)
+        self._burst_lock = threading.Lock()
         # bounded rolling window of per-frame fan-out latencies (ms) for
         # the bench percentiles; the histogram metric is the full record
         self.fanout_ms: deque = deque(maxlen=65536)
         self.frames_total = 0
         self.events_total = 0
         self.relists_total = 0
+
+    # -- shared burst cache --------------------------------------------------
+
+    def _shared_burst(self, cursor: int, head: int,
+                      timeout: float) -> tuple:
+        """``(burst, tail, resync)`` covering ``(cursor, tail]``. A
+        cached burst is reused when it starts exactly where this shard
+        needs to resume AND is still inside the journal window (a
+        snapshot install or force-clear moves ``head`` past every stale
+        burst, invalidating the cache for free). Reuse may serve a tail
+        slightly behind the store head — the shard's next round catches
+        up; what it never does is skip or reorder journal rvs."""
+        with self._burst_lock:
+            for b in self._bursts:
+                if (b.rvs and b.rvs[0] >= head
+                        and b.rvs[0] <= cursor + 1 <= b.rvs[-1]):
+                    return b, b.rvs[-1], False
+        events, tail, resync = self.store.events_since(cursor, timeout)
+        if resync:
+            return None, tail, True
+        if not events:
+            return None, tail, False
+        burst = _BurstIndex(self.store, events)
+        with self._burst_lock:
+            self._bursts.appendleft(burst)
+        return burst, tail, False
+
+    def clear_bursts(self) -> None:
+        """Drop cached bursts (a follower calls this after a snapshot
+        install replaces the mirror wholesale)."""
+        with self._burst_lock:
+            self._bursts.clear()
+
+    def set_epoch(self, epoch: int) -> None:
+        """Advance the replica epoch stamped into frames (leadership
+        changed underneath this replica's mirror)."""
+        self.epoch = int(epoch)
 
     # -- subscriber lifecycle ----------------------------------------------
 
@@ -554,10 +681,13 @@ class ServingHub:
         except Exception:
             pass
 
-    def _note_depth(self, shard: int, depth: int) -> None:
+    def _note_depth(self, shard: int, depth: int,
+                    backpressure: float = 0.0) -> None:
         try:
             from ..metrics import metrics as m
             m.set_gauge(m.SERVING_SHARD_DEPTH, depth, shard=str(shard))
+            m.set_gauge(m.SERVING_SHARD_BACKPRESSURE,
+                        round(backpressure, 4), shard=str(shard))
         except Exception:
             pass
 
@@ -571,10 +701,14 @@ class ServingHub:
                 "p99": round(at(0.99), 3), "count": len(lat)}
 
     def report(self) -> dict:
+        pressures = {s.index: s.pressure() for s in self.shards}
         return {
+            "epoch": self.epoch,
             "shards": len(self.shards),
             "subscribers": self.subscriber_count(),
-            "shard_depths": {s.index: s.depth() for s in self.shards},
+            "shard_depths": {i: p[0] for i, p in pressures.items()},
+            "shard_backpressure": {i: round(p[1], 4)
+                                   for i, p in pressures.items()},
             "frames_total": self.frames_total,
             "events_total": self.events_total,
             "relists_total": self.relists_total,

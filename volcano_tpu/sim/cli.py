@@ -169,6 +169,29 @@ def add_sim_parser(sub) -> None:
     storm.add_argument("--drop-rate", type=float, default=0.03)
     storm.add_argument("--json", action="store_true")
 
+    fed = sim.add_parser(
+        "federation", help="CI gate (make federation-smoke): federated "
+                           "control plane — the bind storm on the "
+                           "leader replicated to follower mirrors, 1k+ "
+                           "subscribers served across 3 replicas' hubs, "
+                           "one replica killed mid-storm (cursors hand "
+                           "off to peers), a forced journal gap "
+                           "(snapshot bootstrap) and a deposed-leader "
+                           "frame (fenced); every cursor must converge "
+                           "with zero unrecovered gaps, every settled "
+                           "mirror must fingerprint-identical to the "
+                           "leader, and the double run must be "
+                           "bit-identical on bind AND ledger "
+                           "fingerprints")
+    fed.add_argument("--seed", type=int, default=43)
+    fed.add_argument("--ticks", type=int, default=60)
+    fed.add_argument("--nodes", type=int, default=128)
+    fed.add_argument("--subscribers", type=int, default=1024)
+    fed.add_argument("--shards", type=int, default=4)
+    fed.add_argument("--followers", type=int, default=2)
+    fed.add_argument("--drop-rate", type=float, default=0.02)
+    fed.add_argument("--json", action="store_true")
+
     exp = sim.add_parser(
         "explain", help="CI gate (make explain-smoke): constrained churn "
                         "+ a preemption storm with the placement "
@@ -1097,6 +1120,98 @@ def dispatch_sim(args) -> int:
             for name, ok in checks.items():
                 print(f"  {name}: {'ok' if ok else 'FAIL'}")
             print(f"storm-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
+        return 0 if verdict["pass"] else 1
+
+    if args.verb == "federation":
+        from ..framework.solver import reset_breaker
+        from ..metrics import metrics as _metrics
+        from ..replication.gate import run_federation
+
+        def one_run():
+            reset_breaker()
+            _metrics.reset()
+            return run_federation(
+                seed=args.seed, ticks=args.ticks, nodes=args.nodes,
+                subscribers=args.subscribers, shards=args.shards,
+                drop_rate=args.drop_rate, followers=args.followers)
+
+        v1 = one_run()
+        v2 = one_run()
+        checks = {
+            # the engine's invariant catalog stayed clean in both runs
+            "no_violations": v1["violations"] == 0
+                             and v2["violations"] == 0,
+            # every cursor — including every handed-off one — reached
+            # the final leader rv on whichever replica now serves it
+            "all_converged": v1["converged"] == v1["subscribers"]
+                             and v2["converged"] == v2["subscribers"],
+            "zero_gaps": v1["gaps_unrecovered"] == 0
+                         and v2["gaps_unrecovered"] == 0,
+            # a replica died mid-storm and its cursors moved to peers
+            "replica_killed": len(v1["dead"]) >= 1,
+            "cursors_handed_off": v1["cursor_handoffs"] >= 1
+                                  and v1["handed_off_clients"] >= 1,
+            # the deposed leader's stale-epoch frame was fenced
+            "stale_leader_fenced": v1["fenced_frames"] >= 1
+                                   and v2["fenced_frames"] >= 1,
+            # the forced journal gap took the snapshot-bootstrap path
+            "snapshot_bootstrap_taken": v1["snapshot_bootstraps"] >= 1,
+            # every settled mirror fingerprints identical to the leader
+            # (the PR-5 anti-entropy machinery pointed across replicas)
+            "mirrors_identical": v1["audit_verdict"] == "identical"
+                                 and v2["audit_verdict"] == "identical",
+            # client-side faults provably fired and recovered
+            "faults_fired": v1["frames_dropped"] > 0
+                            and v1["gaps_detected"] > 0,
+            "coalesced_delivery": v1["coalesce_ratio"] >= 5.0,
+            # the storm gate's determinism contract: decision outputs
+            # bit-identical (rv COUNTS may differ — async status
+            # writers commit a timing-dependent number of no-decision
+            # updates; rv ORDER per commit order is gated by
+            # tests/test_replication.py's double-run identity test)
+            "deterministic_replay":
+                v1["bind_fingerprint"] == v2["bind_fingerprint"]
+                and v1["ledger_fingerprint"] == v2["ledger_fingerprint"]
+                and v1["watch_drops"] == v2["watch_drops"]
+                and v1["cursor_handoffs"] == v2["cursor_handoffs"],
+        }
+        verdict = {
+            "federation": v1["storm"],
+            "epoch": v1["epoch"],
+            "replicas": v1["replicas"],
+            "dead": v1["dead"],
+            "subscribers": v1["subscribers"],
+            "converged": v1["converged"],
+            "cursor_handoffs": v1["cursor_handoffs"],
+            "fenced_frames": v1["fenced_frames"],
+            "snapshot_bootstraps": v1["snapshot_bootstraps"],
+            "catchup_relists": v1["catchup_relists"],
+            "follower_lag_rvs": v1["follower_lag_rvs"],
+            "audit_verdict": v1["audit_verdict"],
+            "coalesce_ratio": v1["coalesce_ratio"],
+            "relists": v1["relists"],
+            "fanout_ms": v1["fanout_ms"],
+            "checks": checks,
+            "pass": all(checks.values()),
+        }
+        if args.json:
+            print(json.dumps(verdict, indent=1))
+        else:
+            _print_summary(v1["storm"], False)
+            print(f"replicas={v1['replicas']} dead={v1['dead']} "
+                  f"epoch={v1['epoch']} "
+                  f"subscribers={v1['subscribers']} "
+                  f"converged={v1['converged']} "
+                  f"handoffs={v1['cursor_handoffs']} "
+                  f"fenced={v1['fenced_frames']} "
+                  f"bootstraps={v1['snapshot_bootstraps']}")
+            print(f"audit: {v1['audit_verdict']} "
+                  f"(divergent: {v1['audit_divergent']}) "
+                  f"lag: {v1['follower_lag_rvs']}")
+            for name, ok in checks.items():
+                print(f"  {name}: {'ok' if ok else 'FAIL'}")
+            print(f"federation-smoke: "
+                  f"{'PASS' if verdict['pass'] else 'FAIL'}")
         return 0 if verdict["pass"] else 1
 
     if args.verb == "explain":

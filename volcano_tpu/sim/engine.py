@@ -344,7 +344,9 @@ class SimEngine:
             from .faults import FlakyWatch
             self._flaky_watch = FlakyWatch(seed=f.seed,
                                            drop_rate=f.watch_drop_rate,
-                                           delay_rate=f.watch_delay_rate)
+                                           delay_rate=f.watch_delay_rate,
+                                           coin=getattr(f, "watch_coin",
+                                                        "seq"))
         for w in self.cache._watches:
             if w.kind == "pods":
                 self._flaky_watch.wrap(w)

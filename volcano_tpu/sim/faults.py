@@ -67,6 +67,14 @@ class FaultConfig:
     # one-tick delay — divergence for the anti-entropy pass to repair
     watch_drop_rate: float = 0.0
     watch_delay_rate: float = 0.0
+    # fault-coin identity: "seq" keys on (key, per-key delivery
+    # sequence) — the PR 14 commit-order re-key that sidestepped the
+    # timing-dependent rv interleaving; "rv" keys on the delivered
+    # object's resource_version directly. With the store's settle
+    # barrier (docs/design/federation.md) rv assignment is itself a
+    # pure function of commit order, so "rv" is now just as stable —
+    # the federation gate runs it as the determinism PROOF.
+    watch_coin: str = "seq"
 
 
 class FlakyBinder(FakeBinder):
@@ -180,10 +188,14 @@ class FlakyWatch:
     """
 
     def __init__(self, seed: int = 0, drop_rate: float = 0.0,
-                 delay_rate: float = 0.0):
+                 delay_rate: float = 0.0, coin: str = "seq"):
         self.seed = seed
         self.drop_rate = drop_rate
         self.delay_rate = delay_rate
+        # "seq" (default) or "rv" — see FaultConfig.watch_coin. The rv
+        # mode deliberately re-creates the coin PR 11 had to retire:
+        # under the settle barrier it must be double-run stable again.
+        self.coin = coin
         self.dropped = 0
         self.delayed = 0
         self._watch = None
@@ -199,9 +211,12 @@ class FlakyWatch:
 
     def _coin(self, action: str, o) -> int:
         key = o.metadata.key()
-        seq = self._key_seq.get(key, 0) + 1
-        self._key_seq[key] = seq
-        h = zlib.crc32(f"{action}:{key}:{seq}:{self.seed}".encode())
+        if self.coin == "rv":
+            ident = o.metadata.resource_version
+        else:
+            ident = self._key_seq.get(key, 0) + 1
+            self._key_seq[key] = ident
+        h = zlib.crc32(f"{action}:{key}:{ident}:{self.seed}".encode())
         u = (h % 10_000) / 10_000.0
         if u < self.drop_rate:
             return self._DROP
