@@ -5,7 +5,8 @@ PYTHON ?= python
 .PHONY: test unit-test e2e bench bench-all bench-check multichip-dryrun \
 	deploy deploy-up trace-smoke sim-smoke flush-bench chaos-smoke \
 	failover-smoke obs-smoke incr-smoke multichip-smoke constraint-smoke \
-	storm-smoke explain-smoke prune-smoke federation-smoke lint sanitize
+	storm-smoke explain-smoke prune-smoke federation-smoke \
+	federation-proc-smoke lint sanitize
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -217,6 +218,24 @@ prune-smoke: explain-smoke
 # bit-identical on bind AND ledger fingerprints.
 federation-smoke: prune-smoke
 	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli federation
+
+# federation PROCESS-mode chaos gate, after federation-smoke: three
+# real vc-apiserver OS processes behind deterministic fault-injecting
+# TCP proxies (seeded connection resets, byte stalls, mid-frame
+# truncations, half-open partitions, lease-push drops), elector-driven
+# epochs end-to-end. Episode A half-open-partitions the leader until a
+# follower's elector takes the lease (fencing token bumped) and one
+# deposed-regime write is rejected 412; episode B SIGKILLs the new
+# leader mid-flush (writes fail fast with 503 + Retry-After, the
+# original replica takes over, the supervisor restarts the corpse as a
+# snapshot-bootstrapping follower). Exit 1 unless both takeovers are
+# elector-driven, every watch cursor converged with zero lost or
+# duplicated events, every acked write survived (post-replay diff
+# empty), the cross-replica audit is identical, and a double run is
+# bit-identical on the bind AND ledger content fingerprints — the
+# whole gate watchdogged.
+federation-proc-smoke: federation-smoke
+	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli federation --procs
 
 # multi-chip sharding dryrun on the virtual CPU mesh (the raw
 # shard_map program + full-pipeline one-shot; multichip-smoke is the

@@ -914,6 +914,37 @@ def try_federation_worker(n_tasks: int, n_nodes: int, watchers: int,
         return None
 
 
+def try_federation_procs_worker():
+    """Process-mode federation chaos leg (docs/design/federation.md
+    "process mode") — BENCH_r15 onward: the run_federation_procs gate
+    at a bench-sized population, reported as the fed_proc_* columns
+    (elector takeovers, client failovers, zero lost events). The gate
+    spawns its own apiserver children and carries its own watchdog, so
+    a hang cannot take the bench down with it."""
+    timeout_s = float(os.environ.get("VOLCANO_BENCH_FED_PROC_TIMEOUT",
+                                     300))
+    log(f"running federation process-mode chaos gate "
+        f"(3 OS-process replicas, watchdog {timeout_s:.0f}s)")
+    try:
+        from volcano_tpu.replication.chaos import run_federation_procs
+        v = run_federation_procs(seed=43, subscribers=1024, pods=192,
+                                 watchdog_s=timeout_s)
+    except Exception as e:
+        log(f"federation proc gate failed ({e})")
+        return None
+    if v.get("watchdog_fired") or not v.get("replicas_ready"):
+        log("federation proc gate incomplete (watchdog/startup)")
+        return None
+    return {
+        "fed_proc_takeovers": v.get("takeovers"),
+        "fed_proc_client_failovers": v.get("client_failovers"),
+        "fed_proc_lost_events": v.get("lost_events"),
+        "fed_proc_fenced_writes": v.get("fenced_deposed_writes"),
+        "fed_proc_supervisor_restarts": v.get("supervisor_restarts"),
+        "fed_proc_elapsed_s": v.get("elapsed_s"),
+    }
+
+
 def write_bench_row(row: dict) -> None:
     """Persist the headline row (BENCH_r14.json by default; override or
     disable with VOLCANO_BENCH_ROW_OUT) with a machine-calibration
@@ -1470,6 +1501,15 @@ def main() -> None:
                 log("federation worker failed; row ships without the "
                     "federated serving columns (bench-check will flag "
                     "it)")
+            # process-mode federation chaos leg — BENCH_r15 onward:
+            # 3 OS-process replicas behind fault-injecting proxies,
+            # leader SIGKILL + partition episodes; gated by bench_check
+            pres = try_federation_procs_worker()
+            if pres is not None:
+                row.update(pres)
+            else:
+                log("federation proc gate failed; row ships without "
+                    "the fed_proc_* columns (bench-check will flag it)")
             print(json.dumps(row))
             write_bench_row(row)
             return

@@ -499,3 +499,276 @@ class TestRvDeterminism:
         assert r1.bind_fingerprint() == r2.bind_fingerprint()
         assert r1.ledger.get("fingerprint") == \
             r2.ledger.get("fingerprint")
+
+
+# ---------------------------------------------------------------------------
+# process mode (docs/design/federation.md "Process mode"): snapshot
+# atomicity, elector-driven fencing, shared seeded backoff, and client
+# replica failover
+# ---------------------------------------------------------------------------
+
+class TestSnapshotBootstrapAtomicity:
+    """An interrupted or malformed snapshot transfer must leave the
+    mirror EXACTLY as it was — the retry starts from scratch against
+    untouched state. Red before install_snapshot/apply_replicated
+    staged derivation ahead of mutation: a pod raising in _derive_pod
+    mid-install used to leave a mix of new kinds over old ones."""
+
+    @staticmethod
+    def _corrupt(pod):
+        # a malformed transfer artifact: derive (resource_request)
+        # raises on it, and no memo hides the parse
+        pod.spec.containers = None
+        pod.__dict__.pop("_rr", None)
+        return pod
+
+    def test_malformed_snapshot_leaves_mirror_untouched(self):
+        leader = _leader(3)
+        mirror = ObjectStore()
+        objects, rv, epoch = ReplicationSource(leader, epoch=1).snapshot()
+        mirror.install_snapshot(objects, rv, epoch=epoch)
+        before = _fingerprints(mirror)
+
+        for i in range(3):
+            leader.create("pods", _pod("default", f"late-{i}"))
+        bad, new_rv, epoch = ReplicationSource(leader, epoch=1).snapshot()
+        self._corrupt(next(iter(bad["pods"].values())))
+        with pytest.raises(TypeError):
+            mirror.install_snapshot(bad, new_rv, epoch=epoch)
+        # all-or-nothing: the failed transfer changed NOTHING
+        assert mirror.current_rv() == rv
+        assert _fingerprints(mirror) == before
+
+        # the retry is a fresh transfer, not a resume of the broken one
+        good, new_rv, epoch = ReplicationSource(leader, epoch=1).snapshot()
+        assert mirror.install_snapshot(good, new_rv, epoch=epoch) == new_rv
+        assert _fingerprints(mirror) == _fingerprints(leader)
+
+    def test_malformed_frame_leaves_mirror_untouched(self):
+        leader = _leader(4)
+        entries, _, _, epoch = ReplicationSource(leader, epoch=1).collect(0)
+        mirror = ObjectStore()
+        bad = [(rv, a, k, o) for rv, a, k, o in entries]
+        self._corrupt(bad[2][3])
+        with pytest.raises(TypeError):
+            mirror.apply_replicated(bad, epoch=epoch)
+        assert mirror.current_rv() == 0
+        assert not mirror.list_refs("pods")
+        entries, _, _, epoch = ReplicationSource(leader, epoch=1).collect(0)
+        mirror.apply_replicated(entries, epoch=epoch)
+        assert _fingerprints(mirror) == _fingerprints(leader)
+
+
+class TestElectorRestartFencing:
+    """EpochElector on a virtual clock across a process restart: the
+    new incarnation shares the identity but NOT the in-memory token —
+    re-acquisition bumps past the stored token, so every write of the
+    previous self is fenced (election.py's restart() seam)."""
+
+    def test_same_identity_restart_fences_previous_self(self):
+        from volcano_tpu.replication.election import EpochElector, LeaseBoard
+        from volcano_tpu.utils.clock import FakeClock
+
+        clock = FakeClock(100.0)
+        store = ObjectStore()
+        board = LeaseBoard(store=store, clock=clock)
+        tokens = []
+        e = EpochElector("r0", board, on_promote=tokens.append,
+                         lease_duration=10.0, retry_period=1.0,
+                         clock=clock)
+        assert e.step() and e.is_leader()
+        assert tokens == [1]
+        assert store.fence_floor() == 1
+        store.create("pods", _pod("default", "pre"), fence=1)
+
+        # crash + same-identity restart WITHIN the lease window: the
+        # holder==identity rule re-acquires immediately — with a HIGHER
+        # token, never the old one
+        clock.advance(2.0)
+        e.restart()
+        assert e.step() and e.is_leader()
+        assert tokens == [1, 2]
+        assert board.peek()["token"] == 2
+        assert store.fence_floor() == 2
+
+        # the previous self's late write dies at the fence
+        with pytest.raises(FencedError):
+            store.create("pods", _pod("default", "late"), fence=1)
+        store.create("pods", _pod("default", "post"), fence=2)
+
+    def test_lapsed_lease_lost_to_peer_then_fenced(self):
+        from volcano_tpu.replication.election import EpochElector, LeaseBoard
+        from volcano_tpu.utils.clock import FakeClock
+
+        clock = FakeClock(0.0)
+        store = ObjectStore()
+        board = LeaseBoard(store=store, clock=clock)
+        t0, t1 = [], []
+        e0 = EpochElector("r0", board, on_promote=t0.append,
+                          lease_duration=5.0, retry_period=1.0,
+                          clock=clock)
+        e1 = EpochElector("r1", board, on_promote=t1.append,
+                          lease_duration=5.0, retry_period=1.0,
+                          clock=clock)
+        assert e0.step()
+        assert not e1.step()            # lease held and live
+        clock.advance(6.0)              # r0 stops renewing; lease lapses
+        assert e1.step() and t1 == [2]  # the peer wins with a bumped token
+        assert store.fence_floor() == 2
+        with pytest.raises(FencedError):
+            store.create("pods", _pod("default", "deposed"), fence=1)
+
+
+class TestSeededBackoffShared:
+    """utils/backoff.seeded_backoff is THE retry pacer — the
+    replication follower and the failover client share it (no third
+    ad-hoc loop), and its jitter is bounded and deterministic."""
+
+    def test_jitter_bounds_and_determinism(self):
+        from volcano_tpu.utils.backoff import seeded_backoff
+        for key in ("f1", "store-client:GET:/apis/pods", "fleet:w-3"):
+            for attempt in (1, 2, 3, 6, 11):
+                full = min(2.0, 0.1 * 2.0 ** (attempt - 1))
+                d = seeded_backoff(key, attempt, 0.1, 2.0, seed=7)
+                # jitter window [0.5, 1.0) of the exponential delay
+                assert full * 0.5 <= d < full
+                assert d == seeded_backoff(key, attempt, 0.1, 2.0,
+                                           seed=7)
+        # base <= 0 disables pacing entirely (the test knob)
+        assert seeded_backoff("k", 5, 0.0, 2.0) == 0.0
+        # the jitter actually varies across keys/attempts/seeds
+        draws = {round(seeded_backoff(k, a, 1.0, 64.0, seed=s) /
+                       min(64.0, 2.0 ** (a - 1)), 6)
+                 for k in ("a", "b") for a in (1, 2, 3)
+                 for s in (0, 1)}
+        assert len(draws) > 6
+
+    def test_follower_and_client_share_the_pacer(self):
+        import volcano_tpu.apiserver.http as http_mod
+        import volcano_tpu.apiserver.remote as remote_mod
+        import volcano_tpu.replication.follower as follower_mod
+        from volcano_tpu.utils import backoff
+        assert http_mod.seeded_backoff is backoff.seeded_backoff
+        assert follower_mod.seeded_backoff is backoff.seeded_backoff
+        assert remote_mod.seeded_backoff is backoff.seeded_backoff
+
+
+class TestClientReplicaFailover:
+    """StoreClient / RemoteStore endpoint-list failover (docs/design/
+    federation.md "Client replica failover"): reads rotate off a dead
+    endpoint, writes re-discover the leader, and the RemoteStore watch
+    stream survives a leader kill by migrating its cursor to a peer
+    replica with zero lost events."""
+
+    def _serve(self, store, hub=None):
+        server = StoreHTTPServer(store, port=0, hub=hub)
+        server.start()
+        return server, f"http://127.0.0.1:{server.port}"
+
+    def test_reads_rotate_and_writes_rediscover_deterministically(self):
+        from volcano_tpu.apiserver.http import StoreClient
+        s1, s2 = ObjectStore(), ObjectStore()
+        s2.create("pods", _pod("default", "on-two"))
+        srv1, url1 = self._serve(s1)
+        srv2, url2 = self._serve(s2)
+        srv1.stop()     # endpoint 1 dead before the client ever lands
+        try:
+            def run(cid):
+                c = StoreClient([url1, url2], timeout=2.0, client_id=cid)
+                assert c.get("pods", "on-two") is not None
+                c.create("pods", _pod("default", f"via-{cid}"))
+                return c.failovers, c.base_url
+            # the read rotates off the dead endpoint, the write
+            # re-discovers the standalone leader — and a second client
+            # under the same seeded pacing lands identically
+            assert run("c-a") == run("c-b")
+            assert s2.get("pods", "via-c-a") is not None
+            assert s2.get("pods", "via-c-b") is not None
+        finally:
+            srv2.stop()
+
+    def test_fenced_write_rediscovers_but_never_silently_retries(self):
+        from volcano_tpu.apiserver.http import ApiError, StoreClient
+        s1, s2 = ObjectStore(), ObjectStore()
+        s1.advance_fence(5)
+        srv1, url1 = self._serve(s1)
+        srv2, url2 = self._serve(s2)
+        try:
+            c = StoreClient([url1, url2], timeout=2.0, client_id="f")
+            with pytest.raises(ApiError) as ei:
+                c.create("pods", _pod("default", "stale"), fence=3)
+            assert ei.value.code == 412
+            assert c.leader_redirects == 1
+            # the rejection surfaced — nothing landed anywhere
+            assert s1.get("pods", "stale") is None
+            assert s2.get("pods", "stale") is None
+        finally:
+            srv1.stop()
+            srv2.stop()
+
+    def test_remotestore_watch_survives_leader_kill(self):
+        import time as _time
+
+        from volcano_tpu.apiserver.remote import RemoteStore
+
+        leader = _leader(0)
+        lhub = ServingHub(leader, shards=1, poll_timeout=0.2)
+        lsrv, lurl = self._serve(leader, hub=lhub)
+        followers, servers, urls = [], [lsrv], [lurl]
+        try:
+            for i in (1, 2):
+                f = FollowerReplica(f"f{i}", HTTPReplicationSource(lurl))
+                f.sync_to_head()
+                hub = ServingHub(f.store, shards=1, poll_timeout=0.2)
+                srv, url = self._serve(f.store, hub=hub)
+                followers.append(f)
+                servers.append(srv)
+                urls.append(url)
+
+            rs = RemoteStore(urls, poll_timeout=1.0)
+            rs.run()
+            try:
+                for i in range(4):
+                    rs.create("pods", _pod("default", f"pre-{i}"))
+                for f in followers:
+                    f.sync_to_head()
+                deadline = _time.monotonic() + 10.0
+                while _time.monotonic() < deadline and \
+                        rs.mirror.get("pods", "pre-3") is None:
+                    _time.sleep(0.05)
+                assert rs.mirror.get("pods", "pre-3") is not None
+
+                # kill the leader: stop its server AND sever the held
+                # stream (a live process kill closes the socket; in-proc
+                # the handler thread owns it, so close via the hub)
+                prev_tail = leader.current_rv()
+                lsrv.stop()
+                for shard in lhub.shards:
+                    for sub in list(shard.subs):
+                        shard.remove(sub)
+
+                # the regime continues on the mirrors: apply the new
+                # leader's frames to both peers, then the failed-over
+                # stream must deliver them with zero lost events
+                for i in range(3):
+                    leader.create("pods", _pod("default", f"post-{i}"))
+                entries, _, gone, _ = ReplicationSource(
+                    leader, epoch=1).collect(prev_tail)
+                assert not gone
+                for f in followers:
+                    f.store.apply_replicated(entries, epoch=1)
+
+                deadline = _time.monotonic() + 20.0
+                while _time.monotonic() < deadline and \
+                        rs.mirror.get("pods", "post-2") is None:
+                    _time.sleep(0.05)
+                assert rs.watch_failovers >= 1
+                for i in range(4):
+                    assert rs.mirror.get("pods", f"pre-{i}") is not None
+                for i in range(3):
+                    assert rs.mirror.get("pods", f"post-{i}") is not None
+            finally:
+                rs.stop()
+        finally:
+            for srv in servers[1:]:
+                srv.stop()

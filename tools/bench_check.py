@@ -489,6 +489,48 @@ def check_federation(fresh: dict, failures: list,
                 "must at least halve the r13 fan-out p99")
 
 
+def check_federation_procs(fresh: dict, failures: list) -> None:
+    """The round-15 process-mode federation columns (bench.py's
+    federation proc gate: 3 OS-process replicas behind fault-injecting
+    proxies, leader SIGKILL + partition episodes, elector takeovers,
+    client replica failover): required on every fresh row. Lost events
+    must be exactly zero — the chaos run is only a pass when every
+    watch cursor rode out both takeovers without a gap."""
+    required = ("fed_proc_takeovers", "fed_proc_client_failovers",
+                "fed_proc_lost_events")
+    missing = [k for k in required if fresh.get(k) is None]
+    if missing:
+        failures.append(
+            f"federation proc columns missing: {', '.join(missing)} — "
+            "the round-15 process-mode chaos gate did not run (re-run "
+            "`python bench.py`)")
+        return
+    takeovers = int(fresh["fed_proc_takeovers"])
+    failovers = int(fresh["fed_proc_client_failovers"])
+    lost = int(fresh["fed_proc_lost_events"])
+    verdict = "ok" if takeovers >= 1 else "REGRESSION"
+    print(f"  {'fed proc takeovers':<24} {takeovers:9d} elector "
+          f"takeovers (>= 1) {verdict}")
+    if verdict != "ok":
+        failures.append(
+            "fed_proc_takeovers is 0 — the leader-kill episode never "
+            "produced an elector takeover")
+    verdict = "ok" if failovers >= 1 else "REGRESSION"
+    print(f"  {'fed proc failovers':<24} {failovers:9d} client "
+          f"replica failovers (>= 1) {verdict}")
+    if verdict != "ok":
+        failures.append(
+            "fed_proc_client_failovers is 0 — no watch client migrated "
+            "endpoints during the chaos episodes")
+    verdict = "ok" if lost == 0 else "REGRESSION"
+    print(f"  {'fed proc lost events':<24} {lost:9d} lost events "
+          f"(== 0) {verdict}")
+    if verdict != "ok":
+        failures.append(
+            f"fed_proc_lost_events is {lost} — a failed-over watch "
+            "cursor dropped journal events")
+
+
 def check(fresh: dict, baseline: dict, tolerance: float,
           baseline_cal: float, fresh_cal: float) -> int:
     scale = fresh_cal / baseline_cal if baseline_cal > 0 else 1.0
@@ -598,6 +640,7 @@ def check(fresh: dict, baseline: dict, tolerance: float,
     check_explain(fresh, failures)
     check_prune(fresh, failures)
     check_federation(fresh, failures, fresh_cal)
+    check_federation_procs(fresh, failures)
     if failures:
         print("bench-check: FAIL")
         for fmsg in failures:
@@ -822,6 +865,7 @@ def check_10x(fresh: dict, tolerance: float, fresh_cal: float,
     check_explain(fresh, failures)
     check_prune(fresh, failures)
     check_federation(fresh, failures, fresh_cal)
+    check_federation_procs(fresh, failures)
     if failures:
         print("bench-check: FAIL")
         for fmsg in failures:

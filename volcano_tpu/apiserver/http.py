@@ -32,12 +32,14 @@ from __future__ import annotations
 import json
 import math
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import List, Optional, Sequence, Union
 
+from ..utils.backoff import seeded_backoff
 from .codec import decode_object, encode_object
 from .store import (CLUSTER_SCOPED, KINDS, AdmissionError, ConflictError,
                     FencedError, ObjectStore)
@@ -72,6 +74,10 @@ class _CountingThreadingHTTPServer(ThreadingHTTPServer):
     connection must leave ``connections_accepted`` at 1."""
 
     connections_accepted = 0
+    # a subscriber storm SYN-floods the stdlib default backlog of 5 —
+    # connects then time out at the client even though the server is
+    # healthy, which reads as a dead replica to failover clients
+    request_queue_size = 1024
 
     def get_request(self):
         req = super().get_request()
@@ -99,13 +105,23 @@ class StoreHTTPServer:
     """The apiserver seam. ``hub``/``admission`` are optional: without
     them the server behaves exactly as the pre-serving era (no
     /watchstream, no write throttling) — cmd/apiserver wires both in
-    for the production multi-tenant edge."""
+    for the production multi-tenant edge.
+
+    ``member`` (a :class:`~volcano_tpu.replication.election.
+    FederationMember`) turns on federation process mode: ``/leader``
+    answers leader discovery, ``/lease/<sender>`` takes peer lease
+    pushes, object writes are role-gated (a follower or degraded
+    replica answers a structured 503 + Retry-After + leader hint
+    instead of silently forking the rv space), and follower reads are
+    annotated with a staleness bound."""
 
     def __init__(self, store: ObjectStore, host: str = "127.0.0.1",
-                 port: int = 8181, hub=None, admission=None):
+                 port: int = 8181, hub=None, admission=None,
+                 member=None):
         self.store = store
         self.hub = hub
         self.admission = admission
+        self.member = member
         if hub is not None and getattr(hub, "encoder", None) is None:
             # pre-serialize frames once per burst at the hub so the
             # watchstream fan-out shares object bytes across subscribers
@@ -157,6 +173,51 @@ class StoreHTTPServer:
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _gate_write(self) -> bool:
+                """Federation role gate on the write path: only the
+                fenced leader takes writes. False = a structured 503
+                with Retry-After and the current leader hint already
+                went out (retry_transient honors the delay; a failover
+                client re-discovers the leader from the hint)."""
+                member = server.member
+                if member is None or member.accepts_writes():
+                    return True
+                hint = member.leader_hint()
+                retry_after = member.retry_after()
+                payload = {"error": f"replica {member.name} is "
+                                    f"{member.role()}: writes go to the "
+                                    f"leader",
+                           "role": member.role(),
+                           "retry_after": retry_after,
+                           "leader": hint}
+                stale = member.staleness()
+                if stale is not None:
+                    payload["staleness"] = stale
+                self._send(503, payload,
+                           headers={"Retry-After":
+                                    str(max(1, math.ceil(retry_after)))})
+                return False
+
+            def _staleness_headers(self) -> Optional[dict]:
+                """Read-path annotation: a non-leader replica stamps
+                its role and staleness bound (applied rv + estimated
+                lag) on every read so clients know how far behind the
+                data may be."""
+                member = server.member
+                if member is None:
+                    return None
+                role = member.role()
+                if role == "leader":
+                    return None
+                headers = {"X-Volcano-Role": role}
+                stale = member.staleness()
+                if stale is not None:
+                    headers["X-Volcano-Applied-Rv"] = \
+                        str(stale["applied_rv"])
+                    headers["X-Volcano-Staleness-Rvs"] = \
+                        str(stale["lag_rvs"])
+                return headers
 
             def _admit_tenant(self, query: dict) -> bool:
                 """Per-tenant write admission; False = throttled (the
@@ -305,16 +366,34 @@ class StoreHTTPServer:
                                      "application/x-ndjson")
                     self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
-                    self._chunk({"hello": True, "rv": sub.cursor,
-                                 "client": client, "epoch": hub.epoch})
+                    # sub.anchor, NOT sub.cursor: dispatch may already
+                    # have advanced the live cursor past frames sitting
+                    # in the outbox, and a hello ahead of those frames
+                    # turns them into client-visible duplicates
+                    hello = {"hello": True, "rv": sub.anchor,
+                             "client": client, "epoch": hub.epoch}
+                    member = server.member
+                    if member is not None:
+                        hello["role"] = member.role()
+                        stale = member.staleness()
+                        if stale is not None:
+                            hello["staleness_rvs"] = stale["lag_rvs"]
+                    self._chunk(hello)
                     while True:
                         frame = sub.next_frame(timeout=heartbeat)
                         if sub.closed:
                             break
                         if frame is None:
-                            self._chunk({"ping": True,
-                                         "rv": store.current_rv(),
-                                         "epoch": hub.epoch})
+                            ping = {"ping": True,
+                                    "rv": store.current_rv(),
+                                    "epoch": hub.epoch}
+                            if member is not None:
+                                ping["role"] = member.role()
+                                stale = member.staleness()
+                                if stale is not None:
+                                    ping["staleness_rvs"] = \
+                                        stale["lag_rvs"]
+                            self._chunk(ping)
                             continue
                         if frame.get("relist"):
                             self._chunk({"relist": True,
@@ -393,6 +472,21 @@ class StoreHTTPServer:
                     return self._send(200, {"rv": store.current_rv()})
                 if parsed.path == "/fence":
                     return self._send(200, {"floor": store.fence_floor()})
+                if parsed.path == "/leader":
+                    member = server.member
+                    if member is None:
+                        # standalone apiserver: it IS the write target
+                        return self._send(200, {
+                            "role": "standalone", "accepts_writes": True,
+                            "holder": "", "url": "",
+                            "token": store.fence_floor(), "live": True})
+                    info = member.leader_hint()
+                    info["role"] = member.role()
+                    info["accepts_writes"] = member.accepts_writes()
+                    stale = member.staleness()
+                    if stale is not None:
+                        info["staleness"] = stale
+                    return self._send(200, info)
                 if parsed.path == "/watchstream":
                     return self._watchstream(
                         urllib.parse.parse_qs(parsed.query))
@@ -423,21 +517,37 @@ class StoreHTTPServer:
                 # live refs — encoding only READS, stored objects are
                 # replaced never mutated, so the per-request deep copy
                 # bought nothing but writer-lock contention
+                stale_headers = self._staleness_headers()
                 if name is None:
                     namespace = query.get("namespace", [None])[0]
                     items = store.list_refs(kind, namespace)
                     return self._send(200, {"items": [
-                        encode_object(kind, o) for o in items]})
+                        encode_object(kind, o) for o in items]},
+                        headers=stale_headers)
                 o = store.get_ref(kind, name, ns)
                 if o is None:
                     return self._send(404, {"error": f"{kind} {name} not found"})
-                return self._send(200, encode_object(kind, o))
+                return self._send(200, encode_object(kind, o),
+                                  headers=stale_headers)
 
             def do_POST(self):
                 parsed = urllib.parse.urlparse(self.path)
                 if not self._admit_tenant(
                         urllib.parse.parse_qs(parsed.query)):
                     return
+                if parsed.path.startswith("/lease/"):
+                    # a peer's leader lease push (process-mode election
+                    # side channel — NEVER the replicated rv space)
+                    member = server.member
+                    if member is None:
+                        return self._send(404, {
+                            "error": "not a federation member"})
+                    body = self._body() or {}
+                    view = member.receive_lease(
+                        body.get("holder", ""),
+                        int(body.get("token", 0)),
+                        body.get("url", ""))
+                    return self._send(200, view)
                 if parsed.path == "/fence":
                     # the LeaderElector of a remote process announcing its
                     # freshly-acquired token; floor advance is monotonic
@@ -445,6 +555,8 @@ class StoreHTTPServer:
                     floor = store.advance_fence(int(body.get("token", 0)))
                     return self._send(200, {"floor": floor})
                 if parsed.path == "/events":
+                    if not self._gate_write():
+                        return
                     body = self._body()
                     o = decode_object(body["kind"], body["object"]) \
                         if body.get("object") else None
@@ -472,6 +584,8 @@ class StoreHTTPServer:
                 route = self._parse()
                 if route is None:
                     return self._send(404, {"error": "not found"})
+                if not self._gate_write():
+                    return
                 kind, _ns, _name, query = route
                 try:
                     fence = _fence_of(query)
@@ -495,6 +609,8 @@ class StoreHTTPServer:
                     return self._send(404, {"error": "not found"})
                 kind, _ns, _name, query = route
                 if not self._admit_tenant(query):
+                    return
+                if not self._gate_write():
                     return
                 try:
                     fence = _fence_of(query)
@@ -520,6 +636,8 @@ class StoreHTTPServer:
                     return self._send(404, {"error": "not found"})
                 kind, ns, name, query = route
                 if not self._admit_tenant(query):
+                    return
+                if not self._gate_write():
                     return
                 try:
                     fence = _fence_of(query)
@@ -650,37 +768,160 @@ class PooledConnection:
 class StoreClient:
     """Remote client mirroring the ObjectStore CRUD surface, over a
     pooled keep-alive connection (writes reuse one TCP connection; the
-    RemoteStore watch loop streams on its own)."""
+    RemoteStore watch loop streams on its own).
 
-    def __init__(self, base_url: str, timeout: float = 10.0):
-        self.base_url = base_url.rstrip("/")
-        self.pool = PooledConnection(self.base_url, timeout=timeout)
+    ``base_url`` may be a single endpoint (the pre-federation shape —
+    behavior is unchanged) or a LIST of replica endpoints. With a list
+    the client fails over: a dead endpoint rotates to the next one
+    (reads) or re-discovers the leader via ``GET /leader`` (writes),
+    a 503 role rejection re-discovers and retries, and a 412 fence
+    rejection re-discovers for the NEXT operation but re-raises —
+    a fenced write is a correctness signal, never silently absorbed.
+    Retry pacing shares :func:`~volcano_tpu.utils.backoff.
+    seeded_backoff` with the replication follower (deterministic
+    jitter, no third ad-hoc retry loop)."""
+
+    FAILOVER_BASE_S = 0.05
+    FAILOVER_CAP_S = 1.0
+
+    def __init__(self, base_url: Union[str, Sequence[str]],
+                 timeout: float = 10.0, client_id: str = ""):
+        if isinstance(base_url, str):
+            endpoints = [base_url]
+        else:
+            endpoints = list(base_url)
+        if not endpoints:
+            raise ValueError("StoreClient needs at least one endpoint")
+        self.endpoints: List[str] = [e.rstrip("/") for e in endpoints]
+        self.timeout = timeout
+        self.client_id = client_id or "store-client"
+        self._pools = {e: PooledConnection(e, timeout=timeout)
+                       for e in self.endpoints}
+        self.base_url = self.endpoints[0]
+        self.pool = self._pools[self.base_url]
+        self.failovers = 0
+        self.leader_redirects = 0
+
+    # -- endpoint routing --------------------------------------------------
+
+    def _use(self, endpoint: str) -> None:
+        self.base_url = endpoint
+        self.pool = self._pools[endpoint]
+
+    def _rotate(self) -> str:
+        """Next endpoint in declaration order (deterministic)."""
+        i = self.endpoints.index(self.base_url)
+        self._use(self.endpoints[(i + 1) % len(self.endpoints)])
+        return self.base_url
+
+    def _probe_leader(self, endpoint: str) -> dict:
+        status, _headers, body = self._pools[endpoint].request(
+            "GET", "/leader")
+        if status != 200:
+            raise ApiError(status, f"leader probe: HTTP {status}")
+        return json.loads(body)
+
+    def discover_leader(self) -> Optional[str]:
+        """Find the replica currently accepting writes: probe every
+        endpoint (active first, then declaration order) for
+        ``GET /leader``; follow a holder-url hint when it names a known
+        endpoint. Returns the endpoint (now active) or None when no
+        replica claims the lease (degraded set — the caller's 503
+        handling paces the retry)."""
+        order = [self.base_url] + [e for e in self.endpoints
+                                   if e != self.base_url]
+        hints: List[str] = []
+        for ep in order:
+            try:
+                info = self._probe_leader(ep)
+            except Exception:
+                continue
+            if info.get("accepts_writes") and info.get("role") in (
+                    "leader", "standalone"):
+                self._use(ep)
+                return ep
+            hint = (info.get("url") or "").rstrip("/")
+            if hint and hint in self.endpoints and hint not in hints:
+                hints.append(hint)
+        for ep in hints:
+            try:
+                info = self._probe_leader(ep)
+            except Exception:
+                continue
+            if info.get("accepts_writes"):
+                self._use(ep)
+                return ep
+        return None
 
     def _request(self, method: str, path: str, payload=None):
         import http.client
         data = json.dumps(payload).encode() if payload is not None else None
-        try:
-            status, headers, body = self.pool.request(method, path,
-                                                      body=data)
-        except (OSError, http.client.HTTPException) as e:
-            # keep the pre-pool error contract: connection-level blips
-            # surface as URLError (what retry_transient classifies)
-            raise urllib.error.URLError(e) from None
-        if status >= 400:
+        is_write = method in ("POST", "PUT", "DELETE")
+        single = len(self.endpoints) == 1
+        attempts = 1 if single else 2 * len(self.endpoints)
+        last_exc: Optional[Exception] = None
+        for attempt in range(1, attempts + 1):
+            if attempt > 1:
+                delay = seeded_backoff(
+                    f"{self.client_id}:{method}:{path}", attempt - 1,
+                    self.FAILOVER_BASE_S, self.FAILOVER_CAP_S)
+                if delay:
+                    time.sleep(delay)
             try:
-                message = json.loads(body).get("error", "")
-            except Exception:
-                message = ""
-            message = message or f"HTTP {status}"
-            retry_after = None
-            ra = headers.get("Retry-After") if headers is not None else None
-            if ra:
+                status, headers, body = self.pool.request(method, path,
+                                                          body=data)
+            except (OSError, http.client.HTTPException) as e:
+                # keep the pre-pool error contract: connection-level
+                # blips surface as URLError (what retry_transient
+                # classifies)
+                last_exc = urllib.error.URLError(e)
+                if single:
+                    raise last_exc from None
+                self.failovers += 1
+                if is_write:
+                    self.discover_leader()
+                else:
+                    self._rotate()
+                continue
+            if status >= 400:
                 try:
-                    retry_after = float(ra)
-                except ValueError:
-                    pass
-            raise ApiError(status, message, retry_after=retry_after)
-        return json.loads(body) if body else None
+                    decoded = json.loads(body)
+                except Exception:
+                    decoded = {}
+                message = decoded.get("error", "") or f"HTTP {status}"
+                retry_after = None
+                ra = headers.get("Retry-After") \
+                    if headers is not None else None
+                if ra:
+                    try:
+                        retry_after = float(ra)
+                    except ValueError:
+                        pass
+                err = ApiError(status, message, retry_after=retry_after)
+                if status == 412 and not single:
+                    # fenced: OUR regime knowledge is stale. Re-discover
+                    # so the next op routes right, but surface the
+                    # rejection — a silent retry into a deposed leader
+                    # (or with a dead token) is the failure mode fencing
+                    # exists to stop
+                    self.leader_redirects += 1
+                    self.discover_leader()
+                    raise err
+                if status == 503 and not single and is_write \
+                        and attempt < attempts:
+                    # role rejection: a follower/degraded replica.
+                    # Honor its Retry-After, then re-discover
+                    self.failovers += 1
+                    if retry_after:
+                        time.sleep(min(retry_after,
+                                       self.FAILOVER_CAP_S))
+                    self.discover_leader()
+                    last_exc = err
+                    continue
+                raise err
+            return json.loads(body) if body else None
+        raise last_exc if last_exc is not None else \
+            urllib.error.URLError("no endpoint reachable")
 
     def _path(self, kind: str, name: Optional[str] = None,
               namespace: str = "default") -> str:

@@ -176,9 +176,19 @@ class RemoteStore:
     WATCH_BACKOFF_BASE_S = 0.5
     WATCH_BACKOFF_CAP_S = 15.0
 
-    def __init__(self, base_url: str, poll_timeout: float = 25.0):
+    def __init__(self, base_url, poll_timeout: float = 25.0):
+        # ``base_url``: one endpoint, or a list of replica endpoints.
+        # With a list, writes route to the fenced leader (StoreClient's
+        # /leader discovery + 503/412 handling) while the WATCH stream
+        # fails over independently — any replica serves watches, so a
+        # broken stream migrates to the next endpoint and resumes from
+        # the mirror's cursor (the prev-chain/relist contract; replays
+        # dedup on server rv).
         self.client = StoreClient(base_url)
-        self.base_url = base_url.rstrip("/")
+        self.endpoints = list(self.client.endpoints)
+        self.base_url = self.endpoints[0]
+        self._watch_url = self.endpoints[0]
+        self.watch_failovers = 0
         self.mirror = ObjectStore()
         self.poll_timeout = poll_timeout
         self._rv = 0
@@ -223,7 +233,7 @@ class RemoteStore:
         rvs, which must never be confused with the server's)."""
         try:
             resp = json.loads(urllib.request.urlopen(
-                f"{self.base_url}/rv", timeout=10.0).read().decode())
+                f"{self.client.base_url}/rv", timeout=10.0).read().decode())
             anchor = int(resp.get("rv", 0))
         except Exception:
             log.exception("rv anchor fetch failed during resync")
@@ -304,7 +314,7 @@ class RemoteStore:
     def _poll_once(self) -> None:
         """One long-poll round against /watch (the pre-serving
         transport, kept as the fallback)."""
-        url = (f"{self.base_url}/watch?since={self._rv}"
+        url = (f"{self._watch_url}/watch?since={self._rv}"
                f"&timeout={self.poll_timeout}")
         with urllib.request.urlopen(
                 url, timeout=self.poll_timeout + 10.0) as resp:
@@ -322,7 +332,7 @@ class RemoteStore:
         from the fresh cursor); raises on any transport failure (the
         caller's seeded-backoff restart, same as the long-poll)."""
         import http.client
-        u = urllib.parse.urlsplit(self.base_url)
+        u = urllib.parse.urlsplit(self._watch_url)
         conn = http.client.HTTPConnection(
             u.hostname or "127.0.0.1", u.port or 80,
             timeout=self.poll_timeout + 10.0)
@@ -390,12 +400,21 @@ class RemoteStore:
                     _m.inc(_m.WATCH_RESTARTS)
                 except Exception:
                     pass
-                delay = seeded_backoff(self.base_url, failures,
+                if len(self.endpoints) > 1:
+                    # replica failover: any replica serves watches —
+                    # resume from the mirror's cursor on the next
+                    # endpoint (server-rv dedup absorbs replays, the
+                    # relist contract covers a rolled-past cursor)
+                    i = self.endpoints.index(self._watch_url)
+                    self._watch_url = self.endpoints[
+                        (i + 1) % len(self.endpoints)]
+                    self.watch_failovers += 1
+                delay = seeded_backoff(self._watch_url, failures,
                                        self.WATCH_BACKOFF_BASE_S,
                                        self.WATCH_BACKOFF_CAP_S)
                 log.warning("watch poll failed (failure %d); restarting "
-                            "the stream in %.2fs", failures, delay,
-                            exc_info=True)
+                            "the stream on %s in %.2fs", failures,
+                            self._watch_url, delay, exc_info=True)
                 self._stop.wait(delay)
                 continue
             failures = 0   # a clean round closes the backoff window
@@ -558,7 +577,8 @@ class RemoteStore:
                    "event_type": event_type, "reason": reason,
                    "message": message}
         req = urllib.request.Request(
-            f"{self.base_url}/events", data=json.dumps(payload).encode(),
+            f"{self.client.base_url}/events",
+            data=json.dumps(payload).encode(),
             headers={"Content-Type": "application/json"}, method="POST")
         try:
             urllib.request.urlopen(req, timeout=10.0).close()

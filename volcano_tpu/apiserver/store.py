@@ -1038,6 +1038,13 @@ class ObjectStore:
             for a, b in zip(rvs, rvs[1:]):
                 if b != a + 1:
                     raise ReplicationGapError(a + 1, b)
+            # derive BEFORE any mutation: a malformed object raising
+            # mid-run would otherwise leave a partially-applied frame
+            # (re-seeds the request memo the HTTP decode dropped)
+            for _, action, kind, o in entries:
+                derive = _DERIVED.get(kind)
+                if derive is not None and action != "DELETED":
+                    derive(o)
             journal: list = []
             for rv, (_, action, kind, o) in zip(rvs, entries):
                 objs = self._objects[kind]
@@ -1046,10 +1053,6 @@ class ObjectStore:
                 if action == "DELETED":
                     objs.pop(key, None)
                 else:
-                    derive = _DERIVED.get(kind)
-                    if derive is not None:
-                        derive(o)   # re-seed the request memo: HTTP
-                        #             decode dropped the leader's copy
                     o.metadata.resource_version = rv
                     objs[key] = o
                 journal.append((rv, action, kind, o))
@@ -1098,16 +1101,23 @@ class ObjectStore:
         exactly the contract a snapshot restore already has. Local
         Watch handlers are NOT replayed: the mirror's consumers are
         journal cursors (the serving hub), which the relist re-anchors."""
+        # validate + derive the ENTIRE snapshot before touching any
+        # state: an interrupted or malformed transfer must leave the
+        # mirror exactly as it was (all-or-nothing), never a mix of
+        # new kinds over old ones
+        staged: Dict[str, dict] = {}
+        for kind in KINDS:
+            incoming = objects.get(kind) or {}
+            derive = _DERIVED.get(kind)
+            if derive is not None:
+                for o in incoming.values():
+                    derive(o)
+            staged[kind] = dict(incoming)
         with self._lock:
             self._wait_journal_settled_locked()
             self._check_fence_locked(epoch)
             for kind in KINDS:
-                incoming = objects.get(kind) or {}
-                derive = _DERIVED.get(kind)
-                if derive is not None:
-                    for o in incoming.values():
-                        derive(o)
-                self._objects[kind] = dict(incoming)
+                self._objects[kind] = staged[kind]
             self._journal.clear()
             self._journal_parked.clear()
             self._trace_ranges.clear()

@@ -228,19 +228,32 @@ def _render_explain(payload: dict) -> str:
 
 
 def _render_replication(payload: dict) -> str:
+    lines_pre = []
+    m = payload.get("member")
+    if m:   # elector-driven federation process mode
+        lines_pre.append(
+            f"member {m.get('name')}: role={m.get('role')} "
+            f"lease={m.get('lease_holder') or '-'}"
+            f"@{m.get('lease_token')} token={m.get('token')} "
+            f"takeovers={m.get('takeovers')} "
+            f"demotions={m.get('demotions')} "
+            f"accepts_writes={m.get('accepts_writes')}")
     f = payload.get("follower")
     if f:   # this process IS a follower apiserver replica
-        return (f"follower {f['name']}: epoch={f['epoch']} "
-                f"applied_rv={f['applied_rv']} lag={f.get('lag_rvs')} "
-                f"frames={f['frames_applied']} gaps={f['gaps_detected']} "
-                f"catchup={f['catchup_relists']} "
-                f"bootstraps={f['snapshot_bootstraps']} "
-                f"fenced={f['fenced_frames']}")
+        return "\n".join(lines_pre + [
+            f"follower {f['name']}: epoch={f['epoch']} "
+            f"applied_rv={f['applied_rv']} lag={f.get('lag_rvs')} "
+            f"frames={f['frames_applied']} gaps={f['gaps_detected']} "
+            f"catchup={f['catchup_relists']} "
+            f"bootstraps={f['snapshot_bootstraps']} "
+            f"fenced={f['fenced_frames']}"])
     rs = payload.get("replica_set")
     if not rs:
-        return "no replica set registered (single-replica deployment)"
+        return "\n".join(lines_pre) if lines_pre else \
+            "no replica set registered (single-replica deployment)"
     leader = rs.get("leader") or {}
-    lines = [f"epoch: {rs.get('epoch')}  leader rv={leader.get('rv')} "
+    lines = lines_pre + [
+        f"epoch: {rs.get('epoch')}  leader rv={leader.get('rv')} "
              f"frames_shipped={leader.get('frames_shipped')} "
              f"events_shipped={leader.get('events_shipped')} "
              f"snapshots_shipped={leader.get('snapshots_shipped')}"]
@@ -275,6 +288,34 @@ _RENDER = {"cycles": _render_cycles, "pending": _render_pending,
            "replication": _render_replication}
 
 
+def _replication_degraded(payload: dict, max_lag: int):
+    """The reason `vcctl debug replication` should exit nonzero, or
+    None: follower lag past the threshold, a diverged last audit, or a
+    member with no electable leader — the same exit-1-while-degraded
+    convention `vcctl debug health` follows."""
+    reasons = []
+    rs = payload.get("replica_set") or {}
+    for name, lag in sorted((rs.get("lag_rvs") or {}).items()):
+        if lag > max_lag:
+            reasons.append(f"follower {name} lag {lag} rvs "
+                           f"> --max-lag {max_lag}")
+    audit = rs.get("last_audit")
+    if audit and audit.get("verdict") not in (None, "identical"):
+        reasons.append(
+            f"last audit {audit.get('verdict')}"
+            + (f" (divergent: {', '.join(audit['divergent'])})"
+               if audit.get("divergent") else ""))
+    f = payload.get("follower")
+    if f and (f.get("lag_rvs") or 0) > max_lag:
+        reasons.append(f"follower {f.get('name')} lag "
+                       f"{f.get('lag_rvs')} rvs > --max-lag {max_lag}")
+    m = payload.get("member")
+    if m and m.get("role") == "degraded":
+        reasons.append(f"member {m.get('name')} degraded "
+                       "(no electable leader)")
+    return "; ".join(reasons) if reasons else None
+
+
 def dispatch_debug(args) -> int:
     path = f"/debug/{args.verb}"
     if args.verb == "explain" and getattr(args, "job", None):
@@ -286,6 +327,12 @@ def dispatch_debug(args) -> int:
         print(_RENDER[args.verb](payload))
     # /debug/health 503s while degraded — the exit code should say so
     # (and an unknown-job explain lookup exits 1 the same way)
+    if args.verb == "replication" and status < 400:
+        reason = _replication_degraded(
+            payload, getattr(args, "max_lag", 1000))
+        if reason:
+            print(f"DEGRADED: {reason}")
+            return 1
     return 0 if status < 400 else 1
 
 
@@ -302,3 +349,7 @@ def add_debug_parser(sub) -> None:
                           "http://127.0.0.1:8080)")
     dbg.add_argument("--json", action="store_true",
                      help="print the raw JSON payload")
+    dbg.add_argument("--max-lag", type=int, default=1000,
+                     help="replication only: exit 1 when any follower "
+                          "lags the leader by more than this many rvs "
+                          "(default 1000)")

@@ -60,6 +60,26 @@ def main(argv=None) -> int:
                              "follower mirror serving reads and watches")
     parser.add_argument("--replica-name", default=None,
                         help="follower replica name (default host:port)")
+    # federation PROCESS mode (docs/design/federation.md "process
+    # mode"): --peers makes this process a full federation MEMBER — it
+    # runs the leader elector against a peer-pushed lease board, follows
+    # whichever replica holds the lease, role-gates its write path, and
+    # takes over (bumping the fencing token) when the lease lapses.
+    parser.add_argument("--peers", default=None,
+                        metavar="NAME=URL,NAME=URL",
+                        help="all replica endpoints (this one included); "
+                             "enables elector-driven federation")
+    parser.add_argument("--advertise-url", default=None, metavar="URL",
+                        help="base url peers/clients reach this replica "
+                             "at (default http://host:port)")
+    parser.add_argument("--bootstrap-leader", action="store_true",
+                        help="acquire the lease immediately at boot "
+                             "(exactly one replica per fresh set)")
+    parser.add_argument("--initial-leader", default=None, metavar="NAME",
+                        help="lease-board seed: which peer leads at "
+                             "boot (followers only)")
+    parser.add_argument("--lease-duration", type=float, default=15.0)
+    parser.add_argument("--renew-interval", type=float, default=5.0)
     parser.add_argument("--metrics", default=None, metavar="HOST:PORT",
                         help="also serve the Prometheus /metrics + "
                              "/debug endpoints (incl. "
@@ -112,7 +132,29 @@ def main(argv=None) -> int:
                          admission=admission)
         serving.set_active(hub=hub, admission=admission)
     follower = None
-    if args.replicate_from:
+    member = None
+    if args.peers:
+        from ..replication import set_active
+        from ..replication.election import FederationMember
+        peers = {}
+        for part in args.peers.split(","):
+            pname, _, purl = part.partition("=")
+            if not pname or not purl:
+                parser.error(f"malformed --peers entry {part!r} "
+                             "(want NAME=URL)")
+            peers[pname.strip()] = purl.strip()
+        name = args.replica_name or f"{args.host}:{args.port}"
+        advertise = args.advertise_url or f"http://{args.host}:{args.port}"
+        initial = args.initial_leader or ""
+        member = FederationMember(
+            name, store, hub=hub, peers=peers, advertise_url=advertise,
+            lease_duration=args.lease_duration,
+            renew_interval=args.renew_interval,
+            bootstrap_leader=args.bootstrap_leader,
+            initial_leader=initial,
+            initial_leader_url=peers.get(initial, ""))
+        set_active(member=member)
+    elif args.replicate_from:
         from ..replication import set_active
         from ..replication.follower import (FollowerReplica,
                                             HTTPReplicationSource)
@@ -129,20 +171,29 @@ def main(argv=None) -> int:
         metrics_server = MetricsServer(mhost or "127.0.0.1", int(mport))
         metrics_server.start()
     server = StoreHTTPServer(store, host=args.host, port=args.port,
-                             hub=hub, admission=admission)
+                             hub=hub, admission=admission, member=member)
     server.start()
-    role = f"follower of {args.replicate_from}" if follower else "leader"
+    if member is not None:
+        if args.bootstrap_leader:
+            member.step()   # claim the lease before the first client
+        member.start()
+        role = f"member:{member.role()}"
+    elif follower is not None:
+        role = f"follower of {args.replicate_from}"
+    else:
+        role = "leader"
     print(f"vc-apiserver ({role}) serving on {args.host}:{server.port}",
           flush=True)
     stop = threading.Event()
-    if checkpointer is not None or follower is not None:
-        import signal as _signal
+    import signal as _signal
 
-        def _graceful(signum, frame):
-            stop.set()
-        for sig in (_signal.SIGTERM, _signal.SIGINT):
-            _signal.signal(sig, _graceful)
+    def _graceful(signum, frame):
+        stop.set()
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        _signal.signal(sig, _graceful)
     stop.wait()
+    if member is not None:
+        member.stop()
     if follower is not None:
         follower.stop()
     if metrics_server is not None:

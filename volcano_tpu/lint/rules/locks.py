@@ -49,7 +49,12 @@ _DEFAULT_SCOPES: Dict[str, Dict[str, Set[str]]] = {
     },
     "replication/follower.py": {
         "locks": {"_lock"},
-        "guarded": {"_epoch", "_applied"},
+        "guarded": {"_epoch", "_applied", "_source_head"},
+    },
+    "replication/election.py": {
+        "locks": {"_lock"},
+        "guarded": {"_lease", "_version", "_role", "_follower",
+                    "_needs_bootstrap"},
     },
 }
 

@@ -25,7 +25,8 @@ from ..framework import (Finding, LintContext, ParsedModule, Rule,
                          dotted_name, import_aliases,
                          importfrom_aliases)
 
-_DEFAULT_SCOPE = ("sim/", "ops/", "framework/")
+_DEFAULT_SCOPE = ("sim/", "ops/", "framework/",
+                  "replication/chaos.py", "replication/election.py")
 
 #: attributes of the `random` module that do NOT touch the global RNG
 _RANDOM_OK = {"Random", "SystemRandom"}

@@ -81,6 +81,19 @@ def _debug_response(path: str, query: dict):
         return 200, tracer.chrome_trace(rec)
     if path == "/debug/health":
         report = m.health_report()
+        # federation process mode: a member with no electable leader
+        # (degraded — writes fail fast, reads are stale-annotated) is a
+        # health component like any other and 503s the endpoint
+        from ..replication import _ACTIVE
+        member = _ACTIVE.get("member")
+        if member is not None:
+            role = member.role()
+            report.setdefault("components", {})["replication_member"] = {
+                "healthy": role != "degraded",
+                "detail": f"role={role} "
+                          f"lease={member.leader_hint().get('holder')}"}
+            if role == "degraded":
+                report["healthy"] = False
         return (200 if report["healthy"] else 503), report
     if path == "/debug/serving":
         from ..serving import serving_report

@@ -27,6 +27,16 @@ own :class:`~volcano_tpu.serving.hub.ServingHub`. The pieces:
   fingerprint audit (the PR-5 cache machinery pointed across mirrors).
 * :mod:`.gate` — the federation storm gate (`vcctl sim federation` /
   `make federation-smoke`).
+* :mod:`.election` — the elector→epoch seam: :class:`EpochElector`
+  (LeaderElector acquisitions promote epochs; restarts fence the
+  previous incarnation), :class:`LeaseBoard` (the process-mode lease
+  side channel, off the replicated rv space), and
+  :class:`FederationMember` (per-process elect/push/follow/degrade
+  runtime).
+* :mod:`.chaos` — process mode's chaos harness: the ReplicaProcess
+  supervisor, the deterministic fault-injecting TCP proxy, the
+  selector-based watch fleet, and the ``run_federation_procs`` gate
+  (`vcctl sim federation --procs` / `make federation-proc-smoke`).
 
 ``set_active``/``replication_report`` register the process's live
 ReplicaSet — or, in a follower apiserver process, its own
@@ -36,32 +46,44 @@ serving registry).
 
 from __future__ import annotations
 
-_ACTIVE = {"replica_set": None, "follower": None}
+_ACTIVE = {"replica_set": None, "follower": None, "member": None}
 
 
-def set_active(replica_set=None, follower=None) -> None:
+def set_active(replica_set=None, follower=None, member=None) -> None:
     """Register the live ReplicaSet (a federated simulator/test
-    harness) and/or this process's own FollowerReplica (a follower
-    apiserver) for /debug/replication."""
+    harness), this process's own FollowerReplica (a follower
+    apiserver), and/or its FederationMember (elector-driven process
+    mode) for /debug/replication."""
     if replica_set is not None:
         _ACTIVE["replica_set"] = replica_set
     if follower is not None:
         _ACTIVE["follower"] = follower
+    if member is not None:
+        _ACTIVE["member"] = member
 
 
 def clear_active() -> None:
     _ACTIVE["replica_set"] = None
     _ACTIVE["follower"] = None
+    _ACTIVE["member"] = None
 
 
 def replication_report() -> dict:
     """The /debug/replication payload: leader epoch, per-follower lag
     in rvs, last fingerprint audit, catch-up relists/bootstraps — from
-    whatever ReplicaSet / FollowerReplica is registered (empty when
-    none is)."""
+    whatever ReplicaSet / FollowerReplica / FederationMember is
+    registered (empty when none is)."""
     rs = _ACTIVE["replica_set"]
     f = _ACTIVE["follower"]
+    m = _ACTIVE["member"]
     report = {"replica_set": rs.report() if rs is not None else None}
     if f is not None:
         report["follower"] = dict(f.report(), lag_rvs=f.lag())
+    if m is not None:
+        report["member"] = m.report()
+        fr = m.follower_report()
+        if fr is not None and "follower" not in report:
+            report["follower"] = dict(
+                fr, lag_rvs=m.staleness()["lag_rvs"]
+                if m.staleness() else 0)
     return report

@@ -102,7 +102,18 @@ class ReplicaSet:
         now ships under a NEW epoch, every live replica observes it,
         and any frame still stamped with the old epoch is fenced at the
         mirrors — the deposed-leader contract."""
-        self.epoch += 1
+        return self.promote_epoch(self.epoch + 1)
+
+    def promote_epoch(self, token: int) -> int:
+        """Promote the replica set to an elector-granted epoch: the
+        fencing token a LeaderElector won the lease with (the
+        ``EpochElector`` seam calls this from ``on_promote``). Monotonic
+        — a stale token is a no-op, so a deposed incarnation re-winning
+        nothing cannot roll the epoch back."""
+        token = int(token)
+        if token <= self.epoch:
+            return self.epoch
+        self.epoch = token
         self.leader_store.advance_fence(self.epoch)
         self.source.set_epoch(self.epoch)
         self.leader_hub.set_epoch(self.epoch)

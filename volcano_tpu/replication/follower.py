@@ -148,6 +148,7 @@ class FollowerReplica:
         self._lock = threading.Lock()
         self._epoch = 0      # newest leadership epoch observed
         self._applied = self.store.current_rv()   # mirror journal tail
+        self._source_head = self._applied   # newest source rv observed
         self.frames_applied = 0
         self.events_applied = 0
         self.gaps_detected = 0
@@ -174,6 +175,9 @@ class FollowerReplica:
             head = self.source.current_rv()
         except Exception:
             return -1
+        with self._lock:
+            if head > self._source_head:
+                self._source_head = head
         lag = max(0, head - self.applied_rv())
         try:
             from ..metrics import metrics as m
@@ -181,6 +185,19 @@ class FollowerReplica:
         except Exception:
             pass
         return lag
+
+    def lag_estimate(self) -> int:
+        """Staleness bound WITHOUT a network round-trip: rvs behind the
+        newest source head this follower has ever observed (frame tails
+        and explicit ``lag()`` probes both advance it). A follower
+        serving reads in degraded mode annotates responses with this —
+        a read path must never block on a dead leader's ``/rv``."""
+        with self._lock:
+            return max(0, self._source_head - self._applied)
+
+    def _observe_head_locked(self, head: int) -> None:
+        if head > self._source_head:
+            self._source_head = head
 
     def _observe_epoch_locked(self, epoch: int) -> None:
         """Record a newer leadership epoch: the mirror's fence floor
@@ -222,6 +239,7 @@ class FollowerReplica:
             raise
         with self._lock:
             self._applied = tail
+            self._observe_head_locked(tail)
             self.frames_applied += 1
             self.events_applied += len(entries)
         return tail
@@ -229,13 +247,21 @@ class FollowerReplica:
     def bootstrap(self) -> int:
         """Whole-store snapshot install: the cold-start path and the
         catch-up of last resort when the leader's journal window rolled
-        past this mirror."""
+        past this mirror.
+
+        Ordering matters: the snapshot transfer and the store install
+        both happen BEFORE any follower state (epoch, fence, hub)
+        advances. An interrupted bootstrap — killed source mid-stream,
+        truncated payload, a malformed object that fails derivation —
+        must leave the mirror exactly as it was and be retried from
+        scratch, not leave a half-observed epoch around a missing
+        install."""
         objects, rv, epoch = self.source.snapshot()
-        with self._lock:
-            self._observe_epoch_locked(int(epoch))
         anchor = self.store.install_snapshot(objects, rv, epoch=epoch)
         with self._lock:
+            self._observe_epoch_locked(int(epoch))
             self._applied = anchor
+            self._observe_head_locked(anchor)
             self.snapshot_bootstraps += 1
         if self.hub is not None:
             # cached bursts describe pre-bootstrap journal ranges
@@ -256,6 +282,7 @@ class FollowerReplica:
         if not entries:
             with self._lock:
                 self._observe_epoch_locked(int(epoch))
+                self._observe_head_locked(int(tail))
             return 0
         try:
             self.apply_frame(entries, epoch)

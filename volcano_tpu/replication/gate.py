@@ -31,6 +31,7 @@ from typing import List, Optional
 
 from ..apiserver.store import FencedError
 from ..serving.storm import STORM_TENANTS, StormClient, storm_config
+from .election import elector_for_replicaset
 from .federation import ReplicaSet
 
 
@@ -130,6 +131,14 @@ def run_federation(seed: int = 43, ticks: int = 60, nodes: int = 128,
                        resident=resident)
     eng = SimEngine(cfg)
     rs = ReplicaSet(eng.store, followers=followers, shards=shards)
+    # epochs are elector-driven end-to-end: the lease lives in the
+    # leader store (replicated like any object), acquisitions promote
+    # the replica set through rs.promote_epoch — the harness never
+    # calls advance_epoch
+    elector = elector_for_replicaset(rs, identity=rs.leader_name,
+                                     lease_duration=4 * cfg.tick_s,
+                                     retry_period=cfg.tick_s)
+    elector.step()   # initial acquisition: token 1 == the seed epoch
     clients = _build_clients(rs, subscribers, seed, drop_rate)
     if kill_tick is None:
         kill_tick = max(2, ticks // 3)
@@ -141,6 +150,7 @@ def run_federation(seed: int = 43, ticks: int = 60, nodes: int = 128,
     fenced_rejections = [0]
 
     def tick_hook(tick: int) -> None:
+        elector.step()   # renew the lease on the virtual clock
         if tick == kill_tick:
             # a replica dies mid-storm: hand every cursor it served to
             # a live peer at the client's applied chain position
@@ -156,14 +166,20 @@ def run_federation(seed: int = 43, ticks: int = 60, nodes: int = 128,
             FlakyWatch.force_gap(eng.store)
         if tick == fence_tick:
             # deposed-leader frame: collect under the CURRENT epoch,
-            # advance the election, then ship under the stale token —
-            # the mirror must reject it untouched
+            # then RESTART the elector incarnation (the leader process
+            # bounced mid-flush). The fresh incarnation re-acquires its
+            # own lease with a bumped fencing token — the PR 5 rule —
+            # and the acquisition itself promotes the epoch; shipping
+            # the pre-restart frame under the stale token must be
+            # rejected at the mirror untouched
             stale = rs.epoch
             target = next(f for f in rs.followers
                           if f.name not in rs.dead)
             entries, _tail, gone, _ = rs.source.collect(
                 target.applied_rv(), 0.0, epoch=stale)
-            rs.advance_epoch()
+            elector.restart()
+            elector.step()
+            assert rs.epoch > stale, "elector takeover did not promote"
             if not gone:
                 try:
                     target.apply_frame(entries, epoch=stale)

@@ -89,6 +89,13 @@ class Subscription:
         self.filter_fn = filter_fn
         self.cursor = int(cursor)       # last journal rv this sub covered
         self.last_framed = int(cursor)  # to_rv of the last frame enqueued
+        # the rv this session was anchored at, frozen at subscribe time.
+        # The streaming handler's hello frame MUST advertise this, not
+        # the live cursor: shard dispatch can enqueue frames and advance
+        # ``cursor`` before the handler writes its hello, and a hello
+        # ahead of the queued frames makes the client count every one of
+        # them as a duplicate (or skip them as already-applied).
+        self.anchor = int(cursor)
         self.outbox: deque = deque()
         self.cond = threading.Condition()
         # keys currently PASSING the filter from this subscriber's view —
@@ -589,14 +596,17 @@ class ServingHub:
             sub = Subscription(client_id, tenant, kinds, filter_attr,
                                filter_fn, cursor)
             sub.hub = self
-            if prime and sub.filtered and cursor >= tail:
+            if prime and sub.filtered and cursor == tail:
                 # old_p baseline: what a list-then-watch client already
                 # sees passing (kind-scoped; the whole store otherwise).
-                # ONLY valid when the cursor anchors at the tail — the
-                # store's CURRENT state is not the view at a past rv, so
-                # a replaying subscriber starts from an empty baseline
-                # instead (replayed first-pass events classify as ADDED,
-                # exactly informer relist semantics).
+                # ONLY valid when the cursor anchors exactly at the tail
+                # — the store's CURRENT state is neither the view at a
+                # past rv nor at a FUTURE one (a failed-over cursor ahead
+                # of a lagging mirror), so both replaying and ahead
+                # subscribers start from an empty baseline instead
+                # (first-pass events classify as ADDED, exactly informer
+                # relist semantics; an ahead cursor just holds until the
+                # mirror's journal passes it).
                 from ..apiserver.store import KINDS
                 for kind in (sub.kinds or KINDS):
                     for o in self.store.list_refs(kind):

@@ -190,6 +190,17 @@ def add_sim_parser(sub) -> None:
     fed.add_argument("--shards", type=int, default=4)
     fed.add_argument("--followers", type=int, default=2)
     fed.add_argument("--drop-rate", type=float, default=0.02)
+    # PROCESS mode (make federation-proc-smoke): 3 real vc-apiserver OS
+    # processes behind fault-injecting proxies, elector-driven epochs,
+    # a half-open partition + a leader SIGKILL, client replica failover
+    fed.add_argument("--procs", action="store_true",
+                     help="run the chaos process-mode gate: real "
+                          "apiserver child processes, seeded fault "
+                          "proxies, elector takeovers, client failover")
+    fed.add_argument("--pods", type=int, default=192,
+                     help="(--procs) writer workload size")
+    fed.add_argument("--watchdog", type=float, default=240.0,
+                     help="(--procs) per-run hard deadline, seconds")
     fed.add_argument("--json", action="store_true")
 
     exp = sim.add_parser(
@@ -1120,6 +1131,91 @@ def dispatch_sim(args) -> int:
             for name, ok in checks.items():
                 print(f"  {name}: {'ok' if ok else 'FAIL'}")
             print(f"storm-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
+        return 0 if verdict["pass"] else 1
+
+    if args.verb == "federation" and args.procs:
+        from ..replication.chaos import run_federation_procs
+
+        def one_proc_run():
+            return run_federation_procs(
+                seed=args.seed, subscribers=args.subscribers,
+                pods=args.pods, watchdog_s=args.watchdog)
+
+        v1 = one_proc_run()
+        v2 = one_proc_run()
+        checks = {
+            "replicas_ready": v1.get("replicas_ready", False)
+                              and v2.get("replicas_ready", False),
+            "watchdog_quiet": not v1["watchdog_fired"]
+                              and not v2["watchdog_fired"],
+            # two elector-driven takeovers: the partitioned leader
+            # deposed (token 2), then the SIGKILLed leader replaced
+            # (token 3) — the harness never calls advance_epoch
+            "elector_takeovers": v1.get("takeovers") == 2
+                                 and v2.get("takeovers") == 2,
+            "deposed_leader_demoted":
+                v1.get("deposed_leader_demoted", False),
+            # >=1 write under the deposed regime's fence token rejected
+            "fenced_deposed_write":
+                v1.get("fenced_deposed_writes", 0) >= 1
+                and v2.get("fenced_deposed_writes", 0) >= 1,
+            # no-leader window: structured 503 + Retry-After, reads
+            # still annotated with the staleness bound
+            "degraded_fail_fast": v1.get("degraded_503", False)
+                                  and v1.get("degraded_retry_after")
+                                  is not None,
+            "staleness_annotated": v1.get("staleness_annotated",
+                                          False),
+            "supervisor_restarted":
+                v1.get("supervisor_restarts", 0) >= 1
+                and v1.get("restarted_ready", False),
+            # every watch client's chain converged on a live replica
+            # with zero duplicated frames; every acked write survives
+            # the takeovers (post-replay diff empty)
+            "all_converged": v1.get("unconverged", 1) == 0
+                             and v2.get("unconverged", 1) == 0,
+            "zero_lost_events": v1.get("lost_events", 1) == 0
+                                and v2.get("lost_events", 1) == 0,
+            "clients_failed_over": v1.get("client_failovers", 0) > 0
+                                   and v2.get("client_failovers",
+                                              0) > 0,
+            # every proxy fault class provably fired
+            "faults_fired": all(
+                v1.get("faults_total", {}).get(k, 0) > 0
+                for k in ("reset", "stall", "truncate",
+                          "lease_blocked")),
+            # cross-replica audit: every mirror bit-identical at the
+            # leader's rvs
+            "audit_identical": v1.get("audit_identical", False)
+                               and v2.get("audit_identical", False),
+            # double run bit-identical on the CONTENT fingerprints
+            "deterministic_replay":
+                v1.get("bind_fingerprint")
+                == v2.get("bind_fingerprint")
+                and v1.get("ledger_fingerprint")
+                == v2.get("ledger_fingerprint"),
+        }
+        verdict = dict(v1, checks=checks, pass_=all(checks.values()))
+        verdict["pass"] = verdict.pop("pass_")
+        if args.json:
+            print(json.dumps(verdict, indent=1))
+        else:
+            print(f"procs={v1['procs']} epoch={v1.get('final_epoch')} "
+                  f"takeovers={v1.get('takeovers')} "
+                  f"fenced={v1.get('fenced_deposed_writes')} "
+                  f"restarts={v1.get('supervisor_restarts')} "
+                  f"subscribers={v1.get('subscribers')} "
+                  f"converged={v1.get('converged')} "
+                  f"client_failovers={v1.get('client_failovers')} "
+                  f"lost={v1.get('lost_events')}")
+            print(f"faults: {v1.get('faults_total')} "
+                  f"rv={v1.get('final_rv')} "
+                  f"elapsed={v1.get('elapsed_s')}s"
+                  f"+{v2.get('elapsed_s')}s")
+            for name, ok in checks.items():
+                print(f"  {name}: {'ok' if ok else 'FAIL'}")
+            print(f"federation-proc-smoke: "
+                  f"{'PASS' if verdict['pass'] else 'FAIL'}")
         return 0 if verdict["pass"] else 1
 
     if args.verb == "federation":
