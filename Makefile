@@ -6,7 +6,7 @@ PYTHON ?= python
 	deploy deploy-up trace-smoke sim-smoke flush-bench chaos-smoke \
 	failover-smoke obs-smoke incr-smoke multichip-smoke constraint-smoke \
 	storm-smoke explain-smoke prune-smoke federation-smoke \
-	federation-proc-smoke lint sanitize
+	federation-proc-smoke durability-smoke lint sanitize
 
 # one-command deployment (the reference's installer/volcano-development.yaml
 # analogue): bring up apiserver + webhook-manager (TLS admission) +
@@ -236,6 +236,22 @@ federation-smoke: prune-smoke
 # whole gate watchdogged.
 federation-proc-smoke: federation-smoke
 	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli federation --procs
+
+# WAL durability gate (docs/design/durability.md), after
+# federation-proc-smoke: the crash-consistency story end to end.
+# In-process: a torn final record is truncated (recovered store
+# bit-identical to the durable prefix), a mid-log bit flip makes
+# recovery REFUSE with segment/offset/CRC evidence, and an ENOSPC
+# episode flips the store read-only (structured 503 + Retry-After over
+# HTTP) then heals on freed space with a contiguous log. Process tier:
+# a real vc-apiserver --data-dir child is SIGKILLed at each of three
+# injection points (pre-fsync, post-fsync-pre-rename, mid-compaction),
+# supervised back up, and must replay its local WAL; after the writer
+# reconciles its acked-op map, the journal/bind/ledger content
+# fingerprints must be bit-identical to an uninterrupted run of the
+# same seeded plan — and the whole gate double-runs bit-identically.
+durability-smoke: federation-proc-smoke
+	JAX_PLATFORMS=cpu $(PYTHON) -m volcano_tpu.sim.cli durability
 
 # multi-chip sharding dryrun on the virtual CPU mesh (the raw
 # shard_map program + full-pipeline one-shot; multichip-smoke is the

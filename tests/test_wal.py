@@ -1,0 +1,378 @@
+"""Unit tripwires for the write-ahead journal
+(volcano_tpu/apiserver/wal.py + docs/design/durability.md).
+
+The process-level crash matrix lives in `make durability-smoke`
+(sim/durability.py: real SIGKILLs at the injection points, fingerprint
+bit-identity). These tests pin the WAL's *mechanisms* in isolation:
+record framing, torn-tail-vs-mid-log classification, group-commit
+ordering under concurrent flushers, ENOSPC degrade/heal, compaction
+anchoring, fence re-anchor, and the generation cutover that guards a
+snapshot-installed follower from replaying a dead rv space."""
+
+import json
+import os
+import threading
+import zlib
+
+import pytest
+
+from volcano_tpu.apiserver.store import ObjectStore, ReadOnlyError
+from volcano_tpu.apiserver.wal import (WalCorruptionError, WriteAheadLog,
+                                       pack_record, recover_store)
+from volcano_tpu.sim.faults import FileFaults, flip_bit, tear_tail
+from volcano_tpu.utils.test_utils import build_pod
+
+
+def _mk_wal(tmp_path, **kw):
+    store = ObjectStore()
+    wal = WriteAheadLog(str(tmp_path), **kw)
+    wal.attach(store)
+    return store, wal
+
+
+def _create(store, n, ns="wal", prefix="p"):
+    for i in range(n):
+        store.create("pods", build_pod(
+            ns, f"{prefix}{i}", "", "Pending",
+            {"cpu": "1", "memory": "1Gi"}), skip_admission=True)
+
+
+def _digest(store):
+    lines = []
+    for kind in ("pods", "nodes"):
+        for o in store.list(kind):
+            lines.append(f"{kind}/{o.metadata.namespace}/"
+                         f"{o.metadata.name}/{o.metadata.resource_version}")
+    return zlib.crc32("\n".join(sorted(lines)).encode())
+
+
+def _segments(tmp_path):
+    return sorted(p for p in os.listdir(tmp_path)
+                  if p.startswith("wal-") and p.endswith(".log"))
+
+
+class TestFraming:
+    def test_round_trip_recovers_everything(self, tmp_path):
+        store, wal = _mk_wal(tmp_path)
+        _create(store, 17)
+        store.bind_pods([(f"p{i}", "wal", f"node-{i % 3}")
+                         for i in range(17)])
+        wal.pump()
+        want = _digest(store)
+        rv = store.current_rv()
+        wal.close()
+        rec, rep = recover_store(str(tmp_path))
+        assert rec.current_rv() == rv
+        assert rep["entries_replayed"] == 34
+        assert _digest(rec) == want
+
+    def test_record_framing_is_len_crc_payload(self, tmp_path):
+        store, wal = _mk_wal(tmp_path)
+        _create(store, 1)
+        wal.pump()
+        wal.close()
+        seg = _segments(tmp_path)[0]
+        with open(tmp_path / seg, "rb") as f:
+            data = f.read()
+        import struct
+        off = 0
+        payloads = []
+        while off < len(data):
+            ln, crc = struct.unpack_from("<II", data, off)
+            payload = data[off + 8:off + 8 + ln]
+            assert zlib.crc32(payload) == crc
+            payloads.append(json.loads(payload))
+            off += 8 + ln
+        # a segment header record then the entry batch
+        assert payloads[0]["t"] == "seg"
+        assert payloads[1]["t"] == "e"
+        assert pack_record(b"x")[:4] == struct.pack("<I", 1)
+
+    def test_rv_sequencer_reanchors_after_recovery(self, tmp_path):
+        store, wal = _mk_wal(tmp_path)
+        _create(store, 5)
+        wal.pump()
+        wal.close()
+        rec, _ = recover_store(str(tmp_path))
+        # the next write must continue the rv space, not fork it
+        rec.create("pods", build_pod("wal", "after", "", "Pending",
+                                     {"cpu": "1", "memory": "1Gi"}),
+                   skip_admission=True)
+        assert rec.current_rv() == 6
+
+
+class TestTornAndCorrupt:
+    def test_torn_tail_truncates_to_clean_prefix(self, tmp_path):
+        store, wal = _mk_wal(tmp_path)
+        _create(store, 9)
+        wal.pump()
+        prefix = _digest(store)
+        _create(store, 1, prefix="late")
+        wal.pump()
+        wal.close()
+        seg = str(tmp_path / _segments(tmp_path)[-1])
+        tear_tail(seg, 6)
+        rec, rep = recover_store(str(tmp_path))
+        assert rep["torn_records_truncated"] == 1
+        assert rep["truncated_bytes"] > 0
+        assert rec.current_rv() == 9
+        assert _digest(rec) == prefix
+        # the truncation is durable: a second recovery sees no tear
+        rec2, rep2 = recover_store(str(tmp_path))
+        assert rep2["torn_records_truncated"] == 0
+        assert _digest(rec2) == prefix
+
+    def test_mid_log_bit_flip_refuses_with_evidence(self, tmp_path):
+        store, wal = _mk_wal(tmp_path)
+        for i in range(6):
+            _create(store, 1, prefix=f"r{i}-")
+            wal.pump()         # one record per pump -> flips land mid-log
+        wal.close()
+        seg = str(tmp_path / _segments(tmp_path)[0])
+        flip_bit(seg, offset=os.path.getsize(seg) // 2)
+        with pytest.raises(WalCorruptionError) as ei:
+            recover_store(str(tmp_path))
+        err = ei.value
+        assert err.segment.endswith(_segments(tmp_path)[0])
+        assert err.offset >= 0
+        assert "refus" in str(err) or "corrupt" in str(err).lower()
+
+
+class TestGroupCommit:
+    def test_concurrent_flushers_never_reorder_records(self, tmp_path):
+        """Regression: two flush() callers draining separate batches
+        used to race to the file write and land records out of rv
+        order — recovery then refused the log as gapped. Whole flushes
+        now serialize."""
+        store, wal = _mk_wal(tmp_path)
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                wal.flush()
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(40):
+                _create(store, 5, prefix=f"b{i}-")
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        rv = store.current_rv()
+        wal.close()
+        rec, rep = recover_store(str(tmp_path))
+        assert rec.current_rv() == rv == 200
+        assert rep["entries_replayed"] == 200
+
+    def test_bulk_run_lands_as_one_record_per_shard(self, tmp_path):
+        store, wal = _mk_wal(tmp_path)
+        n = 4096    # 2 shards: the sharded bulk path, whose per-shard
+        #             publish forwards ONE entry run to the WAL
+        _create(store, n)
+        wal.pump()
+        before = wal.report()["records_written"]
+        store.bind_pods([(f"p{i}", "wal", "node-0") for i in range(n)])
+        wal.pump()
+        # the 4096-bind flush group-commits as one record per shard,
+        # never one record per entry
+        shards = store._shard_count(n)
+        assert shards == 2
+        assert wal.report()["records_written"] == before + shards
+        wal.close()
+
+    def test_segment_rotation(self, tmp_path):
+        store, wal = _mk_wal(tmp_path, segment_max_bytes=4096,
+                             compact_interval=0)
+        for i in range(30):
+            _create(store, 3, prefix=f"s{i}-")
+            wal.pump()
+        assert len(_segments(tmp_path)) > 1
+        rv = store.current_rv()
+        wal.close()
+        rec, _ = recover_store(str(tmp_path))
+        assert rec.current_rv() == rv
+
+
+class TestDegradeHeal:
+    def test_enospc_degrades_then_heals_contiguously(self, tmp_path):
+        faults = FileFaults(enospc_after_bytes=1500)
+        store, wal = _mk_wal(tmp_path, opener=faults.opener)
+        created = 0
+        degraded_seen = False
+        for i in range(40):
+            try:
+                _create(store, 1, prefix=f"d{i}-")
+                created += 1
+            except ReadOnlyError as e:
+                degraded_seen = True
+                assert e.retry_after > 0
+                break
+            wal.pump()
+        assert degraded_seen and faults.enospc_hits >= 1
+        assert wal.report()["read_only"]
+        # heal: refill the byte budget, the retry re-lands the SAME
+        # wound-back batch (no rv gap for recovery)
+        faults.refill()
+        wal.pump()
+        assert not wal.report()["read_only"]
+        _create(store, 1, prefix="post-heal-")
+        wal.pump()
+        rv = store.current_rv()
+        wal.close()
+        rec, rep = recover_store(str(tmp_path))
+        assert rec.current_rv() == rv
+        assert rep["entries_replayed"] == created + 1
+
+    def test_eio_fsync_poisons_permanently(self, tmp_path):
+        faults = FileFaults(fail_fsync_after=1)
+        store, wal = _mk_wal(tmp_path, opener=faults.opener)
+        _create(store, 1)
+        wal.pump()           # first fsync succeeds
+        _create(store, 1, prefix="x")
+        wal.pump()           # second fsync EIOs -> poisoned
+        assert wal.report()["read_only"]
+        with pytest.raises(ReadOnlyError):
+            _create(store, 1, prefix="rejected")
+        # EIO never self-heals: fsyncgate semantics
+        wal.pump()
+        assert wal.report()["read_only"]
+
+
+class TestCompaction:
+    def test_compaction_anchors_and_prunes_segments(self, tmp_path):
+        store, wal = _mk_wal(tmp_path, segment_max_bytes=2048,
+                             compact_interval=0)
+        for i in range(20):
+            _create(store, 2, prefix=f"c{i}-")
+            wal.pump()
+        assert len(_segments(tmp_path)) > 2
+        anchor = wal.compact()
+        assert anchor == store.current_rv()
+        assert os.path.exists(tmp_path / "snapshot.json")
+        assert len(_segments(tmp_path)) == 1   # only the active one
+        _create(store, 1, prefix="tail-")
+        wal.pump()
+        rv = store.current_rv()
+        want = _digest(store)
+        wal.close()
+        rec, rep = recover_store(str(tmp_path))
+        assert rep["snapshot_rv"] == anchor
+        assert rec.current_rv() == rv
+        assert _digest(rec) == want
+
+    def test_fence_floor_survives_recovery(self, tmp_path):
+        store, wal = _mk_wal(tmp_path)
+        _create(store, 3)
+        store.advance_fence(7)
+        wal.pump()
+        wal.close()
+        rec, rep = recover_store(str(tmp_path))
+        assert rep["fence_floor"] == 7
+
+    def test_snapshot_install_cuts_generation(self, tmp_path):
+        """A follower that installs a peer snapshot replaces its rv
+        space: the WAL must cut over to a new generation so recovery
+        never replays pre-install segments into the new history."""
+        store, wal = _mk_wal(tmp_path)
+        _create(store, 4)
+        wal.pump()
+        old_gen = wal.report()["generation"]
+        # simulate the bootstrap path: a peer snapshot lands at rv 100
+        store.install_snapshot({"pods": []}, 100)
+        wal.pump()
+        assert wal.report()["generation"] == old_gen + 1
+        _create(store, 1, prefix="post-")
+        wal.pump()
+        rv = store.current_rv()
+        wal.close()
+        rec, rep = recover_store(str(tmp_path))
+        assert rec.current_rv() == rv == 101
+        # pre-install entries are in dead generations, never replayed
+        assert rep["entries_replayed"] == 1
+
+
+class TestSettleBarrierInteraction:
+    """Satellite gate (docs/design/durability.md): snapshot-anchored
+    compaction taken MID-BULK (the settle barrier's hard case — rvs
+    reserved, shards publishing) must produce a recoverable anchor, and
+    a live HTTP follower replicating throughout must end the episode
+    with fingerprints identical to both the live store and the
+    recovered one — the cross-replica anti-entropy audit's triple."""
+
+    def _fingerprints(self, store):
+        from volcano_tpu.apiserver.store import KINDS
+        from volcano_tpu.cache.cache import SchedulerCache
+        fp = SchedulerCache._fingerprint
+        return {kind: fp({store.key_of(kind, o):
+                          (o.metadata.resource_version, o)
+                          for o in store.list_refs(kind)})
+                for kind in KINDS}
+
+    def test_compact_mid_bulk_with_live_follower(self, tmp_path):
+        from volcano_tpu.apiserver.http import StoreHTTPServer
+        from volcano_tpu.replication.follower import (
+            FollowerReplica, HTTPReplicationSource)
+
+        store, wal = _mk_wal(tmp_path)
+        n = 4500                      # sharded bulk path (3 shards)
+        _create(store, n, ns="sb")
+        wal.pump()
+        server = StoreHTTPServer(store, port=0)
+        server.start()
+        try:
+            follower = FollowerReplica(
+                "f1", HTTPReplicationSource(
+                    f"http://127.0.0.1:{server.port}"))
+            follower.bootstrap()
+
+            errs = []
+
+            def bulk():
+                try:
+                    pairs, missing = store.bind_pods(
+                        [(f"p{i}", "sb", f"node-{i % 7}")
+                         for i in range(n)])
+                    assert not missing and len(pairs) == n
+                except Exception as e:          # surfaced on join
+                    errs.append(e)
+
+            t = threading.Thread(target=bulk)
+            t.start()
+            compactions = 0
+            while t.is_alive():
+                wal.compact()         # save_store mid-bulk
+                compactions += 1
+            t.join()
+            assert not errs, errs
+            assert compactions >= 1
+            # drain the tail past the last mid-bulk anchor
+            wal.pump()
+            follower.sync_to_head()
+            live_fp = self._fingerprints(store)
+            assert self._fingerprints(follower.store) == live_fp
+            rv = store.current_rv()
+            wal.close()
+            rec, rep = recover_store(str(tmp_path))
+            assert rec.current_rv() == rv
+            assert self._fingerprints(rec) == live_fp
+            assert rep["snapshot_rv"] > 0     # a mid-bulk anchor held
+        finally:
+            server.stop()
+
+
+class TestDurabilityReport:
+    def test_report_shape(self, tmp_path):
+        store, wal = _mk_wal(tmp_path)
+        _create(store, 2)
+        wal.pump()
+        rep = wal.report()
+        for key in ("durable_rv", "store_rv", "lag_entries", "segments",
+                    "fsyncs", "fsync_p99_ms", "append_p99_ms",
+                    "read_only", "generation"):
+            assert key in rep
+        assert rep["durable_rv"] == rep["store_rv"] == 2
+        assert rep["lag_entries"] == 0
+        wal.close()

@@ -531,6 +531,52 @@ def check_federation_procs(fresh: dict, failures: list) -> None:
             "cursor dropped journal events")
 
 
+def check_wal(fresh: dict, failures: list) -> None:
+    """The round-16 durability columns (bench.py's WAL leg: the bulk
+    bind flush A/B'd against itself with the write-ahead journal
+    attached, plus a cold-start recovery replay). The budget is on the
+    WRITER-VISIBLE cost: with the group-commit flusher paused, the
+    WAL-on bind must stay within 10% of the WAL-off bind — the append
+    handoff under the store lock is an O(1) run-reference enqueue, so
+    anything above noise there means durability leaked onto the write
+    path. The deferred encode+fsync drain and the recovery wall ride
+    along as tracked columns, not ratio gates (they are absolute
+    machine-speed-dependent costs; the row records them)."""
+    required = ("wal_off_flush_ms", "wal_bind_flush_ms",
+                "wal_flush_overhead_ratio", "wal_drain_ms",
+                "wal_append_p99_ms", "wal_fsync_p99_ms",
+                "wal_recovery_ms")
+    missing = [k for k in required if fresh.get(k) is None]
+    if missing:
+        failures.append(
+            f"wal columns missing: {', '.join(missing)} — the "
+            "round-16 durability leg did not run (re-run "
+            "`python bench.py`)")
+        return
+    off = float(fresh["wal_off_flush_ms"])
+    on = float(fresh["wal_bind_flush_ms"])
+    drain = float(fresh["wal_drain_ms"])
+    recovery = float(fresh["wal_recovery_ms"])
+    # paired within-round ratio (both legs back-to-back, best round):
+    # co-tenant drift cancels inside the pair, and a real handoff leak
+    # is systematic — it cannot hide from every round
+    ratio = float(fresh["wal_flush_overhead_ratio"])
+    verdict = "ok" if ratio <= 1.10 else "REGRESSION"
+    print(f"  {'wal bind overhead':<24} {on:9.1f} ms vs {off:.1f} ms "
+          f"off (paired x{ratio:.3f} <= x1.10) {verdict}")
+    if verdict != "ok":
+        failures.append(
+            f"wal_flush_overhead_ratio is {ratio:.3f}x (> 1.10x "
+            "budget in every paired round) — durability work leaked "
+            "onto the writer path (the append handoff must stay O(1))")
+    print(f"  {'wal drain':<24} {drain:9.1f} ms deferred group-commit "
+          f"drain (tracked)")
+    print(f"  {'wal fsync p99':<24} {float(fresh['wal_fsync_p99_ms']):9.1f} "
+          f"ms (tracked)")
+    print(f"  {'wal recovery':<24} {recovery:9.1f} ms cold-start "
+          f"replay (tracked)")
+
+
 def check(fresh: dict, baseline: dict, tolerance: float,
           baseline_cal: float, fresh_cal: float) -> int:
     scale = fresh_cal / baseline_cal if baseline_cal > 0 else 1.0
@@ -641,6 +687,7 @@ def check(fresh: dict, baseline: dict, tolerance: float,
     check_prune(fresh, failures)
     check_federation(fresh, failures, fresh_cal)
     check_federation_procs(fresh, failures)
+    check_wal(fresh, failures)
     if failures:
         print("bench-check: FAIL")
         for fmsg in failures:
@@ -866,6 +913,7 @@ def check_10x(fresh: dict, tolerance: float, fresh_cal: float,
     check_prune(fresh, failures)
     check_federation(fresh, failures, fresh_cal)
     check_federation_procs(fresh, failures)
+    check_wal(fresh, failures)
     if failures:
         print("bench-check: FAIL")
         for fmsg in failures:

@@ -42,7 +42,7 @@ from typing import List, Optional, Sequence, Union
 from ..utils.backoff import seeded_backoff
 from .codec import decode_object, encode_object
 from .store import (CLUSTER_SCOPED, KINDS, AdmissionError, ConflictError,
-                    FencedError, ObjectStore)
+                    FencedError, ObjectStore, ReadOnlyError)
 
 
 def _fence_of(query: dict):
@@ -92,11 +92,41 @@ def _tenant_of(query: dict) -> str:
     return query.get("tenant", ["default"])[0] or "default"
 
 
+# native frame encoder (fastmodel.encode_object_json): resolved lazily,
+# one probe per process — the guarded twin of the Python body below
+_ENCODER_NATIVE = [None, False]   # [module, probed]
+
+
+def _encoder_native():
+    if not _ENCODER_NATIVE[1]:
+        _ENCODER_NATIVE[1] = True
+        try:
+            from ..native.build import fastmodel
+            fm = fastmodel()
+            if fm is not None and hasattr(fm, "encode_object_json"):
+                _ENCODER_NATIVE[0] = fm
+        except Exception:
+            _ENCODER_NATIVE[0] = None
+    return _ENCODER_NATIVE[0]
+
+
 def json_object_encoder(kind: str, o) -> bytes:
     """The hub's shared wire codec (docs/design/federation.md): one
     JSON serialization of the object payload per event per burst,
     byte-shared across every subscriber's frame. Compact separators —
-    these bytes are spliced verbatim into NDJSON frame lines."""
+    these bytes are spliced verbatim into NDJSON frame lines.
+
+    The native fast path (``fastmodel.encode_object_json``) fuses the
+    dataclass reflection walk and the compact dump into one C pass;
+    byte parity with the Python body is pinned by
+    tests/test_native_encoder.py, and any native miss (no toolchain,
+    unencodable shape) falls through to the Python twin per object."""
+    fm = _encoder_native()
+    if fm is not None:
+        try:
+            return fm.encode_object_json(o)
+        except Exception:
+            pass    # unencodable shape: take the reflective path
     return json.dumps(encode_object(kind, o),
                       separators=(",", ":")).encode()
 
@@ -198,6 +228,19 @@ class StoreHTTPServer:
                            headers={"Retry-After":
                                     str(max(1, math.ceil(retry_after)))})
                 return False
+
+            def _send_read_only(self, e: ReadOnlyError) -> None:
+                """Durability degradation (docs/design/durability.md):
+                the WAL can no longer persist writes (ENOSPC/EIO), so
+                the store answers every mutation with the same
+                structured 503 + Retry-After shape the federation role
+                gate uses — the client pacer already honors it."""
+                retry_after = float(getattr(e, "retry_after", 5.0))
+                self._send(503, {"error": str(e), "read_only": True,
+                                 "reason": getattr(e, "reason", str(e)),
+                                 "retry_after": retry_after},
+                           headers={"Retry-After":
+                                    str(max(1, math.ceil(retry_after)))})
 
             def _staleness_headers(self) -> Optional[dict]:
                 """Read-path annotation: a non-leader replica stamps
@@ -596,6 +639,8 @@ class StoreHTTPServer:
                     created = store.create(kind, o, fence=fence,
                                            trace=_trace_of(query))
                     return self._send(201, encode_object(kind, created))
+                except ReadOnlyError as e:
+                    return self._send_read_only(e)
                 except FencedError as e:
                     return self._send(412, {"error": str(e)})
                 except AdmissionError as e:
@@ -621,6 +666,8 @@ class StoreHTTPServer:
                     updated = store.update(kind, o, fence=fence,
                                            trace=_trace_of(query))
                     return self._send(200, encode_object(kind, updated))
+                except ReadOnlyError as e:
+                    return self._send_read_only(e)
                 except FencedError as e:
                     return self._send(412, {"error": str(e)})
                 except ConflictError as e:
@@ -647,6 +694,8 @@ class StoreHTTPServer:
                     rv = store.delete(kind, name, ns, fence=fence,
                                       trace=_trace_of(query))
                     return self._send(200, {"status": "deleted", "rv": rv})
+                except ReadOnlyError as e:
+                    return self._send_read_only(e)
                 except FencedError as e:
                     return self._send(412, {"error": str(e)})
                 except AdmissionError as e:

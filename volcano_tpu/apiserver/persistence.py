@@ -23,7 +23,7 @@ from .store import KINDS, ObjectStore
 SNAPSHOT_VERSION = 1
 
 
-def save_store(store: ObjectStore, path: str) -> int:
+def save_store(store: ObjectStore, path: str, fsync: bool = False) -> int:
     """Write an atomic snapshot; returns the number of objects saved.
 
     Safe to call while a sharded bulk patch has rvs reserved but
@@ -34,11 +34,45 @@ def save_store(store: ObjectStore, path: str) -> int:
     parked behind the reservation — is captured. Restore re-anchors the
     sequencer at that counter, so a snapshot mid-flight never loses
     writes or replays a torn journal (tests/test_failover.py,
-    TestParkedJournalRestore)."""
-    payload = {"version": SNAPSHOT_VERSION, "resource_version": store._rv,
+    TestParkedJournalRestore).
+
+    ``fsync=True`` makes the snapshot crash-durable (file fsynced
+    before the rename, directory fsynced after) — the WAL compaction
+    contract (docs/design/durability.md) requires it; the periodic
+    checkpointer keeps the cheap page-cache write."""
+    count, _rv = save_store_anchored(store, path, fsync=fsync)
+    return count
+
+
+def save_store_anchored(store: ObjectStore, path: str,
+                        fsync: bool = False,
+                        extra: Optional[dict] = None,
+                        settle: bool = False) -> tuple:
+    """:func:`save_store` returning ``(count, anchor_rv)`` — the rv the
+    payload actually recorded, which WAL compaction needs to decide
+    which segments the snapshot supersedes. ``extra`` merges additional
+    top-level keys into the payload (the WAL stamps its generation and
+    the store's fence floor rides along for recovery re-anchoring).
+
+    ``settle=True`` waits for the journal settle barrier before reading
+    the anchor. The plain checkpointer path deliberately tolerates a
+    mid-flight anchor (the rv counter may be ahead of published
+    content), but WAL compaction must NOT: it prunes every segment at
+    or below the anchor, so a mid-bulk anchor taken above
+    still-publishing shards would silently drop those entries from
+    both the snapshot and the log. The settle wait releases the store
+    lock while blocked, so in-flight shard publishes finish rather
+    than deadlock."""
+    payload = {"version": SNAPSHOT_VERSION, "resource_version": 0,
                "objects": {}}
+    if extra:
+        payload.update(extra)
     count = 0
     with store._lock:
+        if settle:
+            store._wait_journal_settled_locked()
+        anchor = payload["resource_version"] = store._rv
+        payload["fence_floor"] = store._fence_floor
         for kind in sorted(KINDS):
             items = list(store._objects[kind].values())
             payload["objects"][kind] = [encode_object(kind, o) for o in items]
@@ -47,14 +81,36 @@ def save_store(store: ObjectStore, path: str) -> int:
     os.makedirs(directory, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=directory, prefix=".snapshot-")
     try:
+        # lint: allow(durability): tmp-file write inside the atomic-rename helper
         with os.fdopen(fd, "w") as f:
             json.dump(payload, f)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        if fsync:
+            from .wal import _maybe_crash
+            _maybe_crash("post-fsync-pre-rename")
+        # lint: allow(durability): this IS the sanctioned atomic-rename helper
         os.replace(tmp, path)   # atomic on POSIX
+        if fsync:
+            from .wal import _fsync_dir
+            _fsync_dir(directory)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
-    return count
+    return count, anchor
+
+
+def load_snapshot_payload(path: str) -> dict:
+    """Read + version-check a snapshot file without installing it (the
+    WAL recovery path installs rv-preserving itself)."""
+    with open(path) as f:
+        payload = json.load(f)
+    if payload.get("version") != SNAPSHOT_VERSION:
+        raise ValueError(f"unsupported snapshot version "
+                         f"{payload.get('version')!r}")
+    return payload
 
 
 def load_store(path: str, store: Optional[ObjectStore] = None,
@@ -74,11 +130,7 @@ def load_store(path: str, store: Optional[ObjectStore] = None,
     acquisition (the lease ConfigMap itself IS snapshotted). A restorer
     that must close the window before that acquisition carries the old
     floor over explicitly (sim/engine.py _swap_store_from_snapshot)."""
-    with open(path) as f:
-        payload = json.load(f)
-    if payload.get("version") != SNAPSHOT_VERSION:
-        raise ValueError(f"unsupported snapshot version "
-                         f"{payload.get('version')!r}")
+    payload = load_snapshot_payload(path)
     if store is None:
         store = ObjectStore(clock=clock) if clock is not None else ObjectStore()
     count = 0

@@ -148,6 +148,18 @@ class FencedError(Exception):
     stop writing until it re-acquires leadership (and a fresh token)."""
 
 
+class ReadOnlyError(Exception):
+    """The store is in durability-degraded read-only mode (the WAL hit
+    ENOSPC/EIO, docs/design/durability.md): every mutation is refused
+    before any state changes. The HTTP edge maps this to a structured
+    503 + Retry-After, which the client pacer already honors."""
+
+    def __init__(self, reason: str, retry_after: float = 5.0):
+        super().__init__(f"store is read-only: {reason}")
+        self.reason = reason
+        self.retry_after = retry_after
+
+
 class ReplicationGapError(Exception):
     """Raised by :meth:`ObjectStore.apply_replicated` when a replicated
     frame does not extend the follower mirror's journal contiguously
@@ -283,6 +295,13 @@ class ObjectStore:
         # lives in the lease ConfigMap and IS snapshotted).
         self._fence_floor = 0
         self.fenced_writes = 0
+        # durable write-ahead journal (docs/design/durability.md):
+        # attached via attach_wal; every journal-tail advance forwards
+        # its landed entries (O(1) ref enqueue). A WAL append failure
+        # (ENOSPC/EIO) flips the store read-only — writes raise
+        # ReadOnlyError before any state mutates.
+        self.wal = None
+        self._read_only_reason: Optional[str] = None
         # trace-context propagation (docs/design/observability.md): every
         # write form accepts a ``trace=`` correlation ID; committed rvs
         # are recorded here as (lo, hi, trace) ranges so a journal entry
@@ -321,6 +340,10 @@ class ObjectStore:
         with self._lock:
             if token > self._fence_floor:
                 self._fence_floor = token
+                if self.wal is not None:
+                    # fence advances are WAL records so recovery
+                    # re-anchors the floor (docs/design/durability.md)
+                    self.wal.append_fence(token)
             return self._fence_floor
 
     def fence_floor(self) -> int:
@@ -341,6 +364,36 @@ class ObjectStore:
                 f"write fenced: token {fence} is behind the floor "
                 f"{self._fence_floor} (lease superseded)")
 
+    # -- durability (docs/design/durability.md) ----------------------------
+
+    def attach_wal(self, wal) -> None:
+        """Bind a :class:`~volcano_tpu.apiserver.wal.WriteAheadLog`:
+        every journal-tail advance from here on forwards its landed
+        entries. Attach AFTER recovery — the WAL must open its active
+        segment at the recovered tail, not mid-replay."""
+        with self._lock:
+            self.wal = wal
+
+    def enter_read_only(self, reason: str) -> None:
+        """Durability degradation: refuse every mutation until the WAL
+        heals (ENOSPC freed) or the process restarts."""
+        with self._lock:
+            self._read_only_reason = reason
+
+    def exit_read_only(self) -> None:
+        with self._lock:
+            self._read_only_reason = None
+
+    def read_only_reason(self) -> Optional[str]:
+        with self._lock:
+            return self._read_only_reason
+
+    def _check_writable_locked(self) -> None:
+        """Raised before any state mutates — an acked write must never
+        exist only in RAM while the log can no longer persist it."""
+        if self._read_only_reason is not None:
+            raise ReadOnlyError(self._read_only_reason)
+
     # -- keys --------------------------------------------------------------
 
     @staticmethod
@@ -358,7 +411,8 @@ class ObjectStore:
         range below them publishes; watchers are only notified when the
         tail actually advances (parked entries are not yet visible)."""
         if rv == self._journal_tail + 1:
-            self._journal.append((rv, action, kind, o))
+            landed = [(rv, action, kind, o)]
+            self._journal.append(landed[0])
             self._journal_tail = rv
             parked = self._journal_parked
             while parked:
@@ -366,8 +420,11 @@ class ObjectStore:
                 if nxt is None:
                     break
                 self._journal.append(nxt)
+                landed.append(nxt)
                 self._journal_tail += 1
             self._journal_cond.notify_all()
+            if self.wal is not None:
+                self.wal.append_entries(landed)
         else:
             self._journal_parked[rv] = (rv, action, kind, o)
 
@@ -385,14 +442,24 @@ class ObjectStore:
         if entries[0][0] == self._journal_tail + 1:
             self._journal.extend(entries)
             self._journal_tail = entries[-1][0]
+            drained = None
             parked = self._journal_parked
             while parked:
                 nxt = parked.pop(self._journal_tail + 1, None)
                 if nxt is None:
                     break
                 self._journal.append(nxt)
+                if drained is None:
+                    drained = []
+                drained.append(nxt)
                 self._journal_tail += 1
             self._journal_cond.notify_all()
+            if self.wal is not None:
+                # no copy on the hot path: the WAL holds the run by ref
+                # (journal lists are never mutated after publish)
+                self.wal.append_entries(
+                    entries if drained is None
+                    else list(entries) + drained)
         else:
             for e in entries:
                 self._journal_parked[e[0]] = e
@@ -457,6 +524,7 @@ class ObjectStore:
             # fence AFTER the settle wait (which releases the lock): a
             # takeover can happen while this writer queues behind an
             # in-flight flush, and the stale write must not land then
+            self._check_writable_locked()
             self._check_fence_locked(fence)
             key = self.key_of(kind, o)
             if key in self._objects[kind]:
@@ -503,6 +571,7 @@ class ObjectStore:
             # fence AFTER the barrier wait (which releases the lock): a
             # takeover can happen while this writer queues behind an
             # in-flight flush, and the stale write must not land then
+            self._check_writable_locked()
             self._check_fence_locked(fence)
             old = self._objects[kind].get(key)
             if old is None:
@@ -667,6 +736,7 @@ class ObjectStore:
                 # after the wait: a takeover may have happened while this
                 # writer queued behind another flush — check at the last
                 # possible instant before anything is resolved/reserved
+                self._check_writable_locked()
                 self._check_fence_locked(fence)
                 objs = self._objects[kind]
                 seen: set = set()
@@ -989,6 +1059,7 @@ class ObjectStore:
         with self._lock:
             self._wait_journal_settled_locked()
             # fence after the barrier wait — see update()
+            self._check_writable_locked()
             self._check_fence_locked(fence)
             old = self._objects[kind].get(key)
             if old is None:
@@ -1030,6 +1101,7 @@ class ObjectStore:
         deliveries: list = []
         with self._lock:
             self._wait_journal_settled_locked()
+            self._check_writable_locked()
             self._check_fence_locked(epoch)
             rvs = [int(e[0]) for e in entries]
             expected = self._journal_tail + 1
@@ -1115,6 +1187,7 @@ class ObjectStore:
             staged[kind] = dict(incoming)
         with self._lock:
             self._wait_journal_settled_locked()
+            self._check_writable_locked()
             self._check_fence_locked(epoch)
             for kind in KINDS:
                 self._objects[kind] = staged[kind]
@@ -1124,6 +1197,12 @@ class ObjectStore:
             self._rv = self._journal_tail = int(rv)
             self._journal_cond.notify_all()
             self._flush_cond.notify_all()
+            if self.wal is not None:
+                # the rv space changed wholesale: the WAL drops its
+                # pre-install pending batches and schedules a generation
+                # cutover + fresh snapshot (flag-set only — the flusher
+                # does the IO off this lock)
+                self.wal.on_snapshot_installed(int(rv))
         return int(rv)
 
     def get(self, kind: str, name: str, namespace: str = "default"):

@@ -11,6 +11,9 @@
     vcctl debug replication     replica-set state: epoch, follower lag /
                                 applied rvs, gap/bootstrap/fence counters,
                                 last anti-entropy audit
+    vcctl debug durability      write-ahead-log state: durable rv / lag,
+                                fsync latency, segments, last recovery
+                                (exit 1 while the store is read-only)
 
 Talks to the metrics server (`--metrics` / $VOLCANO_METRICS, default
 http://127.0.0.1:8080), not the apiserver; `--json` prints the raw
@@ -29,7 +32,7 @@ from typing import List
 DEFAULT_METRICS = os.environ.get("VOLCANO_METRICS",
                                  "http://127.0.0.1:8080")
 VERBS = ("cycles", "pending", "health", "latency", "timeseries",
-         "explain", "replication")
+         "explain", "replication", "durability")
 
 
 def fetch(server: str, path: str, timeout: float = 10.0):
@@ -282,10 +285,49 @@ def _render_replication(payload: dict) -> str:
     return "\n".join(lines)
 
 
+def _render_durability(payload: dict) -> str:
+    if not payload.get("attached"):
+        lines = ["no WAL attached (started without --data-dir)"]
+    else:
+        lines = [
+            f"wal {payload.get('data_dir')}: gen={payload.get('generation')} "
+            f"durable_rv={payload.get('durable_rv')} "
+            f"store_rv={payload.get('store_rv')} "
+            f"lag={payload.get('lag_entries')} entries",
+            _table([[payload.get("segments"), payload.get("segment_bytes"),
+                     payload.get("records_written"),
+                     payload.get("entries_written"),
+                     payload.get("fsyncs"),
+                     payload.get("fsync_p50_ms"),
+                     payload.get("fsync_p99_ms"),
+                     payload.get("append_p99_ms"),
+                     payload.get("compactions"),
+                     payload.get("rotations")]],
+                   ["segs", "bytes", "records", "entries", "fsyncs",
+                    "fsync_p50", "fsync_p99", "append_p99", "compact",
+                    "rotate"]),
+        ]
+        if payload.get("read_only"):
+            lines.append(f"READ-ONLY: {payload.get('degraded_reason')} "
+                         "(writes 503 + Retry-After until the append "
+                         "path heals)")
+    rec = payload.get("last_recovery")
+    if rec:
+        lines.append(
+            f"last recovery: rv {rec.get('snapshot_rv')} -> "
+            f"{rec.get('final_rv')} "
+            f"({rec.get('snapshot_objects')} snapshot objects, "
+            f"{rec.get('entries_replayed')} WAL entries, "
+            f"{rec.get('torn_records_truncated')} torn records truncated, "
+            f"{rec.get('recovery_ms')}ms)")
+    return "\n".join(lines)
+
+
 _RENDER = {"cycles": _render_cycles, "pending": _render_pending,
            "health": _render_health, "latency": _render_latency,
            "timeseries": _render_timeseries, "explain": _render_explain,
-           "replication": _render_replication}
+           "replication": _render_replication,
+           "durability": _render_durability}
 
 
 def _replication_degraded(payload: dict, max_lag: int):
@@ -333,6 +375,13 @@ def dispatch_debug(args) -> int:
         if reason:
             print(f"DEGRADED: {reason}")
             return 1
+    # a read-only store (ENOSPC/EIO degradation) is operationally
+    # degraded even though the endpoint itself serves 200
+    if args.verb == "durability" and status < 400 \
+            and payload.get("read_only"):
+        print(f"DEGRADED: store read-only "
+              f"({payload.get('degraded_reason')})")
+        return 1
     return 0 if status < 400 else 1
 
 

@@ -36,10 +36,20 @@ def main(argv=None) -> int:
     parser.add_argument("--default-queue", action="store_true",
                         help="pre-create the default queue")
     parser.add_argument("--data-dir", default=None,
-                        help="persist the store to DIR/snapshot.json and "
-                             "restore it on startup (the etcd durability "
-                             "role; apiserver/persistence.py)")
-    parser.add_argument("--checkpoint-interval", type=float, default=30.0)
+                        help="durable state under DIR: segmented "
+                             "write-ahead log + snapshot.json, replayed "
+                             "crash-consistently on startup (the etcd "
+                             "durability role; apiserver/wal.py, "
+                             "docs/design/durability.md)")
+    parser.add_argument("--checkpoint-interval", type=float, default=30.0,
+                        help="WAL compaction interval, seconds (snapshot "
+                             "anchor + segment purge)")
+    parser.add_argument("--wal-flush-interval", type=float, default=0.05,
+                        help="WAL group-commit fsync interval, seconds "
+                             "(the bounded acked-but-not-durable window)")
+    parser.add_argument("--wal-segment-bytes", type=int,
+                        default=64 * 1024 * 1024,
+                        help="WAL segment rotation size")
     # multi-tenant serving hub (docs/design/serving.md): the sharded
     # watch fan-out behind /watchstream plus per-tenant admission at the
     # write edge. On by default; --serving-shards 0 disables the hub
@@ -92,18 +102,25 @@ def main(argv=None) -> int:
         print_version_and_exit()
 
     store = ObjectStore()
-    checkpointer = None
+    wal = None
+    recovered_rv = 0
     if args.data_dir:
-        import os as _os
-
-        from ..apiserver.persistence import StoreCheckpointer, load_store
-        snapshot = _os.path.join(args.data_dir, "snapshot.json")
-        if _os.path.exists(snapshot):
-            _, total = load_store(snapshot, store)
-            print(f"restored {total} objects from {snapshot}", flush=True)
-        checkpointer = StoreCheckpointer(store, snapshot,
-                                         interval=args.checkpoint_interval)
-        checkpointer.start()
+        from ..apiserver.wal import WriteAheadLog, recover_store
+        _, recovery = recover_store(args.data_dir, store)
+        recovered_rv = recovery["final_rv"]
+        if recovery["snapshot_objects"] or recovery["entries_replayed"]:
+            print(f"recovered rv={recovered_rv} "
+                  f"(snapshot {recovery['snapshot_objects']} objects @ "
+                  f"rv {recovery['snapshot_rv']}, "
+                  f"{recovery['entries_replayed']} WAL entries, "
+                  f"{recovery['torn_records_truncated']} torn records "
+                  f"truncated) from {args.data_dir}", flush=True)
+        wal = WriteAheadLog(args.data_dir,
+                            flush_interval=args.wal_flush_interval,
+                            segment_max_bytes=args.wal_segment_bytes,
+                            compact_interval=args.checkpoint_interval)
+        wal.attach(store)
+        wal.start()
     def ensure(kind, obj_):
         try:
             store.create(kind, obj_)
@@ -152,7 +169,9 @@ def main(argv=None) -> int:
             renew_interval=args.renew_interval,
             bootstrap_leader=args.bootstrap_leader,
             initial_leader=initial,
-            initial_leader_url=peers.get(initial, ""))
+            initial_leader_url=peers.get(initial, ""),
+            local_recovery_floor=(recovery["fence_floor"]
+                                  if recovered_rv > 0 else None))
         set_active(member=member)
     elif args.replicate_from:
         from ..replication import set_active
@@ -161,7 +180,17 @@ def main(argv=None) -> int:
         source = HTTPReplicationSource(args.replicate_from)
         name = args.replica_name or f"{args.host}:{args.port}"
         follower = FollowerReplica(name, source, store=store, hub=hub)
-        follower.bootstrap()                  # cold-start snapshot
+        if recovered_rv > 0:
+            # federation restart fast path (docs/design/durability.md):
+            # local WAL recovery already re-anchored the mirror at the
+            # leader's rvs — resume the journal pull from there and only
+            # fall back to the peer snapshot bootstrap when the sync
+            # loop proves the log behind the leader's retained window
+            # (gap -> catch-up relist -> bootstrap, follower.py)
+            print(f"follower resuming from local WAL at rv "
+                  f"{recovered_rv} (peer bootstrap skipped)", flush=True)
+        else:
+            follower.bootstrap()              # cold-start snapshot
         follower.start()                      # continuous journal pull
         set_active(follower=follower)
     metrics_server = None
@@ -198,11 +227,11 @@ def main(argv=None) -> int:
         follower.stop()
     if metrics_server is not None:
         metrics_server.stop()
-    if checkpointer is not None:
-        # stop accepting writes BEFORE the final checkpoint: an acked
-        # write landing after the last save would be lost on restart
+    if wal is not None:
+        # stop accepting writes BEFORE the final flush+compact: an acked
+        # write landing after the last fsync would be lost on restart
         server.stop()
-        checkpointer.stop(final_checkpoint=True)   # durable shutdown
+        wal.close(final_compact=True)   # durable shutdown
     return 0
 
 

@@ -1,6 +1,7 @@
 """Rule catalog for the invariant lint suite (one module per rule)."""
 
 from .clock import ClockDisciplineRule  # noqa: F401
+from .durability import DurabilityRule  # noqa: F401
 from .locks import LockDisciplineRule  # noqa: F401
 from .native_parity import NativeFallbackParityRule  # noqa: F401
 from .randomness import SeededRandomnessRule  # noqa: F401

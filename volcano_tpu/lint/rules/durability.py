@@ -1,0 +1,113 @@
+"""durability: apiserver state writes go through the WAL/atomic-rename
+helpers, never raw file I/O.
+
+The crash-consistency contract (docs/design/durability.md) holds only
+because every durable mutation funnels through exactly two write
+paths: the segmented WAL's framed append (``apiserver/wal.py``) and
+the snapshot's fsync + ``os.replace`` tmp-rename
+(``persistence.save_store_anchored``). A stray ``open(path, "w")`` or
+bare ``os.replace`` in the apiserver package is a state write outside
+the protocol — it can tear on power loss, skip the directory fsync,
+or bypass the read-only degradation gate — and it only shows up as a
+corrupt store after the one crash that matters.
+
+Flagged inside ``apiserver/``:
+
+* ``open(..., "w"/"a"/"wb"/"ab"/...)`` — any write/append mode, and
+  ``os.fdopen`` in a write mode (the snapshot helper's own fdopen
+  carries the sanctioned pragma);
+* ``os.replace`` / ``os.rename`` — atomic installs belong in the one
+  helper that fsyncs file and directory.
+
+Read-mode opens are untouched. The sanctioned implementation sites
+carry ``# lint: allow(durability): <why>`` pragmas — the escape hatch
+IS the audit trail.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..framework import (Finding, LintContext, ParsedModule, Rule,
+                         dotted_name)
+
+_DEFAULT_SCOPE = ("apiserver/",)
+
+#: mode strings whose presence makes an open() a state write
+_WRITE_MODE_CHARS = ("w", "a", "x", "+")
+
+
+def _mode_of(call: ast.Call) -> str:
+    """The literal mode argument of an open()/fdopen() call, or "" when
+    absent/dynamic (dynamic modes are flagged conservatively)."""
+    args = call.args
+    if len(args) >= 2:
+        node = args[1]
+    else:
+        node = next((kw.value for kw in call.keywords
+                     if kw.arg == "mode"), None)
+    if node is None:
+        return "r"                       # open() default: read
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return "?"                           # dynamic: treat as a write
+
+
+def _is_write_mode(mode: str) -> bool:
+    return mode == "?" or any(c in mode for c in _WRITE_MODE_CHARS)
+
+
+class DurabilityRule(Rule):
+    name = "durability"
+    description = ("apiserver state writes go through the WAL append / "
+                   "atomic-rename helpers (open-for-write, os.replace "
+                   "and os.rename are flagged outside them)")
+
+    def __init__(self, scope=_DEFAULT_SCOPE):
+        self.scope = tuple(scope)
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in ctx.modules:
+            if not ctx.in_scope(mod, self.scope):
+                continue
+            out.extend(self._check_module(mod))
+        return out
+
+    def _check_module(self, mod: ParsedModule) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            # bare open(...) (the builtin; a shadowing local would be
+            # stranger than a false positive)
+            if isinstance(fn, ast.Name) and fn.id == "open":
+                mode = _mode_of(node)
+                if _is_write_mode(mode):
+                    out.append(mod.finding(
+                        self.name, node,
+                        f"open(..., {mode!r}) writes apiserver state "
+                        f"outside the WAL/atomic-rename helpers; route "
+                        f"through WriteAheadLog or "
+                        f"save_store_anchored, or pragma the sanctioned "
+                        f"helper"))
+                continue
+            dn = dotted_name(fn)
+            if dn is None:
+                continue
+            if dn in ("os.replace", "os.rename"):
+                out.append(mod.finding(
+                    self.name, node,
+                    f"{dn} outside save_store_anchored: atomic "
+                    f"installs must fsync the file before and the "
+                    f"directory after the rename — use the snapshot "
+                    f"helper or pragma the sanctioned site"))
+            elif dn == "os.fdopen" and _is_write_mode(_mode_of(node)):
+                out.append(mod.finding(
+                    self.name, node,
+                    "os.fdopen in a write mode writes apiserver state "
+                    "outside the WAL/atomic-rename helpers; use the "
+                    "snapshot helper or pragma the sanctioned site"))
+        return out

@@ -9,7 +9,7 @@ from typing import List, Optional, Sequence, Tuple
 
 from . import baseline as baseline_mod
 from .framework import Finding, LintContext, Rule, collect_modules
-from .rules import (ClockDisciplineRule, JitPurityRule,
+from .rules import (ClockDisciplineRule, DurabilityRule, JitPurityRule,
                     LockDisciplineRule, NativeFallbackParityRule,
                     SeededRandomnessRule)
 
@@ -17,7 +17,7 @@ from .rules import (ClockDisciplineRule, JitPurityRule,
 def default_rules() -> List[Rule]:
     return [ClockDisciplineRule(), LockDisciplineRule(),
             NativeFallbackParityRule(), SeededRandomnessRule(),
-            JitPurityRule()]
+            JitPurityRule(), DurabilityRule()]
 
 
 def run_lint(package_root: str, tests_dir: Optional[str] = None,

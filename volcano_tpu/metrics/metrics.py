@@ -187,6 +187,27 @@ REPLICATION_LAG = f"{NS}_replication_follower_lag_rvs"
 REPLICATION_HANDOFFS = f"{NS}_replication_cursor_handoffs_total"
 REPLICATION_AUDITS = f"{NS}_replication_fingerprint_audits_total"
 
+# write-ahead-log durability (PR 20, docs/design/durability.md):
+# append batches accepted from the store's journal hook, framed records
+# and journal entries written, group-commit fsyncs + their latency, the
+# durable rv watermark (everything at or below survived a crash), the
+# read-only degradation gauge (1 while ENOSPC/EIO has the write path
+# returning structured 503s), live segment count, snapshot-anchored
+# compactions, recoveries replayed at startup, and torn final records
+# truncated by recovery (expected after a mid-flush crash; anything
+# further in is corruption and refuses to load)
+WAL_APPENDS = f"{NS}_wal_appends_total"
+WAL_RECORDS = f"{NS}_wal_records_total"
+WAL_ENTRIES = f"{NS}_wal_entries_total"
+WAL_FSYNCS = f"{NS}_wal_fsyncs_total"
+WAL_FSYNC_MS = f"{NS}_wal_fsync_latency_milliseconds"
+WAL_DURABLE_RV = f"{NS}_wal_durable_rv"
+WAL_READ_ONLY = f"{NS}_wal_read_only"
+WAL_SEGMENTS = f"{NS}_wal_segments"
+WAL_COMPACTIONS = f"{NS}_wal_compactions_total"
+WAL_RECOVERIES = f"{NS}_wal_recoveries_total"
+WAL_TORN_TRUNCATIONS = f"{NS}_wal_torn_truncations_total"
+
 # component health registry behind /debug/health: a component absent from
 # the registry is healthy by default; the watchdog (scheduler.py) flips
 # "scheduler" on a cycle-deadline breach and back on recovery

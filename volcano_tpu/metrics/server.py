@@ -44,6 +44,9 @@ DEBUG_ENDPOINTS = {
                       "pruning-readiness aggregates",
     "/debug/replication": "replica-set state: epoch, follower lag/applied "
                           "rvs, gap/bootstrap/fence counters, last audit",
+    "/debug/durability": "write-ahead-log state: durable rv / lag, fsync "
+                         "latency, segments, read-only degradation, last "
+                         "recovery",
 }
 
 
@@ -101,6 +104,9 @@ def _debug_response(path: str, query: dict):
     if path == "/debug/replication":
         from ..replication import replication_report
         return 200, replication_report()
+    if path == "/debug/durability":
+        from ..apiserver.wal import durability_report
+        return 200, durability_report()
     if path == "/debug/explain":
         from ..trace import explain
         job = query.get("job")

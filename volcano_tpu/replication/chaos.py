@@ -503,13 +503,18 @@ class ReplicaProcess:
     """
 
     def __init__(self, name: str, argv: List[str], probe_url: str,
-                 seed: int = 0, max_restarts: int = 3):
+                 seed: int = 0, max_restarts: int = 3,
+                 extra_env: Optional[Dict[str, str]] = None):
         self.name = name
         self.argv = list(argv)
         self.probe_url = probe_url.rstrip("/")
         self.seed = int(seed)
         self.max_restarts = max_restarts
         self.restarts = 0
+        # one-shot env overlay (the durability smoke arms
+        # VOLCANO_WAL_CRASH on the child it intends to kill; the
+        # supervised restart must NOT re-arm it)
+        self.extra_env = dict(extra_env or {})
         self.proc: Optional[subprocess.Popen] = None
         self.log: deque = deque(maxlen=400)
         self._drainer: Optional[threading.Thread] = None
@@ -517,6 +522,8 @@ class ReplicaProcess:
     def start(self) -> None:
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
+        env.update(self.extra_env)
+        self.extra_env = {}
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "volcano_tpu.cmd.apiserver",
              *self.argv],
@@ -1174,6 +1181,12 @@ def run_federation_procs(seed: int = 43, subscribers: int = 1024,
                           truncate_rate=truncate_rate)
                for i in range(3)]
     peers = ",".join(f"{names[i]}={proxies[i].url}" for i in range(3))
+    # every replica runs the durable WAL (docs/design/durability.md):
+    # the SIGKILLed leader's restart exercises real crash recovery, and
+    # the epoch guard then decides local-log resume vs peer bootstrap
+    import tempfile
+    data_dirs = [tempfile.mkdtemp(prefix=f"vc-wal-{names[i]}-")
+                 for i in range(3)]
 
     def _argv(i: int) -> List[str]:
         argv = ["--host", "127.0.0.1", "--port", str(direct_ports[i]),
@@ -1181,6 +1194,9 @@ def run_federation_procs(seed: int = 43, subscribers: int = 1024,
                 "--max-subscriptions", "8192",
                 "--tenant-write-rate", "100000",
                 "--tenant-write-burst", "100000",
+                "--data-dir", data_dirs[i],
+                "--wal-flush-interval", "0.02",
+                "--checkpoint-interval", "5",
                 "--peers", peers,
                 "--replica-name", names[i],
                 "--advertise-url", proxies[i].url,
@@ -1312,6 +1328,11 @@ def run_federation_procs(seed: int = 43, subscribers: int = 1024,
         verdict["supervisor_restarts"] = procs[1].restarts
         verdict["restarted_ready"] = restarted and procs[1].wait_ready(
             60.0)
+        # the SIGKILLed replica must have replayed its local WAL on the
+        # way back up (the deposed-leader epoch guard then decides
+        # whether to keep the log or snapshot-bootstrap over it)
+        verdict["restarted_recovered_wal"] = any(
+            "recovered rv=" in line for line in procs[1].log)
         proxies[1].heal()
 
         # -- replay + settle -------------------------------------------
@@ -1391,6 +1412,9 @@ def run_federation_procs(seed: int = 43, subscribers: int = 1024,
     finally:
         watchdog.cancel()
         _teardown()
+        import shutil
+        for d in data_dirs:
+            shutil.rmtree(d, ignore_errors=True)
     verdict["elapsed_s"] = round(time.perf_counter() - t0, 1)
     return verdict
 

@@ -286,7 +286,8 @@ class FederationMember:
                  initial_leader_url: str = "",
                  push_timeout: float = 2.0,
                  source_timeout: float = 5.0,
-                 clock=None):
+                 clock=None,
+                 local_recovery_floor: Optional[int] = None):
         self.name = name
         self.store = store
         self.hub = hub
@@ -310,6 +311,19 @@ class FederationMember:
             else ("leader" if bootstrap_leader else "follower")
         self._follower = None          # FollowerReplica while following
         self._needs_bootstrap = True   # first follow / post-deposition
+        # federation restart fast path (docs/design/durability.md): the
+        # fence floor the local WAL recovery re-anchored, consumed
+        # one-shot at the first follow.  The local log is trusted —
+        # bootstrap skipped — only while the CURRENT leader's token is
+        # <= this floor, i.e. no takeover happened since the log's last
+        # durable fence record: within one regime a restarted replica's
+        # log is a prefix of the leader's history (catch-up closes the
+        # gap; the window-rolled case still bootstraps via the sync
+        # loop).  A deposed leader's un-replicated tail occupies rvs the
+        # new regime reassigned, so any epoch advance forces the
+        # snapshot re-anchor instead.
+        self._recovery_floor = local_recovery_floor
+        self.bootstrap_skips = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.takeovers = 0
@@ -454,6 +468,13 @@ class FederationMember:
         source = HTTPReplicationSource(url, timeout=self.source_timeout)
         follower = FollowerReplica(self.name, source, store=self.store,
                                    hub=self.hub)
+        if needs_bootstrap and self._recovery_floor is not None:
+            floor, self._recovery_floor = self._recovery_floor, None
+            token = int(self.board.peek().get("token") or 0)
+            if token <= floor:
+                # local-WAL fast path: same regime as the recovered log
+                needs_bootstrap = False
+                self.bootstrap_skips += 1
         if needs_bootstrap:
             try:
                 follower.bootstrap()
@@ -521,6 +542,7 @@ class FederationMember:
             "lease_pushes": self.lease_pushes,
             "push_errors": self.push_errors,
             "bootstrap_failures": self.bootstrap_failures,
+            "bootstrap_skips": self.bootstrap_skips,
             "fence_floor": self.store.fence_floor(),
             "accepts_writes": self.accepts_writes(),
         }

@@ -203,6 +203,26 @@ def add_sim_parser(sub) -> None:
                      help="(--procs) per-run hard deadline, seconds")
     fed.add_argument("--json", action="store_true")
 
+    dur = sim.add_parser(
+        "durability", help="CI gate (make durability-smoke): the WAL's "
+                           "crash-consistency story — torn-tail "
+                           "truncation, mid-log bit-flip refusal (with "
+                           "offset+CRC evidence), ENOSPC read-only "
+                           "degradation (structured 503) + heal, and "
+                           "real vc-apiserver children SIGKILLed at "
+                           "three injection points (pre-fsync, "
+                           "post-fsync-pre-rename, mid-compaction) "
+                           "whose recovered journal/bind/ledger "
+                           "fingerprints must be bit-identical to an "
+                           "uninterrupted run; double run bit-identical")
+    dur.add_argument("--seed", type=int, default=47)
+    dur.add_argument("--pods", type=int, default=72,
+                     help="writer workload size per process run")
+    dur.add_argument("--nodes", type=int, default=8)
+    dur.add_argument("--watchdog", type=float, default=420.0,
+                     help="per-run hard deadline, seconds")
+    dur.add_argument("--json", action="store_true")
+
     exp = sim.add_parser(
         "explain", help="CI gate (make explain-smoke): constrained churn "
                         "+ a preemption storm with the placement "
@@ -1133,6 +1153,37 @@ def dispatch_sim(args) -> int:
             print(f"storm-smoke: {'PASS' if verdict['pass'] else 'FAIL'}")
         return 0 if verdict["pass"] else 1
 
+    if args.verb == "durability":
+        from .durability import durability_checks, run_durability
+
+        def one_dur_run():
+            return run_durability(seed=args.seed, pods=args.pods,
+                                  nodes=args.nodes,
+                                  watchdog_s=args.watchdog)
+
+        v1 = one_dur_run()
+        v2 = one_dur_run()
+        checks = durability_checks(v1, v2)
+        verdict = dict(v1, checks=checks, pass_=all(checks.values()))
+        verdict["pass"] = verdict.pop("pass_")
+        if args.json:
+            print(json.dumps(verdict, indent=1))
+        else:
+            eps = v1.get("episodes", [])
+            print(f"crash episodes: "
+                  + " ".join(f"{e['label']}(nth={e.get('nth')},"
+                             f"repairs={e.get('writer_repairs')})"
+                             for e in eps))
+            print(f"fingerprints: bind={v1.get('bind_fingerprint')} "
+                  f"ledger={v1.get('ledger_fingerprint')} "
+                  f"elapsed={v1.get('elapsed_s')}s"
+                  f"+{v2.get('elapsed_s')}s")
+            for name, ok in checks.items():
+                print(f"  {name}: {'ok' if ok else 'FAIL'}")
+            print(f"durability-smoke: "
+                  f"{'PASS' if verdict['pass'] else 'FAIL'}")
+        return 0 if verdict["pass"] else 1
+
     if args.verb == "federation" and args.procs:
         from ..replication.chaos import run_federation_procs
 
@@ -1169,6 +1220,11 @@ def dispatch_sim(args) -> int:
             "supervisor_restarted":
                 v1.get("supervisor_restarts", 0) >= 1
                 and v1.get("restarted_ready", False),
+            # the SIGKILLed replica came back through local WAL replay
+            # (--data-dir on every replica; docs/design/durability.md)
+            "restarted_recovered_wal":
+                v1.get("restarted_recovered_wal", False)
+                and v2.get("restarted_recovered_wal", False),
             # every watch client's chain converged on a live replica
             # with zero duplicated frames; every acked write survives
             # the takeovers (post-replay diff empty)
