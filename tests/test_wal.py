@@ -137,6 +137,31 @@ class TestTornAndCorrupt:
         assert err.offset >= 0
         assert "refus" in str(err) or "corrupt" in str(err).lower()
 
+    def test_rv_gap_inside_crc_valid_record_refuses(self, tmp_path):
+        """Regression: replay validated contiguity only at record
+        boundaries — an interior rv gap in a CRC-valid record was
+        silently absorbed. A record is one contiguous run by
+        construction, so an interior gap is framing damage."""
+        from volcano_tpu.apiserver.codec import encode_object
+        store, wal = _mk_wal(tmp_path)
+        _create(store, 3)
+        wal.pump()
+        wal.close()
+        pod = build_pod("wal", "forged", "", "Pending",
+                        {"cpu": "1", "memory": "1Gi"})
+        enc = encode_object("pods", pod)
+        rec = json.dumps(
+            {"t": "e", "lo": 4, "hi": 6,
+             "e": [[4, "ADDED", "pods", enc],
+                   [6, "ADDED", "pods", enc]]},
+            separators=(",", ":")).encode()
+        seg = str(tmp_path / _segments(tmp_path)[-1])
+        with open(seg, "ab") as f:
+            f.write(pack_record(rec))
+        with pytest.raises(WalCorruptionError) as ei:
+            recover_store(str(tmp_path))
+        assert "gap inside record" in str(ei.value)
+
 
 class TestGroupCommit:
     def test_concurrent_flushers_never_reorder_records(self, tmp_path):
@@ -239,6 +264,76 @@ class TestDegradeHeal:
         # EIO never self-heals: fsyncgate semantics
         wal.pump()
         assert wal.report()["read_only"]
+
+    def test_poisoned_wal_drops_appends_instead_of_leaking(self, tmp_path):
+        """Regression: while fsync-poisoned the flusher never drains, but
+        fence advances (not gated by read-only) kept enqueueing — an
+        unbounded leak over a long-lived degraded process. Poison now
+        clears the queue and drops every later append."""
+        faults = FileFaults(fail_fsync_after=0)
+        store, wal = _mk_wal(tmp_path, opener=faults.opener)
+        _create(store, 1)
+        wal.pump()               # first fsync EIOs -> poisoned
+        assert wal.report()["read_only"]
+        assert wal.report()["pending_entries"] == 0
+        assert len(wal._pending) == 0
+        for t in range(50):
+            store.advance_fence(t + 1)
+        wal.append_entries([(99, "ADDED", "pods", object())])
+        assert len(wal._pending) == 0
+        assert wal.report()["pending_entries"] == 0
+
+    def test_degrade_with_inflight_writer_neither_blocks_nor_deadlocks(
+            self, tmp_path):
+        """Regression for the ABBA deadlock: the flusher used to hold
+        the WAL lock across write+fsync AND call store.enter_read_only
+        from inside it on failure, while a writer holding the STORE
+        lock blocked in append_entries on the same WAL lock. Two
+        tripwires: the enqueue path must not wait on an in-flight
+        fsync, and the degradation path must notify the store without
+        any WAL lock held."""
+        import errno as _errno
+        in_fsync = threading.Event()
+        release = threading.Event()
+
+        class BlockingFsyncFile:
+            def __init__(self, raw):
+                self._raw = raw
+
+            def write(self, data):
+                return self._raw.write(data)
+
+            def fsync(self):
+                in_fsync.set()
+                release.wait(timeout=10.0)
+                raise OSError(_errno.EIO, "injected: fsync failed")
+
+            def fileno(self):
+                return self._raw.fileno()
+
+            def close(self):
+                self._raw.close()
+
+        store, wal = _mk_wal(
+            tmp_path,
+            opener=lambda p: BlockingFsyncFile(open(p, "ab", buffering=0)))
+        _create(store, 1)
+        flusher = threading.Thread(target=wal.flush, daemon=True)
+        flusher.start()
+        assert in_fsync.wait(5.0)
+        writer = threading.Thread(
+            target=lambda: _create(store, 1, prefix="inflight"),
+            daemon=True)
+        writer.start()
+        writer.join(2.0)
+        assert not writer.is_alive()     # writers never wait on fsync
+        release.set()
+        flusher.join(5.0)
+        assert not flusher.is_alive()    # degrade must not ABBA-deadlock
+        assert wal.report()["read_only"]
+        assert store.read_only_reason()
+        with pytest.raises(ReadOnlyError):
+            _create(store, 1, prefix="rejected")
 
 
 class TestCompaction:

@@ -240,13 +240,24 @@ class WriteAheadLog:
         self._opener = opener or _default_opener
         self._on_degrade = on_degrade
         self.store = None
+        # Three locks, strictly ordered _flush_serial -> _io -> _lock
+        # (never the reverse):
+        #
+        # - _lock/_cond guard ONLY the pending queue + flusher wakeup
+        #   flags. Enqueue runs under the STORE lock, so nothing held
+        #   here may ever block on file I/O (writers must not wait on
+        #   fsync) or call back into the store (ABBA deadlock: a writer
+        #   holding the store lock blocks in append_entries while the
+        #   flusher holding a WAL lock blocks in enter_read_only).
+        # - _io guards the segment file + durability cursor; write +
+        #   fsync happen under it.
+        # - _flush_serial serializes whole flushes: two concurrent
+        #   flushes draining separate batches and racing to the file
+        #   write would land records out of rv order — a gap to the
+        #   recovery scan.
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        # flush serializer: drain -> encode -> write is ONE critical
-        # section per flush. Encoding runs off _lock (appends never
-        # wait on it), but two concurrent flushes draining separate
-        # batches and racing to the file write would land records out
-        # of rv order — a gap to the recovery scan.
+        self._io = threading.Lock()
         self._flush_serial = threading.Lock()
         self._pending: deque = deque()      # ("e", entries) | ("f", token)
         self._pending_entries = 0
@@ -286,7 +297,7 @@ class WriteAheadLog:
         from replay mode to append mode)."""
         self.store = store
         gen, seq = _max_gen_seq(self.data_dir)
-        with self._lock:
+        with self._io:
             self._generation = gen
             self._seq = seq
             self._durable_rv = store.current_rv()
@@ -300,6 +311,8 @@ class WriteAheadLog:
         """Enqueue one contiguous run of journal entries (refs — the
         flusher encodes off-lock). Called by the sequencer on every
         journal-tail advance."""
+        if self._fsync_poisoned:
+            return      # terminal: the queue would never drain again
         t0 = time.perf_counter()
         with self._cond:
             self._pending.append(("e", entries))
@@ -311,6 +324,8 @@ class WriteAheadLog:
             m.inc(m.WAL_APPENDS)
 
     def append_fence(self, token: int) -> None:
+        if self._fsync_poisoned:
+            return      # terminal: the queue would never drain again
         with self._cond:
             self._pending.append(("f", int(token)))
             self._cond.notify()
@@ -370,7 +385,7 @@ class WriteAheadLog:
             else:
                 self.flush()
         finally:
-            with self._lock:
+            with self._io:
                 if self._file is not None:
                     try:
                         self._file.close()
@@ -453,7 +468,8 @@ class WriteAheadLog:
             n_entries += len(entries)
         blob = b"".join(records)
         t0 = time.perf_counter()
-        with self._lock:
+        fail_reason = None
+        with self._io:
             if self._fsync_poisoned:
                 return 0
             start_size = self._segment_bytes
@@ -465,22 +481,35 @@ class WriteAheadLog:
                 _maybe_crash("pre-fsync")
                 self._do_fsync_locked()
             except OSError as e:
-                self._handle_write_error_locked(e, start_size)
-                # re-enqueue the drained batch at the FRONT: the segment
-                # was wound back to a clean prefix, so the retry after
-                # an ENOSPC heal re-lands the same records in the same
-                # order and recovery never sees an rv gap
-                if not self._fsync_poisoned:
+                fail_reason = self._handle_write_error_locked(
+                    e, start_size)
+                poisoned = self._fsync_poisoned
+            else:
+                self._durable_rv = hi_rv
+                self.records_written += len(records)
+                self.entries_written += n_entries
+                self.flushes += 1
+                if self._segment_bytes >= self.segment_max_bytes:
+                    self._rotate_locked(self._durable_rv)
+        if fail_reason is not None:
+            # off _io: the store call in _notify_degrade takes the
+            # store lock, which a writer blocked in append_entries may
+            # hold — acquiring it under a WAL lock would ABBA-deadlock
+            with self._cond:
+                if poisoned:
+                    # nothing will ever drain again — don't leak
+                    self._pending.clear()
+                    self._pending_entries = 0
+                else:
+                    # re-enqueue the drained batch at the FRONT: the
+                    # segment was wound back to a clean prefix, so the
+                    # retry after an ENOSPC heal re-lands the same
+                    # records in the same order and recovery never
+                    # sees an rv gap
                     self._pending.extendleft(reversed(batch))
                     self._pending_entries += n_entries
-                return 0
-            self._durable_rv = hi_rv
-            self.records_written += len(records)
-            self.entries_written += n_entries
-            self.flushes += 1
-            rotate = self._segment_bytes >= self.segment_max_bytes
-            if rotate:
-                self._rotate_locked(self._durable_rv)
+            self._notify_degrade(fail_reason)
+            return 0
         self._fsync_ms.append((time.perf_counter() - t0) * 1000.0)
         self._heal()
         m = _metrics()
@@ -503,10 +532,13 @@ class WriteAheadLog:
             m.inc(m.WAL_FSYNCS)
 
     def _handle_write_error_locked(self, e: OSError,
-                                   start_size: int) -> None:
+                                   start_size: int) -> str:
         """A failed append must never leave a torn record MID-log: wind
         the segment back to the pre-record size so the log stays a clean
-        prefix, then degrade the store to read-only."""
+        prefix. Records the degraded state (caller holds ``_io``) and
+        returns the reason — the caller notifies the store OFF the WAL
+        locks (enter_read_only takes the store lock, which a writer
+        blocked in append_entries may hold)."""
         self.append_errors += 1
         if e.errno not in (errno.ENOSPC, errno.EDQUOT):
             # EIO / unknown: durability of already-written bytes is
@@ -520,10 +552,17 @@ class WriteAheadLog:
             self._fsync_poisoned = True
         reason = (f"WAL append failed: [{errno.errorcode.get(e.errno, e.errno)}] "
                   f"{e.strerror or e}")
-        self._degrade(reason)
+        self._degraded = reason
+        return reason
 
     def _degrade(self, reason: str) -> None:
-        self._degraded = reason
+        with self._io:
+            self._degraded = reason
+        self._notify_degrade(reason)
+
+    def _notify_degrade(self, reason: str) -> None:
+        """Propagate a recorded degradation. MUST be called with no WAL
+        lock held: enter_read_only acquires the store lock."""
         if self.store is not None:
             self.store.enter_read_only(reason)
         if self._on_degrade is not None:
@@ -537,10 +576,13 @@ class WriteAheadLog:
 
     def _heal(self) -> None:
         """A successful full flush after an ENOSPC episode (space was
-        freed) lifts the read-only gate; a poisoned fsync never heals."""
-        if self._degraded is None or self._fsync_poisoned:
-            return
-        self._degraded = None
+        freed) lifts the read-only gate; a poisoned fsync never heals.
+        Store notification runs off the WAL locks (same deadlock rule
+        as _notify_degrade)."""
+        with self._io:
+            if self._degraded is None or self._fsync_poisoned:
+                return
+            self._degraded = None
         if self.store is not None:
             self.store.exit_read_only()
         m = _metrics()
@@ -589,7 +631,7 @@ class WriteAheadLog:
         """Generation bump after a snapshot install: new segments, new
         snapshot, old generation's files purged (their rv space is
         dead). Runs on the flusher thread, off the store lock."""
-        with self._lock:
+        with self._io:
             self._generation += 1
             self._durable_rv = rv
             self._fsync_poisoned = False
@@ -604,7 +646,7 @@ class WriteAheadLog:
             return self._durable_rv
         from .persistence import save_store_anchored
         self.flush()
-        with self._lock:
+        with self._io:
             self._rotate_locked(self._durable_rv)
         try:
             # settle=True: anchoring at the raw allocation counter
@@ -663,6 +705,7 @@ class WriteAheadLog:
                 pass
         with self._lock:
             pending = self._pending_entries
+        with self._io:
             durable = self._durable_rv
         store_rv = self.store.current_rv() if self.store is not None \
             else 0
@@ -803,6 +846,14 @@ def recover_store(data_dir: str, store=None, clock=None) -> tuple:
                     continue        # below the snapshot anchor
                 if rv != expected and not entries and rv <= expected - 1:
                     continue
+                if entries and rv != entries[-1][0] + 1:
+                    # a CRC-valid record is still one contiguous run by
+                    # construction — an interior gap is framing damage,
+                    # never silently absorbed
+                    raise WalCorruptionError(
+                        f"WAL rv gap inside record in {path}: "
+                        f"{entries[-1][0]} followed by {rv} — refusing "
+                        f"to replay a damaged log", segment=path)
                 entries.append((rv, action, kind,
                                 decode_object(kind, data)))
             if not entries:

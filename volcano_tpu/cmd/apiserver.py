@@ -180,13 +180,33 @@ def main(argv=None) -> int:
         source = HTTPReplicationSource(args.replicate_from)
         name = args.replica_name or f"{args.host}:{args.port}"
         follower = FollowerReplica(name, source, store=store, hub=hub)
+        resume_local = False
         if recovered_rv > 0:
             # federation restart fast path (docs/design/durability.md):
             # local WAL recovery already re-anchored the mirror at the
             # leader's rvs — resume the journal pull from there and only
             # fall back to the peer snapshot bootstrap when the sync
             # loop proves the log behind the leader's retained window
-            # (gap -> catch-up relist -> bootstrap, follower.py)
+            # (gap -> catch-up relist -> bootstrap, follower.py).
+            # Guarded like FederationMember._ensure_following
+            # (election.py): the local log is only trusted while the
+            # upstream's fence epoch is <= the recovered floor (no
+            # takeover since the log's last durable fence record) and
+            # our rv does not run AHEAD of the upstream head — a
+            # rebuilt/diverged upstream whose rv space overlaps ours
+            # contiguously would otherwise resume silently divergent
+            # (the sync loop sees no gap to trip on).
+            try:
+                up_head = source.current_rv()
+                _, _, gone, up_epoch = source.collect(up_head,
+                                                      timeout=0.0)
+                resume_local = (not gone
+                                and up_epoch <= recovery["fence_floor"]
+                                and recovered_rv <= up_head)
+            except Exception as e:
+                print(f"follower: upstream probe failed ({e}); "
+                      f"falling back to snapshot bootstrap", flush=True)
+        if resume_local:
             print(f"follower resuming from local WAL at rv "
                   f"{recovered_rv} (peer bootstrap skipped)", flush=True)
         else:

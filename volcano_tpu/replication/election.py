@@ -472,9 +472,29 @@ class FederationMember:
             floor, self._recovery_floor = self._recovery_floor, None
             token = int(self.board.peek().get("token") or 0)
             if token <= floor:
-                # local-WAL fast path: same regime as the recovered log
-                needs_bootstrap = False
-                self.bootstrap_skips += 1
+                # local-WAL fast path candidate — but the LOCAL board is
+                # not authoritative right after a restart: a takeover
+                # that happened while this replica was down is only
+                # learned from the new leader's lease push, which may
+                # not have arrived yet.  A deposed leader's acked-but-
+                # never-replicated WAL tail occupies rvs the new regime
+                # reassigned, and that overlap is rv-contiguous — the
+                # sync loop would resume over it with no gap to trip on
+                # (silent divergence).  So confirm against the UPSTREAM:
+                # its fence epoch must still be <= the recovered floor
+                # (no takeover since the log's last durable fence
+                # record) and the local log must not run AHEAD of its
+                # head.  Probe failure keeps the snapshot bootstrap.
+                try:
+                    up_head = source.current_rv()
+                    _, _, gone, up_epoch = source.collect(up_head,
+                                                          timeout=0.0)
+                    if (not gone and int(up_epoch) <= floor
+                            and self.store.current_rv() <= up_head):
+                        needs_bootstrap = False
+                        self.bootstrap_skips += 1
+                except Exception:
+                    pass
         if needs_bootstrap:
             try:
                 follower.bootstrap()
